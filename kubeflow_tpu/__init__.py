@@ -1,0 +1,26 @@
+"""kubeflow_tpu — a TPU-native notebook-workbench control plane.
+
+A from-scratch re-implementation of the capabilities of the OpenDataHub/Kubeflow
+notebook subsystem (reference: red-hat-data-services/kubeflow, see SURVEY.md):
+a ``Notebook`` custom resource reconciled into StatefulSets + Services, a
+mutating/validating admission webhook, Gateway-API routing with an auth sidecar,
+idle culling — re-designed so the workload layer is TPU-native: StatefulSets
+request ``google.com/tpu`` with GKE TPU nodeSelectors, multi-host slices get a
+headless Service plus ``TPU_WORKER_ID`` / ``TPU_WORKER_HOSTNAMES`` injection,
+and culling treats a slice as one atomic unit.
+
+Package map
+-----------
+- ``api``         Notebook CR types + CRD manifest (reference: components/notebook-controller/api)
+- ``cluster``     API-machinery: in-process apiserver, chaos client, kubelet simulator
+- ``controllers`` core reconciler, culler, manager/workqueue
+- ``tpu``         topology → slice provisioning math (the TPU-native core)
+- ``utils``       names, metrics (Prometheus text format), config, k8s helpers
+- ``webhook``     mutating/validating admission (image swap, sidecar, restart gating)
+- ``runtime``     in-container side: mesh bootstrap from TPU_WORKER_* env
+- ``parallel``    jax.sharding mesh/partition conventions, collectives, ring attention
+- ``ops``         Pallas/XLA kernels for the hot paths of provisioned workloads
+- ``models``      flagship workloads used for slice verification + benchmarking
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,5 @@
+from .types import (API_VERSION, GROUP, KIND, new_notebook, notebook_container,
+                    validate_notebook)
+
+__all__ = ["API_VERSION", "GROUP", "KIND", "new_notebook",
+           "notebook_container", "validate_notebook"]

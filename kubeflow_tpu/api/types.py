@@ -1,0 +1,99 @@
+"""Notebook CRD types.
+
+Reference shape: components/notebook-controller/api/v1/notebook_types.go:27-88 —
+``Notebook{Spec{Template{Spec: corev1.PodSpec}}, Status{Conditions,
+ReadyReplicas, ContainerState}}`` with kubeflow.org/v1 as the storage version
+(api/v1/notebook_types.go:67-68). The spec is deliberately a bare PodSpec
+wrapper: users provide the pod template; controllers and webhooks enrich it.
+
+This framework keeps that wire shape byte-compatible (so existing Notebook CRs
+apply unchanged) and adds the TPU request as annotations
+(``tpu.kubeflow.org/accelerator`` / ``tpu.kubeflow.org/topology``) rather than
+spec fields, matching the reference's convention of feature-gating via
+annotations (SURVEY §5 config system)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..cluster.errors import InvalidError
+from ..utils import k8s
+
+GROUP = "kubeflow.org"
+VERSION = "v1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND = "Notebook"
+
+# Condition types mirrored into status from the pod (reference
+# notebook_controller.go:299-374 mirrors pod conditions verbatim).
+CONDITION_RUNNING = "Running"
+CONDITION_WAITING = "Waiting"
+CONDITION_READY = "Ready"
+# TPU-native aggregate condition (new): all workers of a slice ready AND the
+# JAX mesh formed — SURVEY §7 hard part "multi-host readiness semantics".
+CONDITION_SLICE_READY = "SliceReady"
+
+
+def new_notebook(name: str, namespace: str, *,
+                 image: str = "jupyter-minimal:latest",
+                 annotations: dict[str, str] | None = None,
+                 labels: dict[str, str] | None = None,
+                 containers: list[dict] | None = None,
+                 pod_spec_extra: dict | None = None) -> dict:
+    """Build a Notebook CR in wire form."""
+    if containers is None:
+        containers = [{"name": name, "image": image}]
+    pod_spec: dict[str, Any] = {"containers": containers}
+    if pod_spec_extra:
+        pod_spec.update(pod_spec_extra)
+    nb = {
+        "apiVersion": API_VERSION,
+        "kind": KIND,
+        "metadata": {"name": name, "namespace": namespace},
+        "spec": {"template": {"spec": pod_spec}},
+        "status": {},
+    }
+    if annotations:
+        nb["metadata"]["annotations"] = dict(annotations)
+    if labels:
+        nb["metadata"]["labels"] = dict(labels)
+    return nb
+
+
+def notebook_pod_spec(notebook: dict) -> dict:
+    return k8s.get_in(notebook, "spec", "template", "spec", default={}) or {}
+
+
+def notebook_container(notebook: dict) -> dict | None:
+    """The notebook container is the one named after the CR; fallback to the
+    first container (reference webhook uses the same convention,
+    notebook_mutating_webhook.go:861-972)."""
+    spec = notebook_pod_spec(notebook)
+    c = k8s.find_container(spec, k8s.name(notebook))
+    if c is not None:
+        return c
+    containers = spec.get("containers") or []
+    return containers[0] if containers else None
+
+
+def validate_notebook(notebook: dict) -> None:
+    """Structural validation the CRD schema would enforce."""
+    if k8s.kind(notebook) != KIND:
+        raise InvalidError(f"kind must be {KIND}")
+    if notebook.get("apiVersion") != API_VERSION:
+        raise InvalidError(f"apiVersion must be {API_VERSION}")
+    if not k8s.name(notebook):
+        raise InvalidError("metadata.name required")
+    containers = notebook_pod_spec(notebook).get("containers")
+    if not containers:
+        raise InvalidError("spec.template.spec.containers must be non-empty")
+    for c in containers:
+        if not c.get("name") or not c.get("image"):
+            raise InvalidError("containers require name and image")
+
+
+def get_condition(notebook: dict, cond_type: str) -> dict | None:
+    for c in k8s.get_in(notebook, "status", "conditions", default=[]) or []:
+        if c.get("type") == cond_type:
+            return c
+    return None

@@ -1,0 +1,8 @@
+from .errors import ApiError, ConflictError, NotFoundError, AlreadyExistsError, InvalidError
+from .store import ClusterStore, WatchEvent
+from .chaos import ChaosClient, FaultConfig
+
+__all__ = [
+    "ApiError", "ConflictError", "NotFoundError", "AlreadyExistsError",
+    "InvalidError", "ClusterStore", "WatchEvent", "ChaosClient", "FaultConfig",
+]

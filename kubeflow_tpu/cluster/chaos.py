@@ -1,0 +1,96 @@
+"""Fault-injecting client wrapper — the operator-chaos SDK analog.
+
+The reference's chaos tests wrap the envtest client with per-operation error
+rates (sdk.NewChaosClient, odh chaostests/chaos_test.go:42-54) and assert both
+error propagation and reconvergence after Deactivate(). This wrapper provides
+the same seam over ClusterStore for our chaos tests."""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+from .errors import ApiError
+from .store import ClusterStore
+
+
+class InjectedFault(ApiError):
+    code = 500
+    reason = "InjectedFault"
+
+
+@dataclass
+class FaultConfig:
+    """Per-verb error probabilities in [0, 1]."""
+    get: float = 0.0
+    list: float = 0.0
+    create: float = 0.0
+    update: float = 0.0
+    patch: float = 0.0
+    delete: float = 0.0
+    active: bool = True
+    seed: int | None = None
+    _rng: random.Random = field(init=False, repr=False, default=None)  # type: ignore
+
+    def __post_init__(self) -> None:
+        self._rng = random.Random(self.seed)
+
+    def deactivate(self) -> None:
+        self.active = False
+
+    def activate(self) -> None:
+        self.active = True
+
+    def should_fail(self, verb: str) -> bool:
+        rate = getattr(self, verb, 0.0)
+        return self.active and rate > 0 and self._rng.random() < rate
+
+
+class ChaosClient:
+    """Duck-types ClusterStore's verb surface; controllers take either."""
+
+    def __init__(self, store: ClusterStore, config: FaultConfig):
+        self._store = store
+        self.config = config
+
+    def _maybe_fail(self, verb: str) -> None:
+        if self.config.should_fail(verb):
+            raise InjectedFault(f"injected {verb} fault")
+
+    def create(self, obj):
+        self._maybe_fail("create")
+        return self._store.create(obj)
+
+    def get(self, kind, namespace, name):
+        self._maybe_fail("get")
+        return self._store.get(kind, namespace, name)
+
+    def get_or_none(self, kind, namespace, name):
+        self._maybe_fail("get")
+        return self._store.get_or_none(kind, namespace, name)
+
+    def list(self, kind, namespace=None, label_selector=None):
+        self._maybe_fail("list")
+        return self._store.list(kind, namespace, label_selector)
+
+    def update(self, obj):
+        self._maybe_fail("update")
+        return self._store.update(obj)
+
+    def update_status(self, obj):
+        self._maybe_fail("update")
+        return self._store.update_status(obj)
+
+    def patch(self, kind, namespace, name, patch):
+        self._maybe_fail("patch")
+        return self._store.patch(kind, namespace, name, patch)
+
+    def delete(self, kind, namespace, name):
+        self._maybe_fail("delete")
+        return self._store.delete(kind, namespace, name)
+
+    def watch(self, *args, **kwargs):
+        return self._store.watch(*args, **kwargs)
+
+    def register_admission(self, *args, **kwargs):
+        return self._store.register_admission(*args, **kwargs)

@@ -1,0 +1,55 @@
+"""API error taxonomy mirroring k8s.io/apimachinery/pkg/api/errors.
+
+The reference's controllers branch on apierrs.IsNotFound / IsConflict /
+IsAlreadyExists everywhere (e.g. notebook_controller.go:151-204); our
+controllers do the same against these exception types."""
+
+from __future__ import annotations
+
+
+class ApiError(Exception):
+    code = 500
+    reason = "InternalError"
+
+    def __init__(self, message: str = ""):
+        super().__init__(message or self.reason)
+        self.message = message or self.reason
+
+
+class NotFoundError(ApiError):
+    code = 404
+    reason = "NotFound"
+
+
+class AlreadyExistsError(ApiError):
+    code = 409
+    reason = "AlreadyExists"
+
+
+class ConflictError(ApiError):
+    """Optimistic-concurrency failure (stale resourceVersion) — what
+    retry.RetryOnConflict retries on in the reference."""
+    code = 409
+    reason = "Conflict"
+
+
+class InvalidError(ApiError):
+    code = 422
+    reason = "Invalid"
+
+
+class ForbiddenError(ApiError):
+    code = 403
+    reason = "Forbidden"
+
+
+def is_not_found(err: Exception) -> bool:
+    return isinstance(err, NotFoundError)
+
+
+def is_conflict(err: Exception) -> bool:
+    return isinstance(err, ConflictError)
+
+
+def is_already_exists(err: Exception) -> bool:
+    return isinstance(err, AlreadyExistsError)

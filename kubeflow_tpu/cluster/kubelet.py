@@ -1,0 +1,168 @@
+"""StatefulSet-controller + kubelet simulator.
+
+envtest "has no scheduler/kubelet, so pods never run" and the reference asserts
+on rendered objects only (SURVEY §4.2). We go one step further: this simulator
+reconciles StatefulSets into Pods and marks them Ready after a configurable
+boot delay, so the full CR → slice-ready loop (including status mirroring,
+culling probes and the <90s readiness target, BASELINE.md) is exercisable
+in-process. It reproduces the StatefulSet semantics our TPU layer leans on:
+
+- pods named ``<sts>-<ordinal>`` with the ``apps.kubernetes.io/pod-index``
+  label (the TPU_WORKER_ID source);
+- ``spec.subdomain``/``serviceName`` so worker DNS is representable;
+- scale-down reaps the highest ordinals first; replicas=0 reaps everything
+  (the slice-atomic cull path);
+- pod template changes restart pods (rolling update, OnDelete-ish).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..utils import k8s
+from . import errors
+from .manager_compat import Request, Result  # thin re-export, see module
+from .store import ClusterStore
+
+
+class StatefulSetSimulator:
+    name = "sim-statefulset-controller"
+
+    def __init__(self, client: ClusterStore, boot_delay_s: float = 0.0,
+                 ready_hook=None):
+        """``ready_hook(pod) -> bool`` lets tests/bench gate pod readiness on
+        e.g. a simulated TPU runtime verification."""
+        self.client = client
+        self.boot_delay_s = boot_delay_s
+        self.ready_hook = ready_hook
+        self._boot_times: dict[tuple[str, str], float] = {}
+
+    def setup(self, mgr) -> None:
+        from ..controllers.manager import owner_mapper
+        mgr.register(self)
+        mgr.watch("StatefulSet", self.name)
+        mgr.watch("Pod", self.name, mapper=owner_mapper("StatefulSet"))
+
+    def reconcile(self, req: Request) -> Result | None:
+        sts = self.client.get_or_none("StatefulSet", req.namespace, req.name)
+        if sts is None or k8s.is_deleting(sts):
+            return None
+        replicas = k8s.get_in(sts, "spec", "replicas", default=1)
+        ns, sts_name = req.namespace, req.name
+        selector = k8s.get_in(sts, "spec", "template", "metadata", "labels",
+                              default={}) or {}
+        desired_template = k8s.get_in(sts, "spec", "template", default={})
+
+        requeue: float | None = None
+        existing = {k8s.name(p): p for p in self.client.list("Pod", ns)
+                    if k8s.is_owned_by(p, k8s.uid(sts))}
+
+        # reap pods beyond replicas (highest ordinals first — STS semantics)
+        for pod_name in sorted(existing, reverse=True):
+            ordinal = _ordinal_of(pod_name, sts_name)
+            if ordinal is None or ordinal >= replicas:
+                try:
+                    self.client.delete("Pod", ns, pod_name)
+                except errors.NotFoundError:
+                    pass
+                existing.pop(pod_name, None)
+
+        for i in range(replicas):
+            pod_name = f"{sts_name}-{i}"
+            pod = existing.get(pod_name)
+            if pod is None:
+                pod = self._make_pod(sts, pod_name, i, selector, desired_template)
+                try:
+                    self.client.create(pod)
+                except errors.AlreadyExistsError:
+                    pass
+                self._boot_times[(ns, pod_name)] = time.monotonic()
+                requeue = max(self.boot_delay_s, 0.001)
+                continue
+            # template drift → restart (delete; next pass recreates)
+            if pod.get("spec", {}).get("containers") != \
+                    k8s.get_in(desired_template, "spec", "containers"):
+                try:
+                    self.client.delete("Pod", ns, pod_name)
+                except errors.NotFoundError:
+                    pass
+                requeue = 0.001
+                continue
+            if not _pod_is_ready(pod):
+                booted_at = self._boot_times.get((ns, pod_name), 0.0)
+                if time.monotonic() - booted_at >= self.boot_delay_s and (
+                        self.ready_hook is None or self.ready_hook(pod)):
+                    self._mark_ready(pod)
+                else:
+                    requeue = max(self.boot_delay_s / 4, 0.001)
+
+        ready = sum(1 for p in (self.client.list("Pod", ns))
+                    if k8s.is_owned_by(p, k8s.uid(sts)) and _pod_is_ready(p))
+        if k8s.get_in(sts, "status", "readyReplicas") != ready or \
+                k8s.get_in(sts, "status", "replicas") != replicas:
+            sts["status"] = {"replicas": replicas, "readyReplicas": ready,
+                             "currentReplicas": ready}
+            try:
+                self.client.update_status(sts)
+            except (errors.ConflictError, errors.NotFoundError):
+                requeue = 0.001
+        return Result(requeue_after=requeue) if requeue else None
+
+    def _make_pod(self, sts: dict, pod_name: str, ordinal: int,
+                  selector: dict, template: dict) -> dict:
+        pod_labels = dict(selector)
+        pod_labels["apps.kubernetes.io/pod-index"] = str(ordinal)
+        pod = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": pod_name,
+                "namespace": k8s.namespace(sts),
+                "labels": pod_labels,
+                "annotations": dict(k8s.get_in(
+                    template, "metadata", "annotations", default={}) or {}),
+            },
+            "spec": k8s.deepcopy(template.get("spec", {})),
+            "status": {"phase": "Pending", "conditions": []},
+        }
+        pod["spec"]["hostname"] = pod_name
+        pod["spec"]["subdomain"] = k8s.get_in(sts, "spec", "serviceName",
+                                              default="")
+        k8s.set_controller_reference(sts, pod)
+        return pod
+
+    def _mark_ready(self, pod: dict) -> None:
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        container_statuses = [
+            {"name": c.get("name", ""), "ready": True, "restartCount": 0,
+             "state": {"running": {"startedAt": now}}}
+            for c in k8s.get_in(pod, "spec", "containers", default=[]) or []]
+        pod["status"] = {
+            "phase": "Running",
+            "conditions": [
+                {"type": "PodScheduled", "status": "True"},
+                {"type": "Initialized", "status": "True"},
+                {"type": "ContainersReady", "status": "True"},
+                {"type": "Ready", "status": "True",
+                 "lastTransitionTime": now},
+            ],
+            "containerStatuses": container_statuses,
+        }
+        try:
+            self.client.update_status(pod)
+        except (errors.ConflictError, errors.NotFoundError):
+            pass
+
+
+def _ordinal_of(pod_name: str, sts_name: str) -> int | None:
+    prefix = sts_name + "-"
+    if not pod_name.startswith(prefix):
+        return None
+    suffix = pod_name[len(prefix):]
+    return int(suffix) if suffix.isdigit() else None
+
+
+def _pod_is_ready(pod: dict) -> bool:
+    return any(c.get("type") == "Ready" and c.get("status") == "True"
+               for c in k8s.get_in(pod, "status", "conditions",
+                                   default=[]) or [])

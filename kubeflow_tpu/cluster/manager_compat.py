@@ -1,0 +1,15 @@
+"""Re-export of the manager's Request/Result for cluster-side simulators,
+avoiding a circular import (controllers.manager imports cluster.store)."""
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Request:
+    namespace: str
+    name: str
+
+
+@dataclass
+class Result:
+    requeue_after: float | None = None

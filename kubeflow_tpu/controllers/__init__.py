@@ -1,0 +1,23 @@
+from .manager import Manager, Request
+from .notebook import NotebookReconciler
+from .culling import CullingReconciler
+
+__all__ = ["Manager", "Request", "NotebookReconciler", "CullingReconciler",
+           "setup_controllers"]
+
+
+def setup_controllers(client, config=None, metrics=None, prober=None):
+    """Wire a manager the way the reference main() does
+    (notebook-controller/main.go:58-148): core reconciler always, culler only
+    when ENABLE_CULLING (main.go:111-123). Returns the manager (not started)."""
+    from ..utils.config import ControllerConfig
+    from ..utils.metrics import MetricsRegistry
+
+    config = config or ControllerConfig.from_env()
+    metrics = metrics or MetricsRegistry()
+    mgr = Manager(client)
+    NotebookReconciler(client, config, metrics).setup(mgr)
+    if config.enable_culling:
+        kwargs = {"prober": prober} if prober is not None else {}
+        CullingReconciler(client, config, metrics, **kwargs).setup(mgr)
+    return mgr

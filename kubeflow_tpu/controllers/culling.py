@@ -1,0 +1,222 @@
+"""Idle-culling controller with slice-atomic semantics.
+
+Re-implements the reference CullingReconciler's annotation state machine
+(components/notebook-controller/controllers/culling_controller.go:87-204):
+
+- every notebook re-queues each IDLENESS_CHECK_PERIOD (default 1 min, :33);
+- stop-annotation present → strip activity annotations and exit (:105-118);
+- no worker-0 pod → strip activity annotations (:120-139);
+- first pass initializes ``last-activity`` / ``last_activity_check_timestamp``
+  (:141-154,:458-465);
+- probes Jupyter ``/api/kernels`` + ``/api/terminals`` over HTTP with a 10s
+  timeout (:244-322) — *only worker-0*, which runs the single Jupyter server
+  of a slice;
+- busiest kernel/terminal advances last-activity; idle past CULL_IDLE_TIME
+  (default 1440 min, :32) → set the stop annotation (:170-197,:484-501);
+- every annotation write is conflict-retried (RetryOnConflict, :107,125,144,172).
+
+Slice atomicity (SURVEY §7 stage 5): the stop annotation is observed by the
+core reconciler which scales the one slice StatefulSet to 0 — all workers are
+reaped together; replicas are never partially mutated.
+"""
+
+from __future__ import annotations
+
+import datetime as dt
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable
+
+from ..api import types as api
+from ..cluster import errors
+from ..utils import k8s, names
+from ..utils.config import ControllerConfig
+from ..utils.metrics import MetricsRegistry
+from .manager import Manager, Request, Result
+
+log = logging.getLogger("kubeflow_tpu.culling")
+
+TIME_FORMAT = "%Y-%m-%dT%H:%M:%SZ"
+
+
+def format_time(t: float) -> str:
+    return time.strftime(TIME_FORMAT, time.gmtime(t))
+
+
+def parse_time(s: str) -> float:
+    return dt.datetime.strptime(s, TIME_FORMAT).replace(
+        tzinfo=dt.timezone.utc).timestamp()
+
+
+@dataclass
+class JupyterActivity:
+    """Result of probing a notebook's Jupyter API. ``None`` for an endpoint
+    means that endpoint was unreachable; the reference updates last-activity
+    from kernels and terminals independently (culling_controller.go:244-322),
+    so one dead endpoint must not discard the other's data."""
+    kernels: list[dict] | None = field(default_factory=list)    # {execution_state, last_activity}
+    terminals: list[dict] | None = field(default_factory=list)  # {last_activity}
+
+    @property
+    def reachable(self) -> bool:
+        return self.kernels is not None or self.terminals is not None
+
+    def any_busy(self) -> bool:
+        return any(k.get("execution_state") == "busy"
+                   for k in self.kernels or [])
+
+    def latest_activity(self) -> float | None:
+        stamps = []
+        for item in [*(self.kernels or []), *(self.terminals or [])]:
+            raw = item.get("last_activity")
+            if not raw:
+                continue
+            try:
+                stamps.append(parse_time(raw.split(".")[0].rstrip("Z") + "Z"))
+            except ValueError:
+                continue
+        return max(stamps) if stamps else None
+
+
+def http_prober(config: ControllerConfig) -> Callable[[dict], JupyterActivity]:
+    """Production prober: GET the Jupyter kernels/terminals APIs through the
+    notebook Service (reference URL shape
+    ``http://<name>.<ns>.svc.<domain>/notebook/<ns>/<name>/api/kernels``,
+    culling_controller.go:244-274). In DEV mode the reference targets
+    localhost; we keep the cluster path only."""
+    def probe(notebook: dict) -> JupyterActivity:
+        ns, name = k8s.namespace(notebook), k8s.name(notebook)
+        base = (f"http://{name}.{ns}.svc.{config.cluster_domain}"
+                f"{names.nb_prefix(ns, name)}/api")
+        out = JupyterActivity()
+        for endpoint in ("kernels", "terminals"):
+            try:
+                with urllib.request.urlopen(
+                        f"{base}/{endpoint}",
+                        timeout=config.jupyter_probe_timeout_s) as resp:
+                    setattr(out, endpoint, json.loads(resp.read()))
+            except (urllib.error.URLError, OSError, ValueError) as exc:
+                log.debug("probe %s/%s %s failed: %s", ns, name, endpoint, exc)
+                setattr(out, endpoint, None)
+        return out
+    return probe
+
+
+class CullingReconciler:
+    name = "culling-controller"
+
+    def __init__(self, client, config: ControllerConfig | None = None,
+                 metrics: MetricsRegistry | None = None,
+                 prober: Callable[[dict], JupyterActivity] | None = None,
+                 clock: Callable[[], float] = time.time):
+        self.client = client
+        self.config = config or ControllerConfig()
+        self.metrics = metrics or MetricsRegistry()
+        self.prober = prober or http_prober(self.config)
+        self.clock = clock
+
+    def setup(self, mgr: Manager) -> None:
+        mgr.register(self)
+        mgr.watch(api.KIND, self.name)
+
+    # ------------------------------------------------------------ reconcile
+    def reconcile(self, req: Request) -> Result | None:
+        notebook = self.client.get_or_none(api.KIND, req.namespace, req.name)
+        if notebook is None or k8s.is_deleting(notebook):
+            return None
+        period_s = self.config.idleness_check_period_min * 60
+
+        # stopped → annotations cleared, stop polling (reference :105-118)
+        if k8s.get_annotation(notebook, names.STOP_ANNOTATION) is not None:
+            self._strip_activity_annotations(notebook)
+            return None
+
+        # worker-0 must exist (reference checks pod <name>-0, :120-139)
+        pod0 = self._worker0_pod(notebook)
+        if pod0 is None:
+            self._strip_activity_annotations(notebook)
+            return Result(requeue_after=period_s)
+
+        now = self.clock()
+        last_check = k8s.get_annotation(
+            notebook, names.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION)
+        last_activity = k8s.get_annotation(notebook,
+                                           names.LAST_ACTIVITY_ANNOTATION)
+        if last_check is None or last_activity is None:
+            # first pass: initialize (reference :141-154,:458-465)
+            self._retry_patch_annotations(notebook, {
+                names.LAST_ACTIVITY_ANNOTATION: format_time(now),
+                names.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION: format_time(now),
+            })
+            return Result(requeue_after=period_s)
+
+        if now - parse_time(last_check) < period_s:
+            return Result(requeue_after=period_s)  # reference :156-160
+
+        activity = self.prober(notebook)
+        updates = {names.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION:
+                   format_time(now)}
+        if activity.reachable:
+            if activity.any_busy():
+                updates[names.LAST_ACTIVITY_ANNOTATION] = format_time(now)
+            else:
+                latest = activity.latest_activity()
+                if latest is not None and latest > parse_time(last_activity):
+                    updates[names.LAST_ACTIVITY_ANNOTATION] = format_time(latest)
+
+        effective_last = parse_time(
+            updates.get(names.LAST_ACTIVITY_ANNOTATION, last_activity))
+        idle_s = now - effective_last
+        if idle_s > self.config.cull_idle_time_min * 60:
+            # cull: set stop annotation → core reconciler scales slice STS→0
+            # (reference setStopAnnotation, :484-501)
+            updates[names.STOP_ANNOTATION] = format_time(now)
+            self.metrics.record_culling(req.namespace, req.name)
+            log.info("culling %s/%s (idle %.0fs)", req.namespace, req.name,
+                     idle_s)
+        self._retry_patch_annotations(notebook, updates)
+        return Result(requeue_after=period_s)
+
+    # -------------------------------------------------------------- helpers
+    def _worker0_pod(self, notebook: dict) -> dict | None:
+        """The slice's Jupyter pod. With GenerateName STSs the pod isn't
+        ``<nb>-0`` literally, so resolve through the notebook-name label +
+        pod-index 0."""
+        for pod in self.client.list("Pod", k8s.namespace(notebook),
+                                    {names.NOTEBOOK_NAME_LABEL:
+                                     k8s.name(notebook)}):
+            if k8s.get_label(pod, "apps.kubernetes.io/pod-index", "0") == "0":
+                return pod
+        return None
+
+    def _strip_activity_annotations(self, notebook: dict) -> None:
+        if (k8s.get_annotation(notebook, names.LAST_ACTIVITY_ANNOTATION) is None
+                and k8s.get_annotation(
+                    notebook,
+                    names.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION) is None):
+            return
+        self._retry_patch_annotations(notebook, {
+            names.LAST_ACTIVITY_ANNOTATION: None,
+            names.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION: None,
+        })
+
+    def _retry_patch_annotations(self, notebook: dict,
+                                 annotations: dict[str, str | None]) -> None:
+        """RetryOnConflict analog (merge patch is conflict-free in our store,
+        but retry anyway for client symmetry with chaos wrappers)."""
+        for attempt in range(5):
+            try:
+                self.client.patch(api.KIND, k8s.namespace(notebook),
+                                  k8s.name(notebook),
+                                  {"metadata": {"annotations": annotations}})
+                return
+            except errors.ConflictError:
+                continue
+            except errors.NotFoundError:
+                return
+        log.warning("annotation update for %s/%s kept conflicting",
+                    k8s.namespace(notebook), k8s.name(notebook))

@@ -1,0 +1,237 @@
+"""Controller manager: workqueue, watch wiring, requeue semantics.
+
+Plays the role of sigs.k8s.io/controller-runtime's manager + per-controller
+workqueues (reference main.go:58-148 registers reconcilers on one manager;
+SetupWithManager wires watches, notebook_controller.go:778-826). Semantics
+reproduced:
+
+- a reconcile Request is (namespace, name) — events are coalesced per key;
+- reconcilers return a ``Result`` (requeue_after seconds) or raise → error
+  backoff requeue;
+- watches map secondary objects (Pods, Events, owned resources) back to the
+  owning Notebook key.
+
+Two drive modes:
+- ``run_until_idle()`` — deterministic draining for tests/benchmarks (the
+  envtest suites effectively do this by polling with Eventually);
+- ``start()/stop()`` — background thread with timed requeues, the production
+  shape.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Protocol
+
+from ..cluster.store import WatchEvent
+from ..utils import k8s
+
+log = logging.getLogger("kubeflow_tpu.manager")
+
+
+@dataclass(frozen=True)
+class Request:
+    namespace: str
+    name: str
+
+
+@dataclass
+class Result:
+    requeue_after: float | None = None  # seconds
+
+
+class Reconciler(Protocol):
+    name: str
+
+    def reconcile(self, req: Request) -> Result | None: ...
+
+
+@dataclass(order=True)
+class _QueueItem:
+    ready_at: float
+    seq: int
+    controller: str = field(compare=False)
+    req: Request = field(compare=False)
+    timed: bool = field(compare=False, default=False)
+
+
+class Manager:
+    ERROR_BACKOFF_BASE = 0.005   # fast in-process analog of the 5ms rate-limiter base
+    ERROR_BACKOFF_MAX = 2.0
+
+    def __init__(self, client) -> None:
+        self.client = client
+        self._reconcilers: dict[str, Reconciler] = {}
+        self._queue: list[_QueueItem] = []
+        self._queued: set[tuple[str, Request]] = set()
+        # earliest pending timed requeue per key — AddAfter dedup semantics
+        # (controller-runtime's delaying queue coalesces by key; without this,
+        # every watch event would spawn an extra periodic reconcile chain)
+        self._timed_pending: dict[tuple[str, Request], float] = {}
+        self._failures: dict[tuple[str, Request], int] = {}
+        self._cv = threading.Condition()
+        self._seq = 0
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self.healthz: dict[str, bool] = {}
+
+    # ---------------------------------------------------------------- wiring
+    def register(self, reconciler: Reconciler) -> None:
+        self._reconcilers[reconciler.name] = reconciler
+        self.healthz[reconciler.name] = True
+
+    def watch(self, kind: str, controller: str,
+              mapper: Callable[[dict], list[Request]] | None = None,
+              predicate: Callable[[WatchEvent], bool] | None = None) -> None:
+        """Wire a store watch into a controller's queue. ``mapper`` converts
+        the observed object into reconcile requests (handler.EnqueueRequestsFromMapFunc);
+        default maps to the object's own key (EnqueueRequestForObject /
+        Owns-style mapping is provided by owner_mapper below)."""
+        def cb(event: WatchEvent) -> None:
+            if predicate is not None and not predicate(event):
+                return
+            reqs = (mapper(event.obj) if mapper is not None
+                    else [Request(k8s.namespace(event.obj), k8s.name(event.obj))])
+            for req in reqs:
+                self.enqueue(controller, req)
+        self.client.watch(kind, cb)
+
+    def enqueue(self, controller: str, req: Request, after: float = 0.0) -> None:
+        with self._cv:
+            key = (controller, req)
+            if after == 0.0:
+                if key in self._queued:
+                    return
+                self._queued.add(key)
+                self._seq += 1
+                heapq.heappush(self._queue,
+                               _QueueItem(time.monotonic(), self._seq,
+                                          controller, req))
+            else:
+                ready_at = time.monotonic() + after
+                pending = self._timed_pending.get(key)
+                if pending is not None and pending <= ready_at:
+                    self._cv.notify_all()
+                    return  # an earlier (or equal) timed requeue already exists
+                self._timed_pending[key] = ready_at
+                self._seq += 1
+                heapq.heappush(self._queue,
+                               _QueueItem(ready_at, self._seq, controller,
+                                          req, timed=True))
+            self._cv.notify_all()
+
+    # --------------------------------------------------------------- driving
+    def _pop_ready(self, block: bool) -> _QueueItem | None:
+        with self._cv:
+            while True:
+                now = time.monotonic()
+                if self._queue and self._queue[0].ready_at <= now:
+                    item = heapq.heappop(self._queue)
+                    key = (item.controller, item.req)
+                    if item.timed:
+                        if self._timed_pending.get(key) != item.ready_at:
+                            continue  # superseded by an earlier requeue; drop
+                        del self._timed_pending[key]
+                    else:
+                        self._queued.discard(key)
+                    return item
+                if not block:
+                    return None
+                timeout = (self._queue[0].ready_at - now) if self._queue else None
+                if not self._running:
+                    return None
+                self._cv.wait(timeout=timeout if timeout is None or timeout > 0 else 0)
+
+    def _process(self, item: _QueueItem) -> None:
+        rec = self._reconcilers.get(item.controller)
+        if rec is None:
+            return
+        key = (item.controller, item.req)
+        try:
+            result = rec.reconcile(item.req)
+        except Exception as exc:  # noqa: BLE001 — error→requeue, never crash the loop
+            failures = self._failures.get(key, 0) + 1
+            self._failures[key] = failures
+            backoff = min(self.ERROR_BACKOFF_BASE * (2 ** failures),
+                          self.ERROR_BACKOFF_MAX)
+            log.warning("reconcile %s %s failed (%s); requeue in %.3fs",
+                        item.controller, item.req, exc, backoff)
+            self.enqueue(item.controller, item.req, after=backoff)
+            return
+        self._failures.pop(key, None)
+        if result is not None and result.requeue_after is not None:
+            self.enqueue(item.controller, item.req, after=result.requeue_after)
+
+    def run_until_idle(self, timeout: float = 30.0,
+                       include_delayed_under: float = 0.0) -> int:
+        """Drain the queue synchronously; returns number of reconciles run.
+        Timed requeues further than ``include_delayed_under`` seconds out are
+        left pending (so periodic culler requeues don't spin forever)."""
+        deadline = time.monotonic() + timeout
+        count = 0
+        while time.monotonic() < deadline:
+            item = self._pop_ready(block=False)
+            if item is None:
+                with self._cv:
+                    upcoming = [q for q in self._queue
+                                if q.ready_at - time.monotonic() <= include_delayed_under]
+                if not upcoming:
+                    return count
+                time.sleep(0.001)
+                continue
+            self._process(item)
+            count += 1
+        return count
+
+    def start(self) -> None:
+        with self._cv:
+            if self._running:
+                return
+            self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="kubeflow-tpu-manager")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                if not self._running:
+                    return
+            item = self._pop_ready(block=True)
+            if item is None:
+                continue
+            self._process(item)
+
+    def stop(self) -> None:
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def owner_mapper(owner_kind: str) -> Callable[[dict], list[Request]]:
+    """Owns()-style mapping: enqueue the controller owner of the observed
+    object."""
+    def mapper(obj: dict) -> list[Request]:
+        for ref in k8s.get_in(obj, "metadata", "ownerReferences", default=[]) or []:
+            if ref.get("kind") == owner_kind and ref.get("controller"):
+                return [Request(k8s.namespace(obj), ref["name"])]
+        return []
+    return mapper
+
+
+def label_mapper(label_key: str) -> Callable[[dict], list[Request]]:
+    """Map via a label value — the reference maps Pods to Notebooks through
+    the ``notebook-name`` label (notebook_controller.go:701-737)."""
+    def mapper(obj: dict) -> list[Request]:
+        val = k8s.get_label(obj, label_key)
+        if val:
+            return [Request(k8s.namespace(obj), val)]
+        return []
+    return mapper

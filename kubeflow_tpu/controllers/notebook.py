@@ -1,0 +1,473 @@
+"""Core Notebook reconciler: Notebook CR → StatefulSet + Service(s) + status.
+
+Re-implements the behavior of the reference's upstream NotebookReconciler
+(components/notebook-controller/controllers/notebook_controller.go:94-826) with
+a TPU-native workload layer:
+
+- stop-annotation drives replicas 0 ↔ N (reference :434-437 drives 0 ↔ 1; here
+  N = slice worker count, which is what makes culling slice-atomic — one STS,
+  all workers share one replica flip, SURVEY §7 stage 5);
+- names > 52 chars fall back to GenerateName "nb-" (reference :59,:444-449);
+- labels/annotations propagate with the kubectl/notebook prefix exclusion
+  (reference :486-491);
+- NB_PREFIX, default workdir and port, fsGroup 100 (reference :417-431,
+  :493-521);
+- Service: ClusterIP, port name "http-notebook", 80 → container port
+  (reference :525-552);
+- NEW: TPU slices get nodeSelectors + google.com/tpu resources + a headless
+  Service + TPU_WORKER_ID/TPU_WORKER_HOSTNAMES injection (SURVEY §7 stage 3);
+- status mirrors pod conditions and adds an aggregate SliceReady condition
+  (reference mirrors only pod-0, :299-374 — SliceReady requires ALL workers);
+- restart annotation deletes pods and strips itself (reference :259-294).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..api import types as api
+from ..cluster import errors
+from ..tpu.topology import SliceSpec, parse_slice_request
+from ..utils import k8s, names
+from ..utils.config import ControllerConfig
+from ..utils.metrics import MetricsRegistry
+from .manager import Manager, Request, Result, label_mapper, owner_mapper
+
+log = logging.getLogger("kubeflow_tpu.notebook")
+
+DEFAULT_CONTAINER_PORT = 8888
+DEFAULT_SERVICE_PORT = 80
+DEFAULT_WORKDIR = "/home/jovyan"
+DEFAULT_FSGROUP = 100
+
+# annotation prefixes NOT copied from CR to pod template (reference :486-491)
+_EXCLUDED_ANNOTATION_PREFIXES = ("kubectl.kubernetes.io/", "notebook")
+
+
+class NotebookReconciler:
+    name = "notebook-controller"
+
+    def __init__(self, client, config: ControllerConfig | None = None,
+                 metrics: MetricsRegistry | None = None):
+        self.client = client
+        self.config = config or ControllerConfig()
+        self.metrics = metrics or MetricsRegistry()
+        self.metrics.on_scrape(self._scrape_running)
+
+    # ------------------------------------------------------------- wiring
+    def setup(self, mgr: Manager) -> None:
+        """Watch wiring — reference SetupWithManager
+        (notebook_controller.go:778-826): own Notebook, own STS/Service,
+        map Pods via the notebook-name label."""
+        mgr.register(self)
+        mgr.watch(api.KIND, self.name)
+        mgr.watch("StatefulSet", self.name, mapper=owner_mapper(api.KIND))
+        mgr.watch("Service", self.name, mapper=owner_mapper(api.KIND))
+        mgr.watch("Pod", self.name, mapper=label_mapper(names.NOTEBOOK_NAME_LABEL))
+
+    def _scrape_running(self) -> None:
+        """notebook_running is computed at scrape time by listing STSs with
+        the notebook-name label (reference pkg/metrics/metrics.go:60-99)."""
+        stss = self.client.list("StatefulSet",
+                                label_selector=None)
+        running = sum(1 for s in stss
+                      if k8s.get_label(s, names.NOTEBOOK_NAME_LABEL)
+                      and k8s.get_in(s, "status", "readyReplicas", default=0))
+        self.metrics.notebook_running.set(running)
+
+    # ---------------------------------------------------------- reconcile
+    def reconcile(self, req: Request) -> Result | None:
+        notebook = self.client.get_or_none(api.KIND, req.namespace, req.name)
+        if notebook is None:
+            return None
+        if k8s.is_deleting(notebook):
+            # upstream reconciler no-ops on deletion (reference :138-140);
+            # owner-reference GC reaps STS/Service
+            return None
+
+        slice_spec = parse_slice_request(
+            k8s.get_in(notebook, "metadata", "annotations", default={}))
+
+        self._reconcile_statefulset(notebook, slice_spec)
+        self._reconcile_service(notebook, slice_spec)
+        if slice_spec is not None and slice_spec.multi_host:
+            self._reconcile_headless_service(notebook, slice_spec)
+        self._handle_restart_annotation(notebook, slice_spec)
+        self._update_status(notebook, slice_spec)
+        return None
+
+    # --------------------------------------------------------- generation
+    def desired_replicas(self, notebook: dict, slice_spec: SliceSpec | None) -> int:
+        """Stop annotation → 0, else the slice worker count (reference
+        :434-437 is the 0/1 version). NEVER a partial count — slice atomicity
+        invariant (SURVEY §7 stage 5)."""
+        if k8s.get_annotation(notebook, names.STOP_ANNOTATION) is not None:
+            return 0
+        return slice_spec.num_workers if slice_spec else 1
+
+    def _propagated_labels(self, notebook: dict) -> dict:
+        labels = {
+            "statefulset": k8s.name(notebook),
+            names.NOTEBOOK_NAME_LABEL: k8s.name(notebook),
+        }
+        for key, val in (k8s.get_in(notebook, "metadata", "labels", default={}) or {}).items():
+            labels[key] = val
+        return labels
+
+    def _propagated_annotations(self, notebook: dict) -> dict:
+        out = {}
+        for key, val in (k8s.get_in(notebook, "metadata", "annotations",
+                                    default={}) or {}).items():
+            if any(key.startswith(p) for p in _EXCLUDED_ANNOTATION_PREFIXES):
+                continue
+            if key in (names.TPU_ACCELERATOR_ANNOTATION,
+                       names.TPU_TOPOLOGY_ANNOTATION):
+                continue  # slice identity lives in labels/env, not pod annotations
+            out[key] = val
+        return out
+
+    def generate_statefulset(self, notebook: dict,
+                             slice_spec: SliceSpec | None,
+                             actual_sts_name: str | None = None) -> dict:
+        """Build the desired StatefulSet (reference generateStatefulSet,
+        notebook_controller.go:433-523, extended with the TPU layer).
+
+        ``actual_sts_name`` is the apiserver-materialized name when the
+        52-char rule forced GenerateName — worker DNS (TPU_WORKER_HOSTNAMES)
+        must be derived from the real pod names ``<sts>-<i>``, not the CR
+        name (SURVEY §7 hard part 'TPU_WORKER_HOSTNAMES correctness')."""
+        nb_name = k8s.name(notebook)
+        ns = k8s.namespace(notebook)
+        sts_name, use_generate = names.sts_name_for_notebook(nb_name)
+        pod_spec = k8s.deepcopy(api.notebook_pod_spec(notebook))
+
+        containers = pod_spec.get("containers", [])
+        for idx, container in enumerate(containers):
+            if container.get("name") != nb_name and idx != 0:
+                continue
+            if container.get("name") == nb_name or idx == 0:
+                container.setdefault("workingDir", DEFAULT_WORKDIR)
+                if not container.get("ports"):
+                    container["ports"] = [{
+                        "containerPort": DEFAULT_CONTAINER_PORT,
+                        "name": "notebook-port",
+                        "protocol": "TCP",
+                    }]
+                k8s.upsert_env(container, "NB_PREFIX", names.nb_prefix(ns, nb_name))
+                break
+
+        if self.config.add_fsgroup:
+            pod_spec.setdefault("securityContext", {}).setdefault(
+                "fsGroup", DEFAULT_FSGROUP)
+
+        sts = {
+            "apiVersion": "apps/v1",
+            "kind": "StatefulSet",
+            "metadata": {
+                "namespace": ns,
+                "labels": self._propagated_labels(notebook),
+                "annotations": self._propagated_annotations(notebook),
+            },
+            "spec": {
+                "replicas": self.desired_replicas(notebook, slice_spec),
+                "selector": {"matchLabels": {"statefulset": nb_name}},
+                "serviceName": nb_name,
+                "podManagementPolicy": "Parallel",
+                "template": {
+                    # CR labels/filtered annotations propagate into the pod
+                    # template too (reference :479-491 — poddefault labels,
+                    # istio annotations etc. must reach the pods)
+                    "metadata": {
+                        "labels": self._propagated_labels(notebook),
+                        "annotations": self._propagated_annotations(notebook),
+                    },
+                    "spec": pod_spec,
+                },
+            },
+        }
+        if use_generate:
+            sts["metadata"]["generateName"] = names.STS_GENERATE_PREFIX
+        else:
+            sts["metadata"]["name"] = sts_name
+
+        if slice_spec is not None:
+            self._apply_tpu_spec(sts, notebook, slice_spec,
+                                 actual_sts_name or (None if use_generate
+                                                     else sts_name))
+        k8s.set_controller_reference(notebook, sts)
+        return sts
+
+    def _apply_tpu_spec(self, sts: dict, notebook: dict,
+                        slice_spec: SliceSpec,
+                        sts_name: str | None) -> None:
+        """The TPU-native workload layer (SURVEY §7 stage 3): nodeSelectors,
+        chip resources, worker identity env, headless-service subdomain.
+
+        ``sts_name`` is None only on the very first create of a GenerateName
+        STS; the reconciler re-renders right after create, once the apiserver
+        has materialized the name."""
+        nb_name = k8s.name(notebook)
+        ns = k8s.namespace(notebook)
+        pod_spec = sts["spec"]["template"]["spec"]
+        pod_spec.setdefault("nodeSelector", {}).update(slice_spec.node_selectors())
+
+        sts["metadata"].setdefault("labels", {})[names.TPU_SLICE_LABEL] = (
+            slice_spec.short_name)
+        sts["spec"]["template"]["metadata"]["labels"][names.TPU_SLICE_LABEL] = (
+            slice_spec.short_name)
+
+        container = (k8s.find_container(pod_spec, nb_name)
+                     or pod_spec.get("containers", [{}])[0])
+        resources = container.setdefault("resources", {})
+        qty = str(slice_spec.chips_per_worker)
+        resources.setdefault("requests", {})["google.com/tpu"] = qty
+        resources.setdefault("limits", {})["google.com/tpu"] = qty
+
+        headless = headless_service_name(nb_name)
+        if slice_spec.multi_host:
+            sts["spec"]["serviceName"] = headless
+            if sts_name is not None:
+                hostnames = slice_spec.worker_hostnames(sts_name, headless, ns)
+                k8s.upsert_env(container, "TPU_WORKER_HOSTNAMES",
+                               ",".join(hostnames))
+        else:
+            k8s.upsert_env(container, "TPU_WORKER_HOSTNAMES", "localhost")
+        # Worker id = StatefulSet pod ordinal, surfaced by the apps controller
+        # as the pod-index label (stable across pod restarts).
+        container.setdefault("env", []).append({
+            "name": "TPU_WORKER_ID",
+            "valueFrom": {"fieldRef": {
+                "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"}},
+        })
+        k8s.upsert_env(container, "TPU_ACCELERATOR_TYPE", slice_spec.short_name)
+        k8s.upsert_env(container, "TPU_TOPOLOGY", slice_spec.topology_str)
+
+    def generate_service(self, notebook: dict) -> dict:
+        """ClusterIP Service, port name "http-notebook" (Istio-compatible),
+        80 → container port (reference generateService, :525-552)."""
+        nb_name = k8s.name(notebook)
+        container = api.notebook_container(notebook) or {}
+        ports = container.get("ports") or [{"containerPort": DEFAULT_CONTAINER_PORT}]
+        target_port = ports[0].get("containerPort", DEFAULT_CONTAINER_PORT)
+        svc = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": nb_name,
+                "namespace": k8s.namespace(notebook),
+                "labels": {names.NOTEBOOK_NAME_LABEL: nb_name},
+            },
+            "spec": {
+                "type": "ClusterIP",
+                "selector": {"statefulset": nb_name},
+                "ports": [{
+                    "name": "http-notebook",
+                    "port": DEFAULT_SERVICE_PORT,
+                    "targetPort": target_port,
+                    "protocol": "TCP",
+                }],
+            },
+        }
+        k8s.set_controller_reference(notebook, svc)
+        return svc
+
+    def generate_headless_service(self, notebook: dict,
+                                  slice_spec: SliceSpec) -> dict:
+        """Headless Service for worker DNS — the communication-backend
+        bootstrap for multi-host slices (SURVEY §2d): every worker resolves
+        ``<sts>-<i>.<svc>.<ns>.svc`` for the DCN mesh."""
+        nb_name = k8s.name(notebook)
+        svc = {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": headless_service_name(nb_name),
+                "namespace": k8s.namespace(notebook),
+                "labels": {
+                    names.NOTEBOOK_NAME_LABEL: nb_name,
+                    names.TPU_SLICE_LABEL: slice_spec.short_name,
+                },
+            },
+            "spec": {
+                "clusterIP": "None",
+                "publishNotReadyAddresses": True,
+                "selector": {"statefulset": nb_name},
+                "ports": [{"name": "tpu-dcn", "port": 8471, "protocol": "TCP"}],
+            },
+        }
+        k8s.set_controller_reference(notebook, svc)
+        return svc
+
+    # --------------------------------------------------- create-or-update
+    def _find_owned_sts(self, notebook: dict) -> dict | None:
+        """Find the STS for a notebook, robust to GenerateName (lookup by
+        notebook-name label + owner uid rather than name)."""
+        for sts in self.client.list("StatefulSet", k8s.namespace(notebook),
+                                    {names.NOTEBOOK_NAME_LABEL: k8s.name(notebook)}):
+            if k8s.is_owned_by(sts, k8s.uid(notebook)):
+                return sts
+        return None
+
+    def _reconcile_statefulset(self, notebook: dict,
+                               slice_spec: SliceSpec | None) -> None:
+        found = self._find_owned_sts(notebook)
+        desired = self.generate_statefulset(
+            notebook, slice_spec,
+            actual_sts_name=k8s.name(found) if found else None)
+        if found is None:
+            try:
+                created = self.client.create(desired)
+                self.metrics.notebook_create_total.inc()
+            except errors.AlreadyExistsError:
+                return
+            except Exception:
+                self.metrics.notebook_create_failed_total.inc()
+                raise
+            if desired["metadata"].get("generateName"):
+                # name now materialized — re-render so worker DNS env matches
+                # the real pod names (before any pod has started)
+                fixed = self.generate_statefulset(
+                    notebook, slice_spec, actual_sts_name=k8s.name(created))
+                if copy_statefulset_fields(fixed, created):
+                    self.client.update(created)
+            return
+        if copy_statefulset_fields(desired, found):
+            self.client.update(found)
+
+    def _reconcile_service(self, notebook: dict,
+                           slice_spec: SliceSpec | None) -> None:
+        desired = self.generate_service(notebook)
+        found = self.client.get_or_none("Service", k8s.namespace(notebook),
+                                        k8s.name(notebook))
+        if found is None:
+            try:
+                self.client.create(desired)
+            except errors.AlreadyExistsError:
+                pass
+            return
+        if copy_service_fields(desired, found):
+            self.client.update(found)
+
+    def _reconcile_headless_service(self, notebook: dict,
+                                    slice_spec: SliceSpec) -> None:
+        desired = self.generate_headless_service(notebook, slice_spec)
+        found = self.client.get_or_none("Service", k8s.namespace(notebook),
+                                        k8s.name(desired))
+        if found is None:
+            try:
+                self.client.create(desired)
+            except errors.AlreadyExistsError:
+                pass
+            return
+        if copy_service_fields(desired, found):
+            self.client.update(found)
+
+    # ------------------------------------------------------------ restart
+    def _handle_restart_annotation(self, notebook: dict,
+                                   slice_spec: SliceSpec | None) -> None:
+        """Restart path (reference :259-294): annotation → delete pod(s) →
+        strip annotation. TPU extension: ALL slice workers are bounced
+        together (partial restarts would wedge the mesh)."""
+        if k8s.get_annotation(notebook, names.RESTART_ANNOTATION) != "true":
+            return
+        ns, nb_name = k8s.namespace(notebook), k8s.name(notebook)
+        for pod in self.client.list("Pod", ns,
+                                    {names.NOTEBOOK_NAME_LABEL: nb_name}):
+            try:
+                self.client.delete("Pod", ns, k8s.name(pod))
+            except errors.NotFoundError:
+                pass
+        self.client.patch(api.KIND, ns, nb_name, {
+            "metadata": {"annotations": {names.RESTART_ANNOTATION: None}}})
+
+    # ------------------------------------------------------------- status
+    def _update_status(self, notebook: dict,
+                       slice_spec: SliceSpec | None) -> None:
+        """Mirror pod state into Notebook status (reference
+        updateNotebookStatus, :299-374) + aggregate SliceReady condition."""
+        ns, nb_name = k8s.namespace(notebook), k8s.name(notebook)
+        sts = self._find_owned_sts(notebook)
+        pods = sorted(self.client.list("Pod", ns,
+                                       {names.NOTEBOOK_NAME_LABEL: nb_name}),
+                      key=k8s.name)
+        status: dict = {
+            "readyReplicas": k8s.get_in(sts, "status", "readyReplicas",
+                                        default=0) if sts else 0,
+            "conditions": [],
+            "containerState": {},
+        }
+        expected = self.desired_replicas(notebook, slice_spec)
+        if pods:
+            pod0 = pods[0]
+            # mirror pod-0's conditions, newest first (reference :322-345)
+            status["conditions"] = list(reversed(
+                k8s.get_in(pod0, "status", "conditions", default=[]) or []))
+            for cs in k8s.get_in(pod0, "status", "containerStatuses",
+                                 default=[]) or []:
+                if cs.get("name") == nb_name:
+                    status["containerState"] = cs.get("state", {})
+                    break
+        ready_pods = sum(
+            1 for p in pods
+            if any(c.get("type") == "Ready" and c.get("status") == "True"
+                   for c in k8s.get_in(p, "status", "conditions", default=[]) or []))
+        slice_ready = expected > 0 and ready_pods >= expected
+        status["conditions"].insert(0, {
+            "type": api.CONDITION_SLICE_READY,
+            "status": "True" if slice_ready else "False",
+            "reason": "AllWorkersReady" if slice_ready else "WaitingForWorkers",
+            "message": f"{ready_pods}/{expected} workers ready",
+        })
+        if k8s.get_in(notebook, "status") != status:
+            notebook = k8s.deepcopy(notebook)
+            notebook["status"] = status
+            try:
+                self.client.update_status(notebook)
+            except errors.ConflictError:
+                pass  # next event re-enqueues
+
+
+def headless_service_name(notebook_name: str) -> str:
+    return f"{notebook_name}-workers"[: 63]
+
+
+# -------------------------------------------------------------- copy-fields
+def copy_statefulset_fields(desired: dict, found: dict) -> bool:
+    """Idempotent-update semantics of reconcilehelper.CopyStatefulSetFields
+    (components/common/reconcilehelper/util.go:107-143): copy labels,
+    annotations, replicas and pod template; leave everything else (incl.
+    selector, serviceName on an existing object) untouched. Returns whether
+    an update is required."""
+    changed = False
+    for field in ("labels", "annotations"):
+        want = desired["metadata"].get(field, {})
+        have = found["metadata"].get(field)
+        if have != want:
+            found["metadata"][field] = k8s.deepcopy(want)
+            changed = True
+    if found["spec"].get("replicas") != desired["spec"].get("replicas"):
+        found["spec"]["replicas"] = desired["spec"]["replicas"]
+        changed = True
+    if found["spec"].get("template") != desired["spec"].get("template"):
+        found["spec"]["template"] = k8s.deepcopy(desired["spec"]["template"])
+        changed = True
+    return changed
+
+
+def copy_service_fields(desired: dict, found: dict) -> bool:
+    """reconcilehelper.CopyServiceFields (util.go:170-195): labels,
+    annotations, selector and ports only — NEVER clusterIP (util.go:182)."""
+    changed = False
+    for field in ("labels", "annotations"):
+        want = desired["metadata"].get(field, {})
+        have = found["metadata"].get(field)
+        if have != want:
+            found["metadata"][field] = k8s.deepcopy(want)
+            changed = True
+    if found["spec"].get("selector") != desired["spec"].get("selector"):
+        found["spec"]["selector"] = k8s.deepcopy(desired["spec"]["selector"])
+        changed = True
+    if found["spec"].get("ports") != desired["spec"].get("ports"):
+        found["spec"]["ports"] = k8s.deepcopy(desired["spec"]["ports"])
+        changed = True
+    return changed

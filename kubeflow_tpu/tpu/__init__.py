@@ -1,0 +1,3 @@
+from .topology import SliceSpec, parse_slice_request, TpuRequestError
+
+__all__ = ["SliceSpec", "parse_slice_request", "TpuRequestError"]

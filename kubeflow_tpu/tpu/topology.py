@@ -1,0 +1,204 @@
+"""TPU slice topology math.
+
+The TPU-native core of the framework (SURVEY §7 stage 3): maps an accelerator
+request expressed on the Notebook CR (annotations ``tpu.kubeflow.org/accelerator``
++ ``tpu.kubeflow.org/topology`` or shorthand like ``v5e-16``) to the concrete
+provisioning facts the reconciler needs:
+
+- ``num_workers``      → StatefulSet replicas (one pod per TPU VM / worker)
+- ``chips_per_worker`` → ``google.com/tpu`` resource quantity per pod
+- GKE nodeSelectors    → ``cloud.google.com/gke-tpu-accelerator`` and
+                         ``cloud.google.com/gke-tpu-topology``
+- worker env           → ``TPU_WORKER_ID`` (StatefulSet pod ordinal) and
+                         ``TPU_WORKER_HOSTNAMES`` (headless-Service DNS)
+
+The reference has no analog — its CRD passes the PodSpec through untouched
+(components/notebook-controller/api/v1beta1/notebook_types.go:27-34) and its
+GPU path is just a resource quantity. Topology-awareness is what makes
+multi-host slices (one STS, N workers, slice-atomic lifecycle) possible.
+
+Topology tables follow GKE's published TPU slice shapes: v4/v5p are 3-D tori
+with 4 chips per VM; v5e/v6e are 2-D with single-host shapes up to 8 chips and
+4 chips per VM in multi-host slices.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+
+from ..cluster.errors import InvalidError
+from ..utils import names
+
+
+class TpuRequestError(InvalidError):
+    reason = "InvalidTPURequest"
+
+
+@dataclass(frozen=True)
+class Generation:
+    name: str                  # "v4", "v5e", "v5p", "v6e"
+    gke_accelerator: str       # nodeSelector value
+    dims: int                  # topology dimensionality (2 or 3)
+    chips_per_host: int        # chips per VM in multi-host slices
+    max_single_host_chips: int # largest slice served by one worker VM
+    max_chips: int             # largest supported slice
+
+
+GENERATIONS: dict[str, Generation] = {
+    "v4":  Generation("v4",  "tpu-v4-podslice",      3, 4, 4, 4096),
+    "v5p": Generation("v5p", "tpu-v5p-slice",        3, 4, 4, 8960),
+    "v5e": Generation("v5e", "tpu-v5-lite-podslice", 2, 4, 8, 256),
+    "v6e": Generation("v6e", "tpu-v6e-slice",        2, 4, 8, 256),
+}
+
+# Canonical topology for a chip count (2-D generations). Mirrors GKE's
+# supported v5e/v6e shapes.
+_CHIPS_TO_TOPOLOGY_2D = {
+    1: (1, 1), 4: (2, 2), 8: (2, 4), 16: (4, 4), 32: (4, 8),
+    64: (8, 8), 128: (8, 16), 256: (16, 16),
+}
+
+_slice_short_re = re.compile(r"^(v[0-9]+[a-z]*)-([0-9]+)$")
+_topology_re = re.compile(r"^[0-9]+(x[0-9]+){1,2}$")
+
+
+@dataclass(frozen=True)
+class SliceSpec:
+    """Everything the provisioner needs to emit a slice-shaped StatefulSet."""
+    generation: str            # "v5e"
+    topology: tuple[int, ...]  # (4, 4)
+    chips: int                 # 16
+    num_workers: int           # 4  → STS replicas
+    chips_per_worker: int      # 4  → google.com/tpu quantity
+    gke_accelerator: str       # "tpu-v5-lite-podslice"
+
+    @property
+    def topology_str(self) -> str:
+        return "x".join(str(d) for d in self.topology)
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_workers > 1
+
+    @property
+    def short_name(self) -> str:
+        return f"{self.generation}-{self.chips}"
+
+    def node_selectors(self) -> dict[str, str]:
+        return {
+            "cloud.google.com/gke-tpu-accelerator": self.gke_accelerator,
+            "cloud.google.com/gke-tpu-topology": self.topology_str,
+        }
+
+    def worker_hostnames(self, sts_name: str, headless_svc: str,
+                         namespace: str) -> list[str]:
+        """Stable DNS names of all workers through the headless Service —
+        the value of TPU_WORKER_HOSTNAMES. Stability across pod restarts is
+        guaranteed by StatefulSet ordinal naming + the headless Service
+        (SURVEY §7 hard part 'TPU_WORKER_HOSTNAMES correctness')."""
+        return [f"{sts_name}-{i}.{headless_svc}.{namespace}.svc"
+                for i in range(self.num_workers)]
+
+
+def _topology_for_chips(gen: Generation, chips: int) -> tuple[int, ...]:
+    if gen.dims == 2:
+        if chips not in _CHIPS_TO_TOPOLOGY_2D:
+            raise TpuRequestError(
+                f"{gen.name}-{chips}: unsupported chip count; supported: "
+                f"{sorted(_CHIPS_TO_TOPOLOGY_2D)}")
+        return _CHIPS_TO_TOPOLOGY_2D[chips]
+    # 3-D: factor chips into the most cubic AxBxC with dims that are 1 or even
+    if chips == 1:
+        return (1, 1, 1)
+    if chips % 4 != 0 or chips > gen.max_chips:
+        raise TpuRequestError(
+            f"{gen.name}-{chips}: 3-D slices must be a multiple of 4 chips "
+            f"≤ {gen.max_chips}")
+    c = round(chips ** (1 / 3))
+    for a in range(c, 0, -1):
+        if chips % a:
+            continue
+        rest = chips // a
+        b = round(math.sqrt(rest))
+        for bb in range(b, 0, -1):
+            if rest % bb == 0 and bb >= a:
+                return tuple(sorted((a, bb, rest // bb)))
+    return (1, 1, chips)
+
+
+def _spec_from(gen: Generation, topology: tuple[int, ...]) -> SliceSpec:
+    chips = math.prod(topology)
+    if chips > gen.max_chips:
+        raise TpuRequestError(f"{gen.name} slice of {chips} chips exceeds max "
+                              f"{gen.max_chips}")
+    if chips <= gen.max_single_host_chips:
+        num_workers, chips_per_worker = 1, chips
+    else:
+        if chips % gen.chips_per_host:
+            raise TpuRequestError(
+                f"{gen.name}-{chips}: multi-host slices must be a multiple of "
+                f"{gen.chips_per_host} chips per worker")
+        num_workers = chips // gen.chips_per_host
+        chips_per_worker = gen.chips_per_host
+    return SliceSpec(gen.name, topology, chips, num_workers, chips_per_worker,
+                     gen.gke_accelerator)
+
+
+def parse_topology(generation: str, topology: str) -> SliceSpec:
+    gen = GENERATIONS.get(generation)
+    if gen is None:
+        raise TpuRequestError(
+            f"unknown TPU generation {generation!r}; known: {sorted(GENERATIONS)}")
+    if not _topology_re.match(topology):
+        raise TpuRequestError(f"malformed topology {topology!r} (want e.g. 2x2 or 2x2x4)")
+    dims = tuple(int(d) for d in topology.split("x"))
+    if len(dims) != gen.dims:
+        raise TpuRequestError(
+            f"{gen.name} topologies are {gen.dims}-D; got {topology!r}")
+    return _spec_from(gen, dims)
+
+
+def parse_short_name(short: str) -> SliceSpec:
+    """Parse shorthand like ``v5e-16`` (generation + total chips)."""
+    m = _slice_short_re.match(short)
+    if not m:
+        raise TpuRequestError(f"malformed slice name {short!r} (want e.g. v5e-16)")
+    generation, chips_s = m.group(1), m.group(2)
+    gen = GENERATIONS.get(generation)
+    if gen is None:
+        raise TpuRequestError(
+            f"unknown TPU generation {generation!r}; known: {sorted(GENERATIONS)}")
+    chips = int(chips_s)
+    return _spec_from(gen, _topology_for_chips(gen, chips))
+
+
+def parse_slice_request(annotations: dict[str, str] | None) -> SliceSpec | None:
+    """Extract a slice request from Notebook CR annotations. Returns None for
+    CPU notebooks (no TPU annotations present).
+
+    Accepted forms:
+    - ``tpu.kubeflow.org/accelerator: v5e-16``            (shorthand)
+    - ``tpu.kubeflow.org/accelerator: v5e`` +
+      ``tpu.kubeflow.org/topology: 4x4``                  (explicit topology)
+    """
+    if not annotations:
+        return None
+    acc = annotations.get(names.TPU_ACCELERATOR_ANNOTATION)
+    topo = annotations.get(names.TPU_TOPOLOGY_ANNOTATION)
+    if acc is None and topo is None:
+        return None
+    if acc is None:
+        raise TpuRequestError(
+            f"{names.TPU_TOPOLOGY_ANNOTATION} requires "
+            f"{names.TPU_ACCELERATOR_ANNOTATION}")
+    if topo is not None:
+        return parse_topology(acc, topo)
+    if _slice_short_re.match(acc):
+        return parse_short_name(acc)
+    # bare generation without topology → smallest slice
+    gen = GENERATIONS.get(acc)
+    if gen is None:
+        raise TpuRequestError(f"unknown TPU accelerator {acc!r}")
+    return _spec_from(gen, (1,) * gen.dims)

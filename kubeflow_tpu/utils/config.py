@@ -1,0 +1,70 @@
+"""Controller configuration.
+
+The reference configures through env vars + kustomize params.env (SURVEY §5):
+ENABLE_CULLING, CULL_IDLE_TIME, IDLENESS_CHECK_PERIOD, CLUSTER_DOMAIN, DEV,
+ADD_FSGROUP, USE_ISTIO, SET_PIPELINE_RBAC, SET_PIPELINE_SECRET, MLFLOW_ENABLED,
+GATEWAY_URL, NOTEBOOK_GATEWAY_NAME/NAMESPACE, K8S_NAMESPACE. We keep the same
+variable names so existing deployment manifests translate directly, but load
+them into one explicit dataclass (injectable for tests instead of the
+reference's initGlobalVars pattern, culling_controller.go:534-567)."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class ControllerConfig:
+    # core controller (reference notebook-controller/main.go:65-77 + env)
+    cluster_domain: str = "cluster.local"
+    add_fsgroup: bool = True
+    # culling (reference culling_controller.go:32-36; minutes)
+    enable_culling: bool = False
+    cull_idle_time_min: int = 1440
+    idleness_check_period_min: int = 1
+    dev_mode: bool = False
+    jupyter_probe_timeout_s: float = 10.0
+    # odh-analog extension (odh main.go / params.env)
+    controller_namespace: str = "kubeflow-tpu-system"
+    gateway_name: str = "data-science-gateway"
+    gateway_namespace: str = "openshift-ingress"
+    gateway_url: str = ""
+    mlflow_enabled: bool = False
+    set_pipeline_rbac: bool = False
+    set_pipeline_secret: bool = False
+    inject_cluster_proxy_env: bool = False
+    auth_proxy_image: str = "kube-rbac-proxy:latest"
+    # TPU-native
+    tpu_default_image: str = "us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest"
+    image_swap_map: dict = field(default_factory=dict)  # cuda image → jax/libtpu image
+
+    @classmethod
+    def from_env(cls) -> "ControllerConfig":
+        env = os.environ
+        return cls(
+            cluster_domain=env.get("CLUSTER_DOMAIN", "cluster.local"),
+            add_fsgroup=_env_bool("ADD_FSGROUP", True),
+            enable_culling=_env_bool("ENABLE_CULLING", False),
+            cull_idle_time_min=int(env.get("CULL_IDLE_TIME", "1440")),
+            idleness_check_period_min=int(env.get("IDLENESS_CHECK_PERIOD", "1")),
+            dev_mode=_env_bool("DEV", False),
+            controller_namespace=env.get("K8S_NAMESPACE", "kubeflow-tpu-system"),
+            gateway_name=env.get("NOTEBOOK_GATEWAY_NAME", "data-science-gateway"),
+            gateway_namespace=env.get("NOTEBOOK_GATEWAY_NAMESPACE", "openshift-ingress"),
+            gateway_url=env.get("GATEWAY_URL", ""),
+            mlflow_enabled=_env_bool("MLFLOW_ENABLED", False),
+            set_pipeline_rbac=_env_bool("SET_PIPELINE_RBAC", False),
+            set_pipeline_secret=_env_bool("SET_PIPELINE_SECRET", False),
+            inject_cluster_proxy_env=_env_bool("INJECT_CLUSTER_PROXY_ENV", False),
+            tpu_default_image=env.get(
+                "TPU_NOTEBOOK_IMAGE",
+                "us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest"),
+        )

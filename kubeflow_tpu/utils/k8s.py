@@ -1,0 +1,223 @@
+"""Kubernetes object helpers over plain-dict API objects.
+
+All API objects in this framework are plain nested dicts shaped exactly like
+their Kubernetes JSON wire form (the same shape ``kubectl get -o json`` shows).
+This mirrors how the reference's Go structs serialize and keeps patch/deepcopy
+semantics trivial and dependency-free.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Iterable
+
+Obj = dict  # a Kubernetes API object in JSON form
+
+
+def deepcopy(obj: Obj) -> Obj:
+    """Equivalent of the reference's generated DeepCopy methods
+    (zz_generated.deepcopy.go)."""
+    return copy.deepcopy(obj)
+
+
+def get_in(obj: Obj, *path: str, default: Any = None) -> Any:
+    cur: Any = obj
+    for key in path:
+        if not isinstance(cur, dict) or key not in cur:
+            return default
+        cur = cur[key]
+    return cur
+
+
+def set_in(obj: Obj, *path_and_value: Any) -> None:
+    *path, value = path_and_value
+    cur = obj
+    for key in path[:-1]:
+        cur = cur.setdefault(key, {})
+    cur[path[-1]] = value
+
+
+def meta(obj: Obj) -> dict:
+    return obj.setdefault("metadata", {})
+
+
+def name(obj: Obj) -> str:
+    return get_in(obj, "metadata", "name", default="")
+
+
+def namespace(obj: Obj) -> str:
+    return get_in(obj, "metadata", "namespace", default="")
+
+
+def uid(obj: Obj) -> str:
+    return get_in(obj, "metadata", "uid", default="")
+
+
+def kind(obj: Obj) -> str:
+    return obj.get("kind", "")
+
+
+def labels(obj: Obj) -> dict:
+    return meta(obj).setdefault("labels", {})
+
+
+def annotations(obj: Obj) -> dict:
+    return meta(obj).setdefault("annotations", {})
+
+
+def get_label(obj: Obj, key: str, default: str | None = None) -> str | None:
+    return get_in(obj, "metadata", "labels", key, default=default)
+
+
+def get_annotation(obj: Obj, key: str, default: str | None = None) -> str | None:
+    return get_in(obj, "metadata", "annotations", key, default=default)
+
+
+def set_annotation(obj: Obj, key: str, value: str) -> None:
+    annotations(obj)[key] = value
+
+
+def remove_annotation(obj: Obj, key: str) -> None:
+    anns = get_in(obj, "metadata", "annotations")
+    if isinstance(anns, dict):
+        anns.pop(key, None)
+
+
+def finalizers(obj: Obj) -> list:
+    return meta(obj).setdefault("finalizers", [])
+
+
+def has_finalizer(obj: Obj, fin: str) -> bool:
+    return fin in (get_in(obj, "metadata", "finalizers") or [])
+
+
+def add_finalizer(obj: Obj, fin: str) -> bool:
+    fins = finalizers(obj)
+    if fin in fins:
+        return False
+    fins.append(fin)
+    return True
+
+
+def remove_finalizer(obj: Obj, fin: str) -> bool:
+    fins = get_in(obj, "metadata", "finalizers")
+    if not fins or fin not in fins:
+        return False
+    fins.remove(fin)
+    return True
+
+
+def is_deleting(obj: Obj) -> bool:
+    return get_in(obj, "metadata", "deletionTimestamp") is not None
+
+
+def owner_references(obj: Obj) -> list:
+    return meta(obj).setdefault("ownerReferences", [])
+
+
+def new_owner_ref(owner: Obj, *, controller: bool = True,
+                  block_owner_deletion: bool = True) -> dict:
+    """ctrl.SetControllerReference equivalent."""
+    return {
+        "apiVersion": owner.get("apiVersion", ""),
+        "kind": owner.get("kind", ""),
+        "name": name(owner),
+        "uid": uid(owner),
+        "controller": controller,
+        "blockOwnerDeletion": block_owner_deletion,
+    }
+
+
+def set_controller_reference(owner: Obj, controlled: Obj) -> None:
+    refs = owner_references(controlled)
+    ref = new_owner_ref(owner)
+    for existing in refs:
+        if existing.get("uid") == ref["uid"]:
+            existing.update(ref)
+            return
+    refs.append(ref)
+
+
+def is_owned_by(obj: Obj, owner_uid: str) -> bool:
+    return any(r.get("uid") == owner_uid
+               for r in get_in(obj, "metadata", "ownerReferences", default=[]) or [])
+
+
+def matches_labels(obj: Obj, selector: dict[str, str] | None) -> bool:
+    if not selector:
+        return True
+    have = get_in(obj, "metadata", "labels", default={}) or {}
+    return all(have.get(k) == v for k, v in selector.items())
+
+
+def json_merge_patch(target: Obj, patch: Obj) -> Obj:
+    """RFC 7386 JSON Merge Patch — the semantics of client.MergeFrom patches
+    the reference uses for annotation updates (odh notebook_controller.go:516-523)."""
+    if not isinstance(patch, dict):
+        return copy.deepcopy(patch)
+    if not isinstance(target, dict):
+        target = {}
+    result = dict(target)
+    for key, value in patch.items():
+        if value is None:
+            result.pop(key, None)
+        else:
+            result[key] = json_merge_patch(result.get(key), value)
+    return result
+
+
+def find_container(pod_spec: dict, container_name: str) -> dict | None:
+    for c in pod_spec.get("containers", []) or []:
+        if c.get("name") == container_name:
+            return c
+    return None
+
+
+def env_list_to_dict(env: Iterable[dict]) -> dict[str, str]:
+    return {e["name"]: e.get("value", "") for e in env or []}
+
+
+def upsert_env(container: dict, name_: str, value: str) -> None:
+    env = container.setdefault("env", [])
+    for e in env:
+        if e.get("name") == name_:
+            e.pop("valueFrom", None)
+            e["value"] = value
+            return
+    env.append({"name": name_, "value": value})
+
+
+def remove_env(container: dict, name_: str) -> None:
+    env = container.get("env")
+    if env:
+        container["env"] = [e for e in env if e.get("name") != name_]
+
+
+def upsert_volume(pod_spec: dict, volume: dict) -> None:
+    vols = pod_spec.setdefault("volumes", [])
+    for i, v in enumerate(vols):
+        if v.get("name") == volume["name"]:
+            vols[i] = volume
+            return
+    vols.append(volume)
+
+
+def remove_volume(pod_spec: dict, name_: str) -> None:
+    vols = pod_spec.get("volumes")
+    if vols:
+        pod_spec["volumes"] = [v for v in vols if v.get("name") != name_]
+
+
+def upsert_volume_mount(container: dict, mount: dict) -> None:
+    mounts = container.setdefault("volumeMounts", [])
+    for i, m in enumerate(mounts):
+        if m.get("name") == mount["name"] and m.get("mountPath") == mount.get("mountPath"):
+            mounts[i] = mount
+            return
+    mounts.append(mount)
+
+
+def remove_volume_mount(container: dict, name_: str) -> None:
+    mounts = container.get("volumeMounts")
+    if mounts:
+        container["volumeMounts"] = [m for m in mounts if m.get("name") != name_]

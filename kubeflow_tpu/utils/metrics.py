@@ -1,0 +1,96 @@
+"""Minimal Prometheus metrics registry (text exposition format).
+
+The reference exposes five series via the controller-runtime metrics registry
+(components/notebook-controller/pkg/metrics/metrics.go:13-99):
+``notebook_running`` (gauge, scraped by listing StatefulSets with the
+``notebook-name`` label), ``notebook_create_total``,
+``notebook_create_failed_total``, ``notebook_culling_total``, and
+``last_notebook_culling_timestamp_seconds``. prometheus_client isn't part of
+this image's baked-in set, so we implement the text format directly."""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str, type_: str):
+        self.name = name
+        self.help = help_
+        self.type = type_
+        self._values: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+
+    def _labels_key(self, labels: dict[str, str] | None) -> tuple:
+        return tuple(sorted((labels or {}).items()))
+
+    def inc(self, labels: dict[str, str] | None = None, by: float = 1.0) -> None:
+        key = self._labels_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + by
+
+    def set(self, value: float, labels: dict[str, str] | None = None) -> None:
+        with self._lock:
+            self._values[self._labels_key(labels)] = value
+
+    def get(self, labels: dict[str, str] | None = None) -> float:
+        with self._lock:
+            return self._values.get(self._labels_key(labels), 0.0)
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.type}"]
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items:
+            items = [((), 0.0)]
+        for key, value in items:
+            label_s = ",".join(f'{k}="{v}"' for k, v in key)
+            suffix = f"{{{label_s}}}" if label_s else ""
+            lines.append(f"{self.name}{suffix} {value:g}")
+        return "\n".join(lines)
+
+
+class MetricsRegistry:
+    """Registry + the reference's notebook metric set. ``scrape_callbacks``
+    mirrors the reference's collector that computes ``notebook_running`` at
+    scrape time by listing StatefulSets (metrics.go:60-99)."""
+
+    def __init__(self) -> None:
+        self._metrics: dict[str, _Metric] = {}
+        self._scrape_callbacks: list[Callable[[], None]] = []
+        self.notebook_create_total = self.counter(
+            "notebook_create_total", "Total times of creating notebooks")
+        self.notebook_create_failed_total = self.counter(
+            "notebook_create_failed_total", "Total failure times of creating notebooks")
+        self.notebook_culling_total = self.counter(
+            "notebook_culling_total", "Total times of culling notebooks")
+        self.last_culling_timestamp = self.gauge(
+            "last_notebook_culling_timestamp_seconds",
+            "Timestamp of the last notebook culling in seconds")
+        self.notebook_running = self.gauge(
+            "notebook_running", "Current running notebooks in the cluster")
+
+    def counter(self, name: str, help_: str) -> _Metric:
+        m = _Metric(name, help_, "counter")
+        self._metrics[name] = m
+        return m
+
+    def gauge(self, name: str, help_: str) -> _Metric:
+        m = _Metric(name, help_, "gauge")
+        self._metrics[name] = m
+        return m
+
+    def on_scrape(self, fn: Callable[[], None]) -> None:
+        self._scrape_callbacks.append(fn)
+
+    def record_culling(self, namespace: str, name: str) -> None:
+        self.notebook_culling_total.inc({"namespace": namespace, "name": name})
+        self.last_culling_timestamp.set(time.time())
+
+    def expose(self) -> str:
+        for fn in self._scrape_callbacks:
+            fn()
+        return "\n".join(m.expose() for m in self._metrics.values()) + "\n"

@@ -1,0 +1,82 @@
+"""Naming rules and well-known annotation/label keys.
+
+Mirrors the constants and name-length rules of the reference controllers
+(components/notebook-controller/controllers/notebook_controller.go:53-67 and
+components/odh-notebook-controller/controllers/*)."""
+
+from __future__ import annotations
+
+import hashlib
+import re
+
+# --- annotation / label keys (reference: notebook_controller.go:53-67,
+# culling_controller.go:40-55, odh notebook_mutating_webhook.go:86-111) ---
+STOP_ANNOTATION = "kubeflow-resource-stopped"
+CREATOR_ANNOTATION = "notebooks.kubeflow.org/creator"
+LAST_ACTIVITY_ANNOTATION = "notebooks.kubeflow.org/last-activity"
+LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION = (
+    "notebooks.kubeflow.org/last_activity_check_timestamp")
+RESTART_ANNOTATION = "notebooks.opendatahub.io/notebook-restart"
+UPDATE_PENDING_ANNOTATION = "notebooks.opendatahub.io/update-pending"
+INJECT_AUTH_ANNOTATION = "notebooks.opendatahub.io/inject-auth"
+AUTH_SIDECAR_CPU_ANNOTATION = "notebooks.opendatahub.io/auth-sidecar-cpu"
+AUTH_SIDECAR_MEMORY_ANNOTATION = "notebooks.opendatahub.io/auth-sidecar-memory"
+MLFLOW_INSTANCE_ANNOTATION = "opendatahub.io/mlflow-instance"
+FEAST_LABEL = "opendatahub.io/feast-integration"
+WORKBENCHES_LABEL = "opendatahub.io/workbenches"
+NOTEBOOK_NAME_LABEL = "notebook-name"
+ODH_NOTEBOOK_NAME_LABEL = "opendatahub.io/odh-notebook-name"
+IMAGE_SELECTION_ANNOTATION = "notebooks.opendatahub.io/last-image-selection"
+RECONCILIATION_LOCK_VALUE = "odh-notebook-controller-lock"
+
+# --- TPU-native keys (new in this framework; no reference analog, §2d/§7) ---
+TPU_ACCELERATOR_ANNOTATION = "tpu.kubeflow.org/accelerator"
+TPU_TOPOLOGY_ANNOTATION = "tpu.kubeflow.org/topology"
+TPU_SLICE_LABEL = "tpu.kubeflow.org/slice"
+
+# Kubernetes DNS-1123 subdomain limit for the pod hostname contributed by the
+# StatefulSet name; the reference caps STS names at 52 chars so the "-<ordinal>"
+# suffixed pod name stays a valid label (notebook_controller.go:59,144-149).
+MAX_STS_NAME_LENGTH = 52
+# HTTPRoute names are capped at 63 chars (odh notebook_route.go:51-77).
+MAX_ROUTE_NAME_LENGTH = 63
+
+STS_GENERATE_PREFIX = "nb-"
+
+_dns1123_re = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+
+def is_dns1123_label(s: str) -> bool:
+    return len(s) <= 63 and bool(_dns1123_re.match(s))
+
+
+def sts_name_for_notebook(notebook_name: str) -> tuple[str, bool]:
+    """Return (name, use_generate_name).
+
+    Reference: names longer than 52 chars fall back to
+    ``GenerateName: "nb-"`` (notebook_controller.go:444-449)."""
+    if len(notebook_name) > MAX_STS_NAME_LENGTH:
+        return STS_GENERATE_PREFIX, True
+    return notebook_name, False
+
+
+def route_name_for_notebook(namespace: str, notebook_name: str) -> tuple[str, bool]:
+    """Central-namespace HTTPRoute naming ``nb-<ns>-<name>`` with a
+    GenerateName fallback past 63 chars (odh notebook_route.go:51-77)."""
+    candidate = f"nb-{namespace}-{notebook_name}"
+    if len(candidate) > MAX_ROUTE_NAME_LENGTH:
+        return f"nb-{namespace}-"[: MAX_ROUTE_NAME_LENGTH - 9] + "-", True
+    return candidate, False
+
+
+def generate_suffix(seed: str, n: int = 8) -> str:
+    """Deterministic suffix generator used by the in-process apiserver for
+    GenerateName (apiserver's random 5-char suffix; deterministic here for
+    reproducible tests)."""
+    return hashlib.sha1(seed.encode()).hexdigest()[:n]
+
+
+def nb_prefix(namespace: str, notebook_name: str) -> str:
+    """The URL prefix a notebook is served under — also injected as NB_PREFIX
+    (reference notebook_controller.go:417-431, odh notebook_route.go path)."""
+    return f"/notebook/{namespace}/{notebook_name}"

@@ -1,0 +1,53 @@
+"""Shared test fixtures.
+
+JAX tests run on a virtual 8-device CPU mesh (the envtest analog for the
+compute side): multi-chip sharding is validated without TPU hardware, as the
+reference validates multi-node behavior at the API-object level without nodes
+(SURVEY §4)."""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import pytest
+
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.controllers.manager import Manager
+from kubeflow_tpu.controllers.notebook import NotebookReconciler
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+
+@pytest.fixture
+def store():
+    return ClusterStore()
+
+
+@pytest.fixture
+def config():
+    return ControllerConfig()
+
+
+@pytest.fixture
+def metrics():
+    return MetricsRegistry()
+
+
+@pytest.fixture
+def manager(store):
+    return Manager(store)
+
+
+@pytest.fixture
+def notebook_reconciler(store, manager, config, metrics):
+    rec = NotebookReconciler(store, config, metrics)
+    rec.setup(manager)
+    return rec
+
+
+def drain(manager, timeout=10.0, include_delayed_under=0.0):
+    return manager.run_until_idle(timeout=timeout,
+                                  include_delayed_under=include_delayed_under)

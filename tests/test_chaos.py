@@ -1,0 +1,78 @@
+"""Fault-injection tests — the operator-chaos SDK tier (SURVEY §4.3):
+error propagation while faults are active, reconvergence after Deactivate()."""
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.chaos import ChaosClient, FaultConfig, InjectedFault
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.controllers import Manager, NotebookReconciler
+from kubeflow_tpu.controllers.manager import Request
+from kubeflow_tpu.utils import names
+
+
+def converge(mgr, timeout=5.0):
+    mgr.run_until_idle(timeout=timeout, include_delayed_under=0.5)
+
+
+def test_faults_propagate():
+    store = ClusterStore()
+    chaos = ChaosClient(store, FaultConfig(create=1.0, seed=1))
+    with pytest.raises(InjectedFault):
+        chaos.create({"apiVersion": "v1", "kind": "ConfigMap",
+                      "metadata": {"name": "x", "namespace": "ns"}})
+
+
+def test_reconverges_after_deactivate():
+    """Reference chaos_test.go:132-156: inject faults, deactivate, assert the
+    world converges within the bound."""
+    store = ClusterStore()
+    faults = FaultConfig(create=0.5, update=0.5, get=0.3, seed=7)
+    chaos = ChaosClient(store, faults)
+    mgr = Manager(chaos)
+    NotebookReconciler(chaos).setup(mgr)
+    store.create(api.new_notebook("nb", "ns", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}))
+    converge(mgr, timeout=3.0)
+    faults.deactivate()
+    mgr.enqueue("notebook-controller", Request("ns", "nb"))
+    converge(mgr)
+    sts = store.get("StatefulSet", "ns", "nb")
+    assert sts["spec"]["replicas"] == 4
+    assert store.get("Service", "ns", "nb")
+    assert store.get("Service", "ns", "nb-workers")
+
+
+def test_intermittent_noise_converges():
+    """15% multi-op noise (reference chaos_test.go:385-403)."""
+    store = ClusterStore()
+    faults = FaultConfig(create=0.15, update=0.15, get=0.15, list=0.15, seed=99)
+    chaos = ChaosClient(store, faults)
+    mgr = Manager(chaos)
+    NotebookReconciler(chaos).setup(mgr)
+    for i in range(5):
+        store.create(api.new_notebook(f"nb-{i}", "ns"))
+    converge(mgr, timeout=10.0)
+    faults.deactivate()
+    for i in range(5):
+        mgr.enqueue("notebook-controller", Request("ns", f"nb-{i}"))
+    converge(mgr, timeout=10.0)
+    for i in range(5):
+        assert store.get("StatefulSet", "ns", f"nb-{i}")
+        assert store.get("Service", "ns", f"nb-{i}")
+
+
+def test_delete_faults_then_cleanup():
+    """Finalization under Delete faults (reference chaos_test.go:313-381) —
+    deletion must eventually cascade once faults clear."""
+    store = ClusterStore()
+    faults = FaultConfig(delete=0.9, seed=3)
+    chaos = ChaosClient(store, faults)
+    mgr = Manager(chaos)
+    NotebookReconciler(chaos).setup(mgr)
+    store.create(api.new_notebook("nb", "ns"))
+    converge(mgr)
+    faults.deactivate()
+    store.delete(api.KIND, "ns", "nb")
+    converge(mgr)
+    assert store.get_or_none("StatefulSet", "ns", "nb") is None

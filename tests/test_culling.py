@@ -1,0 +1,168 @@
+"""Culling controller: the annotation state machine of
+culling_controller.go:87-204, slice-atomically (SURVEY §7 stage 5)."""
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
+from kubeflow_tpu.controllers import CullingReconciler, Manager, NotebookReconciler
+from kubeflow_tpu.controllers.culling import JupyterActivity, format_time
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+from tests.conftest import drain
+
+
+class FakeClock:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, s):
+        self.t += s
+
+
+class FakeJupyter:
+    """Switchable prober."""
+
+    def __init__(self):
+        self.activity = JupyterActivity(kernels=[{"execution_state": "busy"}])
+        self.probes = 0
+
+    def __call__(self, notebook):
+        self.probes += 1
+        return self.activity(notebook) if callable(self.activity) else self.activity
+
+
+@pytest.fixture
+def culling_world(store):
+    clock = FakeClock()
+    jupyter = FakeJupyter()
+    cfg = ControllerConfig(enable_culling=True, cull_idle_time_min=60,
+                           idleness_check_period_min=1)
+    metrics = MetricsRegistry()
+    mgr = Manager(store)
+    NotebookReconciler(store, cfg, metrics).setup(mgr)
+    culler = CullingReconciler(store, cfg, metrics, prober=jupyter, clock=clock)
+    culler.setup(mgr)
+    StatefulSetSimulator(store, boot_delay_s=0.0).setup(mgr)
+    return store, mgr, clock, jupyter, metrics, cfg
+
+
+def tick(store, mgr, clock, minutes):
+    """Advance the fake clock and re-drive the periodic requeues (the
+    IDLENESS_CHECK_PERIOD loop) without waiting wall-clock time."""
+    from kubeflow_tpu.controllers.manager import Request
+    clock.advance(minutes * 60)
+    for nb in store.list(api.KIND):
+        mgr.enqueue("culling-controller",
+                    Request(k8s.namespace(nb), k8s.name(nb)))
+    drain(mgr, include_delayed_under=0.1)
+
+
+def test_initializes_annotations(culling_world):
+    store, mgr, clock, jupyter, metrics, cfg = culling_world
+    store.create(api.new_notebook("nb", "ns"))
+    drain(mgr, include_delayed_under=0.1)
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.LAST_ACTIVITY_ANNOTATION)
+    assert k8s.get_annotation(nb, names.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION)
+
+
+def test_busy_kernel_prevents_cull(culling_world):
+    store, mgr, clock, jupyter, metrics, cfg = culling_world
+    store.create(api.new_notebook("nb", "ns"))
+    drain(mgr, include_delayed_under=0.1)
+    for _ in range(5):
+        tick(store, mgr, clock, 30)  # 150 min busy, threshold 60
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is None
+    assert jupyter.probes > 0
+
+
+def test_idle_notebook_culled_slice_atomic(culling_world):
+    store, mgr, clock, jupyter, metrics, cfg = culling_world
+    store.create(api.new_notebook("nb", "ns", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}))
+    drain(mgr, include_delayed_under=0.1)
+    assert len(store.list("Pod", "ns", {names.NOTEBOOK_NAME_LABEL: "nb"})) == 4
+    # user goes idle at a known time, then 61 minutes pass
+    jupyter.activity = JupyterActivity(kernels=[{
+        "execution_state": "idle", "last_activity": format_time(clock())}])
+    tick(store, mgr, clock, 2)
+    tick(store, mgr, clock, 61)
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is not None
+    # all four workers reaped, never a partial count
+    assert store.list("Pod", "ns", {names.NOTEBOOK_NAME_LABEL: "nb"}) == []
+    assert store.get("StatefulSet", "ns", "nb")["spec"]["replicas"] == 0
+    assert metrics.notebook_culling_total.get(
+        {"namespace": "ns", "name": "nb"}) == 1
+    # activity annotations stripped once stopped
+    tick(store, mgr, clock, 2)
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.LAST_ACTIVITY_ANNOTATION) is None
+
+
+def test_one_dead_endpoint_does_not_mask_busy_kernel(culling_world):
+    """Terminals 404ing must not discard a busy kernel signal
+    (culling_controller.go probes the two endpoints independently)."""
+    store, mgr, clock, jupyter, metrics, cfg = culling_world
+    store.create(api.new_notebook("nb", "ns"))
+    drain(mgr, include_delayed_under=0.1)
+    jupyter.activity = JupyterActivity(
+        kernels=[{"execution_state": "busy"}], terminals=None)
+    for _ in range(4):
+        tick(store, mgr, clock, 45)
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is None
+
+
+def test_unreachable_jupyter_does_not_advance_activity(culling_world):
+    store, mgr, clock, jupyter, metrics, cfg = culling_world
+    store.create(api.new_notebook("nb", "ns"))
+    drain(mgr, include_delayed_under=0.1)
+    jupyter.activity = JupyterActivity(kernels=None, terminals=None)
+    tick(store, mgr, clock, 2)
+    tick(store, mgr, clock, 61)  # unreachable the whole time → idle → cull
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is not None
+
+
+def test_terminal_activity_counts(culling_world):
+    store, mgr, clock, jupyter, metrics, cfg = culling_world
+    store.create(api.new_notebook("nb", "ns"))
+    drain(mgr, include_delayed_under=0.1)
+    # kernels idle and stale, but a terminal stays active
+    def active_terminal(nb):
+        return JupyterActivity(
+            kernels=[{"execution_state": "idle",
+                      "last_activity": "2000-01-01T00:00:00Z"}],
+            terminals=[{"last_activity": format_time(clock())}])
+    jupyter.activity = active_terminal
+    for _ in range(4):
+        tick(store, mgr, clock, 45)
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is None
+
+
+def test_no_pod_strips_annotations(culling_world):
+    store, mgr, clock, jupyter, metrics, cfg = culling_world
+    # notebook created stopped → no pods ever
+    store.create(api.new_notebook("nb", "ns", annotations={
+        names.STOP_ANNOTATION: "t"}))
+    drain(mgr, include_delayed_under=0.1)
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.LAST_ACTIVITY_ANNOTATION) is None
+
+
+def test_enable_culling_gate(store):
+    from kubeflow_tpu.controllers import setup_controllers
+    cfg = ControllerConfig(enable_culling=False)
+    mgr = setup_controllers(store, cfg)
+    assert "culling-controller" not in mgr._reconcilers
+    cfg = ControllerConfig(enable_culling=True)
+    mgr = setup_controllers(store, cfg, prober=lambda nb: JupyterActivity())
+    assert "culling-controller" in mgr._reconcilers

@@ -1,0 +1,255 @@
+"""Core reconciler behavior — the envtest-tier suite (SURVEY §4.2): asserts on
+rendered StatefulSets/Services, plus full CR→ready loops with the kubelet
+simulator."""
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
+from kubeflow_tpu.utils import k8s, names
+from tests.conftest import drain
+
+
+def apply_notebook(store, manager, nb):
+    out = store.create(nb)
+    drain(manager)
+    return out
+
+
+def test_creates_sts_and_service(store, manager, notebook_reconciler):
+    nb = api.new_notebook("mynb", "user-ns", image="jupyter:latest")
+    apply_notebook(store, manager, nb)
+    sts = store.get("StatefulSet", "user-ns", "mynb")
+    svc = store.get("Service", "user-ns", "mynb")
+    assert sts["spec"]["replicas"] == 1
+    assert sts["spec"]["selector"]["matchLabels"] == {"statefulset": "mynb"}
+    assert k8s.get_label(sts, names.NOTEBOOK_NAME_LABEL) == "mynb"
+    assert svc["spec"]["type"] == "ClusterIP"
+    assert svc["spec"]["ports"][0]["name"] == "http-notebook"
+    assert svc["spec"]["ports"][0]["port"] == 80
+    assert svc["spec"]["ports"][0]["targetPort"] == 8888
+    # owner refs → GC cleanup
+    assert k8s.is_owned_by(sts, k8s.uid(store.get(api.KIND, "user-ns", "mynb")))
+
+
+def test_container_defaults(store, manager, notebook_reconciler):
+    nb = api.new_notebook("mynb", "user-ns")
+    apply_notebook(store, manager, nb)
+    sts = store.get("StatefulSet", "user-ns", "mynb")
+    c = sts["spec"]["template"]["spec"]["containers"][0]
+    assert c["workingDir"] == "/home/jovyan"
+    assert c["ports"][0]["containerPort"] == 8888
+    env = k8s.env_list_to_dict(c["env"])
+    assert env["NB_PREFIX"] == "/notebook/user-ns/mynb"
+    assert sts["spec"]["template"]["spec"]["securityContext"]["fsGroup"] == 100
+
+
+def test_no_fsgroup_when_disabled(store, manager, config, metrics):
+    from kubeflow_tpu.controllers.notebook import NotebookReconciler
+    config.add_fsgroup = False
+    rec = NotebookReconciler(store, config, metrics)
+    rec.setup(manager)
+    apply_notebook(store, manager, api.new_notebook("mynb", "ns"))
+    sts = store.get("StatefulSet", "ns", "mynb")
+    assert "securityContext" not in sts["spec"]["template"]["spec"]
+
+
+def test_stop_annotation_scales_to_zero(store, manager, notebook_reconciler):
+    nb = api.new_notebook("mynb", "ns")
+    apply_notebook(store, manager, nb)
+    assert store.get("StatefulSet", "ns", "mynb")["spec"]["replicas"] == 1
+    store.patch(api.KIND, "ns", "mynb", {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: "2026-07-29T00:00:00Z"}}})
+    drain(manager)
+    assert store.get("StatefulSet", "ns", "mynb")["spec"]["replicas"] == 0
+    # resume
+    store.patch(api.KIND, "ns", "mynb", {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: None}}})
+    drain(manager)
+    assert store.get("StatefulSet", "ns", "mynb")["spec"]["replicas"] == 1
+
+
+def test_long_name_generate_name(store, manager, notebook_reconciler):
+    long_name = "a" * 60
+    nb = api.new_notebook(long_name, "ns")
+    apply_notebook(store, manager, nb)
+    stss = store.list("StatefulSet", "ns",
+                      {names.NOTEBOOK_NAME_LABEL: long_name})
+    assert len(stss) == 1
+    assert k8s.name(stss[0]).startswith("nb-")
+    assert len(k8s.name(stss[0])) <= 52
+    # reconcile again → still exactly one (GenerateName lookup by label works)
+    from kubeflow_tpu.controllers.manager import Request
+    manager.enqueue("notebook-controller", Request("ns", long_name))
+    drain(manager)
+    assert len(store.list("StatefulSet", "ns",
+                          {names.NOTEBOOK_NAME_LABEL: long_name})) == 1
+
+
+def test_annotation_propagation_excludes_prefixes(store, manager,
+                                                 notebook_reconciler):
+    nb = api.new_notebook("mynb", "ns", annotations={
+        "kubectl.kubernetes.io/last-applied-configuration": "{}",
+        "notebooks.opendatahub.io/inject-auth": "true",
+        "custom/keep": "yes",
+    })
+    apply_notebook(store, manager, nb)
+    sts = store.get("StatefulSet", "ns", "mynb")
+    anns = sts["metadata"]["annotations"]
+    assert "custom/keep" in anns
+    assert "kubectl.kubernetes.io/last-applied-configuration" not in anns
+    assert "notebooks.opendatahub.io/inject-auth" not in anns
+
+
+def test_tpu_v5e4_single_host(store, manager, notebook_reconciler):
+    nb = api.new_notebook("tpu-nb", "ns", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-4"})
+    apply_notebook(store, manager, nb)
+    sts = store.get("StatefulSet", "ns", "tpu-nb")
+    assert sts["spec"]["replicas"] == 1
+    pod_spec = sts["spec"]["template"]["spec"]
+    assert pod_spec["nodeSelector"] == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "2x2",
+    }
+    c = pod_spec["containers"][0]
+    assert c["resources"]["requests"]["google.com/tpu"] == "4"
+    assert c["resources"]["limits"]["google.com/tpu"] == "4"
+    env = k8s.env_list_to_dict(c["env"])
+    assert env["TPU_WORKER_HOSTNAMES"] == "localhost"
+    # single-host: no headless service needed
+    assert store.get_or_none("Service", "ns", "tpu-nb-workers") is None
+
+
+def test_tpu_v5e16_multi_host(store, manager, notebook_reconciler):
+    nb = api.new_notebook("big", "ns", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"})
+    apply_notebook(store, manager, nb)
+    sts = store.get("StatefulSet", "ns", "big")
+    assert sts["spec"]["replicas"] == 4
+    assert sts["spec"]["serviceName"] == "big-workers"
+    headless = store.get("Service", "ns", "big-workers")
+    assert headless["spec"]["clusterIP"] == "None"
+    assert headless["spec"]["publishNotReadyAddresses"] is True
+    c = sts["spec"]["template"]["spec"]["containers"][0]
+    env = k8s.env_list_to_dict(c["env"])
+    assert env["TPU_WORKER_HOSTNAMES"] == ",".join(
+        f"big-{i}.big-workers.ns.svc" for i in range(4))
+    assert env["TPU_TOPOLOGY"] == "4x4"
+    worker_id = [e for e in c["env"] if e["name"] == "TPU_WORKER_ID"][0]
+    assert worker_id["valueFrom"]["fieldRef"]["fieldPath"] == \
+        "metadata.labels['apps.kubernetes.io/pod-index']"
+
+
+def test_long_name_multihost_hostnames_use_real_sts_name(store, manager,
+                                                         notebook_reconciler):
+    """TPU_WORKER_HOSTNAMES must be derived from the materialized STS name
+    when the 52-char rule forces GenerateName, or workers resolve DNS names
+    that don't exist (review finding)."""
+    long_name = "n" * 60
+    nb = api.new_notebook(long_name, "ns", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"})
+    apply_notebook(store, manager, nb)
+    sts = store.list("StatefulSet", "ns",
+                     {names.NOTEBOOK_NAME_LABEL: long_name})[0]
+    real_name = k8s.name(sts)
+    assert real_name.startswith("nb-") and real_name != long_name
+    env = k8s.env_list_to_dict(
+        sts["spec"]["template"]["spec"]["containers"][0]["env"])
+    # hostnames are <real-sts-name>-<i>.<headless>.<ns>.svc
+    for i in range(4):
+        assert f"{real_name}-{i}." in env["TPU_WORKER_HOSTNAMES"]
+    assert long_name not in env["TPU_WORKER_HOSTNAMES"].split(",")[0].split(".")[0]
+
+
+def test_cr_labels_and_annotations_reach_pod_template(store, manager,
+                                                     notebook_reconciler):
+    """Reference :479-491 propagates CR labels + filtered annotations into
+    the pod template (poddefault labels, istio annotations...)."""
+    nb = api.new_notebook("mynb", "ns",
+                          labels={"poddefault/enable-gpu": "true"},
+                          annotations={"sidecar.istio.io/inject": "false",
+                                       "kubectl.kubernetes.io/x": "drop"})
+    apply_notebook(store, manager, nb)
+    tmpl = store.get("StatefulSet", "ns", "mynb")["spec"]["template"]
+    assert tmpl["metadata"]["labels"]["poddefault/enable-gpu"] == "true"
+    assert tmpl["metadata"]["annotations"]["sidecar.istio.io/inject"] == "false"
+    assert "kubectl.kubernetes.io/x" not in tmpl["metadata"]["annotations"]
+
+
+def test_e2e_slice_ready_with_simulator(store, manager, notebook_reconciler):
+    sim = StatefulSetSimulator(store, boot_delay_s=0.0)
+    sim.setup(manager)
+    nb = api.new_notebook("big", "ns", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"})
+    store.create(nb)
+    drain(manager, include_delayed_under=0.1)
+    pods = store.list("Pod", "ns", {names.NOTEBOOK_NAME_LABEL: "big"})
+    assert len(pods) == 4
+    assert {k8s.get_label(p, "apps.kubernetes.io/pod-index") for p in pods} == \
+        {"0", "1", "2", "3"}
+    cur = store.get(api.KIND, "ns", "big")
+    cond = api.get_condition(cur, api.CONDITION_SLICE_READY)
+    assert cond and cond["status"] == "True"
+    assert cur["status"]["readyReplicas"] == 4
+    # cull: stop annotation reaps ALL workers atomically
+    store.patch(api.KIND, "ns", "big", {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: "t"}}})
+    drain(manager, include_delayed_under=0.1)
+    assert store.list("Pod", "ns", {names.NOTEBOOK_NAME_LABEL: "big"}) == []
+    cur = store.get(api.KIND, "ns", "big")
+    cond = api.get_condition(cur, api.CONDITION_SLICE_READY)
+    assert cond["status"] == "False"
+
+
+def test_restart_annotation_bounces_pods(store, manager, notebook_reconciler):
+    sim = StatefulSetSimulator(store, boot_delay_s=0.0)
+    sim.setup(manager)
+    store.create(api.new_notebook("mynb", "ns"))
+    drain(manager, include_delayed_under=0.1)
+    pod = store.list("Pod", "ns", {names.NOTEBOOK_NAME_LABEL: "mynb"})[0]
+    first_uid = k8s.uid(pod)
+    store.patch(api.KIND, "ns", "mynb", {"metadata": {"annotations": {
+        names.RESTART_ANNOTATION: "true"}}})
+    drain(manager, include_delayed_under=0.1)
+    # annotation stripped, pod recreated with a new uid
+    cur = store.get(api.KIND, "ns", "mynb")
+    assert k8s.get_annotation(cur, names.RESTART_ANNOTATION) is None
+    pods = store.list("Pod", "ns", {names.NOTEBOOK_NAME_LABEL: "mynb"})
+    assert len(pods) == 1 and k8s.uid(pods[0]) != first_uid
+
+
+def test_deletion_cascades(store, manager, notebook_reconciler):
+    store.create(api.new_notebook("mynb", "ns"))
+    drain(manager)
+    store.delete(api.KIND, "ns", "mynb")
+    drain(manager)
+    assert store.get_or_none("StatefulSet", "ns", "mynb") is None
+    assert store.get_or_none("Service", "ns", "mynb") is None
+
+
+def test_idempotent_no_spurious_updates(store, manager, notebook_reconciler):
+    store.create(api.new_notebook("mynb", "ns"))
+    drain(manager)
+    sts_rv = store.get("StatefulSet", "ns", "mynb")["metadata"]["resourceVersion"]
+    svc_rv = store.get("Service", "ns", "mynb")["metadata"]["resourceVersion"]
+    from kubeflow_tpu.controllers.manager import Request
+    manager.enqueue("notebook-controller", Request("ns", "mynb"))
+    drain(manager)
+    assert store.get("StatefulSet", "ns", "mynb")["metadata"]["resourceVersion"] == sts_rv
+    assert store.get("Service", "ns", "mynb")["metadata"]["resourceVersion"] == svc_rv
+
+
+def test_service_clusterip_never_copied(store, manager, notebook_reconciler):
+    """CopyServiceFields must never clobber clusterIP
+    (reconcilehelper util.go:182)."""
+    store.create(api.new_notebook("mynb", "ns"))
+    drain(manager)
+    svc = store.get("Service", "ns", "mynb")
+    svc["spec"]["clusterIP"] = "10.0.0.7"  # apiserver-assigned
+    svc["metadata"]["labels"]["drift"] = "yes"
+    store.update(svc)
+    drain(manager)
+    cur = store.get("Service", "ns", "mynb")
+    assert cur["spec"]["clusterIP"] == "10.0.0.7"
+    assert "drift" not in cur["metadata"]["labels"]

@@ -1,0 +1,125 @@
+"""API-machinery semantics the controllers depend on (the envtest contract,
+SURVEY §4.2)."""
+
+import pytest
+
+from kubeflow_tpu.cluster import (AlreadyExistsError, ConflictError,
+                                  NotFoundError)
+from kubeflow_tpu.cluster.store import ClusterStore, WatchEvent
+from kubeflow_tpu.utils import k8s
+
+
+def mk(kind, name, ns="default", **extra):
+    obj = {"apiVersion": "v1", "kind": kind,
+           "metadata": {"name": name, "namespace": ns}}
+    obj.update(extra)
+    return obj
+
+
+def test_create_sets_metadata(store):
+    out = store.create(mk("ConfigMap", "a"))
+    assert out["metadata"]["uid"]
+    assert out["metadata"]["resourceVersion"]
+    assert out["metadata"]["creationTimestamp"]
+    assert out["metadata"]["generation"] == 1
+
+
+def test_create_duplicate_conflicts(store):
+    store.create(mk("ConfigMap", "a"))
+    with pytest.raises(AlreadyExistsError):
+        store.create(mk("ConfigMap", "a"))
+
+
+def test_generate_name(store):
+    obj = {"apiVersion": "apps/v1", "kind": "StatefulSet",
+           "metadata": {"generateName": "nb-", "namespace": "default"}}
+    out = store.create(obj)
+    assert out["metadata"]["name"].startswith("nb-")
+    assert len(out["metadata"]["name"]) > 3
+
+
+def test_optimistic_concurrency(store):
+    a = store.create(mk("ConfigMap", "a", data={"k": "1"}))
+    b = store.get("ConfigMap", "default", "a")
+    b["data"] = {"k": "2"}
+    store.update(b)
+    a["data"] = {"k": "3"}
+    with pytest.raises(ConflictError):
+        store.update(a)  # stale resourceVersion
+
+
+def test_generation_bumps_on_spec_change_only(store):
+    obj = store.create(mk("StatefulSet", "s", spec={"replicas": 1}))
+    obj["metadata"]["labels"] = {"x": "y"}
+    obj = store.update(obj)
+    assert obj["metadata"]["generation"] == 1
+    obj["spec"]["replicas"] = 2
+    obj = store.update(obj)
+    assert obj["metadata"]["generation"] == 2
+
+
+def test_merge_patch_removes_with_null(store):
+    store.create(mk("Notebook", "nb",
+                    metadata={"name": "nb", "namespace": "default",
+                              "annotations": {"a": "1", "b": "2"}}))
+    out = store.patch("Notebook", "default", "nb",
+                      {"metadata": {"annotations": {"a": None}}})
+    assert out["metadata"]["annotations"] == {"b": "2"}
+
+
+def test_finalizer_two_phase_delete(store):
+    obj = mk("Notebook", "nb")
+    obj["metadata"]["finalizers"] = ["example/fin"]
+    store.create(obj)
+    store.delete("Notebook", "default", "nb")
+    # still present, marked deleting
+    cur = store.get("Notebook", "default", "nb")
+    assert k8s.is_deleting(cur)
+    # strip finalizer → object actually removed
+    cur["metadata"]["finalizers"] = []
+    store.update(cur)
+    with pytest.raises(NotFoundError):
+        store.get("Notebook", "default", "nb")
+
+
+def test_owner_gc_cascade(store):
+    owner = store.create(mk("Notebook", "nb"))
+    child = mk("StatefulSet", "nb")
+    k8s.set_controller_reference(owner, child)
+    store.create(child)
+    grandchild = mk("Pod", "nb-0")
+    k8s.set_controller_reference(store.get("StatefulSet", "default", "nb"),
+                                 grandchild)
+    store.create(grandchild)
+    store.delete("Notebook", "default", "nb")
+    with pytest.raises(NotFoundError):
+        store.get("StatefulSet", "default", "nb")
+    with pytest.raises(NotFoundError):
+        store.get("Pod", "default", "nb-0")
+
+
+def test_watch_events(store):
+    seen = []
+    store.watch("ConfigMap", seen.append)
+    store.create(mk("ConfigMap", "a"))
+    cur = store.get("ConfigMap", "default", "a")
+    cur["data"] = {"x": "1"}
+    store.update(cur)
+    store.delete("ConfigMap", "default", "a")
+    assert [e.type for e in seen] == ["ADDED", "MODIFIED", "DELETED"]
+
+
+def test_update_status_subresource_ignores_spec(store):
+    obj = store.create(mk("StatefulSet", "s", spec={"replicas": 1}))
+    obj["spec"]["replicas"] = 5
+    obj["status"] = {"readyReplicas": 1}
+    out = store.update_status(obj)
+    assert out["spec"]["replicas"] == 1
+    assert out["status"]["readyReplicas"] == 1
+
+
+def test_cluster_scoped_kinds(store):
+    store.create({"apiVersion": "rbac.authorization.k8s.io/v1",
+                  "kind": "ClusterRoleBinding",
+                  "metadata": {"name": "crb", "namespace": "ignored"}})
+    assert store.get("ClusterRoleBinding", "", "crb")

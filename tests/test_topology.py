@@ -1,0 +1,73 @@
+"""TPU slice topology math (SURVEY §7 stage 3)."""
+
+import pytest
+
+from kubeflow_tpu.tpu.topology import (TpuRequestError, parse_short_name,
+                                       parse_slice_request, parse_topology)
+from kubeflow_tpu.utils import names
+
+
+def test_v5e_16_multihost():
+    s = parse_short_name("v5e-16")
+    assert s.topology == (4, 4)
+    assert s.num_workers == 4
+    assert s.chips_per_worker == 4
+    assert s.multi_host
+    assert s.gke_accelerator == "tpu-v5-lite-podslice"
+    assert s.node_selectors() == {
+        "cloud.google.com/gke-tpu-accelerator": "tpu-v5-lite-podslice",
+        "cloud.google.com/gke-tpu-topology": "4x4",
+    }
+
+
+def test_v5e_singlehost_shapes():
+    assert parse_short_name("v5e-1").num_workers == 1
+    s4 = parse_short_name("v5e-4")
+    assert (s4.num_workers, s4.chips_per_worker, s4.topology) == (1, 4, (2, 2))
+    s8 = parse_short_name("v5e-8")
+    assert (s8.num_workers, s8.chips_per_worker) == (1, 8)
+
+
+def test_v5e_256_max():
+    s = parse_short_name("v5e-256")
+    assert s.topology == (16, 16)
+    assert s.num_workers == 64
+    with pytest.raises(TpuRequestError):
+        parse_short_name("v5e-512")
+
+
+def test_v4_3d():
+    s = parse_topology("v4", "2x2x2")
+    assert s.chips == 8
+    assert s.num_workers == 2
+    assert s.chips_per_worker == 4
+    s1 = parse_topology("v4", "2x2x1")
+    assert s1.num_workers == 1
+
+
+def test_worker_hostnames():
+    s = parse_short_name("v5e-16")
+    hosts = s.worker_hostnames("mynb", "mynb-workers", "user-ns")
+    assert hosts[0] == "mynb-0.mynb-workers.user-ns.svc"
+    assert len(hosts) == 4
+
+
+def test_parse_slice_request_annotations():
+    assert parse_slice_request(None) is None
+    assert parse_slice_request({"unrelated": "x"}) is None
+    s = parse_slice_request({names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"})
+    assert s.chips == 16
+    s = parse_slice_request({names.TPU_ACCELERATOR_ANNOTATION: "v5e",
+                             names.TPU_TOPOLOGY_ANNOTATION: "2x4"})
+    assert s.chips == 8
+    with pytest.raises(TpuRequestError):
+        parse_slice_request({names.TPU_TOPOLOGY_ANNOTATION: "2x4"})
+    with pytest.raises(TpuRequestError):
+        parse_slice_request({names.TPU_ACCELERATOR_ANNOTATION: "v99-4"})
+
+
+def test_malformed_topology():
+    with pytest.raises(TpuRequestError):
+        parse_topology("v5e", "4x4x4")  # v5e is 2-D
+    with pytest.raises(TpuRequestError):
+        parse_topology("v5e", "banana")
