@@ -1,5 +1,5 @@
-from .types import (API_VERSION, GROUP, KIND, new_notebook, notebook_container,
-                    validate_notebook)
+from .types import (API_VERSION, GROUP, KIND, install_notebook_crd,
+                    new_notebook, notebook_container, validate_notebook)
 
-__all__ = ["API_VERSION", "GROUP", "KIND", "new_notebook",
-           "notebook_container", "validate_notebook"]
+__all__ = ["API_VERSION", "GROUP", "KIND", "install_notebook_crd",
+           "new_notebook", "notebook_container", "validate_notebook"]

@@ -92,6 +92,18 @@ def validate_notebook(notebook: dict) -> None:
             raise InvalidError("containers require name and image")
 
 
+def install_notebook_crd(store) -> None:
+    """Install the Notebook CRD's structural schema validation into an
+    apiserver (ClusterStore) — the analog of applying
+    config/crd/bases/kubeflow.org_notebooks.yaml: invalid CRs are rejected at
+    admission instead of crash-looping reconcilers."""
+    def admit(operation, obj, old):
+        if operation in ("CREATE", "UPDATE"):
+            validate_notebook(obj)
+        return obj
+    store.register_admission(KIND, admit)
+
+
 def get_condition(notebook: dict, cond_type: str) -> dict | None:
     for c in k8s.get_in(notebook, "status", "conditions", default=[]) or []:
         if c.get("type") == cond_type:

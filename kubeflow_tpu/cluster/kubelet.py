@@ -19,9 +19,9 @@ from __future__ import annotations
 
 import time
 
+from ..controllers.manager import Request, Result, owner_mapper
 from ..utils import k8s
 from . import errors
-from .manager_compat import Request, Result  # thin re-export, see module
 from .store import ClusterStore
 
 
@@ -38,7 +38,6 @@ class StatefulSetSimulator:
         self._boot_times: dict[tuple[str, str], float] = {}
 
     def setup(self, mgr) -> None:
-        from ..controllers.manager import owner_mapper
         mgr.register(self)
         mgr.watch("StatefulSet", self.name)
         mgr.watch("Pod", self.name, mapper=owner_mapper("StatefulSet"))

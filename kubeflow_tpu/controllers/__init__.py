@@ -13,8 +13,11 @@ def setup_controllers(client, config=None, metrics=None, prober=None):
     from ..utils.config import ControllerConfig
     from ..utils.metrics import MetricsRegistry
 
+    from ..api.types import install_notebook_crd
+
     config = config or ControllerConfig.from_env()
     metrics = metrics or MetricsRegistry()
+    install_notebook_crd(client)
     mgr = Manager(client)
     NotebookReconciler(client, config, metrics).setup(mgr)
     if config.enable_culling:

@@ -40,8 +40,9 @@ DEFAULT_SERVICE_PORT = 80
 DEFAULT_WORKDIR = "/home/jovyan"
 DEFAULT_FSGROUP = 100
 
-# annotation prefixes NOT copied from CR to pod template (reference :486-491)
-_EXCLUDED_ANNOTATION_PREFIXES = ("kubectl.kubernetes.io/", "notebook")
+# annotation substrings NOT copied from CR to pod template — the reference
+# excludes keys *containing* these anywhere (strings.Contains, :486-491)
+_EXCLUDED_ANNOTATION_SUBSTRINGS = ("kubectl", "notebook")
 
 
 class NotebookReconciler:
@@ -118,7 +119,7 @@ class NotebookReconciler:
         out = {}
         for key, val in (k8s.get_in(notebook, "metadata", "annotations",
                                     default={}) or {}).items():
-            if any(key.startswith(p) for p in _EXCLUDED_ANNOTATION_PREFIXES):
+            if any(s in key for s in _EXCLUDED_ANNOTATION_SUBSTRINGS):
                 continue
             if key in (names.TPU_ACCELERATOR_ANNOTATION,
                        names.TPU_TOPOLOGY_ANNOTATION):
@@ -141,20 +142,19 @@ class NotebookReconciler:
         sts_name, use_generate = names.sts_name_for_notebook(nb_name)
         pod_spec = k8s.deepcopy(api.notebook_pod_spec(notebook))
 
-        containers = pod_spec.get("containers", [])
-        for idx, container in enumerate(containers):
-            if container.get("name") != nb_name and idx != 0:
-                continue
-            if container.get("name") == nb_name or idx == 0:
-                container.setdefault("workingDir", DEFAULT_WORKDIR)
-                if not container.get("ports"):
-                    container["ports"] = [{
-                        "containerPort": DEFAULT_CONTAINER_PORT,
-                        "name": "notebook-port",
-                        "protocol": "TCP",
-                    }]
-                k8s.upsert_env(container, "NB_PREFIX", names.nb_prefix(ns, nb_name))
-                break
+        # the notebook container is the one named after the CR, falling back
+        # to containers[0] (same convention as the webhook/reference) — TPU
+        # injection below targets the same container
+        container = _notebook_container(pod_spec, nb_name)
+        if container is not None:
+            container.setdefault("workingDir", DEFAULT_WORKDIR)
+            if not container.get("ports"):
+                container["ports"] = [{
+                    "containerPort": DEFAULT_CONTAINER_PORT,
+                    "name": "notebook-port",
+                    "protocol": "TCP",
+                }]
+            k8s.upsert_env(container, "NB_PREFIX", names.nb_prefix(ns, nb_name))
 
         if self.config.add_fsgroup:
             pod_spec.setdefault("securityContext", {}).setdefault(
@@ -216,8 +216,9 @@ class NotebookReconciler:
         sts["spec"]["template"]["metadata"]["labels"][names.TPU_SLICE_LABEL] = (
             slice_spec.short_name)
 
-        container = (k8s.find_container(pod_spec, nb_name)
-                     or pod_spec.get("containers", [{}])[0])
+        container = _notebook_container(pod_spec, nb_name)
+        if container is None:
+            return  # structurally invalid CR; admission validation rejects these
         resources = container.setdefault("resources", {})
         qty = str(slice_spec.chips_per_worker)
         resources.setdefault("requests", {})["google.com/tpu"] = qty
@@ -234,11 +235,8 @@ class NotebookReconciler:
             k8s.upsert_env(container, "TPU_WORKER_HOSTNAMES", "localhost")
         # Worker id = StatefulSet pod ordinal, surfaced by the apps controller
         # as the pod-index label (stable across pod restarts).
-        container.setdefault("env", []).append({
-            "name": "TPU_WORKER_ID",
-            "valueFrom": {"fieldRef": {
-                "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"}},
-        })
+        k8s.upsert_env_from(container, "TPU_WORKER_ID", {"fieldRef": {
+            "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"}})
         k8s.upsert_env(container, "TPU_ACCELERATOR_TYPE", slice_spec.short_name)
         k8s.upsert_env(container, "TPU_TOPOLOGY", slice_spec.topology_str)
 
@@ -425,6 +423,15 @@ class NotebookReconciler:
                 self.client.update_status(notebook)
             except errors.ConflictError:
                 pass  # next event re-enqueues
+
+
+def _notebook_container(pod_spec: dict, nb_name: str) -> dict | None:
+    """The container named after the CR, else containers[0], else None."""
+    c = k8s.find_container(pod_spec, nb_name)
+    if c is not None:
+        return c
+    containers = pod_spec.get("containers") or []
+    return containers[0] if containers else None
 
 
 def headless_service_name(notebook_name: str) -> str:

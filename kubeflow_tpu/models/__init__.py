@@ -1,0 +1,3 @@
+from .transformer import TransformerConfig, init_params, forward, param_logical_specs
+
+__all__ = ["TransformerConfig", "init_params", "forward", "param_logical_specs"]

@@ -1,0 +1,146 @@
+"""Training step: loss, optimizer wiring, and the sharded update.
+
+The training loop the provisioned notebooks run on their slice. One jitted
+function carries the whole step (forward, backward, optimizer) so XLA fuses
+and schedules collectives; shardings come from the logical-axis rules, so the
+same step runs dp/fsdp/tp/sp configurations unchanged."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.sharding import DEFAULT_RULES, PartitionRules, param_shardings
+from .transformer import (TransformerConfig, forward, init_params,
+                          param_logical_specs, pipelined_forward)
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    weight_decay: float = 0.01
+    b1: float = 0.9
+    b2: float = 0.95
+    grad_clip: float = 1.0
+    warmup_steps: int = 100
+
+
+def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, tc.learning_rate, tc.warmup_steps, 10_000)
+    return optax.chain(
+        optax.clip_by_global_norm(tc.grad_clip),
+        optax.adamw(schedule, b1=tc.b1, b2=tc.b2,
+                    weight_decay=tc.weight_decay),
+    )
+
+
+def loss_fn(params, tokens, targets, config: TransformerConfig, mesh=None,
+            forward_impl=forward):
+    """Next-token cross entropy, mean over non-padding (-1 targets)."""
+    logits = forward_impl(params, tokens, config, mesh=mesh)
+    valid = targets >= 0
+    safe_targets = jnp.where(valid, targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_targets[..., None],
+                               axis=-1).squeeze(-1)
+    nll = jnp.where(valid, nll, 0.0)
+    return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def train_step(params, opt_state, tokens, targets, *,
+               config: TransformerConfig, optimizer, mesh=None,
+               forward_impl=forward):
+    loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets,
+                                              config, mesh, forward_impl)
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return params, opt_state, loss
+
+
+def pipeline_rules() -> PartitionRules:
+    """Partition rules for pipeline configs: the stacked layer axis shards
+    over pp (contiguous layer blocks per stage)."""
+    rules = tuple(("layers", "pp") if k == "layers" else (k, v)
+                  for k, v in DEFAULT_RULES)
+    return PartitionRules(rules=rules)
+
+
+def make_sharded_train_step(mesh: Mesh, config: TransformerConfig,
+                            tc: TrainConfig | None = None,
+                            rules: PartitionRules | None = None,
+                            n_microbatches: int | None = None):
+    """Build (init_fn, step_fn) jitted with NamedShardings over ``mesh``.
+
+    - params/optimizer state shard per the logical-axis rules (fsdp/tp; with
+      pp>1 the layer stack shards over pp and the forward pass pipelines);
+    - batches shard over (dp, fsdp) × sp;
+    - params+opt_state buffers are donated (in-place update, halves HBM).
+    """
+    tc = tc or TrainConfig()
+    pp = mesh.shape.get("pp", 1)
+    if pp > 1:
+        rules = rules or pipeline_rules()
+        n_microbatches = n_microbatches or 2 * pp
+        fwd = partial(pipelined_forward_adapter, n_microbatches=n_microbatches)
+    else:
+        rules = rules or PartitionRules()
+        fwd = forward
+    optimizer = make_optimizer(tc)
+    p_shardings = param_shardings(mesh, param_logical_specs(config), rules)
+    batch_sh = NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+    replicated = NamedSharding(mesh, P())
+
+    def _opt_shardings():
+        """Optimizer state mirrors param sharding: optax states embed pytrees
+        with the params' structure (adamw mu/nu), so an optimizer-state leaf
+        whose path *ends with* a param path gets that param's sharding;
+        everything else (counters, scalars) replicates."""
+        from jax.tree_util import tree_flatten_with_path
+
+        params_shape = jax.eval_shape(lambda k: init_params(k, config),
+                                      jax.random.key(0))
+        opt_shape = jax.eval_shape(optimizer.init, params_shape)
+        param_by_path = {
+            tuple(str(k) for k in path): sh
+            for (path, sh) in tree_flatten_with_path(p_shardings)[0]}
+
+        leaves, treedef = tree_flatten_with_path(opt_shape)
+        out = []
+        for path, leaf in leaves:
+            keys = tuple(str(k) for k in path)
+            sh = replicated
+            for start in range(len(keys)):
+                if keys[start:] in param_by_path:
+                    sh = param_by_path[keys[start:]]
+                    break
+            out.append(sh if leaf.ndim > 0 else replicated)
+        return jax.tree.unflatten(treedef, out)
+
+    opt_shardings = _opt_shardings()
+
+    @partial(jax.jit, out_shardings=(p_shardings, opt_shardings))
+    def init_fn(key):
+        params = init_params(key, config)
+        return params, optimizer.init(params)
+
+    @partial(jax.jit,
+             in_shardings=(p_shardings, opt_shardings, batch_sh, batch_sh),
+             out_shardings=(p_shardings, opt_shardings, replicated),
+             donate_argnums=(0, 1))
+    def step_fn(params, opt_state, tokens, targets):
+        return train_step(params, opt_state, tokens, targets,
+                          config=config, optimizer=optimizer, mesh=mesh,
+                          forward_impl=fwd)
+
+    return init_fn, step_fn
+
+
+def pipelined_forward_adapter(params, tokens, config, mesh=None, *,
+                              n_microbatches):
+    return pipelined_forward(params, tokens, config, mesh, n_microbatches)

@@ -1,0 +1,279 @@
+"""Flagship workload: decoder-only transformer LM, TPU-first.
+
+This is the model the framework provisions into notebook slices for
+verification and benchmarking (BASELINE.md configs; the reference provisions
+Jupyter images and has no model code — SURVEY §2d — so this model is the
+TPU-native analog of its workload layer).
+
+Design for the MXU/XLA:
+- pure functional: params are an explicit pytree; every weight carries a
+  logical-axis spec (parallel/sharding.py) so one model definition runs under
+  any MeshConfig (dp/fsdp/tp/sp) without edits;
+- bfloat16 activations/matmuls, float32 params + softmax/norm accumulation;
+- static shapes everywhere; layers iterated with lax.scan over stacked
+  weights (one compiled layer body, no Python unrolling);
+- optional jax.checkpoint (remat) per layer to trade FLOPs for HBM;
+- attention dispatches to ring attention (parallel/ring.py) when the mesh has
+  sp>1, else a fused XLA softmax path (ops/attention.py provides the Pallas
+  flash kernel used on real TPU).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.sharding import PartitionRules
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: int = 8          # < n_heads ⇒ grouped-query attention
+    d_ff: int = 1376
+    max_seq_len: int = 2048
+    rope_theta: float = 10_000.0
+    dtype: str = "bfloat16"      # activation/compute dtype
+    param_dtype: str = "float32"
+    remat: bool = False
+    attention: str = "auto"      # auto | xla | ring | flash
+
+    @property
+    def d_head(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @property
+    def compute_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def replace(self, **kw) -> "TransformerConfig":
+        return dataclasses.replace(self, **kw)
+
+
+# ------------------------------------------------------------------ params
+def param_logical_specs(config: TransformerConfig) -> dict:
+    """Logical-axis names per weight; parallel.param_shardings turns these
+    into NamedShardings for any mesh. Layer weights are stacked on a leading
+    'layers' axis (scanned, not unrolled)."""
+    return {
+        "embed": ("vocab", "embed"),
+        "blocks": {
+            "attn_norm": ("layers", "norm"),
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "mlp_norm": ("layers", "norm"),
+            "w_gate": ("layers", "embed", "mlp"),
+            "w_up": ("layers", "embed", "mlp"),
+            "w_down": ("layers", "mlp", "embed"),
+        },
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_params(key: jax.Array, config: TransformerConfig) -> dict:
+    c = config
+    pdt = jnp.dtype(c.param_dtype)
+    k_embed, k_blocks, k_head = jax.random.split(key, 3)
+
+    def dense(k, shape, fan_in):
+        return (jax.random.normal(k, shape, pdt) / math.sqrt(fan_in))
+
+    L = c.n_layers
+    kb = jax.random.split(k_blocks, 7)
+    blocks = {
+        "attn_norm": jnp.ones((L, c.d_model), pdt),
+        "wq": dense(kb[0], (L, c.d_model, c.n_heads, c.d_head), c.d_model),
+        "wk": dense(kb[1], (L, c.d_model, c.n_kv_heads, c.d_head), c.d_model),
+        "wv": dense(kb[2], (L, c.d_model, c.n_kv_heads, c.d_head), c.d_model),
+        "wo": dense(kb[3], (L, c.n_heads, c.d_head, c.d_model),
+                    c.n_heads * c.d_head),
+        "mlp_norm": jnp.ones((L, c.d_model), pdt),
+        "w_gate": dense(kb[4], (L, c.d_model, c.d_ff), c.d_model),
+        "w_up": dense(kb[5], (L, c.d_model, c.d_ff), c.d_model),
+        "w_down": dense(kb[6], (L, c.d_ff, c.d_model), c.d_ff),
+    }
+    return {
+        "embed": jax.random.normal(k_embed, (c.vocab_size, c.d_model), pdt),
+        "blocks": blocks,
+        "final_norm": jnp.ones((c.d_model,), pdt),
+        "lm_head": dense(k_head, (c.d_model, c.vocab_size), c.d_model),
+    }
+
+
+# ------------------------------------------------------------------- layers
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    dt = x.dtype
+    x32 = x.astype(jnp.float32)
+    x32 = x32 * lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * weight.astype(jnp.float32)).astype(dt)
+
+
+def rope_frequencies(config: TransformerConfig, positions: jax.Array):
+    """positions: (..., seq) int32 → cos/sin of shape (..., seq, d_head/2)."""
+    d = config.d_head // 2
+    inv_freq = config.rope_theta ** (-jnp.arange(0, d, dtype=jnp.float32) / d)
+    angles = positions[..., None].astype(jnp.float32) * inv_freq
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (batch, seq, heads, d_head); cos/sin: (batch, seq, d_head/2)."""
+    dt = x.dtype
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    cos = cos[..., None, :]
+    sin = sin[..., None, :]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(dt)
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """(b, s, kv_heads, d) → (b, s, kv_heads*n_rep, d) for GQA."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=2)
+
+
+def xla_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                  causal: bool = True) -> jax.Array:
+    """Reference attention in pure XLA ops — fused well by the compiler;
+    float32 softmax accumulation. Shapes: (b, s, h, d)."""
+    b, sq, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        sk = k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _select_attention(config: TransformerConfig, mesh) -> str:
+    if config.attention != "auto":
+        return config.attention
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        return "ring"
+    if jax.default_backend() == "tpu":
+        return "flash"
+    return "xla"
+
+
+def attention_block(x, layer, config: TransformerConfig, cos, sin, mesh=None):
+    c = config
+    h = rms_norm(x, layer["attn_norm"])
+    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(h.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(h.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(h.dtype))
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    k = repeat_kv(k, c.n_heads // c.n_kv_heads)
+    v = repeat_kv(v, c.n_heads // c.n_kv_heads)
+
+    kind = _select_attention(c, mesh)
+    if kind == "ring":
+        from ..parallel.ring import ring_attention
+        out = ring_attention(q, k, v, mesh=mesh, axis_name="sp", causal=True)
+    elif kind == "flash":
+        from ..ops.attention import flash_attention
+        out = flash_attention(q, k, v, causal=True)
+    else:
+        out = xla_attention(q, k, v, causal=True)
+    return x + jnp.einsum("bshk,hkd->bsd", out, layer["wo"].astype(h.dtype))
+
+
+def mlp_block(x, layer, config: TransformerConfig):
+    h = rms_norm(x, layer["mlp_norm"])
+    dt = h.dtype
+    gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(dt))
+    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt))
+    return x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                          layer["w_down"].astype(dt))
+
+
+def forward(params: dict, tokens: jax.Array, config: TransformerConfig,
+            mesh=None, positions: jax.Array | None = None) -> jax.Array:
+    """tokens: (batch, seq) int32 → logits (batch, seq, vocab) float32.
+
+    When the mesh has sp>1 the caller passes sequence-sharded tokens plus the
+    matching global ``positions`` (runtime handles this; ring attention makes
+    the causal math correct across shards)."""
+    c = config
+    x = params["embed"].astype(c.compute_dtype)[tokens]
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+        positions = jnp.broadcast_to(positions, tokens.shape)
+    cos, sin = rope_frequencies(c, positions)
+
+    def layer_body(x, layer):
+        x = attention_block(x, layer, c, cos, sin, mesh=mesh)
+        x = mlp_block(x, layer, c)
+        return x, None
+
+    body = layer_body
+    if c.remat:
+        body = jax.checkpoint(layer_body)
+    x, _ = lax.scan(body, x, params["blocks"])
+
+    x = rms_norm(x, params["final_norm"])
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(x.dtype)
+                      ).astype(jnp.float32)
+
+
+def pipelined_forward(params: dict, tokens: jax.Array,
+                      config: TransformerConfig, mesh,
+                      n_microbatches: int) -> jax.Array:
+    """Forward pass with the layer stack pipelined over the ``pp`` mesh axis
+    (parallel/pipeline.py). Embedding and LM head run outside the pipeline
+    (they live on every stage's data shards); the blocks are split into
+    contiguous stages. RoPE tables are position-only (batch-size 1) so they
+    broadcast across microbatches."""
+    from ..parallel.pipeline import pipeline_apply, split_stages
+
+    c = config
+    x = params["embed"].astype(c.compute_dtype)[tokens]
+    positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+    cos, sin = rope_frequencies(c, positions)
+
+    stages = split_stages(params["blocks"], mesh.shape["pp"])
+
+    def stage_fn(stage_layers, act):
+        def body(h, layer):
+            h = attention_block(h, layer, c, cos, sin, mesh=None)
+            h = mlp_block(h, layer, c)
+            return h, None
+        body_fn = jax.checkpoint(body) if c.remat else body
+        act, _ = lax.scan(body_fn, act, stage_layers)
+        return act
+
+    x = pipeline_apply(stages, x, stage_fn, mesh=mesh,
+                       n_microbatches=n_microbatches)
+    x = rms_norm(x, params["final_norm"])
+    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(x.dtype)
+                      ).astype(jnp.float32)
+
+
+def count_params(params) -> int:
+    return sum(p.size for p in jax.tree.leaves(params))
+
+
+def model_flops_per_token(config: TransformerConfig) -> float:
+    """Approximate forward FLOPs/token (2*params matmul convention)."""
+    c = config
+    per_layer = 2 * (c.d_model * c.n_heads * c.d_head * 2
+                     + c.d_model * c.n_kv_heads * c.d_head * 2
+                     + 3 * c.d_model * c.d_ff)
+    return c.n_layers * per_layer + 2 * c.d_model * c.vocab_size

@@ -1,0 +1,160 @@
+"""Pallas flash attention for TPU.
+
+The hot op of the flagship workload. FlashAttention-2-style streaming softmax
+in the canonical TPU grid form: grid = (batch, heads, q_blocks, kv_blocks)
+with the kv axis innermost and sequential ("arbitrary"), so each (q_block)
+output revisits across kv steps while Pallas double-buffers the K/V block DMAs
+HBM→VMEM. Per-program VMEM is O(block_q·d + block_k·d) — long sequences
+stream, they never have to fit in VMEM. The running (max, sum, accumulator)
+recurrence lives in VMEM scratch that persists across the kv grid steps.
+Causal masking skips fully-masked kv blocks' compute via pl.when.
+
+Backward currently recomputes through the XLA reference path via custom_vjp
+(correct everywhere; a dedicated backward kernel is a later optimization).
+On non-TPU backends the kernel runs in interpreter mode for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
+_LANES = 128  # per-row stats are stored lane-replicated for (8,128) tiling
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                  scale: float, causal: bool, num_kv: int):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    block_q = q_ref.shape[2]
+    block_k = k_ref.shape[2]
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale          # (bq, d)
+        k = k_ref[0, 0].astype(jnp.float32)                  # (bk, d)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bq, bk)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = kj * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            mask = q_pos >= k_pos
+            logits = jnp.where(mask, logits, _NEG_INF)
+        m_prev = m_scr[:, :1]                                # (bq, 1)
+        l_prev = l_scr[:, :1]
+        row_max = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, row_max)
+        p = jnp.exp(logits - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)                      # (bq, 1)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p.astype(v_ref.dtype), v_ref[0, 0],
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # a kv block right of the diagonal contributes nothing — skip compute
+        pl.when(kj * block_k <= qi * block_q + block_q - 1)(compute)
+    else:
+        compute()
+
+    @pl.when(kj == num_kv - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
+                   interpret: bool):
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq len {s} must be divisible by blocks "
+                         f"({block_q}, {block_k})")
+    scale = 1.0 / math.sqrt(d)
+    # (b, s, h, d) → (b, h, s, d): the kernel wants (seq, d) as the minor
+    # dims (TPU (8,128) tiling); XLA fuses the transposes into neighbors
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    num_kv = s // block_k
+    grid = (b, h, s // block_q, num_kv)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               num_kv=num_kv)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, kj: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, kj: (bi, hi, kj, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),        # output accumulator
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, causal, block_q, block_k):
+    interpret = jax.default_backend() != "tpu"
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k):
+    return _flash(q, k, v, causal, block_q, block_k), (q, k, v)
+
+
+def _flash_bwd(causal, block_q, block_k, res, g):
+    # Recompute-based backward through the XLA reference (exact); a fused
+    # backward kernel replaces this on the optimization pass.
+    from ..models.transformer import xla_attention
+    q, k, v = res
+    _, vjp = jax.vjp(lambda q, k, v: xla_attention(q, k, v, causal=causal),
+                     q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
+    """q/k/v: (batch, seq, heads, d_head) → (batch, seq, heads, d_head).
+    GQA callers repeat K/V heads before the call (models/transformer.py)."""
+    return _flash(q, k, v, causal, block_q, block_k)

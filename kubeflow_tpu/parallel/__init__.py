@@ -1,0 +1,6 @@
+from .mesh import MeshConfig, build_mesh, AXES
+from .sharding import (batch_sharding, named_sharding, param_shardings,
+                       PartitionRules)
+
+__all__ = ["MeshConfig", "build_mesh", "AXES", "batch_sharding",
+           "named_sharding", "param_shardings", "PartitionRules"]

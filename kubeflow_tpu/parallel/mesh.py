@@ -1,0 +1,95 @@
+"""Device-mesh construction and axis conventions.
+
+The workload layer of the framework: the code that runs *inside* the
+containers the control plane provisions (SURVEY §2d — the reference has no
+parallelism code; in this framework the TPU provisioning path and this module
+together realize it). Axis conventions follow the standard TPU sharding
+recipe (mesh → annotate → let XLA insert collectives):
+
+- ``dp``   pure data parallelism (gradients all-reduced over ICI/DCN)
+- ``fsdp`` data parallelism with parameter/optimizer sharding (ZeRO-3-style;
+           params all-gathered per layer, grads reduce-scattered)
+- ``tp``   tensor parallelism (Megatron-style column/row sharded matmuls)
+- ``sp``   sequence/context parallelism (ring attention over the seq axis)
+- ``pp``   pipeline parallelism (layer stages, microbatched)
+- ``ep``   expert parallelism (MoE experts spread over devices)
+
+Multi-host: the controller injects TPU_WORKER_ID/TPU_WORKER_HOSTNAMES
+(controllers/notebook.py) and runtime.bootstrap turns those into a
+jax.distributed world; this module only sees the resulting global device list.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("dp", "fsdp", "pp", "sp", "tp", "ep")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical mesh shape. Any axis set to 1 is still present in the Mesh (a
+    size-1 axis costs nothing under XLA) so PartitionSpecs are config-independent."""
+    dp: int = 1
+    fsdp: int = 1
+    pp: int = 1
+    sp: int = 1
+    tp: int = 1
+    ep: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.dp * self.fsdp * self.pp * self.sp * self.tp * self.ep
+
+    def axis_sizes(self) -> dict[str, int]:
+        return {a: getattr(self, a) for a in AXES}
+
+    @staticmethod
+    def auto(n_devices: int, *, tp: int = 1, sp: int = 1, pp: int = 1,
+             ep: int = 1, fsdp: int | None = None) -> "MeshConfig":
+        """Fill the data axes with whatever devices remain after the model
+        axes are chosen. fsdp defaults to all remaining devices (the usual
+        TPU recipe: fsdp within a slice, dp across slices)."""
+        model = tp * sp * pp * ep
+        if n_devices % model:
+            raise ValueError(f"model axes tp*sp*pp*ep={model} do not divide "
+                             f"device count {n_devices}")
+        remaining = n_devices // model
+        if fsdp is None:
+            fsdp = remaining
+        if remaining % fsdp:
+            raise ValueError(f"fsdp={fsdp} does not divide remaining "
+                             f"{remaining} devices")
+        return MeshConfig(dp=remaining // fsdp, fsdp=fsdp, pp=pp, sp=sp,
+                          tp=tp, ep=ep)
+
+
+def build_mesh(config: MeshConfig, devices=None) -> Mesh:
+    """Build a named Mesh.
+
+    Axis order matters for ICI locality: the innermost (fastest-varying)
+    axes should carry the heaviest collectives. Device order from
+    jax.devices() follows the physical torus, so we place ``tp`` innermost
+    (all-reduce per layer), then ``sp`` (ring permutes), then ``pp``
+    (point-to-point), with the data axes outermost (one gradient
+    reduction per step — fine over DCN)."""
+    if devices is None:
+        devices = jax.devices()
+    if config.size != len(devices):
+        raise ValueError(
+            f"mesh of size {config.size} ({config.axis_sizes()}) != "
+            f"{len(devices)} devices")
+    shape = tuple(getattr(config, a) for a in AXES)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, AXES)
+
+
+def factor_devices(n: int) -> MeshConfig:
+    """Heuristic mesh for quick-start: tp up to 4 if it divides, rest fsdp."""
+    tp = math.gcd(n, 4)
+    return MeshConfig.auto(n, tp=tp)

@@ -1,0 +1,112 @@
+"""Ring attention: exact causal attention over a sequence-sharded mesh axis.
+
+Long-context support (SURVEY preamble: "ring attention or all-to-all
+sequence/context parallelism for long sequences" is first-class). Each device
+holds one sequence block of Q/K/V; K/V blocks rotate around the ``sp`` ring
+via lax.ppermute (XLA collective-permute rides the ICI torus) while each
+device accumulates its Q-block's attention with the numerically-stable
+streaming-softmax (flash) recurrence. Memory per device is O(seq/sp · seq/sp)
+per step instead of O(seq²), and compute/communication overlap is left to
+XLA's async collectives.
+
+Technique after Liu et al., "Ring Attention with Blockwise Transformers"
+(arXiv:2310.01889); implementation is original, built on shard_map + ppermute.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+_NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, *, scale, mask):
+    """One (q-block × kv-block) flash step. q,k,v: (b, s, h, d);
+    mask: (sq, sk) bool or None. Returns (contrib, row_sum, row_max) where
+    contrib = exp(logits - row_max) @ v."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if mask is not None:
+        logits = jnp.where(mask[None, None, :, :], logits, _NEG_INF)
+    row_max = jnp.max(logits, axis=-1)                       # (b, h, sq)
+    p = jnp.exp(logits - row_max[..., None])
+    if mask is not None:
+        p = p * mask[None, None, :, :]
+    row_sum = jnp.sum(p, axis=-1)                            # (b, h, sq)
+    contrib = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return contrib, row_sum, row_max
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                   mesh: Mesh, axis_name: str = "sp",
+                   causal: bool = True) -> jax.Array:
+    """Global-view ring attention. q/k/v: (batch, seq, heads, d_head) with
+    seq sharded over ``axis_name``; returns same shape/sharding as q.
+
+    Callable inside jit; shard_map handles the global→per-device view."""
+    sp = mesh.shape[axis_name]
+    if sp == 1:
+        from ..models.transformer import xla_attention
+        return xla_attention(q, k, v, causal=causal)
+
+    batch_axes = ("dp", "fsdp")
+    spec_q = P(batch_axes, axis_name, "tp", None)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec_q, spec_q, spec_q),
+             out_specs=spec_q, check_vma=False)
+    def _ring(q_blk, k_blk, v_blk):
+        return _ring_local(q_blk, k_blk, v_blk, axis_name=axis_name,
+                           axis_size=sp, causal=causal)
+
+    return _ring(q, k, v)
+
+
+def _ring_local(q, k, v, *, axis_name: str, axis_size: int, causal: bool):
+    """Per-device body: rotate K/V around the ring, accumulate flash stats."""
+    b, sq, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    my_idx = lax.axis_index(axis_name)
+    q32 = q  # keep input dtype for matmuls; stats in f32
+
+    o = jnp.zeros((b, sq, h, d), jnp.float32)
+    l = jnp.zeros((b, h, sq), jnp.float32)
+    m = jnp.full((b, h, sq), _NEG_INF, jnp.float32)
+    q_pos = my_idx * sq + jnp.arange(sq)
+
+    def step(t, carry):
+        o, l, m, k_cur, v_cur = carry
+        kv_idx = (my_idx - t) % axis_size
+        if causal:
+            k_pos = kv_idx * sq + jnp.arange(sq)
+            mask = q_pos[:, None] >= k_pos[None, :]
+        else:
+            mask = jnp.ones((sq, sq), bool)
+        contrib, row_sum, row_max = _block_attention(
+            q32, k_cur, v_cur, scale=scale, mask=mask)
+        m_new = jnp.maximum(m, row_max)
+        alpha = jnp.exp(m - m_new)            # rescale of old accumulator
+        beta = jnp.exp(row_max - m_new)       # rescale of this block
+        l_new = l * alpha + row_sum * beta
+        o_new = (o * alpha.transpose(0, 2, 1)[..., None]
+                 + contrib.astype(jnp.float32)
+                 * beta.transpose(0, 2, 1)[..., None])
+        # rotate kv to the next ring member (device i → i+1)
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return o_new, l_new, m_new, k_nxt, v_nxt
+
+    o, l, m, _, _ = lax.fori_loop(0, axis_size, step, (o, l, m, k, v))
+    denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+    return (o / denom).astype(q.dtype)

@@ -1,0 +1,80 @@
+"""Partition rules: logical axis names → mesh axes → NamedShardings.
+
+The standard TPU recipe (annotate shardings, let XLA/GSPMD insert the
+collectives) rather than hand-written NCCL calls. Rules map *logical* tensor
+axes ("vocab", "embed", "mlp", "heads", "batch", "seq", "layers", "experts")
+to mesh axes, so models declare intent once and any MeshConfig lays it out."""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+# Default rules — Megatron-style TP + ZeRO-3 FSDP + sequence parallelism:
+#   column-parallel weights shard their output dim on tp, row-parallel their
+#   input dim on tp; the other big dim is sharded on fsdp (param gathering);
+#   batch shards over (dp, fsdp); sequence over sp; experts over ep.
+DEFAULT_RULES: tuple[tuple[str, str | tuple | None], ...] = (
+    ("vocab", "tp"),
+    ("embed", "fsdp"),
+    ("heads", "tp"),
+    ("kv_heads", "tp"),
+    ("head_dim", None),
+    ("mlp", "tp"),
+    ("experts", "ep"),
+    ("batch", ("dp", "fsdp")),
+    ("seq", "sp"),
+    ("layers", None),
+    ("stages", "pp"),
+    ("norm", None),
+)
+
+
+@dataclass
+class PartitionRules:
+    rules: tuple = DEFAULT_RULES
+
+    def spec(self, *logical_axes: str | None) -> P:
+        mapping = dict(self.rules)
+        out = []
+        for ax in logical_axes:
+            if ax is None:
+                out.append(None)
+            else:
+                if ax not in mapping:
+                    raise KeyError(f"no partition rule for logical axis {ax!r}")
+                out.append(mapping[ax])
+        return P(*out)
+
+    def sharding(self, mesh: Mesh, *logical_axes: str | None) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(*logical_axes))
+
+
+def named_sharding(mesh: Mesh, *axes) -> NamedSharding:
+    return NamedSharding(mesh, P(*axes))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Input batches shard over the data axes and sequence axis."""
+    return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+
+
+def param_shardings(mesh: Mesh, param_specs, rules: PartitionRules | None = None):
+    """Map a pytree of logical-axis tuples to NamedShardings."""
+    rules = rules or PartitionRules()
+    return jax.tree.map(
+        lambda spec: rules.sharding(mesh, *spec),
+        param_specs,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            isinstance(e, (str, type(None))) for e in x),
+    )
+
+
+def constrain(x, mesh: Mesh, *axes):
+    """with_sharding_constraint shorthand used inside jitted model code to
+    pin activation layouts (e.g. re-shard after attention)."""
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*axes)))

@@ -1,0 +1,3 @@
+from .bootstrap import SliceEnv, initialize_slice, verify_slice
+
+__all__ = ["SliceEnv", "initialize_slice", "verify_slice"]

@@ -1,0 +1,121 @@
+"""In-container slice bootstrap: TPU_WORKER_* env → a JAX distributed world.
+
+The other half of the control plane's provisioning contract: the controller
+injects ``TPU_WORKER_ID`` (StatefulSet pod ordinal) and
+``TPU_WORKER_HOSTNAMES`` (headless-Service DNS names) into every worker pod
+(controllers/notebook.py:_apply_tpu_spec); this module consumes them inside
+the container to form the DCN mesh and verify the slice — the
+``jax.device_count()==16`` check that defines readiness in BASELINE.md.
+
+The reference has no in-container component at all (its pods are plain
+Jupyter images); this is the TPU-native addition that makes a provisioned
+notebook a working multi-host JAX environment out of the box.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass
+
+log = logging.getLogger("kubeflow_tpu.runtime")
+
+DEFAULT_COORDINATOR_PORT = 8476
+
+
+@dataclass(frozen=True)
+class SliceEnv:
+    worker_id: int
+    hostnames: tuple[str, ...]
+    accelerator: str = ""   # e.g. "v5e-16"
+    topology: str = ""      # e.g. "4x4"
+
+    @property
+    def num_workers(self) -> int:
+        return max(len(self.hostnames), 1)
+
+    @property
+    def multi_host(self) -> bool:
+        return self.num_workers > 1
+
+    @property
+    def coordinator_address(self) -> str:
+        host = self.hostnames[0] if self.hostnames else "localhost"
+        return f"{host}:{DEFAULT_COORDINATOR_PORT}"
+
+    @classmethod
+    def from_env(cls, environ=None) -> "SliceEnv":
+        env = environ if environ is not None else os.environ
+        raw_hosts = env.get("TPU_WORKER_HOSTNAMES", "localhost")
+        hostnames = tuple(h.strip() for h in raw_hosts.split(",") if h.strip())
+        return cls(
+            worker_id=int(env.get("TPU_WORKER_ID", "0") or 0),
+            hostnames=hostnames,
+            accelerator=env.get("TPU_ACCELERATOR_TYPE", ""),
+            topology=env.get("TPU_TOPOLOGY", ""),
+        )
+
+
+def initialize_slice(env: SliceEnv | None = None) -> SliceEnv:
+    """Form the DCN world for a multi-host slice via jax.distributed —
+    worker 0 (headless DNS name [0]) is the coordinator. Single-host slices
+    need no initialization. Idempotent."""
+    env = env or SliceEnv.from_env()
+    if env.multi_host:
+        import jax
+        try:
+            jax.distributed.initialize(
+                coordinator_address=env.coordinator_address,
+                num_processes=env.num_workers,
+                process_id=env.worker_id,
+            )
+            log.info("jax.distributed initialized: process %d/%d via %s",
+                     env.worker_id, env.num_workers, env.coordinator_address)
+        except RuntimeError as exc:
+            if "already initialized" not in str(exc):
+                raise
+    return env
+
+
+def expected_device_count(env: SliceEnv, chips_per_worker: int | None = None) -> int:
+    """Total chips the formed slice must expose. Derived from the accelerator
+    shorthand when present (authoritative), else workers × chips/worker."""
+    if env.accelerator:
+        try:
+            from ..tpu.topology import parse_short_name
+            return parse_short_name(env.accelerator).chips
+        except Exception:  # noqa: BLE001 — fall through to the env math
+            pass
+    return env.num_workers * (chips_per_worker or 1)
+
+
+def verify_slice(env: SliceEnv | None = None, *, timeout_s: float = 60.0,
+                 expected: int | None = None) -> dict:
+    """The slice-readiness check: jax.device_count() must match the expected
+    chip count (mesh formed over ICI+DCN); returns a report dict, raises
+    TimeoutError otherwise — the readiness probe turns that into
+    pod-not-ready, which keeps SliceReady=False on the CR.
+
+    Note: device_count is fixed once the backend initializes, so this is a
+    single check, not a poll (``timeout_s`` kept for API stability; waiting
+    happens in jax.distributed.initialize, which blocks until all workers
+    join)."""
+    import jax
+
+    env = env or SliceEnv.from_env()
+    want = expected if expected is not None else expected_device_count(env)
+    last_seen = jax.device_count()
+    if want > 1 and last_seen != want:
+        raise TimeoutError(
+            f"slice mesh incomplete: jax.device_count()={last_seen}, "
+            f"want {want}")
+    return {
+        "worker_id": env.worker_id,
+        "num_workers": env.num_workers,
+        "device_count": last_seen,
+        "local_device_count": jax.local_device_count(),
+        "accelerator": env.accelerator,
+        "topology": env.topology,
+        "backend": jax.default_backend(),
+    }

@@ -187,6 +187,16 @@ def upsert_env(container: dict, name_: str, value: str) -> None:
     env.append({"name": name_, "value": value})
 
 
+def upsert_env_from(container: dict, name_: str, value_from: dict) -> None:
+    env = container.setdefault("env", [])
+    for e in env:
+        if e.get("name") == name_:
+            e.pop("value", None)
+            e["valueFrom"] = value_from
+            return
+    env.append({"name": name_, "valueFrom": value_from})
+
+
 def remove_env(container: dict, name_: str) -> None:
     env = container.get("env")
     if env:

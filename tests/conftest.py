@@ -7,10 +7,16 @@ reference validates multi-node behavior at the API-object level without nodes
 
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax initializes its backends. Note: this environment
+# pre-exports JAX_PLATFORMS=axon (TPU tunnel) and re-asserts it at interpreter
+# startup, so the env var alone is not enough — use jax.config too.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

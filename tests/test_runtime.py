@@ -1,0 +1,46 @@
+"""Runtime bootstrap: TPU_WORKER_* env parsing + slice verification."""
+
+import pytest
+
+from kubeflow_tpu.runtime.bootstrap import (SliceEnv, expected_device_count,
+                                            verify_slice)
+
+
+def test_slice_env_from_env():
+    env = SliceEnv.from_env({
+        "TPU_WORKER_ID": "2",
+        "TPU_WORKER_HOSTNAMES": "nb-0.nb-workers.ns.svc,nb-1.nb-workers.ns.svc,"
+                                "nb-2.nb-workers.ns.svc,nb-3.nb-workers.ns.svc",
+        "TPU_ACCELERATOR_TYPE": "v5e-16",
+        "TPU_TOPOLOGY": "4x4",
+    })
+    assert env.worker_id == 2
+    assert env.num_workers == 4
+    assert env.multi_host
+    assert env.coordinator_address == "nb-0.nb-workers.ns.svc:8476"
+    assert expected_device_count(env) == 16
+
+
+def test_slice_env_single_host_defaults():
+    env = SliceEnv.from_env({})
+    assert env.worker_id == 0
+    assert not env.multi_host
+    assert env.coordinator_address.startswith("localhost:")
+
+
+def test_expected_device_count_fallback():
+    env = SliceEnv(worker_id=0, hostnames=("a", "b"), accelerator="")
+    assert expected_device_count(env, chips_per_worker=4) == 8
+
+
+def test_verify_slice_cpu():
+    env = SliceEnv(worker_id=0, hostnames=("localhost",))
+    report = verify_slice(env, expected=1, timeout_s=5)
+    assert report["device_count"] >= 1
+    assert report["backend"] == "cpu"
+
+
+def test_verify_slice_timeout():
+    env = SliceEnv(worker_id=0, hostnames=("localhost",), accelerator="v5e-16")
+    with pytest.raises(TimeoutError):
+        verify_slice(env, timeout_s=0.1)
