@@ -64,16 +64,21 @@ def notebook_pod_spec(notebook: dict) -> dict:
     return k8s.get_in(notebook, "spec", "template", "spec", default={}) or {}
 
 
-def notebook_container(notebook: dict) -> dict | None:
-    """The notebook container is the one named after the CR; fallback to the
-    first container (reference webhook uses the same convention,
-    notebook_mutating_webhook.go:861-972)."""
-    spec = notebook_pod_spec(notebook)
-    c = k8s.find_container(spec, k8s.name(notebook))
+def pod_spec_notebook_container(pod_spec: dict, nb_name: str) -> dict | None:
+    """The notebook container convention, shared by webhook and reconcilers
+    (they MUST agree to target the same container): the container named after
+    the CR, else containers[0], else None (reference webhook uses the same
+    convention, notebook_mutating_webhook.go:861-972)."""
+    c = k8s.find_container(pod_spec, nb_name)
     if c is not None:
         return c
-    containers = spec.get("containers") or []
+    containers = pod_spec.get("containers") or []
     return containers[0] if containers else None
+
+
+def notebook_container(notebook: dict) -> dict | None:
+    return pod_spec_notebook_container(notebook_pod_spec(notebook),
+                                       k8s.name(notebook))
 
 
 def validate_notebook(notebook: dict) -> None:

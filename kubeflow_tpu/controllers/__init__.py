@@ -1,25 +1,35 @@
 from .manager import Manager, Request
 from .notebook import NotebookReconciler
 from .culling import CullingReconciler
+from .extension import ExtensionReconciler
 
 __all__ = ["Manager", "Request", "NotebookReconciler", "CullingReconciler",
-           "setup_controllers"]
+           "ExtensionReconciler", "setup_controllers"]
 
 
-def setup_controllers(client, config=None, metrics=None, prober=None):
-    """Wire a manager the way the reference main() does
-    (notebook-controller/main.go:58-148): core reconciler always, culler only
-    when ENABLE_CULLING (main.go:111-123). Returns the manager (not started)."""
+def setup_controllers(client, config=None, metrics=None, prober=None, *,
+                      extension=True, webhooks=True):
+    """Wire a manager the way the two reference manager binaries do
+    (notebook-controller/main.go:58-148 + odh main.go:141-374): admission
+    webhooks on the apiserver, core reconciler always, culler only when
+    ENABLE_CULLING (main.go:111-123), extension reconciler for
+    routes/auth/CA/RBAC. Returns the manager (not started)."""
+    from ..api.types import install_notebook_crd
     from ..utils.config import ControllerConfig
     from ..utils.metrics import MetricsRegistry
-
-    from ..api.types import install_notebook_crd
+    from ..webhook import NotebookMutatingWebhook, NotebookValidatingWebhook
 
     config = config or ControllerConfig.from_env()
     metrics = metrics or MetricsRegistry()
     install_notebook_crd(client)
+    if webhooks:
+        # mutating runs before validating, as in the apiserver's phase order
+        NotebookMutatingWebhook(client, config).install(client)
+        NotebookValidatingWebhook(config).install(client)
     mgr = Manager(client)
     NotebookReconciler(client, config, metrics).setup(mgr)
+    if extension:
+        ExtensionReconciler(client, config, metrics).setup(mgr)
     if config.enable_culling:
         kwargs = {"prober": prober} if prober is not None else {}
         CullingReconciler(client, config, metrics, **kwargs).setup(mgr)

@@ -1,0 +1,117 @@
+"""Elyra runtime-config Secret sync from DSPA CRs.
+
+Reference: odh notebook_dspa_secret.go:49-484 — when a DSPA (Data Science
+Pipelines Application) exists in the notebook's namespace and
+SET_PIPELINE_SECRET is on, build the Elyra runtime config JSON
+(``odh_dsp.json``: pipelines API endpoint + S3 object storage details) as a
+Secret owned by the DSPA, and mount it into the notebook. Public-endpoint
+hostname comes from the configured gateway."""
+
+from __future__ import annotations
+
+import base64
+import json
+
+from ..cluster import errors
+from ..utils import k8s
+from ..utils.config import ControllerConfig
+
+SECRET_NAME = "ds-pipeline-config"
+MOUNT_PATH = "/opt/app-root/src/.local/share/jupyter/metadata/runtimes"
+
+
+def extract_runtime_config(dspa: dict, config: ControllerConfig,
+                           namespace: str) -> dict | None:
+    """DSPA CR → Elyra runtime definition (reference
+    extractElyraRuntimeConfigInfo). Returns None when the DSPA lacks the
+    object-storage wiring."""
+    s3 = k8s.get_in(dspa, "spec", "objectStorage", "externalStorage")
+    if not s3:
+        return None
+    host = s3.get("host", "")
+    bucket = s3.get("bucket", "")
+    if not host or not bucket:
+        return None
+    gateway = config.gateway_url or "gateway.invalid"
+    api_endpoint = (f"https://{gateway}/pipelines/{namespace}/"
+                    f"{k8s.name(dspa)}")
+    return {
+        "display_name": f"Data Science Pipeline: {k8s.name(dspa)}",
+        "metadata": {
+            "tags": [],
+            "display_name": f"Data Science Pipeline: {k8s.name(dspa)}",
+            "engine": "Argo",
+            "auth_type": "KUBERNETES_SERVICE_ACCOUNT_TOKEN",
+            "api_endpoint": api_endpoint,
+            "public_api_endpoint": api_endpoint,
+            "cos_auth_type": "KUBERNETES_SECRET",
+            "cos_endpoint": f"https://{host}",
+            "cos_bucket": bucket,
+            "cos_secret": k8s.get_in(s3, "s3CredentialsSecret", "secretName",
+                                     default=""),
+            "runtime_type": "KUBEFLOW_PIPELINES",
+        },
+        "schema_name": "kfp",
+    }
+
+
+def sync_elyra_runtime_secret(client, config: ControllerConfig,
+                              namespace: str) -> bool:
+    """Create/update the runtime Secret from the namespace's DSPA; returns
+    True when a secret exists after the call. The Secret is owned by the
+    DSPA (reference: secret owned by DSPA so it dies with it)."""
+    dspas = client.list("DataSciencePipelinesApplication", namespace)
+    if not dspas:
+        try:
+            client.delete("Secret", namespace, SECRET_NAME)
+        except errors.NotFoundError:
+            pass
+        return False
+    dspa = sorted(dspas, key=k8s.name)[0]
+    runtime = extract_runtime_config(dspa, config, namespace)
+    if runtime is None:
+        return False
+    payload = base64.b64encode(
+        json.dumps(runtime, sort_keys=True).encode()).decode()
+    desired_data = {"odh_dsp.json": payload}
+    existing = client.get_or_none("Secret", namespace, SECRET_NAME)
+    if existing is None:
+        secret = {
+            "apiVersion": "v1",
+            "kind": "Secret",
+            "metadata": {
+                "name": SECRET_NAME,
+                "namespace": namespace,
+                "labels": {"opendatahub.io/managed-by": "workbenches"},
+            },
+            "type": "Opaque",
+            "data": desired_data,
+        }
+        k8s.set_controller_reference(dspa, secret)
+        try:
+            client.create(secret)
+        except errors.AlreadyExistsError:
+            pass
+    elif existing.get("data") != desired_data:
+        existing["data"] = desired_data
+        client.update(existing)
+    return True
+
+
+def mount_elyra_secret(notebook: dict) -> None:
+    """Mount the runtime Secret into the notebook container (reference
+    MountElyraRuntimeConfigSecret). Invoked from the webhook when
+    SET_PIPELINE_SECRET is on and the secret exists."""
+    from ..api import types as api
+
+    pod_spec = api.notebook_pod_spec(notebook)
+    container = api.notebook_container(notebook)
+    if container is None:
+        return
+    k8s.upsert_volume(pod_spec, {
+        "name": "elyra-dsp-config",
+        "secret": {"secretName": SECRET_NAME, "optional": True},
+    })
+    k8s.upsert_volume_mount(container, {
+        "name": "elyra-dsp-config", "mountPath": MOUNT_PATH,
+        "readOnly": True})

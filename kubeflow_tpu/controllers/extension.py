@@ -1,0 +1,250 @@
+"""Extension reconciler — the odh-notebook-controller analog.
+
+Second reconciler watching the SAME Notebook CRD (reference
+OpenshiftNotebookReconciler, odh notebook_controller.go:190-526), cooperating
+with the core reconciler purely through API-server state (SURVEY §1). Per
+notebook it manages: the CA trust bundle, NetworkPolicies, runtime-images
+ConfigMap, pipeline/MLflow RBAC, Elyra secret, the shared ReferenceGrant,
+auth-proxy resources or a plain HTTPRoute, and finally removes the webhook's
+reconciliation lock so the core reconciler scales the slice up.
+
+Cross-namespace/cluster-scoped resources (central-ns HTTPRoutes, the
+auth-delegator ClusterRoleBinding, the shared ReferenceGrant) cannot be GC'd
+via ownerReferences, so deletion is finalizer-driven with the reference's
+partial-progress semantics (:278-330): each cleanup that succeeds strips its
+finalizer; failures leave theirs for the next requeue and surface a combined
+error."""
+
+from __future__ import annotations
+
+import logging
+
+from ..api import types as api
+from ..cluster import errors
+from ..utils import k8s, names
+from ..utils.config import ControllerConfig
+from ..utils.metrics import MetricsRegistry
+from . import auth, cacert, netpol, rbac, routes, runtime_images
+from .manager import Manager, Request, Result, owner_mapper
+
+log = logging.getLogger("kubeflow_tpu.extension")
+
+FINALIZER_ROUTES = "kubeflow-tpu.org/route-cleanup"
+FINALIZER_REFGRANT = "kubeflow-tpu.org/referencegrant-cleanup"
+FINALIZER_CRB = "kubeflow-tpu.org/crb-cleanup"
+ALL_FINALIZERS = (FINALIZER_ROUTES, FINALIZER_REFGRANT, FINALIZER_CRB)
+
+
+class ExtensionReconciler:
+    name = "extension-controller"
+
+    def __init__(self, client, config: ControllerConfig | None = None,
+                 metrics: MetricsRegistry | None = None):
+        self.client = client
+        self.config = config or ControllerConfig()
+        self.metrics = metrics or MetricsRegistry()
+
+    def setup(self, mgr: Manager) -> None:
+        """Reference SetupWithManager (:736-884): own SA/Service/ConfigMap/
+        NetworkPolicy/RoleBinding, watch central-ns HTTPRoutes by label and
+        the CA source ConfigMaps."""
+        mgr.register(self)
+        mgr.watch(api.KIND, self.name)
+        for kind in ("ServiceAccount", "Service", "ConfigMap",
+                     "NetworkPolicy", "RoleBinding"):
+            mgr.watch(kind, self.name, mapper=owner_mapper(api.KIND))
+        mgr.watch("HTTPRoute", self.name, mapper=self._route_mapper)
+        mgr.watch("ConfigMap", self.name, mapper=self._ca_source_mapper)
+
+    def _route_mapper(self, obj: dict) -> list[Request]:
+        nb = k8s.get_label(obj, names.NOTEBOOK_NAME_LABEL)
+        ns = k8s.get_label(obj, routes.ROUTE_NAMESPACE_LABEL)
+        return [Request(ns, nb)] if nb and ns else []
+
+    def _ca_source_mapper(self, obj: dict) -> list[Request]:
+        if k8s.name(obj) not in (cacert.TRUSTED_CA_BUNDLE, cacert.KUBE_ROOT_CA,
+                                 cacert.SERVICE_CA):
+            return []
+        # trust changed → re-reconcile every notebook (reference watches CA
+        # ConfigMaps cluster-wide)
+        return [Request(k8s.namespace(nb), k8s.name(nb))
+                for nb in self.client.list(api.KIND)]
+
+    # ------------------------------------------------------------ reconcile
+    def reconcile(self, req: Request) -> Result | None:
+        notebook = self.client.get_or_none(api.KIND, req.namespace, req.name)
+        if notebook is None:
+            return None
+        if k8s.is_deleting(notebook):
+            return self._reconcile_deletion(notebook)
+
+        auth_mode = (k8s.get_annotation(notebook,
+                                        names.INJECT_AUTH_ANNOTATION) == "true")
+
+        if self._ensure_finalizers(notebook, auth_mode):
+            return None  # update re-triggers the watch; resume on requeue
+
+        cacert.reconcile_ca_bundle(self.client,
+                                   self.config.controller_namespace,
+                                   req.namespace)
+        netpol.reconcile_network_policies(self.client, notebook,
+                                          self.config.controller_namespace,
+                                          auth=auth_mode)
+        runtime_images.sync_runtime_images_config_map(
+            self.client, self.config.controller_namespace, req.namespace)
+        if self.config.set_pipeline_rbac:
+            rbac.reconcile_pipeline_rbac(self.client, notebook)
+        if self.config.set_pipeline_secret:
+            from . import elyra
+            elyra.sync_elyra_runtime_secret(self.client, self.config,
+                                            req.namespace)
+        routes.reconcile_reference_grant(self.client, self.config, notebook)
+
+        if auth_mode:
+            self._reconcile_auth_resources(notebook)
+        else:
+            self._cleanup_auth_resources(notebook)
+        routes.reconcile_httproute(self.client, self.config, notebook,
+                                   auth=auth_mode)
+
+        requeue = None
+        if self.config.mlflow_enabled:
+            requeue = rbac.reconcile_mlflow_integration(self.client, notebook)
+
+        self._remove_reconciliation_lock(notebook)
+        return Result(requeue_after=requeue) if requeue else None
+
+    # ----------------------------------------------------------- finalizers
+    def _ensure_finalizers(self, notebook: dict, auth_mode: bool) -> bool:
+        """Add the cleanup finalizers before creating anything they guard
+        (reference :335-381 adds + requeues). Returns True if an update was
+        written (caller should yield)."""
+        wanted = [FINALIZER_ROUTES, FINALIZER_REFGRANT]
+        if auth_mode:
+            wanted.append(FINALIZER_CRB)
+        added = False
+        for fin in wanted:
+            added |= k8s.add_finalizer(notebook, fin)
+        if added:
+            try:
+                self.client.update(notebook)
+            except errors.ConflictError:
+                pass  # watch re-enqueues with fresh version
+            return True
+        return False
+
+    def _reconcile_deletion(self, notebook: dict) -> Result | None:
+        """Deletion branch (reference :207-333): run each finalizer's
+        cleanup; strip exactly the finalizers whose cleanup succeeded;
+        combined error → requeue for the rest."""
+        cleanups = {
+            FINALIZER_ROUTES: lambda: routes.delete_routes_for_notebook(
+                self.client, self.config, notebook),
+            FINALIZER_REFGRANT: lambda:
+                routes.delete_reference_grant_if_last_notebook(
+                    self.client, self.config, notebook),
+            FINALIZER_CRB: lambda: self._cleanup_crb(notebook),
+        }
+        failures: list[str] = []
+        succeeded: list[str] = []
+        for fin, cleanup in cleanups.items():
+            if not k8s.has_finalizer(notebook, fin):
+                continue
+            try:
+                cleanup()
+                succeeded.append(fin)
+            except Exception as exc:  # noqa: BLE001 — collect, finish others
+                failures.append(f"{fin}: {exc}")
+        if succeeded:
+            for attempt in range(5):
+                cur = self.client.get_or_none(api.KIND,
+                                              k8s.namespace(notebook),
+                                              k8s.name(notebook))
+                if cur is None:
+                    break
+                changed = False
+                for fin in succeeded:
+                    changed |= k8s.remove_finalizer(cur, fin)
+                if not changed:
+                    break
+                try:
+                    self.client.update(cur)
+                    break
+                except errors.ConflictError:
+                    continue
+        if failures:
+            raise RuntimeError("finalization incomplete: " + "; ".join(failures))
+        return None
+
+    def _cleanup_crb(self, notebook: dict) -> None:
+        try:
+            self.client.delete(
+                "ClusterRoleBinding", "",
+                auth.crb_name(k8s.namespace(notebook), k8s.name(notebook)))
+        except errors.NotFoundError:
+            pass
+
+    # ----------------------------------------------------------- auth mode
+    def _reconcile_auth_resources(self, notebook: dict) -> None:
+        ns = k8s.namespace(notebook)
+        for desired in (auth.new_service_account(notebook),
+                        auth.new_rbac_config_map(notebook),
+                        auth.new_tls_service(notebook)):
+            existing = self.client.get_or_none(desired["kind"], ns,
+                                               k8s.name(desired))
+            if existing is None:
+                try:
+                    self.client.create(desired)
+                except errors.AlreadyExistsError:
+                    pass
+            elif desired.get("spec") is not None and \
+                    existing.get("spec") != desired.get("spec"):
+                existing["spec"] = k8s.deepcopy(desired["spec"])
+                self.client.update(existing)
+        crb = auth.new_auth_delegator_crb(notebook)
+        if self.client.get_or_none("ClusterRoleBinding", "",
+                                   k8s.name(crb)) is None:
+            try:
+                self.client.create(crb)
+            except errors.AlreadyExistsError:
+                pass
+
+    def _cleanup_auth_resources(self, notebook: dict) -> None:
+        """Auth switched off: remove per-notebook auth resources (the
+        reference's mode switch also deletes the conflicting route, handled
+        in routes.reconcile_httproute)."""
+        ns, nb_name = k8s.namespace(notebook), k8s.name(notebook)
+        for kind, name in (("ServiceAccount", auth.sa_name(nb_name)),
+                           ("ConfigMap", auth.rbac_config_name(nb_name)),
+                           ("Service", auth.tls_service_name(nb_name))):
+            try:
+                self.client.delete(kind, ns, name)
+            except errors.NotFoundError:
+                pass
+        self._cleanup_crb(notebook)
+
+    # ------------------------------------------------------- lock removal
+    def _remove_reconciliation_lock(self, notebook: dict) -> None:
+        """Reference RemoveReconciliationLock (:516-523 via :155-180): once
+        prerequisites exist, drop the sentinel stop annotation via merge
+        patch so the core reconciler scales the slice 0→N. Only the
+        LOCK value is removed — a user/culler stop stays."""
+        if k8s.get_annotation(notebook, names.STOP_ANNOTATION) != \
+                names.RECONCILIATION_LOCK_VALUE:
+            return
+        if not self._prerequisites_ready(notebook):
+            return
+        self.client.patch(api.KIND, k8s.namespace(notebook),
+                          k8s.name(notebook), {
+            "metadata": {"annotations": {names.STOP_ANNOTATION: None}}})
+
+    def _prerequisites_ready(self, notebook: dict) -> bool:
+        """The reference waits (3 retries, backoff) for the SA image-pull
+        secret before unlocking. Our store has no SA-token controller, so
+        the check is gated: strict mode verifies the default SA exists with
+        an imagePullSecret; lenient mode (default) unlocks immediately."""
+        if not getattr(self.config, "lock_requires_pull_secret", False):
+            return True
+        sa = self.client.get_or_none("ServiceAccount",
+                                     k8s.namespace(notebook), "default")
+        return bool(sa and sa.get("imagePullSecrets"))

@@ -425,13 +425,7 @@ class NotebookReconciler:
                 pass  # next event re-enqueues
 
 
-def _notebook_container(pod_spec: dict, nb_name: str) -> dict | None:
-    """The container named after the CR, else containers[0], else None."""
-    c = k8s.find_container(pod_spec, nb_name)
-    if c is not None:
-        return c
-    containers = pod_spec.get("containers") or []
-    return containers[0] if containers else None
+_notebook_container = api.pod_spec_notebook_container
 
 
 def headless_service_name(notebook_name: str) -> str:

@@ -1,0 +1,114 @@
+"""RBAC integrations: pipelines (Elyra) and MLflow.
+
+Reference: odh notebook_rbac.go:36-154 (``elyra-pipelines-<name>``
+RoleBinding to Role ``ds-pipeline-user-access-dspa``, gated by
+SET_PIPELINE_RBAC, with a role-exists precheck) and notebook_mlflow.go:35-330
+(annotation-gated RoleBinding to the MLflow integration ClusterRole with a
+30 s requeue until the ClusterRole exists)."""
+
+from __future__ import annotations
+
+from ..cluster import errors
+from ..utils import k8s, names
+
+PIPELINE_ROLE = "ds-pipeline-user-access-dspa"
+MLFLOW_CLUSTER_ROLE = "mlflow-operator-mlflow-integration"
+MLFLOW_REQUEUE_SECONDS = 30.0
+
+
+def pipeline_rb_name(nb_name: str) -> str:
+    return f"elyra-pipelines-{nb_name}"[:63]
+
+
+def mlflow_rb_name(nb_name: str) -> str:
+    return f"mlflow-access-{nb_name}"[:63]
+
+
+def new_pipeline_role_binding(notebook: dict) -> dict:
+    nb_name = k8s.name(notebook)
+    rb = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "RoleBinding",
+        "metadata": {
+            "name": pipeline_rb_name(nb_name),
+            "namespace": k8s.namespace(notebook),
+            "labels": {names.NOTEBOOK_NAME_LABEL: nb_name},
+        },
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "Role",
+            "name": PIPELINE_ROLE,
+        },
+        "subjects": [{
+            "kind": "ServiceAccount",
+            "name": "default",
+            "namespace": k8s.namespace(notebook),
+        }],
+    }
+    k8s.set_controller_reference(notebook, rb)
+    return rb
+
+
+def reconcile_pipeline_rbac(client, notebook: dict) -> None:
+    """Create the binding only when the Role exists in the namespace
+    (reference checkRoleExists precheck)."""
+    ns = k8s.namespace(notebook)
+    if client.get_or_none("Role", ns, PIPELINE_ROLE) is None:
+        return
+    desired = new_pipeline_role_binding(notebook)
+    existing = client.get_or_none("RoleBinding", ns, k8s.name(desired))
+    if existing is None:
+        try:
+            client.create(desired)
+        except errors.AlreadyExistsError:
+            pass
+
+
+def new_mlflow_role_binding(notebook: dict) -> dict:
+    nb_name = k8s.name(notebook)
+    rb = {
+        "apiVersion": "rbac.authorization.k8s.io/v1",
+        "kind": "RoleBinding",
+        "metadata": {
+            "name": mlflow_rb_name(nb_name),
+            "namespace": k8s.namespace(notebook),
+            "labels": {names.NOTEBOOK_NAME_LABEL: nb_name},
+        },
+        "roleRef": {
+            "apiGroup": "rbac.authorization.k8s.io",
+            "kind": "ClusterRole",
+            "name": MLFLOW_CLUSTER_ROLE,
+        },
+        "subjects": [{
+            "kind": "ServiceAccount",
+            "name": "default",
+            "namespace": k8s.namespace(notebook),
+        }],
+    }
+    k8s.set_controller_reference(notebook, rb)
+    return rb
+
+
+def reconcile_mlflow_integration(client, notebook: dict) -> float | None:
+    """Returns a requeue delay when the ClusterRole is absent (reference
+    requeues every 30 s until the MLflow operator installs it,
+    notebook_mlflow.go:236-270); None when converged or not requested."""
+    ns = k8s.namespace(notebook)
+    instance = k8s.get_annotation(notebook, names.MLFLOW_INSTANCE_ANNOTATION)
+    if not instance:
+        try:
+            client.delete("RoleBinding", ns,
+                          mlflow_rb_name(k8s.name(notebook)))
+        except errors.NotFoundError:
+            pass
+        return None
+    if client.get_or_none("ClusterRole", "", MLFLOW_CLUSTER_ROLE) is None:
+        return MLFLOW_REQUEUE_SECONDS
+    desired = new_mlflow_role_binding(notebook)
+    existing = client.get_or_none("RoleBinding", ns, k8s.name(desired))
+    if existing is None:
+        try:
+            client.create(desired)
+        except errors.AlreadyExistsError:
+            pass
+    return None

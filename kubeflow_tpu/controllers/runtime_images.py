@@ -1,0 +1,80 @@
+"""Pipeline runtime-images sync.
+
+Reference: odh notebook_runtime.go:40-285 — scrape ImageStreams labeled
+``opendatahub.io/runtime-image`` in the controller namespace, extract each
+tag's runtime metadata, and materialize a per-user-namespace
+``pipeline-runtime-images`` ConfigMap (key = sanitized display name +
+``.json``) that the webhook mounts at /opt/app-root/pipeline-runtimes."""
+
+from __future__ import annotations
+
+import json
+import re
+
+from ..cluster import errors
+from ..utils import k8s
+
+RUNTIME_IMAGE_LABEL = "opendatahub.io/runtime-image"
+CONFIGMAP_NAME = "pipeline-runtime-images"
+
+_key_re = re.compile(r"[^a-zA-Z0-9-_.]")
+
+
+def format_key_name(display_name: str) -> str:
+    """Sanitize a display name into a ConfigMap key (reference
+    formatKeyName: spaces → dashes, strip invalid chars, append .json)."""
+    cleaned = _key_re.sub("", display_name.replace(" ", "-")).strip("-.")
+    return f"{cleaned or 'runtime'}.json"
+
+
+def collect_runtime_images(client, controller_namespace: str) -> dict[str, str]:
+    """ImageStreams → {key: metadata-json}. Each tag may carry an
+    ``opendatahub.io/runtime-image-metadata`` annotation with the Elyra
+    runtime definition (reference parseRuntimeImageMetadata)."""
+    out: dict[str, str] = {}
+    for stream in client.list("ImageStream", controller_namespace,
+                              {RUNTIME_IMAGE_LABEL: "true"}):
+        for tag in k8s.get_in(stream, "spec", "tags", default=[]) or []:
+            raw = k8s.get_in(tag, "annotations",
+                             "opendatahub.io/runtime-image-metadata")
+            if not raw:
+                continue
+            try:
+                meta_list = json.loads(raw)
+            except ValueError:
+                continue
+            entries = meta_list if isinstance(meta_list, list) else [meta_list]
+            for meta in entries:
+                display = meta.get("display_name") or k8s.name(stream)
+                out[format_key_name(display)] = json.dumps(meta,
+                                                           sort_keys=True)
+    return out
+
+
+def sync_runtime_images_config_map(client, controller_namespace: str,
+                                   user_namespace: str) -> None:
+    """Reference SyncRuntimeImagesConfigMap: per-user-namespace projection of
+    the controller-namespace image inventory."""
+    data = collect_runtime_images(client, controller_namespace)
+    existing = client.get_or_none("ConfigMap", user_namespace, CONFIGMAP_NAME)
+    if not data:
+        if existing is not None:
+            client.delete("ConfigMap", user_namespace, CONFIGMAP_NAME)
+        return
+    if existing is None:
+        try:
+            client.create({
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": {
+                    "name": CONFIGMAP_NAME,
+                    "namespace": user_namespace,
+                    "labels": {"opendatahub.io/managed-by": "workbenches"},
+                },
+                "data": data,
+            })
+        except errors.AlreadyExistsError:
+            pass
+    elif existing.get("data") != data:
+        existing["data"] = data
+        client.update(existing)

@@ -151,10 +151,28 @@ def _flash_bwd(causal, block_q, block_k, res, g):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _pick_block(seq_len: int, preferred: int) -> int | None:
+    """Largest block ≤ preferred that divides seq_len and respects the TPU
+    sublane granularity (multiple of 8, or the whole sequence). None when no
+    usable block exists (odd lengths) — callers fall back to XLA attention."""
+    for block in range(min(preferred, seq_len), 0, -1):
+        if seq_len % block == 0 and (block % 8 == 0 or block == seq_len):
+            return block
+    return None
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     causal: bool = True,
                     block_q: int = DEFAULT_BLOCK_Q,
                     block_k: int = DEFAULT_BLOCK_K) -> jax.Array:
     """q/k/v: (batch, seq, heads, d_head) → (batch, seq, heads, d_head).
-    GQA callers repeat K/V heads before the call (models/transformer.py)."""
-    return _flash(q, k, v, causal, block_q, block_k)
+    GQA callers repeat K/V heads before the call (models/transformer.py).
+    Block sizes self-adjust to divide the sequence; sequences with no
+    TPU-tileable divisor fall back to the XLA path instead of erroring."""
+    s = q.shape[1]
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(s, block_k)
+    if bq is None or bk is None:
+        from ..models.transformer import xla_attention
+        return xla_attention(q, k, v, causal=causal)
+    return _flash(q, k, v, causal, bq, bk)

@@ -86,26 +86,42 @@ def _ring_local(q, k, v, *, axis_name: str, axis_size: int, causal: bool):
 
     def step(t, carry):
         o, l, m, k_cur, v_cur = carry
+
+        def attend(operand):
+            o, l, m, k_cur, v_cur, kv_idx = operand
+            if causal:
+                k_pos = kv_idx * sq + jnp.arange(sq)
+                mask = q_pos[:, None] >= k_pos[None, :]
+            else:
+                mask = jnp.ones((sq, sq), bool)
+            contrib, row_sum, row_max = _block_attention(
+                q32, k_cur, v_cur, scale=scale, mask=mask)
+            m_new = jnp.maximum(m, row_max)
+            alpha = jnp.exp(m - m_new)        # rescale of old accumulator
+            beta = jnp.exp(row_max - m_new)   # rescale of this block
+            l_new = l * alpha + row_sum * beta
+            o_new = (o * alpha.transpose(0, 2, 1)[..., None]
+                     + contrib.astype(jnp.float32)
+                     * beta.transpose(0, 2, 1)[..., None])
+            return o_new, l_new, m_new
+
         kv_idx = (my_idx - t) % axis_size
         if causal:
-            k_pos = kv_idx * sq + jnp.arange(sq)
-            mask = q_pos[:, None] >= k_pos[None, :]
+            # blocks strictly above the diagonal are fully masked — skip the
+            # matmuls entirely (≈ halves causal FLOPs; the cond is local
+            # per-device compute, the ppermute below stays unconditional so
+            # the collective schedule is uniform across the ring)
+            o, l, m = lax.cond(kv_idx <= my_idx, attend,
+                               lambda operand: (operand[0], operand[1],
+                                                operand[2]),
+                               (o, l, m, k_cur, v_cur, kv_idx))
         else:
-            mask = jnp.ones((sq, sq), bool)
-        contrib, row_sum, row_max = _block_attention(
-            q32, k_cur, v_cur, scale=scale, mask=mask)
-        m_new = jnp.maximum(m, row_max)
-        alpha = jnp.exp(m - m_new)            # rescale of old accumulator
-        beta = jnp.exp(row_max - m_new)       # rescale of this block
-        l_new = l * alpha + row_sum * beta
-        o_new = (o * alpha.transpose(0, 2, 1)[..., None]
-                 + contrib.astype(jnp.float32)
-                 * beta.transpose(0, 2, 1)[..., None])
+            o, l, m = attend((o, l, m, k_cur, v_cur, kv_idx))
         # rotate kv to the next ring member (device i → i+1)
         perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
         k_nxt = lax.ppermute(k_cur, axis_name, perm)
         v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return o_new, l_new, m_new, k_nxt, v_nxt
+        return o, l, m, k_nxt, v_nxt
 
     o, l, m, _, _ = lax.fori_loop(0, axis_size, step, (o, l, m, k, v))
     denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]

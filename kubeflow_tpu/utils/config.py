@@ -42,6 +42,11 @@ class ControllerConfig:
     set_pipeline_secret: bool = False
     inject_cluster_proxy_env: bool = False
     auth_proxy_image: str = "kube-rbac-proxy:latest"
+    # strict mode: hold the reconciliation lock until the default SA has an
+    # image-pull secret (reference waits 3 retries × backoff, odh
+    # notebook_controller.go:155-180); lenient default suits clusters without
+    # an SA-secret controller
+    lock_requires_pull_secret: bool = False
     # TPU-native
     tpu_default_image: str = "us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest"
     image_swap_map: dict = field(default_factory=dict)  # cuda image → jax/libtpu image

@@ -1,0 +1,5 @@
+from .mutating import NotebookMutatingWebhook
+from .validating import NotebookValidatingWebhook, AdmissionDenied
+
+__all__ = ["NotebookMutatingWebhook", "NotebookValidatingWebhook",
+           "AdmissionDenied"]

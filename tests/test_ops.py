@@ -44,10 +44,25 @@ def test_flash_gradients_match_reference():
                                    rtol=2e-5, atol=2e-5)
 
 
-def test_flash_rejects_indivisible_seq():
+def test_flash_indivisible_seq_falls_back_to_reference():
+    """No TPU-tileable block divides s=100 → transparently uses the XLA path
+    instead of erroring (review finding: auto-selected flash must not crash
+    on real TPU for odd sequence lengths)."""
     q, k, v = qkv(s=100)
-    with pytest.raises(ValueError):
-        flash_attention(q, k, v, block_q=64, block_k=64)
+    got = flash_attention(q, k, v, block_q=64, block_k=64)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_flash_block_autoadjusts_to_divide_seq():
+    """s=384 with preferred block 256 → picks 192/128-style divisors rather
+    than raising."""
+    q, k, v = qkv(s=384)
+    got = flash_attention(q, k, v, block_q=256, block_k=512)
+    ref = xla_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
 
 
 def test_pipeline_apply_identity_stages():

@@ -1,0 +1,223 @@
+"""Admission webhook behavior — the envtest-with-real-webhook tier of the
+reference (odh suite_test.go:113-274): mutation pipeline, image swap,
+sidecar injection, restart gating, validation denials."""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webhook import (AdmissionDenied, NotebookMutatingWebhook,
+                                  NotebookValidatingWebhook)
+from kubeflow_tpu.webhook.mutating import AUTH_PROXY_CONTAINER
+
+
+@pytest.fixture
+def world():
+    store = ClusterStore()
+    config = ControllerConfig(tpu_default_image="jax-notebook:v1",
+                              image_swap_map={"custom:cuda": "custom:tpu"})
+    NotebookMutatingWebhook(store, config).install(store)
+    NotebookValidatingWebhook(config).install(store)
+    return store, config
+
+
+def test_reconciliation_lock_injected_on_create(world):
+    store, _ = world
+    out = store.create(api.new_notebook("nb", "ns"))
+    assert k8s.get_annotation(out, names.STOP_ANNOTATION) == \
+        names.RECONCILIATION_LOCK_VALUE
+
+
+def test_lock_not_injected_on_update(world):
+    store, _ = world
+    store.create(api.new_notebook("nb", "ns"))
+    cur = store.get(api.KIND, "ns", "nb")
+    k8s.remove_annotation(cur, names.STOP_ANNOTATION)
+    out = store.update(cur)
+    assert k8s.get_annotation(out, names.STOP_ANNOTATION) is None
+
+
+def test_image_swap_for_tpu_notebook(world):
+    store, _ = world
+    nb = api.new_notebook("nb", "ns", image="quay.io/jupyter-cuda:2024",
+                          annotations={names.TPU_ACCELERATOR_ANNOTATION: "v5e-4"})
+    out = store.create(nb)
+    c = api.notebook_container(out)
+    assert c["image"] == "jax-notebook:v1"
+    assert k8s.get_annotation(out, names.IMAGE_SELECTION_ANNOTATION) == \
+        "quay.io/jupyter-cuda:2024"
+
+
+def test_image_swap_map_takes_priority(world):
+    store, _ = world
+    nb = api.new_notebook("nb", "ns", image="custom:cuda",
+                          annotations={names.TPU_ACCELERATOR_ANNOTATION: "v5e-1"})
+    out = store.create(nb)
+    assert api.notebook_container(out)["image"] == "custom:tpu"
+
+
+def test_no_swap_without_tpu_request(world):
+    store, _ = world
+    out = store.create(api.new_notebook("nb", "ns", image="jupyter-cuda:1"))
+    assert api.notebook_container(out)["image"] == "jupyter-cuda:1"
+
+
+def test_no_swap_for_tpu_capable_image(world):
+    store, _ = world
+    nb = api.new_notebook("nb", "ns", image="my-jax-notebook:latest",
+                          annotations={names.TPU_ACCELERATOR_ANNOTATION: "v5e-4"})
+    out = store.create(nb)
+    assert api.notebook_container(out)["image"] == "my-jax-notebook:latest"
+
+
+def test_auth_sidecar_injection_and_removal(world):
+    store, _ = world
+    nb = api.new_notebook("nb", "ns", annotations={
+        names.INJECT_AUTH_ANNOTATION: "true"})
+    out = store.create(nb)
+    spec = api.notebook_pod_spec(out)
+    sidecar = k8s.find_container(spec, AUTH_PROXY_CONTAINER)
+    assert sidecar is not None
+    assert sidecar["resources"]["limits"] == {"cpu": "100m", "memory": "64Mi"}
+    assert sidecar["livenessProbe"]["initialDelaySeconds"] == 30
+    assert sidecar["readinessProbe"]["initialDelaySeconds"] == 5
+    assert any(v["name"] == "rbac-config" for v in spec["volumes"])
+    # notebook is stopped (lock) → turning auth off applies immediately
+    cur = store.get(api.KIND, "ns", "nb")
+    cur["metadata"]["annotations"][names.INJECT_AUTH_ANNOTATION] = "false"
+    out = store.update(cur)
+    assert k8s.find_container(api.notebook_pod_spec(out),
+                              AUTH_PROXY_CONTAINER) is None
+
+
+def test_sidecar_resources_from_annotations(world):
+    store, _ = world
+    nb = api.new_notebook("nb", "ns", annotations={
+        names.INJECT_AUTH_ANNOTATION: "true",
+        names.AUTH_SIDECAR_CPU_ANNOTATION: "250m",
+        names.AUTH_SIDECAR_MEMORY_ANNOTATION: "128Mi"})
+    out = store.create(nb)
+    sidecar = k8s.find_container(api.notebook_pod_spec(out),
+                                 AUTH_PROXY_CONTAINER)
+    assert sidecar["resources"]["requests"] == {"cpu": "250m",
+                                                "memory": "128Mi"}
+
+
+def test_ca_bundle_mounted_when_configmap_exists(world):
+    store, _ = world
+    store.create({"apiVersion": "v1", "kind": "ConfigMap",
+                  "metadata": {"name": "workbench-trusted-ca-bundle",
+                               "namespace": "ns"},
+                  "data": {"ca-bundle.crt": "CERT"}})
+    out = store.create(api.new_notebook("nb", "ns"))
+    c = api.notebook_container(out)
+    env = k8s.env_list_to_dict(c["env"])
+    assert env["SSL_CERT_FILE"].endswith("ca-bundle.crt")
+    assert any(m["name"] == "trusted-ca" for m in c["volumeMounts"])
+
+
+def test_restart_gating_parks_webhook_changes_on_running(world):
+    """The subtlest reference behavior (:518-581): a running notebook's
+    admission must not apply webhook-only mutations — they're parked in
+    update-pending."""
+    store, _ = world
+    store.create(api.new_notebook("nb", "ns"))
+    # unlock → running
+    store.patch(api.KIND, "ns", "nb",
+                {"metadata": {"annotations": {names.STOP_ANNOTATION: None}}})
+    # now the trust bundle appears; user makes an unrelated update
+    store.create({"apiVersion": "v1", "kind": "ConfigMap",
+                  "metadata": {"name": "workbench-trusted-ca-bundle",
+                               "namespace": "ns"},
+                  "data": {"ca-bundle.crt": "CERT"}})
+    cur = store.get(api.KIND, "ns", "nb")
+    k8s.labels(cur)["user-label"] = "x"
+    out = store.update(cur)
+    # user change applied, webhook CA mount NOT applied, diff parked
+    assert k8s.get_label(out, "user-label") == "x"
+    c = api.notebook_container(out)
+    assert not any(m.get("name") == "trusted-ca"
+                   for m in c.get("volumeMounts", []) or [])
+    pending = k8s.get_annotation(out, names.UPDATE_PENDING_ANNOTATION)
+    assert pending and "spec" in pending
+    json.loads(pending)  # valid diff payload
+
+
+def test_restart_gating_applies_when_stopped(world):
+    store, _ = world
+    store.create(api.new_notebook("nb", "ns"))  # born locked/stopped
+    store.create({"apiVersion": "v1", "kind": "ConfigMap",
+                  "metadata": {"name": "workbench-trusted-ca-bundle",
+                               "namespace": "ns"},
+                  "data": {"ca-bundle.crt": "CERT"}})
+    cur = store.get(api.KIND, "ns", "nb")
+    k8s.labels(cur)["poke"] = "1"
+    out = store.update(cur)
+    c = api.notebook_container(out)
+    assert any(m["name"] == "trusted-ca" for m in c.get("volumeMounts", []))
+    assert k8s.get_annotation(out, names.UPDATE_PENDING_ANNOTATION) is None
+
+
+def test_validating_denies_malformed_tpu_request(world):
+    store, _ = world
+    with pytest.raises(AdmissionDenied):
+        store.create(api.new_notebook("nb", "ns", annotations={
+            names.TPU_ACCELERATOR_ANNOTATION: "v5e-7"}))
+
+
+def test_validating_denies_slice_resize_while_running(world):
+    store, _ = world
+    store.create(api.new_notebook("nb", "ns", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-4"}))
+    store.patch(api.KIND, "ns", "nb",
+                {"metadata": {"annotations": {names.STOP_ANNOTATION: None}}})
+    with pytest.raises(AdmissionDenied):
+        store.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+            names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}}})
+    # stopped → resize allowed
+    store.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: "t"}}})
+    out = store.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}}})
+    assert k8s.get_annotation(out, names.TPU_ACCELERATOR_ANNOTATION) == "v5e-16"
+
+
+def test_validating_denies_mlflow_annotation_removal_running():
+    store = ClusterStore()
+    config = ControllerConfig(mlflow_enabled=True, gateway_url="gw.example")
+    NotebookMutatingWebhook(store, config).install(store)
+    NotebookValidatingWebhook(config).install(store)
+    store.create(api.new_notebook("nb", "ns", annotations={
+        names.MLFLOW_INSTANCE_ANNOTATION: "tracking-1"}))
+    env = k8s.env_list_to_dict(
+        api.notebook_container(store.get(api.KIND, "ns", "nb"))["env"])
+    assert env["MLFLOW_TRACKING_URI"] == "https://gw.example/mlflow/tracking-1"
+    store.patch(api.KIND, "ns", "nb",
+                {"metadata": {"annotations": {names.STOP_ANNOTATION: None}}})
+    with pytest.raises(AdmissionDenied):
+        store.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+            names.MLFLOW_INSTANCE_ANNOTATION: None}}})
+    # stopping first → removal allowed
+    store.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: "t"}}})
+    out = store.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+        names.MLFLOW_INSTANCE_ANNOTATION: None}}})
+    assert k8s.get_annotation(out, names.MLFLOW_INSTANCE_ANNOTATION) is None
+
+
+def test_feast_mount_label_gated(world):
+    store, _ = world
+    nb = api.new_notebook("nb", "ns", labels={names.FEAST_LABEL: "true"})
+    out = store.create(nb)
+    c = api.notebook_container(out)
+    assert any(m["name"] == "feast-config" for m in c["volumeMounts"])
+    cur = store.get(api.KIND, "ns", "nb")
+    cur["metadata"]["labels"][names.FEAST_LABEL] = "false"
+    out = store.update(cur)
+    c = api.notebook_container(out)
+    assert not any(m.get("name") == "feast-config"
+                   for m in c.get("volumeMounts", []) or [])

@@ -1,0 +1,96 @@
+"""AdmissionReview HTTP server — drives the real wire protocol the
+kube-apiserver speaks (reference serves the actual webhook over local TLS in
+its envtest suite, odh suite_test.go:196-274)."""
+
+import base64
+import json
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.utils import names
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webhook import NotebookMutatingWebhook, NotebookValidatingWebhook
+from kubeflow_tpu.webhook.server import (MUTATE_PATH, VALIDATE_PATH,
+                                         AdmissionServer, json_patch)
+
+
+@pytest.fixture
+def server():
+    store = ClusterStore()
+    config = ControllerConfig(tpu_default_image="jax-nb:1")
+    srv = AdmissionServer(NotebookMutatingWebhook(store, config),
+                          NotebookValidatingWebhook(config),
+                          host="127.0.0.1", port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def post(srv, path, request):
+    review = {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
+              "request": request}
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(review).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())["response"]
+
+
+def test_mutate_returns_jsonpatch(server):
+    nb = api.new_notebook("nb", "ns", image="jupyter-cuda:1", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-4"})
+    resp = post(server, MUTATE_PATH, {
+        "uid": "u1", "operation": "CREATE", "object": nb})
+    assert resp["allowed"] and resp["uid"] == "u1"
+    ops = json.loads(base64.b64decode(resp["patch"]))
+    assert resp["patchType"] == "JSONPatch"
+    # lock annotation added + image swapped somewhere in the ops
+    paths = {op["path"] for op in ops}
+    assert any("annotations" in p for p in paths)
+
+
+def test_validate_denies_bad_tpu_request(server):
+    nb = api.new_notebook("nb", "ns", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-3"})
+    resp = post(server, VALIDATE_PATH, {
+        "uid": "u2", "operation": "CREATE", "object": nb})
+    assert resp["allowed"] is False
+    assert "invalid TPU request" in resp["status"]["message"]
+
+
+def test_malformed_review_is_400(server):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}{MUTATE_PATH}",
+        data=b"{}", headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        urllib.request.urlopen(req, timeout=5)
+    assert exc.value.code == 400
+
+
+def test_json_patch_roundtrip():
+    import copy
+    original = {"a": {"b": 1}, "keep": [1, 2], "drop": "x", "esc/key": 1}
+    mutated = {"a": {"b": 2, "c": 3}, "keep": [1, 2], "esc/key": 2}
+    ops = json_patch(original, mutated)
+    # apply the ops manually to check they describe the transform
+    doc = copy.deepcopy(original)
+
+    def resolve(path):
+        parts = [p.replace("~1", "/").replace("~0", "~")
+                 for p in path.split("/")[1:]]
+        parent = doc
+        for p in parts[:-1]:
+            parent = parent[p]
+        return parent, parts[-1]
+
+    for op in ops:
+        parent, key = resolve(op["path"])
+        if op["op"] == "remove":
+            del parent[key]
+        else:
+            parent[key] = op["value"]
+    assert doc == mutated
