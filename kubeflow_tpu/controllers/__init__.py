@@ -8,16 +8,21 @@ __all__ = ["Manager", "Request", "NotebookReconciler", "CullingReconciler",
 
 
 def setup_controllers(client, config=None, metrics=None, prober=None, *,
-                      extension=True, webhooks=True):
+                      extension=True, webhooks=True, leader_elect=False,
+                      health_port=None):
     """Wire a manager the way the two reference manager binaries do
     (notebook-controller/main.go:58-148 + odh main.go:141-374): admission
     webhooks on the apiserver, core reconciler always, culler only when
     ENABLE_CULLING (main.go:111-123), extension reconciler for
-    routes/auth/CA/RBAC. Returns the manager (not started)."""
+    routes/auth/CA/RBAC; optional leader election (--leader-elect,
+    main.go:87-94) and healthz/readyz+metrics endpoints (main.go:125-133).
+    Returns the manager (not started)."""
     from ..api.types import install_notebook_crd
     from ..utils.config import ControllerConfig
+    from ..utils.health import HealthServer
     from ..utils.metrics import MetricsRegistry
     from ..webhook import NotebookMutatingWebhook, NotebookValidatingWebhook
+    from .election import LeaderElector
 
     config = config or ControllerConfig.from_env()
     metrics = metrics or MetricsRegistry()
@@ -33,4 +38,18 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
     if config.enable_culling:
         kwargs = {"prober": prober} if prober is not None else {}
         CullingReconciler(client, config, metrics, **kwargs).setup(mgr)
+    if leader_elect:
+        mgr.leader_elector = LeaderElector(
+            client, config.controller_namespace,
+            "kubeflow-tpu-notebook-controller-leader")
+    if health_port is not None:
+        mgr.health_server = HealthServer(metrics_registry=metrics,
+                                         port=health_port)
+        # liveness = the reconcile loop thread is actually alive; readiness
+        # deliberately does NOT gate on leadership — standby replicas must
+        # stay Ready (controller-runtime semantics: readyz is a ping, else
+        # rolling updates of a 2-replica deployment deadlock on the lease)
+        mgr.health_server.add_healthz_check(
+            "manager", lambda: mgr._thread is not None
+            and mgr._thread.is_alive())
     return mgr

@@ -1,0 +1,156 @@
+"""Leader election over a coordination Lease.
+
+The reference managers enable controller-runtime leader election by flag
+(notebook-controller/main.go:87-94, --leader-elect with id
+895b3bb9.kubeflow.org; odh main.go registers its own id) so only one replica
+of each controller binary reconciles at a time. controller-runtime implements
+this as a Lease object in the controller namespace renewed on a timer; a
+candidate acquires the lease when it is unheld or its holder's renew time is
+stale.
+
+Same protocol here, against the ClusterStore's optimistic-concurrency Lease
+objects: acquire → renew every ``renew_period`` → another candidate takes
+over only after ``lease_duration`` without renewal. Conflict on update means
+someone else won the race — back off and retry. The Manager consults
+``is_leader()`` before processing its queue, giving active/passive HA with
+the same failover bound as the reference (lease_duration, default 15 s
+scaled down for in-process use)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import uuid
+from typing import Callable
+
+from ..cluster.errors import (AlreadyExistsError, ConflictError,
+                              NotFoundError)
+
+log = logging.getLogger("kubeflow_tpu.election")
+
+LEASE_KIND = "Lease"
+
+
+class LeaderElector:
+    def __init__(self, client, namespace: str, lease_name: str,
+                 identity: str | None = None,
+                 lease_duration: float = 15.0,
+                 renew_period: float = 2.0,
+                 on_started_leading: Callable[[], None] | None = None,
+                 on_stopped_leading: Callable[[], None] | None = None) -> None:
+        self.client = client
+        self.namespace = namespace
+        self.lease_name = lease_name
+        self.identity = identity or f"mgr-{uuid.uuid4().hex[:8]}"
+        self.lease_duration = lease_duration
+        self.renew_period = renew_period
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._leading = False
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ------------------------------------------------------------- protocol
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self._leading
+
+    def _lease_obj(self) -> dict:
+        return {
+            "apiVersion": "coordination.k8s.io/v1",
+            "kind": LEASE_KIND,
+            "metadata": {"name": self.lease_name,
+                         "namespace": self.namespace},
+            "spec": {
+                "holderIdentity": self.identity,
+                "leaseDurationSeconds": self.lease_duration,
+                "renewTime": time.time(),
+            },
+        }
+
+    def try_acquire_or_renew(self) -> bool:
+        """One election round; returns whether we hold the lease after it."""
+        try:
+            lease = self.client.get_or_none(LEASE_KIND, self.namespace,
+                                            self.lease_name)
+            if lease is None:
+                self.client.create(self._lease_obj())
+                return True
+            spec = lease.get("spec", {})
+            holder = spec.get("holderIdentity")
+            renew = float(spec.get("renewTime", 0.0))
+            duration = float(spec.get("leaseDurationSeconds",
+                                      self.lease_duration))
+            if holder != self.identity and time.time() - renew < duration:
+                return False  # held by a live peer
+            spec.update(holderIdentity=self.identity,
+                        renewTime=time.time(),
+                        leaseDurationSeconds=self.lease_duration)
+            lease["spec"] = spec
+            self.client.update(lease)
+            return True
+        except (ConflictError, AlreadyExistsError):
+            return False  # lost the race this round
+        except NotFoundError:
+            return False
+
+    def _set_leading(self, leading: bool) -> None:
+        with self._lock:
+            was = self._leading
+            self._leading = leading
+        if leading and not was:
+            log.info("became leader for %s/%s as %s", self.namespace,
+                     self.lease_name, self.identity)
+            if self.on_started_leading:
+                self.on_started_leading()
+        elif was and not leading:
+            log.warning("lost leadership for %s/%s", self.namespace,
+                        self.lease_name)
+            if self.on_stopped_leading:
+                self.on_stopped_leading()
+
+    # -------------------------------------------------------------- driving
+    def run_once(self) -> bool:
+        leading = self.try_acquire_or_renew()
+        self._set_leading(leading)
+        return leading
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="leader-elector")
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.run_once()
+            self._stop.wait(self.renew_period)
+
+    def release(self) -> None:
+        """Voluntarily drop the lease (controller-runtime's
+        LeaderElectionReleaseOnCancel) so a standby takes over immediately
+        instead of waiting out lease_duration."""
+        if not self.is_leader():
+            return
+        try:
+            lease = self.client.get_or_none(LEASE_KIND, self.namespace,
+                                            self.lease_name)
+            if lease and lease.get("spec", {}).get("holderIdentity") == \
+                    self.identity:
+                lease["spec"]["renewTime"] = 0.0
+                lease["spec"]["holderIdentity"] = ""
+                self.client.update(lease)
+        except (ConflictError, NotFoundError):
+            pass
+        self._set_leading(False)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.release()

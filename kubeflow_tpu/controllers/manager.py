@@ -78,6 +78,13 @@ class Manager:
         self._running = False
         self._thread: threading.Thread | None = None
         self.healthz: dict[str, bool] = {}
+        # optional active/passive HA — when set, the loop parks (queue keeps
+        # accumulating watch events) until this replica holds the lease, the
+        # same semantics as controller-runtime's --leader-elect
+        # (reference main.go:87-94)
+        self.leader_elector = None
+        # optional healthz/readyz+metrics endpoints (reference main.go:125-133)
+        self.health_server = None
 
     # ---------------------------------------------------------------- wiring
     def register(self, reconciler: Reconciler) -> None:
@@ -192,6 +199,10 @@ class Manager:
             if self._running:
                 return
             self._running = True
+        if self.leader_elector is not None:
+            self.leader_elector.start()
+        if self.health_server is not None:
+            self.health_server.start()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="kubeflow-tpu-manager")
         self._thread.start()
@@ -201,8 +212,19 @@ class Manager:
             with self._cv:
                 if not self._running:
                     return
+            if self.leader_elector is not None and \
+                    not self.leader_elector.is_leader():
+                time.sleep(0.01)  # parked standby; watches still enqueue
+                continue
             item = self._pop_ready(block=True)
             if item is None:
+                continue
+            # re-check after the (possibly long) blocking pop: the lease may
+            # have moved while we slept — processing anyway would be
+            # split-brain with the new leader
+            if self.leader_elector is not None and \
+                    not self.leader_elector.is_leader():
+                self.enqueue(item.controller, item.req)
                 continue
             self._process(item)
 
@@ -213,6 +235,10 @@ class Manager:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self.leader_elector is not None:
+            self.leader_elector.stop()
+        if self.health_server is not None:
+            self.health_server.stop()
 
 
 def owner_mapper(owner_kind: str) -> Callable[[dict], list[Request]]:

@@ -1,0 +1,112 @@
+"""Health-probe and metrics HTTP endpoints.
+
+The reference managers expose healthz/readyz on :8081 (notebook-controller
+main.go:125-133, wired to the manager's AddHealthzCheck/AddReadyzCheck) and
+Prometheus metrics on :8080 (TLS-wrapped in odh main.go:239); the deployment
+manifests point liveness/readiness probes at them
+(config/manager/manager.yaml:59-68).
+
+One stdlib HTTP server provides all three paths:
+
+- ``/healthz`` — process liveness: 200 while the manager loop is alive;
+- ``/readyz``  — readiness: 200 once every registered check passes (e.g.
+  webhook server listening, informers synced);
+- ``/metrics`` — Prometheus text exposition from the MetricsRegistry.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+log = logging.getLogger("kubeflow_tpu.health")
+
+
+class HealthServer:
+    def __init__(self, metrics_registry=None, host: str = "0.0.0.0",
+                 port: int = 0) -> None:
+        self.metrics_registry = metrics_registry
+        self._checks: dict[str, Callable[[], bool]] = {}
+        self._ready_checks: dict[str, Callable[[], bool]] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug("health: " + fmt, *args)
+
+            def do_GET(self) -> None:
+                status, body, ctype = outer._get(self.path)
+                data = body.encode()
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: threading.Thread | None = None
+
+    # -------------------------------------------------------------- checks
+    def add_healthz_check(self, name: str, fn: Callable[[], bool]) -> None:
+        with self._lock:
+            self._checks[name] = fn
+
+    def add_readyz_check(self, name: str, fn: Callable[[], bool]) -> None:
+        with self._lock:
+            self._ready_checks[name] = fn
+
+    def _run_checks(self, checks: dict[str, Callable[[], bool]]
+                    ) -> tuple[bool, str]:
+        lines = []
+        ok = True
+        with self._lock:
+            items = list(checks.items())
+        for name, fn in items:
+            try:
+                passed = bool(fn())
+            except Exception as exc:  # noqa: BLE001 — a failing check is a
+                passed = False        # 500, never a crashed probe server
+                log.warning("check %s raised: %s", name, exc)
+            ok = ok and passed
+            lines.append(f"[{'+' if passed else '-'}]{name} "
+                         f"{'ok' if passed else 'failed'}")
+        return ok, "\n".join(lines) + ("\n" if lines else "ok\n")
+
+    def _get(self, path: str) -> tuple[int, str, str]:
+        if path.startswith("/healthz"):
+            ok, body = self._run_checks(self._checks)
+            return (200 if ok else 500), body, "text/plain"
+        if path.startswith("/readyz"):
+            ok, body = self._run_checks({**self._checks,
+                                         **self._ready_checks})
+            return (200 if ok else 500), body, "text/plain"
+        if path.startswith("/metrics"):
+            if self.metrics_registry is None:
+                return 404, "no metrics registry\n", "text/plain"
+            return 200, self.metrics_registry.expose(), \
+                "text/plain; version=0.0.4"
+        return 404, "not found\n", "text/plain"
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True, name="health-server")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is not None:
+            # shutdown() deadlocks unless serve_forever() is running, so only
+            # call it when start() actually ran
+            self._server.shutdown()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self._server.server_close()

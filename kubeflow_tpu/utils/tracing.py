@@ -1,0 +1,206 @@
+"""Tracing for the admission webhook (and anything else that wants spans).
+
+The reference instruments its mutating webhook with OpenTelemetry: a lazy
+tracer (sync.OnceValue, odh notebook_mutating_webhook.go:74-76), one root span
+per admission with notebook/namespace/operation attributes (:366-373), a child
+span inside maybeRestartRunningNotebook (:526), and span events for
+ImageStream lookup misses (:912,928,961). Production default is the global
+no-op provider; the test suite installs a real SDK provider with an in-memory
+exporter (opentelemetry_test.go:26-78).
+
+This module reproduces that shape with the stdlib only (the image carries no
+opentelemetry SDK): an OTel-like API — ``get_tracer(name).start_span(...)`` as
+a context manager, attributes, events, status — over a pluggable provider.
+The default provider is a no-op (zero overhead on the admission hot path);
+``set_provider(SDKProvider(exporter))`` installs a recording one.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Iterator
+
+# ------------------------------------------------------------------ data model
+
+STATUS_UNSET = "UNSET"
+STATUS_OK = "OK"
+STATUS_ERROR = "ERROR"
+
+
+@dataclass
+class SpanEvent:
+    name: str
+    attributes: dict[str, object]
+    timestamp: float
+
+
+@dataclass
+class Span:
+    name: str
+    tracer: str
+    trace_id: int
+    span_id: int
+    parent_id: int | None
+    attributes: dict[str, object] = field(default_factory=dict)
+    events: list[SpanEvent] = field(default_factory=list)
+    status: str = STATUS_UNSET
+    status_description: str = ""
+    start_time: float = 0.0
+    end_time: float = 0.0
+
+    def set_attribute(self, key: str, value: object) -> None:
+        self.attributes[key] = value
+
+    def add_event(self, name: str, attributes: dict | None = None) -> None:
+        self.events.append(SpanEvent(name, dict(attributes or {}),
+                                     time.time()))
+
+    def set_status(self, status: str, description: str = "") -> None:
+        self.status = status
+        self.status_description = description
+
+    def record_exception(self, exc: BaseException) -> None:
+        self.add_event("exception", {
+            "exception.type": type(exc).__name__,
+            "exception.message": str(exc),
+        })
+        self.set_status(STATUS_ERROR, str(exc))
+
+
+class _NoopSpan:
+    """Attribute/event sink with no recording — the global default provider,
+    like OTel's no-op TracerProvider."""
+
+    def set_attribute(self, key: str, value: object) -> None: ...
+
+    def add_event(self, name: str, attributes: dict | None = None) -> None: ...
+
+    def set_status(self, status: str, description: str = "") -> None: ...
+
+    def record_exception(self, exc: BaseException) -> None: ...
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+# ------------------------------------------------------------------- providers
+
+class InMemorySpanExporter:
+    """Test-side exporter mirroring tracetest.NewInMemoryExporter
+    (opentelemetry_test.go:26-78)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    @property
+    def spans(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    def by_name(self, name: str) -> list[Span]:
+        return [s for s in self.spans if s.name == name]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+class NoopProvider:
+    recording = False
+
+    @contextmanager
+    def span(self, tracer: str, name: str,
+             attributes: dict | None = None) -> Iterator[_NoopSpan]:
+        yield _NOOP_SPAN
+
+
+class SDKProvider:
+    """Recording provider: spans export on end, parentage via a context stack
+    (thread-local, like OTel context propagation)."""
+
+    recording = True
+
+    def __init__(self, exporter: InMemorySpanExporter) -> None:
+        self.exporter = exporter
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        self._next_id = 1
+
+    def _ids(self) -> int:
+        with self._lock:
+            i = self._next_id
+            self._next_id += 1
+            return i
+
+    @contextmanager
+    def span(self, tracer: str, name: str,
+             attributes: dict | None = None) -> Iterator[Span]:
+        stack: list[Span] = getattr(self._local, "stack", None) or []
+        self._local.stack = stack
+        parent = stack[-1] if stack else None
+        span = Span(name=name, tracer=tracer,
+                    trace_id=parent.trace_id if parent else self._ids(),
+                    span_id=self._ids(),
+                    parent_id=parent.span_id if parent else None,
+                    attributes=dict(attributes or {}),
+                    start_time=time.time())
+        stack.append(span)
+        try:
+            yield span
+        except BaseException as exc:
+            span.record_exception(exc)
+            raise
+        finally:
+            span.end_time = time.time()
+            stack.pop()
+            self.exporter.export(span)
+
+
+_provider: NoopProvider | SDKProvider = NoopProvider()
+_provider_lock = threading.Lock()
+
+
+def set_provider(provider: NoopProvider | SDKProvider) -> None:
+    global _provider
+    with _provider_lock:
+        _provider = provider
+
+
+def get_provider() -> NoopProvider | SDKProvider:
+    return _provider
+
+
+def current_span():
+    """The innermost active recording span on this thread (OTel's
+    trace.SpanFromContext) — a no-op sink when the provider isn't recording
+    or no span is open, so callers can add events unconditionally."""
+    provider = _provider
+    if isinstance(provider, SDKProvider):
+        stack = getattr(provider._local, "stack", None)
+        if stack:
+            return stack[-1]
+    return _NOOP_SPAN
+
+
+class Tracer:
+    """Named tracer handle — cheap, safe to cache (the reference memoizes via
+    sync.OnceValue; here the provider lookup is deferred to span start so a
+    provider installed later is picked up, same observable behavior)."""
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+
+    def start_span(self, name: str, attributes: dict | None = None):
+        return _provider.span(self.name, name, attributes)
+
+
+def get_tracer(name: str) -> Tracer:
+    return Tracer(name)

@@ -31,11 +31,12 @@ import logging
 
 from ..api import types as api
 from ..tpu.topology import parse_slice_request
-from ..utils import k8s, names
+from ..utils import k8s, names, tracing
 from ..utils.config import ControllerConfig
 from .diff import first_differences
 
 log = logging.getLogger("kubeflow_tpu.webhook")
+_tracer = tracing.get_tracer("kubeflow_tpu.webhook")
 
 CA_BUNDLE_CONFIGMAP = "workbench-trusted-ca-bundle"
 CA_CERT_PATH = "/etc/pki/tls/custom-certs"
@@ -59,29 +60,37 @@ class NotebookMutatingWebhook:
 
     # ------------------------------------------------------------ pipeline
     def handle(self, operation: str, notebook: dict, old: dict | None) -> dict:
+        """One root span per admission with notebook/namespace/operation
+        attributes, like the reference (:366-373)."""
         if operation not in ("CREATE", "UPDATE"):
             return notebook
         if k8s.is_deleting(notebook):
             return notebook
-        mutated = k8s.deepcopy(notebook)
+        with _tracer.start_span("notebook-mutating-webhook", {
+                "notebook.name": k8s.name(notebook),
+                "notebook.namespace": k8s.namespace(notebook),
+                "admission.operation": operation}) as span:
+            mutated = k8s.deepcopy(notebook)
 
-        if operation == "CREATE":
-            self._inject_reconciliation_lock(mutated)
+            if operation == "CREATE":
+                self._inject_reconciliation_lock(mutated)
 
-        self._swap_image_for_tpu(mutated)
-        self._mount_ca_bundle(mutated)
-        self._mount_runtime_images(mutated)
-        self._mount_feast_config(mutated)
-        self._mount_elyra_secret(mutated)
-        self._inject_mlflow_env(mutated)
-        if k8s.get_annotation(mutated, names.INJECT_AUTH_ANNOTATION) == "true":
-            self._inject_auth_proxy(mutated)
-        else:
-            self._remove_auth_proxy(mutated)
+            self._swap_image_for_tpu(mutated)
+            self._mount_ca_bundle(mutated)
+            self._mount_runtime_images(mutated)
+            self._mount_feast_config(mutated)
+            self._mount_elyra_secret(mutated)
+            self._inject_mlflow_env(mutated)
+            self._inject_cluster_proxy_env(mutated)
+            if k8s.get_annotation(mutated, names.INJECT_AUTH_ANNOTATION) == "true":
+                self._inject_auth_proxy(mutated)
+            else:
+                self._remove_auth_proxy(mutated)
 
-        if operation == "UPDATE" and old is not None:
-            mutated = self._maybe_defer_updates(old, notebook, mutated)
-        return mutated
+            if operation == "UPDATE" and old is not None:
+                mutated = self._maybe_defer_updates(old, notebook, mutated)
+            span.set_status(tracing.STATUS_OK)
+            return mutated
 
     # ------------------------------------------------------ lock (stage 1)
     def _inject_reconciliation_lock(self, nb: dict) -> None:
@@ -116,10 +125,16 @@ class NotebookMutatingWebhook:
         elif _looks_cuda(image) or _is_generic_notebook_image(image):
             new_image = self.config.tpu_default_image
         else:
+            # the analog of the reference's ImageStream-miss span events
+            # (:912,928,961): record why no swap happened
+            tracing.current_span().add_event(
+                "image-swap-skipped", {"image": image})
             return  # already a TPU-capable image (or user knows best)
         if new_image and new_image != image:
             k8s.set_annotation(nb, names.IMAGE_SELECTION_ANNOTATION, image)
             container["image"] = new_image
+            tracing.current_span().add_event(
+                "image-swapped", {"from": image, "to": new_image})
 
     # ------------------------------------------------- CA bundle (stage 3)
     def _mount_ca_bundle(self, nb: dict) -> None:
@@ -230,6 +245,33 @@ class NotebookMutatingWebhook:
         k8s.upsert_env(container, "MLFLOW_K8S_INTEGRATION", "true")
         k8s.upsert_env(container, "MLFLOW_TRACKING_AUTH", "oidc")
 
+    # ---------------------------------------- cluster proxy env (stage 4)
+    def _inject_cluster_proxy_env(self, nb: dict) -> None:
+        """Inject cluster egress-proxy env vars (reference injects
+        HTTP_PROXY/HTTPS_PROXY/NO_PROXY from the cluster Proxy config,
+        notebook_mutating_webhook.go:648-697), gated by
+        INJECT_CLUSTER_PROXY_ENV. Source of truth is the cluster-scoped
+        Proxy/cluster object's status; empty fields unset the vars."""
+        if not self.config.inject_cluster_proxy_env:
+            return  # feature off: user-supplied proxy env is left alone
+        container = api.notebook_container(nb)
+        if container is None:
+            return
+        proxy = self.client.get_or_none("Proxy", "", "cluster")
+        status = k8s.get_in(proxy or {}, "status", default={}) or {}
+        for env_name, field_ in (("HTTP_PROXY", "httpProxy"),
+                                 ("HTTPS_PROXY", "httpsProxy"),
+                                 ("NO_PROXY", "noProxy")):
+            value = status.get(field_, "")
+            if value:
+                k8s.upsert_env(container, env_name, value)
+                # lowercase duplicates: many CLI tools only read the
+                # lowercase form and the reference injects both
+                k8s.upsert_env(container, env_name.lower(), value)
+            else:
+                k8s.remove_env(container, env_name)
+                k8s.remove_env(container, env_name.lower())
+
     # ------------------------------------------------- sidecar (stage 5)
     def _auth_sidecar_resources(self, nb: dict) -> dict:
         cpu = k8s.get_annotation(nb, names.AUTH_SIDECAR_CPU_ANNOTATION, "100m")
@@ -321,23 +363,25 @@ class NotebookMutatingWebhook:
         and recorded in update-pending — admission must never silently bounce
         a live slice (a template change restarts every worker). User-caused
         changes always pass through. Stopped notebooks take everything."""
-        stopped = k8s.get_annotation(incoming, names.STOP_ANNOTATION) is not None
-        if stopped:
-            k8s.remove_annotation(mutated, names.UPDATE_PENDING_ANNOTATION)
-            return mutated
-        incoming_spec = k8s.get_in(incoming, "spec", default={})
-        mutated_spec = k8s.get_in(mutated, "spec", default={})
-        if mutated_spec == incoming_spec:
-            k8s.remove_annotation(mutated, names.UPDATE_PENDING_ANNOTATION)
-            return mutated
-        diffs = first_differences(incoming_spec, mutated_spec, path="spec")
-        log.info("parking webhook mutations on running notebook %s/%s: %s",
-                 k8s.namespace(incoming), k8s.name(incoming), diffs)
-        parked = k8s.deepcopy(mutated)
-        parked["spec"] = k8s.deepcopy(incoming_spec)
-        k8s.set_annotation(parked, names.UPDATE_PENDING_ANNOTATION,
-                           json.dumps(diffs))
-        return parked
+        with _tracer.start_span("maybe-restart-running-notebook") as span:
+            stopped = k8s.get_annotation(incoming, names.STOP_ANNOTATION) is not None
+            if stopped:
+                k8s.remove_annotation(mutated, names.UPDATE_PENDING_ANNOTATION)
+                return mutated
+            incoming_spec = k8s.get_in(incoming, "spec", default={})
+            mutated_spec = k8s.get_in(mutated, "spec", default={})
+            if mutated_spec == incoming_spec:
+                k8s.remove_annotation(mutated, names.UPDATE_PENDING_ANNOTATION)
+                return mutated
+            diffs = first_differences(incoming_spec, mutated_spec, path="spec")
+            log.info("parking webhook mutations on running notebook %s/%s: %s",
+                     k8s.namespace(incoming), k8s.name(incoming), diffs)
+            span.add_event("updates-parked", {"diffs": json.dumps(diffs)})
+            parked = k8s.deepcopy(mutated)
+            parked["spec"] = k8s.deepcopy(incoming_spec)
+            k8s.set_annotation(parked, names.UPDATE_PENDING_ANNOTATION,
+                               json.dumps(diffs))
+            return parked
 
 
 def _looks_cuda(image: str) -> bool:

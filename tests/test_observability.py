@@ -1,0 +1,215 @@
+"""Tracing, leader election, health/metrics endpoints.
+
+Mirrors the reference's aux-subsystem coverage: OTel span assertions via an
+in-memory exporter (odh opentelemetry_test.go:26-131), leader-election
+active/passive semantics (controller-runtime --leader-elect,
+notebook-controller/main.go:87-94), healthz/readyz probes (main.go:125-133)."""
+
+import time
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.controllers.election import LeaderElector
+from kubeflow_tpu.controllers.manager import Manager, Request
+from kubeflow_tpu.utils import names, tracing
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.health import HealthServer
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+from kubeflow_tpu.webhook.mutating import NotebookMutatingWebhook
+
+
+@pytest.fixture
+def exporter():
+    exp = tracing.InMemorySpanExporter()
+    tracing.set_provider(tracing.SDKProvider(exp))
+    yield exp
+    tracing.set_provider(tracing.NoopProvider())
+
+
+# ------------------------------------------------------------------- tracing
+
+def test_noop_provider_records_nothing_and_never_fails():
+    tracer = tracing.get_tracer("t")
+    with tracer.start_span("root", {"a": 1}) as span:
+        span.set_attribute("k", "v")
+        span.add_event("e")
+        span.set_status(tracing.STATUS_OK)
+    assert not tracing.get_provider().recording
+
+
+def test_sdk_provider_parents_and_exports(exporter):
+    tracer = tracing.get_tracer("t")
+    with tracer.start_span("root") as root:
+        root.set_attribute("x", 1)
+        with tracer.start_span("child") as child:
+            child.add_event("evt", {"k": "v"})
+    spans = exporter.spans
+    assert [s.name for s in spans] == ["child", "root"]  # export on end
+    child, root = spans
+    assert child.parent_id == root.span_id
+    assert child.trace_id == root.trace_id
+    assert child.events[0].name == "evt"
+
+
+def test_sdk_provider_records_exception(exporter):
+    tracer = tracing.get_tracer("t")
+    with pytest.raises(ValueError):
+        with tracer.start_span("boom"):
+            raise ValueError("bad")
+    (span,) = exporter.spans
+    assert span.status == tracing.STATUS_ERROR
+    assert span.events[0].attributes["exception.type"] == "ValueError"
+
+
+def test_webhook_admission_emits_root_span(exporter):
+    """One root span per admission with notebook/namespace/operation
+    attributes (reference :366-373) and an image-swap event."""
+    store = ClusterStore()
+    wh = NotebookMutatingWebhook(store, ControllerConfig())
+    nb = api.new_notebook(
+        "traced", "ns", image="jupyter/scipy-notebook:latest",
+        annotations={names.TPU_ACCELERATOR_ANNOTATION: "v5e-4"})
+    wh.handle("CREATE", nb, None)
+    (span,) = exporter.by_name("notebook-mutating-webhook")
+    assert span.attributes["notebook.name"] == "traced"
+    assert span.attributes["notebook.namespace"] == "ns"
+    assert span.attributes["admission.operation"] == "CREATE"
+    assert span.status == tracing.STATUS_OK
+    assert any(e.name == "image-swapped" for e in span.events)
+
+
+def test_webhook_restart_gating_child_span(exporter):
+    """The parked-update path opens a child span with an updates-parked
+    event (reference maybeRestartRunningNotebook child span, :526)."""
+    store = ClusterStore()
+    wh = NotebookMutatingWebhook(store, ControllerConfig())
+    # a running notebook (no stop annotation) whose webhook mutations differ
+    old = api.new_notebook(
+        "run", "ns", image="gcr.io/me/jax-notebook:latest",
+        annotations={names.TPU_ACCELERATOR_ANNOTATION: "v5e-4"})
+    incoming = api.new_notebook(
+        "run", "ns", image="nvcr.io/nvidia/cuda:12.4",
+        annotations={names.TPU_ACCELERATOR_ANNOTATION: "v5e-4"})
+    out = wh.handle("UPDATE", incoming, old)
+    children = exporter.by_name("maybe-restart-running-notebook")
+    assert len(children) == 1
+    roots = exporter.by_name("notebook-mutating-webhook")
+    assert children[0].parent_id == roots[0].span_id
+    assert any(e.name == "updates-parked" for e in children[0].events)
+    assert names.UPDATE_PENDING_ANNOTATION in out["metadata"]["annotations"]
+
+
+# ------------------------------------------------------------ leader election
+
+def test_single_candidate_acquires_and_renews():
+    store = ClusterStore()
+    el = LeaderElector(store, "kubeflow-tpu-system", "controller-leader",
+                       identity="a", lease_duration=0.5, renew_period=0.05)
+    assert el.run_once()
+    assert el.is_leader()
+    lease = store.get("Lease", "kubeflow-tpu-system", "controller-leader")
+    assert lease["spec"]["holderIdentity"] == "a"
+    first_renew = lease["spec"]["renewTime"]
+    time.sleep(0.01)
+    assert el.run_once()
+    assert store.get("Lease", "kubeflow-tpu-system",
+                     "controller-leader")["spec"]["renewTime"] > first_renew
+
+
+def test_second_candidate_blocked_until_lease_expires():
+    store = ClusterStore()
+    a = LeaderElector(store, "ns", "lock", identity="a",
+                      lease_duration=0.15, renew_period=0.05)
+    b = LeaderElector(store, "ns", "lock", identity="b",
+                      lease_duration=0.15, renew_period=0.05)
+    assert a.run_once()
+    assert not b.run_once()
+    # a stops renewing; after lease_duration b takes over
+    time.sleep(0.2)
+    assert b.run_once()
+    assert b.is_leader()
+    assert store.get("Lease", "ns", "lock")["spec"]["holderIdentity"] == "b"
+    # a comes back, sees b's live lease, demotes itself
+    assert not a.run_once()
+    assert not a.is_leader()
+
+
+def test_release_hands_over_immediately():
+    store = ClusterStore()
+    a = LeaderElector(store, "ns", "lock", identity="a",
+                      lease_duration=30.0, renew_period=1.0)
+    b = LeaderElector(store, "ns", "lock", identity="b",
+                      lease_duration=30.0, renew_period=1.0)
+    assert a.run_once()
+    a.release()
+    assert b.run_once()  # no 30s wait
+
+
+def test_manager_parks_until_leader():
+    """A standby manager accumulates watch events but reconciles nothing
+    until it wins the lease."""
+    store = ClusterStore()
+
+    class Rec:
+        name = "r"
+        count = 0
+
+        def reconcile(self, req):
+            Rec.count += 1
+            return None
+
+    mgr = Manager(store)
+    mgr.register(Rec())
+    el = LeaderElector(store, "ns", "mgr-lock", identity="standby",
+                      lease_duration=0.3, renew_period=0.02)
+    # someone else holds the lease
+    other = LeaderElector(store, "ns", "mgr-lock", identity="active",
+                          lease_duration=0.3, renew_period=0.02)
+    assert other.run_once()
+    mgr.leader_elector = el
+    mgr.start()
+    try:
+        mgr.enqueue("r", Request("ns", "x"))
+        time.sleep(0.1)
+        assert Rec.count == 0  # parked
+        other.release()
+        deadline = time.monotonic() + 2.0
+        while Rec.count == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert Rec.count == 1  # took over after failover
+    finally:
+        mgr.stop()
+
+
+# ------------------------------------------------------------ health server
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+def test_health_server_endpoints():
+    reg = MetricsRegistry()
+    reg.notebook_create_total.inc()
+    srv = HealthServer(metrics_registry=reg)
+    srv.add_healthz_check("loop", lambda: True)
+    ready = {"ok": False}
+    srv.add_readyz_check("webhook", lambda: ready["ok"])
+    srv.start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        status, body = _get(f"{base}/healthz")
+        assert status == 200 and "loop" in body
+        with pytest.raises(urllib.request.HTTPError):
+            _get(f"{base}/readyz")  # webhook check failing → 500
+        ready["ok"] = True
+        status, _ = _get(f"{base}/readyz")
+        assert status == 200
+        status, body = _get(f"{base}/metrics")
+        assert status == 200
+        assert "notebook_create_total 1" in body
+    finally:
+        srv.stop()

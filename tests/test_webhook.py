@@ -221,3 +221,41 @@ def test_feast_mount_label_gated(world):
     c = api.notebook_container(out)
     assert not any(m.get("name") == "feast-config"
                    for m in c.get("volumeMounts", []) or [])
+
+
+# ------------------------------------------------------- cluster proxy env
+
+def test_cluster_proxy_env_injected_when_enabled():
+    """Reference injects HTTP(S)_PROXY/NO_PROXY from the cluster Proxy
+    config when INJECT_CLUSTER_PROXY_ENV is on (webhook :648-697)."""
+    from kubeflow_tpu.cluster.store import ClusterStore
+    store = ClusterStore()
+    store.create({
+        "apiVersion": "config.openshift.io/v1", "kind": "Proxy",
+        "metadata": {"name": "cluster", "namespace": ""},
+        "status": {"httpProxy": "http://proxy:3128",
+                   "httpsProxy": "https://proxy:3128",
+                   "noProxy": ".cluster.local,.svc"},
+    })
+    cfg = ControllerConfig(inject_cluster_proxy_env=True)
+    wh = NotebookMutatingWebhook(store, cfg)
+    nb = api.new_notebook("p", "ns")
+    out = wh.handle("CREATE", nb, None)
+    env = {e["name"]: e.get("value")
+           for e in api.notebook_container(out).get("env", [])}
+    assert env["HTTP_PROXY"] == "http://proxy:3128"
+    assert env["https_proxy"] == "https://proxy:3128"
+    assert env["NO_PROXY"] == ".cluster.local,.svc"
+
+
+def test_cluster_proxy_env_untouched_when_disabled():
+    from kubeflow_tpu.cluster.store import ClusterStore
+    store = ClusterStore()
+    wh = NotebookMutatingWebhook(store, ControllerConfig())
+    nb = api.new_notebook("p", "ns")
+    api.notebook_container(nb)["env"] = [
+        {"name": "HTTP_PROXY", "value": "http://mine:8080"}]
+    out = wh.handle("CREATE", nb, None)
+    env = {e["name"]: e.get("value")
+           for e in api.notebook_container(out).get("env", [])}
+    assert env["HTTP_PROXY"] == "http://mine:8080"
