@@ -1,0 +1,162 @@
+"""Read-cache client layer with data-stripping transforms.
+
+Reference: odh main.go builds its manager cache with transforms that strip
+``data``/``binaryData``/``stringData`` from every cached Secret and ConfigMap
+(stripSecretData/stripConfigMapData, main.go:95-125) — the controller lists
+hundreds of them across namespaces but only ever reads metadata from cache —
+and disables client-side caching for those kinds entirely
+(client.Options.Cache.DisableFor, main.go:248-268) so that code paths needing
+actual payloads (CA bundle PEM, runtime-image JSON) read straight from the
+apiserver.
+
+``CachingClient`` wraps a ClusterStore with exactly that split:
+
+- watch-fed local cache for every kind, transforms applied on ingest;
+- ``get``/``list`` serve from cache EXCEPT kinds in ``disable_for`` which go
+  direct to the store (fresh, untransformed);
+- writes always pass through.
+
+This is also where the framework's memory ceiling for big fleets is enforced:
+the cache never holds Secret/ConfigMap payloads, the same reason the
+reference added the transforms.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable
+
+from ..utils import k8s
+from .store import WatchEvent
+
+DEFAULT_DISABLE_FOR = ("Secret", "ConfigMap")
+
+
+def strip_secret_data(obj: dict) -> dict:
+    """Transform analog of stripSecretData (main.go:95-109)."""
+    if obj.get("kind") == "Secret":
+        obj = dict(obj)
+        obj.pop("data", None)
+        obj.pop("stringData", None)
+    return obj
+
+
+def strip_configmap_data(obj: dict) -> dict:
+    """Transform analog of stripConfigMapData (main.go:111-125)."""
+    if obj.get("kind") == "ConfigMap":
+        obj = dict(obj)
+        obj.pop("data", None)
+        obj.pop("binaryData", None)
+    return obj
+
+
+DEFAULT_TRANSFORMS = (strip_secret_data, strip_configmap_data)
+
+
+class CachingClient:
+    """Same client surface as ClusterStore for reads/writes/watches, with the
+    manager-cache semantics described above."""
+
+    def __init__(self, store,
+                 transforms: Iterable[Callable[[dict], dict]] =
+                 DEFAULT_TRANSFORMS,
+                 disable_for: Iterable[str] = DEFAULT_DISABLE_FOR) -> None:
+        self.store = store
+        self.transforms = tuple(transforms)
+        self.disable_for = frozenset(disable_for)
+        self._cache: dict[tuple[str, str, str], dict] = {}
+        self._lock = threading.Lock()
+        self._watched: set[str] = set()
+
+    # ------------------------------------------------------------- ingest
+    def _transform(self, obj: dict) -> dict:
+        for t in self.transforms:
+            obj = t(obj)
+        return obj
+
+    def _ensure_informer(self, kind: str) -> None:
+        with self._lock:
+            if kind in self._watched:
+                return
+            self._watched.add(kind)
+        # register the watch BEFORE backfilling: an update landing between a
+        # list snapshot and watch registration would otherwise never be
+        # delivered, leaving the cache stale forever (ingest is idempotent,
+        # so double-delivery during the overlap is harmless)
+        self.store.watch(kind, self._on_event)
+        for obj in self.store.list(kind):
+            self._ingest(obj)
+
+    def _on_event(self, event: WatchEvent) -> None:
+        if event.type == "DELETED":
+            with self._lock:
+                self._cache.pop(self._key(event.obj), None)
+        else:
+            self._ingest(event.obj)
+
+    def _ingest(self, obj: dict) -> None:
+        with self._lock:
+            self._cache[self._key(obj)] = self._transform(obj)
+
+    @staticmethod
+    def _key(obj: dict) -> tuple[str, str, str]:
+        return (obj.get("kind", ""), k8s.namespace(obj), k8s.name(obj))
+
+    # -------------------------------------------------------------- reads
+    def get(self, kind: str, namespace: str, name: str) -> dict:
+        if kind in self.disable_for:
+            return self.store.get(kind, namespace, name)  # live read
+        self._ensure_informer(kind)
+        with self._lock:
+            obj = self._cache.get((kind, namespace, name))
+        if obj is not None:
+            return k8s.deepcopy(obj)
+        # cache miss (first read before any event): fall through live, ingest
+        obj = self.store.get(kind, namespace, name)
+        self._ingest(obj)
+        return self._transform(k8s.deepcopy(obj))
+
+    def get_or_none(self, kind: str, namespace: str, name: str) -> dict | None:
+        from .errors import NotFoundError
+        try:
+            return self.get(kind, namespace, name)
+        except NotFoundError:
+            return None
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict | None = None) -> list[dict]:
+        if kind in self.disable_for:
+            return self.store.list(kind, namespace, label_selector)
+        self._ensure_informer(kind)
+        with self._lock:
+            objs = [k8s.deepcopy(o) for o in self._cache.values()
+                    if o.get("kind") == kind]
+        if namespace is not None:
+            objs = [o for o in objs if k8s.namespace(o) == namespace]
+        if label_selector:
+            objs = [o for o in objs
+                    if all(k8s.get_label(o, k) == v
+                           for k, v in label_selector.items())]
+        return objs
+
+    # ---------------------------------------- writes + watches: passthrough
+    def create(self, obj: dict) -> dict:
+        return self.store.create(obj)
+
+    def update(self, obj: dict) -> dict:
+        return self.store.update(obj)
+
+    def update_status(self, obj: dict) -> dict:
+        return self.store.update_status(obj)
+
+    def patch(self, kind: str, namespace: str, name: str, patch: dict) -> dict:
+        return self.store.patch(kind, namespace, name, patch)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        return self.store.delete(kind, namespace, name)
+
+    def watch(self, kind: str, callback, **kw) -> None:
+        return self.store.watch(kind, callback, **kw)
+
+    def register_admission(self, kind: str, fn) -> None:
+        return self.store.register_admission(kind, fn)

@@ -127,7 +127,13 @@ class LeaderElector:
 
     def _loop(self) -> None:
         while not self._stop.is_set():
-            self.run_once()
+            try:
+                self.run_once()
+            except Exception as exc:  # noqa: BLE001 — an election round that
+                # dies must demote us: keeping _leading=True with no renew
+                # thread is split-brain once a standby takes the lease
+                log.warning("election round failed: %s; demoting", exc)
+                self._set_leading(False)
             self._stop.wait(self.renew_period)
 
     def release(self) -> None:

@@ -24,7 +24,7 @@ from ..cluster import errors
 from ..utils import k8s, names
 from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
-from . import auth, cacert, netpol, rbac, routes, runtime_images
+from . import auth, cacert, netpol, oauth, rbac, routes, runtime_images
 from .manager import Manager, Request, Result, owner_mapper
 
 log = logging.getLogger("kubeflow_tpu.extension")
@@ -138,6 +138,11 @@ class ExtensionReconciler:
         cleanup; strip exactly the finalizers whose cleanup succeeded;
         combined error → requeue for the rest."""
         cleanups = {
+            # legacy OAuthClient first, as in the reference (:214-229) —
+            # never added by this controller, only inherited from pre-auth-
+            # proxy versions (oauth.py)
+            oauth.LEGACY_OAUTH_FINALIZER: lambda:
+                oauth.delete_oauth_client(self.client, notebook),
             FINALIZER_ROUTES: lambda: routes.delete_routes_for_notebook(
                 self.client, self.config, notebook),
             FINALIZER_REFGRANT: lambda:

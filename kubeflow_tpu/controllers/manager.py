@@ -214,7 +214,10 @@ class Manager:
                     return
             if self.leader_elector is not None and \
                     not self.leader_elector.is_leader():
-                time.sleep(0.01)  # parked standby; watches still enqueue
+                # parked standby; watches still enqueue. Leadership can't
+                # change faster than the renew loop, so pace on it instead
+                # of busy-polling.
+                time.sleep(min(self.leader_elector.renew_period / 4, 0.5))
                 continue
             item = self._pop_ready(block=True)
             if item is None:

@@ -1,0 +1,48 @@
+"""Legacy per-notebook OAuthClient cleanup (migration path).
+
+Reference: odh notebook_oauth.go:29-96, invoked from the deletion branch at
+notebook_controller.go:207-229. Before the kube-rbac-proxy era the controller
+provisioned one cluster-scoped ``OAuthClient`` CR per notebook and guarded it
+with a finalizer on the Notebook. Current versions never create these, but
+notebooks born under an old controller still carry the finalizer — so
+deletion must (a) best-effort delete the orphaned OAuthClient and (b) strip
+the legacy finalizer, or the Notebook hangs in Terminating forever.
+
+The OAuthClient is cluster-scoped and named ``<name>-<namespace>-oauth-client``
+(matching the reference's naming), so a namespaced owner reference could never
+GC it — hence the explicit finalizer protocol.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..cluster import errors
+from ..utils import k8s
+
+log = logging.getLogger("kubeflow_tpu.oauth")
+
+OAUTH_CLIENT_KIND = "OAuthClient"
+# the legacy finalizer old controllers stamped on Notebooks
+LEGACY_OAUTH_FINALIZER = "notebooks.kubeflow-tpu.org/oauth-client"
+
+
+def oauth_client_name(namespace: str, name: str) -> str:
+    return f"{name}-{namespace}-oauth-client"[:63]
+
+
+def has_legacy_finalizer(notebook: dict) -> bool:
+    return k8s.has_finalizer(notebook, LEGACY_OAUTH_FINALIZER)
+
+
+def delete_oauth_client(client, notebook: dict) -> None:
+    """Delete the orphaned cluster-scoped OAuthClient; absent is success
+    (reference deleteOAuthClient ignores IsNotFound, notebook_oauth.go:67-96)."""
+    try:
+        client.delete(OAUTH_CLIENT_KIND, "",
+                      oauth_client_name(k8s.namespace(notebook),
+                                        k8s.name(notebook)))
+        log.info("deleted legacy OAuthClient for %s/%s",
+                 k8s.namespace(notebook), k8s.name(notebook))
+    except errors.NotFoundError:
+        pass

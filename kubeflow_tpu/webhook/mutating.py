@@ -249,9 +249,11 @@ class NotebookMutatingWebhook:
     def _inject_cluster_proxy_env(self, nb: dict) -> None:
         """Inject cluster egress-proxy env vars (reference injects
         HTTP_PROXY/HTTPS_PROXY/NO_PROXY from the cluster Proxy config,
-        notebook_mutating_webhook.go:648-697), gated by
-        INJECT_CLUSTER_PROXY_ENV. Source of truth is the cluster-scoped
-        Proxy/cluster object's status; empty fields unset the vars."""
+        notebook_mutating_webhook.go:335-354,648-697), gated by
+        INJECT_CLUSTER_PROXY_ENV. Injection only happens when ALL THREE
+        status fields are populated, and existing env vars are never
+        removed — a missing Proxy object (non-OpenShift cluster) or a
+        transiently empty status must not strip user-supplied proxy env."""
         if not self.config.inject_cluster_proxy_env:
             return  # feature off: user-supplied proxy env is left alone
         container = api.notebook_container(nb)
@@ -259,18 +261,14 @@ class NotebookMutatingWebhook:
             return
         proxy = self.client.get_or_none("Proxy", "", "cluster")
         status = k8s.get_in(proxy or {}, "status", default={}) or {}
-        for env_name, field_ in (("HTTP_PROXY", "httpProxy"),
-                                 ("HTTPS_PROXY", "httpsProxy"),
-                                 ("NO_PROXY", "noProxy")):
-            value = status.get(field_, "")
-            if value:
-                k8s.upsert_env(container, env_name, value)
-                # lowercase duplicates: many CLI tools only read the
-                # lowercase form and the reference injects both
-                k8s.upsert_env(container, env_name.lower(), value)
-            else:
-                k8s.remove_env(container, env_name)
-                k8s.remove_env(container, env_name.lower())
+        values = {env_name: status.get(field_, "")
+                  for env_name, field_ in (("HTTP_PROXY", "httpProxy"),
+                                           ("HTTPS_PROXY", "httpsProxy"),
+                                           ("NO_PROXY", "noProxy"))}
+        if not all(values.values()):
+            return
+        for env_name, value in values.items():
+            k8s.upsert_env(container, env_name, value)
 
     # ------------------------------------------------- sidecar (stage 5)
     def _auth_sidecar_resources(self, nb: dict) -> dict:

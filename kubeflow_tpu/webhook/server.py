@@ -64,7 +64,7 @@ class AdmissionServer:
 
     def __init__(self, mutating, validating, host: str = "0.0.0.0",
                  port: int = 8443, certfile: str | None = None,
-                 keyfile: str | None = None):
+                 keyfile: str | None = None, tls_profile=None):
         self.mutating = mutating
         self.validating = validating
         outer = self
@@ -91,7 +91,13 @@ class AdmissionServer:
         self._server = ThreadingHTTPServer((host, port), Handler)
         if certfile:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
-            ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+            if tls_profile is not None:
+                # cluster TLS security profile (utils.tls_profile; reference
+                # odh main.go:178-234 applies the fetched-or-fallback profile
+                # to every listener)
+                tls_profile.apply(ctx)
+            else:
+                ctx.minimum_version = ssl.TLSVersion.TLSv1_2
             ctx.load_cert_chain(certfile, keyfile)
             self._server.socket = ctx.wrap_socket(self._server.socket,
                                                   server_side=True)

@@ -1,0 +1,161 @@
+"""Platform-integration parity: legacy OAuth cleanup, TLS security profile,
+cache transforms.
+
+Reference coverage models: notebook_oauth.go:29-96 (legacy OAuthClient
+finalizer), odh main.go:178-234/344-367 (TLS profile fetch/fallback/watch),
+odh main_test.go (stripSecretData/stripConfigMapData cache transforms)."""
+
+import ssl
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.cache import (CachingClient, strip_configmap_data,
+                                        strip_secret_data)
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.controllers import oauth, setup_controllers
+from kubeflow_tpu.utils import k8s, names, tls_profile
+
+
+# --------------------------------------------------------- legacy oauth
+
+
+def _legacy_notebook(store, name="old-nb", ns="user"):
+    nb = api.new_notebook(name, ns)
+    nb = store.create(nb)
+    nb["metadata"].setdefault("finalizers", []).append(
+        oauth.LEGACY_OAUTH_FINALIZER)
+    return store.update(nb)
+
+
+def test_legacy_oauth_client_deleted_and_finalizer_stripped():
+    """A notebook born under a pre-auth-proxy controller carries the legacy
+    OAuthClient finalizer; deletion must reap the cluster-scoped OAuthClient
+    and unstick the Notebook (reference notebook_controller.go:214-229)."""
+    store = ClusterStore()
+    mgr = setup_controllers(store)
+    nb = _legacy_notebook(store)
+    store.create({
+        "apiVersion": "oauth.openshift.io/v1", "kind": "OAuthClient",
+        "metadata": {"name": oauth.oauth_client_name("user", "old-nb"),
+                     "namespace": ""},
+    })
+    mgr.run_until_idle()
+    store.delete(api.KIND, "user", "old-nb")
+    mgr.run_until_idle()
+    assert store.get_or_none("OAuthClient", "",
+                             oauth.oauth_client_name("user", "old-nb")) is None
+    assert store.get_or_none(api.KIND, "user", "old-nb") is None
+
+
+def test_legacy_oauth_cleanup_tolerates_absent_client():
+    store = ClusterStore()
+    mgr = setup_controllers(store)
+    _legacy_notebook(store)
+    mgr.run_until_idle()
+    store.delete(api.KIND, "user", "old-nb")
+    mgr.run_until_idle()
+    assert store.get_or_none(api.KIND, "user", "old-nb") is None
+
+
+# ------------------------------------------------------------ tls profile
+
+
+def test_tls_profile_fallback_when_no_apiserver_config():
+    store = ClusterStore()
+    prof = tls_profile.fetch_apiserver_tls_profile(store)
+    assert prof.source == "fallback"
+    assert prof.min_version == "VersionTLS12"
+    assert "ECDHE" in (prof.ciphers or "")
+
+
+def test_tls_profile_parses_presets_and_custom():
+    store = ClusterStore()
+    store.create({
+        "apiVersion": "config.openshift.io/v1", "kind": "APIServer",
+        "metadata": {"name": "cluster", "namespace": ""},
+        "spec": {"tlsSecurityProfile": {"type": "Modern"}},
+    })
+    prof = tls_profile.fetch_apiserver_tls_profile(store)
+    assert prof.min_version == "VersionTLS13"
+    custom = tls_profile.parse_profile({
+        "type": "Custom",
+        "custom": {"minTLSVersion": "VersionTLS13",
+                   "ciphers": ["TLS_AES_256_GCM_SHA384"]}})
+    assert custom.min_version == "VersionTLS13"
+    assert custom.ciphers == "TLS_AES_256_GCM_SHA384"
+
+
+def test_tls_profile_applies_to_ssl_context():
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    tls_profile.hardened_fallback().apply(ctx)
+    assert ctx.minimum_version == ssl.TLSVersion.TLSv1_2
+
+
+def test_security_profile_watcher_fires_once_on_change():
+    """Profile change → restart callback, exactly once (reference cancels
+    the manager ctx, main.go:344-367)."""
+    store = ClusterStore()
+    booted = tls_profile.hardened_fallback()
+    fired = []
+    w = tls_profile.SecurityProfileWatcher(store, booted,
+                                           on_change=lambda: fired.append(1))
+    w.setup()
+    # same-as-booted profile: no fire
+    obj = store.create({
+        "apiVersion": "config.openshift.io/v1", "kind": "APIServer",
+        "metadata": {"name": "cluster", "namespace": ""},
+        "spec": {"tlsSecurityProfile": {"type": "Intermediate"}},
+    })
+    assert fired == []
+    obj["spec"]["tlsSecurityProfile"] = {"type": "Modern"}
+    obj = store.update(obj)
+    assert fired == [1]
+    obj["spec"]["tlsSecurityProfile"] = {"type": "Old"}
+    store.update(obj)
+    assert fired == [1]  # restart already requested; don't double-fire
+
+
+# -------------------------------------------------------- cache transforms
+
+
+def test_strip_transforms_remove_payloads_keep_metadata():
+    secret = {"kind": "Secret", "metadata": {"name": "s"},
+              "data": {"k": "djE="}, "stringData": {"p": "x"}}
+    out = strip_secret_data(secret)
+    assert "data" not in out and "stringData" not in out
+    assert out["metadata"]["name"] == "s"
+    assert secret["data"]  # input not mutated
+    cm = {"kind": "ConfigMap", "metadata": {"name": "c"},
+          "data": {"a": "1"}, "binaryData": {"b": "Yg=="}}
+    out = strip_configmap_data(cm)
+    assert "data" not in out and "binaryData" not in out
+
+
+def test_caching_client_strips_cached_kinds_but_reads_payloads_live():
+    """Secrets/ConfigMaps are in disable_for: get() returns full payloads
+    (live read), while the informer cache for OTHER kinds applies transforms
+    — the exact split of odh main.go:95-125 + 248-268."""
+    store = ClusterStore()
+    client = CachingClient(store)
+    store.create({"apiVersion": "v1", "kind": "Secret",
+                  "metadata": {"name": "s", "namespace": "ns"},
+                  "data": {"k": "djE="}})
+    live = client.get("Secret", "ns", "s")
+    assert live["data"] == {"k": "djE="}  # DisableFor → live, untransformed
+
+    # a kind that IS cached: transforms would apply on ingest
+    client2 = CachingClient(store, disable_for=())
+    cached = client2.get("Secret", "ns", "s")
+    assert "data" not in cached  # stripped in cache
+
+
+def test_caching_client_follows_watch_stream():
+    store = ClusterStore()
+    client = CachingClient(store, disable_for=())
+    nb = store.create(api.new_notebook("w", "ns"))
+    assert client.get(api.KIND, "ns", "w")["metadata"]["name"] == "w"
+    k8s.set_annotation(nb, "x", "1")
+    store.update(nb)
+    assert k8s.get_annotation(client.get(api.KIND, "ns", "w"), "x") == "1"
+    store.delete(api.KIND, "ns", "w")
+    assert client.get_or_none(api.KIND, "ns", "w") is None
+    assert client.list(api.KIND, "ns") == []

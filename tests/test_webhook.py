@@ -244,8 +244,30 @@ def test_cluster_proxy_env_injected_when_enabled():
     env = {e["name"]: e.get("value")
            for e in api.notebook_container(out).get("env", [])}
     assert env["HTTP_PROXY"] == "http://proxy:3128"
-    assert env["https_proxy"] == "https://proxy:3128"
+    assert env["HTTPS_PROXY"] == "https://proxy:3128"
     assert env["NO_PROXY"] == ".cluster.local,.svc"
+
+
+def test_cluster_proxy_env_requires_all_fields_and_never_strips():
+    """Reference injects only when all three status fields are populated and
+    never removes existing env (webhook :335-354) — a missing Proxy object
+    must not break user-supplied proxy settings."""
+    from kubeflow_tpu.cluster.store import ClusterStore
+    store = ClusterStore()
+    store.create({
+        "apiVersion": "config.openshift.io/v1", "kind": "Proxy",
+        "metadata": {"name": "cluster", "namespace": ""},
+        "status": {"httpProxy": "http://proxy:3128"},  # partial status
+    })
+    cfg = ControllerConfig(inject_cluster_proxy_env=True)
+    wh = NotebookMutatingWebhook(store, cfg)
+    nb = api.new_notebook("p", "ns")
+    api.notebook_container(nb)["env"] = [
+        {"name": "NO_PROXY", "value": ".mine"}]
+    out = wh.handle("CREATE", nb, None)
+    env = {e["name"]: e.get("value")
+           for e in api.notebook_container(out).get("env", [])}
+    assert env == {"NO_PROXY": ".mine"}
 
 
 def test_cluster_proxy_env_untouched_when_disabled():
