@@ -1,0 +1,64 @@
+#!/usr/bin/env python3
+"""Regenerate the deployment manifests under config/.
+
+The reference's codegen drift gate (ci/generate_code.sh:1-12) runs
+controller-gen and fails CI when the checked-in YAML differs from the
+generated output. Same contract here:
+
+    python ci/generate_manifests.py            # rewrite config/
+    python ci/generate_manifests.py --check    # exit 1 on drift
+
+tests/test_manifests.py runs the --check logic in pytest so drift fails the
+normal test run too.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from kubeflow_tpu.deploy import generate_all  # noqa: E402
+
+
+def check(root: Path) -> list[str]:
+    generated = generate_all()
+    drifted = []
+    for rel, want in generated.items():
+        path = root / "config" / rel
+        if not path.exists() or path.read_text() != want:
+            drifted.append(str(path.relative_to(root)))
+    # stale files the generator no longer emits must fail the gate too (the
+    # reference's git-diff-based check catches deletions the same way)
+    config_root = root / "config"
+    if config_root.exists():
+        for path in sorted(config_root.rglob("*")):
+            if path.is_file() and \
+                    str(path.relative_to(config_root)) not in generated:
+                drifted.append(f"{path.relative_to(root)} (stale)")
+    return drifted
+
+
+def main() -> int:
+    root = REPO
+    if "--check" in sys.argv:
+        drifted = check(root)
+        if drifted:
+            print("manifest drift (run python ci/generate_manifests.py):")
+            for p in drifted:
+                print(f"  {p}")
+            return 1
+        print("manifests up to date")
+        return 0
+    for rel, text in generate_all().items():
+        path = root / "config" / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(text)
+        print(f"wrote {path.relative_to(root)}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -65,6 +65,10 @@ class CachingClient:
         self.transforms = tuple(transforms)
         self.disable_for = frozenset(disable_for)
         self._cache: dict[tuple[str, str, str], dict] = {}
+        # keys DELETED by the watch stream; guards the backfill (and the
+        # cache-miss fall-through) against resurrecting an object whose
+        # DELETED event raced the list snapshot
+        self._tombstones: set[tuple[str, str, str]] = set()
         self._lock = threading.Lock()
         self._watched: set[str] = set()
 
@@ -81,22 +85,43 @@ class CachingClient:
             self._watched.add(kind)
         # register the watch BEFORE backfilling: an update landing between a
         # list snapshot and watch registration would otherwise never be
-        # delivered, leaving the cache stale forever (ingest is idempotent,
-        # so double-delivery during the overlap is harmless)
+        # delivered, leaving the cache stale forever. The overlap is made
+        # safe by (a) the resourceVersion guard in _ingest (a newer watched
+        # copy is never overwritten by the older snapshot) and (b) the
+        # tombstone set (a DELETED racing the snapshot is not resurrected).
         self.store.watch(kind, self._on_event)
         for obj in self.store.list(kind):
             self._ingest(obj)
 
     def _on_event(self, event: WatchEvent) -> None:
+        key = self._key(event.obj)
         if event.type == "DELETED":
             with self._lock:
-                self._cache.pop(self._key(event.obj), None)
+                self._cache.pop(key, None)
+                self._tombstones.add(key)
         else:
-            self._ingest(event.obj)
+            self._ingest(event.obj, from_watch=True)
 
-    def _ingest(self, obj: dict) -> None:
+    @staticmethod
+    def _rv(obj: dict) -> int:
+        try:
+            return int((obj.get("metadata") or {})
+                       .get("resourceVersion", 0))
+        except (TypeError, ValueError):
+            return 0
+
+    def _ingest(self, obj: dict, from_watch: bool = False) -> None:
+        key = self._key(obj)
         with self._lock:
-            self._cache[self._key(obj)] = self._transform(obj)
+            if from_watch:
+                # an ADDED after DELETED is a genuine recreate
+                self._tombstones.discard(key)
+            elif key in self._tombstones:
+                return  # stale snapshot of a deleted object
+            cached = self._cache.get(key)
+            if cached is not None and self._rv(cached) > self._rv(obj):
+                return  # never replace a newer watched copy with older state
+            self._cache[key] = self._transform(obj)
 
     @staticmethod
     def _key(obj: dict) -> tuple[str, str, str]:
@@ -128,16 +153,16 @@ class CachingClient:
         if kind in self.disable_for:
             return self.store.list(kind, namespace, label_selector)
         self._ensure_informer(kind)
+        # filter first, deepcopy only the matches, and do the copying
+        # outside the lock — list() on a big fleet must not stall ingestion
         with self._lock:
-            objs = [k8s.deepcopy(o) for o in self._cache.values()
-                    if o.get("kind") == kind]
-        if namespace is not None:
-            objs = [o for o in objs if k8s.namespace(o) == namespace]
-        if label_selector:
-            objs = [o for o in objs
-                    if all(k8s.get_label(o, k) == v
-                           for k, v in label_selector.items())]
-        return objs
+            matched = [o for (k, ns, _), o in self._cache.items()
+                       if k == kind
+                       and (namespace is None or ns == namespace)
+                       and (not label_selector
+                            or all(k8s.get_label(o, lk) == lv
+                                   for lk, lv in label_selector.items()))]
+        return [k8s.deepcopy(o) for o in matched]
 
     # ---------------------------------------- writes + watches: passthrough
     def create(self, obj: dict) -> dict:

@@ -1,0 +1,89 @@
+"""Declarative chaos-experiment schema validation.
+
+Reference: chaos/experiments/*.yaml are ChaosExperiment CRs for an external
+chaos operator (pod-kill tier 1 … webhook-disrupt tier 4) against a
+steady-state/recovery model in chaos/knowledge/workbenches.yaml; CI only
+schema-validates them (.github/workflows/operator_chaos_validation.yaml).
+This module is that validator, used by tests/test_chaos_experiments.py (and
+usable from CI directly: ``python -m kubeflow_tpu.cluster.experiments``).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import yaml
+
+EXPERIMENT_KIND = "ChaosExperiment"
+VALID_INJECTIONS = {"PodKill", "NetworkPartition", "WebhookDisrupt",
+                    "RBACRevoke", "DeploymentScaleZero", "SliceWorkerKill"}
+VALID_CHECK_TYPES = {"conditionTrue", "resourceExists", "httpGet",
+                     "sliceAtomic"}
+
+
+def _require(cond: bool, errors: list[str], msg: str) -> None:
+    if not cond:
+        errors.append(msg)
+
+
+def validate_experiment(doc: dict) -> list[str]:
+    """Returns a list of schema violations (empty = valid)."""
+    errors: list[str] = []
+    _require(doc.get("kind") == EXPERIMENT_KIND, errors,
+             f"kind must be {EXPERIMENT_KIND}")
+    _require(bool((doc.get("metadata") or {}).get("name")), errors,
+             "metadata.name required")
+    spec = doc.get("spec") or {}
+    _require(isinstance(spec.get("tier"), int) and 1 <= spec["tier"] <= 4,
+             errors, "spec.tier must be an int in 1..4")
+    target = spec.get("target") or {}
+    for key in ("operator", "component", "resource"):
+        _require(bool(target.get(key)), errors, f"spec.target.{key} required")
+    steady = spec.get("steadyState") or {}
+    _require(bool(steady.get("timeout")), errors,
+             "spec.steadyState.timeout required")
+    checks = steady.get("checks") or []
+    _require(bool(checks), errors, "spec.steadyState.checks must be non-empty")
+    for i, check in enumerate(checks):
+        _require(check.get("type") in VALID_CHECK_TYPES, errors,
+                 f"checks[{i}].type must be one of {sorted(VALID_CHECK_TYPES)}")
+    injection = spec.get("injection") or {}
+    _require(injection.get("type") in VALID_INJECTIONS, errors,
+             f"spec.injection.type must be one of {sorted(VALID_INJECTIONS)}")
+    hypothesis = spec.get("hypothesis") or {}
+    _require(bool(hypothesis.get("description")), errors,
+             "spec.hypothesis.description required")
+    _require(bool(hypothesis.get("recoveryTimeout")), errors,
+             "spec.hypothesis.recoveryTimeout required")
+    blast = spec.get("blastRadius") or {}
+    _require(bool(blast.get("allowedNamespaces")), errors,
+             "spec.blastRadius.allowedNamespaces required")
+    return errors
+
+
+def validate_file(path: str | Path) -> list[str]:
+    errors = []
+    for doc in yaml.safe_load_all(Path(path).read_text()):
+        if doc is None:
+            continue
+        errors.extend(f"{path}: {e}" for e in validate_experiment(doc))
+    return errors
+
+
+def validate_dir(path: str | Path) -> list[str]:
+    errors = []
+    files = sorted(Path(path).glob("*.yaml"))
+    if not files:
+        errors.append(f"{path}: no experiment files found")
+    for f in files:
+        errors.extend(validate_file(f))
+    return errors
+
+
+if __name__ == "__main__":
+    target = sys.argv[1] if len(sys.argv) > 1 else "chaos/experiments"
+    problems = validate_dir(target)
+    for p in problems:
+        print(p)
+    raise SystemExit(1 if problems else 0)

@@ -85,6 +85,8 @@ class Manager:
         self.leader_elector = None
         # optional healthz/readyz+metrics endpoints (reference main.go:125-133)
         self.health_server = None
+        # optional HTTPS admission server (set by main.build_manager)
+        self.webhook_server = None
 
     # ---------------------------------------------------------------- wiring
     def register(self, reconciler: Reconciler) -> None:

@@ -28,7 +28,11 @@ LEGACY_OAUTH_FINALIZER = "notebooks.kubeflow-tpu.org/oauth-client"
 
 
 def oauth_client_name(namespace: str, name: str) -> str:
-    return f"{name}-{namespace}-oauth-client"[:63]
+    # NOT truncated: legacy controllers created the full name (OAuthClient
+    # names may be up to 253 chars) — truncating here would delete the wrong
+    # (nonexistent) object and leak the real one while stripping the
+    # finalizer
+    return f"{name}-{namespace}-oauth-client"
 
 
 def has_legacy_finalizer(notebook: dict) -> bool:

@@ -1,0 +1,5 @@
+from .manifests import (generate_all, notebook_crd, render_kustomize_tree,
+                        write_tree)
+
+__all__ = ["generate_all", "notebook_crd", "render_kustomize_tree",
+           "write_tree"]

@@ -1,0 +1,429 @@
+"""Deployment-manifest generation: CRD + kustomize tree.
+
+The reference ships a generated CRD
+(config/crd/bases/kubeflow.org_notebooks.yaml, 11,650 lines produced by
+controller-gen from the Go types) plus a kustomize layout per controller:
+bases (crd/manager/rbac/webhook), a ``default`` composition, and overlays
+(kubeflow: Istio on; openshift: culler ConfigMap + USE_ISTIO=false +
+ADD_FSGROUP=false; standalone) — notebook-controller/config/* — and for the
+extension controller a ``params.env`` image/flag pinning wired into the
+Deployment through kustomize replacements (odh config/base/kustomization.yaml).
+CI regenerates and diffs to catch drift (ci/generate_code.sh:1-12).
+
+Here the single source of truth is the Python API layer: this module renders
+the CRD schema and every deployment object from the same constants the
+controllers use (api.types, utils.names, utils.config), and
+``ci/generate_manifests.py`` writes the tree under ``config/``; a pytest
+drift check regenerates and compares, replacing the reference's CI shell
+diff. The spec keeps the reference's wire shape — ``spec.template.spec`` is a
+full PodSpec (pruned-but-preserved, x-kubernetes-preserve-unknown-fields) —
+so existing Notebook CRs apply unchanged; TPU topology rides on annotations
+(tpu.kubeflow.org/accelerator, .../topology).
+"""
+
+from __future__ import annotations
+
+import io
+from pathlib import Path
+
+import yaml
+
+from ..api import types as api
+
+MANAGER_IMAGE_PARAM = "kubeflow-tpu-notebook-controller"
+DEFAULT_MANAGER_IMAGE = \
+    "us-docker.pkg.dev/kubeflow-tpu/notebook-controller:latest"
+NAMESPACE = "kubeflow-tpu-system"
+CRD_NAME = f"notebooks.{api.GROUP}"
+
+
+# ----------------------------------------------------------------------- CRD
+
+def _condition_schema() -> dict:
+    return {
+        "type": "object",
+        "properties": {
+            "type": {"type": "string"},
+            "status": {"type": "string"},
+            "reason": {"type": "string"},
+            "message": {"type": "string"},
+            "lastProbeTime": {"type": "string", "format": "date-time"},
+            "lastTransitionTime": {"type": "string", "format": "date-time"},
+        },
+        "required": ["type", "status"],
+    }
+
+
+def _notebook_schema() -> dict:
+    """The storage schema: spec wraps a bare PodSpec template (reference
+    api/v1beta1/notebook_types.go:27-34 — ``Template{Spec corev1.PodSpec}``),
+    which we keep opaque-but-preserved instead of inlining the reference's
+    11k-line expansion; validation beyond structure lives in the validating
+    webhook, where it can say WHY something is rejected."""
+    return {
+        "openAPIV3Schema": {
+            "type": "object",
+            "properties": {
+                "spec": {
+                    "type": "object",
+                    "properties": {
+                        "template": {
+                            "type": "object",
+                            "properties": {
+                                "spec": {
+                                    "type": "object",
+                                    "x-kubernetes-preserve-unknown-fields": True,
+                                },
+                            },
+                        },
+                    },
+                },
+                "status": {
+                    "type": "object",
+                    "properties": {
+                        "conditions": {"type": "array",
+                                       "items": _condition_schema()},
+                        "readyReplicas": {"type": "integer",
+                                          "format": "int32"},
+                        "containerState": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        },
+                    },
+                },
+            },
+        },
+    }
+
+
+def notebook_crd() -> dict:
+    """CustomResourceDefinition with v1 as storage version and served
+    v1beta1/v1alpha1 sharing the identical schema — the reference serves all
+    three with v1 as storage (api/v1/notebook_types.go:67-68)."""
+    versions = []
+    for version, storage in (("v1", True), ("v1beta1", False),
+                             ("v1alpha1", False)):
+        versions.append({
+            "name": version,
+            "served": True,
+            "storage": storage,
+            "schema": _notebook_schema(),
+            "subresources": {"status": {}},
+            "additionalPrinterColumns": [
+                {"name": "Ready", "type": "string",
+                 "jsonPath": ".status.conditions[?(@.type=='SliceReady')].status"},
+                {"name": "Age", "type": "date",
+                 "jsonPath": ".metadata.creationTimestamp"},
+            ],
+        })
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": CRD_NAME},
+        "spec": {
+            "group": api.GROUP,
+            "names": {"kind": api.KIND, "listKind": "NotebookList",
+                      "plural": "notebooks", "singular": "notebook"},
+            "scope": "Namespaced",
+            "versions": versions,
+        },
+    }
+
+
+# ------------------------------------------------------------------- manager
+
+def params_env() -> str:
+    """odh config/base/params.env analog: image + per-feature flags pinned in
+    one file, piped into the Deployment by kustomize replacements."""
+    return (
+        f"{MANAGER_IMAGE_PARAM}={DEFAULT_MANAGER_IMAGE}\n"
+        "tpu-notebook-image=us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest\n"
+        "auth-proxy-image=kube-rbac-proxy:latest\n"
+        "notebook-gateway-name=data-science-gateway\n"
+        "notebook-gateway-namespace=openshift-ingress\n"
+    )
+
+
+def culler_configmap() -> dict:
+    """Culler config ConfigMap (reference
+    notebook-controller/config/manager/manager.yaml:44-57 wires
+    ENABLE_CULLING/CULL_IDLE_TIME/IDLENESS_CHECK_PERIOD from
+    notebook-controller-culler-config)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": {"name": "notebook-controller-culler-config",
+                     "namespace": NAMESPACE},
+        "data": {
+            "ENABLE_CULLING": "false",
+            "CULL_IDLE_TIME": "1440",
+            "IDLENESS_CHECK_PERIOD": "1",
+        },
+    }
+
+
+def manager_deployment() -> dict:
+    env_from_culler = [
+        {"name": var,
+         "valueFrom": {"configMapKeyRef": {
+             "name": "notebook-controller-culler-config", "key": var,
+             "optional": True}}}
+        for var in ("ENABLE_CULLING", "CULL_IDLE_TIME",
+                    "IDLENESS_CHECK_PERIOD")]
+    return {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": "kubeflow-tpu-notebook-controller",
+                     "namespace": NAMESPACE,
+                     "labels": {"app": "kubeflow-tpu-notebook-controller"}},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": {
+                "app": "kubeflow-tpu-notebook-controller"}},
+            "template": {
+                "metadata": {"labels": {
+                    "app": "kubeflow-tpu-notebook-controller"}},
+                "spec": {
+                    "serviceAccountName": "kubeflow-tpu-notebook-controller",
+                    "containers": [{
+                        "name": "manager",
+                        "image": DEFAULT_MANAGER_IMAGE,
+                        "args": ["--leader-elect",
+                                 "--health-probe-bind-address=:8081"],
+                        "env": [
+                            {"name": "K8S_NAMESPACE",
+                             "valueFrom": {"fieldRef": {
+                                 "fieldPath": "metadata.namespace"}}},
+                            *env_from_culler,
+                        ],
+                        "ports": [
+                            {"containerPort": 8443, "name": "webhook",
+                             "protocol": "TCP"},
+                            {"containerPort": 8081, "name": "health",
+                             "protocol": "TCP"},
+                        ],
+                        # reference manager probe shape
+                        # (config/manager/manager.yaml:59-68)
+                        "livenessProbe": {
+                            "httpGet": {"path": "/healthz", "port": 8081},
+                            "initialDelaySeconds": 5, "periodSeconds": 10,
+                        },
+                        "readinessProbe": {
+                            "httpGet": {"path": "/readyz", "port": 8081},
+                            "initialDelaySeconds": 5, "periodSeconds": 10,
+                        },
+                        "resources": {
+                            "requests": {"cpu": "100m", "memory": "128Mi"},
+                            "limits": {"cpu": "500m", "memory": "512Mi"},
+                        },
+                        "volumeMounts": [{
+                            "name": "webhook-certs",
+                            "mountPath": "/etc/webhook/certs",
+                            "readOnly": True}],
+                    }],
+                    "volumes": [{
+                        "name": "webhook-certs",
+                        "secret": {
+                            "secretName": "kubeflow-tpu-webhook-certs"}}],
+                },
+            },
+        },
+    }
+
+
+# ---------------------------------------------------------------------- rbac
+
+def rbac_objects() -> list[dict]:
+    rules = [
+        {"apiGroups": [api.GROUP], "resources": ["notebooks"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch",
+                   "delete"]},
+        {"apiGroups": [api.GROUP], "resources": ["notebooks/status"],
+         "verbs": ["get", "update", "patch"]},
+        {"apiGroups": ["apps"], "resources": ["statefulsets"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch",
+                   "delete"]},
+        {"apiGroups": [""], "resources": ["services", "serviceaccounts",
+                                          "configmaps", "secrets", "pods",
+                                          "events"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch",
+                   "delete"]},
+        {"apiGroups": ["networking.k8s.io"], "resources": ["networkpolicies"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch",
+                   "delete"]},
+        {"apiGroups": ["gateway.networking.k8s.io"],
+         "resources": ["httproutes", "referencegrants"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch",
+                   "delete"]},
+        {"apiGroups": ["rbac.authorization.k8s.io"],
+         "resources": ["rolebindings", "clusterrolebindings"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch",
+                   "delete"]},
+        {"apiGroups": ["coordination.k8s.io"], "resources": ["leases"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch",
+                   "delete"]},
+    ]
+    return [
+        {"apiVersion": "v1", "kind": "ServiceAccount",
+         "metadata": {"name": "kubeflow-tpu-notebook-controller",
+                      "namespace": NAMESPACE}},
+        {"apiVersion": "rbac.authorization.k8s.io/v1", "kind": "ClusterRole",
+         "metadata": {"name": "kubeflow-tpu-notebook-controller"},
+         "rules": rules},
+        {"apiVersion": "rbac.authorization.k8s.io/v1",
+         "kind": "ClusterRoleBinding",
+         "metadata": {"name": "kubeflow-tpu-notebook-controller"},
+         "roleRef": {"apiGroup": "rbac.authorization.k8s.io",
+                     "kind": "ClusterRole",
+                     "name": "kubeflow-tpu-notebook-controller"},
+         "subjects": [{"kind": "ServiceAccount",
+                       "name": "kubeflow-tpu-notebook-controller",
+                       "namespace": NAMESPACE}]},
+    ]
+
+
+# ------------------------------------------------------------------- webhook
+
+def webhook_objects() -> list[dict]:
+    """Webhook Service (serving-cert annotation, odh
+    config/webhook/kustomization.yaml:6-7) + Mutating/Validating
+    configurations with failurePolicy=Fail (admission is a hard gate,
+    notebook_mutating_webhook.go:54)."""
+    service = {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {
+            "name": "kubeflow-tpu-webhook-service",
+            "namespace": NAMESPACE,
+            "annotations": {"service.beta.openshift.io/serving-cert-secret-name":
+                            "kubeflow-tpu-webhook-certs"}},
+        "spec": {
+            "ports": [{"port": 443, "targetPort": 8443,
+                       "protocol": "TCP"}],
+            "selector": {"app": "kubeflow-tpu-notebook-controller"}},
+    }
+    rule = {
+        "apiGroups": [api.GROUP], "apiVersions": ["v1"],
+        "operations": ["CREATE", "UPDATE"], "resources": ["notebooks"]}
+    client_cfg = lambda path: {  # noqa: E731
+        "service": {"name": "kubeflow-tpu-webhook-service",
+                    "namespace": NAMESPACE, "path": path, "port": 443}}
+    mutating = {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "MutatingWebhookConfiguration",
+        "metadata": {
+            "name": "kubeflow-tpu-mutating-webhook",
+            "annotations": {"service.beta.openshift.io/inject-cabundle":
+                            "true"}},
+        "webhooks": [{
+            "name": f"notebooks.{api.GROUP}",
+            "admissionReviewVersions": ["v1"],
+            "sideEffects": "None",
+            "failurePolicy": "Fail",
+            "clientConfig": client_cfg("/mutate-notebook-v1"),
+            "rules": [rule],
+        }],
+    }
+    validating = {
+        "apiVersion": "admissionregistration.k8s.io/v1",
+        "kind": "ValidatingWebhookConfiguration",
+        "metadata": {
+            "name": "kubeflow-tpu-validating-webhook",
+            "annotations": {"service.beta.openshift.io/inject-cabundle":
+                            "true"}},
+        "webhooks": [{
+            "name": f"validating.notebooks.{api.GROUP}",
+            "admissionReviewVersions": ["v1"],
+            "sideEffects": "None",
+            "failurePolicy": "Fail",
+            "clientConfig": client_cfg("/validate-notebook-v1"),
+            "rules": [rule],
+        }],
+    }
+    return [service, mutating, validating]
+
+
+# ----------------------------------------------------------------- kustomize
+
+def _kustomization(resources: list[str], **extra) -> dict:
+    out = {"apiVersion": "kustomize.config.k8s.io/v1beta1",
+           "kind": "Kustomization", "resources": resources}
+    out.update(extra)
+    return out
+
+
+def render_kustomize_tree() -> dict[str, object]:
+    """Full config/ tree as {relative_path: yaml_dict_or_list_or_str}.
+    Mirrors the reference layout: crd/manager/rbac/webhook bases, a default
+    composition, and the three overlays (kubeflow / openshift / standalone,
+    notebook-controller/config/overlays)."""
+    tree: dict[str, object] = {
+        "crd/bases/kubeflow.org_notebooks.yaml": notebook_crd(),
+        "crd/kustomization.yaml":
+            _kustomization(["bases/kubeflow.org_notebooks.yaml"]),
+        "manager/manager.yaml": [manager_deployment(), culler_configmap()],
+        "manager/params.env": params_env(),
+        "manager/kustomization.yaml": _kustomization(
+            ["manager.yaml"],
+            configMapGenerator=[{
+                "name": "kubeflow-tpu-params",
+                "envs": ["params.env"],
+                "options": {"disableNameSuffixHash": True}}]),
+        "rbac/rbac.yaml": rbac_objects(),
+        "rbac/kustomization.yaml": _kustomization(["rbac.yaml"]),
+        "webhook/webhook.yaml": webhook_objects(),
+        "webhook/kustomization.yaml": _kustomization(["webhook.yaml"]),
+        "default/kustomization.yaml": _kustomization(
+            ["../crd", "../rbac", "../manager", "../webhook"],
+            namespace=NAMESPACE),
+        # overlays — feature flags via env patches, as the reference does
+        # with its openshift/kubeflow/standalone overlays
+        "overlays/gke/kustomization.yaml": _kustomization(
+            ["../../default"],
+            patches=[{"patch": yaml.safe_dump([
+                {"op": "add",
+                 "path": "/spec/template/spec/containers/0/env/-",
+                 "value": {"name": "ADD_FSGROUP", "value": "false"}},
+            ], sort_keys=False),
+                "target": {"kind": "Deployment",
+                           "name": "kubeflow-tpu-notebook-controller"}}]),
+        "overlays/culling/kustomization.yaml": _kustomization(
+            ["../../default"],
+            patches=[{"patch": yaml.safe_dump([
+                {"op": "replace", "path": "/data/ENABLE_CULLING",
+                 "value": "true"},
+            ], sort_keys=False),
+                "target": {"kind": "ConfigMap",
+                           "name": "notebook-controller-culler-config"}}]),
+        "overlays/standalone/kustomization.yaml": _kustomization(
+            ["../../default"]),
+    }
+    return tree
+
+
+GENERATED_HEADER = ("# GENERATED by ci/generate_manifests.py — do not edit.\n"
+                    "# Source of truth: kubeflow_tpu/deploy/manifests.py\n")
+
+
+def _dump(content: object) -> str:
+    if isinstance(content, str):
+        return content
+    buf = io.StringIO()
+    docs = content if isinstance(content, list) else [content]
+    yaml.safe_dump_all(docs, buf, sort_keys=False, default_flow_style=False)
+    return GENERATED_HEADER + buf.getvalue()
+
+
+def generate_all() -> dict[str, str]:
+    return {path: _dump(content)
+            for path, content in render_kustomize_tree().items()}
+
+
+def write_tree(root: str | Path) -> list[Path]:
+    root = Path(root)
+    written = []
+    for rel, text in generate_all().items():
+        path = root / rel
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_text(text)
+        written.append(path)
+    return written

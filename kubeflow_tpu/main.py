@@ -1,0 +1,133 @@
+"""Manager entrypoint — the analog of the reference's two main.go binaries.
+
+Reference wiring being reproduced (notebook-controller/main.go:48-148 + odh
+main.go:141-374):
+
+- flag parsing (health-probe addr, webhook port/cert-dir, leader election,
+  debug log) + env config (ControllerConfig.from_env);
+- bootstrap TLS-profile fetch with hardened fallback, applied to the webhook
+  listener; SecurityProfileWatcher triggers graceful shutdown on change so
+  the process restarts with the new profile (odh main.go:178-234,344-367);
+- manager cache with Secret/ConfigMap data stripped + live reads for those
+  kinds (odh main.go:95-125,248-268) — our CachingClient;
+- reconcilers + admission webhooks registered on one manager, healthz/readyz
+  endpoints, optional leader election.
+
+``build_manager`` is the composition root (importable, used by e2e tests —
+the production path IS the tested path); ``main()`` adds flags/signals. The
+client defaults to the in-process ClusterStore (the framework's apiserver);
+a standalone run with ``--simulate-kubelet`` is a full working control plane
+on one machine.
+
+Run:  python -m kubeflow_tpu.main --simulate-kubelet --health-port 8081
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+from .cluster.cache import CachingClient
+from .cluster.store import ClusterStore
+from .controllers import setup_controllers
+from .utils import tls_profile
+from .utils.config import ControllerConfig
+from .webhook.server import AdmissionServer
+
+log = logging.getLogger("kubeflow_tpu.main")
+
+
+def build_manager(store=None, config: ControllerConfig | None = None, *,
+                  leader_elect: bool = False, health_port: int | None = None,
+                  webhook_port: int | None = None,
+                  cert_dir: str | None = None,
+                  simulate_kubelet: bool = False,
+                  on_tls_change=None):
+    """Compose the full production stack; returns (manager, shutdown_event).
+
+    The returned manager's client is the read-cached view (Secret/ConfigMap
+    payloads never cached); admission plugins and the optional HTTPS webhook
+    server share one handler path. ``on_tls_change`` defaults to setting the
+    shutdown event — the caller exits and the supervisor restarts the
+    process with the new cluster TLS profile.
+    """
+    store = store if store is not None else ClusterStore()
+    config = config or ControllerConfig.from_env()
+    client = CachingClient(store)
+    shutdown = threading.Event()
+
+    mgr = setup_controllers(client, config, leader_elect=leader_elect,
+                            health_port=health_port)
+
+    profile = tls_profile.fetch_apiserver_tls_profile(client)
+    watcher = tls_profile.SecurityProfileWatcher(
+        client, profile,
+        on_change=on_tls_change or shutdown.set)
+    watcher.setup()
+
+    if webhook_port is not None:
+        certfile = f"{cert_dir}/tls.crt" if cert_dir else None
+        keyfile = f"{cert_dir}/tls.key" if cert_dir else None
+        # same webhook objects the in-process admission plugins use — one
+        # code path for cluster (HTTPS) and standalone (in-process) modes
+        from .webhook import (NotebookMutatingWebhook,
+                              NotebookValidatingWebhook)
+        mgr.webhook_server = AdmissionServer(
+            NotebookMutatingWebhook(client, config),
+            NotebookValidatingWebhook(config),
+            port=webhook_port, certfile=certfile, keyfile=keyfile,
+            tls_profile=profile)
+        if mgr.health_server is not None:
+            mgr.health_server.add_readyz_check(
+                "webhook", lambda: mgr.webhook_server is not None)
+
+    if simulate_kubelet:
+        from .cluster.kubelet import StatefulSetSimulator
+        StatefulSetSimulator(store).setup(mgr)
+
+    return mgr, shutdown
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--leader-elect", action="store_true",
+                    help="enable Lease-based leader election")
+    ap.add_argument("--health-port", type=int, default=8081,
+                    help="healthz/readyz/metrics port (0 disables)")
+    ap.add_argument("--webhook-port", type=int, default=8443)
+    ap.add_argument("--cert-dir", default=None,
+                    help="dir with tls.crt/tls.key for the webhook server "
+                         "(absent → plain HTTP, dev only)")
+    ap.add_argument("--simulate-kubelet", action="store_true",
+                    help="run the StatefulSet/pod simulator (standalone)")
+    ap.add_argument("--debug-log", action="store_true")
+    args = ap.parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.debug_log else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+
+    mgr, shutdown = build_manager(
+        leader_elect=args.leader_elect,
+        health_port=args.health_port or None,
+        webhook_port=args.webhook_port or None,
+        cert_dir=args.cert_dir,
+        simulate_kubelet=args.simulate_kubelet)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: shutdown.set())
+    if getattr(mgr, "webhook_server", None) is not None:
+        mgr.webhook_server.start()
+    mgr.start()
+    log.info("manager started (leader_elect=%s)", args.leader_elect)
+    shutdown.wait()
+    log.info("shutting down")
+    if getattr(mgr, "webhook_server", None) is not None:
+        mgr.webhook_server.stop()
+    mgr.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -115,6 +115,19 @@ class SecurityProfileWatcher:
 
     def setup(self) -> None:
         self.client.watch(APISERVER_KIND, self._handle)
+        # the watch delivers no initial state (store.watch registers a
+        # callback only), so self-correct immediately: if boot fetched the
+        # fallback because of a transient error while the cluster actually
+        # pins a different profile, fire now rather than waiting for the
+        # next write to APIServer/cluster
+        current = fetch_apiserver_tls_profile(self.client)
+        if (current.min_version, current.ciphers) != (
+                self.booted.min_version, self.booted.ciphers):
+            log.warning("booted TLS profile (%s) does not match cluster "
+                        "profile (%s); requesting restart",
+                        self.booted.source, current.source)
+            self._fired.set()
+            self.on_change()
 
     def _handle(self, event) -> None:
         if self._fired.is_set():

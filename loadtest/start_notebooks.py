@@ -1,0 +1,138 @@
+#!/usr/bin/env python3
+"""Notebook fan-out load test.
+
+Reference: notebook-controller/loadtest/start_notebooks.py:1-99 templates N
+Notebook CRs (+ PVC each) and applies them with kubectl, as a manual
+scalability probe. Two modes here:
+
+- default (self-contained): drive the in-process control plane — apiserver,
+  webhooks, both reconcilers, StatefulSet simulator — with N TPU notebooks
+  and report creation→SliceReady latency percentiles and reconcile
+  throughput. This is the control-plane scalability measurement the
+  reference's script only eyeballs via kubectl.
+- ``--emit-yaml``: print N templated Notebook CRs (with PVCs, like the
+  reference's jupyter_test.yaml shape) for kubectl-apply against a real
+  cluster.
+
+Usage:
+    python loadtest/start_notebooks.py --count 200
+    python loadtest/start_notebooks.py --count 10 --emit-yaml | kubectl apply -f -
+"""
+
+from __future__ import annotations
+
+import argparse
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def notebook_yaml(i: int, namespace: str, accelerator: str) -> str:
+    return f"""---
+apiVersion: v1
+kind: PersistentVolumeClaim
+metadata:
+  name: loadtest-nb-{i}-pvc
+  namespace: {namespace}
+spec:
+  accessModes: [ReadWriteOnce]
+  resources:
+    requests:
+      storage: 10Gi
+---
+apiVersion: kubeflow.org/v1
+kind: Notebook
+metadata:
+  name: loadtest-nb-{i}
+  namespace: {namespace}
+  annotations:
+    tpu.kubeflow.org/accelerator: "{accelerator}"
+spec:
+  template:
+    spec:
+      containers:
+      - name: loadtest-nb-{i}
+        image: jupyter-minimal:latest
+        volumeMounts:
+        - name: workspace
+          mountPath: /home/jovyan
+      volumes:
+      - name: workspace
+        persistentVolumeClaim:
+          claimName: loadtest-nb-{i}-pvc
+"""
+
+
+def run_inprocess(count: int, namespace: str, accelerator: str,
+                  timeout: float) -> int:
+    from kubeflow_tpu.api import types as api
+    from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
+    from kubeflow_tpu.cluster.store import ClusterStore
+    from kubeflow_tpu.controllers import setup_controllers
+    from kubeflow_tpu.utils import names
+
+    store = ClusterStore()
+    mgr = setup_controllers(store)
+    StatefulSetSimulator(store, boot_delay_s=0.0).setup(mgr)
+    mgr.start()
+    created: dict[str, float] = {}
+    ready: dict[str, float] = {}
+    t0 = time.monotonic()
+    for i in range(count):
+        name = f"loadtest-nb-{i}"
+        store.create(api.new_notebook(
+            name, namespace,
+            annotations={names.TPU_ACCELERATOR_ANNOTATION: accelerator}))
+        created[name] = time.monotonic()
+    deadline = time.monotonic() + timeout
+    while len(ready) < count and time.monotonic() < deadline:
+        for name in list(created):
+            if name in ready:
+                continue
+            nb = store.get_or_none(api.KIND, namespace, name)
+            cond = api.get_condition(nb, api.CONDITION_SLICE_READY) \
+                if nb else None
+            if cond and cond["status"] == "True":
+                ready[name] = time.monotonic() - created[name]
+        time.sleep(0.01)
+    total = time.monotonic() - t0
+    mgr.stop()
+    if len(ready) < count:
+        print(f"FAIL: only {len(ready)}/{count} notebooks became SliceReady "
+              f"within {timeout}s")
+        return 1
+    lat = sorted(ready.values())
+    print(f"notebooks: {count}  wall: {total:.2f}s  "
+          f"throughput: {count/total:.1f} nb/s")
+    print(f"create→SliceReady  p50: {statistics.median(lat)*1000:.1f}ms  "
+          f"p95: {lat[int(0.95*(len(lat)-1))]*1000:.1f}ms  "
+          f"max: {lat[-1]*1000:.1f}ms")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--count", type=int, default=50)
+    ap.add_argument("--namespace", default="loadtest")
+    ap.add_argument("--accelerator", default="v5e-4")
+    ap.add_argument("--timeout", type=float, default=120.0)
+    ap.add_argument("--emit-yaml", action="store_true",
+                    help="print CRs for kubectl instead of running in-process")
+    args = ap.parse_args()
+    if args.emit_yaml:
+        try:
+            for i in range(args.count):
+                sys.stdout.write(
+                    notebook_yaml(i, args.namespace, args.accelerator))
+        except BrokenPipeError:
+            pass  # downstream consumer (head, kubectl) closed the pipe
+        return 0
+    return run_inprocess(args.count, args.namespace, args.accelerator,
+                         args.timeout)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,44 @@
+"""Declarative chaos experiments: schema gate.
+
+Mirrors the reference CI's operator_chaos_validation workflow, which
+schema-validates chaos/experiments/*.yaml without running them."""
+
+from pathlib import Path
+
+import yaml
+
+from kubeflow_tpu.cluster.experiments import (validate_dir,
+                                              validate_experiment)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_all_checked_in_experiments_valid():
+    assert validate_dir(REPO / "chaos" / "experiments") == []
+
+
+def test_expected_experiment_set_present():
+    names = {p.stem for p in (REPO / "chaos" / "experiments").glob("*.yaml")}
+    # the reference's five experiment classes + the TPU-native slice one
+    assert {"pod-kill", "network-partition", "webhook-disrupt",
+            "rbac-revoke", "deployment-scale-zero",
+            "slice-worker-kill"} <= names
+
+
+def test_validator_rejects_bad_experiments():
+    bad = {"kind": "ChaosExperiment", "metadata": {"name": "x"},
+           "spec": {"tier": 9, "injection": {"type": "Nuke"}}}
+    errors = validate_experiment(bad)
+    assert any("tier" in e for e in errors)
+    assert any("injection.type" in e for e in errors)
+    assert any("steadyState" in e for e in errors)
+
+
+def test_knowledge_model_declares_tpu_invariants():
+    doc = yaml.safe_load(
+        (REPO / "chaos" / "knowledge" / "workbenches.yaml").read_text())
+    invariants = {i["name"]
+                  for i in doc["components"][0]["invariants"]}
+    assert {"slice-atomicity", "stable-worker-identity"} <= invariants
+    hooks = {w["path"] for w in doc["components"][0]["webhooks"]}
+    assert hooks == {"/mutate-notebook-v1", "/validate-notebook-v1"}
