@@ -24,6 +24,7 @@ reference added the transforms.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Iterable
 
 from ..utils import k8s
@@ -65,10 +66,13 @@ class CachingClient:
         self.transforms = tuple(transforms)
         self.disable_for = frozenset(disable_for)
         self._cache: dict[tuple[str, str, str], dict] = {}
-        # keys DELETED by the watch stream; guards the backfill (and the
-        # cache-miss fall-through) against resurrecting an object whose
-        # DELETED event raced the list snapshot
-        self._tombstones: set[tuple[str, str, str]] = set()
+        # key → deletion time for keys DELETED by the watch stream; guards
+        # the backfill (and the cache-miss fall-through) against resurrecting
+        # an object whose DELETED event raced the list/get. The race window
+        # is milliseconds, so entries expire after TOMBSTONE_TTL_S — without
+        # the TTL this set would grow with every deletion for the process
+        # lifetime
+        self._tombstones: dict[tuple[str, str, str], float] = {}
         self._lock = threading.Lock()
         self._watched: set[str] = set()
 
@@ -93,12 +97,21 @@ class CachingClient:
         for obj in self.store.list(kind):
             self._ingest(obj)
 
+    TOMBSTONE_TTL_S = 10.0
+
+    def _prune_tombstones_locked(self) -> None:
+        cutoff = time.monotonic() - self.TOMBSTONE_TTL_S
+        stale = [k for k, t in self._tombstones.items() if t < cutoff]
+        for k in stale:
+            del self._tombstones[k]
+
     def _on_event(self, event: WatchEvent) -> None:
         key = self._key(event.obj)
         if event.type == "DELETED":
             with self._lock:
                 self._cache.pop(key, None)
-                self._tombstones.add(key)
+                self._prune_tombstones_locked()
+                self._tombstones[key] = time.monotonic()
         else:
             self._ingest(event.obj, from_watch=True)
 
@@ -115,8 +128,9 @@ class CachingClient:
         with self._lock:
             if from_watch:
                 # an ADDED after DELETED is a genuine recreate
-                self._tombstones.discard(key)
-            elif key in self._tombstones:
+                self._tombstones.pop(key, None)
+            elif self._tombstones.get(key, 0) > \
+                    time.monotonic() - self.TOMBSTONE_TTL_S:
                 return  # stale snapshot of a deleted object
             cached = self._cache.get(key)
             if cached is not None and self._rv(cached) > self._rv(obj):

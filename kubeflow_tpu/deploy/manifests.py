@@ -188,8 +188,13 @@ def manager_deployment() -> dict:
                     "containers": [{
                         "name": "manager",
                         "image": DEFAULT_MANAGER_IMAGE,
+                        # flags must exist in kubeflow_tpu/main.py argparse —
+                        # tests/test_manifests.py parses them against it
+                        "command": ["python", "-m", "kubeflow_tpu.main"],
                         "args": ["--leader-elect",
-                                 "--health-probe-bind-address=:8081"],
+                                 "--health-port", "8081",
+                                 "--webhook-port", "8443",
+                                 "--cert-dir", "/etc/webhook/certs"],
                         "env": [
                             {"name": "K8S_NAMESPACE",
                              "valueFrom": {"fieldRef": {
@@ -217,6 +222,8 @@ def manager_deployment() -> dict:
                             "limits": {"cpu": "500m", "memory": "512Mi"},
                         },
                         "volumeMounts": [{
+                            # --cert-dir above: serving cert materialized by
+                            # the cluster cert machinery into this secret
                             "name": "webhook-certs",
                             "mountPath": "/etc/webhook/certs",
                             "readOnly": True}],
@@ -228,6 +235,21 @@ def manager_deployment() -> dict:
                 },
             },
         },
+    }
+
+
+def manager_health_service() -> dict:
+    """Health/metrics Service: Prometheus scrape target and the endpoint the
+    chaos experiments' readyz steady-state checks probe."""
+    return {
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "kubeflow-tpu-notebook-controller",
+                     "namespace": NAMESPACE,
+                     "labels": {"app": "kubeflow-tpu-notebook-controller"}},
+        "spec": {
+            "ports": [{"name": "health", "port": 8081,
+                       "targetPort": 8081, "protocol": "TCP"}],
+            "selector": {"app": "kubeflow-tpu-notebook-controller"}},
     }
 
 
@@ -360,7 +382,8 @@ def render_kustomize_tree() -> dict[str, object]:
         "crd/bases/kubeflow.org_notebooks.yaml": notebook_crd(),
         "crd/kustomization.yaml":
             _kustomization(["bases/kubeflow.org_notebooks.yaml"]),
-        "manager/manager.yaml": [manager_deployment(), culler_configmap()],
+        "manager/manager.yaml": [manager_deployment(), culler_configmap(),
+                                 manager_health_service()],
         "manager/params.env": params_env(),
         "manager/kustomization.yaml": _kustomization(
             ["manager.yaml"],
@@ -374,7 +397,21 @@ def render_kustomize_tree() -> dict[str, object]:
         "webhook/kustomization.yaml": _kustomization(["webhook.yaml"]),
         "default/kustomization.yaml": _kustomization(
             ["../crd", "../rbac", "../manager", "../webhook"],
-            namespace=NAMESPACE),
+            namespace=NAMESPACE,
+            # pipe params.env values into the Deployment (the odh
+            # config/base/kustomization.yaml replacements pattern) — without
+            # this the params file would be dead config
+            replacements=[{
+                "source": {"kind": "ConfigMap",
+                           "name": "kubeflow-tpu-params",
+                           "fieldPath": f"data.{MANAGER_IMAGE_PARAM}"},
+                "targets": [{
+                    "select": {"kind": "Deployment",
+                               "name": "kubeflow-tpu-notebook-controller"},
+                    "fieldPaths": [
+                        "spec.template.spec.containers.0.image"],
+                }],
+            }]),
         # overlays — feature flags via env patches, as the reference does
         # with its openshift/kubeflow/standalone overlays
         "overlays/gke/kustomization.yaml": _kustomization(

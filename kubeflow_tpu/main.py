@@ -81,7 +81,7 @@ def build_manager(store=None, config: ControllerConfig | None = None, *,
             tls_profile=profile)
         if mgr.health_server is not None:
             mgr.health_server.add_readyz_check(
-                "webhook", lambda: mgr.webhook_server is not None)
+                "webhook", lambda: mgr.webhook_server.is_serving())
 
     if simulate_kubelet:
         from .cluster.kubelet import StatefulSetSimulator
@@ -90,7 +90,7 @@ def build_manager(store=None, config: ControllerConfig | None = None, *,
     return mgr, shutdown
 
 
-def main(argv=None) -> int:
+def build_arg_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--leader-elect", action="store_true",
                     help="enable Lease-based leader election")
@@ -103,7 +103,11 @@ def main(argv=None) -> int:
     ap.add_argument("--simulate-kubelet", action="store_true",
                     help="run the StatefulSet/pod simulator (standalone)")
     ap.add_argument("--debug-log", action="store_true")
-    args = ap.parse_args(argv)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
     logging.basicConfig(
         level=logging.DEBUG if args.debug_log else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s %(message)s")

@@ -107,6 +107,11 @@ class AdmissionServer:
     def port(self) -> int:
         return self._server.server_address[1]
 
+    def is_serving(self) -> bool:
+        """True while the accept loop is actually running — readiness probes
+        must reflect a dead listener, not mere construction."""
+        return self._thread is not None and self._thread.is_alive()
+
     # ------------------------------------------------------------- review
     def review(self, path: str, review: dict) -> dict:
         request = review["request"]

@@ -79,3 +79,33 @@ def test_checked_in_manifests_match_generated():
         [sys.executable, str(REPO / "ci" / "generate_manifests.py"),
          "--check"], capture_output=True, text=True)
     assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_deployment_args_parse_against_entrypoint():
+    """The generated Deployment's command/args must be accepted by the REAL
+    kubeflow_tpu.main argparse — a flag mismatch means CrashLoopBackOff in
+    every cluster deployment."""
+    from kubeflow_tpu.main import build_arg_parser
+    dep = manager_deployment()
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"] == ["python", "-m", "kubeflow_tpu.main"]
+    parsed = build_arg_parser().parse_args(c["args"])  # SystemExit on mismatch
+    assert parsed.cert_dir == "/etc/webhook/certs"
+    assert parsed.leader_elect
+    assert parsed.health_port == 8081
+    assert parsed.webhook_port == 8443
+
+
+def test_params_env_replacement_targets_exist():
+    """The kustomize replacement must reference a real params key and the
+    real Deployment container path (dead-config guard)."""
+    from kubeflow_tpu.deploy.manifests import (MANAGER_IMAGE_PARAM,
+                                               params_env,
+                                               render_kustomize_tree)
+    tree = render_kustomize_tree()
+    kust = tree["default/kustomization.yaml"]
+    (repl,) = kust["replacements"]
+    assert repl["source"]["fieldPath"] == f"data.{MANAGER_IMAGE_PARAM}"
+    assert MANAGER_IMAGE_PARAM in params_env()
+    dep = manager_deployment()
+    assert dep["spec"]["template"]["spec"]["containers"][0]["image"]
