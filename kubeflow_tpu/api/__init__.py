@@ -1,5 +1,9 @@
-from .types import (API_VERSION, GROUP, KIND, install_notebook_crd,
-                    new_notebook, notebook_container, validate_notebook)
+from .types import (API_VERSION, GROUP, KIND, SERVED_VERSIONS,
+                    STORAGE_VERSION, convert_notebook, install_notebook_crd,
+                    new_notebook, notebook_container, parse_version,
+                    validate_notebook)
 
-__all__ = ["API_VERSION", "GROUP", "KIND", "install_notebook_crd",
-           "new_notebook", "notebook_container", "validate_notebook"]
+__all__ = ["API_VERSION", "GROUP", "KIND", "SERVED_VERSIONS",
+           "STORAGE_VERSION", "convert_notebook", "install_notebook_crd",
+           "new_notebook", "notebook_container", "parse_version",
+           "validate_notebook"]

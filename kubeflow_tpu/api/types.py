@@ -24,6 +24,14 @@ VERSION = "v1"
 API_VERSION = f"{GROUP}/{VERSION}"
 KIND = "Notebook"
 
+# Served versions. The reference registers three schemes (v1, v1beta1,
+# v1alpha1 — notebook-controller/main.go:48-56) over structurally identical
+# types; v1 is the storage version (api/v1/notebook_types.go:67-68). Because
+# the schemas are identical, conversion is an apiVersion rewrite (the
+# reference needs no conversion webhook either).
+SERVED_VERSIONS = ("v1", "v1beta1", "v1alpha1")
+STORAGE_VERSION = VERSION
+
 # Condition types mirrored into status from the pod (reference
 # notebook_controller.go:299-374 mirrors pod conditions verbatim).
 CONDITION_RUNNING = "Running"
@@ -81,13 +89,40 @@ def notebook_container(notebook: dict) -> dict | None:
                                        k8s.name(notebook))
 
 
+def parse_version(notebook: dict) -> str:
+    """The CR's version ("v1"), validated against the served set."""
+    api_version = notebook.get("apiVersion") or ""
+    group, _, version = api_version.partition("/")
+    if group != GROUP or version not in SERVED_VERSIONS:
+        served = ", ".join(f"{GROUP}/{v}" for v in SERVED_VERSIONS)
+        raise InvalidError(f"apiVersion must be one of: {served}")
+    return version
+
+
+def convert_notebook(notebook: dict, to_version: str = STORAGE_VERSION) -> dict:
+    """Convert a Notebook between served versions. The hub-and-spoke
+    conversion the apiserver would perform; with identical schemas this is an
+    apiVersion rewrite (returns the same object if already at to_version)."""
+    parse_version(notebook)
+    if to_version not in SERVED_VERSIONS:
+        raise InvalidError(f"unknown version {to_version!r}")
+    target = f"{GROUP}/{to_version}"
+    if notebook.get("apiVersion") == target:
+        return notebook
+    converted = k8s.deepcopy(notebook)
+    converted["apiVersion"] = target
+    return converted
+
+
 def validate_notebook(notebook: dict) -> None:
     """Structural validation the CRD schema would enforce."""
     if k8s.kind(notebook) != KIND:
         raise InvalidError(f"kind must be {KIND}")
-    if notebook.get("apiVersion") != API_VERSION:
-        raise InvalidError(f"apiVersion must be {API_VERSION}")
-    if not k8s.name(notebook):
+    parse_version(notebook)
+    md = k8s.meta(notebook)
+    # admission runs before the apiserver expands generateName, so an empty
+    # name is valid when generateName is set (as on a real apiserver)
+    if not md.get("name") and not md.get("generateName"):
         raise InvalidError("metadata.name required")
     containers = notebook_pod_spec(notebook).get("containers")
     if not containers:
@@ -105,6 +140,9 @@ def install_notebook_crd(store) -> None:
     def admit(operation, obj, old):
         if operation in ("CREATE", "UPDATE"):
             validate_notebook(obj)
+            # the apiserver persists at the storage version regardless of the
+            # served version the client wrote (api/v1/notebook_types.go:67-68)
+            obj = convert_notebook(obj, STORAGE_VERSION)
         return obj
     store.register_admission(KIND, admit)
 

@@ -101,12 +101,11 @@ def notebook_crd() -> dict:
     v1beta1/v1alpha1 sharing the identical schema — the reference serves all
     three with v1 as storage (api/v1/notebook_types.go:67-68)."""
     versions = []
-    for version, storage in (("v1", True), ("v1beta1", False),
-                             ("v1alpha1", False)):
+    for version in api.SERVED_VERSIONS:
         versions.append({
             "name": version,
             "served": True,
-            "storage": storage,
+            "storage": version == api.STORAGE_VERSION,
             "schema": _notebook_schema(),
             "subresources": {"status": {}},
             "additionalPrinterColumns": [
