@@ -65,6 +65,8 @@ class NotebookReconciler:
         mgr.watch("StatefulSet", self.name, mapper=owner_mapper(api.KIND))
         mgr.watch("Service", self.name, mapper=owner_mapper(api.KIND))
         mgr.watch("Pod", self.name, mapper=label_mapper(names.NOTEBOOK_NAME_LABEL))
+        if self.config.use_istio:
+            mgr.watch("VirtualService", self.name, mapper=owner_mapper(api.KIND))
 
     def _scrape_running(self) -> None:
         """notebook_running is computed at scrape time by listing STSs with
@@ -93,6 +95,8 @@ class NotebookReconciler:
         self._reconcile_service(notebook, slice_spec)
         if slice_spec is not None and slice_spec.multi_host:
             self._reconcile_headless_service(notebook, slice_spec)
+        if self.config.use_istio:
+            self._reconcile_virtual_service(notebook)
         self._handle_restart_annotation(notebook, slice_spec)
         self._update_status(notebook, slice_spec)
         return None
@@ -332,24 +336,12 @@ class NotebookReconciler:
         if copy_statefulset_fields(desired, found):
             self.client.update(found)
 
-    def _reconcile_service(self, notebook: dict,
-                           slice_spec: SliceSpec | None) -> None:
-        desired = self.generate_service(notebook)
-        found = self.client.get_or_none("Service", k8s.namespace(notebook),
-                                        k8s.name(notebook))
-        if found is None:
-            try:
-                self.client.create(desired)
-            except errors.AlreadyExistsError:
-                pass
-            return
-        if copy_service_fields(desired, found):
-            self.client.update(found)
-
-    def _reconcile_headless_service(self, notebook: dict,
-                                    slice_spec: SliceSpec) -> None:
-        desired = self.generate_headless_service(notebook, slice_spec)
-        found = self.client.get_or_none("Service", k8s.namespace(notebook),
+    def _create_or_update(self, desired: dict, copy_fields) -> None:
+        """Create-or-idempotent-update for a named desired object: swallow
+        the create race (another worker got there first; the watch re-enqueues)
+        and only update when copy_fields reports drift."""
+        found = self.client.get_or_none(k8s.kind(desired),
+                                        k8s.namespace(desired),
                                         k8s.name(desired))
         if found is None:
             try:
@@ -357,8 +349,57 @@ class NotebookReconciler:
             except errors.AlreadyExistsError:
                 pass
             return
-        if copy_service_fields(desired, found):
+        if copy_fields(desired, found):
             self.client.update(found)
+
+    def _reconcile_service(self, notebook: dict,
+                           slice_spec: SliceSpec | None) -> None:
+        self._create_or_update(self.generate_service(notebook),
+                               copy_service_fields)
+
+    def _reconcile_headless_service(self, notebook: dict,
+                                    slice_spec: SliceSpec) -> None:
+        self._create_or_update(
+            self.generate_headless_service(notebook, slice_spec),
+            copy_service_fields)
+
+    def generate_virtual_service(self, notebook: dict) -> dict:
+        """Istio VirtualService routing ``/notebook/<ns>/<name>/`` through the
+        cluster gateway to the notebook Service (reference
+        generateVirtualService, notebook_controller.go:558-658): host/gateway
+        from ISTIO_HOST/ISTIO_GATEWAY, rewrite to the same prefix, destination
+        ``<name>.<ns>.svc.<cluster-domain>`` port 80."""
+        nb_name = k8s.name(notebook)
+        ns = k8s.namespace(notebook)
+        prefix = names.nb_prefix(ns, nb_name) + "/"
+        vs = {
+            "apiVersion": "networking.istio.io/v1alpha3",
+            "kind": "VirtualService",
+            "metadata": {
+                "name": virtual_service_name(nb_name, ns),
+                "namespace": ns,
+                "labels": {names.NOTEBOOK_NAME_LABEL: nb_name},
+            },
+            "spec": {
+                "hosts": [self.config.istio_host],
+                "gateways": [self.config.istio_gateway],
+                "http": [{
+                    "match": [{"uri": {"prefix": prefix}}],
+                    "rewrite": {"uri": prefix},
+                    "route": [{"destination": {
+                        "host": f"{nb_name}.{ns}.svc.{self.config.cluster_domain}",
+                        "port": {"number": DEFAULT_SERVICE_PORT},
+                    }}],
+                    "timeout": "300s",
+                }],
+            },
+        }
+        k8s.set_controller_reference(notebook, vs)
+        return vs
+
+    def _reconcile_virtual_service(self, notebook: dict) -> None:
+        self._create_or_update(self.generate_virtual_service(notebook),
+                               copy_virtual_service_fields)
 
     # ------------------------------------------------------------ restart
     def _handle_restart_annotation(self, notebook: dict,
@@ -432,6 +473,13 @@ def headless_service_name(notebook_name: str) -> str:
     return f"{notebook_name}-workers"[: 63]
 
 
+def virtual_service_name(notebook_name: str, namespace: str) -> str:
+    """``notebook-<ns>-<name>`` (reference virtualServiceName helper). No
+    truncation: VirtualService is not a DNS label, so the 253-char object-name
+    limit applies and truncating at 63 could collide two notebooks."""
+    return f"notebook-{namespace}-{notebook_name}"
+
+
 # -------------------------------------------------------------- copy-fields
 def copy_statefulset_fields(desired: dict, found: dict) -> bool:
     """Idempotent-update semantics of reconcilehelper.CopyStatefulSetFields
@@ -451,6 +499,22 @@ def copy_statefulset_fields(desired: dict, found: dict) -> bool:
         changed = True
     if found["spec"].get("template") != desired["spec"].get("template"):
         found["spec"]["template"] = k8s.deepcopy(desired["spec"]["template"])
+        changed = True
+    return changed
+
+
+def copy_virtual_service_fields(desired: dict, found: dict) -> bool:
+    """reconcilehelper.CopyVirtualService (util.go:197-219): labels,
+    annotations, and the whole (unstructured) spec."""
+    changed = False
+    for field in ("labels", "annotations"):
+        want = desired["metadata"].get(field, {})
+        have = found["metadata"].get(field)
+        if have != want:
+            found["metadata"][field] = k8s.deepcopy(want)
+            changed = True
+    if found.get("spec") != desired.get("spec"):
+        found["spec"] = k8s.deepcopy(desired["spec"])
         changed = True
     return changed
 

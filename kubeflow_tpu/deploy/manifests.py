@@ -272,6 +272,9 @@ def rbac_objects() -> list[dict]:
         {"apiGroups": ["networking.k8s.io"], "resources": ["networkpolicies"],
          "verbs": ["get", "list", "watch", "create", "update", "patch",
                    "delete"]},
+        {"apiGroups": ["networking.istio.io"], "resources": ["virtualservices"],
+         "verbs": ["get", "list", "watch", "create", "update", "patch",
+                   "delete"]},
         {"apiGroups": ["gateway.networking.k8s.io"],
          "resources": ["httproutes", "referencegrants"],
          "verbs": ["get", "list", "watch", "create", "update", "patch",
@@ -432,6 +435,21 @@ def render_kustomize_tree() -> dict[str, object]:
                            "name": "notebook-controller-culler-config"}}]),
         "overlays/standalone/kustomization.yaml": _kustomization(
             ["../../default"]),
+        # istio overlay — the reference's kubeflow overlay turns on
+        # VirtualService generation (USE_ISTIO, notebook_controller.go:558-658)
+        "overlays/istio/kustomization.yaml": _kustomization(
+            ["../../default"],
+            patches=[{"patch": yaml.safe_dump([
+                {"op": "add",
+                 "path": "/spec/template/spec/containers/0/env/-",
+                 "value": {"name": "USE_ISTIO", "value": "true"}},
+                {"op": "add",
+                 "path": "/spec/template/spec/containers/0/env/-",
+                 "value": {"name": "ISTIO_GATEWAY",
+                           "value": "kubeflow/kubeflow-gateway"}},
+            ], sort_keys=False),
+                "target": {"kind": "Deployment",
+                           "name": "kubeflow-tpu-notebook-controller"}}]),
     }
     return tree
 

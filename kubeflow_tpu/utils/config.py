@@ -26,6 +26,11 @@ class ControllerConfig:
     # core controller (reference notebook-controller/main.go:65-77 + env)
     cluster_domain: str = "cluster.local"
     add_fsgroup: bool = True
+    # Istio routing (reference USE_ISTIO/ISTIO_GATEWAY/ISTIO_HOST env,
+    # notebook_controller.go:558-658; kubeflow overlay turns it on)
+    use_istio: bool = False
+    istio_gateway: str = "kubeflow/kubeflow-gateway"
+    istio_host: str = "*"
     # culling (reference culling_controller.go:32-36; minutes)
     enable_culling: bool = False
     cull_idle_time_min: int = 1440
@@ -57,6 +62,9 @@ class ControllerConfig:
         return cls(
             cluster_domain=env.get("CLUSTER_DOMAIN", "cluster.local"),
             add_fsgroup=_env_bool("ADD_FSGROUP", True),
+            use_istio=_env_bool("USE_ISTIO", False),
+            istio_gateway=env.get("ISTIO_GATEWAY", "kubeflow/kubeflow-gateway"),
+            istio_host=env.get("ISTIO_HOST", "*"),
             enable_culling=_env_bool("ENABLE_CULLING", False),
             cull_idle_time_min=int(env.get("CULL_IDLE_TIME", "1440")),
             idleness_check_period_min=int(env.get("IDLENESS_CHECK_PERIOD", "1")),

@@ -253,3 +253,61 @@ def test_service_clusterip_never_copied(store, manager, notebook_reconciler):
     cur = store.get("Service", "ns", "mynb")
     assert cur["spec"]["clusterIP"] == "10.0.0.7"
     assert "drift" not in cur["metadata"]["labels"]
+
+
+# ----------------------------------------------------------- istio routing
+def _istio_reconciler(store, manager, config, metrics):
+    from kubeflow_tpu.controllers.notebook import NotebookReconciler
+    config.use_istio = True
+    rec = NotebookReconciler(store, config, metrics)
+    rec.setup(manager)
+    return rec
+
+
+def test_virtual_service_created_when_istio_enabled(store, manager, config,
+                                                    metrics):
+    _istio_reconciler(store, manager, config, metrics)
+    apply_notebook(store, manager, api.new_notebook("mynb", "user-ns"))
+    vs = store.get("VirtualService", "user-ns", "notebook-user-ns-mynb")
+    assert vs["apiVersion"] == "networking.istio.io/v1alpha3"
+    assert vs["spec"]["hosts"] == ["*"]
+    assert vs["spec"]["gateways"] == ["kubeflow/kubeflow-gateway"]
+    http = vs["spec"]["http"][0]
+    assert http["match"][0]["uri"]["prefix"] == "/notebook/user-ns/mynb/"
+    assert http["rewrite"]["uri"] == "/notebook/user-ns/mynb/"
+    dest = http["route"][0]["destination"]
+    assert dest["host"] == "mynb.user-ns.svc.cluster.local"
+    assert dest["port"]["number"] == 80
+    # owned → GC'd with the notebook
+    assert k8s.is_owned_by(vs, k8s.uid(store.get(api.KIND, "user-ns", "mynb")))
+
+
+def test_virtual_service_gateway_host_configurable(store, manager, config,
+                                                   metrics):
+    config.istio_gateway = "my-ns/my-gw"
+    config.istio_host = "notebooks.example.com"
+    config.cluster_domain = "corp.local"
+    _istio_reconciler(store, manager, config, metrics)
+    apply_notebook(store, manager, api.new_notebook("nb", "ns"))
+    vs = store.get("VirtualService", "ns", "notebook-ns-nb")
+    assert vs["spec"]["hosts"] == ["notebooks.example.com"]
+    assert vs["spec"]["gateways"] == ["my-ns/my-gw"]
+    assert (vs["spec"]["http"][0]["route"][0]["destination"]["host"]
+            == "nb.ns.svc.corp.local")
+
+
+def test_virtual_service_drift_repaired(store, manager, config, metrics):
+    _istio_reconciler(store, manager, config, metrics)
+    apply_notebook(store, manager, api.new_notebook("nb", "ns"))
+    vs = store.get("VirtualService", "ns", "notebook-ns-nb")
+    vs["spec"]["http"][0]["route"][0]["destination"]["host"] = "evil.svc"
+    store.update(vs)
+    drain(manager)
+    vs = store.get("VirtualService", "ns", "notebook-ns-nb")
+    assert (vs["spec"]["http"][0]["route"][0]["destination"]["host"]
+            == "nb.ns.svc.cluster.local")
+
+
+def test_no_virtual_service_by_default(store, manager, notebook_reconciler):
+    apply_notebook(store, manager, api.new_notebook("nb", "ns"))
+    assert store.get_or_none("VirtualService", "ns", "notebook-ns-nb") is None
