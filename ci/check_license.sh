@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# License freshness gate (analog of the reference's check-license.sh).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+python third_party/concatenate_licenses.py --check

@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""Static-analysis gate for the control-plane package — the analog of the
+reference's semgrep ruleset (semgrep.yaml) + code-quality workflow, as an
+AST walker since no external linter is available in this image.
+
+Rules (each mirrors a semgrep-style policy the reference enforces on its Go
+code, adapted to Python):
+
+  bare-except          except: with no exception type swallows SystemExit
+  silent-pass-except   except Exception: pass without a comment justifying it
+  mutable-default      def f(x=[]) / f(x={}) shared across calls
+  print-in-package     control-plane code must use logging, not print()
+  missing-docstring    every module must say what it is and cite the
+                       reference file it re-implements where applicable
+  star-import          from x import * defeats static analysis
+  thread-no-daemon     threading.Thread without daemon= risks hung shutdown
+
+Exit non-zero with findings; used by the code-quality CI workflow."""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+PACKAGE = Path(__file__).resolve().parent.parent / "kubeflow_tpu"
+
+
+class Linter(ast.NodeVisitor):
+    def __init__(self, path: Path, source: str):
+        self.path = path
+        self.lines = source.splitlines()
+        self.findings: list[tuple[int, str, str]] = []
+
+    def flag(self, node: ast.AST, rule: str, msg: str) -> None:
+        self.findings.append((getattr(node, "lineno", 0), rule, msg))
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.type is None:
+            self.flag(node, "bare-except",
+                      "bare 'except:' also catches SystemExit/KeyboardInterrupt")
+        elif (isinstance(node.type, ast.Name)
+              and node.type.id == "Exception"
+              and len(node.body) == 1
+              and isinstance(node.body[0], ast.Pass)):
+            # allow when the line (or the one above 'pass') carries a comment
+            line_idx = node.body[0].lineno - 1
+            context = "".join(self.lines[max(0, line_idx - 1):line_idx + 1])
+            if "#" not in context:
+                self.flag(node, "silent-pass-except",
+                          "'except Exception: pass' without a justifying comment")
+        self.generic_visit(node)
+
+    def _check_defaults(self, node: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                self.flag(default, "mutable-default",
+                          f"mutable default argument in {node.name}()")
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_defaults(node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Name) and node.func.id == "print":
+            self.flag(node, "print-in-package",
+                      "use the module logger, not print()")
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "Thread"
+                and not any(k.arg == "daemon" for k in node.keywords)):
+            self.flag(node, "thread-no-daemon",
+                      "threading.Thread without explicit daemon=")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if any(a.name == "*" for a in node.names):
+            self.flag(node, "star-import", "wildcard import")
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        # CLI glue under `if __name__ == "__main__":` may print to stdout
+        t = node.test
+        if (isinstance(t, ast.Compare) and isinstance(t.left, ast.Name)
+                and t.left.id == "__name__"):
+            return
+        self.generic_visit(node)
+
+
+def lint_file(path: Path) -> list[tuple[int, str, str]]:
+    source = path.read_text()
+    tree = ast.parse(source, filename=str(path))
+    findings = []
+    if (not (ast.get_docstring(tree) or "").strip()
+            and path.name != "__init__.py"):
+        findings.append((1, "missing-docstring", "module docstring required"))
+    linter = Linter(path, source)
+    linter.visit(tree)
+    return findings + linter.findings
+
+
+def main() -> int:
+    total = 0
+    for path in sorted(PACKAGE.rglob("*.py")):
+        for lineno, rule, msg in lint_file(path):
+            rel = path.relative_to(PACKAGE.parent)
+            sys.stderr.write(f"{rel}:{lineno}: [{rule}] {msg}\n")
+            total += 1
+    if total:
+        sys.stderr.write(f"{total} finding(s)\n")
+        return 1
+    sys.stdout.write("lint clean\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Collect the conformance report from the runner pod (analog of
+# conformance/1.7/report-pod.sh).
+set -euo pipefail
+
+NAMESPACE="${KUBEFLOW_NAMESPACE:-kf-conformance}"
+POD="${1:-notebook-tpu-conformance}"
+OUT_DIR="${2:-/tmp/kf-conformance}"
+
+mkdir -p "${OUT_DIR}"
+kubectl wait --for=condition=Ready "pod/${POD}" -n "${NAMESPACE}" --timeout=60s || true
+kubectl cp "${NAMESPACE}/${POD}:/tmp/kf-conformance/notebook-conformance.json" \
+  "${OUT_DIR}/notebook-conformance.json"
+echo "report collected at ${OUT_DIR}/notebook-conformance.json"

@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Conformance runner: verifies the 5 BASELINE configs against a control
+plane (the analog of the reference's conformance harness, conformance/1.7/ —
+per-component conformance pods writing a report).
+
+Two modes:
+
+- ``--simulate``: runs the full control plane in-process (apiserver +
+  reconcilers + kubelet simulator) and drives all 5 configs through
+  CR→SliceReady. This is what CI runs — the same way the reference's KinD
+  flavor substitutes for a real OpenShift cluster.
+- in-cluster (default): applies Notebook CRs with kubectl and polls the
+  SliceReady condition; meant to run inside the conformance pod
+  (notebook-conformance.yaml).
+
+Writes a JSON report (one entry per config) to --report-dir and exits
+non-zero if any config fails.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+# The 5 BASELINE.json configs.
+CONFIGS = [
+    {"name": "cpu-minimal", "annotations": {}},
+    {"name": "v5e-1", "annotations": {"tpu.kubeflow.org/accelerator": "v5e-1"},
+     "expect_workers": 1, "expect_chips": 1},
+    {"name": "v5e-4", "annotations": {"tpu.kubeflow.org/accelerator": "v5e-4"},
+     "expect_workers": 1, "expect_chips": 4},
+    {"name": "v5e-16", "annotations": {"tpu.kubeflow.org/accelerator": "v5e-16"},
+     "expect_workers": 4, "expect_chips": 4},
+    {"name": "v5e-16-auth-culling",
+     "annotations": {"tpu.kubeflow.org/accelerator": "v5e-16",
+                     "notebooks.opendatahub.io/inject-auth": "true"},
+     "expect_workers": 4, "expect_chips": 4, "cull": True},
+]
+
+NAMESPACE = "kf-conformance"
+TIMEOUT_S = 180  # reference e2e ceiling: 3 min (notebook_controller_setup_test.go:88-90)
+
+
+def _check_rendered(sts: dict, cfg: dict, errors: list[str]) -> None:
+    """Assert the TPU contract on the rendered StatefulSet."""
+    spec = sts["spec"]
+    workers = cfg.get("expect_workers")
+    if workers is not None and spec["replicas"] != workers:
+        errors.append(f"replicas {spec['replicas']} != {workers}")
+    if cfg.get("expect_chips"):
+        containers = spec["template"]["spec"]["containers"]
+        nb = containers[0]
+        chips = nb.get("resources", {}).get("limits", {}).get("google.com/tpu")
+        if chips != str(cfg["expect_chips"]):
+            errors.append(f"google.com/tpu {chips!r} != {cfg['expect_chips']}")
+        sel = spec["template"]["spec"].get("nodeSelector", {})
+        if "cloud.google.com/gke-tpu-topology" not in sel:
+            errors.append("missing gke-tpu-topology nodeSelector")
+        env = {e.get("name") for e in nb.get("env", [])}
+        if "TPU_WORKER_HOSTNAMES" not in env or "TPU_WORKER_ID" not in env:
+            errors.append("missing TPU worker identity env")
+    if cfg.get("annotations", {}).get("notebooks.opendatahub.io/inject-auth"):
+        containers = spec["template"]["spec"]["containers"]
+        if not any("rbac-proxy" in (c.get("image") or "") or
+                   c.get("name") == "kube-rbac-proxy" for c in containers):
+            errors.append("auth sidecar not injected")
+
+
+def run_simulated(report_dir: str) -> list[dict]:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from kubeflow_tpu.api import types as api
+    from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
+    from kubeflow_tpu.cluster.store import ClusterStore
+    from kubeflow_tpu.controllers import (CullingReconciler, Manager,
+                                          NotebookReconciler)
+    from kubeflow_tpu.controllers.extension import ExtensionReconciler
+    from kubeflow_tpu.utils import names
+    from kubeflow_tpu.utils.config import ControllerConfig
+    from kubeflow_tpu.webhook.mutating import NotebookMutatingWebhook
+    from kubeflow_tpu.webhook.validating import NotebookValidatingWebhook
+
+    results = []
+    for cfg in CONFIGS:
+        t0 = time.monotonic()
+        errors: list[str] = []
+        store = ClusterStore()
+        api.install_notebook_crd(store)
+        config = ControllerConfig(enable_culling=True, cull_idle_time_min=1)
+        NotebookMutatingWebhook(store, config).install(store)
+        NotebookValidatingWebhook(config).install(store)
+        mgr = Manager(store)
+        NotebookReconciler(store, config).setup(mgr)
+        ExtensionReconciler(store, config).setup(mgr)
+        culler = CullingReconciler(store, config)
+        culler.setup(mgr)
+        StatefulSetSimulator(store, boot_delay_s=0.0).setup(mgr)
+        nb = api.new_notebook(cfg["name"], NAMESPACE,
+                              annotations=cfg["annotations"] or None)
+        store.create(nb)
+        mgr.run_until_idle(timeout=30)
+        cur = store.get_or_none(api.KIND, NAMESPACE, cfg["name"])
+        cond = api.get_condition(cur, api.CONDITION_SLICE_READY) if cur else None
+        if not cond or cond["status"] != "True":
+            errors.append(f"SliceReady != True ({cond})")
+        stss = store.list("StatefulSet", NAMESPACE)
+        if stss:
+            _check_rendered(stss[0], cfg, errors)
+        else:
+            errors.append("no StatefulSet rendered")
+        if cfg.get("cull"):
+            # stop annotation reaps the whole slice atomically
+            store.patch(api.KIND, NAMESPACE, cfg["name"], {
+                "metadata": {"annotations": {names.STOP_ANNOTATION: "1"}}})
+            mgr.run_until_idle(timeout=30)
+            pods = store.list("Pod", NAMESPACE)
+            if pods:
+                errors.append(f"{len(pods)} pods survived slice-atomic cull")
+        results.append({"config": cfg["name"], "passed": not errors,
+                        "errors": errors,
+                        "duration_s": round(time.monotonic() - t0, 3)})
+    return results
+
+
+def _kubectl(*args: str, input_: str | None = None) -> str:
+    out = subprocess.run(["kubectl", *args], capture_output=True, text=True,
+                         input=input_, check=False)
+    if out.returncode != 0:
+        raise RuntimeError(f"kubectl {' '.join(args)}: {out.stderr.strip()}")
+    return out.stdout
+
+
+def run_in_cluster(report_dir: str) -> list[dict]:
+    results = []
+    for cfg in CONFIGS:
+        t0 = time.monotonic()
+        errors: list[str] = []
+        manifest = {
+            "apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+            "metadata": {"name": cfg["name"], "namespace": NAMESPACE,
+                         "annotations": cfg["annotations"]},
+            "spec": {"template": {"spec": {"containers": [
+                {"name": cfg["name"], "image": "jupyter-minimal:latest"}]}}},
+        }
+        _kubectl("apply", "-f", "-", input_=json.dumps(manifest))
+        deadline = time.monotonic() + TIMEOUT_S
+        ready = False
+        while time.monotonic() < deadline:
+            out = _kubectl("get", "notebook", cfg["name"], "-n", NAMESPACE,
+                           "-o", "jsonpath={.status.conditions[?(@.type=='SliceReady')].status}")
+            if out.strip() == "True":
+                ready = True
+                break
+            time.sleep(5)
+        if not ready:
+            errors.append(f"SliceReady != True within {TIMEOUT_S}s")
+        else:
+            sts = json.loads(_kubectl("get", "statefulset", "-n", NAMESPACE,
+                                      "-l", f"notebook-name={cfg['name']}",
+                                      "-o", "json"))["items"]
+            if sts:
+                _check_rendered(sts[0], cfg, errors)
+            else:
+                errors.append("no StatefulSet found")
+        results.append({"config": cfg["name"], "passed": not errors,
+                        "errors": errors,
+                        "duration_s": round(time.monotonic() - t0, 3)})
+    return results
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--simulate", action="store_true",
+                    help="run against the in-process control plane (CI mode)")
+    ap.add_argument("--report-dir", default="/tmp/kf-conformance")
+    args = ap.parse_args()
+    os.makedirs(args.report_dir, exist_ok=True)
+    results = run_simulated(args.report_dir) if args.simulate \
+        else run_in_cluster(args.report_dir)
+    report = {"suite": "notebook-tpu-conformance",
+              "passed": all(r["passed"] for r in results),
+              "results": results}
+    path = os.path.join(args.report_dir, "notebook-conformance.json")
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+    return 0 if report["passed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,42 @@
+"""Conformance + code-quality gates runnable inside the unit suite (the
+reference schema-validates its chaos experiments in CI the same way)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_conformance_simulate_all_pass(tmp_path):
+    from conformance.run_conformance import CONFIGS, run_simulated
+    results = run_simulated(str(tmp_path))
+    assert len(results) == len(CONFIGS) == 5
+    failed = [r for r in results if not r["passed"]]
+    assert not failed, failed
+
+
+def test_conformance_cli_writes_report(tmp_path):
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "conformance" / "run_conformance.py"),
+         "--simulate", "--report-dir", str(tmp_path)],
+        capture_output=True, text=True, cwd=str(ROOT))
+    assert out.returncode == 0, out.stderr
+    report = json.loads((tmp_path / "notebook-conformance.json").read_text())
+    assert report["passed"] is True
+    assert {r["config"] for r in report["results"]} == {
+        "cpu-minimal", "v5e-1", "v5e-4", "v5e-16", "v5e-16-auth-culling"}
+
+
+def test_lint_clean():
+    out = subprocess.run([sys.executable, str(ROOT / "ci" / "lint.py")],
+                         capture_output=True, text=True, cwd=str(ROOT))
+    assert out.returncode == 0, out.stderr
+
+
+def test_license_file_fresh():
+    out = subprocess.run(
+        [sys.executable, str(ROOT / "third_party" / "concatenate_licenses.py"),
+         "--check"], capture_output=True, text=True, cwd=str(ROOT))
+    assert out.returncode == 0, out.stderr
