@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import itertools
 import threading
-import time
 from dataclasses import dataclass, field
 from typing import Callable, Iterator
 
@@ -61,8 +60,7 @@ class _Watch:
     label_selector: dict[str, str] | None = None
 
 
-def _now_iso() -> str:
-    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+_now_iso = k8s.now_iso
 
 
 class ClusterStore:

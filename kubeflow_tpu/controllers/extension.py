@@ -20,7 +20,7 @@ from __future__ import annotations
 import logging
 
 from ..api import types as api
-from ..cluster import errors
+from ..cluster import errors, events
 from ..utils import k8s, names
 from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
@@ -43,6 +43,7 @@ class ExtensionReconciler:
         self.client = client
         self.config = config or ControllerConfig()
         self.metrics = metrics or MetricsRegistry()
+        self.recorder = events.EventRecorder(client, component=self.name)
 
     def setup(self, mgr: Manager) -> None:
         """Reference SetupWithManager (:736-884): own SA/Service/ConfigMap/
@@ -109,7 +110,8 @@ class ExtensionReconciler:
 
         requeue = None
         if self.config.mlflow_enabled:
-            requeue = rbac.reconcile_mlflow_integration(self.client, notebook)
+            requeue = rbac.reconcile_mlflow_integration(self.client, notebook,
+                                                        recorder=self.recorder)
 
         self._remove_reconciliation_lock(notebook)
         return Result(requeue_after=requeue) if requeue else None

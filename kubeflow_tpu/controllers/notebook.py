@@ -26,7 +26,7 @@ from __future__ import annotations
 import logging
 
 from ..api import types as api
-from ..cluster import errors
+from ..cluster import errors, events
 from ..tpu.topology import SliceSpec, parse_slice_request
 from ..utils import k8s, names
 from ..utils.config import ControllerConfig
@@ -54,6 +54,7 @@ class NotebookReconciler:
         self.config = config or ControllerConfig()
         self.metrics = metrics or MetricsRegistry()
         self.metrics.on_scrape(self._scrape_running)
+        self.recorder = events.EventRecorder(client, component=self.name)
 
     # ------------------------------------------------------------- wiring
     def setup(self, mgr: Manager) -> None:
@@ -65,8 +66,26 @@ class NotebookReconciler:
         mgr.watch("StatefulSet", self.name, mapper=owner_mapper(api.KIND))
         mgr.watch("Service", self.name, mapper=owner_mapper(api.KIND))
         mgr.watch("Pod", self.name, mapper=label_mapper(names.NOTEBOOK_NAME_LABEL))
+        # Events of known notebooks' Pods/STSs share the Notebook queue and
+        # are re-emitted on the CR (reference predNBEvents + mapEventToRequest,
+        # notebook_controller.go:739-767,780-800; delete events are ignored)
+        mgr.watch(events.EVENT_KIND, self.name,
+                  predicate=self._pred_nb_events)
         if self.config.use_istio:
             mgr.watch("VirtualService", self.name, mapper=owner_mapper(api.KIND))
+
+    def _pred_nb_events(self, watch_event) -> bool:
+        if watch_event.type == "DELETED":
+            return False
+        obj = watch_event.obj
+        if not events.is_sts_or_pod_event(obj):
+            return False
+        nb_name = events.nb_name_from_involved_object(
+            self.client, obj, names.NOTEBOOK_NAME_LABEL)
+        if nb_name is None:
+            return False
+        return self.client.get_or_none(api.KIND, k8s.namespace(obj),
+                                       nb_name) is not None
 
     def _scrape_running(self) -> None:
         """notebook_running is computed at scrape time by listing STSs with
@@ -80,8 +99,17 @@ class NotebookReconciler:
 
     # ---------------------------------------------------------- reconcile
     def reconcile(self, req: Request) -> Result | None:
+        # Events ride the same queue as Notebooks: a request that names an
+        # Event object is a re-emission request (reference event-or-notebook
+        # disambiguation, notebook_controller.go:99-126 — but checked second
+        # here: the common case is a Notebook key served from cache, and event
+        # names always carry a ".<hash>" suffix no Notebook's STS could have)
         notebook = self.client.get_or_none(api.KIND, req.namespace, req.name)
         if notebook is None:
+            event = self.client.get_or_none(events.EVENT_KIND, req.namespace,
+                                            req.name)
+            if event is not None:
+                self._reemit_event(req.namespace, event)
             return None
         if k8s.is_deleting(notebook):
             # upstream reconciler no-ops on deletion (reference :138-140);
@@ -100,6 +128,28 @@ class NotebookReconciler:
         self._handle_restart_annotation(notebook, slice_spec)
         self._update_status(notebook, slice_spec)
         return None
+
+    def _reemit_event(self, namespace: str, event: dict) -> None:
+        """Re-emit a Pod/StatefulSet event on the owning Notebook CR
+        (reference notebook_controller.go:103-121): the re-issued event's
+        involvedObject is the Notebook, so it does not re-trigger the Event
+        watch (predicate only passes Pod/STS events)."""
+        if not events.is_sts_or_pod_event(event):
+            return
+        nb_name = events.nb_name_from_involved_object(
+            self.client, event, names.NOTEBOOK_NAME_LABEL)
+        if nb_name is None:
+            return
+        notebook = self.client.get_or_none(api.KIND, namespace, nb_name)
+        if notebook is None:
+            return
+        involved = event.get("involvedObject", {})
+        self.recorder.eventf(
+            notebook, event.get("type", events.TYPE_NORMAL),
+            event.get("reason", ""),
+            "Reissued from %s/%s: %s" % (
+                str(involved.get("kind", "")).lower(),
+                involved.get("name", ""), event.get("message", "")))
 
     # --------------------------------------------------------- generation
     def desired_replicas(self, notebook: dict, slice_spec: SliceSpec | None) -> int:

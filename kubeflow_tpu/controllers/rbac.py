@@ -89,10 +89,12 @@ def new_mlflow_role_binding(notebook: dict) -> dict:
     return rb
 
 
-def reconcile_mlflow_integration(client, notebook: dict) -> float | None:
+def reconcile_mlflow_integration(client, notebook: dict,
+                                 recorder=None) -> float | None:
     """Returns a requeue delay when the ClusterRole is absent (reference
-    requeues every 30 s until the MLflow operator installs it,
-    notebook_mlflow.go:236-270); None when converged or not requested."""
+    requeues every 30 s until the MLflow operator installs it, recording a
+    Warning event on the CR, notebook_mlflow.go:236-270); None when converged
+    or not requested."""
     ns = k8s.namespace(notebook)
     instance = k8s.get_annotation(notebook, names.MLFLOW_INSTANCE_ANNOTATION)
     if not instance:
@@ -103,6 +105,11 @@ def reconcile_mlflow_integration(client, notebook: dict) -> float | None:
             pass
         return None
     if client.get_or_none("ClusterRole", "", MLFLOW_CLUSTER_ROLE) is None:
+        if recorder is not None:
+            recorder.eventf(
+                notebook, "Warning", "MLflowClusterRolePending",
+                'Waiting for MLflow ClusterRole "%s" to be created'
+                % MLFLOW_CLUSTER_ROLE)
         return MLFLOW_REQUEUE_SECONDS
     desired = new_mlflow_role_binding(notebook)
     existing = client.get_or_none("RoleBinding", ns, k8s.name(desired))

@@ -9,9 +9,16 @@ semantics trivial and dependency-free.
 from __future__ import annotations
 
 import copy
+import time
 from typing import Any, Iterable
 
 Obj = dict  # a Kubernetes API object in JSON form
+
+
+def now_iso() -> str:
+    """RFC3339 second-granularity timestamp, the apiserver's metadata format
+    (shared by creationTimestamp, deletionTimestamp, and Event timestamps)."""
+    return time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
 
 
 def deepcopy(obj: Obj) -> Obj:

@@ -1,0 +1,190 @@
+"""Event recording + re-emission plumbing (reference
+notebook_controller.go:99-126,700-826 and odh notebook_mlflow.go:259-260)."""
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster import events
+from kubeflow_tpu.controllers import rbac
+from kubeflow_tpu.utils import k8s, names
+from tests.conftest import drain
+
+
+def _notebook_events(store, ns, nb_name):
+    out = []
+    for ev in store.list(events.EVENT_KIND, ns):
+        inv = ev.get("involvedObject", {})
+        if inv.get("kind") == api.KIND and inv.get("name") == nb_name:
+            out.append(ev)
+    return out
+
+
+def test_recorder_creates_and_aggregates(store):
+    nb = store.create(api.new_notebook("mynb", "ns"))
+    rec = events.EventRecorder(store)
+    first = rec.eventf(nb, events.TYPE_WARNING, "FailedScheduling",
+                       "0/3 nodes available")
+    assert first["count"] == 1
+    assert first["involvedObject"]["uid"] == k8s.uid(nb)
+    assert first["source"]["component"] == "notebook-controller"
+    again = rec.eventf(nb, events.TYPE_WARNING, "FailedScheduling",
+                       "0/3 nodes available")
+    assert again["count"] == 2
+    assert k8s.name(again) == k8s.name(first)  # aggregated, not a new object
+    other = rec.eventf(nb, events.TYPE_WARNING, "FailedScheduling",
+                       "0/4 nodes available")
+    assert other["count"] == 1
+    assert k8s.name(other) != k8s.name(first)
+
+
+def test_sts_event_reemitted_on_notebook(store, manager, notebook_reconciler):
+    store.create(api.new_notebook("mynb", "ns"))
+    drain(manager)
+    sts = store.get("StatefulSet", "ns", "mynb")
+    events.EventRecorder(store, component="statefulset-controller").eventf(
+        sts, events.TYPE_WARNING, "FailedCreate", "pods \"mynb-0\" forbidden")
+    drain(manager)
+    emitted = _notebook_events(store, "ns", "mynb")
+    assert len(emitted) == 1
+    assert emitted[0]["reason"] == "FailedCreate"
+    assert emitted[0]["message"] == (
+        'Reissued from statefulset/mynb: pods "mynb-0" forbidden')
+    assert emitted[0]["type"] == events.TYPE_WARNING
+
+
+def test_pod_event_resolves_via_label(store, manager, notebook_reconciler):
+    store.create(api.new_notebook("mynb", "ns"))
+    drain(manager)
+    pod = store.create({
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "mynb-0", "namespace": "ns",
+                     "labels": {names.NOTEBOOK_NAME_LABEL: "mynb"}},
+        "spec": {"containers": []},
+    })
+    events.EventRecorder(store, component="kubelet").eventf(
+        pod, events.TYPE_NORMAL, "Pulled", "image pulled")
+    drain(manager)
+    emitted = _notebook_events(store, "ns", "mynb")
+    assert len(emitted) == 1
+    assert emitted[0]["message"] == "Reissued from pod/mynb-0: image pulled"
+    assert emitted[0]["type"] == events.TYPE_NORMAL
+
+
+def test_unrelated_events_ignored(store, manager, notebook_reconciler):
+    store.create(api.new_notebook("mynb", "ns"))
+    drain(manager)
+    # event on an STS with no matching notebook
+    stranger = store.create({
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": {"name": "not-a-notebook", "namespace": "ns"},
+        "spec": {"replicas": 1},
+    })
+    events.EventRecorder(store).eventf(stranger, events.TYPE_WARNING,
+                                       "FailedCreate", "boom")
+    # event on a non-Pod/STS object
+    svc = store.get("Service", "ns", "mynb")
+    events.EventRecorder(store).eventf(svc, events.TYPE_WARNING,
+                                       "Unrelated", "nope")
+    drain(manager)
+    assert _notebook_events(store, "ns", "mynb") == []
+
+
+def test_reemission_does_not_loop(store, manager, notebook_reconciler):
+    """The re-issued event's involvedObject is the Notebook → the Event
+    predicate rejects it; repeated source events aggregate instead of
+    multiplying."""
+    store.create(api.new_notebook("mynb", "ns"))
+    drain(manager)
+    sts = store.get("StatefulSet", "ns", "mynb")
+    rec = events.EventRecorder(store, component="statefulset-controller")
+    for _ in range(3):
+        rec.eventf(sts, events.TYPE_WARNING, "FailedCreate", "quota")
+        drain(manager)
+    emitted = _notebook_events(store, "ns", "mynb")
+    assert len(emitted) == 1
+    assert emitted[0]["count"] == 3
+
+
+def test_mlflow_pending_event(store):
+    nb = store.create(api.new_notebook(
+        "mynb", "ns",
+        annotations={names.MLFLOW_INSTANCE_ANNOTATION: "tracking"}))
+    rec = events.EventRecorder(store, component="extension-controller")
+    delay = rbac.reconcile_mlflow_integration(store, nb, recorder=rec)
+    assert delay == rbac.MLFLOW_REQUEUE_SECONDS
+    emitted = _notebook_events(store, "ns", "mynb")
+    assert len(emitted) == 1
+    assert emitted[0]["reason"] == "MLflowClusterRolePending"
+    assert emitted[0]["type"] == events.TYPE_WARNING
+
+
+def test_sts_event_for_long_name_notebook(store, manager, notebook_reconciler):
+    """STS events resolve via the notebook-name label, so notebooks whose STS
+    fell back to GenerateName "nb-" still get their events (the reference
+    loses these, notebook_controller.go:709-711)."""
+    long_name = "n" * 60
+    store.create(api.new_notebook(long_name, "ns"))
+    drain(manager)
+    stss = [s for s in store.list("StatefulSet", "ns")
+            if k8s.get_label(s, names.NOTEBOOK_NAME_LABEL) == long_name]
+    assert len(stss) == 1 and k8s.name(stss[0]) != long_name
+    events.EventRecorder(store, component="statefulset-controller").eventf(
+        stss[0], events.TYPE_WARNING, "FailedCreate", "quota exceeded")
+    drain(manager)
+    emitted = _notebook_events(store, "ns", long_name)
+    assert len(emitted) == 1
+    assert emitted[0]["reason"] == "FailedCreate"
+
+
+def test_foreign_sts_sharing_notebook_name_ignored(store, manager,
+                                                   notebook_reconciler):
+    """An unlabeled STS that happens to share a Notebook's name must not have
+    its failures attributed to the Notebook."""
+    store.create(api.new_notebook("db", "ns"))
+    drain(manager)
+    # replace the controller-made STS view with a foreign, unlabeled STS in
+    # another namespace-shape: simplest is a second ns-local STS name clash on
+    # a different name that matches another notebook
+    store.create(api.new_notebook("other", "ns"))
+    drain(manager)
+    foreign = store.create({
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": {"name": "db-foreign", "namespace": "ns"},
+        "spec": {"replicas": 1},
+    })
+    events.EventRecorder(store).eventf(foreign, events.TYPE_WARNING,
+                                       "FailedCreate", "boom")
+    drain(manager)
+    assert _notebook_events(store, "ns", "db") == []
+    assert _notebook_events(store, "ns", "other") == []
+
+
+def test_terminal_pod_event_survives_pod_deletion(store, manager,
+                                                  notebook_reconciler):
+    """Events on an already-deleted pod resolve through the owning STS
+    (pods are named <sts>-<ordinal>)."""
+    store.create(api.new_notebook("mynb", "ns"))
+    drain(manager)
+    # the pod never existed in the store — only the STS did
+    ghost_pod = {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "mynb-0", "namespace": "ns", "uid": "ghost-uid"},
+    }
+    events.EventRecorder(store, component="kubelet").eventf(
+        ghost_pod, events.TYPE_WARNING, "OOMKilled", "container killed")
+    drain(manager)
+    emitted = _notebook_events(store, "ns", "mynb")
+    assert len(emitted) == 1
+    assert emitted[0]["reason"] == "OOMKilled"
+
+
+def test_event_ttl_prune(store):
+    nb = store.create(api.new_notebook("mynb", "ns"))
+    rec = events.EventRecorder(store, ttl_seconds=0.0)
+    rec.eventf(nb, events.TYPE_NORMAL, "Old", "stale")
+    # force the prune window open and record a new event: the stale one
+    # (lastTimestamp <= now - 0) is reaped
+    rec._last_prune.clear()
+    import time as _t
+    _t.sleep(1.1)  # RFC3339 has 1s granularity
+    rec.eventf(nb, events.TYPE_NORMAL, "New", "fresh")
+    reasons = {e["reason"] for e in store.list(events.EVENT_KIND, "ns")}
+    assert reasons == {"New"}
