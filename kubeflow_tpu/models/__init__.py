@@ -1,3 +1,6 @@
 from .transformer import TransformerConfig, init_params, forward, param_logical_specs
+from .moe import MoEConfig, init_moe_params, moe_forward, moe_param_logical_specs
 
-__all__ = ["TransformerConfig", "init_params", "forward", "param_logical_specs"]
+__all__ = ["TransformerConfig", "init_params", "forward", "param_logical_specs",
+           "MoEConfig", "init_moe_params", "moe_forward",
+           "moe_param_logical_specs"]

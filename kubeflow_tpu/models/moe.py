@@ -1,0 +1,273 @@
+"""Mixture-of-Experts transformer LM — the expert-parallel workload family.
+
+Second model family the framework provisions into notebook slices (the
+reference has no model code, SURVEY §2d; this extends the flagship dense LM
+in transformer.py with sparse MoE MLPs). Reuses the dense model's attention
+stack, norms, and RoPE wholesale — only the MLP is replaced.
+
+TPU-first routing (GShard/Switch-style, GSPMD-friendly):
+- static shapes end to end: top-k routing is expressed as one-hot dispatch /
+  combine tensors (token, expert, capacity) contracted with einsum — no
+  dynamic gathers, no data-dependent shapes, nothing XLA can't tile;
+- experts are a leading weight axis sharded over the ``ep`` mesh axis
+  (parallel/sharding.py "experts" rule); the dispatch/combine einsums are
+  where GSPMD inserts the all-to-alls;
+- router math in float32 (softmax + cumsum), expert FFN in the compute dtype
+  on the MXU;
+- Switch-style load-balance auxiliary loss (n_experts · Σ fraction·prob,
+  minimized at 1.0 when routing is uniform) returned alongside the logits.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.sharding import (PartitionRules, batch_sharding,
+                                 param_shardings)
+from .transformer import (TransformerConfig, attention_block, rms_norm,
+                          rope_frequencies)
+
+
+@dataclass(frozen=True)
+class MoEConfig(TransformerConfig):
+    n_experts: int = 8
+    experts_per_token: int = 2       # top-k routing
+    capacity_factor: float = 1.25    # expert capacity ≈ N/E · factor
+    router_aux_coef: float = 0.01    # weight of the load-balance loss
+
+
+# ------------------------------------------------------------------ params
+def moe_param_logical_specs(config: MoEConfig) -> dict:
+    """Same attention weights as the dense model; MLP weights gain a leading
+    'experts' axis (→ ep), plus the router projection."""
+    return {
+        "embed": ("vocab", "embed"),
+        "blocks": {
+            "attn_norm": ("layers", "norm"),
+            "wq": ("layers", "embed", "heads", "head_dim"),
+            "wk": ("layers", "embed", "kv_heads", "head_dim"),
+            "wv": ("layers", "embed", "kv_heads", "head_dim"),
+            "wo": ("layers", "heads", "head_dim", "embed"),
+            "mlp_norm": ("layers", "norm"),
+            "router": ("layers", "embed", "experts"),
+            "w_gate": ("layers", "experts", "embed", "mlp"),
+            "w_up": ("layers", "experts", "embed", "mlp"),
+            "w_down": ("layers", "experts", "mlp", "embed"),
+        },
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+def init_moe_params(key: jax.Array, config: MoEConfig) -> dict:
+    c = config
+    pdt = jnp.dtype(c.param_dtype)
+    k_embed, k_blocks, k_head = jax.random.split(key, 3)
+
+    def dense(k, shape, fan_in):
+        return jax.random.normal(k, shape, pdt) / math.sqrt(fan_in)
+
+    L, E = c.n_layers, c.n_experts
+    kb = jax.random.split(k_blocks, 8)
+    blocks = {
+        "attn_norm": jnp.ones((L, c.d_model), pdt),
+        "wq": dense(kb[0], (L, c.d_model, c.n_heads, c.d_head), c.d_model),
+        "wk": dense(kb[1], (L, c.d_model, c.n_kv_heads, c.d_head), c.d_model),
+        "wv": dense(kb[2], (L, c.d_model, c.n_kv_heads, c.d_head), c.d_model),
+        "wo": dense(kb[3], (L, c.n_heads, c.d_head, c.d_model),
+                    c.n_heads * c.d_head),
+        "mlp_norm": jnp.ones((L, c.d_model), pdt),
+        "router": dense(kb[4], (L, c.d_model, E), c.d_model),
+        "w_gate": dense(kb[5], (L, E, c.d_model, c.d_ff), c.d_model),
+        "w_up": dense(kb[6], (L, E, c.d_model, c.d_ff), c.d_model),
+        "w_down": dense(kb[7], (L, E, c.d_ff, c.d_model), c.d_ff),
+    }
+    return {
+        "embed": jax.random.normal(k_embed, (c.vocab_size, c.d_model), pdt),
+        "blocks": blocks,
+        "final_norm": jnp.ones((c.d_model,), pdt),
+        "lm_head": dense(k_head, (c.d_model, c.vocab_size), c.d_model),
+    }
+
+
+# ------------------------------------------------------------------ routing
+def expert_capacity(n_tokens: int, config: MoEConfig) -> int:
+    """Static per-expert capacity: ceil(N/E · factor · k), floor 4. Python int
+    at trace time — shapes stay static."""
+    c = config
+    cap = math.ceil(n_tokens / c.n_experts * c.capacity_factor
+                    * c.experts_per_token)
+    return max(4, cap)
+
+
+def route_tokens(router_logits: jax.Array, config: MoEConfig,
+                 capacity: int):
+    """Top-k token→expert assignment as dense one-hot tensors.
+
+    router_logits: (N, E) float32 →
+      combine  (N, E, C) float32 — gate weight where token n occupies slot c
+                                   of expert e, 0 elsewhere;
+      dispatch (N, E, C) bool    — combine > 0;
+      aux      ()        float32 — Switch load-balance loss.
+
+    Tokens beyond an expert's capacity are dropped (their combine weight is 0
+    — the residual connection carries them through, standard GShard behavior).
+    """
+    c = config
+    N, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits, axis=-1)          # (N, E) f32
+    gate_vals, gate_idx = lax.top_k(probs, c.experts_per_token)  # (N, k)
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(axis=-1, keepdims=True), 1e-9)
+
+    combine = jnp.zeros((N, E, capacity), dtype=jnp.float32)
+    filled = jnp.zeros((E,), dtype=jnp.int32)   # slots used per expert so far
+    top1_mask = None
+    for j in range(c.experts_per_token):
+        mask_j = jax.nn.one_hot(gate_idx[:, j], E, dtype=jnp.int32)  # (N, E)
+        if j == 0:
+            top1_mask = mask_j
+        # slot index for each token within its chosen expert (first-come
+        # order over the flattened token axis, GShard's cumsum assignment)
+        pos = jnp.cumsum(mask_j, axis=0) - mask_j + filled[None, :]  # (N, E)
+        keep = (pos < capacity) & (mask_j > 0)
+        filled = filled + mask_j.sum(axis=0).clip(max=capacity)
+        slot = jax.nn.one_hot(pos, capacity, dtype=jnp.float32)      # (N,E,C)
+        combine = combine + (gate_vals[:, j, None, None]
+                             * keep[..., None].astype(jnp.float32) * slot)
+    dispatch = combine > 0.0
+
+    # Switch aux loss: E · Σ_e fraction_routed(e) · mean_prob(e); == 1 at
+    # perfect balance, grows as routing collapses onto few experts
+    fraction = top1_mask.astype(jnp.float32).mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = E * jnp.sum(fraction * mean_prob)
+    return combine, dispatch, aux
+
+
+def moe_mlp_block(x: jax.Array, layer: dict, config: MoEConfig,
+                  mesh: Mesh | None = None):
+    """Sparse MLP: route → dispatch einsum → per-expert gated FFN → combine
+    einsum. Returns (x + out, aux_loss)."""
+    c = config
+    h = rms_norm(x, layer["mlp_norm"])
+    B, S, D = h.shape
+    N = B * S
+    ht = h.reshape(N, D)
+    router_logits = jnp.einsum(
+        "nd,de->ne", ht.astype(jnp.float32),
+        layer["router"].astype(jnp.float32))
+    capacity = expert_capacity(N, c)
+    combine, dispatch, aux = route_tokens(router_logits, c, capacity)
+
+    dt = h.dtype
+    # (N,E,C) × (N,D) → (E,C,D): the all-to-all under ep sharding
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(dt), ht)
+    if mesh is not None and mesh.shape.get("ep", 1) > 1:
+        expert_in = lax.with_sharding_constraint(
+            expert_in, NamedSharding(mesh, P("ep", None, None)))
+    gate = jnp.einsum("ecd,edf->ecf", expert_in, layer["w_gate"].astype(dt))
+    up = jnp.einsum("ecd,edf->ecf", expert_in, layer["w_up"].astype(dt))
+    expert_out = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up,
+                            layer["w_down"].astype(dt))
+    out = jnp.einsum("nec,ecd->nd", combine.astype(dt), expert_out)
+    return x + out.reshape(B, S, D), aux
+
+
+def moe_forward(params: dict, tokens: jax.Array, config: MoEConfig,
+                mesh: Mesh | None = None,
+                positions: jax.Array | None = None):
+    """tokens (batch, seq) → (logits (b, s, vocab) f32, aux_loss scalar).
+    Attention is shared with the dense model (ring/flash/xla dispatch)."""
+    c = config
+    x = params["embed"].astype(c.compute_dtype)[tokens]
+    if positions is None:
+        positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+        positions = jnp.broadcast_to(positions, tokens.shape)
+    cos, sin = rope_frequencies(c, positions)
+
+    def layer_body(carry, layer):
+        x, aux = carry
+        x = attention_block(x, layer, c, cos, sin, mesh=mesh)
+        x, layer_aux = moe_mlp_block(x, layer, c, mesh=mesh)
+        return (x, aux + layer_aux), None
+
+    body = jax.checkpoint(layer_body) if c.remat else layer_body
+    (x, aux), _ = lax.scan(body, (x, jnp.float32(0.0)), params["blocks"])
+
+    x = rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(x.dtype)
+                        ).astype(jnp.float32)
+    return logits, aux / c.n_layers
+
+
+# ----------------------------------------------------------------- training
+def moe_loss_fn(params, tokens, targets, config: MoEConfig, mesh=None):
+    """Next-token CE + router load-balance aux."""
+    logits, aux = moe_forward(params, tokens, config, mesh=mesh)
+    valid = targets >= 0
+    safe_targets = jnp.where(valid, targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe_targets[..., None],
+                               axis=-1).squeeze(-1)
+    nll = jnp.where(valid, nll, 0.0)
+    ce = nll.sum() / jnp.maximum(valid.sum(), 1)
+    return ce + config.router_aux_coef * aux
+
+
+def make_sharded_moe_train_step(mesh: Mesh, config: MoEConfig,
+                                tc=None, rules: PartitionRules | None = None):
+    """(init_fn, step_fn) jitted over ``mesh`` with dp/fsdp/tp/sp/ep
+    shardings — the MoE counterpart of train.make_sharded_train_step (which
+    documents the opt-state sharding scheme; pp is a dense-model feature)."""
+    from .train import TrainConfig, make_optimizer, opt_state_shardings
+
+    if mesh.shape.get("pp", 1) > 1:
+        raise NotImplementedError("MoE + pipeline parallelism not supported; "
+                                  "use dp/fsdp/tp/sp/ep meshes")
+    tc = tc or TrainConfig()
+    rules = rules or PartitionRules()
+    optimizer = make_optimizer(tc)
+    p_shardings = param_shardings(mesh, moe_param_logical_specs(config), rules)
+    batch_sh = batch_sharding(mesh)
+    replicated = NamedSharding(mesh, P())
+    opt_shardings = opt_state_shardings(
+        optimizer, lambda k: init_moe_params(k, config), p_shardings,
+        replicated)
+
+    @partial(jax.jit, out_shardings=(p_shardings, opt_shardings))
+    def init_fn(key):
+        params = init_moe_params(key, config)
+        return params, optimizer.init(params)
+
+    @partial(jax.jit,
+             in_shardings=(p_shardings, opt_shardings, batch_sh, batch_sh),
+             out_shardings=(p_shardings, opt_shardings, replicated),
+             donate_argnums=(0, 1))
+    def step_fn(params, opt_state, tokens, targets):
+        loss, grads = jax.value_and_grad(moe_loss_fn)(
+            params, tokens, targets, config, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return init_fn, step_fn
+
+
+def count_active_params(config: MoEConfig) -> float:
+    """Per-token active parameter count (k of E experts) — the MoE efficiency
+    headline."""
+    c = config
+    attn = c.n_layers * (c.d_model * c.n_heads * c.d_head * 2
+                         + c.d_model * c.n_kv_heads * c.d_head * 2)
+    mlp_active = c.n_layers * c.experts_per_token * 3 * c.d_model * c.d_ff
+    embed = 2 * c.vocab_size * c.d_model
+    return attn + mlp_active + embed

@@ -71,6 +71,32 @@ def pipeline_rules() -> PartitionRules:
     return PartitionRules(rules=rules)
 
 
+def opt_state_shardings(optimizer, init_params_fn, p_shardings, replicated):
+    """Optimizer state mirrors param sharding: optax states embed pytrees
+    with the params' structure (adamw mu/nu), so an optimizer-state leaf
+    whose path *ends with* a param path gets that param's sharding;
+    everything else (counters, scalars) replicates."""
+    from jax.tree_util import tree_flatten_with_path
+
+    params_shape = jax.eval_shape(init_params_fn, jax.random.key(0))
+    opt_shape = jax.eval_shape(optimizer.init, params_shape)
+    param_by_path = {
+        tuple(str(k) for k in path): sh
+        for (path, sh) in tree_flatten_with_path(p_shardings)[0]}
+
+    leaves, treedef = tree_flatten_with_path(opt_shape)
+    out = []
+    for path, leaf in leaves:
+        keys = tuple(str(k) for k in path)
+        sh = replicated
+        for start in range(len(keys)):
+            if keys[start:] in param_by_path:
+                sh = param_by_path[keys[start:]]
+                break
+        out.append(sh if leaf.ndim > 0 else replicated)
+    return jax.tree.unflatten(treedef, out)
+
+
 def make_sharded_train_step(mesh: Mesh, config: TransformerConfig,
                             tc: TrainConfig | None = None,
                             rules: PartitionRules | None = None,
@@ -96,33 +122,8 @@ def make_sharded_train_step(mesh: Mesh, config: TransformerConfig,
     batch_sh = NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
     replicated = NamedSharding(mesh, P())
 
-    def _opt_shardings():
-        """Optimizer state mirrors param sharding: optax states embed pytrees
-        with the params' structure (adamw mu/nu), so an optimizer-state leaf
-        whose path *ends with* a param path gets that param's sharding;
-        everything else (counters, scalars) replicates."""
-        from jax.tree_util import tree_flatten_with_path
-
-        params_shape = jax.eval_shape(lambda k: init_params(k, config),
-                                      jax.random.key(0))
-        opt_shape = jax.eval_shape(optimizer.init, params_shape)
-        param_by_path = {
-            tuple(str(k) for k in path): sh
-            for (path, sh) in tree_flatten_with_path(p_shardings)[0]}
-
-        leaves, treedef = tree_flatten_with_path(opt_shape)
-        out = []
-        for path, leaf in leaves:
-            keys = tuple(str(k) for k in path)
-            sh = replicated
-            for start in range(len(keys)):
-                if keys[start:] in param_by_path:
-                    sh = param_by_path[keys[start:]]
-                    break
-            out.append(sh if leaf.ndim > 0 else replicated)
-        return jax.tree.unflatten(treedef, out)
-
-    opt_shardings = _opt_shardings()
+    opt_shardings = opt_state_shardings(
+        optimizer, lambda k: init_params(k, config), p_shardings, replicated)
 
     @partial(jax.jit, out_shardings=(p_shardings, opt_shardings))
     def init_fn(key):
