@@ -1,3 +1,13 @@
 from .bootstrap import SliceEnv, initialize_slice, verify_slice
 
-__all__ = ["SliceEnv", "initialize_slice", "verify_slice"]
+__all__ = ["SliceEnv", "initialize_slice", "verify_slice",
+           "TrainCheckpointer", "abstract_state"]
+
+
+def __getattr__(name):
+    # lazy: checkpoint pulls in orbax, which the orbax-free bootstrap path
+    # (bench, in-container slice verification) must not pay for or require
+    if name in ("TrainCheckpointer", "abstract_state"):
+        from . import checkpoint
+        return getattr(checkpoint, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

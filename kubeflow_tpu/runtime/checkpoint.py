@@ -1,0 +1,104 @@
+"""Sharded train-state checkpoint/resume (orbax-backed).
+
+The reference's checkpoint story is control-plane only: stop/resume is the
+replicas 0↔N flip keyed on the stop annotation, and user data persistence is
+delegated to PVCs in the pod spec (SURVEY §5; culling_controller.go:53-54).
+This module is the compute-side counterpart the TPU workload needs: when the
+culler reaps an idle slice mid-training, the notebook resumes from the last
+checkpoint on its PVC instead of from scratch.
+
+TPU-first details:
+- saves are sharding-aware: each host writes only its addressable shards
+  (orbax OCDBT), so multi-host slices checkpoint in parallel over DCN;
+- restore takes an *abstract* state (ShapeDtypeStructs carrying
+  NamedShardings), so a checkpoint written on one mesh restores onto a
+  different mesh/topology — resharding happens at load, not via a separate
+  conversion step;
+- saves are async by default: the step returns to training while the write
+  drains in the background (wait() before exit).
+"""
+
+from __future__ import annotations
+
+import jax
+import orbax.checkpoint as ocp
+
+
+def abstract_state(state, shardings=None):
+    """ShapeDtypeStruct skeleton of ``state`` (any pytree of arrays), with
+    ``shardings`` (a matching pytree of NamedShardings) attached when given —
+    the restore target for cross-mesh resume. ``state`` may itself already be
+    abstract (e.g. from jax.eval_shape)."""
+    if shardings is None:
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+    return jax.tree.map(
+        lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+        state, shardings)
+
+
+class TrainCheckpointer:
+    """Checkpoint manager for (params, opt_state) train state.
+
+    Retention and cadence mirror common trainer policy: keep the newest
+    ``max_to_keep`` checkpoints, persist every ``save_interval_steps`` steps
+    (off-cadence saves are no-ops unless forced)."""
+
+    def __init__(self, directory, *, max_to_keep: int = 3,
+                 save_interval_steps: int = 1, async_save: bool = True):
+        self._options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            save_interval_steps=save_interval_steps,
+            enable_async_checkpointing=async_save,
+        )
+        self._mngr = ocp.CheckpointManager(directory, options=self._options)
+
+    # ------------------------------------------------------------------ save
+    def save(self, step: int, params, opt_state, *, force: bool = False) -> bool:
+        """Persist train state at ``step``; returns False when skipped by the
+        save-interval policy."""
+        return self._mngr.save(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardSave(params),
+                opt_state=ocp.args.StandardSave(opt_state)),
+            force=force)
+
+    # --------------------------------------------------------------- restore
+    def latest_step(self) -> int | None:
+        return self._mngr.latest_step()
+
+    def restore(self, abstract_params, abstract_opt_state,
+                step: int | None = None):
+        """Restore (step, params, opt_state); the abstract trees' shardings
+        decide the on-device layout (pass the *target* mesh's shardings to
+        reshard). Returns None when no checkpoint exists at ``step`` (or at
+        all), e.g. when retention already evicted a pinned step."""
+        if step is None:
+            step = self._mngr.latest_step()
+        if step is None or step not in self._mngr.all_steps():
+            return None
+        out = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(abstract_params),
+                opt_state=ocp.args.StandardRestore(abstract_opt_state)))
+        return step, out["params"], out["opt_state"]
+
+    # -------------------------------------------------------------- lifecycle
+    def all_steps(self) -> list[int]:
+        return sorted(self._mngr.all_steps())
+
+    def wait(self) -> None:
+        """Block until pending async saves are durable."""
+        self._mngr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mngr.close()
+
+    def __enter__(self) -> "TrainCheckpointer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.wait()
+        self.close()
