@@ -1,6 +1,8 @@
 from .transformer import TransformerConfig, init_params, forward, param_logical_specs
 from .moe import MoEConfig, init_moe_params, moe_forward, moe_param_logical_specs
+from .decode import init_kv_cache, prefill, decode_step, generate
 
 __all__ = ["TransformerConfig", "init_params", "forward", "param_logical_specs",
            "MoEConfig", "init_moe_params", "moe_forward",
-           "moe_param_logical_specs"]
+           "moe_param_logical_specs",
+           "init_kv_cache", "prefill", "decode_step", "generate"]

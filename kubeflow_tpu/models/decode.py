@@ -1,0 +1,181 @@
+"""Autoregressive decoding: KV cache, prefill, single-token step, generate.
+
+The inference surface of the model families — what a provisioned notebook
+runs when serving/sampling rather than training (the reference provisions
+Jupyter images and has no model code, SURVEY §2d).
+
+TPU-first decode:
+- the KV cache is preallocated at ``max_seq_len`` and updated in place with
+  ``lax.dynamic_update_slice`` — static shapes, no concatenation growth, so
+  the decode step compiles once and XLA keeps the cache in HBM across steps
+  (donated through lax.scan's carry);
+- the causal structure at decode time is a position mask over the full cache
+  (compare against ``arange(max_seq)``), not a data-dependent slice;
+- generation is one ``lax.scan`` over decode steps — a single compiled loop,
+  no per-token Python dispatch;
+- GQA caches the un-repeated kv_heads (memory ∝ n_kv_heads, the point of
+  GQA); heads are repeated after the cache read.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .transformer import (TransformerConfig, apply_rope, attention_block,
+                          mlp_block, rms_norm, rope_frequencies)
+
+
+# ------------------------------------------------------------------- cache
+def init_kv_cache(config: TransformerConfig, batch: int) -> dict:
+    """Zeroed (layers, batch, max_seq, kv_heads, d_head) K/V buffers in the
+    compute dtype."""
+    c = config
+    shape = (c.n_layers, batch, c.max_seq_len, c.n_kv_heads, c.d_head)
+    return {
+        "k": jnp.zeros(shape, c.compute_dtype),
+        "v": jnp.zeros(shape, c.compute_dtype),
+    }
+
+
+def _write_cache(cache_layer: dict, k: jax.Array, v: jax.Array,
+                 start: jax.Array) -> dict:
+    """Write (b, s, h, d) K/V into a (b, max_seq, h, d) layer cache at
+    sequence offset ``start``."""
+    zero = jnp.int32(0)
+    idx = (zero, jnp.asarray(start, jnp.int32), zero, zero)
+    return {
+        "k": lax.dynamic_update_slice(cache_layer["k"], k, idx),
+        "v": lax.dynamic_update_slice(cache_layer["v"], v, idx),
+    }
+
+
+# ----------------------------------------------------------------- prefill
+def prefill(params: dict, tokens: jax.Array, config: TransformerConfig):
+    """Run the prompt through a fresh KV cache.
+
+    tokens: (batch, prompt_len) → (logits (batch, vocab) for the LAST
+    position, cache). Reuses the training forward's attention block
+    (return_kv) so prefill stays a large, MXU-friendly batched pass; prompt
+    lengths with no TPU-tileable divisor fall back to XLA attention inside
+    flash_attention itself (ops/attention.py _pick_block)."""
+    c = config
+    B, S = tokens.shape
+    cache = init_kv_cache(c, B)
+    x = params["embed"].astype(c.compute_dtype)[tokens]
+    positions = jnp.broadcast_to(
+        jnp.arange(S, dtype=jnp.int32)[None, :], tokens.shape)
+    cos, sin = rope_frequencies(c, positions)
+
+    def layer_body(x, layer_and_cache):
+        layer, cache_layer = layer_and_cache
+        x, (k, v) = attention_block(x, layer, c, cos, sin, return_kv=True)
+        cache_layer = _write_cache(cache_layer, k, v, 0)
+        x = mlp_block(x, layer, c)
+        return x, cache_layer
+
+    x, new_cache = lax.scan(layer_body, x, (params["blocks"], cache))
+    x = rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bd,dv->bv", x[:, -1], params["lm_head"].astype(x.dtype))
+    return logits.astype(jnp.float32), new_cache
+
+
+# -------------------------------------------------------------- decode step
+def decode_step(params: dict, cache: dict, token: jax.Array,
+                pos: jax.Array, config: TransformerConfig):
+    """One token in, next-token logits out.
+
+    token: (batch,) int32; pos: scalar int32, the sequence position being
+    written (prompt_len for the first generated token). Attention runs over
+    the full static cache with a ``<= pos`` mask."""
+    c = config
+    B = token.shape[0]
+    x = params["embed"].astype(c.compute_dtype)[token][:, None, :]  # (B,1,D)
+    positions = jnp.broadcast_to(
+        jnp.asarray(pos, jnp.int32)[None, None], (B, 1))
+    cos, sin = rope_frequencies(c, positions)
+    scale = 1.0 / math.sqrt(c.d_head)
+    valid = jnp.arange(c.max_seq_len, dtype=jnp.int32)[None, None, None, :] \
+        <= jnp.asarray(pos, jnp.int32)                       # (1,1,1,S)
+
+    rep = c.n_heads // c.n_kv_heads
+
+    def layer_body(x, layer_and_cache):
+        layer, cache_layer = layer_and_cache
+        h = rms_norm(x, layer["attn_norm"])
+        dt = h.dtype
+        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
+        k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(dt))
+        v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        cache_layer = _write_cache(cache_layer, k, v, pos)
+        # grouped GQA: q heads fold to (kv_heads, rep) and contract against
+        # the UN-repeated cache — head h reads kv head h//rep, matching
+        # repeat_kv's layout, without materializing a rep× cache copy (the
+        # KV-bandwidth saving is the point of GQA)
+        B_, _, H_, D_ = q.shape
+        qg = q.reshape(B_, 1, c.n_kv_heads, rep, D_)
+        ck, cv = cache_layer["k"], cache_layer["v"]     # (B, S, G, D)
+        logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ck,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(valid[:, :, None], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+        out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, cv).reshape(
+            B_, 1, H_, D_)
+        x = x + jnp.einsum("bshk,hkd->bsd", out, layer["wo"].astype(dt))
+        x = mlp_block(x, layer, c)
+        return x, cache_layer
+
+    x, new_cache = lax.scan(layer_body, x, (params["blocks"], cache))
+    x = rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], params["lm_head"].astype(x.dtype))
+    return logits.astype(jnp.float32), new_cache
+
+
+# ---------------------------------------------------------------- generate
+@partial(jax.jit, static_argnames=("config", "max_new_tokens"))
+def generate(params: dict, prompt: jax.Array, config: TransformerConfig,
+             max_new_tokens: int, temperature: float = 0.0,
+             key: jax.Array | None = None) -> jax.Array:
+    """Greedy (temperature=0) or temperature sampling.
+
+    prompt: (batch, prompt_len) → (batch, max_new_tokens). One prefill pass,
+    then a single scanned decode loop. ``temperature`` is traced (serving
+    varies it per request — one compiled executable covers all values; the
+    greedy/sampled choice is a jnp.where, not a recompile)."""
+    c = config
+    B, prompt_len = prompt.shape
+    if prompt_len + max_new_tokens > c.max_seq_len:
+        raise ValueError(
+            f"prompt_len {prompt_len} + max_new_tokens {max_new_tokens} "
+            f"exceeds max_seq_len {c.max_seq_len}")
+    if key is None:
+        key = jax.random.key(0)
+    temperature = jnp.asarray(temperature, jnp.float32)
+
+    logits, cache = prefill(params, prompt, c)
+
+    def pick(logits, k):
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        sampled = jax.random.categorical(
+            k, logits / jnp.maximum(temperature, 1e-6),
+            axis=-1).astype(jnp.int32)
+        return jnp.where(temperature > 0.0, sampled, greedy)
+
+    def step(carry, i):
+        logits, cache, key = carry
+        key, sub = jax.random.split(key)
+        token = pick(logits, sub)
+        logits, cache = decode_step(params, cache, token,
+                                    prompt_len + i, c)
+        return (logits, cache, key), token
+
+    (_, _, _), tokens = lax.scan(
+        step, (logits, cache, key),
+        jnp.arange(max_new_tokens, dtype=jnp.int32))
+    return tokens.T  # (steps, batch) → (batch, steps)

@@ -172,7 +172,10 @@ def _select_attention(config: TransformerConfig, mesh) -> str:
     return "xla"
 
 
-def attention_block(x, layer, config: TransformerConfig, cos, sin, mesh=None):
+def attention_block(x, layer, config: TransformerConfig, cos, sin, mesh=None,
+                    return_kv: bool = False):
+    """``return_kv=True`` additionally returns the post-RoPE, pre-GQA-repeat
+    (k, v) — what a decode KV cache stores (models/decode.py prefill)."""
     c = config
     h = rms_norm(x, layer["attn_norm"])
     q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(h.dtype))
@@ -180,6 +183,7 @@ def attention_block(x, layer, config: TransformerConfig, cos, sin, mesh=None):
     v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(h.dtype))
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
+    kv = (k, v)
     k = repeat_kv(k, c.n_heads // c.n_kv_heads)
     v = repeat_kv(v, c.n_heads // c.n_kv_heads)
 
@@ -192,7 +196,8 @@ def attention_block(x, layer, config: TransformerConfig, cos, sin, mesh=None):
         out = flash_attention(q, k, v, causal=True)
     else:
         out = xla_attention(q, k, v, causal=True)
-    return x + jnp.einsum("bshk,hkd->bsd", out, layer["wo"].astype(h.dtype))
+    x = x + jnp.einsum("bshk,hkd->bsd", out, layer["wo"].astype(h.dtype))
+    return (x, kv) if return_kv else x
 
 
 def mlp_block(x, layer, config: TransformerConfig):
