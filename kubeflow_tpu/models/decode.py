@@ -175,7 +175,12 @@ def generate(params: dict, prompt: jax.Array, config: TransformerConfig,
                                     prompt_len + i, c)
         return (logits, cache, key), token
 
-    (_, _, _), tokens = lax.scan(
+    # scan N-1 steps; the last token needs only a pick from the carried
+    # logits, not another full model step
+    (logits, _, key), tokens = lax.scan(
         step, (logits, cache, key),
-        jnp.arange(max_new_tokens, dtype=jnp.int32))
+        jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
+    _, sub = jax.random.split(key)
+    last = pick(logits, sub)
+    tokens = jnp.concatenate([tokens, last[None]], axis=0)
     return tokens.T  # (steps, batch) → (batch, steps)

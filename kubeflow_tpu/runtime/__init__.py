@@ -1,13 +1,25 @@
 from .bootstrap import SliceEnv, initialize_slice, verify_slice
 
 __all__ = ["SliceEnv", "initialize_slice", "verify_slice",
-           "TrainCheckpointer", "abstract_state"]
+           "TrainCheckpointer", "abstract_state",
+           "Trainer", "TrainerStats",
+           "prefetch_to_device", "synthetic_lm_batches"]
+
+_LAZY = {
+    # checkpoint/trainer pull in orbax, which the orbax-free bootstrap path
+    # (bench, in-container slice verification) must not pay for or require
+    "TrainCheckpointer": "checkpoint",
+    "abstract_state": "checkpoint",
+    "Trainer": "trainer",
+    "TrainerStats": "trainer",
+    "prefetch_to_device": "data",
+    "synthetic_lm_batches": "data",
+}
 
 
 def __getattr__(name):
-    # lazy: checkpoint pulls in orbax, which the orbax-free bootstrap path
-    # (bench, in-container slice verification) must not pay for or require
-    if name in ("TrainCheckpointer", "abstract_state"):
-        from . import checkpoint
-        return getattr(checkpoint, name)
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

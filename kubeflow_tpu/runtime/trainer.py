@@ -1,0 +1,176 @@
+"""Trainer: the training loop a provisioned notebook runs on its slice.
+
+Composes the pieces the framework provides — sharded train step
+(models/train.py, models/moe.py), host input pipeline with device prefetch
+(runtime/data.py), sharded checkpoint/resume (runtime/checkpoint.py) — into
+the loop the culler interrupts and the resume path restarts. The reference
+has no workload code (SURVEY §2d); this is the TPU-native layer its notebook
+images leave to the user.
+
+Loop design for TPU throughput:
+- one jitted step per iteration, params/opt donated; the host never reads
+  the loss inside the loop (``loss.block_until_ready`` only at log points),
+  so steps dispatch ahead of the device — the classic async dispatch queue;
+- input batches arrive pre-sharded from the prefetch thread;
+- checkpoint saves are async (orbax) and ride the save-interval policy;
+- on construction the trainer restores the latest checkpoint if one exists:
+  a culled slice resumes where it stopped, on whatever mesh it now has.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+
+import jax
+from jax.sharding import Mesh
+
+from ..models import moe as moe_model
+from ..models import train as train_lib
+from ..models.moe import MoEConfig
+from ..models.transformer import (TransformerConfig, init_params,
+                                  model_flops_per_token, param_logical_specs)
+from ..parallel.sharding import param_shardings
+from .checkpoint import TrainCheckpointer, abstract_state
+from .data import prefetch_to_device
+
+log = logging.getLogger("kubeflow_tpu.trainer")
+
+
+@dataclass
+class TrainerStats:
+    step: int = 0
+    last_loss: float | None = None
+    tokens_seen: int = 0
+    steps_per_sec: float = 0.0
+    tokens_per_sec: float = 0.0
+    model_tflops_per_sec: float = 0.0
+    losses: list = field(default_factory=list)  # (step, loss) at log points
+
+
+class Trainer:
+    """Drive sharded training with prefetch, periodic checkpointing, and
+    throughput accounting.
+
+    ``config`` may be a dense ``TransformerConfig`` or an ``MoEConfig`` —
+    the matching sharded step is selected automatically.
+    """
+
+    def __init__(self, mesh: Mesh, config: TransformerConfig,
+                 train_config: train_lib.TrainConfig | None = None,
+                 checkpoint_dir=None, *, checkpoint_interval: int = 100,
+                 max_checkpoints: int = 3, seed: int = 0):
+        self.mesh = mesh
+        self.config = config
+        self.tc = train_config or train_lib.TrainConfig()
+        self.is_moe = isinstance(config, MoEConfig)
+        if self.is_moe:
+            self.init_fn, self.step_fn = moe_model.make_sharded_moe_train_step(
+                mesh, config, tc=self.tc)
+        else:
+            self.init_fn, self.step_fn = train_lib.make_sharded_train_step(
+                mesh, config, tc=self.tc)
+        self.stats = TrainerStats()
+        self.checkpointer = None
+        if checkpoint_dir is not None:
+            self.checkpointer = TrainCheckpointer(
+                checkpoint_dir, max_to_keep=max_checkpoints,
+                save_interval_steps=checkpoint_interval)
+        self.params, self.opt_state = self.init_fn(jax.random.key(seed))
+        if self.checkpointer is not None:
+            self._maybe_resume()
+
+    # ------------------------------------------------------------- resume
+    def _restore_targets(self):
+        """Abstract (params, opt_state) with THIS mesh's shardings, so a
+        checkpoint from a different topology reshards on load."""
+        if self.is_moe:
+            specs = moe_model.moe_param_logical_specs(self.config)
+            init = lambda k: moe_model.init_moe_params(k, self.config)  # noqa: E731
+        else:
+            specs = param_logical_specs(self.config)
+            init = lambda k: init_params(k, self.config)  # noqa: E731
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        p_sh = param_shardings(self.mesh, specs)
+        opt_sh = train_lib.opt_state_shardings(
+            train_lib.make_optimizer(self.tc), init, p_sh,
+            NamedSharding(self.mesh, P()))
+        return (abstract_state(self.params, p_sh),
+                abstract_state(self.opt_state, opt_sh))
+
+    def _maybe_resume(self) -> None:
+        abstract_p, abstract_o = self._restore_targets()
+        restored = self.checkpointer.restore(abstract_p, abstract_o)
+        if restored is None:
+            return
+        step, self.params, self.opt_state = restored
+        self.stats.step = step
+        log.info("resumed from checkpoint at step %d", step)
+
+    # --------------------------------------------------------------- loop
+    def fit(self, source, *, steps: int, log_every: int = 50,
+            prefetch_buffer: int = 2) -> TrainerStats:
+        """Train for ``steps`` steps over ``source`` (an iterable of
+        (tokens, targets) host batches). Returns the updated stats; call
+        again to continue (step count persists)."""
+        flops_tok = model_flops_per_token(self.config)
+        target = self.stats.step + steps
+        t0 = time.perf_counter()
+        tokens_t0 = self.stats.tokens_seen
+        loss = None
+        with prefetch_to_device(source, self.mesh,
+                                buffer_size=prefetch_buffer) as batches:
+            for tokens, targets in batches:
+                if self.stats.step >= target:
+                    break
+                self.params, self.opt_state, loss = self.step_fn(
+                    self.params, self.opt_state, tokens, targets)
+                self.stats.step += 1
+                self.stats.tokens_seen += int(tokens.size)
+                if self.checkpointer is not None:
+                    self.checkpointer.save(self.stats.step, self.params,
+                                           self.opt_state)
+                if self.stats.step % log_every == 0 or \
+                        self.stats.step == target:
+                    # the only host sync point in the loop
+                    self.stats.last_loss = float(loss)
+                    self.stats.losses.append(
+                        (self.stats.step, self.stats.last_loss))
+                    dt = time.perf_counter() - t0
+                    dtok = self.stats.tokens_seen - tokens_t0
+                    if dt > 0:
+                        self.stats.tokens_per_sec = dtok / dt
+                        self.stats.steps_per_sec = \
+                            (self.stats.step - (target - steps)) / dt
+                        # 3x forward FLOPs for fwd+bwd, per-device
+                        self.stats.model_tflops_per_sec = (
+                            3 * flops_tok * dtok / dt / 1e12
+                            / max(1, self.mesh.size))
+                    log.info("step %d loss %.4f %.0f tok/s",
+                             self.stats.step, self.stats.last_loss,
+                             self.stats.tokens_per_sec)
+        if loss is not None and self.stats.last_loss is None:
+            self.stats.last_loss = float(loss)
+        return self.stats
+
+    def save(self, *, force: bool = True) -> None:
+        """Durably persist the current step (idempotent: a step the interval
+        policy already wrote is not re-written)."""
+        if self.checkpointer is None:
+            return
+        if self.stats.step not in self.checkpointer.all_steps():
+            self.checkpointer.save(self.stats.step, self.params,
+                                   self.opt_state, force=force)
+        self.checkpointer.wait()
+
+    def close(self) -> None:
+        if self.checkpointer is not None:
+            self.checkpointer.wait()
+            self.checkpointer.close()
+
+    def __enter__(self) -> "Trainer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
