@@ -93,3 +93,62 @@ def factor_devices(n: int) -> MeshConfig:
     """Heuristic mesh for quick-start: tp up to 4 if it divides, rest fsdp."""
     tp = math.gcd(n, 4)
     return MeshConfig.auto(n, tp=tp)
+
+
+# ------------------------------------------------------- multi-slice (DCN)
+def group_by_slice(devices) -> list[list]:
+    """Group devices by their TPU slice. Real multi-slice TPU devices carry
+    ``slice_index``; devices without it (CPU, single slice) land in one
+    group. Groups are ordered by slice index; within a group the caller's
+    device order is preserved (like build_mesh — callers may pass a
+    torus-ordered list from mesh_utils)."""
+    groups: dict[int, list] = {}
+    for d in devices:
+        groups.setdefault(getattr(d, "slice_index", 0), []).append(d)
+    return [groups[k] for k in sorted(groups)]
+
+
+def build_hybrid_mesh(n_slices: int, per_slice: MeshConfig,
+                      devices=None) -> tuple[Mesh, MeshConfig]:
+    """Multi-slice mesh: ``dp`` spans slices (DCN), every other axis stays
+    inside a slice (ICI).
+
+    This is the sharding-recipe shape for TPU multislice: the only
+    per-step cross-slice traffic is the gradient all-reduce on ``dp``,
+    which tolerates DCN latency, while fsdp all-gathers, tp all-reduces,
+    sp ring permutes, and ep all-to-alls ride the intra-slice torus
+    (mesh_utils.create_hybrid_device_mesh encodes the same rule; this
+    builder additionally works with explicit/virtual device lists, where
+    devices are chunked into equal contiguous slices).
+
+    Returns (mesh, full_config) — the full config is ``per_slice`` with
+    ``dp`` multiplied by ``n_slices``, usable anywhere a MeshConfig is.
+    """
+    if devices is None:
+        devices = jax.devices()
+    total = n_slices * per_slice.size
+    if len(devices) != total:
+        raise ValueError(f"{n_slices} slices × per-slice size "
+                         f"{per_slice.size} != {len(devices)} devices")
+    groups = group_by_slice(devices)
+    if len(groups) == 1 and n_slices > 1:
+        # virtual/CPU devices carry no slice_index: chunk contiguously
+        flat = groups[0]
+        groups = [flat[i * per_slice.size:(i + 1) * per_slice.size]
+                  for i in range(n_slices)]
+    if len(groups) != n_slices:
+        raise ValueError(f"devices span {len(groups)} slices, expected "
+                         f"{n_slices}")
+    for i, g in enumerate(groups):
+        if len(g) != per_slice.size:
+            raise ValueError(
+                f"slice {i} has {len(g)} devices, per-slice mesh needs "
+                f"{per_slice.size} ({per_slice.axis_sizes()})")
+    per_shape = tuple(getattr(per_slice, a) for a in AXES)
+    slice_arrays = [np.asarray(g).reshape(per_shape) for g in groups]
+    # stack along dp: (n_slices * per_dp, fsdp, pp, sp, tp, ep)
+    arr = np.concatenate(slice_arrays, axis=0)
+    full = MeshConfig(dp=n_slices * per_slice.dp, fsdp=per_slice.fsdp,
+                      pp=per_slice.pp, sp=per_slice.sp, tp=per_slice.tp,
+                      ep=per_slice.ep)
+    return Mesh(arr, AXES), full
