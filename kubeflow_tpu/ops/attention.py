@@ -9,9 +9,16 @@ stream, they never have to fit in VMEM. The running (max, sum, accumulator)
 recurrence lives in VMEM scratch that persists across the kv grid steps.
 Causal masking skips fully-masked kv blocks' compute via pl.when.
 
-Backward currently recomputes through the XLA reference path via custom_vjp
-(correct everywhere; a dedicated backward kernel is a later optimization).
-On non-TPU backends the kernel runs in interpreter mode for tests.
+Backward is a pair of FlashAttention-2-style Pallas kernels (no O(s²)
+materialization): the forward additionally emits the per-row log-sum-exp
+(lane-replicated (b, h, s, 128) float32, the same layout jax's own TPU
+kernel uses), the host computes Δ = rowsum(dO ⊙ O), then
+- the dKV kernel runs grid (b, h, kv_blocks, q_blocks) with q innermost,
+  accumulating dK/dV for its kv block across q blocks in VMEM scratch;
+- the dQ kernel runs grid (b, h, q_blocks, kv_blocks) with kv innermost.
+Both rebuild P = exp(S − lse) from the residuals (recompute-over-store, the
+flash trade), mask causally, and skip fully-masked blocks via pl.when.
+On non-TPU backends the kernels run in interpreter mode for tests.
 """
 
 from __future__ import annotations
@@ -30,8 +37,14 @@ DEFAULT_BLOCK_K = 512
 _LANES = 128  # per-row stats are stored lane-replicated for (8,128) tiling
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  scale: float, causal: bool, num_kv: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                  scale: float, causal: bool, num_kv: int,
+                  with_lse: bool = False):
+    if with_lse:
+        lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        lse_ref = None
+        m_scr, l_scr, acc_scr = rest
     qi = pl.program_id(2)
     kj = pl.program_id(3)
     block_q = q_ref.shape[2]
@@ -50,11 +63,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # (bq, bk)
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = kj * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            mask = q_pos >= k_pos
+            mask = _causal_mask(qi, kj, block_q, block_k)
             logits = jnp.where(mask, logits, _NEG_INF)
         m_prev = m_scr[:, :1]                                # (bq, 1)
         l_prev = l_scr[:, :1]
@@ -81,10 +90,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
     def _finalize():
         denom = jnp.maximum(l_scr[:, :1], 1e-30)
         o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+        if lse_ref is not None:
+            lse_ref[0, 0] = m_scr[:] + jnp.log(
+                jnp.maximum(l_scr[:], 1e-30))
 
 
 def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
-                   interpret: bool):
+                   interpret: bool, save_residuals: bool = False):
     b, s, h, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
@@ -100,8 +112,17 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
     num_kv = s // block_k
     grid = (b, h, s // block_q, num_kv)
     kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               num_kv=num_kv)
-    out = pl.pallas_call(
+                               num_kv=num_kv,
+                               with_lse=save_residuals)
+    out_shape = [jax.ShapeDtypeStruct(qt.shape, q.dtype)]
+    out_specs = [pl.BlockSpec((1, 1, block_q, d),
+                              lambda bi, hi, qi, kj: (bi, hi, qi, 0))]
+    if save_residuals:
+        out_shape.append(
+            jax.ShapeDtypeStruct((b, h, s, _LANES), jnp.float32))
+        out_specs.append(pl.BlockSpec((1, 1, block_q, _LANES),
+                                      lambda bi, hi, qi, kj: (bi, hi, qi, 0)))
+    outs = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -112,9 +133,8 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, hi, qi, kj: (bi, hi, kj, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d),
-                               lambda bi, hi, qi, kj: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # running sum
@@ -125,7 +145,172 @@ def _flash_forward(q, k, v, causal: bool, block_q: int, block_k: int,
                                  "arbitrary")),
         interpret=interpret,
     )(qt, kt, vt)
-    return out.transpose(0, 2, 1, 3)
+    out = outs[0].transpose(0, 2, 1, 3)
+    if save_residuals:
+        return out, outs[1]
+    return out
+
+
+# ---------------------------------------------------------------- backward
+def _causal_mask(qi, kj, block_q, block_k):
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return q_pos >= k_pos
+
+
+def _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    qi, kj, scale: float, causal: bool):
+    """Shared FA2 backward math: rebuild P = exp(S − lse) from residuals and
+    form dS = P ⊙ (dO·Vᵀ − Δ)·scale. Both backward kernels consume (p, ds,
+    q, do) — keeping it in one place keeps dQ consistent with dK/dV."""
+    q = q_ref[0, 0].astype(jnp.float32)                  # (bq, d)
+    k = k_ref[0, 0].astype(jnp.float32)                  # (bk, d)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)                # (bq, d)
+    lse = lse_ref[0, 0][:, :1]                           # (bq, 1)
+    delta = delta_ref[0, 0][:, :1]                       # (bq, 1)
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale      # (bq, bk)
+    p = jnp.exp(logits - lse)
+    if causal:
+        block_q, block_k = q.shape[0], k.shape[0]
+        p = jnp.where(_causal_mask(qi, kj, block_q, block_k), p, 0.0)
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)              # (bq, bk)
+    ds = p * (dp - delta) * scale
+    return p, ds, q, do
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_scr, dv_scr, *,
+                          scale: float, causal: bool, num_q: int):
+    kj = pl.program_id(2)
+    qi = pl.program_id(3)
+    block_k = k_ref.shape[2]
+    block_q = q_ref.shape[2]
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def compute():
+        p, ds, q, do = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                       delta_ref, qi, kj, scale, causal)
+        # dV += Pᵀ · dO;  dK += dSᵀ · Q
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, d)
+        dk_scr[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (bk, d)
+
+    if causal:
+        # q blocks strictly above the diagonal see none of this kv block
+        pl.when(qi * block_q + block_q - 1 >= kj * block_k)(compute)
+    else:
+        compute()
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *,
+                         scale: float, causal: bool, num_kv: int):
+    qi = pl.program_id(2)
+    kj = pl.program_id(3)
+    block_q = q_ref.shape[2]
+    block_k = k_ref.shape[2]
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def compute():
+        _, ds, _, _ = _recompute_p_ds(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                      delta_ref, qi, kj, scale, causal)
+        # dQ += dS · K
+        dq_scr[:] += jax.lax.dot(ds, k_ref[0, 0].astype(jnp.float32),
+                                 preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(kj * block_k <= qi * block_q + block_q - 1)(compute)
+    else:
+        compute()
+
+    @pl.when(kj == num_kv - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, causal: bool, block_q: int,
+                    block_k: int, interpret: bool):
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    scale = 1.0 / math.sqrt(d)
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    dot = g.transpose(0, 2, 1, 3)
+    ot = o.transpose(0, 2, 1, 3)
+    # Δ = rowsum(dO ⊙ O), lane-replicated like lse
+    delta = jnp.broadcast_to(
+        jnp.sum(dot.astype(jnp.float32) * ot.astype(jnp.float32), axis=-1,
+                keepdims=True), (b, h, s, _LANES))
+    num_q, num_kv = s // block_q, s // block_k
+
+    q_spec = pl.BlockSpec((1, 1, block_q, d),
+                          lambda bi, hi, kj, qi: (bi, hi, qi, 0))
+    kv_spec = pl.BlockSpec((1, 1, block_k, d),
+                           lambda bi, hi, kj, qi: (bi, hi, kj, 0))
+    lane_spec = pl.BlockSpec((1, 1, block_q, _LANES),
+                             lambda bi, hi, kj, qi: (bi, hi, qi, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, scale=scale, causal=causal,
+                          num_q=num_q),
+        grid=(b, h, num_kv, num_q),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, lane_spec, lane_spec],
+        out_specs=[kv_spec, kv_spec],
+        out_shape=[jax.ShapeDtypeStruct(kt.shape, k.dtype),
+                   jax.ShapeDtypeStruct(vt.shape, v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+
+    q_spec2 = pl.BlockSpec((1, 1, block_q, d),
+                           lambda bi, hi, qi, kj: (bi, hi, qi, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, block_k, d),
+                            lambda bi, hi, qi, kj: (bi, hi, kj, 0))
+    lane_spec2 = pl.BlockSpec((1, 1, block_q, _LANES),
+                              lambda bi, hi, qi, kj: (bi, hi, qi, 0))
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, scale=scale, causal=causal,
+                          num_kv=num_kv),
+        grid=(b, h, num_q, num_kv),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, lane_spec2,
+                  lane_spec2],
+        out_specs=q_spec2,
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, delta)
+    return (dq.transpose(0, 2, 1, 3), dk.transpose(0, 2, 1, 3),
+            dv.transpose(0, 2, 1, 3))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -135,17 +320,17 @@ def _flash(q, k, v, causal, block_q, block_k):
 
 
 def _flash_fwd(q, k, v, causal, block_q, block_k):
-    return _flash(q, k, v, causal, block_q, block_k), (q, k, v)
+    interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret,
+                              save_residuals=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, res, g):
-    # Recompute-based backward through the XLA reference (exact); a fused
-    # backward kernel replaces this on the optimization pass.
-    from ..models.transformer import xla_attention
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: xla_attention(q, k, v, causal=causal),
-                     q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    interpret = jax.default_backend() != "tpu"
+    return _flash_backward(q, k, v, o, lse, g, causal, block_q, block_k,
+                           interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
