@@ -45,7 +45,7 @@ class TransformerConfig:
     dtype: str = "bfloat16"      # activation/compute dtype
     param_dtype: str = "float32"
     remat: bool = False
-    attention: str = "auto"      # auto | xla | ring | flash
+    attention: str = "auto"      # auto | xla | ring | ulysses | flash
 
     @property
     def d_head(self) -> int:
@@ -184,18 +184,26 @@ def attention_block(x, layer, config: TransformerConfig, cos, sin, mesh=None,
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     kv = (k, v)
-    k = repeat_kv(k, c.n_heads // c.n_kv_heads)
-    v = repeat_kv(v, c.n_heads // c.n_kv_heads)
+    n_rep = c.n_heads // c.n_kv_heads
 
     kind = _select_attention(c, mesh)
-    if kind == "ring":
-        from ..parallel.ring import ring_attention
-        out = ring_attention(q, k, v, mesh=mesh, axis_name="sp", causal=True)
-    elif kind == "flash":
-        from ..ops.attention import flash_attention
-        out = flash_attention(q, k, v, causal=True)
+    if kind == "ulysses":
+        # takes the un-repeated K/V: its all-to-alls move 1/n_rep the bytes
+        from ..parallel.ulysses import ulysses_attention
+        out = ulysses_attention(q, k, v, mesh=mesh, axis_name="sp",
+                                causal=True, n_rep=n_rep)
     else:
-        out = xla_attention(q, k, v, causal=True)
+        k = repeat_kv(k, n_rep)
+        v = repeat_kv(v, n_rep)
+        if kind == "ring":
+            from ..parallel.ring import ring_attention
+            out = ring_attention(q, k, v, mesh=mesh, axis_name="sp",
+                                 causal=True)
+        elif kind == "flash":
+            from ..ops.attention import flash_attention
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            out = xla_attention(q, k, v, causal=True)
     x = x + jnp.einsum("bshk,hkd->bsd", out, layer["wo"].astype(h.dtype))
     return (x, kv) if return_kv else x
 

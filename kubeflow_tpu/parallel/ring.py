@@ -54,8 +54,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """Global-view ring attention. q/k/v: (batch, seq, heads, d_head) with
     seq sharded over ``axis_name``; returns same shape/sharding as q.
 
-    Callable inside jit; shard_map handles the global→per-device view."""
-    sp = mesh.shape[axis_name]
+    Callable inside jit; shard_map handles the global→per-device view.
+    Falls back to local attention when no mesh is in play (decode prefill
+    and pipeline stages call attention with mesh=None)."""
+    sp = mesh.shape[axis_name] if mesh is not None else 1
     if sp == 1:
         from ..models.transformer import xla_attention
         return xla_attention(q, k, v, causal=causal)

@@ -1,0 +1,92 @@
+"""Ulysses-style sequence parallelism: all-to-all head/sequence exchange.
+
+The second long-context scheme (SURVEY preamble: "ring attention OR
+all-to-all sequence/context parallelism"), complementing parallel/ring.py.
+Where ring attention keeps the sequence sharded and rotates K/V around the
+``sp`` ring (sp ppermutes of the K/V blocks per layer), Ulysses trades two
+all-to-alls for fully local attention: scatter heads / gather sequence, run
+the exact attention kernel on the full sequence with heads/sp heads per
+device, then scatter sequence / gather heads back. Communication volume per
+device is O(seq/sp · d · heads) per all-to-all, independent of sp — usually
+cheaper than ring on meshes where sp is large and heads are plentiful, while
+ring wins when heads/sp would not divide or the per-device full-seq logits
+would not fit.
+
+Technique after Jacobs et al., "DeepSpeed Ulysses" (arXiv:2309.14509);
+implementation is original, built on shard_map + lax.all_to_all.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.6 exposes shard_map at top level
+    from jax import shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                      mesh: Mesh | None, axis_name: str = "sp",
+                      causal: bool = True, n_rep: int = 1) -> jax.Array:
+    """Global-view Ulysses attention. q: (batch, seq, heads, d_head), k/v:
+    (batch, seq, heads/n_rep, d_head) — GQA callers pass the UN-repeated
+    K/V plus ``n_rep`` so the K/V all-to-alls move 1/n_rep the bytes; the
+    repeat happens after the exchange (chunk-aligned because consecutive-head
+    repeat and the head split commute). Sequence is sharded over
+    ``axis_name``; returns q's shape/sharding.
+
+    The per-device q head count (heads already divided by tp) must be
+    divisible by the ``sp`` axis size. Callable inside jit. Falls back to
+    local attention when no mesh is in play (decode prefill and pipeline
+    stages call attention with mesh=None)."""
+    sp = mesh.shape[axis_name] if mesh is not None else 1
+    if sp == 1:
+        from ..models.transformer import repeat_kv, xla_attention
+        return xla_attention(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
+                             causal=causal)
+
+    tp = mesh.shape.get("tp", 1)
+    heads_local = q.shape[2] // tp
+    if heads_local % sp:
+        raise ValueError(
+            f"ulysses needs per-device heads ({q.shape[2]}/tp={heads_local}) "
+            f"divisible by sp={sp}; use ring attention for this shape")
+    kv_heads_local = k.shape[2] // tp
+    # exchange-then-repeat only when the kv head chunks stay aligned
+    repeat_after = n_rep > 1 and kv_heads_local % sp == 0
+
+    spec = P(("dp", "fsdp"), axis_name, "tp", None)
+
+    @partial(shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+             out_specs=spec, check_vma=False)
+    def _ulysses(q_blk, k_blk, v_blk):
+        from ..models.transformer import repeat_kv
+
+        # (b, s/sp, h, d) → (b, s, h/sp, d): scatter heads, gather sequence
+        def fwd(x):
+            return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        if not repeat_after:
+            k_in, v_in = repeat_kv(k_blk, n_rep), repeat_kv(v_blk, n_rep)
+        else:
+            k_in, v_in = k_blk, v_blk
+        qf, kf, vf = fwd(q_blk), fwd(k_in), fwd(v_in)
+        if repeat_after:
+            kf, vf = repeat_kv(kf, n_rep), repeat_kv(vf, n_rep)
+        if jax.default_backend() == "tpu":
+            from ..ops.attention import flash_attention
+            out = flash_attention(qf, kf, vf, causal=causal)
+        else:
+            from ..models.transformer import xla_attention
+            out = xla_attention(qf, kf, vf, causal=causal)
+        # (b, s, h/sp, d) → (b, s/sp, h, d): scatter sequence, gather heads
+        return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    return _ulysses(q, k, v)

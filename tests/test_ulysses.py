@@ -1,0 +1,128 @@
+"""Ulysses all-to-all sequence parallelism vs the exact reference, and the
+sharded train step with attention='ulysses'."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.transformer import (TransformerConfig, forward,
+                                             init_params, xla_attention)
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubeflow_tpu.parallel.ulysses import ulysses_attention
+
+
+def qkv(b=4, s=64, h=4, d=16):
+    keys = jax.random.split(jax.random.key(0), 3)
+    return tuple(jax.random.normal(k, (b, s, h, d)) for k in keys)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("sp", [2, 4])
+def test_ulysses_matches_reference(causal, sp):
+    mesh = build_mesh(MeshConfig.auto(8, sp=sp, fsdp=8 // sp),
+                      devices=jax.devices()[:8])
+    q, k, v = qkv()
+    ref = xla_attention(q, k, v, causal=causal)
+    got = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, mesh=mesh, causal=causal))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_with_tp_mesh():
+    """Heads shard over tp first; the per-device remainder splits over sp."""
+    mesh = build_mesh(MeshConfig.auto(8, tp=2, sp=2),
+                      devices=jax.devices()[:8])
+    q, k, v = qkv(h=8)
+    ref = xla_attention(q, k, v, causal=True)
+    got = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, mesh=mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = build_mesh(MeshConfig.auto(8, tp=2, sp=4),
+                      devices=jax.devices()[:8])
+    q, k, v = qkv(h=4)  # 4/tp=2 heads per device, sp=4 does not divide
+    with pytest.raises(ValueError, match="ring attention for this shape"):
+        ulysses_attention(q, k, v, mesh=mesh)
+
+
+def test_forward_with_ulysses_matches_xla():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                            n_kv_heads=4, d_ff=48, dtype="float32",
+                            max_seq_len=64, attention="ulysses")
+    mesh = build_mesh(MeshConfig.auto(8, sp=2, fsdp=4),
+                      devices=jax.devices()[:8])
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0, 64)
+    got = jax.jit(lambda p, t: forward(p, t, cfg, mesh=mesh))(params, tokens)
+    ref = forward(params, tokens, cfg.replace(attention="xla"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_train_step():
+    from kubeflow_tpu.models.train import TrainConfig, make_sharded_train_step
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=2, n_heads=4,
+                            n_kv_heads=4, d_ff=48, dtype="float32",
+                            max_seq_len=64, attention="ulysses")
+    mesh = build_mesh(MeshConfig.auto(8, sp=2, tp=2),
+                      devices=jax.devices()[:8])
+    init_fn, step_fn = make_sharded_train_step(mesh, cfg,
+                                               tc=TrainConfig(warmup_steps=1))
+    params, opt = init_fn(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0, 64)
+    targets = jnp.roll(tokens, -1, axis=1)
+    _, _, loss = step_fn(params, opt, tokens, targets)
+    assert bool(jnp.isfinite(loss))
+
+
+def test_ulysses_gqa_unrepeated_kv_matches_reference():
+    """GQA path: k/v passed un-repeated with n_rep, exchanged at kv width,
+    repeated after — must equal reference attention on repeated K/V."""
+    from kubeflow_tpu.models.transformer import repeat_kv
+    mesh = build_mesh(MeshConfig.auto(8, sp=2, fsdp=4),
+                      devices=jax.devices()[:8])
+    keys = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(keys[0], (4, 64, 8, 16))
+    k = jax.random.normal(keys[1], (4, 64, 2, 16))   # n_rep = 4
+    v = jax.random.normal(keys[2], (4, 64, 2, 16))
+    ref = xla_attention(q, repeat_kv(k, 4), repeat_kv(v, 4), causal=True)
+    got = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, mesh=mesh, n_rep=4))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gqa_forward_with_ulysses_matches_xla():
+    cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=1, n_heads=4,
+                            n_kv_heads=2, d_ff=48, dtype="float32",
+                            max_seq_len=64, attention="ulysses")
+    mesh = build_mesh(MeshConfig.auto(8, sp=2, fsdp=4),
+                      devices=jax.devices()[:8])
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0, 64)
+    got = jax.jit(lambda p, t: forward(p, t, cfg, mesh=mesh))(params, tokens)
+    ref = forward(params, tokens, cfg.replace(attention="xla"))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_and_ring_tolerate_mesh_none():
+    """attention='ulysses'/'ring' through mesh=None call paths (decode
+    prefill, pipeline stages) falls back to local attention."""
+    from kubeflow_tpu.models.decode import prefill
+    for kind in ("ulysses", "ring"):
+        cfg = TransformerConfig(vocab_size=64, d_model=32, n_layers=1,
+                                n_heads=4, n_kv_heads=2, d_ff=48,
+                                dtype="float32", max_seq_len=32,
+                                attention=kind)
+        params = init_params(jax.random.key(0), cfg)
+        tokens = jax.random.randint(jax.random.key(1), (2, 8), 0, 64)
+        logits, _ = prefill(params, tokens, cfg)
+        ref, _ = prefill(params, tokens, cfg.replace(attention="xla"))
+        np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)
