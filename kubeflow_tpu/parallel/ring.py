@@ -49,7 +49,7 @@ def _block_attention(q, k, v, *, scale, mask):
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                   mesh: Mesh, axis_name: str = "sp",
+                   mesh: Mesh | None, axis_name: str = "sp",
                    causal: bool = True) -> jax.Array:
     """Global-view ring attention. q/k/v: (batch, seq, heads, d_head) with
     seq sharded over ``axis_name``; returns same shape/sharding as q.

@@ -56,6 +56,13 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         raise ValueError(
             f"ulysses needs per-device heads ({q.shape[2]}/tp={heads_local}) "
             f"divisible by sp={sp}; use ring attention for this shape")
+    if k.shape[2] % tp:
+        # kv heads don't divide tp (possible with aggressive GQA): repeat
+        # K/V up to q's head count BEFORE sharding so the tp split holds —
+        # full-width exchange, correctness over the bandwidth saving
+        from ..models.transformer import repeat_kv
+        k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
+        n_rep = 1
     kv_heads_local = k.shape[2] // tp
     # exchange-then-repeat only when the kv head chunks stay aligned
     repeat_after = n_rep > 1 and kv_heads_local % sp == 0

@@ -60,7 +60,8 @@ class Trainer:
     def __init__(self, mesh: Mesh, config: TransformerConfig,
                  train_config: train_lib.TrainConfig | None = None,
                  checkpoint_dir=None, *, checkpoint_interval: int = 100,
-                 max_checkpoints: int = 3, seed: int = 0):
+                 max_checkpoints: int = 3, seed: int = 0,
+                 profile_dir=None, profile_steps: tuple = (10, 15)):
         self.mesh = mesh
         self.config = config
         self.tc = train_config or train_lib.TrainConfig()
@@ -77,6 +78,12 @@ class Trainer:
             self.checkpointer = TrainCheckpointer(
                 checkpoint_dir, max_to_keep=max_checkpoints,
                 save_interval_steps=checkpoint_interval)
+        # optional XLA/TPU trace window (the aux-subsystem analog of the
+        # reference's OTel webhook spans, SURVEY §5 — but for the workload:
+        # view with tensorboard / xprof)
+        self.profile_dir = str(profile_dir) if profile_dir else None
+        self.profile_steps = profile_steps
+        self._profiling = False
         self.params, self.opt_state = self.init_fn(jax.random.key(seed))
         if self.checkpointer is not None:
             self._maybe_resume()
@@ -124,6 +131,7 @@ class Trainer:
             for tokens, targets in batches:
                 if self.stats.step >= target:
                     break
+                self._profile_tick()
                 self.params, self.opt_state, loss = self.step_fn(
                     self.params, self.opt_state, tokens, targets)
                 self.stats.step += 1
@@ -154,6 +162,21 @@ class Trainer:
             self.stats.last_loss = float(loss)
         return self.stats
 
+    def _profile_tick(self) -> None:
+        """Open/close the jax.profiler trace when the step counter crosses
+        the [start, stop) profile window."""
+        if self.profile_dir is None:
+            return
+        start, stop = self.profile_steps
+        if not self._profiling and self.stats.step == start:
+            jax.profiler.start_trace(self.profile_dir)
+            self._profiling = True
+        elif self._profiling and self.stats.step >= stop:
+            jax.tree.map(lambda x: x.block_until_ready(), self.params)
+            jax.profiler.stop_trace()
+            self._profiling = False
+            log.info("profile trace written to %s", self.profile_dir)
+
     def save(self, *, force: bool = True) -> None:
         """Durably persist the current step (idempotent: a step the interval
         policy already wrote is not re-written)."""
@@ -165,6 +188,9 @@ class Trainer:
         self.checkpointer.wait()
 
     def close(self) -> None:
+        if self._profiling:
+            jax.profiler.stop_trace()
+            self._profiling = False
         if self.checkpointer is not None:
             self.checkpointer.wait()
             self.checkpointer.close()

@@ -211,3 +211,72 @@ def test_e2e_two_notebooks_share_reference_grant(cluster):
     wait_for(lambda: store.get_or_none(
         "ReferenceGrant", "shared-ns", routes.REFERENCE_GRANT_NAME) is None,
         msg="grant removed with last notebook")
+
+
+# --------------------------------------------------- BASELINE.json configs
+
+def test_e2e_baseline_configs(cluster):
+    """The five judged configurations (BASELINE.json `configs`), end to end
+    through the production stack: rendered shape asserted per config, plus
+    slice-atomic cull+resume on the auth-enabled v5e-16."""
+    store, config, mgr = cluster
+    ns = "baseline"
+
+    # 1: minimal CPU notebook — no accelerator, no TPU surface
+    store.create(api.new_notebook("cpu-nb", ns, image="jupyter-minimal"))
+    wait_for(lambda: _slice_ready(store, ns, "cpu-nb"), msg="cpu ready")
+    sts = store.get("StatefulSet", ns, "cpu-nb")
+    c = sts["spec"]["template"]["spec"]["containers"][0]
+    assert sts["spec"]["replicas"] == 1
+    assert "google.com/tpu" not in (c.get("resources", {})
+                                    .get("limits", {}))
+    assert "nodeSelector" not in sts["spec"]["template"]["spec"]
+
+    # 2-4: v5e-1 (single chip), v5e-4 (single host), v5e-16 (multi host)
+    shapes = {"v5e-1": (1, 1), "v5e-4": (1, 4), "v5e-16": (4, 4)}
+    for acc, (workers, chips) in shapes.items():
+        name = acc.replace("v5e-", "tpu")
+        _create_notebook(store, name, ns, accelerator=acc)
+        wait_for(lambda n=name: _slice_ready(store, ns, n), msg=f"{acc} ready")
+        sts = store.get("StatefulSet", ns, name)
+        pod = sts["spec"]["template"]["spec"]
+        c = pod["containers"][0]
+        assert sts["spec"]["replicas"] == workers, acc
+        assert c["resources"]["limits"]["google.com/tpu"] == str(chips), acc
+        sel = pod["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == \
+            "tpu-v5-lite-podslice"
+        env = k8s.env_list_to_dict(
+            [e for e in c["env"] if "value" in e])
+        if workers > 1:
+            assert store.get_or_none("Service", ns, f"{name}-workers")
+            assert "TPU_WORKER_HOSTNAMES" in env
+            assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == workers
+        else:
+            assert store.get_or_none("Service", ns, f"{name}-workers") is None
+
+    # 5: culling + auth sidecar on v5e-16 — slice-atomic reap and resume
+    _create_notebook(store, "authed", ns, accelerator="v5e-16", auth=True)
+    wait_for(lambda: _slice_ready(store, ns, "authed"), msg="authed ready")
+    sts = store.get("StatefulSet", ns, "authed")
+    names_ = [c["name"] for c in sts["spec"]["template"]["spec"]["containers"]]
+    assert any("proxy" in n or "auth" in n for n in names_), names_
+    wait_for(lambda: len([p for p in store.list("Pod", ns)
+                          if k8s.get_label(p, "notebook-name") == "authed"])
+             == 4, msg="4 workers")
+    # the culler's stop annotation reaps ALL workers atomically
+    nb = store.get(api.KIND, ns, "authed")
+    k8s.set_annotation(nb, names.STOP_ANNOTATION, "2026-07-29T00:00:00Z")
+    store.update(nb)
+    wait_for(lambda: store.get("StatefulSet", ns, "authed")
+             ["spec"]["replicas"] == 0, msg="scaled to 0")
+    wait_for(lambda: not [p for p in store.list("Pod", ns)
+                          if k8s.get_label(p, "notebook-name") == "authed"],
+             msg="all workers reaped")
+    # resume restores the FULL worker count (never partial)
+    nb = store.get(api.KIND, ns, "authed")
+    k8s.remove_annotation(nb, names.STOP_ANNOTATION)
+    store.update(nb)
+    wait_for(lambda: store.get("StatefulSet", ns, "authed")
+             ["spec"]["replicas"] == 4, msg="resumed to 4")
+    wait_for(lambda: _slice_ready(store, ns, "authed"), msg="ready again")

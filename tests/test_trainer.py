@@ -126,3 +126,14 @@ def test_trainer_checkpoints_and_resumes(tmp_path):
                                              n_batches=5, seed=3),
                         steps=5, log_every=5)
     assert stats.step == 15
+
+
+def test_trainer_profile_window_writes_trace(tmp_path):
+    cfg = tiny_config()
+    with Trainer(mesh8(), cfg, TrainConfig(warmup_steps=1),
+                 profile_dir=tmp_path / "trace",
+                 profile_steps=(1, 3)) as tr:
+        tr.fit(synthetic_lm_batches(8, 16, cfg.vocab_size, n_batches=5),
+               steps=5, log_every=10)
+    trace_files = list((tmp_path / "trace").rglob("*"))
+    assert any(f.is_file() for f in trace_files), "no trace output written"

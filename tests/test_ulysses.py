@@ -126,3 +126,20 @@ def test_ulysses_and_ring_tolerate_mesh_none():
         ref, _ = prefill(params, tokens, cfg.replace(attention="xla"))
         np.testing.assert_allclose(np.asarray(logits), np.asarray(ref),
                                    rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_gqa_kv_heads_not_divisible_by_tp():
+    """n_kv_heads < tp: K/V repeat to full width before sharding instead of
+    crashing in shard_map (review finding)."""
+    from kubeflow_tpu.models.transformer import repeat_kv
+    mesh = build_mesh(MeshConfig.auto(8, tp=4, sp=2),
+                      devices=jax.devices()[:8])
+    keys = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(keys[0], (2, 64, 8, 16))
+    k = jax.random.normal(keys[1], (2, 64, 2, 16))   # 2 kv heads, tp=4
+    v = jax.random.normal(keys[2], (2, 64, 2, 16))
+    ref = xla_attention(q, repeat_kv(k, 4), repeat_kv(v, 4), causal=True)
+    got = jax.jit(lambda q, k, v: ulysses_attention(
+        q, k, v, mesh=mesh, n_rep=4))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
