@@ -26,7 +26,6 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -224,11 +223,14 @@ def moe_loss_fn(params, tokens, targets, config: MoEConfig, mesh=None):
 
 
 def make_sharded_moe_train_step(mesh: Mesh, config: MoEConfig,
-                                tc=None, rules: PartitionRules | None = None):
+                                tc=None, rules: PartitionRules | None = None,
+                                accum_steps: int = 1):
     """(init_fn, step_fn) jitted over ``mesh`` with dp/fsdp/tp/sp/ep
     shardings — the MoE counterpart of train.make_sharded_train_step (which
-    documents the opt-state sharding scheme; pp is a dense-model feature)."""
-    from .train import TrainConfig, make_optimizer, opt_state_shardings
+    documents the opt-state sharding scheme and the accum_steps microbatch
+    contract; pp is a dense-model feature)."""
+    from .train import (TrainConfig, accumulated_value_and_grad,
+                        apply_update, make_optimizer, opt_state_shardings)
 
     if mesh.shape.get("pp", 1) > 1:
         raise NotImplementedError("MoE + pipeline parallelism not supported; "
@@ -237,7 +239,7 @@ def make_sharded_moe_train_step(mesh: Mesh, config: MoEConfig,
     rules = rules or PartitionRules()
     optimizer = make_optimizer(tc)
     p_shardings = param_shardings(mesh, moe_param_logical_specs(config), rules)
-    batch_sh = batch_sharding(mesh)
+    batch_sh = batch_sharding(mesh, accum=accum_steps > 1)
     replicated = NamedSharding(mesh, P())
     opt_shardings = opt_state_shardings(
         optimizer, lambda k: init_moe_params(k, config), p_shardings,
@@ -253,10 +255,14 @@ def make_sharded_moe_train_step(mesh: Mesh, config: MoEConfig,
              out_shardings=(p_shardings, opt_shardings, replicated),
              donate_argnums=(0, 1))
     def step_fn(params, opt_state, tokens, targets):
-        loss, grads = jax.value_and_grad(moe_loss_fn)(
-            params, tokens, targets, config, mesh)
-        updates, opt_state = optimizer.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        if accum_steps == 1:
+            loss, grads = jax.value_and_grad(moe_loss_fn)(
+                params, tokens, targets, config, mesh)
+        else:
+            loss, grads = accumulated_value_and_grad(
+                lambda p, t, tg: moe_loss_fn(p, t, tg, config, mesh),
+                params, tokens, targets)
+        params, opt_state = apply_update(optimizer, params, opt_state, grads)
         return params, opt_state, loss
 
     return init_fn, step_fn

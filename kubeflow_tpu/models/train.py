@@ -13,9 +13,11 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import optax
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..parallel.sharding import DEFAULT_RULES, PartitionRules, param_shardings
+from ..parallel.sharding import (DEFAULT_RULES, PartitionRules,
+                                 batch_sharding, param_shardings)
 from .transformer import (TransformerConfig, forward, init_params,
                           param_logical_specs, pipelined_forward)
 
@@ -58,8 +60,7 @@ def train_step(params, opt_state, tokens, targets, *,
                forward_impl=forward):
     loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets,
                                               config, mesh, forward_impl)
-    updates, opt_state = optimizer.update(grads, opt_state, params)
-    params = optax.apply_updates(params, updates)
+    params, opt_state = apply_update(optimizer, params, opt_state, grads)
     return params, opt_state, loss
 
 
@@ -69,6 +70,33 @@ def pipeline_rules() -> PartitionRules:
     rules = tuple(("layers", "pp") if k == "layers" else (k, v)
                   for k, v in DEFAULT_RULES)
     return PartitionRules(rules=rules)
+
+
+def accumulated_value_and_grad(loss_fn, params, tokens, targets):
+    """Gradient accumulation: scan the microbatches on tokens/targets'
+    leading axis, summing grads in place — peak activation memory is one
+    microbatch's. The divisor is the actual leading-axis length, so a
+    batch shaped differently than the step was configured for cannot
+    silently mis-scale. Loss/grads are microbatch means averaged over steps
+    (exact for equal valid-token counts, the synthetic/packed case)."""
+    def micro(carry, xs):
+        loss_acc, grads_acc = carry
+        t, tg = xs
+        loss, grads = jax.value_and_grad(loss_fn)(params, t, tg)
+        return (loss_acc + loss, jax.tree.map(jnp.add, grads_acc, grads)), None
+
+    zeros = jax.tree.map(jnp.zeros_like, params)
+    (loss_sum, grads_sum), _ = lax.scan(micro, (jnp.float32(0.0), zeros),
+                                        (tokens, targets))
+    inv = 1.0 / tokens.shape[0]
+    return loss_sum * inv, jax.tree.map(lambda g: g * inv, grads_sum)
+
+
+def apply_update(optimizer, params, opt_state, grads):
+    """The shared optimizer tail: one place to change if the update step
+    grows (e.g. grad-norm metrics)."""
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state
 
 
 def opt_state_shardings(optimizer, init_params_fn, p_shardings, replicated):
@@ -100,13 +128,17 @@ def opt_state_shardings(optimizer, init_params_fn, p_shardings, replicated):
 def make_sharded_train_step(mesh: Mesh, config: TransformerConfig,
                             tc: TrainConfig | None = None,
                             rules: PartitionRules | None = None,
-                            n_microbatches: int | None = None):
+                            n_microbatches: int | None = None,
+                            accum_steps: int = 1):
     """Build (init_fn, step_fn) jitted with NamedShardings over ``mesh``.
 
     - params/optimizer state shard per the logical-axis rules (fsdp/tp; with
       pp>1 the layer stack shards over pp and the forward pass pipelines);
     - batches shard over (dp, fsdp) × sp;
-    - params+opt_state buffers are donated (in-place update, halves HBM).
+    - params+opt_state buffers are donated (in-place update, halves HBM);
+    - with ``accum_steps`` > 1, step_fn takes (accum, batch, seq)-shaped
+      tokens/targets (leading axis unsharded) and accumulates grads over
+      the microbatches before one optimizer update.
     """
     tc = tc or TrainConfig()
     pp = mesh.shape.get("pp", 1)
@@ -119,7 +151,7 @@ def make_sharded_train_step(mesh: Mesh, config: TransformerConfig,
         fwd = forward
     optimizer = make_optimizer(tc)
     p_shardings = param_shardings(mesh, param_logical_specs(config), rules)
-    batch_sh = NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
+    batch_sh = batch_sharding(mesh, accum=accum_steps > 1)
     replicated = NamedSharding(mesh, P())
 
     opt_shardings = opt_state_shardings(
@@ -135,9 +167,15 @@ def make_sharded_train_step(mesh: Mesh, config: TransformerConfig,
              out_shardings=(p_shardings, opt_shardings, replicated),
              donate_argnums=(0, 1))
     def step_fn(params, opt_state, tokens, targets):
-        return train_step(params, opt_state, tokens, targets,
-                          config=config, optimizer=optimizer, mesh=mesh,
-                          forward_impl=fwd)
+        if accum_steps == 1:
+            return train_step(params, opt_state, tokens, targets,
+                              config=config, optimizer=optimizer, mesh=mesh,
+                              forward_impl=fwd)
+        loss, grads = accumulated_value_and_grad(
+            lambda p, t, tg: loss_fn(p, t, tg, config, mesh, fwd),
+            params, tokens, targets)
+        params, opt_state = apply_update(optimizer, params, opt_state, grads)
+        return params, opt_state, loss
 
     return init_fn, step_fn
 

@@ -58,8 +58,11 @@ def named_sharding(mesh: Mesh, *axes) -> NamedSharding:
     return NamedSharding(mesh, P(*axes))
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Input batches shard over the data axes and sequence axis."""
+def batch_sharding(mesh: Mesh, accum: bool = False) -> NamedSharding:
+    """Input batches shard over the data axes and sequence axis; with
+    ``accum`` the leading microbatch axis stays unsharded (scanned)."""
+    if accum:
+        return NamedSharding(mesh, P(None, ("dp", "fsdp"), "sp"))
     return NamedSharding(mesh, P(("dp", "fsdp"), "sp"))
 
 
