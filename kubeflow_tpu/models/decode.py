@@ -26,8 +26,21 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .moe import MoEConfig, moe_mlp_block
 from .transformer import (TransformerConfig, apply_rope, attention_block,
                           mlp_block, rms_norm, rope_frequencies)
+
+
+def _mlp(x: jax.Array, layer: dict, config: TransformerConfig) -> jax.Array:
+    """Dense or sparse MLP by config type. At decode time the MoE router
+    sees one token per sequence (N = batch), so per-step expert capacity is
+    ceil(batch/E·factor·k) — with a non-binding capacity (the usual serving
+    setup) decode logits match the full forward exactly; the aux loss is a
+    training quantity and is dropped here."""
+    if isinstance(config, MoEConfig):
+        x, _ = moe_mlp_block(x, layer, config)
+        return x
+    return mlp_block(x, layer, config)
 
 
 # ------------------------------------------------------------------- cache
@@ -75,7 +88,7 @@ def prefill(params: dict, tokens: jax.Array, config: TransformerConfig):
         layer, cache_layer = layer_and_cache
         x, (k, v) = attention_block(x, layer, c, cos, sin, return_kv=True)
         cache_layer = _write_cache(cache_layer, k, v, 0)
-        x = mlp_block(x, layer, c)
+        x = _mlp(x, layer, c)
         return x, cache_layer
 
     x, new_cache = lax.scan(layer_body, x, (params["blocks"], cache))
@@ -128,7 +141,7 @@ def decode_step(params: dict, cache: dict, token: jax.Array,
         out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, cv).reshape(
             B_, 1, H_, D_)
         x = x + jnp.einsum("bshk,hkd->bsd", out, layer["wo"].astype(dt))
-        x = mlp_block(x, layer, c)
+        x = _mlp(x, layer, c)
         return x, cache_layer
 
     x, new_cache = lax.scan(layer_body, x, (params["blocks"], cache))
@@ -147,7 +160,8 @@ def generate(params: dict, prompt: jax.Array, config: TransformerConfig,
     prompt: (batch, prompt_len) → (batch, max_new_tokens). One prefill pass,
     then a single scanned decode loop. ``temperature`` is traced (serving
     varies it per request — one compiled executable covers all values; the
-    greedy/sampled choice is a jnp.where, not a recompile)."""
+    greedy/sampled choice is a jnp.where, not a recompile) and may be a
+    scalar or a per-row (batch,) vector (mixed greedy/sampled batches)."""
     c = config
     B, prompt_len = prompt.shape
     if prompt_len + max_new_tokens > c.max_seq_len:
@@ -156,14 +170,15 @@ def generate(params: dict, prompt: jax.Array, config: TransformerConfig,
             f"exceeds max_seq_len {c.max_seq_len}")
     if key is None:
         key = jax.random.key(0)
-    temperature = jnp.asarray(temperature, jnp.float32)
+    temperature = jnp.broadcast_to(
+        jnp.asarray(temperature, jnp.float32), (B,))
 
     logits, cache = prefill(params, prompt, c)
 
     def pick(logits, k):
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         sampled = jax.random.categorical(
-            k, logits / jnp.maximum(temperature, 1e-6),
+            k, logits / jnp.maximum(temperature, 1e-6)[:, None],
             axis=-1).astype(jnp.int32)
         return jnp.where(temperature > 0.0, sampled, greedy)
 

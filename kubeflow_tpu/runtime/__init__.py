@@ -3,7 +3,8 @@ from .bootstrap import SliceEnv, initialize_slice, verify_slice
 __all__ = ["SliceEnv", "initialize_slice", "verify_slice",
            "TrainCheckpointer", "abstract_state",
            "Trainer", "TrainerStats",
-           "prefetch_to_device", "synthetic_lm_batches"]
+           "prefetch_to_device", "synthetic_lm_batches",
+           "BatchedGenerator", "GenerateRequest"]
 
 _LAZY = {
     # checkpoint/trainer pull in orbax, which the orbax-free bootstrap path
@@ -14,6 +15,8 @@ _LAZY = {
     "TrainerStats": "trainer",
     "prefetch_to_device": "data",
     "synthetic_lm_batches": "data",
+    "BatchedGenerator": "serving",
+    "GenerateRequest": "serving",
 }
 
 

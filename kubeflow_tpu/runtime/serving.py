@@ -1,0 +1,181 @@
+"""Batched generation service: the serving half of the notebook workload.
+
+A provisioned notebook that serves its model needs request batching to keep
+the chip busy — single-prompt generate calls leave the MXU mostly idle. The
+``BatchedGenerator`` runs a background scheduler thread that coalesces
+concurrent requests into batches and answers each caller through a Future.
+
+TPU-first batching policy:
+- requests batch only when their (prompt_len, max_new_tokens) shapes match —
+  one compiled executable per shape, no padding/masking corrections needed,
+  and XLA's compile cache makes repeated shapes free (notebook serving is
+  dominated by templated, fixed-shape prompts);
+- per-request temperatures ride one batch as a traced (batch,) vector
+  (models/decode.py generate), so greedy and sampled requests coexist in a
+  batch without recompiling;
+- the scheduler waits at most ``max_wait_s`` for the batch to fill — a
+  latency/throughput knob, not a correctness one.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.decode import generate
+
+
+@dataclass
+class GenerateRequest:
+    prompt: np.ndarray                # (prompt_len,) int32 token ids
+    max_new_tokens: int
+    temperature: float = 0.0
+    future: Future = field(default_factory=Future)
+
+    @property
+    def shape_key(self) -> tuple:
+        return (len(self.prompt), self.max_new_tokens)
+
+
+class BatchedGenerator:
+    """Coalesce concurrent generate requests into shape-matched batches.
+
+    ``submit`` returns a Future resolving to the (max_new_tokens,) int32
+    generated ids; ``generate_sync`` blocks for the result.
+    """
+
+    def __init__(self, params, config, *, max_batch: int = 8,
+                 max_wait_s: float = 0.01, seed: int = 0):
+        self.params = params
+        self.config = config
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_s
+        self._queue: queue.Queue = queue.Queue()
+        # shape-mismatched requests parked in arrival order: the next cycle
+        # starts from this deque's head, so minority shapes cannot starve
+        # behind a sustained stream of newer majority-shape arrivals
+        self._pending: collections.deque = collections.deque()
+        self._key = jax.random.key(seed)
+        self._closed = False
+        self._lifecycle = threading.Lock()  # submit/close atomicity
+        self.batch_sizes: collections.deque = collections.deque(maxlen=1024)
+        self.batches_total = 0
+        self.requests_total = 0
+        self._thread = threading.Thread(target=self._scheduler, daemon=True,
+                                        name="kubeflow-tpu-serving")
+        self._thread.start()
+
+    # ----------------------------------------------------------------- API
+    def submit(self, prompt, max_new_tokens: int,
+               temperature: float = 0.0) -> Future:
+        req = GenerateRequest(np.asarray(prompt, np.int32), max_new_tokens,
+                              temperature)
+        with self._lifecycle:
+            if self._closed:
+                raise RuntimeError("generator is closed")
+            self._queue.put(req)
+        return req.future
+
+    def generate_sync(self, prompt, max_new_tokens: int,
+                      temperature: float = 0.0, timeout: float = 120.0):
+        return self.submit(prompt, max_new_tokens,
+                           temperature).result(timeout=timeout)
+
+    def close(self) -> None:
+        with self._lifecycle:
+            if self._closed:
+                return
+            self._closed = True
+            self._queue.put(None)  # sentinel AFTER the last possible submit
+        self._thread.join(timeout=10)
+
+    def __enter__(self) -> "BatchedGenerator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------ scheduler
+    def _take_batch(self) -> list[GenerateRequest] | None:
+        """Oldest request first (parked pending before the live queue), then
+        gather shape-matched peers until max_batch or a monotonic
+        ``max_wait_s`` deadline. Mismatches park in arrival order. Returns
+        None on the close sentinel."""
+        if self._pending:
+            first = self._pending.popleft()
+        else:
+            first = self._queue.get()
+            if first is None:
+                return None
+        batch = [first]
+        # same-shape requests already parked join immediately (FIFO scan)
+        for req in list(self._pending):
+            if len(batch) >= self.max_batch:
+                break
+            if req.shape_key == first.shape_key:
+                self._pending.remove(req)
+                batch.append(req)
+        deadline = time.monotonic() + self.max_wait_s
+        while len(batch) < self.max_batch:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                req = self._queue.get(timeout=remaining)
+            except queue.Empty:
+                break
+            if req is None:
+                self._queue.put(None)  # re-arm the sentinel for next cycle
+                break
+            if req.shape_key == first.shape_key:
+                batch.append(req)
+            else:
+                self._pending.append(req)
+        return batch
+
+    def _scheduler(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                # drain: fail any stragglers so callers don't hang. close()
+                # enqueues the sentinel under the lifecycle lock AFTER the
+                # last possible submit, so everything is visible here.
+                stragglers = list(self._pending)
+                self._pending.clear()
+                while True:
+                    try:
+                        req = self._queue.get_nowait()
+                    except queue.Empty:
+                        break
+                    if req is not None:
+                        stragglers.append(req)
+                for req in stragglers:
+                    req.future.set_exception(RuntimeError("generator closed"))
+                return
+            try:
+                self._run_batch(batch)
+            except BaseException as exc:  # noqa: BLE001 — deliver per-request
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(exc)
+
+    def _run_batch(self, batch: list[GenerateRequest]) -> None:
+        self.batch_sizes.append(len(batch))
+        self.batches_total += 1
+        self.requests_total += len(batch)
+        prompts = jnp.asarray(np.stack([r.prompt for r in batch]))
+        temps = jnp.asarray([r.temperature for r in batch], jnp.float32)
+        self._key, sub = jax.random.split(self._key)
+        out = generate(self.params, prompts, self.config,
+                       batch[0].max_new_tokens, temperature=temps, key=sub)
+        out = np.asarray(out)
+        for i, req in enumerate(batch):
+            req.future.set_result(out[i])

@@ -101,3 +101,38 @@ def test_temperature_change_does_not_recompile():
     generate(params, prompt, cfg, max_new_tokens=4, temperature=1.3,
              key=jax.random.key(1))
     assert generate._cache_size() == misses  # same executable reused
+
+
+def test_moe_decode_matches_moe_forward():
+    """MoE teacher-forced decode equals the MoE training forward when expert
+    capacity is non-binding (capacity_factor ample so nothing drops)."""
+    from kubeflow_tpu.models.moe import MoEConfig, init_moe_params, moe_forward
+    cfg = MoEConfig(vocab_size=96, d_model=32, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=48, dtype="float32", max_seq_len=32,
+                    n_experts=2, experts_per_token=2, capacity_factor=8.0)
+    params = init_moe_params(jax.random.key(0), cfg)
+    B, S, prompt_len = 2, 10, 4
+    tokens = jax.random.randint(jax.random.key(1), (B, S), 0, cfg.vocab_size)
+    full, _ = moe_forward(params, tokens, cfg)
+
+    logits, cache = prefill(params, tokens[:, :prompt_len], cfg)
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(full[:, prompt_len - 1]), atol=1e-4)
+    for pos in range(prompt_len, S):
+        logits, cache = decode_step(params, cache, tokens[:, pos], pos, cfg)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, pos]), atol=1e-4,
+                                   err_msg=f"divergence at position {pos}")
+
+
+def test_moe_generate_runs():
+    from kubeflow_tpu.models.moe import MoEConfig, init_moe_params
+    cfg = MoEConfig(vocab_size=96, d_model=32, n_layers=1, n_heads=4,
+                    n_kv_heads=4, d_ff=48, dtype="float32", max_seq_len=32,
+                    n_experts=2, experts_per_token=1, capacity_factor=4.0)
+    params = init_moe_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(1), (2, 4), 0, cfg.vocab_size)
+    out = generate(params, prompt, cfg, max_new_tokens=6)
+    assert out.shape == (2, 6)
+    out2 = generate(params, prompt, cfg, max_new_tokens=6)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
