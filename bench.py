@@ -92,7 +92,32 @@ def measure_once() -> float:
         mgr.stop()
 
 
+def _ensure_live_backend(probe_timeout_s: float = 180.0) -> None:
+    """The axon TPU tunnel can wedge at backend init (observed: jax.devices()
+    hangs indefinitely). Probe it in a subprocess first; if it doesn't come
+    up, pin this process to the CPU backend so the bench always terminates
+    and prints its JSON line. Must run BEFORE jax is imported here."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        result = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout_s, capture_output=True)
+        if result.returncode == 0:
+            return
+    except subprocess.TimeoutExpired:
+        pass
+    sys.stderr.write("bench: accelerator backend unreachable, "
+                     "falling back to CPU\n")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
 def main() -> None:
+    _ensure_live_backend()
     latencies = [measure_once() for _ in range(RUNS)]
     p50 = statistics.median(latencies)
     print(json.dumps({
