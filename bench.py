@@ -1,36 +1,208 @@
-"""Benchmark: Notebook CR → slice-ready end-to-end latency.
+"""Benchmarks: control-plane latency + single-chip compute throughput.
 
 The reference publishes no benchmark numbers (BASELINE.md); the north-star
 metric is "kubectl apply of a Notebook CR yields a ready Jupyter server with
 jax.device_count() parity in <90 s" (BASELINE.json, within the reference's
 3-minute e2e ceiling, odh e2e/notebook_controller_setup_test.go:88-90).
 
-This bench runs the full control-plane loop in-process — apiserver, core
-reconciler, kubelet/StatefulSet simulator — with one twist that keeps it
-honest on real hardware: a worker pod only becomes Ready once the actual TPU
-runtime verification has run on the real chip (jax device enumeration + a
-jitted forward step of the flagship model, i.e. the work a JAX notebook image
-does at boot). So the measured latency includes genuine XLA compile/execute
-on the TPU, not just control-plane bookkeeping.
+Three benches, each emitted as a JSON line (headline metric printed LAST):
 
-Config benched: v5e-1 single-chip Notebook (BASELINE.json config #2) — the
-one shape the attached single-chip environment can genuinely verify.
+1. ``attention``  — flash-attention (pallas) vs XLA attention forward
+   timing at several sequence lengths. TPU-only: off-TPU the pallas kernel
+   runs in interpreter mode, which times the emulator, not the kernel.
+2. ``train_step`` — jitted sharded train-step throughput on the flagship
+   transformer: tokens/s and model FLOPs utilisation (MFU vs the chip's
+   bf16 peak; off-TPU MFU is reported as null — no meaningful peak).
+3. ``notebook_cr_to_slice_ready_p50_s`` (headline) — full control-plane
+   loop in-process (apiserver, core reconciler, kubelet/STS simulator)
+   where a worker pod only becomes Ready once genuine device enumeration +
+   a jitted forward step have run, so the latency includes real XLA
+   compile/execute, not just bookkeeping.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"};
-vs_baseline = baseline_seconds / measured (>1 means faster than the 90 s
-target).
+Every line carries ``backend`` (what actually executed) and ``fallback``
+(true when the accelerator tunnel was unreachable and the bench pinned
+itself to CPU) — a CPU run can never masquerade as a TPU result.
 """
 
 from __future__ import annotations
 
 import json
 import statistics
+import sys
 import time
 
 BASELINE_SECONDS = 90.0
 RUNS = 5
 
+# bf16 peak FLOP/s per chip, by device_kind substring (public TPU specs).
+PEAK_FLOPS = (
+    ("v6", 918e12),  # Trillium
+    ("v5p", 459e12),
+    ("v5e", 197e12),
+    ("v5", 197e12),
+    ("v4", 275e12),
+)
 
+
+# --------------------------------------------------------------- backend probe
+def probe_backend(timeout_s: float = 90.0) -> dict:
+    """Probe the accelerator backend in a subprocess (the axon TPU tunnel can
+    wedge at init: jax.devices() hangs indefinitely — observed round 1 at 60s
+    and 560s). Time-boxed, one retry, stderr captured for diagnostics. On
+    failure, pins THIS process to the CPU backend so every bench terminates
+    and reports honestly. Must run before jax is imported here."""
+    import os
+    import subprocess
+
+    code = ("import jax; d = jax.devices(); "
+            "print(jax.default_backend(), len(d), "
+            "getattr(d[0], 'device_kind', 'unknown'))")
+    diag = ""
+    # two full-budget attempts: a half-budget retry could never succeed where
+    # a slow-but-healthy init already needs the whole window
+    for attempt, budget in enumerate((timeout_s, timeout_s)):
+        try:
+            r = subprocess.run([sys.executable, "-c", code], timeout=budget,
+                               capture_output=True, text=True)
+            if r.returncode == 0 and r.stdout.strip():
+                try:
+                    # parse only the last line: jax/libtpu init may write
+                    # banners to stdout before the probe's print
+                    backend, n, kind = \
+                        r.stdout.strip().splitlines()[-1].split(None, 2)
+                    return {"backend": backend, "n_devices": int(n),
+                            "device_kind": kind.strip(), "fallback": False,
+                            "probe_error": None}
+                except ValueError as e:
+                    diag = (f"probe attempt {attempt + 1} unparseable "
+                            f"stdout {r.stdout.strip()[-200:]!r}: {e}")
+                    sys.stderr.write(f"bench: {diag}\n")
+                    continue
+            diag = (f"probe attempt {attempt + 1} rc={r.returncode}: "
+                    f"{(r.stderr or '').strip()[-400:]}")
+        except subprocess.TimeoutExpired as e:
+            stderr = e.stderr.decode(errors="replace") if e.stderr else ""
+            diag = (f"probe attempt {attempt + 1} timed out after "
+                    f"{budget:.0f}s (backend init hang); last stderr: "
+                    f"{stderr.strip()[-400:]}")
+        sys.stderr.write(f"bench: {diag}\n")
+    sys.stderr.write("bench: accelerator backend unreachable, "
+                     "falling back to CPU (fallback=true in output)\n")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    return {"backend": "cpu", "n_devices": jax.device_count(),
+            "device_kind": "host-cpu", "fallback": True, "probe_error": diag}
+
+
+def _peak_flops(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for key, peak in PEAK_FLOPS:
+        if key in kind:
+            return peak
+    if "tpu" in kind or "axon" in kind:
+        return PEAK_FLOPS[2][1]  # conservative: v5e
+    return None
+
+
+def _emit(info: dict, **fields) -> None:
+    fields.setdefault("backend", info["backend"])
+    fields.setdefault("fallback", info["fallback"])
+    print(json.dumps(fields), flush=True)
+
+
+# ------------------------------------------------------------ compute benches
+def bench_attention(info: dict) -> None:
+    """flash_attention (pallas) vs xla_attention forward wall time. TPU-only:
+    interpreter-mode pallas off-TPU measures the emulator, not the kernel."""
+    if info["backend"] == "cpu":
+        _emit(info, metric="flash_vs_xla_attention_speedup", value=None,
+              unit="x", vs_baseline=None,
+              skipped="pallas kernels only timed on real TPU "
+                      "(interpret mode would time the emulator)")
+        return
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.transformer import xla_attention
+    from kubeflow_tpu.ops.attention import flash_attention
+
+    b, h, d = 4, 8, 128
+    results = {}
+    for s in (512, 1024, 2048, 4096):
+        key = jax.random.key(s)
+        q, k, v = (jax.random.normal(kk, (b, s, h, d), dtype=jnp.bfloat16)
+                   for kk in jax.random.split(key, 3))
+        flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+        xla = jax.jit(lambda q, k, v: xla_attention(q, k, v, causal=True))
+        times = {}
+        for name, fn in (("flash", flash), ("xla", xla)):
+            jax.block_until_ready(fn(q, k, v))  # compile
+            t0 = time.perf_counter()
+            for _ in range(10):
+                out = fn(q, k, v)
+            jax.block_until_ready(out)
+            times[name] = (time.perf_counter() - t0) / 10
+        results[s] = {"flash_ms": round(times["flash"] * 1e3, 3),
+                      "xla_ms": round(times["xla"] * 1e3, 3),
+                      "speedup": round(times["xla"] / times["flash"], 3)}
+    geomean = statistics.geometric_mean(
+        [r["speedup"] for r in results.values()])
+    _emit(info, metric="flash_vs_xla_attention_speedup",
+          value=round(geomean, 3), unit="x", vs_baseline=round(geomean, 3),
+          detail={str(s): r for s, r in results.items()})
+
+
+def bench_train_step(info: dict) -> None:
+    """Jitted single-chip train-step throughput on the flagship transformer:
+    tokens/s and MFU (3x forward FLOPs for fwd+bwd over the chip's bf16
+    peak). Off-TPU this still reports tokens/s (backend=cpu) but MFU=null."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.train import make_sharded_train_step
+    from kubeflow_tpu.models.transformer import (TransformerConfig,
+                                                 model_flops_per_token)
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    on_tpu = info["backend"] != "cpu"
+    if on_tpu:
+        # the same flagship config entry() serves — keep them in lockstep
+        from __graft_entry__ import _flagship_config
+        config = _flagship_config()
+        batch, seq, steps = 8, 1024, 20
+    else:  # keep the CPU fallback fast but real
+        config = TransformerConfig(vocab_size=2048, d_model=128, n_layers=2,
+                                   n_heads=4, n_kv_heads=4, d_ff=256,
+                                   max_seq_len=256, dtype="float32")
+        batch, seq, steps = 4, 256, 3
+
+    mesh = build_mesh(MeshConfig.auto(1), devices=jax.devices()[:1])
+    init_fn, step_fn = make_sharded_train_step(mesh, config)
+    params, opt_state = init_fn(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                config.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    # compile + warmup (buffers are donated: thread state through)
+    params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    tok_s = batch * seq * steps / dt
+    achieved = 3 * model_flops_per_token(config) * tok_s
+    peak = _peak_flops(info["device_kind"]) if on_tpu else None
+    mfu = round(achieved / peak, 4) if peak else None
+    _emit(info, metric="train_step_tokens_per_sec", value=round(tok_s, 1),
+          unit="tokens/s", vs_baseline=None, mfu=mfu,
+          model_tflops_per_sec=round(achieved / 1e12, 3),
+          detail={"batch": batch, "seq": seq, "steps": steps,
+                  "loss": round(float(loss), 4)})
+
+
+# ------------------------------------------------------- control-plane bench
 def _tpu_boot_verification():
     """What a JAX notebook container does at boot: enumerate devices, form
     the (single-host) mesh, compile+run a forward step of the flagship model."""
@@ -92,40 +264,20 @@ def measure_once() -> float:
         mgr.stop()
 
 
-def _ensure_live_backend(probe_timeout_s: float = 180.0) -> None:
-    """The axon TPU tunnel can wedge at backend init (observed: jax.devices()
-    hangs indefinitely). Probe it in a subprocess first; if it doesn't come
-    up, pin this process to the CPU backend so the bench always terminates
-    and prints its JSON line. Must run BEFORE jax is imported here."""
-    import os
-    import subprocess
-    import sys
-
-    try:
-        result = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=probe_timeout_s, capture_output=True)
-        if result.returncode == 0:
-            return
-    except subprocess.TimeoutExpired:
-        pass
-    sys.stderr.write("bench: accelerator backend unreachable, "
-                     "falling back to CPU\n")
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-
-
 def main() -> None:
-    _ensure_live_backend()
+    info = probe_backend()
+    for bench, metric in ((bench_attention, "flash_vs_xla_attention_speedup"),
+                          (bench_train_step, "train_step_tokens_per_sec")):
+        try:
+            bench(info)
+        except Exception as e:  # a compute bench must never eat the headline
+            _emit(info, metric=metric, value=None, unit="error",
+                  vs_baseline=None, error=f"{type(e).__name__}: {e}")
     latencies = [measure_once() for _ in range(RUNS)]
     p50 = statistics.median(latencies)
-    print(json.dumps({
-        "metric": "notebook_cr_to_slice_ready_p50_s",
-        "value": round(p50, 4),
-        "unit": "s",
-        "vs_baseline": round(BASELINE_SECONDS / p50, 2),
-    }))
+    _emit(info, metric="notebook_cr_to_slice_ready_p50_s",
+          value=round(p50, 4), unit="s",
+          vs_baseline=round(BASELINE_SECONDS / p50, 2))
 
 
 if __name__ == "__main__":
