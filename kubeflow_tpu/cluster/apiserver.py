@@ -1,0 +1,312 @@
+"""HTTP(S) apiserver facade over a ClusterStore.
+
+Serves the real Kubernetes REST wire protocol — resource paths, list kinds,
+``?watch=true`` streaming, RFC 7386 merge-patch, the ``/status`` subresource,
+``Status`` error objects — backed by the in-process ClusterStore. Two roles:
+
+- **standalone-mode apiserver**: ``python -m kubeflow_tpu.main
+  --serve-apiserver 6443`` exposes the store so *other processes* (a second
+  manager replica, kubectl-style tooling, the e2e suite) reconcile the same
+  cluster state over real HTTP — the transport seam the reference gets from
+  kube-apiserver (controllers speak HTTPS to it,
+  notebook-controller/main.go:95-148);
+- **transport test target**: the HttpApiClient record/replay tests run the
+  full client↔server protocol (auth, conflicts, watch streaming) without
+  needing a real cluster.
+
+Admission plugins registered on the backing store run server-side, exactly
+where kube-apiserver runs its webhook phase — remote clients get mutated
+objects and admission denials as 4xx Status responses.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import ssl
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..utils import k8s
+from . import restmapper
+from .errors import ApiError, NotFoundError
+from .store import WatchEvent
+
+log = logging.getLogger("kubeflow_tpu.apiserver")
+
+WATCH_BOOKMARK_INTERVAL_S = 10.0
+
+
+def _parse_label_selector(raw: str | None) -> dict[str, str] | None:
+    if not raw:
+        return None
+    out = {}
+    for part in raw.split(","):
+        if "=" in part:
+            key, _, val = part.partition("=")
+            out[key.strip()] = val.strip()
+    return out or None
+
+
+def _status_body(code: int, reason: str, message: str) -> bytes:
+    return json.dumps({
+        "kind": "Status", "apiVersion": "v1", "status": "Failure",
+        "message": message, "reason": reason, "code": code,
+    }).encode()
+
+
+class _Route:
+    """A parsed request path: which mapping, namespace, name, subresource."""
+
+    def __init__(self, mapping: restmapper.RestMapping,
+                 namespace: str | None, name: str | None,
+                 subresource: str | None) -> None:
+        self.mapping = mapping
+        self.namespace = namespace
+        self.name = name
+        self.subresource = subresource
+
+
+def _parse_path(path: str) -> _Route | None:
+    parts = [p for p in path.split("/") if p]
+    if not parts:
+        return None
+    if parts[0] == "api":
+        if len(parts) < 3 or parts[1] != "v1":
+            return None
+        group, version, rest = "", "v1", parts[2:]
+    elif parts[0] == "apis":
+        if len(parts) < 4:
+            return None
+        group, version, rest = parts[1], parts[2], parts[3:]
+    else:
+        return None
+    namespace: str | None = None
+    if rest[0] == "namespaces" and len(rest) >= 3:
+        # /namespaces/{ns}/{plural}... — but /api/v1/namespaces/{name} alone
+        # is the Namespace resource itself
+        namespace, rest = rest[1], rest[2:]
+    elif rest[0] == "namespaces":
+        mapping = restmapper.mapping_for_route("", "v1", "namespaces")
+        name = rest[1] if len(rest) > 1 else None
+        return _Route(mapping, None, name, None) if mapping else None
+    plural, rest = rest[0], rest[1:]
+    mapping = restmapper.mapping_for_route(group, version, plural)
+    if mapping is None:
+        return None
+    name = rest[0] if rest else None
+    subresource = rest[1] if len(rest) > 1 else None
+    return _Route(mapping, namespace, name, subresource)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "kubeflow-tpu-apiserver"
+
+    # ------------------------------------------------------------- plumbing
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        log.debug("%s %s", self.address_string(), fmt % args)
+
+    @property
+    def store(self):
+        return self.server.store  # type: ignore[attr-defined]
+
+    def _authorized(self) -> bool:
+        token = self.server.token  # type: ignore[attr-defined]
+        if token is None:
+            return True
+        got = self.headers.get("Authorization", "")
+        return got == f"Bearer {token}"
+
+    def _send_json(self, code: int, body: dict) -> None:
+        data = json.dumps(body).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_error_status(self, code: int, reason: str, message: str) -> None:
+        data = _status_body(code, reason, message)
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_api_error(self, err: ApiError) -> None:
+        self._send_error_status(err.code, err.reason, err.message)
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length) or b"{}")
+
+    def _dispatch(self, method: str) -> None:
+        if not self._authorized():
+            self._send_error_status(401, "Unauthorized", "invalid bearer token")
+            return
+        parsed = urlparse(self.path)
+        if parsed.path in ("/healthz", "/readyz", "/livez"):
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+            return
+        route = _parse_path(parsed.path)
+        if route is None:
+            self._send_error_status(404, "NotFound",
+                                    f"unrecognized path {parsed.path}")
+            return
+        query = {key: vals[-1] for key, vals in parse_qs(parsed.query).items()}
+        try:
+            getattr(self, f"_handle_{method}")(route, query)
+        except ApiError as err:
+            self._send_api_error(err)
+        except BrokenPipeError:
+            raise
+        except Exception as exc:  # noqa: BLE001 — surface as 500 Status
+            log.exception("handler error on %s %s", method, self.path)
+            self._send_error_status(500, "InternalError", str(exc))
+
+    do_GET = lambda self: self._dispatch("GET")            # noqa: E731
+    do_POST = lambda self: self._dispatch("POST")          # noqa: E731
+    do_PUT = lambda self: self._dispatch("PUT")            # noqa: E731
+    do_PATCH = lambda self: self._dispatch("PATCH")        # noqa: E731
+    do_DELETE = lambda self: self._dispatch("DELETE")      # noqa: E731
+
+    # ---------------------------------------------------------------- verbs
+    def _handle_GET(self, route: _Route, query: dict) -> None:
+        kind = route.mapping.kind
+        if route.name:
+            obj = self.store.get(kind, route.namespace or "", route.name)
+            self._send_json(200, obj)
+            return
+        selector = _parse_label_selector(query.get("labelSelector"))
+        if query.get("watch") in ("true", "1"):
+            self._stream_watch(route, selector)
+            return
+        items = self.store.list(kind, route.namespace, selector)
+        self._send_json(200, {
+            "kind": f"{kind}List",
+            "apiVersion": route.mapping.api_version,
+            "metadata": {},
+            "items": items,
+        })
+
+    def _handle_POST(self, route: _Route, query: dict) -> None:
+        obj = self._read_body()
+        obj.setdefault("kind", route.mapping.kind)
+        obj.setdefault("apiVersion", route.mapping.api_version)
+        if route.namespace and route.mapping.namespaced:
+            k8s.meta(obj).setdefault("namespace", route.namespace)
+        self._send_json(201, self.store.create(obj))
+
+    def _handle_PUT(self, route: _Route, query: dict) -> None:
+        if not route.name:
+            raise NotFoundError("PUT requires a resource name")
+        obj = self._read_body()
+        obj.setdefault("kind", route.mapping.kind)
+        obj.setdefault("apiVersion", route.mapping.api_version)
+        if route.subresource == "status":
+            self._send_json(200, self.store.update_status(obj))
+        else:
+            self._send_json(200, self.store.update(obj))
+
+    def _handle_PATCH(self, route: _Route, query: dict) -> None:
+        if not route.name:
+            raise NotFoundError("PATCH requires a resource name")
+        ctype = self.headers.get("Content-Type", "")
+        if "merge-patch" not in ctype and "strategic-merge-patch" not in ctype:
+            self._send_error_status(
+                415, "UnsupportedMediaType",
+                f"unsupported patch type {ctype!r}; use "
+                f"application/merge-patch+json")
+            return
+        patch = self._read_body()
+        self._send_json(200, self.store.patch(
+            route.mapping.kind, route.namespace or "", route.name, patch))
+
+    def _handle_DELETE(self, route: _Route, query: dict) -> None:
+        if not route.name:
+            raise NotFoundError("DELETE requires a resource name")
+        self.store.delete(route.mapping.kind, route.namespace or "", route.name)
+        self._send_json(200, {"kind": "Status", "apiVersion": "v1",
+                              "status": "Success"})
+
+    # ---------------------------------------------------------------- watch
+    def _stream_watch(self, route: _Route, selector) -> None:
+        """Stream watch events as newline-delimited JSON, the real watch wire
+        format. The connection closes when the client goes away (detected on
+        the next write — idle bookmarks bound the detection latency) or the
+        server shuts down."""
+        events: queue.Queue = queue.Queue()
+        relay = events.put
+        self.store.watch(route.mapping.kind, relay,
+                         namespace=route.namespace, label_selector=selector)
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.close_connection = True
+        try:
+            while not self.server.shutting_down:  # type: ignore[attr-defined]
+                try:
+                    event: WatchEvent = events.get(
+                        timeout=WATCH_BOOKMARK_INTERVAL_S)
+                    frame = {"type": event.type, "object": event.obj}
+                except queue.Empty:
+                    frame = {"type": "BOOKMARK", "object": {}}
+                self.wfile.write(json.dumps(frame).encode() + b"\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self.store.unwatch(relay)
+
+
+class ApiServerProxy:
+    """The HTTP front door for a ClusterStore. Optional bearer-token auth and
+    TLS (certfile/keyfile) — the same knobs a real apiserver endpoint has."""
+
+    def __init__(self, store, port: int = 0, host: str = "127.0.0.1",
+                 token: str | None = None, certfile: str | None = None,
+                 keyfile: str | None = None) -> None:
+        self.store = store
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.store = store  # type: ignore[attr-defined]
+        self._httpd.token = token  # type: ignore[attr-defined]
+        self._httpd.shutting_down = False  # type: ignore[attr-defined]
+        self.scheme = "http"
+        if certfile:
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile, keyfile)
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                                 server_side=True)
+            self.scheme = "https"
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        return f"{self.scheme}://127.0.0.1:{self.port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="kubeflow-tpu-apiserver")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutting_down = True  # type: ignore[attr-defined]
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

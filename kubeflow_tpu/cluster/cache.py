@@ -199,3 +199,7 @@ class CachingClient:
 
     def register_admission(self, kind: str, fn) -> None:
         return self.store.register_admission(kind, fn)
+
+    @property
+    def supports_inprocess_admission(self) -> bool:
+        return getattr(self.store, "supports_inprocess_admission", True)

@@ -271,6 +271,12 @@ class ClusterStore:
         with self._lock:
             self._watches.append(_Watch(kind, callback, namespace, label_selector))
 
+    def unwatch(self, callback: Callable[[WatchEvent], None]) -> None:
+        """Deregister a watch callback (watch stream teardown — the apiserver
+        facade drops its per-connection relay when the HTTP client goes away)."""
+        with self._lock:
+            self._watches = [w for w in self._watches if w.callback is not callback]
+
     def _notify(self, event: WatchEvent) -> None:
         kind = k8s.kind(event.obj)
         ns = k8s.namespace(event.obj)

@@ -26,8 +26,13 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
 
     config = config or ControllerConfig.from_env()
     metrics = metrics or MetricsRegistry()
-    install_notebook_crd(client)
-    if webhooks:
+    # remote clients (HttpApiClient) can't register in-process admission —
+    # there, schema validation and the webhooks run server-side (CRD schema +
+    # AdmissionServer behind webhook configurations, as in the reference)
+    inprocess_admission = getattr(client, "supports_inprocess_admission", True)
+    if inprocess_admission:
+        install_notebook_crd(client)
+    if webhooks and inprocess_admission:
         # mutating runs before validating, as in the apiserver's phase order
         NotebookMutatingWebhook(client, config).install(client)
         NotebookValidatingWebhook(config).install(client)
@@ -41,7 +46,9 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
     if leader_elect:
         mgr.leader_elector = LeaderElector(
             client, config.controller_namespace,
-            "kubeflow-tpu-notebook-controller-leader")
+            "kubeflow-tpu-notebook-controller-leader",
+            lease_duration=config.leader_lease_duration_s,
+            renew_period=config.leader_renew_period_s)
     if health_port is not None:
         mgr.health_server = HealthServer(metrics_registry=metrics,
                                          port=health_port)

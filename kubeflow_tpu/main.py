@@ -47,6 +47,12 @@ def build_manager(store=None, config: ControllerConfig | None = None, *,
                   on_tls_change=None):
     """Compose the full production stack; returns (manager, shutdown_event).
 
+    ``store`` is any object implementing the client protocol: the in-process
+    ClusterStore (default), or an HttpApiClient pointed at a real apiserver —
+    the reconcilers are identical either way (the reference's controllers are
+    equally transport-agnostic behind controller-runtime's client,
+    notebook-controller/main.go:95-148).
+
     The returned manager's client is the read-cached view (Secret/ConfigMap
     payloads never cached); admission plugins and the optional HTTPS webhook
     server share one handler path. ``on_tls_change`` defaults to setting the
@@ -103,7 +109,36 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--simulate-kubelet", action="store_true",
                     help="run the StatefulSet/pod simulator (standalone)")
     ap.add_argument("--debug-log", action="store_true")
+    # real-cluster transport: pick ONE of kubeconfig / api-server / in-cluster
+    ap.add_argument("--kubeconfig", default=None,
+                    help="reconcile a real cluster via this kubeconfig")
+    ap.add_argument("--api-server", default=None,
+                    help="reconcile a real cluster at this apiserver URL "
+                         "(token via --api-token or SA mount)")
+    ap.add_argument("--api-token", default=None)
+    ap.add_argument("--in-cluster", action="store_true",
+                    help="use the ServiceAccount mount (the deploy "
+                         "manifests' mode)")
+    ap.add_argument("--insecure-skip-tls-verify", action="store_true")
+    ap.add_argument("--serve-apiserver", type=int, default=None,
+                    metavar="PORT",
+                    help="standalone mode: expose the in-process store over "
+                         "HTTP so other processes share this cluster state")
     return ap
+
+
+def build_client_from_args(args):
+    """Resolve the transport flags to a client, or None for the in-process
+    store (client-go's loading order: explicit flag > kubeconfig > SA)."""
+    from .cluster.http_client import HttpApiClient
+    if args.api_server:
+        return HttpApiClient(args.api_server, token=args.api_token,
+                             verify=not args.insecure_skip_tls_verify)
+    if args.kubeconfig:
+        return HttpApiClient.from_kubeconfig(args.kubeconfig)
+    if args.in_cluster:
+        return HttpApiClient.in_cluster()
+    return None
 
 
 def main(argv=None) -> int:
@@ -112,12 +147,26 @@ def main(argv=None) -> int:
         level=logging.DEBUG if args.debug_log else logging.INFO,
         format="%(asctime)s %(levelname)s %(name)s %(message)s")
 
+    client = build_client_from_args(args)
     mgr, shutdown = build_manager(
+        store=client,
         leader_elect=args.leader_elect,
         health_port=args.health_port or None,
         webhook_port=args.webhook_port or None,
         cert_dir=args.cert_dir,
-        simulate_kubelet=args.simulate_kubelet)
+        simulate_kubelet=args.simulate_kubelet and client is None)
+
+    apiserver = None
+    if args.serve_apiserver is not None:
+        if client is not None:
+            log.error("--serve-apiserver requires the in-process store")
+            return 2
+        from .cluster.apiserver import ApiServerProxy
+        apiserver = ApiServerProxy(mgr.client.store,
+                                   port=args.serve_apiserver,
+                                   host="0.0.0.0")
+        apiserver.start()
+        log.info("apiserver facade listening on %s", apiserver.url)
 
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: shutdown.set())
@@ -127,8 +176,12 @@ def main(argv=None) -> int:
     log.info("manager started (leader_elect=%s)", args.leader_elect)
     shutdown.wait()
     log.info("shutting down")
+    if apiserver is not None:
+        apiserver.stop()
     if getattr(mgr, "webhook_server", None) is not None:
         mgr.webhook_server.stop()
+    if client is not None:
+        client.close()
     mgr.stop()
     return 0
 

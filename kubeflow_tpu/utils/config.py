@@ -52,6 +52,10 @@ class ControllerConfig:
     # notebook_controller.go:155-180); lenient default suits clusters without
     # an SA-secret controller
     lock_requires_pull_secret: bool = False
+    # leader-election timing (controller-runtime's LeaseDuration/RenewDeadline
+    # analog; env-overridable so multi-process failover tests can shrink it)
+    leader_lease_duration_s: float = 15.0
+    leader_renew_period_s: float = 2.0
     # TPU-native
     tpu_default_image: str = "us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest"
     image_swap_map: dict = field(default_factory=dict)  # cuda image → jax/libtpu image
@@ -77,6 +81,8 @@ class ControllerConfig:
             set_pipeline_rbac=_env_bool("SET_PIPELINE_RBAC", False),
             set_pipeline_secret=_env_bool("SET_PIPELINE_SECRET", False),
             inject_cluster_proxy_env=_env_bool("INJECT_CLUSTER_PROXY_ENV", False),
+            leader_lease_duration_s=float(env.get("LEADER_LEASE_DURATION", "15")),
+            leader_renew_period_s=float(env.get("LEADER_RENEW_PERIOD", "2")),
             tpu_default_image=env.get(
                 "TPU_NOTEBOOK_IMAGE",
                 "us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest"),
