@@ -125,18 +125,28 @@ def validate_notebook(notebook: dict) -> None:
     if not md.get("name") and not md.get("generateName"):
         raise InvalidError("metadata.name required")
     containers = notebook_pod_spec(notebook).get("containers")
-    if not containers:
-        raise InvalidError("spec.template.spec.containers must be non-empty")
+    if not isinstance(containers, list) or not containers:
+        raise InvalidError("spec.template.spec.containers must be a "
+                           "non-empty list")
     for c in containers:
-        if not c.get("name") or not c.get("image"):
+        if not isinstance(c, dict) or not c.get("name") or not c.get("image"):
             raise InvalidError("containers require name and image")
 
 
 def install_notebook_crd(store) -> None:
-    """Install the Notebook CRD's structural schema validation into an
-    apiserver (ClusterStore) — the analog of applying
-    config/crd/bases/kubeflow.org_notebooks.yaml: invalid CRs are rejected at
-    admission instead of crash-looping reconcilers."""
+    """Install the Notebook CRD into an apiserver (ClusterStore) — the analog
+    of applying config/crd/bases/kubeflow.org_notebooks.yaml: the CRD object
+    carries the typed structural schema (api/schema.py) which the store
+    enforces server-side, so a malformed pod spec is rejected at admission
+    instead of crash-looping reconcilers; typed admission adds the semantic
+    checks and version conversion a schema can't express."""
+    from ..cluster.errors import AlreadyExistsError
+    from ..deploy.manifests import notebook_crd
+    try:
+        store.create(notebook_crd())
+    except AlreadyExistsError:
+        pass
+
     def admit(operation, obj, old):
         if operation in ("CREATE", "UPDATE"):
             validate_notebook(obj)

@@ -75,6 +75,10 @@ class ClusterStore:
         self._watches: list[_Watch] = []
         # admission hooks: list of (kind, fn(operation, obj, old) -> obj|raise)
         self._admission: list[tuple[str, Callable]] = []
+        # CRD structural schemas: kind → {version: openAPIV3Schema}; kept in
+        # step with CustomResourceDefinition objects so CRs are validated
+        # server-side, as kube-apiserver does for installed CRDs
+        self._crd_schemas: dict[str, dict[str, dict]] = {}
 
     # ------------------------------------------------------------------ keys
     def _key(self, kind: str, namespace: str, name: str) -> ObjectKey:
@@ -97,7 +101,46 @@ class ClusterStore:
         for kind, fn in self._admission:
             if kind == k8s.kind(obj):
                 obj = fn(operation, obj, old)
+        # schema validation runs AFTER webhooks, on what will be persisted —
+        # the apiserver's phase order (mutating admission → schema →
+        # persistence)
+        self._validate_against_crd(obj)
         return obj
+
+    # -------------------------------------------------------- CRD schemas
+    def _index_crd(self, crd: dict) -> None:
+        kind = k8s.get_in(crd, "spec", "names", "kind")
+        if not kind:
+            return
+        versions = {}
+        for v in k8s.get_in(crd, "spec", "versions", default=[]) or []:
+            s = k8s.get_in(v, "schema", "openAPIV3Schema")
+            if v.get("served") and s:
+                versions[v["name"]] = s
+        if versions:
+            self._crd_schemas[kind] = versions
+
+    def _unindex_crd(self, crd: dict) -> None:
+        kind = k8s.get_in(crd, "spec", "names", "kind")
+        self._crd_schemas.pop(kind, None)
+
+    def _validate_against_crd(self, obj: dict) -> None:
+        versions = self._crd_schemas.get(k8s.kind(obj))
+        if not versions:
+            return
+        version = (obj.get("apiVersion") or "").rpartition("/")[2]
+        schema = versions.get(version)
+        if schema is None:
+            return  # unserved/unknown version: caught by typed admission
+        from ..api.schema import validate_schema
+        errors = validate_schema(obj, schema)
+        if errors:
+            shown = "; ".join(errors[:5])
+            if len(errors) > 5:
+                shown += f" (+{len(errors) - 5} more)"
+            raise InvalidError(
+                f"{k8s.kind(obj)} {k8s.namespace(obj)}/{k8s.name(obj)} "
+                f"is invalid: {shown}")
 
     # ----------------------------------------------------------------- verbs
     def create(self, obj: dict) -> dict:
@@ -118,6 +161,8 @@ class ClusterStore:
             md["generation"] = 1
             md.setdefault("creationTimestamp", _now_iso())
             self._objects[key] = obj
+            if key.kind == "CustomResourceDefinition":
+                self._index_crd(obj)
             stored = k8s.deepcopy(obj)
         self._notify(WatchEvent("ADDED", stored))
         return k8s.deepcopy(stored)
@@ -173,6 +218,8 @@ class ClusterStore:
                 deferred_events = self._remove_and_gc(key, replacement=obj)
             else:
                 self._objects[key] = obj
+                if key.kind == "CustomResourceDefinition":
+                    self._index_crd(obj)
                 deferred_events = [WatchEvent("MODIFIED", k8s.deepcopy(obj))]
             stored = k8s.deepcopy(obj)
         for ev in deferred_events:
@@ -246,6 +293,8 @@ class ClusterStore:
             del self._objects[key]
         if obj is None:
             return events
+        if key.kind == "CustomResourceDefinition":
+            self._unindex_crd(obj)
         events.append(WatchEvent("DELETED", k8s.deepcopy(obj)))
         owner_uid = k8s.uid(obj)
         if owner_uid:

@@ -28,6 +28,7 @@ from pathlib import Path
 
 import yaml
 
+from ..api import schema
 from ..api import types as api
 
 MANAGER_IMAGE_PARAM = "kubeflow-tpu-notebook-controller"
@@ -55,25 +56,26 @@ def _condition_schema() -> dict:
 
 
 def _notebook_schema() -> dict:
-    """The storage schema: spec wraps a bare PodSpec template (reference
-    api/v1beta1/notebook_types.go:27-34 — ``Template{Spec corev1.PodSpec}``),
-    which we keep opaque-but-preserved instead of inlining the reference's
-    11k-line expansion; validation beyond structure lives in the validating
-    webhook, where it can say WHY something is rejected."""
+    """The storage schema: spec wraps a PodSpec template (reference
+    api/v1beta1/notebook_types.go:27-34 — ``Template{Spec corev1.PodSpec}``)
+    with the pod spec TYPED on every field the controllers touch
+    (api/schema.py's maintained subset standing in for the reference's
+    11k-line generated expansion) so a malformed container is rejected
+    server-side; semantic validation beyond structure stays in the
+    validating webhook, where it can say WHY something is rejected."""
     return {
         "openAPIV3Schema": {
             "type": "object",
             "properties": {
                 "spec": {
                     "type": "object",
+                    "required": ["template"],
                     "properties": {
                         "template": {
                             "type": "object",
+                            "required": ["spec"],
                             "properties": {
-                                "spec": {
-                                    "type": "object",
-                                    "x-kubernetes-preserve-unknown-fields": True,
-                                },
+                                "spec": schema.pod_spec_schema(),
                             },
                         },
                     },
