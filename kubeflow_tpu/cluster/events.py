@@ -41,11 +41,15 @@ def _aggregation_suffix(uid: str, type_: str, reason: str,
     return h[:16]
 
 
-def _parse_iso(ts: str) -> float:
+def _parse_iso(ts: str) -> float | None:
+    """RFC3339 seconds ("...:00Z") or MicroTime ("...:00.000000Z", the
+    events.k8s.io eventTime shape) → epoch seconds; None if unparseable."""
+    if isinstance(ts, str) and "." in ts:
+        ts = ts.split(".")[0] + "Z"
     try:
         return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
     except (ValueError, TypeError):
-        return 0.0
+        return None
 
 
 class EventRecorder:
@@ -118,7 +122,12 @@ class EventRecorder:
             self._last_prune[namespace] = now_mono
         cutoff = time.time() - self.ttl_seconds
         for ev in self.client.list(EVENT_KIND, namespace):
-            if _parse_iso(ev.get("lastTimestamp", "")) < cutoff:
+            # externally-created Events may carry only eventTime (events.k8s.io
+            # shape) or none of the timestamps; never prune what we can't date
+            stamp = (_parse_iso(ev.get("lastTimestamp", ""))
+                     or _parse_iso(ev.get("firstTimestamp", ""))
+                     or _parse_iso(ev.get("eventTime", "")))
+            if stamp is not None and stamp < cutoff:
                 try:
                     self.client.delete(EVENT_KIND, namespace, k8s.name(ev))
                 except Exception:  # noqa: BLE001 — racing deletes are fine

@@ -7,9 +7,12 @@ concurrent requests into batches and answers each caller through a Future.
 
 TPU-first batching policy:
 - requests batch only when their (prompt_len, max_new_tokens) shapes match —
-  one compiled executable per shape, no padding/masking corrections needed,
-  and XLA's compile cache makes repeated shapes free (notebook serving is
-  dominated by templated, fixed-shape prompts);
+  no padding/masking corrections needed, and XLA's compile cache makes
+  repeated shapes free (notebook serving is dominated by templated,
+  fixed-shape prompts);
+- the batch dimension is padded up to power-of-two buckets (dummy rows,
+  outputs discarded), so a shape key compiles at most log2(max_batch)+1
+  executables rather than one per distinct batch size;
 - per-request temperatures ride one batch as a traced (batch,) vector
   (models/decode.py generate), so greedy and sampled requests coexist in a
   batch without recompiling;
@@ -167,12 +170,30 @@ class BatchedGenerator:
                     if not req.future.done():
                         req.future.set_exception(exc)
 
+    @staticmethod
+    def _bucket_size(n: int) -> int:
+        """Smallest power of two >= n: pads the batch dimension to a few
+        bucket sizes so XLA compiles one executable per (shape_key, bucket)
+        instead of one per distinct batch size 1..max_batch — without this,
+        variable load causes multi-second compile stalls on every new size."""
+        size = 1
+        while size < n:
+            size *= 2
+        return size
+
     def _run_batch(self, batch: list[GenerateRequest]) -> None:
         self.batch_sizes.append(len(batch))
         self.batches_total += 1
         self.requests_total += len(batch)
-        prompts = jnp.asarray(np.stack([r.prompt for r in batch]))
-        temps = jnp.asarray([r.temperature for r in batch], jnp.float32)
+        rows = [r.prompt for r in batch]
+        temps_list = [r.temperature for r in batch]
+        # never exceed the operator's cap: max_batch bounds device memory
+        pad = min(self._bucket_size(len(batch)), self.max_batch) - len(batch)
+        if pad:
+            rows.extend([rows[0]] * pad)       # dummy rows, outputs discarded
+            temps_list.extend([0.0] * pad)
+        prompts = jnp.asarray(np.stack(rows))
+        temps = jnp.asarray(temps_list, jnp.float32)
         self._key, sub = jax.random.split(self._key)
         out = generate(self.params, prompts, self.config,
                        batch[0].max_new_tokens, temperature=temps, key=sub)

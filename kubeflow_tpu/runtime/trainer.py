@@ -19,6 +19,7 @@ Loop design for TPU throughput:
 
 from __future__ import annotations
 
+import itertools
 import logging
 import time
 from dataclasses import dataclass, field
@@ -126,7 +127,11 @@ class Trainer:
         t0 = time.perf_counter()
         tokens_t0 = self.stats.tokens_seen
         loss = None
-        with prefetch_to_device(source, self.mesh,
+        # bound the draw count BEFORE prefetch: a stateful source reused
+        # across fit() calls must not lose the batch the old loop fetched
+        # just to notice the step target, nor the buffered ones behind it
+        bounded = itertools.islice(iter(source), steps)
+        with prefetch_to_device(bounded, self.mesh,
                                 buffer_size=prefetch_buffer) as batches:
             for tokens, targets in batches:
                 if self.stats.step >= target:

@@ -188,3 +188,41 @@ def test_event_ttl_prune(store):
     rec.eventf(nb, events.TYPE_NORMAL, "New", "fresh")
     reasons = {e["reason"] for e in store.list(events.EVENT_KIND, "ns")}
     assert reasons == {"New"}
+
+
+def test_prune_spares_undatable_events_but_dates_microtime(store):
+    """Externally-created Events with NO parseable timestamp must never be
+    pruned on sight; events.k8s.io-shaped ones carrying only a MicroTime
+    eventTime ARE datable and expire normally."""
+    nb = store.create(api.new_notebook("mynb", "ns"))
+    store.create({"kind": "Event", "apiVersion": "v1",
+                  "metadata": {"name": "ext-no-ts", "namespace": "ns"},
+                  "involvedObject": {"kind": "Notebook", "name": "mynb"},
+                  "reason": "External"})
+    store.create({"kind": "Event", "apiVersion": "v1",
+                  "metadata": {"name": "ext-eventtime", "namespace": "ns"},
+                  "involvedObject": {"kind": "Notebook", "name": "mynb"},
+                  "reason": "ExternalMicroStale",
+                  "eventTime": "2020-01-01T12:00:00.000000Z"})
+    rec = events.EventRecorder(store, ttl_seconds=60.0)
+    rec._last_prune.clear()
+    rec.eventf(nb, events.TYPE_NORMAL, "New", "fresh")
+    reasons = {e["reason"] for e in store.list(events.EVENT_KIND, "ns")}
+    assert "External" in reasons and "New" in reasons
+    assert "ExternalMicroStale" not in reasons  # MicroTime parsed → expired
+
+
+def test_prune_falls_back_to_first_timestamp(store):
+    """An aggregated event whose lastTimestamp was clobbered still expires
+    via firstTimestamp."""
+    store.create({"kind": "Event", "apiVersion": "v1",
+                  "metadata": {"name": "old-first-ts", "namespace": "ns"},
+                  "involvedObject": {"kind": "Notebook", "name": "mynb"},
+                  "reason": "OldFirst",
+                  "firstTimestamp": "2020-01-01T00:00:00Z"})
+    nb = store.create(api.new_notebook("mynb", "ns"))
+    rec = events.EventRecorder(store, ttl_seconds=60.0)
+    rec._last_prune.clear()
+    rec.eventf(nb, events.TYPE_NORMAL, "New", "fresh")
+    reasons = {e["reason"] for e in store.list(events.EVENT_KIND, "ns")}
+    assert "OldFirst" not in reasons and "New" in reasons

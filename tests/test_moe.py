@@ -121,3 +121,47 @@ def test_moe_rejects_pipeline_mesh():
                       devices=jax.devices()[:8])
     with pytest.raises(NotImplementedError):
         make_sharded_moe_train_step(mesh, cfg)
+
+
+def test_grouped_routing_memory_is_linear_in_tokens():
+    """ADVICE r1 (medium): dispatch must be (G, g, E, C_g) with per-group
+    capacity, not (N, E, C_N) — memory linear, not quadratic, in N."""
+    from kubeflow_tpu.models.moe import num_route_groups
+    cfg = tiny_config(route_group_size=64)
+    # N = 512 tokens → 8 groups of 64; per-group capacity scales with 64
+    assert num_route_groups(512, 64) == 8
+    cap_group = expert_capacity(64, cfg)
+    cap_flat = expert_capacity(512, cfg)
+    assert cap_group * 8 <= cap_flat + 8 * 4  # linear total slots
+    # non-divisible N still groups (smallest G dividing N with g <= 64)
+    assert num_route_groups(96, 64) == 2
+    assert num_route_groups(7, 64) == 1
+    assert num_route_groups(130, 64) == 5  # 130 = 5 * 26
+
+
+def test_grouped_forward_matches_ungrouped():
+    """Grouping changes capacity bookkeeping, not routing math: with ample
+    capacity (no drops) grouped and ungrouped forward agree."""
+    cfg_small_groups = tiny_config(route_group_size=8, capacity_factor=4.0)
+    cfg_one_group = tiny_config(route_group_size=1 << 20, capacity_factor=4.0)
+    params = init_moe_params(jax.random.key(0), cfg_small_groups)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0, 128)
+    out_a, aux_a = moe_forward(params, tokens, cfg_small_groups)
+    out_b, aux_b = moe_forward(params, tokens, cfg_one_group)
+    assert jnp.allclose(out_a, out_b, atol=1e-5)
+    # aux is computed per group (GShard semantics: balance WITHIN each group)
+    # so it legitimately differs from the global statistic — but stays in the
+    # same regime (≥1 at its minimum, close for near-uniform random routing)
+    assert 0.9 < float(aux_a) < 1.6 and 0.9 < float(aux_b) < 1.6
+
+
+def test_grouped_ep_sharded_step_still_trains():
+    cfg = tiny_config(route_group_size=16)
+    mesh = build_mesh(MeshConfig.auto(8, tp=2, ep=2),
+                      devices=jax.devices()[:8])
+    init_fn, step_fn = make_sharded_moe_train_step(mesh, cfg)
+    params, opt = init_fn(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 32), 0, cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    _, _, loss = step_fn(params, opt, tokens, targets)
+    assert jnp.isfinite(loss)

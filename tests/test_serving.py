@@ -91,3 +91,30 @@ def test_minority_shape_not_starved_under_sustained_load():
         assert out.shape == (2,)
         for f in majority:
             f.result(timeout=60)
+
+
+def test_batch_padding_buckets_to_powers_of_two():
+    """ADVICE r1: pad the batch dim to power-of-two buckets so each shape key
+    compiles O(log max_batch) executables, not one per batch size."""
+    assert BatchedGenerator._bucket_size(1) == 1
+    assert BatchedGenerator._bucket_size(2) == 2
+    assert BatchedGenerator._bucket_size(3) == 4
+    assert BatchedGenerator._bucket_size(5) == 8
+    params, cfg = model()
+    with BatchedGenerator(params, cfg, max_batch=8, max_wait_s=0.2) as gen:
+        # 3 concurrent requests → padded to a 4-row batch; results must be
+        # exactly the 3 real rows
+        futs = [gen.submit(p, max_new_tokens=4) for p in prompts(3)]
+        outs = [f.result(timeout=120) for f in futs]
+    direct = generate(params, np.stack(prompts(3)), cfg, 4)
+    for got, want in zip(outs, np.asarray(direct)):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_batch_padding_clamped_to_max_batch():
+    """Padding buckets must never exceed the operator's max_batch cap."""
+    params, cfg = model()
+    with BatchedGenerator(params, cfg, max_batch=3, max_wait_s=0.2) as gen:
+        futs = [gen.submit(p, max_new_tokens=4) for p in prompts(3)]
+        outs = [f.result(timeout=120) for f in futs]
+    assert len(outs) == 3  # 3 > bucket 2, cap 3 < bucket 4 → padded to 3

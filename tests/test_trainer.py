@@ -137,3 +137,25 @@ def test_trainer_profile_window_writes_trace(tmp_path):
                steps=5, log_every=10)
     trace_files = list((tmp_path / "trace").rglob("*"))
     assert any(f.is_file() for f in trace_files), "no trace output written"
+
+
+def test_fit_does_not_skip_batches_across_calls():
+    """ADVICE r1: a stateful source reused across fit() calls must see every
+    batch exactly once — the old loop discarded the fetched-but-unconsumed
+    batch (plus prefetch staging) at each fit() boundary."""
+    cfg = tiny_config()
+    drawn = []
+
+    def source():
+        for i, batch in enumerate(
+                synthetic_lm_batches(4, 16, cfg.vocab_size, n_batches=64)):
+            drawn.append(i)
+            yield batch
+
+    stream = source()
+    with Trainer(mesh8(), cfg, TrainConfig(warmup_steps=1)) as tr:
+        tr.fit(stream, steps=3, prefetch_buffer=2)
+        assert len(drawn) == 3          # exactly the consumed count
+        tr.fit(stream, steps=3, prefetch_buffer=2)
+        assert len(drawn) == 6          # continued, nothing skipped
+        assert tr.stats.step == 6
