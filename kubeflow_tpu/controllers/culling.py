@@ -86,19 +86,31 @@ def http_prober(config: ControllerConfig) -> Callable[[dict], JupyterActivity]:
     """Production prober: GET the Jupyter kernels/terminals APIs through the
     notebook Service (reference URL shape
     ``http://<name>.<ns>.svc.<domain>/notebook/<ns>/<name>/api/kernels``,
-    culling_controller.go:244-274). In DEV mode the reference targets
-    localhost; we keep the cluster path only."""
+    culling_controller.go:244-274). In DEV mode requests route through a
+    local apiserver proxy (kubectl proxy) exactly as the reference does
+    (culling_controller.go:249-254): ``<dev_proxy_url>/api/v1/namespaces/
+    <ns>/services/<name>/proxy<nb_prefix>/api/...``."""
     def probe(notebook: dict) -> JupyterActivity:
         ns, name = k8s.namespace(notebook), k8s.name(notebook)
-        base = (f"http://{name}.{ns}.svc.{config.cluster_domain}"
-                f"{names.nb_prefix(ns, name)}/api")
+        if config.dev_mode:
+            base = (f"{config.dev_proxy_url}/api/v1/namespaces/{ns}/"
+                    f"services/{name}/proxy"
+                    f"{names.nb_prefix(ns, name)}/api")
+        else:
+            base = (f"http://{name}.{ns}.svc.{config.cluster_domain}"
+                    f"{names.nb_prefix(ns, name)}/api")
         out = JupyterActivity()
         for endpoint in ("kernels", "terminals"):
             try:
                 with urllib.request.urlopen(
                         f"{base}/{endpoint}",
                         timeout=config.jupyter_probe_timeout_s) as resp:
-                    setattr(out, endpoint, json.loads(resp.read()))
+                    body = json.loads(resp.read())
+                if not isinstance(body, list) or not all(
+                        isinstance(item, dict) for item in body):
+                    raise ValueError(f"unexpected {endpoint} shape: "
+                                     f"{type(body).__name__}")
+                setattr(out, endpoint, body)
             except (urllib.error.URLError, OSError, ValueError) as exc:
                 log.debug("probe %s/%s %s failed: %s", ns, name, endpoint, exc)
                 setattr(out, endpoint, None)

@@ -36,6 +36,9 @@ class ControllerConfig:
     cull_idle_time_min: int = 1440
     idleness_check_period_min: int = 1
     dev_mode: bool = False
+    # kubectl-proxy endpoint the DEV-mode culler probes through (reference
+    # culling_controller.go:249-254)
+    dev_proxy_url: str = "http://localhost:8001"
     jupyter_probe_timeout_s: float = 10.0
     # odh-analog extension (odh main.go / params.env)
     controller_namespace: str = "kubeflow-tpu-system"
@@ -73,6 +76,7 @@ class ControllerConfig:
             cull_idle_time_min=int(env.get("CULL_IDLE_TIME", "1440")),
             idleness_check_period_min=int(env.get("IDLENESS_CHECK_PERIOD", "1")),
             dev_mode=_env_bool("DEV", False),
+            dev_proxy_url=env.get("DEV_PROXY_URL", "http://localhost:8001"),
             controller_namespace=env.get("K8S_NAMESPACE", "kubeflow-tpu-system"),
             gateway_name=env.get("NOTEBOOK_GATEWAY_NAME", "data-science-gateway"),
             gateway_namespace=env.get("NOTEBOOK_GATEWAY_NAMESPACE", "openshift-ingress"),
