@@ -27,12 +27,17 @@ WORKBENCHES_LABEL = "opendatahub.io/workbenches"
 NOTEBOOK_NAME_LABEL = "notebook-name"
 ODH_NOTEBOOK_NAME_LABEL = "opendatahub.io/odh-notebook-name"
 IMAGE_SELECTION_ANNOTATION = "notebooks.opendatahub.io/last-image-selection"
+# ImageStream lookup namespace for the image selection (reference
+# WorkbenchImageNamespaceAnnotation; empty/missing → controller namespace)
+WORKBENCH_IMAGE_NAMESPACE_ANNOTATION = "opendatahub.io/workbench-image-namespace"
 RECONCILIATION_LOCK_VALUE = "odh-notebook-controller-lock"
 
 # --- TPU-native keys (new in this framework; no reference analog, §2d/§7) ---
 TPU_ACCELERATOR_ANNOTATION = "tpu.kubeflow.org/accelerator"
 TPU_TOPOLOGY_ANNOTATION = "tpu.kubeflow.org/topology"
 TPU_SLICE_LABEL = "tpu.kubeflow.org/slice"
+# records what the image was before the TPU image swap replaced it
+TPU_ORIGINAL_IMAGE_ANNOTATION = "tpu.kubeflow.org/original-image"
 
 # Kubernetes DNS-1123 subdomain limit for the pod hostname contributed by the
 # StatefulSet name; the reference caps STS names at 52 chars so the "-<ordinal>"

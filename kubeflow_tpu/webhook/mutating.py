@@ -7,10 +7,11 @@ Re-implements the admission pipeline of the reference's NotebookWebhook.Handle
    sentinel so the StatefulSet starts at replicas=0 until the extension
    reconciler confirms prerequisites (reference :382-389,:113-122; prevents
    the pod racing its image-pull secret);
-2. image swap: where the reference resolves ImageStream tags to digests
-   (:861-972), the TPU analog swaps CUDA/generic notebook images for
-   JAX/libtpu images when the CR requests a TPU slice — mapping from
-   config.image_swap_map with config.tpu_default_image fallback;
+2. image resolution + TPU swap: annotation-selected ImageStream tags resolve
+   to digest-pinned references (SetContainerImageFromRegistry, :861-972),
+   then CRs requesting a TPU slice get CUDA/generic images swapped for
+   JAX/libtpu images — mapping from config.image_swap_map with
+   config.tpu_default_image fallback;
 3. CA bundle mount when the per-namespace trust ConfigMap exists
    (:699-859);
 4. MLflow env-var injection, Feast config mount (label-gated), pipeline
@@ -30,6 +31,7 @@ import json
 import logging
 
 from ..api import types as api
+from ..cluster.errors import InvalidError
 from ..tpu.topology import parse_slice_request
 from ..utils import k8s, names, tracing
 from ..utils.config import ControllerConfig
@@ -75,6 +77,7 @@ class NotebookMutatingWebhook:
             if operation == "CREATE":
                 self._inject_reconciliation_lock(mutated)
 
+            self._resolve_image_selection(mutated, operation)
             self._swap_image_for_tpu(mutated)
             self._mount_ca_bundle(mutated)
             self._mount_runtime_images(mutated)
@@ -101,13 +104,94 @@ class NotebookMutatingWebhook:
         if names.STOP_ANNOTATION not in anns:
             anns[names.STOP_ANNOTATION] = names.RECONCILIATION_LOCK_VALUE
 
+    # ------------------------------------- image resolution (stage 2a)
+    INTERNAL_REGISTRY_HOST = "image-registry.openshift-image-registry.svc:5000"
+
+    def _resolve_image_selection(self, nb: dict, operation: str) -> None:
+        """Annotation-driven image selection with digest pinning — reference
+        SetContainerImageFromRegistry (notebook_mutating_webhook.go:861-972):
+
+        - ``last-image-selection: <imagestream>:<tag>`` names the selection;
+        - an image already pointing at the internal registry is left alone;
+        - the ImageStream is looked up in the workbench-image-namespace
+          annotation's namespace, defaulting to the controller namespace;
+        - the newest item of the matching status tag provides the
+          digest-pinned dockerImageReference, which becomes the container
+          image (stable across reconciles — re-admission resolves to the
+          same digest);
+        - JUPYTER_IMAGE env (when present) is updated to the selection;
+        - misses emit the reference's span events and leave the image as-is,
+          except a malformed selection / missing tags, which deny admission.
+        """
+        selection = k8s.get_annotation(nb, names.IMAGE_SELECTION_ANNOTATION)
+        if not selection:
+            return
+        # shared container convention (api.notebook_container: name-matched
+        # else containers[0]) — webhook and reconcilers MUST target the same
+        # container (api/types.py)
+        container = api.notebook_container(nb)
+        if container is None:
+            raise InvalidError(
+                f"notebook {k8s.name(nb)} has no containers to resolve the "
+                f"image selection onto")
+        if self.INTERNAL_REGISTRY_HOST in container.get("image", ""):
+            return  # digest already pinned by the internal registry
+        parts = selection.split(":")
+        if len(parts) != 2:
+            # strict on CREATE (reference errors on a malformed selection);
+            # lenient on UPDATE so a pre-existing object carrying a legacy
+            # or hand-written value is never bricked — stop/resume and
+            # culling patches must keep flowing
+            if operation == "CREATE":
+                raise InvalidError(f"invalid image selection format: "
+                                   f"{selection!r}")
+            tracing.current_span().add_event(
+                "image-selection-malformed", {"selection": selection})
+            return
+        stream_name, tag_name = parts
+        stream_ns = (k8s.get_annotation(
+            nb, names.WORKBENCH_IMAGE_NAMESPACE_ANNOTATION) or "").strip() \
+            or self.config.controller_namespace
+        stream = self.client.get_or_none("ImageStream", stream_ns, stream_name)
+        if stream is None:
+            tracing.current_span().add_event(
+                "image-stream-not-found",
+                {"imagestream": stream_name, "namespace": stream_ns})
+            return
+        tags = k8s.get_in(stream, "status", "tags", default=None)
+        if not tags:
+            tracing.current_span().add_event(
+                "image-stream-tag-not-found", {"imagestream": stream_name})
+            raise InvalidError(
+                f"ImageStream {stream_ns}/{stream_name} has no status or tags")
+        for tag in tags:
+            if tag.get("tag") != tag_name:
+                continue
+            items = tag.get("items") or []
+            if not items:
+                continue
+            newest = max(items, key=lambda item: item.get("created", ""))
+            image_ref = newest.get("dockerImageReference", "")
+            if not image_ref:
+                continue
+            container["image"] = image_ref
+            for env in container.get("env", []) or []:
+                if env.get("name") == "JUPYTER_IMAGE":
+                    env["value"] = selection
+                    break
+            tracing.current_span().add_event(
+                "image-resolved", {"selection": selection, "image": image_ref})
+            return
+        tracing.current_span().add_event(
+            "image-stream-tag-not-found",
+            {"imagestream": stream_name, "tag": tag_name})
+
     # ------------------------------------------------ image swap (stage 2)
     def _swap_image_for_tpu(self, nb: dict) -> None:
-        """TPU analog of SetContainerImageFromRegistry (:861-972): a CR
-        requesting a TPU slice gets CUDA/generic images replaced by the
-        JAX/libtpu image so the provisioned pod can actually drive the chips.
-        The original image is recorded in the last-image-selection annotation
-        (reference records the ImageStream selection the same way)."""
+        """TPU-native stage after image resolution: a CR requesting a TPU
+        slice gets CUDA/generic images replaced by the JAX/libtpu image so
+        the provisioned pod can actually drive the chips. The replaced image
+        is recorded in the tpu original-image annotation."""
         try:
             slice_spec = parse_slice_request(
                 k8s.get_in(nb, "metadata", "annotations", default={}))
@@ -131,7 +215,7 @@ class NotebookMutatingWebhook:
                 "image-swap-skipped", {"image": image})
             return  # already a TPU-capable image (or user knows best)
         if new_image and new_image != image:
-            k8s.set_annotation(nb, names.IMAGE_SELECTION_ANNOTATION, image)
+            k8s.set_annotation(nb, names.TPU_ORIGINAL_IMAGE_ANNOTATION, image)
             container["image"] = new_image
             tracing.current_span().add_event(
                 "image-swapped", {"from": image, "to": new_image})

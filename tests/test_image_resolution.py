@@ -1,0 +1,213 @@
+"""Annotation-driven image selection with digest pinning.
+
+Mirrors the reference's SetContainerImageFromRegistry spec surface
+(notebook_mutating_webhook.go:861-972 + notebook_mutating_webhook_test.go):
+internal-registry short-circuit, namespace annotation fallback, newest-item
+digest selection, JUPYTER_IMAGE update, miss events, and the interplay with
+TPU swap and restart gating.
+"""
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.errors import InvalidError
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webhook import NotebookMutatingWebhook
+
+CONTROLLER_NS = "kubeflow-tpu-system"
+DIGEST_OLD = ("image-registry.example.com/ds/jupyter-ds"
+              "@sha256:" + "a" * 64)
+DIGEST_NEW = ("image-registry.example.com/ds/jupyter-ds"
+              "@sha256:" + "b" * 64)
+
+
+def imagestream(name, ns=CONTROLLER_NS, tags=None):
+    return {"kind": "ImageStream", "apiVersion": "image.openshift.io/v1",
+            "metadata": {"name": name, "namespace": ns},
+            "status": {"tags": tags if tags is not None else [{
+                "tag": "2024.2",
+                "items": [
+                    {"created": "2024-01-01T00:00:00Z",
+                     "dockerImageReference": DIGEST_OLD},
+                    {"created": "2024-06-01T00:00:00Z",
+                     "dockerImageReference": DIGEST_NEW},
+                ],
+            }]}}
+
+
+@pytest.fixture
+def world():
+    store = ClusterStore()
+    config = ControllerConfig(controller_namespace=CONTROLLER_NS)
+    NotebookMutatingWebhook(store, config).install(store)
+    return store, config
+
+
+def nb_with_selection(selection="jupyter-ds:2024.2", image="placeholder:latest",
+                      extra_annotations=None, env=None):
+    annotations = {names.IMAGE_SELECTION_ANNOTATION: selection}
+    annotations.update(extra_annotations or {})
+    containers = [{"name": "nb", "image": image}]
+    if env:
+        containers[0]["env"] = env
+    return api.new_notebook("nb", "ns", annotations=annotations,
+                            containers=containers)
+
+
+def test_selection_resolves_to_newest_digest(world):
+    store, _ = world
+    store.create(imagestream("jupyter-ds"))
+    out = store.create(nb_with_selection())
+    assert api.notebook_container(out)["image"] == DIGEST_NEW
+
+
+def test_resolution_is_digest_stable_across_readmissions(world):
+    store, _ = world
+    store.create(imagestream("jupyter-ds"))
+    out = store.create(nb_with_selection())
+    # re-admission (any update) resolves to the same digest — idempotent
+    out["metadata"]["labels"] = {"touch": "1"}
+    out2 = store.update(out)
+    assert api.notebook_container(out2)["image"] == DIGEST_NEW
+
+
+def test_internal_registry_image_left_alone(world):
+    store, _ = world
+    store.create(imagestream("jupyter-ds"))
+    internal = ("image-registry.openshift-image-registry.svc:5000"
+                "/ns/img:tag")
+    out = store.create(nb_with_selection(image=internal))
+    assert api.notebook_container(out)["image"] == internal
+
+
+def test_namespace_annotation_overrides_lookup_ns(world):
+    store, _ = world
+    store.create(imagestream("jupyter-ds", ns="custom-ns"))
+    out = store.create(nb_with_selection(extra_annotations={
+        names.WORKBENCH_IMAGE_NAMESPACE_ANNOTATION: "custom-ns"}))
+    assert api.notebook_container(out)["image"] == DIGEST_NEW
+
+
+def test_empty_namespace_annotation_falls_back_to_controller_ns(world):
+    store, _ = world
+    store.create(imagestream("jupyter-ds"))
+    out = store.create(nb_with_selection(extra_annotations={
+        names.WORKBENCH_IMAGE_NAMESPACE_ANNOTATION: "  "}))
+    assert api.notebook_container(out)["image"] == DIGEST_NEW
+
+
+def test_jupyter_image_env_updated_to_selection(world):
+    store, _ = world
+    store.create(imagestream("jupyter-ds"))
+    out = store.create(nb_with_selection(
+        env=[{"name": "JUPYTER_IMAGE", "value": "old"}]))
+    env = k8s.env_list_to_dict(api.notebook_container(out)["env"])
+    assert env["JUPYTER_IMAGE"] == "jupyter-ds:2024.2"
+
+
+def test_missing_imagestream_leaves_image(world):
+    store, _ = world
+    out = store.create(nb_with_selection())
+    assert api.notebook_container(out)["image"] == "placeholder:latest"
+
+
+def test_missing_tag_leaves_image(world):
+    store, _ = world
+    store.create(imagestream("jupyter-ds"))
+    out = store.create(nb_with_selection(selection="jupyter-ds:other-tag"))
+    assert api.notebook_container(out)["image"] == "placeholder:latest"
+
+
+def test_imagestream_without_tags_denied(world):
+    store, _ = world
+    store.create(imagestream("jupyter-ds", tags=[]))
+    with pytest.raises(InvalidError, match="no status or tags"):
+        store.create(nb_with_selection())
+
+
+def test_malformed_selection_denied(world):
+    store, _ = world
+    with pytest.raises(InvalidError, match="invalid image selection"):
+        store.create(nb_with_selection(selection="registry.io/a:b:c"))
+
+
+def test_selection_without_any_container_denied(world):
+    """Only a notebook with NO containers at all is denied; a
+    differently-named single container resolves via the shared containers[0]
+    convention (separate test below)."""
+    store, _ = world
+    nb = {"kind": "Notebook", "apiVersion": "kubeflow.org/v1",
+          "metadata": {"name": "nb", "namespace": "ns", "annotations": {
+              names.IMAGE_SELECTION_ANNOTATION: "jupyter-ds:2024.2"}},
+          "spec": {"template": {"spec": {"containers": []}}}}
+    with pytest.raises(InvalidError):
+        store.create(nb)
+
+
+def test_resolution_then_tpu_swap_composes(world):
+    """A selected CUDA stream on a TPU CR: resolve to digest first, then the
+    TPU stage swaps it and records the digest as the original image."""
+    store, config = world
+    cuda_digest = "reg.example.com/cuda-notebook@sha256:" + "c" * 64
+    store.create(imagestream("jupyter-cuda", tags=[{
+        "tag": "1.0", "items": [{"created": "2024-01-01T00:00:00Z",
+                                 "dockerImageReference": cuda_digest}]}]))
+    out = store.create(nb_with_selection(
+        selection="jupyter-cuda:1.0",
+        extra_annotations={names.TPU_ACCELERATOR_ANNOTATION: "v5e-4"}))
+    c = api.notebook_container(out)
+    assert c["image"] == config.tpu_default_image
+    assert k8s.get_annotation(out, names.TPU_ORIGINAL_IMAGE_ANNOTATION) == \
+        cuda_digest
+
+
+def test_resolution_parked_on_running_notebook(world):
+    """Restart gating: annotating a selection on a RUNNING notebook must not
+    bounce the slice — the resolved image parks in update-pending."""
+    store, _ = world
+    store.create(imagestream("jupyter-ds"))
+    created = store.create(api.new_notebook(
+        "nb", "ns", containers=[{"name": "nb", "image": "placeholder:1"}]))
+    running = store.get(api.KIND, "ns", "nb")
+    k8s.remove_annotation(running, names.STOP_ANNOTATION)  # running now
+    running = store.update(running)
+    k8s.set_annotation(running, names.IMAGE_SELECTION_ANNOTATION,
+                       "jupyter-ds:2024.2")
+    out = store.update(running)
+    assert api.notebook_container(out)["image"] == "placeholder:1"
+    assert k8s.get_annotation(out, names.UPDATE_PENDING_ANNOTATION)
+
+
+def test_legacy_malformed_selection_does_not_brick_updates(world):
+    """Round-1 wrote plain image refs (ports, no tag) into the selection
+    annotation; UPDATEs on such objects must keep flowing (stop/resume,
+    culling patches), while CREATE stays strict like the reference."""
+    store, _ = world
+    nb = api.new_notebook("nb", "ns")
+    created = store.create(nb)
+    # legacy value arrives via an update (e.g. imported from a round-1 store)
+    k8s.set_annotation(created, names.IMAGE_SELECTION_ANNOTATION,
+                       "registry.local:5000/cuda:2024")
+    updated = store.update(created)  # not denied
+    # and further updates (a stop) still flow
+    store.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+    assert k8s.get_annotation(store.get(api.KIND, "ns", "nb"),
+                              names.STOP_ANNOTATION)
+    assert api.notebook_container(updated)["image"] == "jupyter-minimal:latest"
+
+
+def test_selection_targets_first_container_when_name_differs(world):
+    """Shared container convention: name-matched else containers[0]
+    (api/types.py) — a differently-named single container still resolves."""
+    store, _ = world
+    store.create(imagestream("jupyter-ds"))
+    nb = api.new_notebook(
+        "nb", "ns",
+        annotations={names.IMAGE_SELECTION_ANNOTATION: "jupyter-ds:2024.2"},
+        containers=[{"name": "main", "image": "placeholder:latest"}])
+    out = store.create(nb)
+    assert out["spec"]["template"]["spec"]["containers"][0]["image"] == \
+        DIGEST_NEW

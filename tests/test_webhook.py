@@ -48,7 +48,7 @@ def test_image_swap_for_tpu_notebook(world):
     out = store.create(nb)
     c = api.notebook_container(out)
     assert c["image"] == "jax-notebook:v1"
-    assert k8s.get_annotation(out, names.IMAGE_SELECTION_ANNOTATION) == \
+    assert k8s.get_annotation(out, names.TPU_ORIGINAL_IMAGE_ANNOTATION) == \
         "quay.io/jupyter-cuda:2024"
 
 
