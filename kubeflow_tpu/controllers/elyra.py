@@ -4,27 +4,80 @@ Reference: odh notebook_dspa_secret.go:49-484 — when a DSPA (Data Science
 Pipelines Application) exists in the notebook's namespace and
 SET_PIPELINE_SECRET is on, build the Elyra runtime config JSON
 (``odh_dsp.json``: pipelines API endpoint + S3 object storage details) as a
-Secret owned by the DSPA, and mount it into the notebook. Public-endpoint
-hostname comes from the configured gateway."""
+Secret owned by the DSPA, and mount it into the notebook. The
+public-endpoint hostname is DISCOVERED from cluster objects: the Gateway
+CR's first listener, with a Route fallback through the Gateway's
+GatewayConfig owner (getHostnameForPublicEndpoint,
+notebook_dspa_secret.go:104-147)."""
 
 from __future__ import annotations
 
 import base64
 import json
+import logging
 
 from ..cluster import errors
 from ..utils import k8s
 from ..utils.config import ControllerConfig
 
+log = logging.getLogger("kubeflow_tpu.elyra")
+
 SECRET_NAME = "ds-pipeline-config"
 MOUNT_PATH = "/opt/app-root/src/.local/share/jupyter/metadata/runtimes"
 
 
+def _gateway_config_owner(gateway: dict) -> str:
+    """Reference getGatewayConfigOwnerName (notebook_dspa_secret.go:90-102)."""
+    for ref in k8s.get_in(gateway, "metadata", "ownerReferences",
+                          default=[]) or []:
+        if ref.get("kind") == "GatewayConfig":
+            return ref.get("name", "")
+    return ""
+
+
+def discover_public_hostname(client, config: ControllerConfig) -> str:
+    """Hostname for the Elyra public endpoint, by the reference's fallback
+    chain (getHostnameForPublicEndpoint, notebook_dspa_secret.go:104-147):
+
+    1. Gateway <gateway_name> in <gateway_namespace>: first listener's
+       ``hostname``;
+    2. else a Route in the gateway namespace owned by the Gateway's
+       GatewayConfig owner, via ``spec.host``;
+    3. else the static GATEWAY_URL config (our extension — the reference has
+       no static override here and returns ""), else "".
+    """
+    gateway = client.get_or_none("Gateway", config.gateway_namespace,
+                                 config.gateway_name)
+    if gateway is not None:
+        listeners = k8s.get_in(gateway, "spec", "listeners", default=[]) or []
+        hostname = listeners[0].get("hostname", "") if listeners else ""
+        if hostname:
+            return hostname
+        owner = _gateway_config_owner(gateway)
+        if owner:
+            for route in client.list("Route", config.gateway_namespace):
+                for ref in k8s.get_in(route, "metadata", "ownerReferences",
+                                      default=[]) or []:
+                    if ref.get("kind") == "GatewayConfig" and \
+                            ref.get("name") == owner:
+                        host = k8s.get_in(route, "spec", "host", default="")
+                        if host:
+                            return host
+                        log.info("Route %s owned by GatewayConfig %s has "
+                                 "empty spec.host", k8s.name(route), owner)
+        else:
+            log.info("Gateway has no GatewayConfig owner - cannot fall back "
+                     "to Route")
+    return config.gateway_url or ""
+
+
 def extract_runtime_config(dspa: dict, config: ControllerConfig,
-                           namespace: str) -> dict | None:
+                           namespace: str, client=None) -> dict | None:
     """DSPA CR → Elyra runtime definition (reference
     extractElyraRuntimeConfigInfo). Returns None when the DSPA lacks the
-    object-storage wiring."""
+    object-storage wiring. The public endpoint is set only when a hostname
+    was discoverable (reference omits it otherwise,
+    notebook_dspa_secret.go:281-291)."""
     s3 = k8s.get_in(dspa, "spec", "objectStorage", "externalStorage")
     if not s3:
         return None
@@ -32,25 +85,29 @@ def extract_runtime_config(dspa: dict, config: ControllerConfig,
     bucket = s3.get("bucket", "")
     if not host or not bucket:
         return None
-    gateway = config.gateway_url or "gateway.invalid"
-    api_endpoint = (f"https://{gateway}/pipelines/{namespace}/"
-                    f"{k8s.name(dspa)}")
+    hostname = discover_public_hostname(client, config) if client is not None \
+        else (config.gateway_url or "")
+    api_endpoint = (f"https://{hostname or 'gateway.invalid'}/pipelines/"
+                    f"{namespace}/{k8s.name(dspa)}")
+    metadata = {
+        "tags": [],
+        "display_name": f"Data Science Pipeline: {k8s.name(dspa)}",
+        "engine": "Argo",
+        "auth_type": "KUBERNETES_SERVICE_ACCOUNT_TOKEN",
+        "api_endpoint": api_endpoint,
+        "cos_auth_type": "KUBERNETES_SECRET",
+        "cos_endpoint": f"https://{host}",
+        "cos_bucket": bucket,
+        "cos_secret": k8s.get_in(s3, "s3CredentialsSecret", "secretName",
+                                 default=""),
+        "runtime_type": "KUBEFLOW_PIPELINES",
+    }
+    if hostname:
+        metadata["public_api_endpoint"] = \
+            f"https://{hostname}/external/elyra/{namespace}"
     return {
         "display_name": f"Data Science Pipeline: {k8s.name(dspa)}",
-        "metadata": {
-            "tags": [],
-            "display_name": f"Data Science Pipeline: {k8s.name(dspa)}",
-            "engine": "Argo",
-            "auth_type": "KUBERNETES_SERVICE_ACCOUNT_TOKEN",
-            "api_endpoint": api_endpoint,
-            "public_api_endpoint": api_endpoint,
-            "cos_auth_type": "KUBERNETES_SECRET",
-            "cos_endpoint": f"https://{host}",
-            "cos_bucket": bucket,
-            "cos_secret": k8s.get_in(s3, "s3CredentialsSecret", "secretName",
-                                     default=""),
-            "runtime_type": "KUBEFLOW_PIPELINES",
-        },
+        "metadata": metadata,
         "schema_name": "kfp",
     }
 
@@ -68,7 +125,7 @@ def sync_elyra_runtime_secret(client, config: ControllerConfig,
             pass
         return False
     dspa = sorted(dspas, key=k8s.name)[0]
-    runtime = extract_runtime_config(dspa, config, namespace)
+    runtime = extract_runtime_config(dspa, config, namespace, client)
     if runtime is None:
         return False
     payload = base64.b64encode(
