@@ -204,9 +204,17 @@ class ExtensionReconciler:
                     self.client.create(desired)
                 except errors.AlreadyExistsError:
                     pass
-            elif desired.get("spec") is not None and \
-                    existing.get("spec") != desired.get("spec"):
-                existing["spec"] = k8s.deepcopy(desired["spec"])
+                continue
+            # repair drift on whichever payload the resource carries: spec
+            # (Service) or data (the SAR ConfigMap — tampering with it would
+            # change what the auth proxy authorizes)
+            changed = False
+            for payload in ("spec", "data"):
+                if desired.get(payload) is not None and \
+                        existing.get(payload) != desired.get(payload):
+                    existing[payload] = k8s.deepcopy(desired[payload])
+                    changed = True
+            if changed:
                 self.client.update(existing)
         crb = auth.new_auth_delegator_crb(notebook)
         if self.client.get_or_none("ClusterRoleBinding", "",
