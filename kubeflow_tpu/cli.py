@@ -1,0 +1,233 @@
+"""kubectl-style CLI against the HTTP apiserver (facade or real cluster).
+
+The reference's operational surface is kubectl: apply Notebook CRs, inspect
+status, set the stop annotation, delete (SURVEY §3; the load test scripts
+kubectl directly, loadtest/start_notebooks.py). This module is that surface
+for the framework's own transport — it speaks the same REST protocol through
+HttpApiClient, so it works against ``--serve-apiserver`` standalone clusters
+and real apiservers alike.
+
+    python -m kubeflow_tpu.cli --server http://127.0.0.1:6443 apply -f nb.yaml
+    python -m kubeflow_tpu.cli get notebooks -n proj
+    python -m kubeflow_tpu.cli stop notebook proj/demo
+    python -m kubeflow_tpu.cli delete notebook proj/demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .cluster.errors import ApiError, NotFoundError
+from .cluster.http_client import HttpApiClient
+from .utils import k8s, names
+
+# plural/short → canonical kind (the CLI analog of kubectl's RESTMapper)
+KIND_ALIASES = {
+    "notebook": "Notebook", "notebooks": "Notebook", "nb": "Notebook",
+    "statefulset": "StatefulSet", "statefulsets": "StatefulSet",
+    "sts": "StatefulSet",
+    "service": "Service", "services": "Service", "svc": "Service",
+    "pod": "Pod", "pods": "Pod", "po": "Pod",
+    "configmap": "ConfigMap", "configmaps": "ConfigMap", "cm": "ConfigMap",
+    "secret": "Secret", "secrets": "Secret",
+    "event": "Event", "events": "Event", "ev": "Event",
+    "httproute": "HTTPRoute", "httproutes": "HTTPRoute",
+    "referencegrant": "ReferenceGrant", "referencegrants": "ReferenceGrant",
+    "networkpolicy": "NetworkPolicy", "networkpolicies": "NetworkPolicy",
+    "netpol": "NetworkPolicy",
+    "serviceaccount": "ServiceAccount", "serviceaccounts": "ServiceAccount",
+    "sa": "ServiceAccount",
+    "lease": "Lease", "leases": "Lease",
+    "namespace": "Namespace", "namespaces": "Namespace", "ns": "Namespace",
+}
+
+
+def resolve_kind(token: str) -> str:
+    kind = KIND_ALIASES.get(token.lower())
+    if kind is None:
+        # accept exact CamelCase kinds too
+        if token[:1].isupper():
+            return token
+        raise SystemExit(f"error: unknown resource type {token!r}")
+    return kind
+
+
+def split_ref(ref: str, namespace: str) -> tuple[str, str]:
+    """'ns/name' or 'name' (+ -n namespace) → (ns, name)."""
+    if "/" in ref:
+        ns, _, name = ref.partition("/")
+        return ns, name
+    return namespace, ref
+
+
+def build_client(args) -> HttpApiClient:
+    if args.kubeconfig:
+        return HttpApiClient.from_kubeconfig(args.kubeconfig)
+    return HttpApiClient(args.server, token=args.token,
+                         verify=not args.insecure_skip_tls_verify)
+
+
+def load_documents(path: str):
+    import contextlib
+
+    import yaml
+    ctx = contextlib.nullcontext(sys.stdin) if path == "-" else open(path)
+    with ctx as stream:
+        for doc in yaml.safe_load_all(stream):
+            if doc:
+                yield doc
+
+
+# ------------------------------------------------------------------ commands
+def cmd_apply(client, args) -> int:
+    rc = 0
+    for obj in load_documents(args.filename):
+        kind, ns, name = k8s.kind(obj), k8s.namespace(obj), k8s.name(obj)
+        try:
+            existing = client.get_or_none(kind, ns, name) if name else None
+            if existing is None:
+                created = client.create(obj)
+                print(f"{kind.lower()}/{k8s.name(created)} created")
+            else:
+                obj.setdefault("metadata", {})["resourceVersion"] = \
+                    existing["metadata"]["resourceVersion"]
+                client.update(obj)
+                print(f"{kind.lower()}/{name} configured")
+        except ApiError as err:
+            print(f"error applying {kind}/{name}: {err.message}",
+                  file=sys.stderr)
+            rc = 1
+        except KeyError as err:  # unmapped kind: keep applying the rest
+            print(f"error applying {kind}/{name}: {err.args[0]}",
+                  file=sys.stderr)
+            rc = 1
+    return rc
+
+
+def _ready_of(obj: dict) -> str:
+    from .api import types as api
+    if k8s.kind(obj) == "Notebook":
+        cond = api.get_condition(obj, api.CONDITION_SLICE_READY)
+        if cond:
+            return cond["status"]
+        return "Stopped" if k8s.get_annotation(
+            obj, names.STOP_ANNOTATION) is not None else "Unknown"
+    if k8s.kind(obj) == "Pod":
+        return k8s.get_in(obj, "status", "phase", default="Unknown")
+    return ""
+
+
+def cmd_get(client, args) -> int:
+    kind = resolve_kind(args.resource)
+    if args.name:
+        ns, name = split_ref(args.name, args.namespace)
+        try:
+            obj = client.get(kind, ns, name)
+        except NotFoundError:
+            print(f"Error: {kind.lower()} {ns}/{name} not found",
+                  file=sys.stderr)
+            return 1
+        if args.output == "json":
+            print(json.dumps(obj, indent=2))
+        else:
+            _print_table([obj])
+        return 0
+    objs = client.list(kind, args.namespace or None)
+    if args.output == "json":
+        print(json.dumps({"kind": f"{kind}List", "items": objs}, indent=2))
+    else:
+        _print_table(objs)
+    return 0
+
+
+def _print_table(objs) -> None:
+    rows = [("NAMESPACE", "NAME", "READY", "AGE")]
+    for obj in objs:
+        rows.append((k8s.namespace(obj) or "-", k8s.name(obj),
+                     _ready_of(obj) or "-",
+                     k8s.get_in(obj, "metadata", "creationTimestamp",
+                                default="-")))
+    widths = [max(len(r[i]) for r in rows) for i in range(4)]
+    for row in rows:
+        print("  ".join(cell.ljust(widths[i])
+                        for i, cell in enumerate(row)).rstrip())
+
+
+def cmd_delete(client, args) -> int:
+    kind = resolve_kind(args.resource)
+    ns, name = split_ref(args.name, args.namespace)
+    try:
+        client.delete(kind, ns, name)
+    except NotFoundError:
+        print(f"Error: {kind.lower()} {ns}/{name} not found", file=sys.stderr)
+        return 1
+    print(f"{kind.lower()}/{name} deleted")
+    return 0
+
+
+def cmd_stop(client, args) -> int:
+    ns, name = split_ref(args.name, args.namespace)
+    client.patch("Notebook", ns, name, {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: k8s.now_iso()}}})
+    print(f"notebook/{name} stopped")
+    return 0
+
+
+def cmd_resume(client, args) -> int:
+    ns, name = split_ref(args.name, args.namespace)
+    client.patch("Notebook", ns, name, {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: None}}})
+    print(f"notebook/{name} resumed")
+    return 0
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="kubeflow-tpu", description=__doc__.splitlines()[0])
+    ap.add_argument("--server", default="http://127.0.0.1:6443")
+    ap.add_argument("--kubeconfig", default=None)
+    ap.add_argument("--token", default=None)
+    ap.add_argument("--insecure-skip-tls-verify", action="store_true")
+    ap.add_argument("-n", "--namespace", default="default")
+    sub = ap.add_subparsers(dest="command", required=True)
+
+    p_apply = sub.add_parser("apply", help="apply YAML manifests")
+    p_apply.add_argument("-f", "--filename", required=True,
+                         help="path or - for stdin")
+
+    p_get = sub.add_parser("get", help="list/show resources")
+    p_get.add_argument("resource")
+    p_get.add_argument("name", nargs="?")
+    p_get.add_argument("-o", "--output", choices=("table", "json"),
+                       default="table")
+
+    p_del = sub.add_parser("delete", help="delete a resource")
+    p_del.add_argument("resource")
+    p_del.add_argument("name")
+
+    for verb in ("stop", "resume"):
+        p = sub.add_parser(verb, help=f"{verb} a notebook (slice-atomic)")
+        p.add_argument("resource", choices=("notebook", "nb"))
+        p.add_argument("name")
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    client = build_client(args)
+    handler = {"apply": cmd_apply, "get": cmd_get, "delete": cmd_delete,
+               "stop": cmd_stop, "resume": cmd_resume}[args.command]
+    try:
+        return handler(client, args)
+    except ApiError as err:
+        print(f"Error from server: {err.message}", file=sys.stderr)
+        return 1
+    except KeyError as err:  # restmapper: kind without a REST mapping
+        print(f"Error: {err.args[0]}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

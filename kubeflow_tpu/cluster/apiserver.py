@@ -226,6 +226,22 @@ class _Handler(BaseHTTPRequestHandler):
                 f"application/merge-patch+json")
             return
         patch = self._read_body()
+        if route.subresource == "status":
+            # status-subresource semantics: only .status from the patch is
+            # applied (a real apiserver ignores spec fields sent here).
+            # Merge-patch never conflicts: re-merge on a racing writer, the
+            # same loop store.patch runs for the main resource.
+            from .errors import ConflictError
+            while True:
+                old = self.store.get(route.mapping.kind,
+                                     route.namespace or "", route.name)
+                old["status"] = k8s.json_merge_patch(
+                    old.get("status") or {}, patch.get("status") or {})
+                try:
+                    self._send_json(200, self.store.update_status(old))
+                    return
+                except ConflictError:
+                    continue
         self._send_json(200, self.store.patch(
             route.mapping.kind, route.namespace or "", route.name, patch))
 

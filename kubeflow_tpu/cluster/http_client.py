@@ -17,9 +17,12 @@ Auth, mirroring client-go's loading order:
 - plain constructor for tests / token-only setups.
 
 Watches are reconnecting daemon threads reading the newline-delimited JSON
-stream (``?watch=true``). After a drop the client re-lists and re-delivers
-every object as MODIFIED — safe because the controllers are level-based —
-so no event is permanently lost across apiserver restarts.
+stream (``?watch=true``). After a drop the client re-lists and diffs against
+the per-key resourceVersions it has delivered: changed/new objects re-deliver
+as MODIFIED/ADDED and objects that vanished during the outage synthesize
+DELETED — so informer caches can neither go stale nor keep ghosts across
+apiserver restarts, and a quiet cluster costs one cheap list per reconnect,
+not a full re-delivery.
 
 In-process admission registration is NOT available here: against a real
 apiserver, admission runs via webhook configurations served by the manager's
@@ -59,7 +62,14 @@ _ERROR_BY_REASON = {
 _ERROR_BY_CODE = {404: NotFoundError, 409: ConflictError, 422: InvalidError,
                   403: ForbiddenError}
 
-WATCH_READ_TIMEOUT_S = 30.0  # > server bookmark interval; bounds dead-stream detection
+# Watch streams ask the server to close gracefully after this long
+# (?timeoutSeconds=, honored by real apiservers); the socket read timeout
+# sits just above it so a dead stream is still detected. Our facade sends
+# 10s bookmarks, a real apiserver sends nothing on an idle watch — either
+# way a reconnect costs one list that delivers nothing when RVs are
+# unchanged, so the cadence is cheap.
+WATCH_SERVER_TIMEOUT_S = 290
+WATCH_READ_TIMEOUT_S = WATCH_SERVER_TIMEOUT_S + 10.0
 WATCH_RECONNECT_DELAY_S = 1.0
 
 
@@ -239,7 +249,9 @@ class HttpApiClient:
         """Blocks until the first stream is connected (up to 5 s) so that,
         as with ClusterStore.watch, no event after watch() returns can be
         missed — CachingClient's watch-then-list backfill depends on this
-        ordering to never go stale."""
+        ordering to never go stale. If the stream can't connect in time
+        (transient network failure), the eventual first connect runs a
+        resync diff so nothing stays missed."""
         connected = threading.Event()
         thread = threading.Thread(
             target=self._watch_loop,
@@ -247,36 +259,82 @@ class HttpApiClient:
             daemon=True, name=f"kubeflow-tpu-watch-{kind}")
         self._watch_threads.append(thread)
         thread.start()
-        connected.wait(timeout=5.0)
+        if not connected.wait(timeout=5.0):
+            log.warning("watch %s not connected after 5s; resync will run "
+                        "on first connect", kind)
+
+    @staticmethod
+    def _obj_key(obj: dict) -> tuple[str, str]:
+        return (k8s.namespace(obj), k8s.name(obj))
+
+    @staticmethod
+    def _obj_rv(obj: dict) -> str:
+        return str(k8s.get_in(obj, "metadata", "resourceVersion", default=""))
 
     def _watch_loop(self, kind: str, callback, namespace, label_selector,
                     connected: threading.Event):
-        first = True
+        # (namespace, name) → last resourceVersion delivered to the callback;
+        # the resync diff below keeps this exact across stream outages
+        seen: dict[tuple[str, str], str] = {}
         while not self._stopped.is_set():
             try:
-                if not first:
-                    # resync after a dropped stream: level-based re-delivery
-                    # of current state (controllers are idempotent)
-                    for obj in self.list(kind, namespace, label_selector):
-                        callback(WatchEvent("MODIFIED", obj))
-                first = False
                 self._watch_stream(kind, callback, namespace, label_selector,
-                                   connected)
-            except (urllib.error.URLError, OSError, ApiError) as err:
+                                   connected, seen)
+            except (urllib.error.URLError, OSError, ApiError,
+                    ValueError, KeyError) as err:
+                # ValueError/KeyError: a truncated NDJSON frame from an
+                # apiserver killed mid-write — must reconnect, not die
                 if self._stopped.is_set():
                     return
+                # a timed-out idle stream is the designed reconnect cadence,
+                # not an error worth resyncing eagerly over — but we cannot
+                # distinguish it from a drop, and the resync is cheap when
+                # nothing changed (RV diff delivers zero events)
                 log.debug("watch %s dropped (%s); reconnecting", kind, err)
             self._stopped.wait(WATCH_RECONNECT_DELAY_S)
 
+    def _resync(self, kind, callback, namespace, label_selector,
+                seen: dict) -> None:
+        """After a dropped stream: list and diff against what was delivered.
+        Changed objects → MODIFIED, unseen → ADDED, vanished → DELETED (a
+        deletion during the outage would otherwise never surface and leave
+        ghost objects in informer caches)."""
+        current: dict[tuple[str, str], dict] = {}
+        for obj in self.list(kind, namespace, label_selector):
+            current[self._obj_key(obj)] = obj
+        for key, obj in current.items():
+            rv = self._obj_rv(obj)
+            if key not in seen:
+                seen[key] = rv
+                callback(WatchEvent("ADDED", obj))
+            elif seen[key] != rv:
+                seen[key] = rv
+                callback(WatchEvent("MODIFIED", obj))
+        for key in [key for key in seen if key not in current]:
+            del seen[key]
+            ns, name = key
+            callback(WatchEvent("DELETED", {
+                "kind": kind,
+                "metadata": {"namespace": ns, "name": name}}))
+
     def _watch_stream(self, kind: str, callback, namespace, label_selector,
-                      connected: threading.Event):
-        query = {"watch": "true"}
+                      connected: threading.Event, seen: dict):
+        query = {"watch": "true",
+                 "timeoutSeconds": str(WATCH_SERVER_TIMEOUT_S)}
         if label_selector:
             query["labelSelector"] = ",".join(
                 f"{key}={val}" for key, val in label_selector.items())
         path = self._path(kind, namespace, query=query)
         with self._request("GET", path, timeout=WATCH_READ_TIMEOUT_S) as resp:
             connected.set()  # server has registered the watch relay
+            # resync AFTER the stream is live (no missable gap): on the
+            # first connect this is informer semantics — initial list →
+            # ADDED for existing objects, as controller-runtime delivers at
+            # boot — and after an outage it is the diff that surfaces
+            # missed changes and deletions. Events racing the resync may
+            # deliver twice (level-based consumers tolerate that); with
+            # unchanged RVs the diff delivers nothing.
+            self._resync(kind, callback, namespace, label_selector, seen)
             while not self._stopped.is_set():
                 line = resp.readline()
                 if not line:
@@ -284,7 +342,13 @@ class HttpApiClient:
                 frame = json.loads(line)
                 if frame.get("type") == "BOOKMARK":
                     continue
-                callback(WatchEvent(frame["type"], frame["object"]))
+                obj = frame["object"]
+                key = self._obj_key(obj)
+                if frame["type"] == "DELETED":
+                    seen.pop(key, None)
+                else:
+                    seen[key] = self._obj_rv(obj)
+                callback(WatchEvent(frame["type"], obj))
 
     def close(self) -> None:
         """Stop watch threads (they exit at the next read timeout/bookmark)."""

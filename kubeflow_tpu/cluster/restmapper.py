@@ -3,8 +3,9 @@
 The reference's client knows how to turn a typed object into an apiserver
 URL via the discovery-backed RESTMapper inside client-go; our API objects are
 plain dicts keyed by ``kind``, so the mapping lives in one static table
-covering every kind the controllers touch. Unknown kinds fall back to a
-pluralize-and-guess CRD-style mapping so user-defined CRs still route.
+covering every kind the controllers touch. An unknown kind raises — a
+fabricated group/version would just 404 confusingly on a real apiserver;
+extend the table (or pass a RestMapping) instead.
 
 Path shapes (the real wire format):
 
@@ -89,6 +90,8 @@ _MAPPINGS = [
     # OpenShift groups the extension controller touches
     RestMapping("APIServer", "config.openshift.io/v1", "apiservers",
                 namespaced=False),
+    RestMapping("Proxy", "config.openshift.io/v1", "proxies",
+                namespaced=False),
     RestMapping("OAuthClient", "oauth.openshift.io/v1", "oauthclients",
                 namespaced=False),
     RestMapping("ImageStream", "image.openshift.io/v1", "imagestreams"),
@@ -108,15 +111,20 @@ for _m in _MAPPINGS:
     _BY_ROUTE[(_g, _v, _m.plural)] = _m
 
 
-def _guess(kind: str) -> RestMapping:
-    """CRD-style fallback for kinds outside the static table."""
-    lower = kind.lower()
-    plural = lower + ("es" if lower.endswith(("s", "x", "z")) else "s")
-    return RestMapping(kind, f"{lower}.example.com/v1", plural)
+def register(mapping: RestMapping) -> None:
+    """Extend the table at runtime (user-defined CRDs)."""
+    _BY_KIND[mapping.kind] = mapping
+    group, version = mapping.group_version
+    _BY_ROUTE[(group, version, mapping.plural)] = mapping
 
 
 def mapping_for(kind: str) -> RestMapping:
-    return _BY_KIND.get(kind) or _guess(kind)
+    mapping = _BY_KIND.get(kind)
+    if mapping is None:
+        raise KeyError(
+            f"no REST mapping for kind {kind!r}; register one with "
+            f"restmapper.register(RestMapping(...)) or add it to the table")
+    return mapping
 
 
 def mapping_for_route(group: str, version: str, plural: str) -> RestMapping | None:

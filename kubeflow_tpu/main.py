@@ -124,6 +124,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     metavar="PORT",
                     help="standalone mode: expose the in-process store over "
                          "HTTP so other processes share this cluster state")
+    ap.add_argument("--apiserver-bind", default="127.0.0.1",
+                    help="bind address for --serve-apiserver; non-loopback "
+                         "requires --apiserver-token (the facade grants "
+                         "full cluster read/write, Secrets included)")
+    ap.add_argument("--apiserver-token", default=None,
+                    help="bearer token required by --serve-apiserver "
+                         "(env APISERVER_TOKEN also honored); TLS via "
+                         "--cert-dir")
     return ap
 
 
@@ -161,12 +169,24 @@ def main(argv=None) -> int:
         if client is not None:
             log.error("--serve-apiserver requires the in-process store")
             return 2
+        import os
+        token = args.apiserver_token or os.environ.get("APISERVER_TOKEN")
+        if args.apiserver_bind not in ("127.0.0.1", "localhost", "::1") \
+                and not token:
+            log.error("refusing to serve the apiserver facade on %s without "
+                      "--apiserver-token: it grants full cluster read/write "
+                      "(Secrets included) to any network peer",
+                      args.apiserver_bind)
+            return 2
         from .cluster.apiserver import ApiServerProxy
-        apiserver = ApiServerProxy(mgr.client.store,
-                                   port=args.serve_apiserver,
-                                   host="0.0.0.0")
+        apiserver = ApiServerProxy(
+            mgr.client.store, port=args.serve_apiserver,
+            host=args.apiserver_bind, token=token,
+            certfile=f"{args.cert_dir}/tls.crt" if args.cert_dir else None,
+            keyfile=f"{args.cert_dir}/tls.key" if args.cert_dir else None)
         apiserver.start()
-        log.info("apiserver facade listening on %s", apiserver.url)
+        log.info("apiserver facade listening on %s (auth=%s)",
+                 apiserver.url, "token" if token else "none/loopback")
 
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: shutdown.set())

@@ -1,0 +1,109 @@
+"""kubectl-analog CLI over the HTTP apiserver facade."""
+
+import json
+
+import pytest
+
+from kubeflow_tpu import cli
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.apiserver import ApiServerProxy
+from kubeflow_tpu.utils import k8s, names
+
+NB_YAML = """
+apiVersion: kubeflow.org/v1
+kind: Notebook
+metadata:
+  name: demo
+  namespace: proj
+  annotations:
+    tpu.kubeflow.org/accelerator: v5e-4
+spec:
+  template:
+    spec:
+      containers:
+      - name: demo
+        image: jupyter:latest
+---
+apiVersion: v1
+kind: ConfigMap
+metadata:
+  name: extra
+  namespace: proj
+data:
+  k: v
+"""
+
+
+@pytest.fixture()
+def server(store):
+    api.install_notebook_crd(store)
+    proxy = ApiServerProxy(store)
+    proxy.start()
+    yield proxy
+    proxy.stop()
+
+
+def run(server, *argv):
+    return cli.main(["--server", server.url, *argv])
+
+
+def test_apply_create_then_configure(server, store, tmp_path, capsys):
+    manifest = tmp_path / "nb.yaml"
+    manifest.write_text(NB_YAML)
+    assert run(server, "apply", "-f", str(manifest)) == 0
+    out = capsys.readouterr().out
+    assert "notebook/demo created" in out
+    assert "configmap/extra created" in out
+    assert store.get("Notebook", "proj", "demo")
+    # second apply is an update
+    assert run(server, "apply", "-f", str(manifest)) == 0
+    assert "notebook/demo configured" in capsys.readouterr().out
+
+
+def test_apply_reports_admission_errors(server, tmp_path, capsys):
+    manifest = tmp_path / "bad.yaml"
+    manifest.write_text("""
+apiVersion: kubeflow.org/v1
+kind: Notebook
+metadata: {name: bad, namespace: proj}
+spec: {template: {spec: {containers: []}}}
+""")
+    assert run(server, "apply", "-f", str(manifest)) == 1
+    assert "error applying" in capsys.readouterr().err
+
+
+def test_get_table_and_json(server, store, tmp_path, capsys):
+    manifest = tmp_path / "nb.yaml"
+    manifest.write_text(NB_YAML)
+    run(server, "apply", "-f", str(manifest))
+    capsys.readouterr()
+    assert run(server, "-n", "proj", "get", "notebooks") == 0
+    table = capsys.readouterr().out
+    assert "NAME" in table and "demo" in table
+    assert run(server, "get", "nb", "proj/demo", "-o", "json") == 0
+    obj = json.loads(capsys.readouterr().out)
+    assert k8s.name(obj) == "demo"
+
+
+def test_stop_resume_delete_roundtrip(server, store, tmp_path, capsys):
+    manifest = tmp_path / "nb.yaml"
+    manifest.write_text(NB_YAML)
+    run(server, "apply", "-f", str(manifest))
+    assert run(server, "stop", "notebook", "proj/demo") == 0
+    nb = store.get("Notebook", "proj", "demo")
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION)
+    assert run(server, "resume", "notebook", "proj/demo") == 0
+    nb = store.get("Notebook", "proj", "demo")
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is None
+    assert run(server, "delete", "notebook", "proj/demo") == 0
+    assert store.get_or_none("Notebook", "proj", "demo") is None
+
+
+def test_get_missing_resource_is_error(server, capsys):
+    assert run(server, "get", "notebook", "proj/ghost") == 1
+    assert "not found" in capsys.readouterr().err
+
+
+def test_unknown_resource_type_rejected(server):
+    with pytest.raises(SystemExit):
+        run(server, "get", "flurble")

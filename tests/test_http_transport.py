@@ -244,28 +244,64 @@ def test_watch_with_label_selector_filters(client):
 
 
 def test_watch_reconnects_after_server_restart(store, monkeypatch):
+    """Apiserver outage: objects changed during the gap re-deliver as
+    MODIFIED, deletions during the gap synthesize DELETED (without this,
+    informer caches keep ghost objects forever), and unchanged objects are
+    NOT re-delivered (RV diff keeps reconnects cheap)."""
     monkeypatch.setattr(http_client_mod, "WATCH_RECONNECT_DELAY_S", 0.05)
     proxy = ApiServerProxy(store)
     proxy.start()
     port = proxy.port
     client = HttpApiClient(proxy.url)
     try:
-        store.create(cm("pre-existing"))
+        store.create(cm("unchanged"))
+        store.create(cm("will-change"))
+        store.create(cm("will-vanish"))
         events = []
         client.watch("ConfigMap", lambda ev: events.append(
             (ev.type, k8s.name(ev.obj))))
-        time.sleep(0.3)
+        # first connect replays existing state as ADDED (informer semantics)
+        wait_for(lambda: ("ADDED", "will-vanish") in events, timeout=10,
+                 msg="initial replay")
         proxy.stop()
+        baseline = len(events)
+        # mutate during the outage
+        store.patch("ConfigMap", "default", "will-change",
+                    {"data": {"k": "v2"}})
+        store.delete("ConfigMap", "default", "will-vanish")
         # same store, same port — an apiserver restart
         proxy = ApiServerProxy(store, port=port)
         proxy.start()
-        # resync re-delivers current state as MODIFIED...
-        wait_for(lambda: ("MODIFIED", "pre-existing") in events, timeout=10,
-                 msg="resync after reconnect")
-        # ...and the new stream delivers fresh events
+        wait_for(lambda: ("MODIFIED", "will-change") in events[baseline:],
+                 timeout=10, msg="changed object resynced")
+        wait_for(lambda: ("DELETED", "will-vanish") in events[baseline:],
+                 timeout=10, msg="outage deletion synthesized")
+        assert not any(name == "unchanged" for _, name in events[baseline:])
+        # the new stream delivers fresh events
         store.create(cm("post-restart"))
         wait_for(lambda: ("ADDED", "post-restart") in events, timeout=10,
                  msg="event after reconnect")
     finally:
         client.close()
         proxy.stop()
+
+
+def test_status_subresource_patch_only_touches_status(client):
+    nb = {"kind": "Notebook",
+          "metadata": {"name": "nb-sp", "namespace": "default"},
+          "spec": {"template": {"spec": {"containers": [
+              {"name": "nb-sp", "image": "img"}]}}}}
+    client.create(nb)
+    path = ("/apis/kubeflow.org/v1/namespaces/default/notebooks/nb-sp/status")
+    client._json("PATCH", path,
+                 {"spec": {"mangled": True},
+                  "status": {"readyReplicas": 3}},
+                 content_type="application/merge-patch+json")
+    got = client.get("Notebook", "default", "nb-sp")
+    assert got["status"]["readyReplicas"] == 3
+    assert "mangled" not in got["spec"]
+
+
+def test_unknown_kind_raises_clear_mapping_error(client):
+    with pytest.raises(KeyError, match="no REST mapping"):
+        client.get("SomethingNobodyRegistered", "default", "x")
