@@ -248,6 +248,48 @@ def bench_train_step(info: dict) -> None:
                   "loss": round(float(loss), 4)})
 
 
+def bench_decode(info: dict) -> None:
+    """Autoregressive decode throughput on the flagship model: batched
+    generate (prefill + scanned decode loop), generated tokens/s."""
+    import jax
+
+    from kubeflow_tpu.models.decode import generate
+    from kubeflow_tpu.models.transformer import (TransformerConfig,
+                                                 init_params)
+
+    on_tpu = info["backend"] != "cpu"
+    if on_tpu:
+        from __graft_entry__ import _flagship_config
+        config = _flagship_config()
+        batch, prompt_len, new_tokens = 8, 128, 256
+    else:
+        config = TransformerConfig(vocab_size=2048, d_model=128, n_layers=2,
+                                   n_heads=4, n_kv_heads=4, d_ff=256,
+                                   max_seq_len=256, dtype="float32")
+        batch, prompt_len, new_tokens = 2, 16, 16
+
+    params = init_params(jax.random.key(0), config)
+    prompts = jax.random.randint(jax.random.key(1), (batch, prompt_len), 0,
+                                 config.vocab_size)
+    gen = jax.jit(lambda p, t: generate(p, t, config, new_tokens))
+    sync = _make_syncer()
+    sync(gen(params, prompts))  # compile + warm readback
+
+    def run_n(n):
+        out = None
+        for _ in range(n):
+            out = gen(params, prompts)
+        sync(out)
+    per_call = _timed_iters(run_n, counts=(2, 6))
+    tok_s = batch * new_tokens / per_call
+    _emit(info, metric="decode_tokens_per_sec", value=round(tok_s, 1),
+          unit="tokens/s", vs_baseline=None,
+          detail={"batch": batch, "prompt_len": prompt_len,
+                  "new_tokens": new_tokens,
+                  "ms_per_token_per_seq": round(per_call / new_tokens * 1e3,
+                                                3)})
+
+
 # ------------------------------------------------------- control-plane bench
 def _tpu_boot_verification():
     """What a JAX notebook container does at boot: enumerate devices, form
@@ -313,7 +355,8 @@ def measure_once() -> float:
 def main() -> None:
     info = probe_backend()
     for bench, metric in ((bench_attention, "flash_vs_xla_attention_speedup"),
-                          (bench_train_step, "train_step_tokens_per_sec")):
+                          (bench_train_step, "train_step_tokens_per_sec"),
+                          (bench_decode, "decode_tokens_per_sec")):
         try:
             bench(info)
         except Exception as e:  # a compute bench must never eat the headline

@@ -135,6 +135,39 @@ def sweep_blocks(quick: bool) -> dict:
     return best
 
 
+def long_context(quick: bool) -> dict:
+    """Long-sequence capability on one chip: the streaming kernel's whole
+    point is that KV never materializes as an s×s matrix, so sequences far
+    past xla_attention's memory wall must run. Validates numerics vs XLA at
+    8k (still XLA-feasible) and runs flash alone at 16k/32k with finiteness
+    + timing (readback-anchored)."""
+    from kubeflow_tpu.models.transformer import xla_attention
+    from kubeflow_tpu.ops.attention import flash_attention
+
+    out = {}
+    b, h, d = 1, 8, 128
+    q, k, v = _mk_inputs(jax.random.key(8192), b, 8192, h, d)
+    flash_8k = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    xla_8k = jax.jit(lambda q, k, v: xla_attention(q, k, v, causal=True))
+    err = _max_err(flash_8k(q, k, v), xla_8k(q, k, v))
+    out["8192"] = {"vs_xla_rel_err": round(err, 5), "ok": err < ATOL}
+    print(f"  long-context s=8192 vs XLA: {err:.2e}", file=sys.stderr)
+
+    for s in (16384,) if quick else (16384, 32768):
+        q, k, v = _mk_inputs(jax.random.key(s), b, s, h, d)
+        fn = jax.jit(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True).astype(jnp.float32)))
+        val = float(fn(q, k, v))  # compile + sync
+        t0 = time.perf_counter()
+        val = float(fn(q, k, v))
+        ms = (time.perf_counter() - t0) * 1e3
+        finite = val == val and abs(val) < 1e30
+        out[str(s)] = {"finite": finite, "fwd_ms_incl_roundtrip": round(ms, 1)}
+        print(f"  long-context s={s}: finite={finite} {ms:.0f}ms",
+              file=sys.stderr)
+    return out
+
+
 def main() -> int:
     quick = "--quick" in sys.argv
     t0 = time.time()
@@ -147,13 +180,16 @@ def main() -> int:
     print(f"backend={backend} devices={devices}", file=sys.stderr)
     numerics = check_numerics(quick)
     blocks = sweep_blocks(quick)
-    ok = all(r["ok"] for r in numerics)
+    long_ctx = long_context(quick)
+    ok = all(r["ok"] for r in numerics) and \
+        all(r.get("ok", r.get("finite")) for r in long_ctx.values())
     print(json.dumps({
         "backend": backend,
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "numerics_ok": ok,
         "numerics": numerics,
         "block_sweep": blocks,
+        "long_context": long_ctx,
         "wall_s": round(time.time() - t0, 1),
     }))
     return 0 if ok else 1

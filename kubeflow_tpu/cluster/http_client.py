@@ -250,8 +250,13 @@ class HttpApiClient:
         as with ClusterStore.watch, no event after watch() returns can be
         missed — CachingClient's watch-then-list backfill depends on this
         ordering to never go stale. If the stream can't connect in time
-        (transient network failure), the eventual first connect runs a
-        resync diff so nothing stays missed."""
+        (transient network failure), the eventual first connect resyncs
+        creations/updates from that gap as ADDED; one narrow hole remains —
+        an object both created-and-deleted (or listed by the consumer and
+        deleted) entirely within the pre-connect gap leaves no trace for the
+        diff, so a consumer that listed during the gap can hold it until its
+        next list. Level-based reconcilers tolerate this; it closes the
+        moment the object changes again."""
         connected = threading.Event()
         thread = threading.Thread(
             target=self._watch_loop,
@@ -273,17 +278,17 @@ class HttpApiClient:
 
     def _watch_loop(self, kind: str, callback, namespace, label_selector,
                     connected: threading.Event):
-        # (namespace, name) → last resourceVersion delivered to the callback;
-        # the resync diff below keeps this exact across stream outages
-        seen: dict[tuple[str, str], str] = {}
+        # (namespace, name) → last object DELIVERED to the callback (the
+        # informer's deleted-final-state store): the resync diff compares
+        # resourceVersions against it, and an outage-time deletion is
+        # synthesized as DELETED carrying this full final object, so
+        # owner-mapped and label-filtered watches still route it
+        seen: dict[tuple[str, str], dict] = {}
         while not self._stopped.is_set():
             try:
                 self._watch_stream(kind, callback, namespace, label_selector,
                                    connected, seen)
-            except (urllib.error.URLError, OSError, ApiError,
-                    ValueError, KeyError) as err:
-                # ValueError/KeyError: a truncated NDJSON frame from an
-                # apiserver killed mid-write — must reconnect, not die
+            except (urllib.error.URLError, OSError, ApiError) as err:
                 if self._stopped.is_set():
                     return
                 # a timed-out idle stream is the designed reconnect cadence,
@@ -293,29 +298,40 @@ class HttpApiClient:
                 log.debug("watch %s dropped (%s); reconnecting", kind, err)
             self._stopped.wait(WATCH_RECONNECT_DELAY_S)
 
+    def _deliver(self, callback, event: WatchEvent, seen: dict) -> None:
+        """Invoke the callback, then record delivery. A raising callback is
+        logged and NOT recorded, so the next resync re-delivers the event
+        instead of silently losing it."""
+        try:
+            callback(event)
+        except Exception:  # noqa: BLE001 — consumer bug must not kill the watch
+            log.exception("watch callback failed for %s %s",
+                          k8s.kind(event.obj), event.type)
+            return
+        key = self._obj_key(event.obj)
+        if event.type == "DELETED":
+            seen.pop(key, None)
+        else:
+            seen[key] = event.obj
+
     def _resync(self, kind, callback, namespace, label_selector,
                 seen: dict) -> None:
         """After a dropped stream: list and diff against what was delivered.
-        Changed objects → MODIFIED, unseen → ADDED, vanished → DELETED (a
-        deletion during the outage would otherwise never surface and leave
-        ghost objects in informer caches)."""
+        Changed objects → MODIFIED, unseen → ADDED, vanished → DELETED with
+        the last-delivered object as the final state (a deletion during the
+        outage would otherwise never surface and leave ghost objects in
+        informer caches)."""
         current: dict[tuple[str, str], dict] = {}
         for obj in self.list(kind, namespace, label_selector):
             current[self._obj_key(obj)] = obj
         for key, obj in current.items():
-            rv = self._obj_rv(obj)
             if key not in seen:
-                seen[key] = rv
-                callback(WatchEvent("ADDED", obj))
-            elif seen[key] != rv:
-                seen[key] = rv
-                callback(WatchEvent("MODIFIED", obj))
+                self._deliver(callback, WatchEvent("ADDED", obj), seen)
+            elif self._obj_rv(seen[key]) != self._obj_rv(obj):
+                self._deliver(callback, WatchEvent("MODIFIED", obj), seen)
         for key in [key for key in seen if key not in current]:
-            del seen[key]
-            ns, name = key
-            callback(WatchEvent("DELETED", {
-                "kind": kind,
-                "metadata": {"namespace": ns, "name": name}}))
+            final_state = seen[key]
+            self._deliver(callback, WatchEvent("DELETED", final_state), seen)
 
     def _watch_stream(self, kind: str, callback, namespace, label_selector,
                       connected: threading.Event, seen: dict):
@@ -339,16 +355,17 @@ class HttpApiClient:
                 line = resp.readline()
                 if not line:
                     return  # server closed the stream
-                frame = json.loads(line)
-                if frame.get("type") == "BOOKMARK":
+                try:
+                    frame = json.loads(line)
+                    event_type = frame["type"]
+                    obj = frame["object"]
+                except (ValueError, KeyError, TypeError):
+                    # truncated NDJSON frame (apiserver killed mid-write):
+                    # reconnect; the resync re-covers whatever it carried
+                    return
+                if event_type == "BOOKMARK":
                     continue
-                obj = frame["object"]
-                key = self._obj_key(obj)
-                if frame["type"] == "DELETED":
-                    seen.pop(key, None)
-                else:
-                    seen[key] = self._obj_rv(obj)
-                callback(WatchEvent(frame["type"], obj))
+                self._deliver(callback, WatchEvent(event_type, obj), seen)
 
     def close(self) -> None:
         """Stop watch threads (they exit at the next read timeout/bookmark)."""

@@ -30,6 +30,7 @@ class SliceEnv:
     hostnames: tuple[str, ...]
     accelerator: str = ""   # e.g. "v5e-16"
     topology: str = ""      # e.g. "4x4"
+    coordinator_port: int = DEFAULT_COORDINATOR_PORT
 
     @property
     def num_workers(self) -> int:
@@ -42,18 +43,25 @@ class SliceEnv:
     @property
     def coordinator_address(self) -> str:
         host = self.hostnames[0] if self.hostnames else "localhost"
-        return f"{host}:{DEFAULT_COORDINATOR_PORT}"
+        return f"{host}:{self.coordinator_port}"
 
     @classmethod
     def from_env(cls, environ=None) -> "SliceEnv":
         env = environ if environ is not None else os.environ
         raw_hosts = env.get("TPU_WORKER_HOSTNAMES", "localhost")
         hostnames = tuple(h.strip() for h in raw_hosts.split(",") if h.strip())
+        try:
+            port = int(env.get("KFTPU_COORDINATOR_PORT", "") or
+                       DEFAULT_COORDINATOR_PORT)
+        except ValueError:
+            log.warning("ignoring non-numeric KFTPU_COORDINATOR_PORT")
+            port = DEFAULT_COORDINATOR_PORT
         return cls(
             worker_id=int(env.get("TPU_WORKER_ID", "0") or 0),
             hostnames=hostnames,
             accelerator=env.get("TPU_ACCELERATOR_TYPE", ""),
             topology=env.get("TPU_TOPOLOGY", ""),
+            coordinator_port=port,
         )
 
 

@@ -270,3 +270,40 @@ def test_leader_election_failover_across_processes(config):
                 proc.wait()
         proxy.stop()
         sim_mgr.stop()
+
+
+@pytest.mark.slow
+def test_manager_recovers_from_apiserver_outage(config, monkeypatch):
+    """Controller-level outage recovery: work created while the apiserver is
+    down is reconciled after it returns (the watch resync delivers it as
+    ADDED), without restarting the manager."""
+    import kubeflow_tpu.cluster.http_client as hc
+    monkeypatch.setattr(hc, "WATCH_RECONNECT_DELAY_S", 0.05)
+    store = ClusterStore()
+    api.install_notebook_crd(store)
+    sim_mgr = Manager(store)
+    StatefulSetSimulator(store).setup(sim_mgr)
+    sim_mgr.start()
+    proxy = ApiServerProxy(store)
+    proxy.start()
+    port = proxy.port
+    client = HttpApiClient(proxy.url)
+    mgr, _ = build_manager(store=client, config=config)
+    mgr.start()
+    try:
+        store.create(notebook("nb-before"))
+        wait_for(lambda: store.get_or_none("Pod", "default", "nb-before-0"),
+                 msg="baseline reconcile over HTTP")
+        proxy.stop()  # apiserver outage
+        store.create(notebook("nb-during"))  # work arrives during the outage
+        time.sleep(1.0)
+        assert store.get_or_none("StatefulSet", "default", "nb-during") is None
+        proxy = ApiServerProxy(store, port=port)
+        proxy.start()  # apiserver returns on the same endpoint
+        wait_for(lambda: store.get_or_none("Pod", "default", "nb-during-0"),
+                 msg="outage-time notebook reconciled after recovery")
+    finally:
+        client.close()
+        mgr.stop()
+        proxy.stop()
+        sim_mgr.stop()

@@ -1,0 +1,240 @@
+"""Per-feature platform integration depth: runtime images, Feast,
+NetworkPolicies, pipelines RBAC.
+
+Mirrors the reference's feature spec files (notebook_runtime_test.go 571
+lines, notebook_feast_config_test.go 740, NetworkPolicy specs in
+notebook_controller_test.go:919-967, notebook_rbac.go tests) — each §2b
+component gets content asserts and failure-path coverage.
+"""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.controllers import netpol, rbac, runtime_images
+from kubeflow_tpu.controllers import setup_controllers
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+from tests.conftest import drain
+
+CENTRAL = "kubeflow-tpu-system"
+
+
+@pytest.fixture
+def world():
+    store = ClusterStore()
+    config = ControllerConfig(controller_namespace=CENTRAL,
+                              set_pipeline_rbac=True)
+    mgr = setup_controllers(store, config)
+    return store, mgr, config
+
+
+def create_nb(store, mgr, name="nb", ns="user-ns", **kw):
+    store.create(api.new_notebook(name, ns, **kw))
+    drain(mgr)
+    return store.get(api.KIND, ns, name)
+
+
+def runtime_stream(name, metadata, tag="1.0", labeled=True):
+    labels = {runtime_images.RUNTIME_IMAGE_LABEL: "true"} if labeled else {}
+    return {"kind": "ImageStream", "apiVersion": "image.openshift.io/v1",
+            "metadata": {"name": name, "namespace": CENTRAL,
+                         "labels": labels},
+            "spec": {"tags": [{
+                "name": tag,
+                "annotations": {
+                    "opendatahub.io/runtime-image-metadata": metadata},
+            }]}}
+
+
+# ------------------------------------------------------------ runtime images
+
+
+def test_runtime_images_collected_and_projected(world):
+    store, mgr, config = world
+    meta = json.dumps([{"display_name": "Datascience with Python 3.11",
+                        "metadata": {"image_name": "img@sha256:abc"}}])
+    store.create(runtime_stream("ds-runtime", meta))
+    create_nb(store, mgr)
+    cm = store.get("ConfigMap", "user-ns", runtime_images.CONFIGMAP_NAME)
+    key = "Datascience-with-Python-3.11.json"
+    assert key in cm["data"]
+    assert json.loads(cm["data"][key])["display_name"] == \
+        "Datascience with Python 3.11"
+
+
+def test_runtime_images_key_sanitization():
+    assert runtime_images.format_key_name("A b/c*d (v2)!") == "A-bcd-v2.json"
+    assert runtime_images.format_key_name("***") == "runtime.json"
+
+
+def test_runtime_images_malformed_metadata_skipped(world):
+    store, mgr, config = world
+    store.create(runtime_stream("bad-runtime", "{not json"))
+    good = json.dumps({"display_name": "Good"})
+    store.create(runtime_stream("good-runtime", good))
+    create_nb(store, mgr)
+    cm = store.get("ConfigMap", "user-ns", runtime_images.CONFIGMAP_NAME)
+    assert list(cm["data"]) == ["Good.json"]
+
+
+def test_runtime_images_unlabeled_streams_ignored(world):
+    store, mgr, config = world
+    store.create(runtime_stream("unlabeled",
+                                json.dumps({"display_name": "X"}),
+                                labeled=False))
+    create_nb(store, mgr)
+    assert store.get_or_none("ConfigMap", "user-ns",
+                             runtime_images.CONFIGMAP_NAME) is None
+
+
+def test_runtime_images_cm_deleted_when_streams_gone(world):
+    store, mgr, config = world
+    store.create(runtime_stream("ds", json.dumps({"display_name": "DS"})))
+    create_nb(store, mgr)
+    assert store.get("ConfigMap", "user-ns", runtime_images.CONFIGMAP_NAME)
+    store.delete("ImageStream", CENTRAL, "ds")
+    store.patch(api.KIND, "user-ns", "nb",
+                {"metadata": {"labels": {"touch": "1"}}})
+    drain(mgr)
+    assert store.get_or_none("ConfigMap", "user-ns",
+                             runtime_images.CONFIGMAP_NAME) is None
+
+
+def test_runtime_images_mounted_then_unmounted_on_stopped_notebook(world):
+    store, mgr, config = world
+    store.create(runtime_stream("ds", json.dumps({"display_name": "DS"})))
+    create_nb(store, mgr)
+    # keep the notebook stopped so webhook mutations always apply
+    store.patch(api.KIND, "user-ns", "nb", {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+    drain(mgr)
+    nb = store.get(api.KIND, "user-ns", "nb")
+    container = api.notebook_container(nb)
+    assert any(m["name"] == "runtime-images"
+               for m in container.get("volumeMounts", []))
+    store.delete("ImageStream", CENTRAL, "ds")
+    # first touch lets the reconciler delete the projected CM (admission on
+    # that same write still sees the old CM); the second admission unmounts
+    for i in ("1", "2"):
+        store.patch(api.KIND, "user-ns", "nb",
+                    {"metadata": {"labels": {"touch": i}}})
+        drain(mgr)
+    nb = store.get(api.KIND, "user-ns", "nb")
+    container = api.notebook_container(nb)
+    assert not any(m["name"] == "runtime-images"
+                   for m in container.get("volumeMounts", []))
+
+
+# ----------------------------------------------------------------- feast
+
+
+def test_feast_mount_content_and_label_cycle(world):
+    store, mgr, config = world
+    create_nb(store, mgr, labels={names.FEAST_LABEL: "true"})
+    nb = store.get(api.KIND, "user-ns", "nb")
+    vol = next(v for v in api.notebook_pod_spec(nb)["volumes"]
+               if v["name"] == "feast-config")
+    assert vol["configMap"] == {"name": "nb-feast-config", "optional": True}
+    mount = next(m for m in api.notebook_container(nb)["volumeMounts"]
+                 if m["name"] == "feast-config")
+    assert mount["mountPath"] == "/opt/app-root/src/feast-config"
+    assert mount["readOnly"] is True
+    # on a RUNNING notebook the unmount parks (restart gating); stop first,
+    # then the label change applies
+    store.patch(api.KIND, "user-ns", "nb", {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+    store.patch(api.KIND, "user-ns", "nb",
+                {"metadata": {"labels": {names.FEAST_LABEL: "false"}}})
+    nb = store.get(api.KIND, "user-ns", "nb")
+    assert not any(v["name"] == "feast-config"
+                   for v in api.notebook_pod_spec(nb).get("volumes", []))
+
+
+def test_feast_label_other_values_do_not_mount(world):
+    store, mgr, config = world
+    create_nb(store, mgr, labels={names.FEAST_LABEL: "enabled"})  # not "true"
+    nb = store.get(api.KIND, "user-ns", "nb")
+    assert not any(v["name"] == "feast-config"
+                   for v in api.notebook_pod_spec(nb).get("volumes", []))
+
+
+# ------------------------------------------------------------- networkpolicy
+
+
+def test_network_policy_contents(world):
+    store, mgr, config = world
+    create_nb(store, mgr, annotations={names.INJECT_AUTH_ANNOTATION: "true"})
+    np = store.get("NetworkPolicy", "user-ns", netpol.notebook_policy_name("nb"))
+    rule = np["spec"]["ingress"][0]
+    assert rule["ports"] == [{"protocol": "TCP", "port": 8888}]
+    assert rule["from"][0]["namespaceSelector"]["matchLabels"][
+        "kubernetes.io/metadata.name"] == CENTRAL
+    auth_np = store.get("NetworkPolicy", "user-ns",
+                        netpol.auth_policy_name("nb"))
+    auth_rule = auth_np["spec"]["ingress"][0]
+    assert auth_rule["ports"] == [{"protocol": "TCP", "port": 8443}]
+    assert "from" not in auth_rule  # 8443 open to everything: sidecar auths
+
+
+def test_auth_network_policy_removed_with_auth_mode(world):
+    store, mgr, config = world
+    create_nb(store, mgr, annotations={names.INJECT_AUTH_ANNOTATION: "true"})
+    store.patch(api.KIND, "user-ns", "nb", {"metadata": {"annotations": {
+        names.INJECT_AUTH_ANNOTATION: "false"}}})
+    drain(mgr)
+    assert store.get_or_none("NetworkPolicy", "user-ns",
+                             netpol.auth_policy_name("nb")) is None
+    assert store.get("NetworkPolicy", "user-ns",
+                     netpol.notebook_policy_name("nb"))
+
+
+def test_network_policy_drift_repaired(world):
+    store, mgr, config = world
+    create_nb(store, mgr)
+    np = store.get("NetworkPolicy", "user-ns",
+                   netpol.notebook_policy_name("nb"))
+    np["spec"]["ingress"] = []  # opened up by hand
+    store.update(np)
+    drain(mgr)
+    np = store.get("NetworkPolicy", "user-ns",
+                   netpol.notebook_policy_name("nb"))
+    assert np["spec"]["ingress"][0]["ports"] == [
+        {"protocol": "TCP", "port": 8888}]
+
+
+# ------------------------------------------------------------ pipelines rbac
+
+
+def test_pipeline_rbac_requires_role_precheck(world):
+    store, mgr, config = world
+    create_nb(store, mgr)
+    # no Role in the namespace → no binding (reference checkRoleExists)
+    assert store.get_or_none("RoleBinding", "user-ns",
+                             rbac.pipeline_rb_name("nb")) is None
+    store.create({"kind": "Role", "apiVersion":
+                  "rbac.authorization.k8s.io/v1",
+                  "metadata": {"name": rbac.PIPELINE_ROLE,
+                               "namespace": "user-ns"}})
+    store.patch(api.KIND, "user-ns", "nb",
+                {"metadata": {"labels": {"touch": "1"}}})
+    drain(mgr)
+    rb = store.get("RoleBinding", "user-ns", rbac.pipeline_rb_name("nb"))
+    assert rb["roleRef"]["name"] == rbac.PIPELINE_ROLE
+    assert rb["subjects"][0] == {"kind": "ServiceAccount", "name": "default",
+                                 "namespace": "user-ns"}
+
+
+def test_pipeline_rbac_env_gated(store):
+    config = ControllerConfig(controller_namespace=CENTRAL,
+                              set_pipeline_rbac=False)
+    mgr = setup_controllers(store, config)
+    store.create({"kind": "Role", "apiVersion":
+                  "rbac.authorization.k8s.io/v1",
+                  "metadata": {"name": rbac.PIPELINE_ROLE,
+                               "namespace": "user-ns"}})
+    create_nb(store, mgr)
+    assert store.get_or_none("RoleBinding", "user-ns",
+                             rbac.pipeline_rb_name("nb")) is None
