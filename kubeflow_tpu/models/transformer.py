@@ -217,9 +217,13 @@ def mlp_block(x, layer, config: TransformerConfig):
                           layer["w_down"].astype(dt))
 
 
-def forward(params: dict, tokens: jax.Array, config: TransformerConfig,
-            mesh=None, positions: jax.Array | None = None) -> jax.Array:
-    """tokens: (batch, seq) int32 → logits (batch, seq, vocab) float32.
+def forward_hidden(params: dict, tokens: jax.Array,
+                   config: TransformerConfig, mesh=None,
+                   positions: jax.Array | None = None) -> jax.Array:
+    """tokens: (batch, seq) int32 → final-norm hidden states (b, s, d).
+    The LM-head projection is NOT applied — the fused chunked cross-entropy
+    (models/train.py) consumes hidden states directly so the (b, s, vocab)
+    f32 logits tensor never materializes.
 
     When the mesh has sp>1 the caller passes sequence-sharded tokens plus the
     matching global ``positions`` (runtime handles this; ring attention makes
@@ -241,7 +245,13 @@ def forward(params: dict, tokens: jax.Array, config: TransformerConfig,
         body = jax.checkpoint(layer_body)
     x, _ = lax.scan(body, x, params["blocks"])
 
-    x = rms_norm(x, params["final_norm"])
+    return rms_norm(x, params["final_norm"])
+
+
+def forward(params: dict, tokens: jax.Array, config: TransformerConfig,
+            mesh=None, positions: jax.Array | None = None) -> jax.Array:
+    """tokens: (batch, seq) int32 → logits (batch, seq, vocab) float32."""
+    x = forward_hidden(params, tokens, config, mesh=mesh, positions=positions)
     return jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(x.dtype)
                       ).astype(jnp.float32)
 

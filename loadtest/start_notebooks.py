@@ -67,17 +67,27 @@ spec:
 
 
 def run_inprocess(count: int, namespace: str, accelerator: str,
-                  timeout: float) -> int:
+                  timeout: float, server: str | None = None) -> int:
+    """Default: drive the in-process control plane. With ``server``: the
+    same fan-out over REAL HTTP against a running apiserver (start one with
+    ``python -m kubeflow_tpu.main --serve-apiserver PORT --simulate-kubelet``)
+    — transport latency included in every number."""
     from kubeflow_tpu.api import types as api
-    from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
-    from kubeflow_tpu.cluster.store import ClusterStore
-    from kubeflow_tpu.controllers import setup_controllers
     from kubeflow_tpu.utils import names
 
-    store = ClusterStore()
-    mgr = setup_controllers(store)
-    StatefulSetSimulator(store, boot_delay_s=0.0).setup(mgr)
-    mgr.start()
+    mgr = None
+    if server:
+        from kubeflow_tpu.cluster.http_client import HttpApiClient
+        store = HttpApiClient(server)
+    else:
+        from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
+        from kubeflow_tpu.cluster.store import ClusterStore
+        from kubeflow_tpu.controllers import setup_controllers
+
+        store = ClusterStore()
+        mgr = setup_controllers(store)
+        StatefulSetSimulator(store, boot_delay_s=0.0).setup(mgr)
+        mgr.start()
     created: dict[str, float] = {}
     ready: dict[str, float] = {}
     t0 = time.monotonic()
@@ -99,7 +109,8 @@ def run_inprocess(count: int, namespace: str, accelerator: str,
                 ready[name] = time.monotonic() - created[name]
         time.sleep(0.01)
     total = time.monotonic() - t0
-    mgr.stop()
+    if mgr is not None:
+        mgr.stop()
     if len(ready) < count:
         print(f"FAIL: only {len(ready)}/{count} notebooks became SliceReady "
               f"within {timeout}s")
@@ -121,6 +132,9 @@ def main() -> int:
     ap.add_argument("--timeout", type=float, default=120.0)
     ap.add_argument("--emit-yaml", action="store_true",
                     help="print CRs for kubectl instead of running in-process")
+    ap.add_argument("--server", default=None,
+                    help="drive a running apiserver over HTTP instead of "
+                         "the in-process stack (URL)")
     args = ap.parse_args()
     if args.emit_yaml:
         try:
@@ -131,7 +145,7 @@ def main() -> int:
             pass  # downstream consumer (head, kubectl) closed the pipe
         return 0
     return run_inprocess(args.count, args.namespace, args.accelerator,
-                         args.timeout)
+                         args.timeout, server=args.server)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,103 @@
+"""Fused chunked cross-entropy: parity with the whole-logits path and the
+trace-time engagement rule.
+
+The fused path (models/train.py fused_loss_fn) projects and reduces one
+sequence chunk at a time so the (b, s, vocab) f32 logits tensor never
+materializes — validated on a real v5e to be the difference between
+compiling and OOMing at batch 4 x seq 8192 x vocab 32k. These CPU tests pin
+the numerics (loss AND grads identical to loss_fn) and the size-gated
+selection.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.train import (CE_FUSE_THRESHOLD_BYTES, TrainConfig,
+                                       _ce_chunks, fused_loss_fn, loss_fn,
+                                       make_sharded_train_step)
+from kubeflow_tpu.models.transformer import TransformerConfig, init_params
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+def tiny_config(**kw):
+    base = dict(vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+                n_kv_heads=2, d_ff=128, max_seq_len=128, dtype="float32")
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+def batch(cfg, b=2, s=96, pad_frac=0.1):
+    tokens = jax.random.randint(jax.random.key(1), (b, s), 0, cfg.vocab_size)
+    targets = jnp.where(
+        jax.random.uniform(jax.random.key(2), (b, s)) < pad_frac, -1,
+        jnp.roll(tokens, -1, axis=1))
+    return tokens, targets
+
+
+def test_fused_loss_matches_reference_incl_padding():
+    cfg = tiny_config()
+    params = init_params(jax.random.key(0), cfg)
+    tokens, targets = batch(cfg)
+    ref = float(loss_fn(params, tokens, targets, cfg))
+    for chunk in (32, 48, 96, 4096):  # several counts incl. one-chunk
+        fused = float(fused_loss_fn(params, tokens, targets, cfg,
+                                    chunk_tokens=chunk))
+        assert abs(ref - fused) < 1e-5, (chunk, ref, fused)
+
+
+def test_fused_grads_match_reference():
+    cfg = tiny_config()
+    params = init_params(jax.random.key(0), cfg)
+    tokens, targets = batch(cfg)
+    ref = jax.grad(lambda p: loss_fn(p, tokens, targets, cfg))(params)
+    fused = jax.grad(lambda p: fused_loss_fn(p, tokens, targets, cfg,
+                                             chunk_tokens=32))(params)
+    for a, b in zip(jax.tree.leaves(ref), jax.tree.leaves(fused)):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-6)
+
+
+def test_all_padding_batch_is_finite():
+    cfg = tiny_config()
+    params = init_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 32), 0, cfg.vocab_size)
+    targets = jnp.full((2, 32), -1)
+    assert float(fused_loss_fn(params, tokens, targets, cfg,
+                               chunk_tokens=16)) == 0.0
+
+
+def test_ce_chunk_count_divides_sequence():
+    assert _ce_chunks(1024, 512) == 2
+    assert _ce_chunks(96, 32) == 3
+    assert _ce_chunks(100, 32) == 4   # 100 = 4 * 25
+    assert _ce_chunks(7, 512) == 1
+    assert _ce_chunks(97, 32) == 97   # prime: chunk of 1 still static
+
+
+def test_sharded_step_trains_with_fused_ce_forced():
+    """Force the fused path (threshold 0 via huge ce_chunk + tiny batch won't
+    cross 1.5 GB, so drop the threshold by using a big synthetic vocab calc:
+    here simply call fused_loss_fn through a sharded step via monkey
+    threshold) — exercise train parity at the step level instead: a step
+    with fused loss produces the same loss value as one with the reference
+    loss on identical params/batch."""
+    cfg = tiny_config()
+    mesh = build_mesh(MeshConfig.auto(8, tp=2), devices=jax.devices()[:8])
+    tokens, targets = batch(cfg, b=4, s=64, pad_frac=0.0)
+    init_fn, step_ref = make_sharded_train_step(
+        mesh, cfg, tc=TrainConfig(ce_chunk_tokens=0))
+    params, opt = init_fn(jax.random.key(0))
+    _, _, loss_ref = step_ref(params, opt, tokens, targets)
+
+    import kubeflow_tpu.models.train as train_mod
+    orig = train_mod.CE_FUSE_THRESHOLD_BYTES
+    train_mod.CE_FUSE_THRESHOLD_BYTES = 0  # engage fused at any size
+    try:
+        init_fn2, step_fused = make_sharded_train_step(
+            mesh, cfg, tc=TrainConfig(ce_chunk_tokens=32))
+        params2, opt2 = init_fn2(jax.random.key(0))
+        _, _, loss_fused = step_fused(params2, opt2, tokens, targets)
+    finally:
+        train_mod.CE_FUSE_THRESHOLD_BYTES = orig
+    assert abs(float(loss_ref) - float(loss_fused)) < 1e-5
