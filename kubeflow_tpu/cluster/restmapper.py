@@ -84,6 +84,13 @@ _MAPPINGS = [
     # apiextensions
     RestMapping("CustomResourceDefinition", "apiextensions.k8s.io/v1",
                 "customresourcedefinitions", namespaced=False),
+    # admissionregistration
+    RestMapping("MutatingWebhookConfiguration",
+                "admissionregistration.k8s.io/v1",
+                "mutatingwebhookconfigurations", namespaced=False),
+    RestMapping("ValidatingWebhookConfiguration",
+                "admissionregistration.k8s.io/v1",
+                "validatingwebhookconfigurations", namespaced=False),
     # scheduling
     RestMapping("PriorityClass", "scheduling.k8s.io/v1", "priorityclasses",
                 namespaced=False),

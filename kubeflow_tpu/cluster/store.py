@@ -36,6 +36,7 @@ from .errors import (AlreadyExistsError, ConflictError, InvalidError,
 CLUSTER_SCOPED_KINDS = {
     "Namespace", "ClusterRole", "ClusterRoleBinding", "OAuthClient",
     "CustomResourceDefinition", "PriorityClass", "Node", "APIServer",
+    "MutatingWebhookConfiguration", "ValidatingWebhookConfiguration",
 }
 
 
@@ -79,6 +80,9 @@ class ClusterStore:
         # step with CustomResourceDefinition objects so CRs are validated
         # server-side, as kube-apiserver does for installed CRDs
         self._crd_schemas: dict[str, dict[str, dict]] = {}
+        # Mutating/ValidatingWebhookConfiguration objects, indexed so writes
+        # call out over real HTTPS AdmissionReview (cluster/remote_admission)
+        self._webhook_configs: dict[str, dict[ObjectKey, dict]] = {}
 
     # ------------------------------------------------------------------ keys
     def _key(self, kind: str, namespace: str, name: str) -> ObjectKey:
@@ -101,11 +105,36 @@ class ClusterStore:
         for kind, fn in self._admission:
             if kind == k8s.kind(obj):
                 obj = fn(operation, obj, old)
+        obj = self._run_remote_admission(operation, obj, old)
         # schema validation runs AFTER webhooks, on what will be persisted —
         # the apiserver's phase order (mutating admission → schema →
         # persistence)
         self._validate_against_crd(obj)
         return obj
+
+    def _run_remote_admission(self, operation: str, obj: dict,
+                              old: dict | None) -> dict:
+        """HTTPS AdmissionReview against registered webhook configurations
+        (mutating phase, then validating — the apiserver's order)."""
+        from . import remote_admission as ra
+        if k8s.kind(obj) in ra.CONFIG_KINDS:
+            return obj  # configurations themselves are not gated
+        mutating = list(self._webhook_configs.get(ra.MUTATING_KIND,
+                                                  {}).values())
+        validating = list(self._webhook_configs.get(ra.VALIDATING_KIND,
+                                                    {}).values())
+        if mutating:
+            obj = ra.run_webhooks(mutating, operation, obj, old,
+                                  mutating=True)
+        if validating:
+            ra.run_webhooks(validating, operation, obj, old, mutating=False)
+        return obj
+
+    def _index_webhook_config(self, key: ObjectKey, obj: dict) -> None:
+        self._webhook_configs.setdefault(key.kind, {})[key] = k8s.deepcopy(obj)
+
+    def _unindex_webhook_config(self, key: ObjectKey) -> None:
+        self._webhook_configs.get(key.kind, {}).pop(key, None)
 
     # -------------------------------------------------------- CRD schemas
     def _index_crd(self, crd: dict) -> None:
@@ -145,8 +174,13 @@ class ClusterStore:
     # ----------------------------------------------------------------- verbs
     def create(self, obj: dict) -> dict:
         obj = k8s.deepcopy(obj)
+        # admission runs OUTSIDE the store lock (kube-apiserver holds no
+        # global lock around webhook calls): remote webhooks are HTTPS
+        # round-trips whose handlers read back into this store from their
+        # own threads — under the lock that is a deadlock. Races admitted
+        # here are caught at persist (AlreadyExists / Conflict).
+        obj = self._admit("CREATE", obj, None)
         with self._lock:
-            obj = self._admit("CREATE", obj, None)
             md = k8s.meta(obj)
             if not md.get("name") and md.get("generateName"):
                 md["name"] = md["generateName"] + generate_suffix(
@@ -163,6 +197,9 @@ class ClusterStore:
             self._objects[key] = obj
             if key.kind == "CustomResourceDefinition":
                 self._index_crd(obj)
+            elif key.kind in ("MutatingWebhookConfiguration",
+                              "ValidatingWebhookConfiguration"):
+                self._index_webhook_config(key, obj)
             stored = k8s.deepcopy(obj)
         self._notify(WatchEvent("ADDED", stored))
         return k8s.deepcopy(stored)
@@ -192,16 +229,33 @@ class ClusterStore:
     def update(self, obj: dict) -> dict:
         obj = k8s.deepcopy(obj)
         deferred_events: list[WatchEvent] = []
+        key = self._key_of(obj)
+        # snapshot + early conflict check, then admit OUTSIDE the lock (see
+        # create()); the post-admission check below re-validates that the
+        # state admitted against is still the state being replaced
         with self._lock:
-            key = self._key_of(obj)
+            old_snapshot = self._objects.get(key)
+            if old_snapshot is None:
+                raise NotFoundError(f"{key.kind} {key.namespace}/{key.name}")
+            old_snapshot = k8s.deepcopy(old_snapshot)
+        snapshot_rv = old_snapshot["metadata"]["resourceVersion"]
+        new_rv = k8s.get_in(obj, "metadata", "resourceVersion")
+        if new_rv is not None and new_rv != snapshot_rv:
+            raise ConflictError(
+                f"{key.kind} {key.namespace}/{key.name}: stale resourceVersion")
+        obj = self._admit("UPDATE", obj, old_snapshot)
+        with self._lock:
             old = self._objects.get(key)
             if old is None:
                 raise NotFoundError(f"{key.kind} {key.namespace}/{key.name}")
-            new_rv = k8s.get_in(obj, "metadata", "resourceVersion")
-            if new_rv is not None and new_rv != old["metadata"]["resourceVersion"]:
+            # re-check ONLY for optimistic writers: a no-RV update keeps the
+            # apiserver's unconditional last-write-wins semantics even when a
+            # concurrent write landed during the out-of-lock admission window
+            if new_rv is not None and \
+                    old["metadata"]["resourceVersion"] != snapshot_rv:
                 raise ConflictError(
-                    f"{key.kind} {key.namespace}/{key.name}: stale resourceVersion")
-            obj = self._admit("UPDATE", obj, k8s.deepcopy(old))
+                    f"{key.kind} {key.namespace}/{key.name}: object changed "
+                    f"during admission")
             md = k8s.meta(obj)
             md["uid"] = old["metadata"]["uid"]
             md["creationTimestamp"] = old["metadata"]["creationTimestamp"]
@@ -220,6 +274,9 @@ class ClusterStore:
                 self._objects[key] = obj
                 if key.kind == "CustomResourceDefinition":
                     self._index_crd(obj)
+                elif key.kind in ("MutatingWebhookConfiguration",
+                                  "ValidatingWebhookConfiguration"):
+                    self._index_webhook_config(key, obj)
                 deferred_events = [WatchEvent("MODIFIED", k8s.deepcopy(obj))]
             stored = k8s.deepcopy(obj)
         for ev in deferred_events:
@@ -266,6 +323,14 @@ class ClusterStore:
         """Two-phase delete: finalizers present → set deletionTimestamp and
         wait for controllers to strip them; else remove + cascade to owned
         objects (background GC)."""
+        with self._lock:
+            snapshot = self._objects.get(self._key(kind, namespace, name))
+            if snapshot is None:
+                raise NotFoundError(f"{kind} {namespace}/{name}")
+            snapshot = k8s.deepcopy(snapshot)
+        # DELETE-gating webhooks (operations: ["DELETE"]) fire like the real
+        # apiserver's; outside the lock (see create())
+        self._run_remote_admission("DELETE", snapshot, snapshot)
         events: list[WatchEvent] = []
         with self._lock:
             key = self._key(kind, namespace, name)
@@ -295,6 +360,9 @@ class ClusterStore:
             return events
         if key.kind == "CustomResourceDefinition":
             self._unindex_crd(obj)
+        elif key.kind in ("MutatingWebhookConfiguration",
+                          "ValidatingWebhookConfiguration"):
+            self._unindex_webhook_config(key)
         events.append(WatchEvent("DELETED", k8s.deepcopy(obj)))
         owner_uid = k8s.uid(obj)
         if owner_uid:

@@ -1,0 +1,180 @@
+"""Webhook-configuration-driven admission: apiserver → HTTPS AdmissionReview.
+
+The real cluster shape: the manager serves the webhooks over HTTPS
+(AdmissionServer, webhook/server.py), and the apiserver — here the
+ClusterStore, as kube-apiserver does via Mutating/ValidatingWebhook-
+Configuration — POSTs AdmissionReview and applies the returned JSONPatch.
+Round 1 exercised the handlers only as in-process plugins; this closes the
+loop over the genuine wire protocol, TLS and failurePolicy included.
+"""
+
+import base64
+import subprocess
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster import remote_admission
+from kubeflow_tpu.cluster.errors import ApiError, InvalidError
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webhook import (NotebookMutatingWebhook,
+                                  NotebookValidatingWebhook)
+from kubeflow_tpu.webhook.server import (MUTATE_PATH, VALIDATE_PATH,
+                                         AdmissionServer)
+
+
+@pytest.fixture()
+def tls(tmp_path):
+    cert = tmp_path / "tls.crt"
+    key = tmp_path / "tls.key"
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", str(key), "-out", str(cert), "-days", "1",
+         "-subj", "/CN=127.0.0.1",
+         "-addext", "subjectAltName=IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return cert, key
+
+
+@pytest.fixture()
+def world(tls):
+    """Store WITHOUT in-process webhook plugins + the manager's real
+    AdmissionServer over TLS + webhook configurations pointing at it."""
+    cert, key = tls
+    store = ClusterStore()          # note: no install() of local plugins
+    config = ControllerConfig(tpu_default_image="jax-notebook:v1")
+    server = AdmissionServer(NotebookMutatingWebhook(store, config),
+                             NotebookValidatingWebhook(config),
+                             host="127.0.0.1", port=0,
+                             certfile=str(cert), keyfile=str(key))
+    server.start()
+    ca_bundle = base64.b64encode(cert.read_bytes()).decode()
+
+    def webhook_config(kind, name, path):
+        return {
+            "kind": kind,
+            "apiVersion": "admissionregistration.k8s.io/v1",
+            "metadata": {"name": name},
+            "webhooks": [{
+                "name": "notebooks.kubeflow.org",
+                "failurePolicy": "Fail",
+                "clientConfig": {
+                    "url": f"https://127.0.0.1:{server.port}{path}",
+                    "caBundle": ca_bundle,
+                },
+                "rules": [{
+                    "apiGroups": ["kubeflow.org"],
+                    "apiVersions": ["v1"],
+                    "operations": ["CREATE", "UPDATE"],
+                    "resources": ["notebooks"],
+                }],
+            }],
+        }
+
+    store.create(webhook_config("MutatingWebhookConfiguration",
+                                "nb-mutating", MUTATE_PATH))
+    store.create(webhook_config("ValidatingWebhookConfiguration",
+                                "nb-validating", VALIDATE_PATH))
+    yield store, server
+    server.stop()
+
+
+def test_mutations_arrive_via_https_admission_review(world):
+    store, _ = world
+    created = store.create(api.new_notebook(
+        "nb", "ns", image="quay.io/jupyter-cuda:2024",
+        annotations={names.TPU_ACCELERATOR_ANNOTATION: "v5e-4"}))
+    # reconciliation lock injected AND image swapped — both via JSONPatch
+    # applied from the HTTPS response
+    assert k8s.get_annotation(created, names.STOP_ANNOTATION) == \
+        names.RECONCILIATION_LOCK_VALUE
+    assert api.notebook_container(created)["image"] == "jax-notebook:v1"
+
+
+def test_denial_arrives_via_https_admission_review(world):
+    store, _ = world
+    with pytest.raises(ApiError, match="invalid TPU request"):
+        store.create(api.new_notebook(
+            "bad", "ns",
+            annotations={names.TPU_TOPOLOGY_ANNOTATION: "4x4"}))  # no accel
+
+
+def test_failure_policy_fail_blocks_when_webhook_down(world):
+    store, server = world
+    server.stop()
+    with pytest.raises(ApiError, match="calling webhook"):
+        store.create(api.new_notebook("nb2", "ns"))
+
+
+def test_failure_policy_ignore_admits_when_webhook_down(world):
+    store, server = world
+    server.stop()
+    for kind in ("MutatingWebhookConfiguration",
+                 "ValidatingWebhookConfiguration"):
+        cfg = store.get(kind, "", "nb-mutating" if "Mut" in kind
+                        else "nb-validating")
+        cfg["webhooks"][0]["failurePolicy"] = "Ignore"
+        store.update(cfg)
+    created = store.create(api.new_notebook("nb3", "ns"))
+    # fail-open: admitted WITHOUT the webhook's mutations
+    assert k8s.get_annotation(created, names.STOP_ANNOTATION) is None
+
+
+def test_non_matching_kinds_skip_webhooks(world):
+    store, server = world
+    server.stop()  # would hard-fail if called
+    assert store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                         "metadata": {"name": "cm", "namespace": "ns"}})
+
+
+def test_deleting_configuration_disables_remote_admission(world):
+    store, _ = world
+    store.delete("MutatingWebhookConfiguration", "", "nb-mutating")
+    store.delete("ValidatingWebhookConfiguration", "", "nb-validating")
+    created = store.create(api.new_notebook("nb4", "ns"))
+    assert k8s.get_annotation(created, names.STOP_ANNOTATION) is None
+
+
+def test_json_patch_roundtrip_unit():
+    original = {"a": {"b": [1, 2]}, "keep": "x", "drop": True}
+    mutated = {"a": {"b": [1, 2, 3], "c": "new"}, "keep": "x"}
+    from kubeflow_tpu.webhook.server import json_patch
+    ops = json_patch(original, mutated)
+    assert remote_admission.apply_json_patch(original, ops) == mutated
+
+
+def test_json_patch_escaped_keys():
+    original = {"metadata": {"annotations": {}}}
+    mutated = {"metadata": {"annotations": {
+        "tpu.kubeflow.org/accelerator": "v5e-4", "a~b": "1"}}}
+    from kubeflow_tpu.webhook.server import json_patch
+    ops = json_patch(original, mutated)
+    assert remote_admission.apply_json_patch(original, ops) == mutated
+
+
+def test_delete_gating_webhook_fires(world, tls):
+    """operations: ["DELETE"] webhooks gate deletion like kube-apiserver."""
+    store, server = world
+    store.create(api.new_notebook("protected", "ns"))
+    cfg = store.get("ValidatingWebhookConfiguration", "", "nb-validating")
+    cfg["webhooks"][0]["rules"][0]["operations"] = ["DELETE"]
+    # point at a dead endpoint with failurePolicy Fail → deletion blocked
+    cfg["webhooks"][0]["clientConfig"]["url"] = "https://127.0.0.1:1/validate"
+    store.update(cfg)
+    with pytest.raises(ApiError, match="calling webhook"):
+        store.delete("Notebook", "ns", "protected")
+    assert store.get("Notebook", "ns", "protected")
+
+
+def test_no_rv_update_keeps_last_write_wins(world):
+    """A writer that omits resourceVersion opts out of optimistic
+    concurrency — admission races must not surface as conflicts."""
+    store, _ = world
+    store.create(api.new_notebook("nb-lww", "ns"))
+    replacement = api.new_notebook("nb-lww", "ns", image="img:other")
+    replacement["metadata"].pop("resourceVersion", None)
+    out = store.update(replacement)  # no conflict, unconditional replace
+    assert api.notebook_container(out)["image"] in ("img:other",
+                                                    "jax-notebook:v1")
