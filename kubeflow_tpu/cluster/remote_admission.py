@@ -53,16 +53,29 @@ def _unescape(token: str) -> str:
 
 
 def apply_json_patch(obj: dict, ops: list[dict]) -> dict:
-    """RFC 6902 add/remove/replace (the ops AdmissionServer emits)."""
+    """RFC 6902 add/remove/replace (the ops AdmissionServer emits). A
+    ``remove`` whose intermediate path is absent is a no-op instead of
+    grafting empty maps into the object (can happen when webhook patches
+    race each other)."""
     result = copy.deepcopy(obj)
     for op in ops:
         tokens = [_unescape(t) for t in op["path"].split("/")[1:]]
-        parent = result
-        for token in tokens[:-1]:
-            parent = parent[int(token)] if isinstance(parent, list) \
-                else parent.setdefault(token, {})
-        leaf = tokens[-1] if tokens else ""
         verb = op["op"]
+        parent = result
+        missing = False
+        for token in tokens[:-1]:
+            if isinstance(parent, list):
+                parent = parent[int(token)]
+            elif token in parent:
+                parent = parent[token]
+            elif verb == "remove":
+                missing = True
+                break
+            else:
+                parent = parent.setdefault(token, {})
+        if missing:
+            continue
+        leaf = tokens[-1] if tokens else ""
         if isinstance(parent, list):
             index = len(parent) if leaf == "-" else int(leaf)
             if verb == "add":
@@ -79,7 +92,8 @@ def apply_json_patch(obj: dict, ops: list[dict]) -> dict:
     return result
 
 
-def _rule_matches(rule: dict, kind: str, operation: str) -> bool:
+def _rule_matches(rule: dict, kind: str, operation: str,
+                  api_version: str = "") -> bool:
     try:
         mapping = restmapper.mapping_for(kind)
     except KeyError:
@@ -90,6 +104,10 @@ def _rule_matches(rule: dict, kind: str, operation: str) -> bool:
         return False
     resources = rule.get("resources", ["*"])
     if "*" not in resources and mapping.plural not in resources:
+        return False
+    versions = rule.get("apiVersions", ["*"])
+    version = api_version.rpartition("/")[2] if api_version else ""
+    if "*" not in versions and version and version not in versions:
         return False
     operations = rule.get("operations", ["*"])
     return "*" in operations or operation in operations
@@ -127,9 +145,10 @@ def run_webhooks(configs: list[dict], operation: str, obj: dict,
     """Run every matching webhook of the given phase; returns the (possibly
     mutated) object, raises ApiError on denial/hard failure."""
     kind = k8s.kind(obj)
+    api_version = obj.get("apiVersion", "")
     for config in configs:
         for webhook in config.get("webhooks", []) or []:
-            if not any(_rule_matches(rule, kind, operation)
+            if not any(_rule_matches(rule, kind, operation, api_version)
                        for rule in webhook.get("rules", []) or []):
                 continue
             client_config = webhook.get("clientConfig", {}) or {}

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Iterator
 
@@ -115,14 +116,19 @@ class ClusterStore:
     def _run_remote_admission(self, operation: str, obj: dict,
                               old: dict | None) -> dict:
         """HTTPS AdmissionReview against registered webhook configurations
-        (mutating phase, then validating — the apiserver's order)."""
+        (mutating phase, then validating — the apiserver's order). The
+        config index is snapshotted under the lock; the HTTP calls run
+        outside it (see create())."""
         from . import remote_admission as ra
         if k8s.kind(obj) in ra.CONFIG_KINDS:
             return obj  # configurations themselves are not gated
-        mutating = list(self._webhook_configs.get(ra.MUTATING_KIND,
-                                                  {}).values())
-        validating = list(self._webhook_configs.get(ra.VALIDATING_KIND,
-                                                    {}).values())
+        with self._lock:
+            mutating = [k8s.deepcopy(c) for c in
+                        self._webhook_configs.get(ra.MUTATING_KIND,
+                                                  {}).values()]
+            validating = [k8s.deepcopy(c) for c in
+                          self._webhook_configs.get(ra.VALIDATING_KIND,
+                                                    {}).values()]
         if mutating:
             obj = ra.run_webhooks(mutating, operation, obj, old,
                                   mutating=True)
@@ -154,7 +160,8 @@ class ClusterStore:
         self._crd_schemas.pop(kind, None)
 
     def _validate_against_crd(self, obj: dict) -> None:
-        versions = self._crd_schemas.get(k8s.kind(obj))
+        with self._lock:  # schema index is written under the lock
+            versions = self._crd_schemas.get(k8s.kind(obj))
         if not versions:
             return
         version = (obj.get("apiVersion") or "").rpartition("/")[2]
@@ -283,12 +290,18 @@ class ClusterStore:
             self._notify(ev)
         return k8s.deepcopy(stored)
 
+    # bounds the patch re-merge loop: each retry re-runs admission (possibly
+    # remote HTTPS round-trips), so a hot object must back off and eventually
+    # surface the conflict rather than livelock
+    PATCH_MAX_RETRIES = 20
+
     def patch(self, kind: str, namespace: str, name: str, patch: dict) -> dict:
         """RFC 7386 JSON merge patch (client.MergeFrom semantics). Unlike
-        update(), never conflicts — it re-merges against the current version
-        on a concurrent write, as the reference relies on for annotation
-        removal (odh notebook_controller.go:516-523)."""
-        while True:
+        update(), it re-merges against the current version on a concurrent
+        write, as the reference relies on for annotation removal
+        (odh notebook_controller.go:516-523) — with bounded backoff now that
+        each attempt may spend webhook round-trips outside the lock."""
+        for attempt in range(self.PATCH_MAX_RETRIES):
             with self._lock:
                 key = self._key(kind, namespace, name)
                 old = self._objects.get(key)
@@ -299,7 +312,11 @@ class ClusterStore:
             try:
                 return self.update(merged)
             except ConflictError:
-                continue  # raced a concurrent writer; re-merge on new version
+                # raced a concurrent writer; re-merge on the new version
+                time.sleep(min(0.001 * (2 ** attempt), 0.1))
+        raise ConflictError(f"{kind} {namespace}/{name}: patch kept "
+                            f"conflicting after {self.PATCH_MAX_RETRIES} "
+                            f"attempts")
 
     def update_status(self, obj: dict) -> dict:
         """Status subresource semantics: only .status is applied."""

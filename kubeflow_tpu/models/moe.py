@@ -207,10 +207,10 @@ def moe_mlp_block(x: jax.Array, layer: dict, config: MoEConfig,
     return x + out.reshape(B, S, D), aux
 
 
-def moe_forward(params: dict, tokens: jax.Array, config: MoEConfig,
-                mesh: Mesh | None = None,
-                positions: jax.Array | None = None):
-    """tokens (batch, seq) → (logits (b, s, vocab) f32, aux_loss scalar).
+def moe_forward_hidden(params: dict, tokens: jax.Array, config: MoEConfig,
+                       mesh: Mesh | None = None,
+                       positions: jax.Array | None = None):
+    """tokens (batch, seq) → (final-norm hidden (b, s, d), aux_loss scalar).
     Attention is shared with the dense model (ring/flash/xla dispatch)."""
     c = config
     x = params["embed"].astype(c.compute_dtype)[tokens]
@@ -228,15 +228,32 @@ def moe_forward(params: dict, tokens: jax.Array, config: MoEConfig,
     body = jax.checkpoint(layer_body) if c.remat else layer_body
     (x, aux), _ = lax.scan(body, (x, jnp.float32(0.0)), params["blocks"])
 
-    x = rms_norm(x, params["final_norm"])
+    return rms_norm(x, params["final_norm"]), aux / c.n_layers
+
+
+def moe_forward(params: dict, tokens: jax.Array, config: MoEConfig,
+                mesh: Mesh | None = None,
+                positions: jax.Array | None = None):
+    """tokens (batch, seq) → (logits (b, s, vocab) f32, aux_loss scalar)."""
+    x, aux = moe_forward_hidden(params, tokens, config, mesh=mesh,
+                                positions=positions)
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(x.dtype)
                         ).astype(jnp.float32)
-    return logits, aux / c.n_layers
+    return logits, aux
 
 
 # ----------------------------------------------------------------- training
-def moe_loss_fn(params, tokens, targets, config: MoEConfig, mesh=None):
-    """Next-token CE + router load-balance aux."""
+def moe_loss_fn(params, tokens, targets, config: MoEConfig, mesh=None,
+                ce_chunk_tokens: int = 0):
+    """Next-token CE + router load-balance aux. ``ce_chunk_tokens`` > 0
+    switches to the fused chunked CE (train.chunked_softmax_ce) so long
+    contexts never materialize the full logits tensor."""
+    if ce_chunk_tokens:
+        from .train import chunked_softmax_ce
+        x, aux = moe_forward_hidden(params, tokens, config, mesh=mesh)
+        ce = chunked_softmax_ce(x, params["lm_head"], targets,
+                                ce_chunk_tokens)
+        return ce + config.router_aux_coef * aux
     logits, aux = moe_forward(params, tokens, config, mesh=mesh)
     valid = targets >= 0
     safe_targets = jnp.where(valid, targets, 0)
@@ -276,18 +293,22 @@ def make_sharded_moe_train_step(mesh: Mesh, config: MoEConfig,
         params = init_moe_params(key, config)
         return params, optimizer.init(params)
 
+    def step_loss(p, t, tg):
+        from .train import ce_chunk_for  # one shared engagement policy
+        chunk = ce_chunk_for(tc, t, config.vocab_size)
+        return moe_loss_fn(p, t, tg, config, mesh, ce_chunk_tokens=chunk)
+
     @partial(jax.jit,
              in_shardings=(p_shardings, opt_shardings, batch_sh, batch_sh),
              out_shardings=(p_shardings, opt_shardings, replicated),
              donate_argnums=(0, 1))
     def step_fn(params, opt_state, tokens, targets):
         if accum_steps == 1:
-            loss, grads = jax.value_and_grad(moe_loss_fn)(
-                params, tokens, targets, config, mesh)
+            loss, grads = jax.value_and_grad(step_loss)(params, tokens,
+                                                        targets)
         else:
-            loss, grads = accumulated_value_and_grad(
-                lambda p, t, tg: moe_loss_fn(p, t, tg, config, mesh),
-                params, tokens, targets)
+            loss, grads = accumulated_value_and_grad(step_loss, params,
+                                                     tokens, targets)
         params, opt_state = apply_update(optimizer, params, opt_state, grads)
         return params, opt_state, loss
 

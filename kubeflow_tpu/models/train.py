@@ -70,6 +70,17 @@ def loss_fn(params, tokens, targets, config: TransformerConfig, mesh=None,
     return nll.sum() / jnp.maximum(valid.sum(), 1)
 
 
+def ce_chunk_for(tc: "TrainConfig", tokens: jax.Array,
+                 vocab_size: int) -> int:
+    """The one fused-CE engagement policy, shared by the dense and MoE
+    steps: chunk size to use (0 = whole-logits path), decided by the
+    trace-time f32 logits size against CE_FUSE_THRESHOLD_BYTES."""
+    logits_bytes = tokens.shape[0] * tokens.shape[1] * vocab_size * 4
+    if tc.ce_chunk_tokens and logits_bytes > CE_FUSE_THRESHOLD_BYTES:
+        return tc.ce_chunk_tokens
+    return 0
+
+
 def _ce_chunks(seq_len: int, chunk_tokens: int) -> int:
     """Chunk count dividing seq_len with chunks <= chunk_tokens (static)."""
     n = max(1, -(-seq_len // max(chunk_tokens, 1)))
@@ -78,18 +89,16 @@ def _ce_chunks(seq_len: int, chunk_tokens: int) -> int:
     return n
 
 
-def fused_loss_fn(params, tokens, targets, config: TransformerConfig,
-                  mesh=None, chunk_tokens: int = 512):
+def chunked_softmax_ce(x: jax.Array, lm_head: jax.Array,
+                       targets: jax.Array, chunk_tokens: int) -> jax.Array:
     """Cross entropy fused with the LM-head projection, chunked over the
     sequence axis: each scan step projects one (b, chunk, d) slice onto the
     vocab, reduces it to logsumexp + target logit, and discards the chunk's
     logits. Peak logits memory drops from (b, s, V) to (b, s/n, V) and the
-    1 GB-per-step f32 logits round-trip to HBM disappears; jax.checkpoint on
-    the chunk body recomputes the projection in backward (the standard
-    remat trade — the LM-head matmul re-runs, the bandwidth win dominates
-    for small-d models). Numerically identical to loss_fn."""
-    x = forward_hidden(params, tokens, config, mesh=mesh)
-    lm_head = params["lm_head"]
+    full-logits round-trip to HBM disappears; jax.checkpoint on the chunk
+    body recomputes the projection in backward (the standard remat trade —
+    the LM-head matmul re-runs, the memory win dominates at long context).
+    Shared by the dense and MoE loss paths."""
     b, s, d = x.shape
     n = _ce_chunks(s, chunk_tokens)
     xc = jnp.moveaxis(x.reshape(b, n, s // n, d), 1, 0)        # (n, b, c, d)
@@ -111,6 +120,13 @@ def fused_loss_fn(params, tokens, targets, config: TransformerConfig,
     (total, count), _ = lax.scan(jax.checkpoint(chunk_body),
                                  (jnp.float32(0.0), jnp.int32(0)), (xc, tc))
     return total / jnp.maximum(count, 1)
+
+
+def fused_loss_fn(params, tokens, targets, config: TransformerConfig,
+                  mesh=None, chunk_tokens: int = 512):
+    """Numerically identical to loss_fn, via chunked_softmax_ce."""
+    x = forward_hidden(params, tokens, config, mesh=mesh)
+    return chunked_softmax_ce(x, params["lm_head"], targets, chunk_tokens)
 
 
 def train_step(params, opt_state, tokens, targets, *,
@@ -224,11 +240,9 @@ def make_sharded_train_step(mesh: Mesh, config: TransformerConfig,
     # forward does not expose (its LM head runs per-stage) — fused path is
     # for the non-pp layouts, engaged by the trace-time logits size
     def step_loss(p, t, tg):
-        logits_bytes = t.shape[0] * t.shape[1] * config.vocab_size * 4
-        if tc.ce_chunk_tokens and pp == 1 and \
-                logits_bytes > CE_FUSE_THRESHOLD_BYTES:
-            return fused_loss_fn(p, t, tg, config, mesh,
-                                 chunk_tokens=tc.ce_chunk_tokens)
+        chunk = ce_chunk_for(tc, t, config.vocab_size) if pp == 1 else 0
+        if chunk:
+            return fused_loss_fn(p, t, tg, config, mesh, chunk_tokens=chunk)
         return loss_fn(p, t, tg, config, mesh, fwd)
 
     @partial(jax.jit,

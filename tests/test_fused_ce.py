@@ -101,3 +101,18 @@ def test_sharded_step_trains_with_fused_ce_forced():
     finally:
         train_mod.CE_FUSE_THRESHOLD_BYTES = orig
     assert abs(float(loss_ref) - float(loss_fused)) < 1e-5
+
+
+def test_moe_fused_loss_matches_reference():
+    from kubeflow_tpu.models.moe import (MoEConfig, init_moe_params,
+                                         moe_loss_fn)
+    cfg = MoEConfig(vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+                    n_kv_heads=2, d_ff=128, max_seq_len=128, dtype="float32",
+                    n_experts=4, experts_per_token=2)
+    params = init_moe_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 96), 0, 512)
+    targets = jnp.roll(tokens, -1, axis=1)
+    ref = float(moe_loss_fn(params, tokens, targets, cfg))
+    fused = float(moe_loss_fn(params, tokens, targets, cfg,
+                              ce_chunk_tokens=32))
+    assert abs(ref - fused) < 1e-5
