@@ -151,17 +151,51 @@ def decode_step(params: dict, cache: dict, token: jax.Array,
 
 
 # ---------------------------------------------------------------- generate
+def top_k_top_p_mask(logits: jax.Array, top_k: jax.Array,
+                     top_p: jax.Array) -> jax.Array:
+    """Mask logits outside the top-k / nucleus (top-p) sets to -inf.
+
+    Both knobs are TRACED per-row (batch,) vectors — one compiled executable
+    covers every setting, matching the temperature contract:
+    - top_k <= 0 disables the k-cut for that row;
+    - top_p >= 1 disables the nucleus cut.
+    Static shapes throughout: O(V log V) sorts over the vocab (tiny next to
+    a decode step's matmuls), rank/cumulative-mass comparisons instead of
+    dynamic gathers."""
+    order = jnp.argsort(-logits, axis=-1)                        # desc
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)                          # 0 = best
+    keep = jnp.ones_like(logits, dtype=bool)
+    k = top_k[:, None]
+    keep &= jnp.where(k > 0, ranks < k, True)
+    # nucleus: keep the smallest prefix of the sorted probs with mass >= p —
+    # a token stays if the cumulative mass BEFORE it is < p
+    probs_sorted = jax.nn.softmax(sorted_logits, axis=-1)
+    cum_before = jnp.cumsum(probs_sorted, axis=-1) - probs_sorted
+    mass_before = jnp.take_along_axis(cum_before, ranks, axis=-1)
+    keep &= jnp.where(top_p[:, None] < 1.0,
+                      mass_before < top_p[:, None], True)
+    return jnp.where(keep, logits, -jnp.inf)
+
+
 @partial(jax.jit, static_argnames=("config", "max_new_tokens"))
 def generate(params: dict, prompt: jax.Array, config: TransformerConfig,
              max_new_tokens: int, temperature: float = 0.0,
-             key: jax.Array | None = None) -> jax.Array:
-    """Greedy (temperature=0) or temperature sampling.
+             key: jax.Array | None = None, top_k: int = 0,
+             top_p: float = 1.0, eos_id: int | None = None,
+             pad_id: int = 0) -> jax.Array:
+    """Greedy (temperature=0), temperature, top-k, and/or nucleus sampling.
 
     prompt: (batch, prompt_len) → (batch, max_new_tokens). One prefill pass,
-    then a single scanned decode loop. ``temperature`` is traced (serving
-    varies it per request — one compiled executable covers all values; the
-    greedy/sampled choice is a jnp.where, not a recompile) and may be a
-    scalar or a per-row (batch,) vector (mixed greedy/sampled batches)."""
+    then a single scanned decode loop. ``temperature``/``top_k``/``top_p``
+    are traced (serving varies them per request — one compiled executable
+    covers all values; the greedy/sampled choice is a jnp.where, not a
+    recompile) and may be scalars or per-row (batch,) vectors (mixed
+    batches).
+
+    ``eos_id``: sequences that emit it keep their static shape — every
+    position after the first EOS holds ``pad_id`` (the loop still runs
+    max_new_tokens steps; per-row early exit would be a dynamic shape)."""
     c = config
     B, prompt_len = prompt.shape
     if prompt_len + max_new_tokens > c.max_seq_len:
@@ -172,30 +206,41 @@ def generate(params: dict, prompt: jax.Array, config: TransformerConfig,
         key = jax.random.key(0)
     temperature = jnp.broadcast_to(
         jnp.asarray(temperature, jnp.float32), (B,))
+    top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
+    top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
 
     logits, cache = prefill(params, prompt, c)
 
     def pick(logits, k):
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        sampled = jax.random.categorical(
-            k, logits / jnp.maximum(temperature, 1e-6)[:, None],
-            axis=-1).astype(jnp.int32)
+        # temperature first, THEN the k/p cuts (the standard order: the
+        # nucleus is computed on the temperature-scaled distribution)
+        scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+        filtered = top_k_top_p_mask(scaled, top_k, top_p)
+        sampled = jax.random.categorical(k, filtered,
+                                         axis=-1).astype(jnp.int32)
         return jnp.where(temperature > 0.0, sampled, greedy)
 
     def step(carry, i):
-        logits, cache, key = carry
+        logits, cache, key, done = carry
         key, sub = jax.random.split(key)
         token = pick(logits, sub)
+        if eos_id is not None:
+            token = jnp.where(done, jnp.int32(pad_id), token)
+            done = done | (token == eos_id)
         logits, cache = decode_step(params, cache, token,
                                     prompt_len + i, c)
-        return (logits, cache, key), token
+        return (logits, cache, key, done), token
 
+    done0 = jnp.zeros((B,), dtype=bool)
     # scan N-1 steps; the last token needs only a pick from the carried
     # logits, not another full model step
-    (logits, _, key), tokens = lax.scan(
-        step, (logits, cache, key),
+    (logits, _, key, done), tokens = lax.scan(
+        step, (logits, cache, key, done0),
         jnp.arange(max_new_tokens - 1, dtype=jnp.int32))
     _, sub = jax.random.split(key)
     last = pick(logits, sub)
+    if eos_id is not None:
+        last = jnp.where(done, jnp.int32(pad_id), last)
     tokens = jnp.concatenate([tokens, last[None]], axis=0)
     return tokens.T  # (steps, batch) → (batch, steps)

@@ -4,6 +4,7 @@ __all__ = ["SliceEnv", "initialize_slice", "verify_slice",
            "TrainCheckpointer", "abstract_state",
            "Trainer", "TrainerStats",
            "prefetch_to_device", "synthetic_lm_batches",
+           "token_file_batches", "write_token_file",
            "BatchedGenerator", "GenerateRequest"]
 
 _LAZY = {
@@ -15,6 +16,8 @@ _LAZY = {
     "TrainerStats": "trainer",
     "prefetch_to_device": "data",
     "synthetic_lm_batches": "data",
+    "token_file_batches": "data",
+    "write_token_file": "data",
     "BatchedGenerator": "serving",
     "GenerateRequest": "serving",
 }

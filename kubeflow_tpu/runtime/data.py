@@ -45,6 +45,52 @@ def synthetic_lm_batches(batch_size: int, seq_len: int, vocab_size: int,
         i += 1
 
 
+def write_token_file(path, tokens: np.ndarray) -> None:
+    """Write a flat int32 token stream in the loader's on-disk format (raw
+    little-endian int32, no header — the memmap-friendly layout every
+    packed-corpus pipeline bottoms out in)."""
+    np.asarray(tokens, dtype="<i4").ravel().tofile(path)
+
+
+def token_file_batches(path, batch_size: int, seq_len: int, *,
+                       n_epochs: int | None = 1, seed: int | None = 0,
+                       doc_sep: int | None = None) -> Iterator[tuple]:
+    """Packed-sequence batches from a raw int32 token file via ``np.memmap``
+    — the corpus never loads into host RAM, each batch slices seq_len+1
+    windows (the +1 provides the shifted target) straight off the mapping.
+
+    - windows are non-overlapping and epoch-shuffled when ``seed`` is set
+      (None = sequential order, resumable streaming);
+    - ``doc_sep``: positions holding this token id get target -1 (don't
+      predict across document boundaries), the separator itself still
+      conditions the following text;
+    - ``n_epochs=None`` streams forever.
+    """
+    data = np.memmap(path, dtype="<i4", mode="r")
+    window = seq_len + 1
+    n_windows = (len(data) - 1) // seq_len
+    if n_windows < batch_size:
+        raise ValueError(
+            f"{path}: {len(data)} tokens give {n_windows} {window}-token "
+            f"windows < batch_size {batch_size} — the loader would yield "
+            f"nothing (or spin forever with n_epochs=None)")
+    rng = np.random.default_rng(seed) if seed is not None else None
+    epoch = 0
+    while n_epochs is None or epoch < n_epochs:
+        order = np.arange(n_windows)
+        if rng is not None:
+            rng.shuffle(order)
+        for start in range(0, n_windows - batch_size + 1, batch_size):
+            idx = order[start:start + batch_size]
+            rows = np.stack([data[i * seq_len:i * seq_len + window]
+                             for i in idx]).astype(np.int32)
+            tokens, targets = rows[:, :-1], rows[:, 1:].copy()
+            if doc_sep is not None:
+                targets[targets == doc_sep] = -1
+            yield tokens, targets
+        epoch += 1
+
+
 class _Stop:
     pass
 

@@ -41,6 +41,8 @@ class GenerateRequest:
     prompt: np.ndarray                # (prompt_len,) int32 token ids
     max_new_tokens: int
     temperature: float = 0.0
+    top_k: int = 0                    # 0 disables the k-cut
+    top_p: float = 1.0                # 1.0 disables the nucleus cut
     future: Future = field(default_factory=Future)
 
     @property
@@ -78,9 +80,10 @@ class BatchedGenerator:
 
     # ----------------------------------------------------------------- API
     def submit(self, prompt, max_new_tokens: int,
-               temperature: float = 0.0) -> Future:
+               temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0) -> Future:
         req = GenerateRequest(np.asarray(prompt, np.int32), max_new_tokens,
-                              temperature)
+                              temperature, top_k, top_p)
         with self._lifecycle:
             if self._closed:
                 raise RuntimeError("generator is closed")
@@ -88,9 +91,12 @@ class BatchedGenerator:
         return req.future
 
     def generate_sync(self, prompt, max_new_tokens: int,
-                      temperature: float = 0.0, timeout: float = 120.0):
-        return self.submit(prompt, max_new_tokens,
-                           temperature).result(timeout=timeout)
+                      temperature: float = 0.0, *, top_k: int = 0,
+                      top_p: float = 1.0, timeout: float = 120.0):
+        # keyword-only knobs: a legacy positional `timeout` argument must
+        # fail loudly, not silently become top_k
+        return self.submit(prompt, max_new_tokens, temperature, top_k,
+                           top_p).result(timeout=timeout)
 
     def close(self) -> None:
         with self._lifecycle:
@@ -187,16 +193,22 @@ class BatchedGenerator:
         self.requests_total += len(batch)
         rows = [r.prompt for r in batch]
         temps_list = [r.temperature for r in batch]
+        top_ks = [r.top_k for r in batch]
+        top_ps = [r.top_p for r in batch]
         # never exceed the operator's cap: max_batch bounds device memory
         pad = min(self._bucket_size(len(batch)), self.max_batch) - len(batch)
         if pad:
             rows.extend([rows[0]] * pad)       # dummy rows, outputs discarded
             temps_list.extend([0.0] * pad)
+            top_ks.extend([0] * pad)
+            top_ps.extend([1.0] * pad)
         prompts = jnp.asarray(np.stack(rows))
         temps = jnp.asarray(temps_list, jnp.float32)
         self._key, sub = jax.random.split(self._key)
         out = generate(self.params, prompts, self.config,
-                       batch[0].max_new_tokens, temperature=temps, key=sub)
+                       batch[0].max_new_tokens, temperature=temps, key=sub,
+                       top_k=jnp.asarray(top_ks, jnp.int32),
+                       top_p=jnp.asarray(top_ps, jnp.float32))
         out = np.asarray(out)
         for i, req in enumerate(batch):
             req.future.set_result(out[i])

@@ -136,3 +136,74 @@ def test_moe_generate_runs():
     assert out.shape == (2, 6)
     out2 = generate(params, prompt, cfg, max_new_tokens=6)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+
+def test_top_k_mask_keeps_only_k_best():
+    import jax.numpy as jnp
+    from kubeflow_tpu.models.decode import top_k_top_p_mask
+    logits = jnp.asarray([[1.0, 5.0, 3.0, 2.0, 4.0]])
+    out = top_k_top_p_mask(logits, jnp.asarray([2]), jnp.asarray([1.0]))
+    assert bool(jnp.isfinite(out[0, 1])) and bool(jnp.isfinite(out[0, 4]))
+    assert not bool(jnp.isfinite(out[0, 0]))
+    assert not bool(jnp.isfinite(out[0, 2]))
+    assert not bool(jnp.isfinite(out[0, 3]))
+    # k=0 disables the cut
+    out = top_k_top_p_mask(logits, jnp.asarray([0]), jnp.asarray([1.0]))
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_top_p_keeps_smallest_nucleus():
+    import jax.numpy as jnp
+    from kubeflow_tpu.models.decode import top_k_top_p_mask
+    # probs ~ [0.643, 0.236, 0.087, 0.032, 0.002]
+    logits = jnp.log(jnp.asarray([[0.643, 0.236, 0.087, 0.032, 0.002]]))
+    out = top_k_top_p_mask(logits, jnp.asarray([0]), jnp.asarray([0.8]))
+    # 0.643 < 0.8 → second token still included; 0.643+0.236 >= 0.8 → stop
+    assert bool(jnp.isfinite(out[0, 0])) and bool(jnp.isfinite(out[0, 1]))
+    assert not bool(jnp.isfinite(out[0, 2]))
+    # the top token is always kept even when p is tiny
+    out = top_k_top_p_mask(logits, jnp.asarray([0]), jnp.asarray([0.01]))
+    assert bool(jnp.isfinite(out[0, 0]))
+    assert not bool(jnp.isfinite(out[0, 1]))
+
+
+def test_generate_with_topk_topp_matches_greedy_when_k1():
+    """top_k=1 with any temperature is argmax — pins the mask into the
+    sampling path end to end."""
+    cfg = tiny_config()
+    from kubeflow_tpu.models.transformer import init_params
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(3), (2, 8), 0, cfg.vocab_size)
+    greedy = generate(params, prompt, cfg, 6, temperature=0.0)
+    k1 = generate(params, prompt, cfg, 6, temperature=1.0, top_k=1,
+                  key=jax.random.key(9))
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+
+def test_generate_per_row_topk_vector():
+    cfg = tiny_config()
+    from kubeflow_tpu.models.transformer import init_params
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(3), (2, 8), 0, cfg.vocab_size)
+    import jax.numpy as jnp
+    out = generate(params, prompt, cfg, 4, temperature=1.0,
+                   top_k=jnp.asarray([1, 0]), top_p=jnp.asarray([1.0, 0.9]),
+                   key=jax.random.key(5))
+    assert out.shape == (2, 4)
+
+
+def test_eos_pads_remainder_static_shape():
+    cfg = tiny_config()
+    from kubeflow_tpu.models.transformer import init_params
+    params = init_params(jax.random.key(0), cfg)
+    prompt = jax.random.randint(jax.random.key(3), (2, 6), 0, cfg.vocab_size)
+    greedy = np.asarray(generate(params, prompt, cfg, 8))
+    # pick row 0's second token as the "EOS": everything after its first
+    # occurrence must become pad (id 0); other rows unaffected until theirs
+    eos = int(greedy[0, 1])
+    out = np.asarray(generate(params, prompt, cfg, 8, eos_id=eos, pad_id=0))
+    assert out.shape == (2, 8)
+    first = np.argmax(np.asarray(greedy[0]) == eos)
+    # up to and including the first EOS the stream matches greedy
+    np.testing.assert_array_equal(out[0, :first + 1], greedy[0, :first + 1])
+    assert (out[0, first + 1:] == 0).all()

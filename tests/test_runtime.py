@@ -44,3 +44,66 @@ def test_verify_slice_timeout():
     env = SliceEnv(worker_id=0, hostnames=("localhost",), accelerator="v5e-16")
     with pytest.raises(TimeoutError):
         verify_slice(env, timeout_s=0.1)
+
+
+# ------------------------------------------------------------- token files
+
+import numpy as np
+
+
+def test_token_file_batches_roundtrip(tmp_path):
+    from kubeflow_tpu.runtime.data import token_file_batches, write_token_file
+    path = tmp_path / "corpus.bin"
+    corpus = np.arange(1000, dtype=np.int32)
+    write_token_file(path, corpus)
+    batches = list(token_file_batches(path, batch_size=2, seq_len=16,
+                                      seed=None))
+    assert batches  # (1000-1)//16 = 62 windows → 31 batches
+    tokens, targets = batches[0]
+    assert tokens.shape == (2, 16) and tokens.dtype == np.int32
+    # sequential order: window i starts at i*seq_len; target = next token
+    np.testing.assert_array_equal(tokens[0], corpus[:16])
+    np.testing.assert_array_equal(targets[0], corpus[1:17])
+
+
+def test_token_file_batches_shuffles_per_epoch(tmp_path):
+    from kubeflow_tpu.runtime.data import token_file_batches, write_token_file
+    path = tmp_path / "corpus.bin"
+    write_token_file(path, np.arange(4000, dtype=np.int32))
+    two_epochs = list(token_file_batches(path, 4, 32, n_epochs=2, seed=7))
+    one_epoch = len(two_epochs) // 2
+    first = np.stack([t for t, _ in two_epochs[:one_epoch]])
+    second = np.stack([t for t, _ in two_epochs[one_epoch:]])
+    assert not np.array_equal(first, second)  # different order
+    # same windows overall, just reordered
+    assert sorted(first.ravel()[::32].tolist()) == \
+        sorted(second.ravel()[::32].tolist())
+
+
+def test_token_file_doc_separator_masks_targets(tmp_path):
+    from kubeflow_tpu.runtime.data import token_file_batches, write_token_file
+    path = tmp_path / "corpus.bin"
+    corpus = np.arange(1, 200, dtype=np.int32)
+    corpus[::10] = 0  # doc separator token id 0
+    write_token_file(path, corpus)
+    tokens, targets = next(token_file_batches(path, 1, 64, seed=None,
+                                              doc_sep=0))
+    assert (targets == -1).sum() > 0
+    assert not (targets == 0).any()     # every separator target masked
+    assert (tokens == 0).any()          # separators still condition
+
+
+def test_token_file_too_small_raises(tmp_path):
+    from kubeflow_tpu.runtime.data import token_file_batches, write_token_file
+    path = tmp_path / "tiny.bin"
+    write_token_file(path, np.arange(8, dtype=np.int32))
+    with pytest.raises(ValueError, match="window"):
+        next(token_file_batches(path, 1, 16))
+
+
+def test_token_file_fewer_windows_than_batch_raises(tmp_path):
+    from kubeflow_tpu.runtime.data import token_file_batches, write_token_file
+    path = tmp_path / "small.bin"
+    write_token_file(path, np.arange(1000, dtype=np.int32))  # 62 windows @16
+    with pytest.raises(ValueError, match="batch_size"):
+        next(token_file_batches(path, batch_size=64, seq_len=16))

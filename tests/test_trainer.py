@@ -159,3 +159,14 @@ def test_fit_does_not_skip_batches_across_calls():
         tr.fit(stream, steps=3, prefetch_buffer=2)
         assert len(drawn) == 6          # continued, nothing skipped
         assert tr.stats.step == 6
+
+
+def test_trainer_fits_from_token_file(tmp_path):
+    from kubeflow_tpu.runtime.data import token_file_batches, write_token_file
+    path = tmp_path / "corpus.bin"
+    rng = np.random.default_rng(0)
+    write_token_file(path, rng.integers(0, 128, 40_000, dtype=np.int32))
+    cfg = tiny_config()
+    with Trainer(mesh8(), cfg, TrainConfig(warmup_steps=1)) as tr:
+        tr.fit(token_file_batches(path, 4, 16, n_epochs=None), steps=3)
+        assert tr.stats.step == 3 and tr.stats.last_loss is not None
