@@ -56,11 +56,16 @@ def init_kv_cache(config: TransformerConfig, batch: int) -> dict:
 
 
 def _write_cache(cache_layer: dict, k: jax.Array, v: jax.Array,
-                 start: jax.Array) -> dict:
-    """Write (b, s, h, d) K/V into a (b, max_seq, h, d) layer cache at
-    sequence offset ``start``."""
+                 start: jax.Array, layer: int | None = None) -> dict:
+    """Write (b, s, h, d) K/V into a layer cache at sequence offset
+    ``start``. With ``layer`` set, the cache is the stacked
+    (L, b, max_seq, h, d) form and the write targets that layer (the
+    decode_step unrolled-loop path)."""
     zero = jnp.int32(0)
     idx = (zero, jnp.asarray(start, jnp.int32), zero, zero)
+    if layer is not None:
+        idx = (jnp.int32(layer), *idx)
+        k, v = k[None], v[None]
     return {
         "k": lax.dynamic_update_slice(cache_layer["k"], k, idx),
         "v": lax.dynamic_update_slice(cache_layer["v"], v, idx),
@@ -104,21 +109,31 @@ def decode_step(params: dict, cache: dict, token: jax.Array,
 
     token: (batch,) int32; pos: scalar int32, the sequence position being
     written (prompt_len for the first generated token). Attention runs over
-    the full static cache with a ``<= pos`` mask."""
+    the full static cache with a ``<= pos`` mask.
+
+    The layer loop is UNROLLED (not lax.scan): scanning over the stacked
+    (L, B, S, G, D) cache forces per-layer dynamic-slice reads, a restacking
+    write, and full cache copies every step — profiled at ~80% of decode
+    wall time on v5e (copy + slice/update fusions ≈ 2 ms of a 2.5 ms step).
+    With static layer indices the cache updates are single-position
+    dynamic-update-slices XLA aliases in place across the outer generate
+    scan; the unrolled compile covers n_layers identical bodies, a one-off
+    cost the serving path amortizes."""
     c = config
     B = token.shape[0]
+    pos32 = jnp.asarray(pos, jnp.int32)
     x = params["embed"].astype(c.compute_dtype)[token][:, None, :]  # (B,1,D)
-    positions = jnp.broadcast_to(
-        jnp.asarray(pos, jnp.int32)[None, None], (B, 1))
+    positions = jnp.broadcast_to(pos32[None, None], (B, 1))
     cos, sin = rope_frequencies(c, positions)
     scale = 1.0 / math.sqrt(c.d_head)
     valid = jnp.arange(c.max_seq_len, dtype=jnp.int32)[None, None, None, :] \
-        <= jnp.asarray(pos, jnp.int32)                       # (1,1,1,S)
+        <= pos32                                             # (1,1,1,S)
 
     rep = c.n_heads // c.n_kv_heads
+    stacked = {"k": cache["k"], "v": cache["v"]}     # (L, B, S, G, D)
 
-    def layer_body(x, layer_and_cache):
-        layer, cache_layer = layer_and_cache
+    for i in range(c.n_layers):
+        layer = jax.tree.map(lambda a: a[i], params["blocks"])
         h = rms_norm(x, layer["attn_norm"])
         dt = h.dtype
         q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
@@ -126,14 +141,14 @@ def decode_step(params: dict, cache: dict, token: jax.Array,
         v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        cache_layer = _write_cache(cache_layer, k, v, pos)
+        stacked = _write_cache(stacked, k, v, pos32, layer=i)
         # grouped GQA: q heads fold to (kv_heads, rep) and contract against
         # the UN-repeated cache — head h reads kv head h//rep, matching
         # repeat_kv's layout, without materializing a rep× cache copy (the
         # KV-bandwidth saving is the point of GQA)
         B_, _, H_, D_ = q.shape
         qg = q.reshape(B_, 1, c.n_kv_heads, rep, D_)
-        ck, cv = cache_layer["k"], cache_layer["v"]     # (B, S, G, D)
+        ck, cv = stacked["k"][i], stacked["v"][i]    # (B, S, G, D) views
         logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ck,
                             preferred_element_type=jnp.float32) * scale
         logits = jnp.where(valid[:, :, None], logits, -jnp.inf)
@@ -142,12 +157,10 @@ def decode_step(params: dict, cache: dict, token: jax.Array,
             B_, 1, H_, D_)
         x = x + jnp.einsum("bshk,hkd->bsd", out, layer["wo"].astype(dt))
         x = _mlp(x, layer, c)
-        return x, cache_layer
 
-    x, new_cache = lax.scan(layer_body, x, (params["blocks"], cache))
     x = rms_norm(x, params["final_norm"])
     logits = jnp.einsum("bd,dv->bv", x[:, 0], params["lm_head"].astype(x.dtype))
-    return logits.astype(jnp.float32), new_cache
+    return logits.astype(jnp.float32), stacked
 
 
 # ---------------------------------------------------------------- generate
