@@ -1,0 +1,50 @@
+# Developer surface — the analog of the reference's per-component Makefiles
+# (notebook-controller/Makefile, odh-notebook-controller/Makefile).
+
+PYTHON ?= python
+TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+
+.PHONY: help test test-fast test-chaos test-transport lint manifests \
+        manifests-check check-license bench numerics dryrun loadtest run
+
+help: ## Display this help.
+	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_-]+:.*?##/ {printf "  %-16s %s\n", $$1, $$2}' $(MAKEFILE_LIST)
+
+test: ## Run the full suite on the virtual 8-device CPU mesh.
+	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q
+
+test-fast: ## Suite minus the subprocess/multi-process tests.
+	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q -k "not slow"
+
+test-chaos: ## Fault-injection tier only (reference: make test-chaos).
+	$(TEST_ENV) $(PYTHON) -m pytest tests/test_chaos.py tests/test_chaos_experiments.py -q
+
+test-transport: ## Real-HTTP transport + multi-process HA tier.
+	$(TEST_ENV) $(PYTHON) -m pytest tests/test_http_transport.py tests/test_http_stack.py tests/test_cli.py tests/test_multihost.py -q
+
+lint: ## Repo lint rules (ci/lint.py; the fmt/vet analog).
+	$(PYTHON) ci/lint.py
+
+manifests: ## Regenerate config/ from kubeflow_tpu/deploy/manifests.py.
+	$(PYTHON) ci/generate_manifests.py
+
+manifests-check: ## Fail on config/ drift (CI gate).
+	$(PYTHON) ci/generate_manifests.py --check
+
+check-license: ## Third-party license concatenation check.
+	bash ci/check_license.sh
+
+bench: ## Benchmarks (JSON lines; real TPU when the tunnel is live).
+	$(PYTHON) bench.py
+
+numerics: ## On-chip Pallas kernel validation (requires a live TPU).
+	$(PYTHON) ci/tpu_numerics.py
+
+dryrun: ## Multi-chip sharding dryrun on 8 virtual CPU devices.
+	$(PYTHON) __graft_entry__.py 8
+
+loadtest: ## 100-notebook control-plane fan-out, in-process.
+	$(PYTHON) loadtest/start_notebooks.py --count 100
+
+run: ## Standalone control plane: apiserver on :6443 + kubelet simulator.
+	$(PYTHON) -m kubeflow_tpu.main --serve-apiserver 6443 --simulate-kubelet

@@ -192,7 +192,12 @@ def manager_deployment() -> dict:
                         # flags must exist in kubeflow_tpu/main.py argparse —
                         # tests/test_manifests.py parses them against it
                         "command": ["python", "-m", "kubeflow_tpu.main"],
-                        "args": ["--leader-elect",
+                        # --in-cluster: ServiceAccount-mount transport to the
+                        # real apiserver (cluster/http_client.py); without it
+                        # the manager would reconcile an empty in-process
+                        # store and never touch the cluster
+                        "args": ["--in-cluster",
+                                 "--leader-elect",
                                  "--health-port", "8081",
                                  "--webhook-port", "8443",
                                  "--cert-dir", "/etc/webhook/certs"],

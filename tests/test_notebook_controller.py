@@ -311,3 +311,44 @@ def test_virtual_service_drift_repaired(store, manager, config, metrics):
 def test_no_virtual_service_by_default(store, manager, notebook_reconciler):
     apply_notebook(store, manager, api.new_notebook("nb", "ns"))
     assert store.get_or_none("VirtualService", "ns", "notebook-ns-nb") is None
+
+
+def test_worker_env_stable_across_stop_resume_cycles(store, manager,
+                                                     notebook_reconciler):
+    """SURVEY §7 hard part: TPU_WORKER_* and the headless-Service DNS must
+    be BYTE-IDENTICAL across replicas 0↔N flips — a resumed slice reforms
+    its mesh with the same coordinator address, and a changed pod template
+    would needlessly roll every worker."""
+    nb = api.new_notebook("cyc", "ns", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"})
+    apply_notebook(store, manager, nb)
+
+    def rendered():
+        sts = store.get("StatefulSet", "ns", "cyc")
+        template = sts["spec"]["template"]
+        return (sts["spec"]["replicas"], sts["spec"].get("serviceName"),
+                template)
+
+    replicas0, svc0, template0 = rendered()
+    assert replicas0 == 4
+    for cycle in range(2):
+        store.patch(api.KIND, "ns", "cyc", {"metadata": {"annotations": {
+            names.STOP_ANNOTATION: f"2026-01-0{cycle + 1}T00:00:00Z"}}})
+        drain(manager)
+        stopped_replicas, svc_stopped, template_stopped = rendered()
+        assert stopped_replicas == 0          # slice-atomic: 0, never partial
+        # while stopped the template may carry the stop annotation (no pods
+        # exist to roll); everything else must be untouched
+        scrubbed = k8s.deepcopy(template_stopped)
+        scrubbed["metadata"]["annotations"].pop(names.STOP_ANNOTATION, None)
+        assert scrubbed == template0
+        assert svc_stopped == svc0
+        store.patch(api.KIND, "ns", "cyc", {"metadata": {"annotations": {
+            names.STOP_ANNOTATION: None}}})
+        drain(manager)
+        resumed_replicas, svc_resumed, template_resumed = rendered()
+        assert resumed_replicas == 4
+        assert template_resumed == template0
+        assert svc_resumed == svc0
+    # headless service survives the cycles (worker DNS never disappears)
+    assert store.get("Service", "ns", "cyc-workers")
