@@ -203,3 +203,7 @@ class CachingClient:
     @property
     def supports_inprocess_admission(self) -> bool:
         return getattr(self.store, "supports_inprocess_admission", True)
+
+    def attach_metrics(self, registry) -> None:
+        if hasattr(self.store, "attach_metrics"):
+            self.store.attach_metrics(registry)

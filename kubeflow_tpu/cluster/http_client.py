@@ -106,10 +106,13 @@ class HttpApiClient:
     def __init__(self, base_url: str, token: str | None = None,
                  ca_cert: str | None = None, client_cert: str | None = None,
                  client_key: str | None = None, verify: bool = True,
-                 timeout: float = 30.0) -> None:
+                 timeout: float = 30.0, metrics=None) -> None:
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
+        self._requests_metric = None
+        if metrics is not None:
+            self.attach_metrics(metrics)
         self._ssl: ssl.SSLContext | None = None
         if self.base_url.startswith("https"):
             ctx = ssl.create_default_context(cafile=ca_cert)
@@ -173,10 +176,30 @@ class HttpApiClient:
         if self.token:
             req.add_header("Authorization", f"Bearer {self.token}")
         try:
-            return urllib.request.urlopen(
+            resp = urllib.request.urlopen(
                 req, timeout=timeout or self.timeout, context=self._ssl)
+            self._count_request(method, resp.status)
+            return resp
         except urllib.error.HTTPError as err:
+            self._count_request(method, err.code)
             raise _error_from_response(err.code, err.read()) from None
+        except (urllib.error.URLError, OSError):
+            self._count_request(method, "<error>")
+            raise
+
+    def _count_request(self, method: str, code) -> None:
+        if self._requests_metric is not None:
+            self._requests_metric.inc({"method": method, "code": str(code)})
+
+    def attach_metrics(self, registry) -> None:
+        """Bind a metrics registry — the rest_client_requests_total analog
+        (client-go exposes it through the controller-runtime registry; the
+        reference's managers ship it on the same endpoint as the notebook
+        series). setup_controllers calls this late, since the client is
+        constructed before the registry exists."""
+        self._requests_metric = registry.counter(
+            "rest_client_requests_total",
+            "Number of apiserver HTTP requests, by verb and status code.")
 
     def _json(self, method: str, path: str, body: dict | None = None,
               content_type: str = "application/json") -> dict:

@@ -26,6 +26,8 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
 
     config = config or ControllerConfig.from_env()
     metrics = metrics or MetricsRegistry()
+    if hasattr(client, "attach_metrics"):
+        client.attach_metrics(metrics)  # rest_client_requests_total
     # remote clients (HttpApiClient) can't register in-process admission —
     # there, schema validation and the webhooks run server-side (CRD schema +
     # AdmissionServer behind webhook configurations, as in the reference)

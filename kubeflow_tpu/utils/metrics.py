@@ -74,11 +74,19 @@ class MetricsRegistry:
             "notebook_running", "Current running notebooks in the cluster")
 
     def counter(self, name: str, help_: str) -> _Metric:
+        # get-or-create (prometheus registration semantics): re-registering
+        # must return the live metric, not silently reset it
+        existing = self._metrics.get(name)
+        if existing is not None:
+            return existing
         m = _Metric(name, help_, "counter")
         self._metrics[name] = m
         return m
 
     def gauge(self, name: str, help_: str) -> _Metric:
+        existing = self._metrics.get(name)
+        if existing is not None:
+            return existing
         m = _Metric(name, help_, "gauge")
         self._metrics[name] = m
         return m

@@ -305,3 +305,23 @@ def test_status_subresource_patch_only_touches_status(client):
 def test_unknown_kind_raises_clear_mapping_error(client):
     with pytest.raises(KeyError, match="no REST mapping"):
         client.get("SomethingNobodyRegistered", "default", "x")
+
+
+def test_rest_client_requests_metric(server):
+    """controller-runtime parity: rest_client_requests_total by verb+code."""
+    from kubeflow_tpu.utils.metrics import MetricsRegistry
+    registry = MetricsRegistry()
+    client = HttpApiClient(server.url)
+    client.attach_metrics(registry)
+    try:
+        client.create(cm("metric-cm"))
+        client.get("ConfigMap", "default", "metric-cm")
+        with pytest.raises(NotFoundError):
+            client.get("ConfigMap", "default", "ghost")
+        metric = registry.counter("rest_client_requests_total", "")
+        assert metric.get({"method": "POST", "code": "201"}) == 1
+        assert metric.get({"method": "GET", "code": "200"}) == 1
+        assert metric.get({"method": "GET", "code": "404"}) == 1
+        assert "rest_client_requests_total" in registry.expose()
+    finally:
+        client.close()
