@@ -39,6 +39,7 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
         NotebookMutatingWebhook(client, config).install(client)
         NotebookValidatingWebhook(config).install(client)
     mgr = Manager(client)
+    mgr.attach_metrics(metrics)
     NotebookReconciler(client, config, metrics).setup(mgr)
     if extension:
         ExtensionReconciler(client, config, metrics).setup(mgr)

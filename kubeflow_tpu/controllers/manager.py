@@ -87,6 +87,32 @@ class Manager:
         self.health_server = None
         # optional HTTPS admission server (set by main.build_manager)
         self.webhook_server = None
+        # controller-runtime parity metrics (attach_metrics):
+        # controller_runtime_reconcile_total{controller,result} and the
+        # workqueue depth gauge, computed at scrape
+        self._reconcile_metric = None
+
+    def attach_metrics(self, registry) -> None:
+        self._reconcile_metric = registry.counter(
+            "controller_runtime_reconcile_total",
+            "Total reconciliations per controller, by result.")
+        depth = registry.gauge(
+            "workqueue_depth", "Current depth of the reconcile workqueue.")
+
+        def scrape() -> None:
+            with self._cv:
+                per_controller: dict[str, int] = {}
+                for item in self._queue:
+                    per_controller[item.controller] = \
+                        per_controller.get(item.controller, 0) + 1
+            for name in self._reconcilers:
+                depth.set(per_controller.get(name, 0), {"name": name})
+        registry.on_scrape(scrape)
+
+    def _count_reconcile(self, controller: str, result: str) -> None:
+        if self._reconcile_metric is not None:
+            self._reconcile_metric.inc({"controller": controller,
+                                        "result": result})
 
     # ---------------------------------------------------------------- wiring
     def register(self, reconciler: Reconciler) -> None:
@@ -169,11 +195,15 @@ class Manager:
                           self.ERROR_BACKOFF_MAX)
             log.warning("reconcile %s %s failed (%s); requeue in %.3fs",
                         item.controller, item.req, exc, backoff)
+            self._count_reconcile(item.controller, "error")
             self.enqueue(item.controller, item.req, after=backoff)
             return
         self._failures.pop(key, None)
         if result is not None and result.requeue_after is not None:
+            self._count_reconcile(item.controller, "requeue_after")
             self.enqueue(item.controller, item.req, after=result.requeue_after)
+        else:
+            self._count_reconcile(item.controller, "success")
 
     def run_until_idle(self, timeout: float = 30.0,
                        include_delayed_under: float = 0.0) -> int:

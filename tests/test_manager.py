@@ -97,3 +97,30 @@ def test_background_thread_mode():
         assert rec.count == 2
     finally:
         mgr.stop()
+
+
+def test_reconcile_and_workqueue_metrics(store):
+    from kubeflow_tpu.utils.metrics import MetricsRegistry
+    registry = MetricsRegistry()
+    mgr = Manager(store)
+    mgr.attach_metrics(registry)
+
+    class Flaky:
+        name = "flaky"
+        calls = 0
+
+        def reconcile(self, req):
+            Flaky.calls += 1
+            if Flaky.calls == 1:
+                raise RuntimeError("boom")
+            return None
+
+    mgr.register(Flaky())
+    mgr.enqueue("flaky", Request("ns", "a"))
+    mgr.run_until_idle(include_delayed_under=5.0)
+    metric = registry.counter("controller_runtime_reconcile_total", "")
+    assert metric.get({"controller": "flaky", "result": "error"}) == 1
+    assert metric.get({"controller": "flaky", "result": "success"}) == 1
+    exposition = registry.expose()
+    assert "controller_runtime_reconcile_total" in exposition
+    assert "workqueue_depth" in exposition
