@@ -129,17 +129,21 @@ def cmd_get(client, args) -> int:
             print(f"Error: {kind.lower()} {ns}/{name} not found",
                   file=sys.stderr)
             return 1
-        if args.output == "json":
-            print(json.dumps(obj, indent=2))
-        else:
-            _print_table([obj])
+        _print_objs(args.output, obj, [obj])
         return 0
     objs = client.list(kind, args.namespace or None)
-    if args.output == "json":
-        print(json.dumps({"kind": f"{kind}List", "items": objs}, indent=2))
+    _print_objs(args.output, {"kind": f"{kind}List", "items": objs}, objs)
+    return 0
+
+
+def _print_objs(output: str, raw, objs) -> None:
+    if output == "json":
+        print(json.dumps(raw, indent=2))
+    elif output == "yaml":
+        import yaml
+        print(yaml.safe_dump(raw, sort_keys=False), end="")
     else:
         _print_table(objs)
-    return 0
 
 
 def _print_table(objs) -> None:
@@ -200,7 +204,7 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p_get = sub.add_parser("get", help="list/show resources")
     p_get.add_argument("resource")
     p_get.add_argument("name", nargs="?")
-    p_get.add_argument("-o", "--output", choices=("table", "json"),
+    p_get.add_argument("-o", "--output", choices=("table", "json", "yaml"),
                        default="table")
 
     p_del = sub.add_parser("delete", help="delete a resource")

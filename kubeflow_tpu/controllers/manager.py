@@ -100,11 +100,16 @@ class Manager:
             "workqueue_depth", "Current depth of the reconcile workqueue.")
 
         def scrape() -> None:
+            # count live work only: _queued (immediate) + _timed_pending
+            # (earliest timed requeue per key) — the raw heap also holds
+            # superseded ghost entries that _pop_ready discards lazily, and
+            # counting those over-reports depth
             with self._cv:
                 per_controller: dict[str, int] = {}
-                for item in self._queue:
-                    per_controller[item.controller] = \
-                        per_controller.get(item.controller, 0) + 1
+                for controller, _req in list(self._queued) + \
+                        list(self._timed_pending):
+                    per_controller[controller] = \
+                        per_controller.get(controller, 0) + 1
             for name in self._reconcilers:
                 depth.set(per_controller.get(name, 0), {"name": name})
         registry.on_scrape(scrape)

@@ -109,6 +109,9 @@ def build_arg_parser() -> argparse.ArgumentParser:
     ap.add_argument("--simulate-kubelet", action="store_true",
                     help="run the StatefulSet/pod simulator (standalone)")
     ap.add_argument("--debug-log", action="store_true")
+    ap.add_argument("--log-format", choices=("text", "json"), default="text",
+                    help="json = zap production-encoder analog (one JSON "
+                         "object per line, RFC3339 ts)")
     # real-cluster transport: pick ONE of kubeconfig / api-server / in-cluster
     ap.add_argument("--kubeconfig", default=None,
                     help="reconcile a real cluster via this kubeconfig")
@@ -151,9 +154,8 @@ def build_client_from_args(args):
 
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
-    logging.basicConfig(
-        level=logging.DEBUG if args.debug_log else logging.INFO,
-        format="%(asctime)s %(levelname)s %(name)s %(message)s")
+    from .utils.logging import setup_logging
+    setup_logging(debug=args.debug_log, fmt=args.log_format)
 
     client = build_client_from_args(args)
     mgr, shutdown = build_manager(

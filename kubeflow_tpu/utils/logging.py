@@ -1,0 +1,47 @@
+"""Structured logging setup — the zap analog.
+
+The reference's managers configure zap with RFC3339 timestamps and a
+``--debug-log`` verbosity flag (odh main.go:161-169); zap's two encoders
+(production JSON, development console) map to the ``json`` and ``text``
+formats here. JSON lines carry ts/level/logger/msg plus exception text, the
+shape log pipelines expect from controller pods.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+
+
+class JsonFormatter(logging.Formatter):
+    """zap production-encoder analog: one JSON object per line."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        entry = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                time.gmtime(record.created)),
+            "level": record.levelname.lower(),
+            "logger": record.name,
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            entry["error"] = self.formatException(record.exc_info)
+        return json.dumps(entry)
+
+
+def setup_logging(debug: bool = False, fmt: str = "text") -> None:
+    """Configure the root logger once (idempotent: replaces handlers)."""
+    root = logging.getLogger()
+    for handler in list(root.handlers):
+        root.removeHandler(handler)
+    handler = logging.StreamHandler()
+    if fmt == "json":
+        handler.setFormatter(JsonFormatter())
+    else:
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(name)s %(message)s",
+            datefmt="%Y-%m-%dT%H:%M:%SZ"))
+        logging.Formatter.converter = time.gmtime
+    root.addHandler(handler)
+    root.setLevel(logging.DEBUG if debug else logging.INFO)

@@ -107,3 +107,14 @@ def test_get_missing_resource_is_error(server, capsys):
 def test_unknown_resource_type_rejected(server):
     with pytest.raises(SystemExit):
         run(server, "get", "flurble")
+
+
+def test_get_output_yaml(server, store, tmp_path, capsys):
+    manifest = tmp_path / "nb.yaml"
+    manifest.write_text(NB_YAML)
+    run(server, "apply", "-f", str(manifest))
+    capsys.readouterr()
+    assert run(server, "get", "nb", "proj/demo", "-o", "yaml") == 0
+    import yaml as yaml_mod
+    obj = yaml_mod.safe_load(capsys.readouterr().out)
+    assert k8s.name(obj) == "demo"

@@ -66,3 +66,18 @@ def test_secret_payloads_not_cached_by_manager_client():
                   "data": {"k": "djE="}})
     assert mgr.client.get("Secret", "ns", "s")["data"] == {"k": "djE="}
     assert ("Secret", "ns", "s") not in mgr.client._cache
+
+
+def test_json_log_format():
+    import json as json_mod
+    import logging
+
+    from kubeflow_tpu.utils.logging import JsonFormatter
+    record = logging.LogRecord("kubeflow_tpu.test", logging.WARNING,
+                               __file__, 1, "something %s", ("happened",),
+                               None)
+    entry = json_mod.loads(JsonFormatter().format(record))
+    assert entry["level"] == "warning"
+    assert entry["logger"] == "kubeflow_tpu.test"
+    assert entry["msg"] == "something happened"
+    assert entry["ts"].endswith("Z")

@@ -124,3 +124,26 @@ def test_reconcile_and_workqueue_metrics(store):
     exposition = registry.expose()
     assert "controller_runtime_reconcile_total" in exposition
     assert "workqueue_depth" in exposition
+
+
+def test_workqueue_depth_ignores_superseded_ghosts(store):
+    """A superseded timed requeue leaves a lazy ghost in the heap; depth
+    must count live keys, not heap entries."""
+    from kubeflow_tpu.utils.metrics import MetricsRegistry
+    registry = MetricsRegistry()
+    mgr = Manager(store)
+    mgr.attach_metrics(registry)
+
+    class Idle:
+        name = "idle"
+
+        def reconcile(self, req):
+            return None
+
+    mgr.register(Idle())
+    req = Request("ns", "a")
+    mgr.enqueue("idle", req, after=300.0)   # far-future requeue
+    mgr.enqueue("idle", req, after=100.0)   # supersedes it (ghost remains)
+    registry.expose()
+    depth = registry.gauge("workqueue_depth", "")
+    assert depth.get({"name": "idle"}) == 1
