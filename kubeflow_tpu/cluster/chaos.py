@@ -94,3 +94,18 @@ class ChaosClient:
 
     def register_admission(self, *args, **kwargs):
         return self._store.register_admission(*args, **kwargs)
+
+    @property
+    def supports_inprocess_admission(self) -> bool:
+        # composes over HttpApiClient too (chaos across the real transport)
+        return getattr(self._store, "supports_inprocess_admission", True)
+
+    def attach_metrics(self, registry) -> None:
+        attach = getattr(self._store, "attach_metrics", None)
+        if attach is not None:
+            attach(registry)
+
+    def close(self) -> None:
+        close = getattr(self._store, "close", None)
+        if close is not None:
+            close()

@@ -39,9 +39,12 @@ def setup_logging(debug: bool = False, fmt: str = "text") -> None:
     if fmt == "json":
         handler.setFormatter(JsonFormatter())
     else:
-        handler.setFormatter(logging.Formatter(
+        formatter = logging.Formatter(
             "%(asctime)s %(levelname)s %(name)s %(message)s",
-            datefmt="%Y-%m-%dT%H:%M:%SZ"))
-        logging.Formatter.converter = time.gmtime
+            datefmt="%Y-%m-%dT%H:%M:%SZ")
+        # UTC on THIS formatter only — mutating the logging.Formatter class
+        # attribute would flip every other formatter in the process
+        formatter.converter = time.gmtime
+        handler.setFormatter(formatter)
     root.addHandler(handler)
     root.setLevel(logging.DEBUG if debug else logging.INFO)

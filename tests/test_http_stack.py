@@ -307,3 +307,36 @@ def test_manager_recovers_from_apiserver_outage(config, monkeypatch):
         mgr.stop()
         proxy.stop()
         sim_mgr.stop()
+
+
+@pytest.mark.slow
+def test_reconcilers_converge_under_intermittent_http_faults(cluster_server,
+                                                             config):
+    """The reference's 15% intermittent multi-op noise test
+    (chaostests/chaos_test.go:385-403), composed over the REAL transport:
+    ChaosClient wraps HttpApiClient, so every injected fault hits a manager
+    that is also paying genuine HTTP round-trips. Error→requeue backoff must
+    converge while the noise is ACTIVE, and stay converged after
+    deactivation."""
+    from kubeflow_tpu.cluster.chaos import ChaosClient, FaultConfig
+    fault_cfg = FaultConfig(get=0.15, list=0.15, create=0.15, update=0.15,
+                            patch=0.15, seed=7)
+    chaotic = ChaosClient(HttpApiClient(cluster_server.url), fault_cfg)
+    mgr, _ = build_manager(store=chaotic, config=config)
+    mgr.start()
+    kubectl = HttpApiClient(cluster_server.url)
+    try:
+        for i in range(3):
+            kubectl.create(notebook(f"noisy-{i}"))
+        wait_for(lambda: all(
+            kubectl.get_or_none("Pod", "default", f"noisy-{i}-0")
+            for i in range(3)), timeout=60,
+            msg="reconcile through 15% fault noise over HTTP")
+        fault_cfg.deactivate()
+        kubectl.create(notebook("calm"))
+        wait_for(lambda: kubectl.get_or_none("Pod", "default", "calm-0"),
+                 msg="post-deactivation reconcile")
+    finally:
+        chaotic.close()
+        kubectl.close()
+        mgr.stop()
