@@ -125,6 +125,66 @@ def run_simulated(report_dir: str) -> list[dict]:
     return results
 
 
+def run_against_server(report_dir: str, server: str) -> list[dict]:
+    """Third mode: the same 5 configs over REAL HTTP against a running
+    apiserver (start one with ``python -m kubeflow_tpu.main
+    --serve-apiserver PORT --simulate-kubelet``) — transport latency and
+    server-side admission included, symmetric with loadtest --server."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from kubeflow_tpu.api import types as api
+    from kubeflow_tpu.cluster.http_client import HttpApiClient
+    from kubeflow_tpu.utils import names
+
+    client = HttpApiClient(server)
+    results = []
+    try:
+        for cfg in CONFIGS:
+            t0 = time.monotonic()
+            errors: list[str] = []
+            client.create(api.new_notebook(cfg["name"], NAMESPACE,
+                                           annotations=cfg["annotations"]
+                                           or None))
+            deadline = time.monotonic() + TIMEOUT_S
+            ready = False
+            while time.monotonic() < deadline:
+                cur = client.get_or_none(api.KIND, NAMESPACE, cfg["name"])
+                cond = api.get_condition(cur, api.CONDITION_SLICE_READY) \
+                    if cur else None
+                if cond and cond["status"] == "True":
+                    ready = True
+                    break
+                time.sleep(0.2)
+            if not ready:
+                errors.append(f"SliceReady != True within {TIMEOUT_S}s")
+            stss = [s for s in client.list("StatefulSet", NAMESPACE)
+                    if s["metadata"]["labels"].get("notebook-name")
+                    == cfg["name"]]
+            if stss:
+                _check_rendered(stss[0], cfg, errors)
+            else:
+                errors.append("no StatefulSet found")
+            if cfg.get("cull"):
+                client.patch(api.KIND, NAMESPACE, cfg["name"], {
+                    "metadata": {"annotations": {names.STOP_ANNOTATION: "1"}}})
+                deadline = time.monotonic() + TIMEOUT_S
+                while time.monotonic() < deadline:
+                    pods = [p for p in client.list("Pod", NAMESPACE)
+                            if p["metadata"]["labels"].get("notebook-name")
+                            == cfg["name"]]
+                    if not pods:
+                        break
+                    time.sleep(0.2)
+                else:
+                    errors.append("pods survived slice-atomic cull")
+            client.delete(api.KIND, NAMESPACE, cfg["name"])
+            results.append({"config": cfg["name"], "passed": not errors,
+                            "errors": errors,
+                            "duration_s": round(time.monotonic() - t0, 3)})
+    finally:
+        client.close()
+    return results
+
+
 def _kubectl(*args: str, input_: str | None = None) -> str:
     out = subprocess.run(["kubectl", *args], capture_output=True, text=True,
                          input=input_, check=False)
@@ -175,11 +235,17 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--simulate", action="store_true",
                     help="run against the in-process control plane (CI mode)")
+    ap.add_argument("--server", default=None,
+                    help="run over HTTP against a running apiserver URL")
     ap.add_argument("--report-dir", default="/tmp/kf-conformance")
     args = ap.parse_args()
     os.makedirs(args.report_dir, exist_ok=True)
-    results = run_simulated(args.report_dir) if args.simulate \
-        else run_in_cluster(args.report_dir)
+    if args.simulate:
+        results = run_simulated(args.report_dir)
+    elif args.server:
+        results = run_against_server(args.report_dir, args.server)
+    else:
+        results = run_in_cluster(args.report_dir)
     report = {"suite": "notebook-tpu-conformance",
               "passed": all(r["passed"] for r in results),
               "results": results}
