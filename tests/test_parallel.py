@@ -246,7 +246,7 @@ def test_ring_attention_gradients_match_reference():
 def test_ulysses_attention_gradients_match_reference():
     from kubeflow_tpu.parallel.ulysses import ulysses_attention
     # ulysses constraint: per-device heads (h/tp) divisible by sp
-    mesh = build_mesh(MeshConfig(sp=2, tp=2))
+    mesh = build_mesh(MeshConfig(dp=2, sp=2, tp=2))
     b, s, h, d = 2, 32, 8, 16
     keys = jax.random.split(jax.random.key(21), 3)
     q, k, v = (jax.random.normal(kk, (b, s, h, d), jnp.float32)
