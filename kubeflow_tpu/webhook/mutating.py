@@ -299,16 +299,24 @@ class NotebookMutatingWebhook:
 
     # ----------------------------------------------------- elyra (stage 4)
     def _mount_elyra_secret(self, nb: dict) -> None:
-        """Mount the Elyra runtime Secret when pipeline-secret sync is on and
-        the extension reconciler has materialized it (reference
-        SyncElyraRuntimeConfigSecret + Mount, :421-437)."""
+        """Sync then mount the Elyra runtime Secret when pipeline-secret
+        sync is on (reference SyncElyraRuntimeConfigSecret + Mount,
+        :421-437). The webhook syncs BEFORE mounting so the first notebook
+        in a namespace already gets the mount — the reference's
+        RHOAIENG-24545 race fix (notebook_dspa_secret.go:307-312)."""
+        from ..cluster import errors
         from ..controllers import elyra
         if not self.config.set_pipeline_secret:
             return
-        if self.client.get_or_none("Secret", k8s.namespace(nb),
-                                   elyra.SECRET_NAME) is None:
-            return
-        elyra.mount_elyra_secret(nb)
+        try:
+            elyra.sync_elyra_runtime_secret(self.client, self.config,
+                                            k8s.namespace(nb))
+        except errors.ApiError as e:
+            # supplemental integration: a write conflict with the extension
+            # reconciler's concurrent sync must not fail admission — the
+            # reconciler converges the secret on its next pass
+            log.warning("elyra secret sync skipped during admission: %s", e)
+        elyra.mount_elyra_secret(self.client, nb)
 
     # ---------------------------------------------------- mlflow (stage 4)
     def _inject_mlflow_env(self, nb: dict) -> None:

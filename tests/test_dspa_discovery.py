@@ -57,7 +57,18 @@ def dspa(name="dspa", ns="proj"):
             "metadata": {"name": name, "namespace": ns},
             "spec": {"objectStorage": {"externalStorage": {
                 "host": "s3.example.com", "bucket": "pipelines",
-                "s3CredentialsSecret": {"secretName": "s3-creds"}}}}}
+                "s3CredentialsSecret": {
+                    "secretName": "s3-creds",
+                    "accessKey": "AWS_ACCESS_KEY_ID",
+                    "secretKey": "AWS_SECRET_ACCESS_KEY"}}}}}
+
+
+def cos_secret(ns="proj", name="s3-creds"):
+    b64 = lambda s: base64.b64encode(s.encode()).decode()  # noqa: E731
+    return {"kind": "Secret", "apiVersion": "v1",
+            "metadata": {"name": name, "namespace": ns},
+            "data": {"AWS_ACCESS_KEY_ID": b64("minio-user"),
+                     "AWS_SECRET_ACCESS_KEY": b64("minio-pass")}}
 
 
 def test_gateway_listener_hostname_wins(store):
@@ -106,6 +117,7 @@ def test_secret_content_carries_discovered_endpoint(store):
     """End-to-end: DSPA + Gateway → secret JSON with the discovered public
     endpoint in the reference's /external/elyra/<ns> shape."""
     store.create(gateway(listeners=[{"hostname": "gw.apps.example.com"}]))
+    store.create(cos_secret())
     store.create(dspa())
     assert elyra.sync_elyra_runtime_secret(store, config(), "proj")
     runtime = decoded_secret(store)
@@ -117,10 +129,13 @@ def test_secret_content_carries_discovered_endpoint(store):
     assert md["cos_endpoint"] == "https://s3.example.com"
     assert md["cos_bucket"] == "pipelines"
     assert md["cos_secret"] == "s3-creds"
+    assert md["cos_username"] == "minio-user"
+    assert md["cos_password"] == "minio-pass"
     assert runtime["schema_name"] == "kfp"
 
 
 def test_secret_omits_public_endpoint_without_hostname(store):
+    store.create(cos_secret())
     store.create(dspa())
     assert elyra.sync_elyra_runtime_secret(store, config(), "proj")
     md = decoded_secret(store)["metadata"]
@@ -130,6 +145,7 @@ def test_secret_omits_public_endpoint_without_hostname(store):
 
 def test_secret_updates_when_gateway_appears(store):
     """Level-based: a Gateway arriving later re-syncs the secret content."""
+    store.create(cos_secret())
     store.create(dspa())
     elyra.sync_elyra_runtime_secret(store, config(), "proj")
     store.create(gateway(listeners=[{"hostname": "late.example.com"}]))

@@ -1,0 +1,512 @@
+"""Deep Elyra/DSPA integration spec.
+
+Mirrors the behavior inventory of the reference's
+``notebook_dspa_secret_test.go`` (1,104 lines): GatewayConfig owner
+extraction, the hostname fallback chain, extractElyraRuntimeConfigInfo's
+full validation-error matrix (including COS-secret fetch + key checks),
+SyncElyraRuntimeConfigSecret's graceful-skip / create / update / label-repair
+paths, and MountElyraRuntimeConfigSecret's managed-by/empty-data gating and
+per-container dedup.
+"""
+
+import base64
+import json
+
+import pytest
+
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.controllers import elyra
+from kubeflow_tpu.utils.config import ControllerConfig
+
+GW_NS = "openshift-ingress"
+GW_NAME = "data-science-gateway"
+NS = "proj"
+
+
+def b64(s: str) -> str:
+    return base64.b64encode(s.encode()).decode()
+
+
+@pytest.fixture
+def store():
+    return ClusterStore()
+
+
+def config(**kw):
+    return ControllerConfig(gateway_name=GW_NAME, gateway_namespace=GW_NS,
+                            **kw)
+
+
+def gateway(listeners=None, owners=None):
+    gw = {"kind": "Gateway",
+          "apiVersion": "gateway.networking.k8s.io/v1",
+          "metadata": {"name": GW_NAME, "namespace": GW_NS},
+          "spec": {"listeners": [] if listeners is None else listeners}}
+    if owners:
+        gw["metadata"]["ownerReferences"] = owners
+    return gw
+
+
+def owner_ref(kind, name):
+    return {"kind": kind, "name": name, "uid": f"uid-{kind}-{name}",
+            "apiVersion": "v1"}
+
+
+def route(name, host, owners):
+    return {"kind": "Route", "apiVersion": "route.openshift.io/v1",
+            "metadata": {"name": name, "namespace": GW_NS,
+                         "ownerReferences": owners},
+            "spec": {"host": host}}
+
+
+def cos_secret(ns=NS, name="s3-creds", data=None):
+    if data is None:
+        data = {"AWS_ACCESS_KEY_ID": b64("minio-user"),
+                "AWS_SECRET_ACCESS_KEY": b64("minio-pass")}
+    return {"kind": "Secret", "apiVersion": "v1",
+            "metadata": {"name": name, "namespace": ns}, "data": data}
+
+
+def dspa(name="dspa", ns=NS, spec=None, status=None):
+    obj = {"kind": "DataSciencePipelinesApplication",
+           "apiVersion":
+               "datasciencepipelinesapplications.opendatahub.io/v1alpha1",
+           "metadata": {"name": name, "namespace": ns},
+           "spec": spec if spec is not None else {
+               "objectStorage": {"externalStorage": {
+                   "host": "s3.example.com", "bucket": "pipelines",
+                   "s3CredentialsSecret": {
+                       "secretName": "s3-creds",
+                       "accessKey": "AWS_ACCESS_KEY_ID",
+                       "secretKey": "AWS_SECRET_ACCESS_KEY"}}}}}
+    if status is not None:
+        obj["status"] = status
+    return obj
+
+
+def decoded_secret(store, ns=NS):
+    secret = store.get("Secret", ns, elyra.SECRET_NAME)
+    return json.loads(base64.b64decode(secret["data"]["odh_dsp.json"]))
+
+
+# ------------------------------------------------- GatewayConfig owner name
+class TestGatewayConfigOwner:
+    """Reference getGatewayConfigOwnerName specs
+    (notebook_dspa_secret_test.go:34-98)."""
+
+    def test_no_owner_references(self):
+        assert elyra._gateway_config_owner(gateway()) == ""
+
+    def test_owner_references_without_gatewayconfig(self):
+        gw = gateway(owners=[owner_ref("Deployment", "some-deploy"),
+                             owner_ref("ConfigMap", "some-cm")])
+        assert elyra._gateway_config_owner(gw) == ""
+
+    def test_gatewayconfig_owner_found(self):
+        gw = gateway(owners=[owner_ref("GatewayConfig", "default-gateway")])
+        assert elyra._gateway_config_owner(gw) == "default-gateway"
+
+    def test_gatewayconfig_among_multiple_owners(self):
+        gw = gateway(owners=[owner_ref("Deployment", "other"),
+                             owner_ref("GatewayConfig", "default-gateway"),
+                             owner_ref("Service", "svc")])
+        assert elyra._gateway_config_owner(gw) == "default-gateway"
+
+
+# ------------------------------------------- hostname for public endpoint
+class TestHostnameDiscovery:
+    """Reference getHostnameForPublicEndpoint + getHostnameFromRoute specs
+    (notebook_dspa_secret_test.go:100-493)."""
+
+    def test_no_gateway_returns_empty(self, store):
+        assert elyra.discover_public_hostname(store, config()) == ""
+
+    def test_hostname_from_first_listener(self, store):
+        store.create(gateway(listeners=[{"hostname": "gw.apps.example.com"},
+                                        {"hostname": "second.example.com"}]))
+        assert elyra.discover_public_hostname(store, config()) == \
+            "gw.apps.example.com"
+
+    def test_route_fallback_when_listeners_empty(self, store):
+        store.create(gateway(
+            listeners=[], owners=[owner_ref("GatewayConfig", "gc")]))
+        store.create(route("r", "route.apps.example.com",
+                           [owner_ref("GatewayConfig", "gc")]))
+        assert elyra.discover_public_hostname(store, config()) == \
+            "route.apps.example.com"
+
+    def test_route_fallback_when_listener_hostname_missing(self, store):
+        store.create(gateway(
+            listeners=[{}], owners=[owner_ref("GatewayConfig", "gc")]))
+        store.create(route("r", "route.apps.example.com",
+                           [owner_ref("GatewayConfig", "gc")]))
+        assert elyra.discover_public_hostname(store, config()) == \
+            "route.apps.example.com"
+
+    def test_route_fallback_when_listener_hostname_empty(self, store):
+        store.create(gateway(
+            listeners=[{"hostname": ""}],
+            owners=[owner_ref("GatewayConfig", "gc")]))
+        store.create(route("r", "route.apps.example.com",
+                           [owner_ref("GatewayConfig", "gc")]))
+        assert elyra.discover_public_hostname(store, config()) == \
+            "route.apps.example.com"
+
+    def test_no_owner_and_no_hostname_returns_empty(self, store):
+        store.create(gateway(listeners=[{}]))
+        store.create(route("r", "route.apps.example.com",
+                           [owner_ref("GatewayConfig", "gc")]))
+        assert elyra.discover_public_hostname(store, config()) == ""
+
+    def test_route_fallback_finds_no_matching_route(self, store):
+        store.create(gateway(owners=[owner_ref("GatewayConfig", "gc")]))
+        store.create(route("r", "other.example.com",
+                           [owner_ref("GatewayConfig", "other-gc")]))
+        assert elyra.discover_public_hostname(store, config()) == ""
+
+    def test_gateway_hostname_preferred_over_route(self, store):
+        store.create(gateway(
+            listeners=[{"hostname": "gw.apps.example.com"}],
+            owners=[owner_ref("GatewayConfig", "gc")]))
+        store.create(route("r", "route.apps.example.com",
+                           [owner_ref("GatewayConfig", "gc")]))
+        assert elyra.discover_public_hostname(store, config()) == \
+            "gw.apps.example.com"
+
+    def test_route_without_owner_references_skipped(self, store):
+        store.create(gateway(owners=[owner_ref("GatewayConfig", "gc")]))
+        store.create({"kind": "Route", "apiVersion": "route.openshift.io/v1",
+                      "metadata": {"name": "r", "namespace": GW_NS},
+                      "spec": {"host": "route.apps.example.com"}})
+        assert elyra.discover_public_hostname(store, config()) == ""
+
+    def test_route_owner_not_gatewayconfig_kind_skipped(self, store):
+        store.create(gateway(owners=[owner_ref("GatewayConfig", "gc")]))
+        store.create(route("r", "route.apps.example.com",
+                           [owner_ref("Deployment", "gc")]))
+        assert elyra.discover_public_hostname(store, config()) == ""
+
+    def test_route_matching_owner_but_empty_host(self, store):
+        store.create(gateway(owners=[owner_ref("GatewayConfig", "gc")]))
+        store.create(route("r", "", [owner_ref("GatewayConfig", "gc")]))
+        assert elyra.discover_public_hostname(store, config()) == ""
+
+
+# --------------------------------------------- extract validation matrix
+class TestExtractValidation:
+    """Reference extractElyraRuntimeConfigInfo error matrix
+    (notebook_dspa_secret_test.go:495-791)."""
+
+    def extract(self, store, d):
+        return elyra.extract_runtime_config(d, config(), NS, store)
+
+    def expect_error(self, store, d, fragment):
+        with pytest.raises(elyra.IncompleteDSPAError, match=fragment):
+            self.extract(store, d)
+
+    def test_object_storage_missing(self, store):
+        self.expect_error(store, dspa(spec={}), "objectStorage")
+
+    def test_external_storage_missing(self, store):
+        self.expect_error(store, dspa(spec={"objectStorage": {}}),
+                          "externalStorage")
+
+    def test_host_empty(self, store):
+        d = dspa()
+        d["spec"]["objectStorage"]["externalStorage"]["host"] = ""
+        self.expect_error(store, d, "host")
+
+    def test_bucket_empty(self, store):
+        d = dspa()
+        d["spec"]["objectStorage"]["externalStorage"]["bucket"] = ""
+        self.expect_error(store, d, "bucket")
+
+    def test_credentials_secret_missing(self, store):
+        d = dspa()
+        del d["spec"]["objectStorage"]["externalStorage"][
+            "s3CredentialsSecret"]
+        self.expect_error(store, d, "s3CredentialsSecret")
+
+    def test_secret_name_empty(self, store):
+        d = dspa()
+        d["spec"]["objectStorage"]["externalStorage"][
+            "s3CredentialsSecret"]["secretName"] = ""
+        self.expect_error(store, d, "secretName")
+
+    def test_access_key_empty(self, store):
+        d = dspa()
+        d["spec"]["objectStorage"]["externalStorage"][
+            "s3CredentialsSecret"]["accessKey"] = ""
+        self.expect_error(store, d, "accessKey")
+
+    def test_secret_key_empty(self, store):
+        d = dspa()
+        d["spec"]["objectStorage"]["externalStorage"][
+            "s3CredentialsSecret"]["secretKey"] = ""
+        self.expect_error(store, d, "secretKey")
+
+    def test_cos_secret_not_found(self, store):
+        self.expect_error(store, dspa(), "not found")
+
+    def test_access_key_missing_from_secret(self, store):
+        store.create(cos_secret(
+            data={"AWS_SECRET_ACCESS_KEY": b64("minio-pass")}))
+        self.expect_error(store, dspa(), "AWS_ACCESS_KEY_ID")
+
+    def test_secret_key_missing_from_secret(self, store):
+        store.create(cos_secret(
+            data={"AWS_ACCESS_KEY_ID": b64("minio-user")}))
+        self.expect_error(store, dspa(), "AWS_SECRET_ACCESS_KEY")
+
+    def test_malformed_base64_credential_skips_gracefully(self, store):
+        store.create(cos_secret(
+            data={"AWS_ACCESS_KEY_ID": "%%%not-base64%%%",
+                  "AWS_SECRET_ACCESS_KEY": b64("p")}))
+        self.expect_error(store, dspa(), "unreadable")
+
+    def test_non_utf8_credential_skips_gracefully(self, store):
+        raw = base64.b64encode(b"\xff\xfe\x80").decode()
+        store.create(cos_secret(
+            data={"AWS_ACCESS_KEY_ID": raw,
+                  "AWS_SECRET_ACCESS_KEY": b64("p")}))
+        self.expect_error(store, dspa(), "unreadable")
+
+
+# ------------------------------------------------ extract content building
+class TestExtractContent:
+    """Reference extract content specs
+    (notebook_dspa_secret_test.go:792-1000)."""
+
+    def extract(self, store, d):
+        return elyra.extract_runtime_config(d, config(), NS, store)
+
+    def test_default_https_scheme(self, store):
+        store.create(cos_secret())
+        runtime = self.extract(store, dspa())
+        assert runtime["metadata"]["cos_endpoint"] == "https://s3.example.com"
+
+    def test_custom_scheme(self, store):
+        store.create(cos_secret())
+        d = dspa()
+        d["spec"]["objectStorage"]["externalStorage"]["scheme"] = "http"
+        runtime = self.extract(store, d)
+        assert runtime["metadata"]["cos_endpoint"] == "http://s3.example.com"
+
+    def test_api_endpoint_from_dspa_status(self, store):
+        store.create(cos_secret())
+        d = dspa(status={"components": {"apiServer": {
+            "externalUrl": "https://pipe.apps.example.com/pipeline"}}})
+        runtime = self.extract(store, d)
+        assert runtime["metadata"]["api_endpoint"] == \
+            "https://pipe.apps.example.com/pipeline"
+
+    def test_public_endpoint_with_gateway_hostname(self, store):
+        store.create(cos_secret())
+        store.create(gateway(listeners=[{"hostname": "gw.example.com"}]))
+        runtime = self.extract(store, dspa())
+        assert runtime["metadata"]["public_api_endpoint"] == \
+            f"https://gw.example.com/external/elyra/{NS}"
+
+    def test_no_public_endpoint_without_gateway(self, store):
+        store.create(cos_secret())
+        runtime = self.extract(store, dspa())
+        assert "public_api_endpoint" not in runtime["metadata"]
+
+    def test_public_endpoint_from_route_fallback(self, store):
+        store.create(cos_secret())
+        store.create(gateway(owners=[owner_ref("GatewayConfig", "gc")]))
+        store.create(route("r", "route.example.com",
+                           [owner_ref("GatewayConfig", "gc")]))
+        runtime = self.extract(store, dspa())
+        assert runtime["metadata"]["public_api_endpoint"] == \
+            f"https://route.example.com/external/elyra/{NS}"
+
+    def test_all_required_fields_populated(self, store):
+        store.create(cos_secret())
+        runtime = self.extract(store, dspa())
+        md = runtime["metadata"]
+        assert runtime["schema_name"] == "kfp"
+        assert runtime["display_name"] == "Pipeline"
+        assert md["engine"] == "Argo"
+        assert md["runtime_type"] == "KUBEFLOW_PIPELINES"
+        assert md["auth_type"] == "KUBERNETES_SERVICE_ACCOUNT_TOKEN"
+        assert md["cos_auth_type"] == "KUBERNETES_SECRET"
+        assert md["cos_bucket"] == "pipelines"
+        assert md["cos_secret"] == "s3-creds"
+        assert md["cos_username"] == "minio-user"
+        assert md["cos_password"] == "minio-pass"
+        assert md["tags"] == []
+
+    def test_string_data_credentials_accepted(self, store):
+        secret = {"kind": "Secret", "apiVersion": "v1",
+                  "metadata": {"name": "s3-creds", "namespace": NS},
+                  "stringData": {"AWS_ACCESS_KEY_ID": "u",
+                                 "AWS_SECRET_ACCESS_KEY": "p"}}
+        store.create(secret)
+        runtime = self.extract(store, dspa())
+        assert runtime["metadata"]["cos_username"] == "u"
+        assert runtime["metadata"]["cos_password"] == "p"
+
+
+# ---------------------------------------------------------- sync lifecycle
+class TestSyncLifecycle:
+    """Reference SyncElyraRuntimeConfigSecret specs
+    (notebook_dspa_secret_test.go:1002-1104) + the create/update/repair
+    paths of notebook_dspa_secret.go:336-399."""
+
+    def test_skips_when_dspa_absent(self, store):
+        assert not elyra.sync_elyra_runtime_secret(store, config(), NS)
+        assert store.get_or_none("Secret", NS, elyra.SECRET_NAME) is None
+
+    @pytest.mark.parametrize("spec", [
+        {},  # objectStorage nil
+        {"objectStorage": {}},  # externalStorage nil
+        {"objectStorage": {"externalStorage": {
+            "host": "h", "bucket": "b"}}},  # s3CredentialSecret nil
+    ])
+    def test_skips_gracefully_on_incomplete_dspa(self, store, spec):
+        store.create(dspa(spec=spec))
+        assert not elyra.sync_elyra_runtime_secret(store, config(), NS)
+        assert store.get_or_none("Secret", NS, elyra.SECRET_NAME) is None
+
+    def test_skips_when_cos_secret_missing(self, store):
+        store.create(dspa())
+        assert not elyra.sync_elyra_runtime_secret(store, config(), NS)
+
+    def test_creates_secret_owned_by_dspa(self, store):
+        store.create(cos_secret())
+        d = store.create(dspa())
+        assert elyra.sync_elyra_runtime_secret(store, config(), NS)
+        secret = store.get("Secret", NS, elyra.SECRET_NAME)
+        assert secret["metadata"]["labels"][elyra.MANAGED_BY_KEY] == \
+            elyra.MANAGED_BY_VALUE
+        owners = secret["metadata"]["ownerReferences"]
+        assert owners[0]["kind"] == "DataSciencePipelinesApplication"
+        assert owners[0]["uid"] == d["metadata"]["uid"]
+        # reference sets blockOwnerDeletion=false to avoid requiring
+        # delete permission on the DSPA (notebook_dspa_secret.go:353-362)
+        assert owners[0]["controller"] is True
+        assert owners[0]["blockOwnerDeletion"] is False
+
+    def test_updates_secret_on_content_drift(self, store):
+        store.create(cos_secret())
+        store.create(dspa())
+        elyra.sync_elyra_runtime_secret(store, config(), NS)
+        secret = store.get("Secret", NS, elyra.SECRET_NAME)
+        secret["data"] = {"odh_dsp.json": b64("{}")}
+        store.update(secret)
+        elyra.sync_elyra_runtime_secret(store, config(), NS)
+        assert decoded_secret(store)["schema_name"] == "kfp"
+
+    def test_repairs_stripped_managed_by_label(self, store):
+        store.create(cos_secret())
+        store.create(dspa())
+        elyra.sync_elyra_runtime_secret(store, config(), NS)
+        secret = store.get("Secret", NS, elyra.SECRET_NAME)
+        secret["metadata"]["labels"] = {"app.kubernetes.io/part-of": "x"}
+        store.update(secret)
+        elyra.sync_elyra_runtime_secret(store, config(), NS)
+        labels = store.get("Secret", NS, elyra.SECRET_NAME)["metadata"][
+            "labels"]
+        assert labels[elyra.MANAGED_BY_KEY] == elyra.MANAGED_BY_VALUE
+        # repair adds our key without clobbering foreign labels
+        assert labels["app.kubernetes.io/part-of"] == "x"
+
+    def test_no_update_when_content_stable(self, store):
+        store.create(cos_secret())
+        store.create(dspa())
+        elyra.sync_elyra_runtime_secret(store, config(), NS)
+        rv = store.get("Secret", NS, elyra.SECRET_NAME)["metadata"][
+            "resourceVersion"]
+        elyra.sync_elyra_runtime_secret(store, config(), NS)
+        assert store.get("Secret", NS, elyra.SECRET_NAME)["metadata"][
+            "resourceVersion"] == rv
+
+    def test_deletes_secret_when_dspa_removed(self, store):
+        store.create(cos_secret())
+        d = store.create(dspa())
+        elyra.sync_elyra_runtime_secret(store, config(), NS)
+        store.delete("DataSciencePipelinesApplication", NS,
+                     d["metadata"]["name"])
+        elyra.sync_elyra_runtime_secret(store, config(), NS)
+        assert store.get_or_none("Secret", NS, elyra.SECRET_NAME) is None
+
+
+# ----------------------------------------------------------------- mount
+def notebook(containers=None, volumes=None):
+    spec = {"containers": containers if containers is not None else
+            [{"name": "nb", "image": "img"}]}
+    if volumes is not None:
+        spec["volumes"] = volumes
+    return {"apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+            "metadata": {"name": "nb", "namespace": NS},
+            "spec": {"template": {"spec": spec}}}
+
+
+def managed_secret(store):
+    store.create(cos_secret())
+    store.create(dspa())
+    assert elyra.sync_elyra_runtime_secret(store, config(), NS)
+
+
+class TestMount:
+    """Reference MountElyraRuntimeConfigSecret specs
+    (notebook_dspa_secret.go:403-469)."""
+
+    def test_skips_when_secret_absent(self, store):
+        nb = notebook()
+        elyra.mount_elyra_secret(store, nb)
+        assert "volumes" not in nb["spec"]["template"]["spec"]
+
+    def test_skips_unmanaged_secret(self, store):
+        store.create({"kind": "Secret", "apiVersion": "v1",
+                      "metadata": {"name": elyra.SECRET_NAME,
+                                   "namespace": NS},
+                      "data": {"odh_dsp.json": b64("{}")}})
+        nb = notebook()
+        elyra.mount_elyra_secret(store, nb)
+        assert "volumes" not in nb["spec"]["template"]["spec"]
+
+    def test_skips_empty_secret(self, store):
+        store.create({"kind": "Secret", "apiVersion": "v1",
+                      "metadata": {"name": elyra.SECRET_NAME,
+                                   "namespace": NS,
+                                   "labels": {elyra.MANAGED_BY_KEY:
+                                              elyra.MANAGED_BY_VALUE}},
+                      "data": {}})
+        nb = notebook()
+        elyra.mount_elyra_secret(store, nb)
+        assert "volumes" not in nb["spec"]["template"]["spec"]
+
+    def test_mounts_volume_and_every_container(self, store):
+        managed_secret(store)
+        nb = notebook(containers=[{"name": "nb", "image": "img"},
+                                  {"name": "sidecar", "image": "proxy"}])
+        elyra.mount_elyra_secret(store, nb)
+        spec = nb["spec"]["template"]["spec"]
+        assert spec["volumes"] == [{
+            "name": elyra.VOLUME_NAME,
+            "secret": {"secretName": elyra.SECRET_NAME, "optional": True}}]
+        for c in spec["containers"]:
+            assert any(m["mountPath"] == elyra.MOUNT_PATH
+                       for m in c["volumeMounts"])
+
+    def test_mount_idempotent(self, store):
+        managed_secret(store)
+        nb = notebook()
+        elyra.mount_elyra_secret(store, nb)
+        elyra.mount_elyra_secret(store, nb)
+        spec = nb["spec"]["template"]["spec"]
+        assert len(spec["volumes"]) == 1
+        assert len(spec["containers"][0]["volumeMounts"]) == 1
+
+    def test_mount_dedupes_by_path_even_with_foreign_name(self, store):
+        managed_secret(store)
+        nb = notebook(containers=[{
+            "name": "nb", "image": "img",
+            "volumeMounts": [{"name": "user-runtimes",
+                              "mountPath": elyra.MOUNT_PATH}]}])
+        elyra.mount_elyra_secret(store, nb)
+        mounts = nb["spec"]["template"]["spec"]["containers"][0][
+            "volumeMounts"]
+        assert len(mounts) == 1 and mounts[0]["name"] == "user-runtimes"
