@@ -218,10 +218,16 @@ def sync_elyra_runtime_secret(client, config: ControllerConfig,
     reference :383-397)."""
     dspas = client.list("DataSciencePipelinesApplication", namespace)
     if not dspas:
-        try:
-            client.delete("Secret", namespace, SECRET_NAME)
-        except errors.NotFoundError:
-            pass
+        existing = client.get_or_none("Secret", namespace, SECRET_NAME)
+        if existing is not None and k8s.get_in(
+                existing, "metadata", "labels", MANAGED_BY_KEY,
+                default=None) == MANAGED_BY_VALUE:
+            # only OUR projection dies with the DSPA — a foreign secret
+            # that happens to share the name is never touched
+            try:
+                client.delete("Secret", namespace, SECRET_NAME)
+            except errors.NotFoundError:
+                pass
         return False
     dspa = sorted(dspas, key=k8s.name)[0]
     try:

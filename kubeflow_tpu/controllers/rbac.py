@@ -13,7 +13,32 @@ from ..utils import k8s, names
 
 PIPELINE_ROLE = "ds-pipeline-user-access-dspa"
 MLFLOW_CLUSTER_ROLE = "mlflow-operator-mlflow-integration"
+MLFLOW_IDENTIFIER = "mlflow"
+MLFLOW_TRACKING_AUTH_VALUE = "kubernetes-namespaced"
 MLFLOW_REQUEUE_SECONDS = 30.0
+
+
+def get_mlflow_tracking_uri(client, config, instance: str) -> str | None:
+    """Tracking URI for an MLflow instance (reference getMLflowTrackingURI,
+    notebook_mlflow.go:100-143): the configured GATEWAY_URL bypasses Gateway
+    lookup; otherwise the hostname comes from the Gateway→Route discovery
+    chain. Path segment is ``mlflow`` for the default instance, else
+    ``mlflow-<instance>``; a hostname without a scheme gets ``https://``
+    prepended, an existing http(s) scheme is preserved. Returns None when
+    no hostname is determinable (caller skips URI injection)."""
+    from . import elyra
+
+    hostname = config.gateway_url
+    if not hostname:
+        hostname = elyra.discover_public_hostname(client, config)
+    if not hostname:
+        return None
+    segment = MLFLOW_IDENTIFIER
+    if instance and instance != MLFLOW_IDENTIFIER:
+        segment = f"{MLFLOW_IDENTIFIER}-{instance}"
+    if hostname.startswith(("https://", "http://")):
+        return f"{hostname}/{segment}"
+    return f"https://{hostname}/{segment}"
 
 
 def pipeline_rb_name(nb_name: str) -> str:
@@ -96,7 +121,10 @@ def reconcile_mlflow_integration(client, notebook: dict,
     Warning event on the CR, notebook_mlflow.go:236-270); None when converged
     or not requested."""
     ns = k8s.namespace(notebook)
-    instance = k8s.get_annotation(notebook, names.MLFLOW_INSTANCE_ANNOTATION)
+    # trimmed, like the webhook (reference getMLflowInstanceAnnotation) —
+    # a whitespace-only value must not diverge between the two paths
+    instance = (k8s.get_annotation(
+        notebook, names.MLFLOW_INSTANCE_ANNOTATION) or "").strip()
     if not instance:
         try:
             client.delete("RoleBinding", ns,
@@ -118,4 +146,17 @@ def reconcile_mlflow_integration(client, notebook: dict,
             client.create(desired)
         except errors.AlreadyExistsError:
             pass
+        return None
+    # repair drift in subjects/labels/ownerRefs in place, preserving
+    # resourceVersion (reference needsUpdate, notebook_mlflow.go:336-357;
+    # roleRef is immutable so it is never touched)
+    getters = (lambda o: o.get("subjects"),
+               lambda o: k8s.get_in(o, "metadata", "labels"),
+               lambda o: k8s.get_in(o, "metadata", "ownerReferences"))
+    if any(g(existing) != g(desired) for g in getters):
+        existing["subjects"] = desired["subjects"]
+        existing["metadata"]["labels"] = desired["metadata"]["labels"]
+        existing["metadata"]["ownerReferences"] = \
+            desired["metadata"]["ownerReferences"]
+        client.update(existing)
     return None

@@ -4,62 +4,117 @@ Reference: odh notebook_runtime.go:40-285 — scrape ImageStreams labeled
 ``opendatahub.io/runtime-image`` in the controller namespace, extract each
 tag's runtime metadata, and materialize a per-user-namespace
 ``pipeline-runtime-images`` ConfigMap (key = sanitized display name +
-``.json``) that the webhook mounts at /opt/app-root/pipeline-runtimes."""
+``.json``) that the webhook mounts at /opt/app-root/pipeline-runtimes.
+"""
 
 from __future__ import annotations
 
 import json
+import logging
 import re
 
 from ..cluster import errors
 from ..utils import k8s
 
+log = logging.getLogger("kubeflow_tpu.runtime_images")
+
 RUNTIME_IMAGE_LABEL = "opendatahub.io/runtime-image"
+METADATA_ANNOTATION = "opendatahub.io/runtime-image-metadata"
 CONFIGMAP_NAME = "pipeline-runtime-images"
 
-_key_re = re.compile(r"[^a-zA-Z0-9-_.]")
+_invalid_chars = re.compile(r"[^-._a-zA-Z0-9]+")
+_multi_dash = re.compile(r"-+")
 
 
 def format_key_name(display_name: str) -> str:
     """Sanitize a display name into a ConfigMap key (reference
-    formatKeyName: spaces → dashes, strip invalid chars, append .json)."""
-    cleaned = _key_re.sub("", display_name.replace(" ", "-")).strip("-.")
-    return f"{cleaned or 'runtime'}.json"
+    formatKeyName, notebook_runtime.go:174-182): lowercase, invalid-char
+    runs → ``-``, dash runs collapsed, trimmed; returns "" for an
+    all-invalid name (caller skips the entry)."""
+    s = _invalid_chars.sub("-", display_name.lower())
+    s = _multi_dash.sub("-", s).strip("-")
+    return f"{s}.json" if s else ""
+
+
+def parse_runtime_image_metadata(raw: str, image_url: str) -> str:
+    """First object of the metadata JSON array with ``metadata.image_name``
+    set to the tag's image reference (reference parseRuntimeImageMetadata,
+    notebook_runtime.go:185-208); ``{}`` when unparseable or empty."""
+    try:
+        meta_list = json.loads(raw)
+    except ValueError:
+        return "{}"
+    if not isinstance(meta_list, list) or not meta_list or \
+            not isinstance(meta_list[0], dict):
+        return "{}"
+    first = meta_list[0]
+    if isinstance(first.get("metadata"), dict):
+        first["metadata"]["image_name"] = image_url
+    return json.dumps(first, sort_keys=True)
+
+
+def extract_display_name(metadata_json: str) -> str:
+    """``display_name`` of a parsed entry, "" when absent/not a string
+    (reference extractDisplayName, notebook_runtime.go:154-165)."""
+    try:
+        meta = json.loads(metadata_json)
+    except ValueError:
+        return ""
+    display = meta.get("display_name") if isinstance(meta, dict) else None
+    return display if isinstance(display, str) else ""
 
 
 def collect_runtime_images(client, controller_namespace: str) -> dict[str, str]:
-    """ImageStreams → {key: metadata-json}. Each tag may carry an
-    ``opendatahub.io/runtime-image-metadata`` annotation with the Elyra
-    runtime definition (reference parseRuntimeImageMetadata)."""
+    """ImageStreams → {key: metadata-json} (reference
+    SyncRuntimeImagesConfigMap's scrape loop, notebook_runtime.go:46-92):
+    only streams labeled runtime-image=true; a labeled stream without tags
+    or a tag without a ``from`` image reference is a logged
+    misconfiguration; entries without a display_name are skipped."""
     out: dict[str, str] = {}
     for stream in client.list("ImageStream", controller_namespace,
                               {RUNTIME_IMAGE_LABEL: "true"}):
-        for tag in k8s.get_in(stream, "spec", "tags", default=[]) or []:
-            raw = k8s.get_in(tag, "annotations",
-                             "opendatahub.io/runtime-image-metadata")
-            if not raw:
+        tags = k8s.get_in(stream, "spec", "tags", default=[]) or []
+        if not tags:
+            log.error("ImageStream %s labeled as runtime-image has no tags "
+                      "- possible misconfiguration", k8s.name(stream))
+            continue
+        for tag in tags:
+            image_url = k8s.get_in(tag, "from", "name", default="")
+            if not image_url:
+                log.error("Failed to extract image URL from ImageStream %s "
+                          "tag %s", k8s.name(stream), tag.get("name", ""))
                 continue
-            try:
-                meta_list = json.loads(raw)
-            except ValueError:
+            raw = k8s.get_in(tag, "annotations", METADATA_ANNOTATION) or "[]"
+            parsed = parse_runtime_image_metadata(raw, image_url)
+            display = extract_display_name(parsed)
+            if not display:
                 continue
-            entries = meta_list if isinstance(meta_list, list) else [meta_list]
-            for meta in entries:
-                display = meta.get("display_name") or k8s.name(stream)
-                out[format_key_name(display)] = json.dumps(meta,
-                                                           sort_keys=True)
+            key = format_key_name(display)
+            if not key:
+                log.error("Failed to construct ConfigMap key name for "
+                          "ImageStream %s tag %s", k8s.name(stream),
+                          tag.get("name", ""))
+                continue
+            out[key] = parsed
     return out
 
 
 def sync_runtime_images_config_map(client, controller_namespace: str,
                                    user_namespace: str) -> None:
-    """Reference SyncRuntimeImagesConfigMap: per-user-namespace projection of
-    the controller-namespace image inventory."""
+    """Reference SyncRuntimeImagesConfigMap (notebook_runtime.go:95-151):
+    per-user-namespace projection of the controller-namespace inventory.
+    With no runtime images found, an existing ConfigMap is deliberately
+    LEFT AS IS (the reference chose not to delete, :109-117) and no empty
+    ConfigMap is created."""
     data = collect_runtime_images(client, controller_namespace)
     existing = client.get_or_none("ConfigMap", user_namespace, CONFIGMAP_NAME)
     if not data:
-        if existing is not None:
-            client.delete("ConfigMap", user_namespace, CONFIGMAP_NAME)
+        if existing is None:
+            log.info("No runtime images found. Skipping creation of empty "
+                     "ConfigMap.")
+        else:
+            log.info("Data is empty but the ConfigMap already exists. "
+                     "Leaving it as is.")
         return
     if existing is None:
         try:

@@ -255,9 +255,21 @@ class NotebookMutatingWebhook:
 
     # --------------------------------------------- runtime images (stage 4)
     def _mount_runtime_images(self, nb: dict) -> None:
-        """Mount the per-namespace pipeline-runtime-images ConfigMap
-        (reference MountPipelineRuntimeImages, notebook_runtime.go:200-285)."""
+        """Sync then mount the per-namespace pipeline-runtime-images
+        ConfigMap (reference Handle runs SyncRuntimeImagesConfigMap before
+        MountPipelineRuntimeImages, notebook_mutating_webhook.go:405-418,
+        so the FIRST notebook in a namespace already gets the mount)."""
+        from ..cluster import errors
+        from ..controllers import runtime_images
         ns = k8s.namespace(nb)
+        try:
+            runtime_images.sync_runtime_images_config_map(
+                self.client, self.config.controller_namespace, ns)
+        except errors.ApiError as e:
+            # supplemental: a conflict with the extension reconciler's
+            # concurrent sync must not fail admission
+            log.warning("runtime-images sync skipped during admission: %s",
+                        e)
         cm = self.client.get_or_none("ConfigMap", ns, RUNTIME_IMAGES_CONFIGMAP)
         pod_spec = api.notebook_pod_spec(nb)
         container = api.notebook_container(nb)
@@ -289,10 +301,13 @@ class NotebookMutatingWebhook:
             k8s.remove_volume(pod_spec, "feast-config")
             k8s.remove_volume_mount(container, "feast-config")
             return
+        # deliberately NOT optional: if the Feast ConfigMap is missing the
+        # pod must fail to start, surfacing the misconfiguration (reference
+        # mounts the CM without optional, notebook_feast_config.go:60-70,
+        # asserted in notebook_feast_config_test.go:513-564)
         k8s.upsert_volume(pod_spec, {
             "name": "feast-config",
-            "configMap": {"name": f"{k8s.name(nb)}-feast-config",
-                          "optional": True},
+            "configMap": {"name": f"{k8s.name(nb)}-feast-config"},
         })
         k8s.upsert_volume_mount(container, {
             "name": "feast-config", "mountPath": FEAST_MOUNT, "readOnly": True})
@@ -321,21 +336,34 @@ class NotebookMutatingWebhook:
     # ---------------------------------------------------- mlflow (stage 4)
     def _inject_mlflow_env(self, nb: dict) -> None:
         """Annotation-gated MLflow env injection (reference
-        HandleMLflowEnvVars, notebook_mlflow.go:287-322)."""
+        HandleMLflowEnvVars, notebook_mlflow.go:273-324): a present,
+        non-empty (trimmed) instance annotation injects
+        MLFLOW_K8S_INTEGRATION=true and
+        MLFLOW_TRACKING_AUTH=kubernetes-namespaced unconditionally;
+        MLFLOW_TRACKING_URI only when a hostname is determinable (else it
+        is removed, never failing admission — integration is optional)."""
+        from ..controllers import rbac
         container = api.notebook_container(nb)
         if container is None:
             return
-        instance = k8s.get_annotation(nb, names.MLFLOW_INSTANCE_ANNOTATION)
+        instance = (k8s.get_annotation(
+            nb, names.MLFLOW_INSTANCE_ANNOTATION) or "").strip()
         if not self.config.mlflow_enabled or not instance:
             for var in ("MLFLOW_TRACKING_URI", "MLFLOW_K8S_INTEGRATION",
                         "MLFLOW_TRACKING_AUTH"):
                 k8s.remove_env(container, var)
             return
-        gateway = self.config.gateway_url or "gateway.invalid"
-        k8s.upsert_env(container, "MLFLOW_TRACKING_URI",
-                       f"https://{gateway}/mlflow/{instance}")
         k8s.upsert_env(container, "MLFLOW_K8S_INTEGRATION", "true")
-        k8s.upsert_env(container, "MLFLOW_TRACKING_AUTH", "oidc")
+        k8s.upsert_env(container, "MLFLOW_TRACKING_AUTH",
+                       rbac.MLFLOW_TRACKING_AUTH_VALUE)
+        uri = rbac.get_mlflow_tracking_uri(self.client, self.config,
+                                           instance)
+        if uri is None:
+            log.warning("unable to determine MLflow tracking URI, "
+                        "skipping injection")
+            k8s.remove_env(container, "MLFLOW_TRACKING_URI")
+            return
+        k8s.upsert_env(container, "MLFLOW_TRACKING_URI", uri)
 
     # ---------------------------------------- cluster proxy env (stage 4)
     def _inject_cluster_proxy_env(self, nb: dict) -> None:

@@ -422,6 +422,16 @@ class TestSyncLifecycle:
         assert store.get("Secret", NS, elyra.SECRET_NAME)["metadata"][
             "resourceVersion"] == rv
 
+    def test_foreign_secret_never_deleted(self, store):
+        """A user-owned Secret that happens to share the name survives the
+        no-DSPA cleanup path (only our managed projection is deleted)."""
+        store.create({"kind": "Secret", "apiVersion": "v1",
+                      "metadata": {"name": elyra.SECRET_NAME,
+                                   "namespace": NS},
+                      "data": {"user": b64("data")}})
+        assert not elyra.sync_elyra_runtime_secret(store, config(), NS)
+        assert store.get("Secret", NS, elyra.SECRET_NAME)
+
     def test_deletes_secret_when_dspa_removed(self, store):
         store.create(cos_secret())
         d = store.create(dspa())

@@ -224,10 +224,12 @@ def test_runtime_images_synced_to_user_namespace(world):
                      "labels": {"opendatahub.io/runtime-image": "true"}},
         "spec": {"tags": [{
             "name": "2024a",
+            "from": {"kind": "DockerImage",
+                     "name": "quay.io/org/spark@sha256:def"},
             "annotations": {"opendatahub.io/runtime-image-metadata":
                             '[{"display_name": "Datascience with Spark"}]'},
         }]},
     })
     create_nb(store, mgr)
     cm = store.get("ConfigMap", "user-ns", "pipeline-runtime-images")
-    assert "Datascience-with-Spark.json" in cm["data"]
+    assert "datascience-with-spark.json" in cm["data"]

@@ -1,0 +1,237 @@
+"""Deep Feast-config spec.
+
+Mirrors the behavior inventory of the reference's
+``notebook_feast_config_test.go`` (740 lines): the isFeastEnabled label
+matrix, mount/update/unmount mechanics per container, and the admission
+integration cycle (enable → mount, missing ConfigMap still mounts by
+design, disable → unmount, pre-mounted volume with label off on create →
+unmounted).
+
+The mount targets the notebook container by the shared convention
+(name-match else containers[0], api/types.py:75-83); the reference errors
+when no container matches the CR name — our fallback-to-first keeps webhook
+stages total, which the last test pins.
+"""
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.utils import names
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webhook.mutating import NotebookMutatingWebhook
+
+NS = "proj"
+VOL = "feast-config"
+MOUNT_PATH = "/opt/app-root/src/feast-config"
+
+
+@pytest.fixture
+def store():
+    return ClusterStore()
+
+
+@pytest.fixture
+def webhook(store):
+    return NotebookMutatingWebhook(store, ControllerConfig())
+
+
+def notebook(name="nb", labels=None, containers=None, volumes=None,
+             annotations=None):
+    spec = {"containers": containers if containers is not None else
+            [{"name": name, "image": "img"}]}
+    if volumes is not None:
+        spec["volumes"] = volumes
+    nb = {"apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+          "metadata": {"name": name, "namespace": NS},
+          "spec": {"template": {"spec": spec}}}
+    if labels is not None:
+        nb["metadata"]["labels"] = labels
+    if annotations is not None:
+        nb["metadata"]["annotations"] = annotations
+    return nb
+
+
+def admit(webhook, nb, operation="CREATE", old=None):
+    return webhook.handle(operation, nb, old)
+
+
+def feast_volume(nb):
+    return [v for v in api.notebook_pod_spec(nb).get("volumes", [])
+            if v["name"] == VOL]
+
+
+def feast_mounts(container):
+    return [m for m in container.get("volumeMounts", [])
+            if m["name"] == VOL]
+
+
+# ----------------------------------------------------- label gating matrix
+class TestFeastEnabled:
+    """Reference isFeastEnabled specs (notebook_feast_config_test.go:45-111)."""
+
+    def test_label_absent(self, webhook):
+        out = admit(webhook, notebook())
+        assert not feast_volume(out)
+
+    def test_nil_labels(self, webhook):
+        out = admit(webhook, notebook(labels=None))
+        assert not feast_volume(out)
+
+    def test_label_true(self, webhook):
+        out = admit(webhook, notebook(labels={names.FEAST_LABEL: "true"}))
+        assert feast_volume(out)
+
+    def test_label_false(self, webhook):
+        out = admit(webhook, notebook(labels={names.FEAST_LABEL: "false"}))
+        assert not feast_volume(out)
+
+    @pytest.mark.parametrize("value", ["True", "TRUE", "yes", "1", "enabled",
+                                       ""])
+    def test_label_invalid_values(self, webhook, value):
+        out = admit(webhook, notebook(labels={names.FEAST_LABEL: value}))
+        assert not feast_volume(out)
+
+
+# -------------------------------------------------------- mount mechanics
+class TestMount:
+    """Reference mountFeastConfig specs
+    (notebook_feast_config_test.go:113-307)."""
+
+    def test_adds_volume_and_mount(self, webhook):
+        out = admit(webhook, notebook(labels={names.FEAST_LABEL: "true"}))
+        vol = feast_volume(out)[0]
+        # NOT optional: a missing ConfigMap must fail pod start (reference
+        # notebook_feast_config_test.go:513-564)
+        assert vol["configMap"] == {"name": "nb-feast-config"}
+        mount = feast_mounts(api.notebook_container(out))[0]
+        assert mount["mountPath"] == MOUNT_PATH
+        assert mount["readOnly"] is True
+
+    def test_updates_existing_stale_volume(self, webhook):
+        nb = notebook(labels={names.FEAST_LABEL: "true"},
+                      volumes=[{"name": VOL,
+                                "configMap": {"name": "stale-config"}}])
+        out = admit(webhook, nb)
+        vols = feast_volume(out)
+        assert len(vols) == 1
+        assert vols[0]["configMap"]["name"] == "nb-feast-config"
+
+    def test_updates_existing_stale_mount(self, webhook):
+        nb = notebook(labels={names.FEAST_LABEL: "true"},
+                      containers=[{"name": "nb", "image": "img",
+                                   "volumeMounts": [{
+                                       "name": VOL,
+                                       "mountPath": MOUNT_PATH,
+                                       "readOnly": False}]}])
+        out = admit(webhook, nb)
+        mounts = feast_mounts(api.notebook_container(out))
+        assert len(mounts) == 1
+        assert mounts[0]["readOnly"] is True
+
+    def test_multiple_containers_only_notebook_container_mounted(self,
+                                                                 webhook):
+        nb = notebook(labels={names.FEAST_LABEL: "true"},
+                      containers=[{"name": "sidecar", "image": "proxy"},
+                                  {"name": "nb", "image": "img"}])
+        out = admit(webhook, nb)
+        containers = api.notebook_pod_spec(out)["containers"]
+        by_name = {c["name"]: c for c in containers}
+        assert feast_mounts(by_name["nb"])
+        assert not feast_mounts(by_name["sidecar"])
+
+    def test_mount_idempotent_across_admissions(self, webhook):
+        out = admit(webhook, notebook(labels={names.FEAST_LABEL: "true"}))
+        out2 = admit(webhook, out, operation="UPDATE", old=out)
+        assert len(feast_volume(out2)) == 1
+        assert len(feast_mounts(api.notebook_container(out2))) == 1
+
+    def test_no_name_matching_container_falls_back_to_first(self, webhook):
+        nb = notebook(labels={names.FEAST_LABEL: "true"},
+                      containers=[{"name": "custom", "image": "img"}])
+        out = admit(webhook, nb)
+        assert feast_mounts(api.notebook_pod_spec(out)["containers"][0])
+
+
+# ------------------------------------------------------ unmount mechanics
+class TestUnmount:
+    """Reference unmountFeastConfig specs
+    (notebook_feast_config_test.go:309-402)."""
+
+    def stopped(self, **kw):
+        # stopped notebooks take webhook mutations immediately (no
+        # restart-gating deferral)
+        return notebook(
+            annotations={names.STOP_ANNOTATION: "2026-01-01T00:00:00Z"},
+            **kw)
+
+    def test_removes_volume_and_mount(self, webhook):
+        mounted = admit(webhook,
+                        self.stopped(labels={names.FEAST_LABEL: "true"}))
+        assert feast_volume(mounted)
+        mounted["metadata"]["labels"][names.FEAST_LABEL] = "false"
+        out = admit(webhook, mounted, operation="UPDATE", old=mounted)
+        assert not feast_volume(out)
+        assert not feast_mounts(api.notebook_container(out))
+
+    def test_label_removed_entirely_unmounts(self, webhook):
+        mounted = admit(webhook,
+                        self.stopped(labels={names.FEAST_LABEL: "true"}))
+        del mounted["metadata"]["labels"][names.FEAST_LABEL]
+        out = admit(webhook, mounted, operation="UPDATE", old=mounted)
+        assert not feast_volume(out)
+
+    def test_graceful_without_feast_config(self, webhook):
+        out = admit(webhook, self.stopped())
+        assert not feast_volume(out)
+        assert not feast_mounts(api.notebook_container(out))
+
+    def test_premounted_volume_with_label_off_on_create(self, webhook):
+        """Reference edge case (notebook_feast_config_test.go:679-739):
+        a CR arriving with the volume already present but the label not
+        'true' gets the volume stripped at admission."""
+        nb = notebook(volumes=[{"name": VOL,
+                                "configMap": {"name": "nb-feast-config"}}],
+                      containers=[{"name": "nb", "image": "img",
+                                   "volumeMounts": [{
+                                       "name": VOL,
+                                       "mountPath": MOUNT_PATH}]}])
+        out = admit(webhook, nb)
+        assert not feast_volume(out)
+        assert not feast_mounts(api.notebook_container(out))
+
+    def test_other_volumes_untouched_by_unmount(self, webhook):
+        nb = self.stopped(
+            volumes=[{"name": "data", "emptyDir": {}},
+                     {"name": VOL, "configMap": {"name": "nb-feast-config"}}],
+            containers=[{"name": "nb", "image": "img",
+                         "volumeMounts": [
+                             {"name": "data", "mountPath": "/data"},
+                             {"name": VOL, "mountPath": MOUNT_PATH}]}])
+        out = admit(webhook, nb)
+        spec = api.notebook_pod_spec(out)
+        assert [v["name"] for v in spec["volumes"]] == ["data"]
+        assert [m["name"] for m in
+                api.notebook_container(out)["volumeMounts"]] == ["data"]
+
+
+# ----------------------------------------------------- admission integration
+class TestIntegration:
+    """Reference integration specs (notebook_feast_config_test.go:404-739)
+    — through the full webhook pipeline against the store."""
+
+    def test_mounts_when_configmap_exists(self, store, webhook):
+        store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                      "metadata": {"name": "nb-feast-config",
+                                   "namespace": NS},
+                      "data": {"feature_store.yaml": "project: demo"}})
+        out = admit(webhook, notebook(labels={names.FEAST_LABEL: "true"}))
+        assert feast_volume(out)
+
+    def test_mounts_even_when_configmap_missing(self, webhook):
+        """The volume reference is created regardless — the pod will fail
+        to start, surfacing the misconfiguration (reference
+        notebook_feast_config_test.go:513-564)."""
+        out = admit(webhook, notebook(labels={names.FEAST_LABEL: "true"}))
+        vol = feast_volume(out)[0]
+        assert "optional" not in vol["configMap"]

@@ -1,0 +1,274 @@
+"""Deep MLflow integration spec.
+
+Mirrors the behavior inventory of the reference's ``notebook_mlflow_test.go``
+(604 lines): RoleBinding reconcile (absent annotation cleans up, missing
+ClusterRole requeues, present annotation creates, drift repairs),
+HandleMLflowEnvVars (annotation matrix, Gateway lookup vs configured
+gateway-url, per-instance path segments), getMLflowTrackingURI scheme
+handling, and the webhook end-to-end injection path.
+"""
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.controllers import rbac
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webhook.mutating import NotebookMutatingWebhook
+
+NS = "proj"
+GW_NS = "openshift-ingress"
+GW_NAME = "data-science-gateway"
+ENV_VARS = ("MLFLOW_TRACKING_URI", "MLFLOW_K8S_INTEGRATION",
+            "MLFLOW_TRACKING_AUTH")
+
+
+@pytest.fixture
+def store():
+    return ClusterStore()
+
+
+def config(**kw):
+    kw.setdefault("mlflow_enabled", True)
+    return ControllerConfig(gateway_name=GW_NAME, gateway_namespace=GW_NS,
+                            **kw)
+
+
+def notebook(name="nb", annotations=None):
+    nb = {"apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+          "metadata": {"name": name, "namespace": NS},
+          "spec": {"template": {"spec": {
+              "containers": [{"name": name, "image": "img"}]}}}}
+    if annotations:
+        nb["metadata"]["annotations"] = annotations
+    return nb
+
+
+def cluster_role(store):
+    store.create({"kind": "ClusterRole",
+                  "apiVersion": "rbac.authorization.k8s.io/v1",
+                  "metadata": {"name": rbac.MLFLOW_CLUSTER_ROLE}})
+
+
+def gateway(store, hostname="gw.apps.example.com"):
+    store.create({"kind": "Gateway",
+                  "apiVersion": "gateway.networking.k8s.io/v1",
+                  "metadata": {"name": GW_NAME, "namespace": GW_NS},
+                  "spec": {"listeners": [{"hostname": hostname}]}})
+
+
+def env_of(nb):
+    return k8s.env_list_to_dict(api.notebook_container(nb).get("env", []))
+
+
+# ------------------------------------------------- RoleBinding reconcile
+class TestReconcileRoleBinding:
+    """Reference ReconcileMLflowIntegration specs
+    (notebook_mlflow_test.go:83-246)."""
+
+    def test_no_annotation_no_rolebinding(self, store):
+        cluster_role(store)
+        nb = store.create(notebook())
+        assert rbac.reconcile_mlflow_integration(store, nb) is None
+        assert store.get_or_none("RoleBinding", NS,
+                                 rbac.mlflow_rb_name("nb")) is None
+
+    def test_cleans_up_rolebinding_when_annotation_absent(self, store):
+        cluster_role(store)
+        nb = store.create(notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}))
+        rbac.reconcile_mlflow_integration(store, nb)
+        assert store.get("RoleBinding", NS, rbac.mlflow_rb_name("nb"))
+        nb["metadata"]["annotations"] = {}
+        assert rbac.reconcile_mlflow_integration(store, nb) is None
+        assert store.get_or_none("RoleBinding", NS,
+                                 rbac.mlflow_rb_name("nb")) is None
+
+    def test_whitespace_annotation_treated_as_absent(self, store):
+        """The reconciler trims like the webhook — a whitespace-only value
+        must not create a RoleBinding the env-injection path ignores."""
+        cluster_role(store)
+        nb = store.create(notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "   "}))
+        assert rbac.reconcile_mlflow_integration(store, nb) is None
+        assert store.get_or_none("RoleBinding", NS,
+                                 rbac.mlflow_rb_name("nb")) is None
+
+    def test_requeues_without_clusterrole(self, store):
+        nb = store.create(notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}))
+        delay = rbac.reconcile_mlflow_integration(store, nb)
+        assert delay == rbac.MLFLOW_REQUEUE_SECONDS
+        assert store.get_or_none("RoleBinding", NS,
+                                 rbac.mlflow_rb_name("nb")) is None
+
+    def test_creates_rolebinding_with_annotation(self, store):
+        cluster_role(store)
+        nb = store.create(notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}))
+        assert rbac.reconcile_mlflow_integration(store, nb) is None
+        rb = store.get("RoleBinding", NS, rbac.mlflow_rb_name("nb"))
+        assert rb["roleRef"] == {"apiGroup": "rbac.authorization.k8s.io",
+                                 "kind": "ClusterRole",
+                                 "name": rbac.MLFLOW_CLUSTER_ROLE}
+        assert rb["subjects"] == [{"kind": "ServiceAccount",
+                                   "name": "default", "namespace": NS}]
+        assert rb["metadata"]["ownerReferences"][0]["kind"] == "Notebook"
+
+    def test_repairs_subject_drift(self, store):
+        """Reference needsUpdate path (notebook_mlflow.go:336-357)."""
+        cluster_role(store)
+        nb = store.create(notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}))
+        rbac.reconcile_mlflow_integration(store, nb)
+        rb = store.get("RoleBinding", NS, rbac.mlflow_rb_name("nb"))
+        rb["subjects"] = [{"kind": "ServiceAccount", "name": "hijacked",
+                           "namespace": NS}]
+        store.update(rb)
+        rbac.reconcile_mlflow_integration(store, nb)
+        rb = store.get("RoleBinding", NS, rbac.mlflow_rb_name("nb"))
+        assert rb["subjects"][0]["name"] == "default"
+
+    def test_stable_rolebinding_not_rewritten(self, store):
+        cluster_role(store)
+        nb = store.create(notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}))
+        rbac.reconcile_mlflow_integration(store, nb)
+        rv = store.get("RoleBinding", NS, rbac.mlflow_rb_name("nb"))[
+            "metadata"]["resourceVersion"]
+        rbac.reconcile_mlflow_integration(store, nb)
+        assert store.get("RoleBinding", NS, rbac.mlflow_rb_name("nb"))[
+            "metadata"]["resourceVersion"] == rv
+
+
+# ------------------------------------------------------------ tracking URI
+class TestTrackingURI:
+    """Reference getMLflowTrackingURI specs
+    (notebook_mlflow_test.go:375-403)."""
+
+    def test_prepends_https_when_no_scheme(self, store):
+        uri = rbac.get_mlflow_tracking_uri(
+            store, config(gateway_url="gw.example.com"), "mlflow")
+        assert uri == "https://gw.example.com/mlflow"
+
+    def test_preserves_https_scheme(self, store):
+        uri = rbac.get_mlflow_tracking_uri(
+            store, config(gateway_url="https://gw.example.com"), "mlflow")
+        assert uri == "https://gw.example.com/mlflow"
+
+    def test_preserves_http_scheme(self, store):
+        uri = rbac.get_mlflow_tracking_uri(
+            store, config(gateway_url="http://gw.example.com"), "mlflow")
+        assert uri == "http://gw.example.com/mlflow"
+
+    def test_non_default_instance_path_segment(self, store):
+        uri = rbac.get_mlflow_tracking_uri(
+            store, config(gateway_url="gw.example.com"), "tracking-1")
+        assert uri == "https://gw.example.com/mlflow-tracking-1"
+
+    def test_gateway_lookup_when_no_configured_url(self, store):
+        gateway(store)
+        uri = rbac.get_mlflow_tracking_uri(store, config(), "mlflow")
+        assert uri == "https://gw.apps.example.com/mlflow"
+
+    def test_configured_url_bypasses_gateway_lookup(self, store):
+        gateway(store, hostname="from-gateway.example.com")
+        uri = rbac.get_mlflow_tracking_uri(
+            store, config(gateway_url="configured.example.com"), "mlflow")
+        assert uri == "https://configured.example.com/mlflow"
+
+    def test_none_when_no_hostname_determinable(self, store):
+        assert rbac.get_mlflow_tracking_uri(store, config(), "mlflow") is None
+
+
+# --------------------------------------------------------- env injection
+class TestEnvInjection:
+    """Reference HandleMLflowEnvVars specs
+    (notebook_mlflow_test.go:248-373)."""
+
+    def admit(self, store, nb, cfg=None):
+        return NotebookMutatingWebhook(store, cfg or config()).handle(
+            "CREATE", nb, None)
+
+    def test_no_annotation_no_env(self, store):
+        out = self.admit(store, notebook())
+        assert not set(env_of(out)) & set(ENV_VARS)
+
+    def test_empty_annotation_value_no_env(self, store):
+        out = self.admit(store, notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: ""}))
+        assert not set(env_of(out)) & set(ENV_VARS)
+
+    def test_whitespace_annotation_value_no_env(self, store):
+        out = self.admit(store, notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "   "}))
+        assert not set(env_of(out)) & set(ENV_VARS)
+
+    def test_integration_and_auth_vars_injected(self, store):
+        out = self.admit(store, notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}))
+        env = env_of(out)
+        assert env["MLFLOW_K8S_INTEGRATION"] == "true"
+        assert env["MLFLOW_TRACKING_AUTH"] == "kubernetes-namespaced"
+
+    def test_no_tracking_uri_without_hostname(self, store):
+        out = self.admit(store, notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}))
+        env = env_of(out)
+        # integration/auth are set even when the URI is undeterminable
+        assert "MLFLOW_TRACKING_URI" not in env
+        assert env["MLFLOW_K8S_INTEGRATION"] == "true"
+
+    def test_tracking_uri_via_gateway_lookup(self, store):
+        gateway(store)
+        out = self.admit(store, notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}))
+        assert env_of(out)["MLFLOW_TRACKING_URI"] == \
+            "https://gw.apps.example.com/mlflow"
+
+    def test_tracking_uri_prefers_configured_gateway_url(self, store):
+        gateway(store, hostname="from-gateway.example.com")
+        out = self.admit(store, notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}),
+            cfg=config(gateway_url="configured.example.com"))
+        assert env_of(out)["MLFLOW_TRACKING_URI"] == \
+            "https://configured.example.com/mlflow"
+
+    def test_non_default_instance_uri(self, store):
+        gateway(store)
+        out = self.admit(store, notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "tracking-1"}))
+        assert env_of(out)["MLFLOW_TRACKING_URI"] == \
+            "https://gw.apps.example.com/mlflow-tracking-1"
+
+    def test_annotation_removed_cleans_env(self, store):
+        gateway(store)
+        webhook = NotebookMutatingWebhook(store, config())
+        nb = notebook(annotations={
+            names.MLFLOW_INSTANCE_ANNOTATION: "mlflow",
+            names.STOP_ANNOTATION: "2026-01-01T00:00:00Z"})
+        mounted = webhook.handle("CREATE", nb, None)
+        assert set(env_of(mounted)) & set(ENV_VARS)
+        del mounted["metadata"]["annotations"][
+            names.MLFLOW_INSTANCE_ANNOTATION]
+        out = webhook.handle("UPDATE", mounted, mounted)
+        assert not set(env_of(out)) & set(ENV_VARS)
+
+    def test_mlflow_disabled_config_no_env(self, store):
+        gateway(store)
+        out = self.admit(store, notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}),
+            cfg=config(mlflow_enabled=False))
+        assert not set(env_of(out)) & set(ENV_VARS)
+
+    def test_user_env_preserved_alongside_injection(self, store):
+        gateway(store)
+        nb = notebook(annotations={
+            names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"})
+        nb["spec"]["template"]["spec"]["containers"][0]["env"] = [
+            {"name": "USER_VAR", "value": "keep"}]
+        out = self.admit(store, nb)
+        env = env_of(out)
+        assert env["USER_VAR"] == "keep"
+        assert env["MLFLOW_K8S_INTEGRATION"] == "true"

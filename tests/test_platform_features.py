@@ -37,13 +37,15 @@ def create_nb(store, mgr, name="nb", ns="user-ns", **kw):
     return store.get(api.KIND, ns, name)
 
 
-def runtime_stream(name, metadata, tag="1.0", labeled=True):
+def runtime_stream(name, metadata, tag="1.0", labeled=True,
+                   image="quay.io/org/img@sha256:abc"):
     labels = {runtime_images.RUNTIME_IMAGE_LABEL: "true"} if labeled else {}
     return {"kind": "ImageStream", "apiVersion": "image.openshift.io/v1",
             "metadata": {"name": name, "namespace": CENTRAL,
                          "labels": labels},
             "spec": {"tags": [{
                 "name": tag,
+                "from": {"kind": "DockerImage", "name": image},
                 "annotations": {
                     "opendatahub.io/runtime-image-metadata": metadata},
             }]}}
@@ -55,29 +57,33 @@ def runtime_stream(name, metadata, tag="1.0", labeled=True):
 def test_runtime_images_collected_and_projected(world):
     store, mgr, config = world
     meta = json.dumps([{"display_name": "Datascience with Python 3.11",
-                        "metadata": {"image_name": "img@sha256:abc"}}])
+                        "metadata": {"image_name": "placeholder"}}])
     store.create(runtime_stream("ds-runtime", meta))
     create_nb(store, mgr)
     cm = store.get("ConfigMap", "user-ns", runtime_images.CONFIGMAP_NAME)
-    key = "Datascience-with-Python-3.11.json"
+    key = "datascience-with-python-3.11.json"
     assert key in cm["data"]
-    assert json.loads(cm["data"][key])["display_name"] == \
-        "Datascience with Python 3.11"
+    entry = json.loads(cm["data"][key])
+    assert entry["display_name"] == "Datascience with Python 3.11"
+    # the tag's from.name overwrites metadata.image_name (reference
+    # parseRuntimeImageMetadata, notebook_runtime.go:193-199)
+    assert entry["metadata"]["image_name"] == "quay.io/org/img@sha256:abc"
 
 
 def test_runtime_images_key_sanitization():
-    assert runtime_images.format_key_name("A b/c*d (v2)!") == "A-bcd-v2.json"
-    assert runtime_images.format_key_name("***") == "runtime.json"
+    assert runtime_images.format_key_name("A b/c*d (v2)!") == \
+        "a-b-c-d-v2.json"
+    assert runtime_images.format_key_name("***") == ""
 
 
 def test_runtime_images_malformed_metadata_skipped(world):
     store, mgr, config = world
     store.create(runtime_stream("bad-runtime", "{not json"))
-    good = json.dumps({"display_name": "Good"})
+    good = json.dumps([{"display_name": "Good"}])
     store.create(runtime_stream("good-runtime", good))
     create_nb(store, mgr)
     cm = store.get("ConfigMap", "user-ns", runtime_images.CONFIGMAP_NAME)
-    assert list(cm["data"]) == ["Good.json"]
+    assert list(cm["data"]) == ["good.json"]
 
 
 def test_runtime_images_unlabeled_streams_ignored(world):
@@ -90,22 +96,24 @@ def test_runtime_images_unlabeled_streams_ignored(world):
                              runtime_images.CONFIGMAP_NAME) is None
 
 
-def test_runtime_images_cm_deleted_when_streams_gone(world):
+def test_runtime_images_cm_left_as_is_when_streams_gone(world):
+    """The reference deliberately leaves an existing projection in place
+    when the inventory empties (notebook_runtime.go:109-117)."""
     store, mgr, config = world
-    store.create(runtime_stream("ds", json.dumps({"display_name": "DS"})))
+    store.create(runtime_stream("ds", json.dumps([{"display_name": "DS"}])))
     create_nb(store, mgr)
     assert store.get("ConfigMap", "user-ns", runtime_images.CONFIGMAP_NAME)
     store.delete("ImageStream", CENTRAL, "ds")
     store.patch(api.KIND, "user-ns", "nb",
                 {"metadata": {"labels": {"touch": "1"}}})
     drain(mgr)
-    assert store.get_or_none("ConfigMap", "user-ns",
-                             runtime_images.CONFIGMAP_NAME) is None
+    cm = store.get("ConfigMap", "user-ns", runtime_images.CONFIGMAP_NAME)
+    assert "ds.json" in cm["data"]
 
 
 def test_runtime_images_mounted_then_unmounted_on_stopped_notebook(world):
     store, mgr, config = world
-    store.create(runtime_stream("ds", json.dumps({"display_name": "DS"})))
+    store.create(runtime_stream("ds", json.dumps([{"display_name": "DS"}])))
     create_nb(store, mgr)
     # keep the notebook stopped so webhook mutations always apply
     store.patch(api.KIND, "user-ns", "nb", {"metadata": {"annotations": {
@@ -115,13 +123,13 @@ def test_runtime_images_mounted_then_unmounted_on_stopped_notebook(world):
     container = api.notebook_container(nb)
     assert any(m["name"] == "runtime-images"
                for m in container.get("volumeMounts", []))
+    # the projection is left as-is when streams vanish, so unmount is
+    # triggered by the ConfigMap itself going away (user/GC deletion)
     store.delete("ImageStream", CENTRAL, "ds")
-    # first touch lets the reconciler delete the projected CM (admission on
-    # that same write still sees the old CM); the second admission unmounts
-    for i in ("1", "2"):
-        store.patch(api.KIND, "user-ns", "nb",
-                    {"metadata": {"labels": {"touch": i}}})
-        drain(mgr)
+    store.delete("ConfigMap", "user-ns", runtime_images.CONFIGMAP_NAME)
+    store.patch(api.KIND, "user-ns", "nb",
+                {"metadata": {"labels": {"touch": "1"}}})
+    drain(mgr)
     nb = store.get(api.KIND, "user-ns", "nb")
     container = api.notebook_container(nb)
     assert not any(m["name"] == "runtime-images"
@@ -137,7 +145,7 @@ def test_feast_mount_content_and_label_cycle(world):
     nb = store.get(api.KIND, "user-ns", "nb")
     vol = next(v for v in api.notebook_pod_spec(nb)["volumes"]
                if v["name"] == "feast-config")
-    assert vol["configMap"] == {"name": "nb-feast-config", "optional": True}
+    assert vol["configMap"] == {"name": "nb-feast-config"}
     mount = next(m for m in api.notebook_container(nb)["volumeMounts"]
                  if m["name"] == "feast-config")
     assert mount["mountPath"] == "/opt/app-root/src/feast-config"

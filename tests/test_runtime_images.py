@@ -1,0 +1,242 @@
+"""Deep runtime-images spec.
+
+Mirrors the behavior inventory of the reference's
+``notebook_runtime_test.go`` (571 lines): the ImageStream scrape loop's
+misconfiguration handling (no tags, missing from-reference, malformed or
+missing metadata, no display_name), parseRuntimeImageMetadata's
+first-object + image_name-injection contract, formatKeyName's table, the
+sync create/update/leave-as-is lifecycle, and the webhook mount matrix
+(data → mounted, empty → skipped, missing → skipped, dedup).
+"""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.controllers import runtime_images
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webhook.mutating import NotebookMutatingWebhook
+
+CENTRAL = "kubeflow-tpu-system"
+NS = "proj"
+VOL = "runtime-images"
+
+
+@pytest.fixture
+def store():
+    return ClusterStore()
+
+
+def stream(name="ds", tags=None, labeled=True):
+    labels = {runtime_images.RUNTIME_IMAGE_LABEL: "true"} if labeled else {}
+    return {"kind": "ImageStream", "apiVersion": "image.openshift.io/v1",
+            "metadata": {"name": name, "namespace": CENTRAL,
+                         "labels": labels},
+            "spec": {"tags": tags if tags is not None else []}}
+
+
+def tag(display="DS Runtime", image="quay.io/org/img@sha256:abc",
+        metadata=None, name="1.0"):
+    t = {"name": name}
+    if image is not None:
+        t["from"] = {"kind": "DockerImage", "name": image}
+    if metadata is None and display is not None:
+        metadata = json.dumps([{"display_name": display, "metadata": {}}])
+    if metadata is not None:
+        t["annotations"] = {runtime_images.METADATA_ANNOTATION: metadata}
+    return t
+
+
+def collect(store):
+    return runtime_images.collect_runtime_images(store, CENTRAL)
+
+
+# ------------------------------------------------------------ scrape loop
+class TestCollect:
+    def test_labeled_stream_with_tag_collected(self, store):
+        store.create(stream(tags=[tag()]))
+        data = collect(store)
+        assert "ds-runtime.json" in data
+        entry = json.loads(data["ds-runtime.json"])
+        assert entry["metadata"]["image_name"] == "quay.io/org/img@sha256:abc"
+
+    def test_unlabeled_stream_ignored(self, store):
+        store.create(stream(tags=[tag()], labeled=False))
+        assert collect(store) == {}
+
+    def test_stream_without_tags_skipped(self, store):
+        store.create(stream())
+        assert collect(store) == {}
+
+    def test_tag_without_from_reference_skipped(self, store):
+        store.create(stream(tags=[tag(image=None)]))
+        assert collect(store) == {}
+
+    def test_tag_without_metadata_annotation_skipped(self, store):
+        # raw defaults to "[]" → parse yields {} → no display_name → skip
+        store.create(stream(tags=[tag(display=None)]))
+        assert collect(store) == {}
+
+    def test_malformed_metadata_skipped(self, store):
+        store.create(stream(tags=[tag(metadata="{not json")]))
+        assert collect(store) == {}
+
+    def test_non_array_metadata_skipped(self, store):
+        store.create(stream(
+            tags=[tag(metadata=json.dumps({"display_name": "X"}))]))
+        assert collect(store) == {}
+
+    def test_only_first_array_object_used(self, store):
+        meta = json.dumps([{"display_name": "First", "metadata": {}},
+                           {"display_name": "Second", "metadata": {}}])
+        store.create(stream(tags=[tag(metadata=meta)]))
+        data = collect(store)
+        assert list(data) == ["first.json"]
+
+    def test_entry_without_display_name_skipped(self, store):
+        store.create(stream(
+            tags=[tag(metadata=json.dumps([{"metadata": {}}]))]))
+        assert collect(store) == {}
+
+    def test_all_invalid_display_name_skipped(self, store):
+        store.create(stream(
+            tags=[tag(metadata=json.dumps([{"display_name": "***"}]))]))
+        assert collect(store) == {}
+
+    def test_multiple_tags_multiple_entries(self, store):
+        store.create(stream(tags=[
+            tag(display="Python 3.11", name="py311",
+                image="quay.io/org/py@sha256:1"),
+            tag(display="Spark 3.5", name="spark",
+                image="quay.io/org/spark@sha256:2")]))
+        data = collect(store)
+        assert set(data) == {"python-3.11.json", "spark-3.5.json"}
+
+    def test_image_name_injected_only_into_metadata_dict(self, store):
+        # entry whose "metadata" is not a dict: image_name not injected,
+        # entry still collected under its display name
+        meta = json.dumps([{"display_name": "X", "metadata": "odd"}])
+        store.create(stream(tags=[tag(metadata=meta)]))
+        entry = json.loads(collect(store)["x.json"])
+        assert entry["metadata"] == "odd"
+
+
+# ---------------------------------------------------------- formatKeyName
+class TestFormatKeyName:
+    """Reference formatKeyName table (notebook_runtime_test.go:532-570)."""
+
+    @pytest.mark.parametrize("given,expected", [
+        ("Datascience with Python 3.11", "datascience-with-python-3.11.json"),
+        ("A b/c*d (v2)!", "a-b-c-d-v2.json"),
+        ("UPPER", "upper.json"),
+        ("under_score.keep", "under_score.keep.json"),
+        ("--edge--", "edge.json"),
+        ("a  +  b", "a-b.json"),
+        ("***", ""),
+        ("", ""),
+    ])
+    def test_table(self, given, expected):
+        assert runtime_images.format_key_name(given) == expected
+
+
+# ------------------------------------------------------------- sync paths
+class TestSync:
+    def sync(self, store):
+        runtime_images.sync_runtime_images_config_map(store, CENTRAL, NS)
+
+    def test_no_images_no_configmap_created(self, store):
+        self.sync(store)
+        assert store.get_or_none("ConfigMap", NS,
+                                 runtime_images.CONFIGMAP_NAME) is None
+
+    def test_creates_labeled_configmap(self, store):
+        store.create(stream(tags=[tag()]))
+        self.sync(store)
+        cm = store.get("ConfigMap", NS, runtime_images.CONFIGMAP_NAME)
+        assert cm["metadata"]["labels"]["opendatahub.io/managed-by"] == \
+            "workbenches"
+        assert "ds-runtime.json" in cm["data"]
+
+    def test_updates_on_inventory_change(self, store):
+        store.create(stream(tags=[tag()]))
+        self.sync(store)
+        store.create(stream(name="spark", tags=[
+            tag(display="Spark", image="quay.io/org/spark@sha256:2")]))
+        self.sync(store)
+        cm = store.get("ConfigMap", NS, runtime_images.CONFIGMAP_NAME)
+        assert set(cm["data"]) == {"ds-runtime.json", "spark.json"}
+
+    def test_existing_configmap_left_as_is_when_inventory_empties(self,
+                                                                  store):
+        """Deliberate reference behavior (notebook_runtime.go:109-117)."""
+        s = store.create(stream(tags=[tag()]))
+        self.sync(store)
+        store.delete("ImageStream", CENTRAL, s["metadata"]["name"])
+        self.sync(store)
+        cm = store.get("ConfigMap", NS, runtime_images.CONFIGMAP_NAME)
+        assert "ds-runtime.json" in cm["data"]
+
+    def test_no_rewrite_when_stable(self, store):
+        store.create(stream(tags=[tag()]))
+        self.sync(store)
+        rv = store.get("ConfigMap", NS, runtime_images.CONFIGMAP_NAME)[
+            "metadata"]["resourceVersion"]
+        self.sync(store)
+        assert store.get("ConfigMap", NS, runtime_images.CONFIGMAP_NAME)[
+            "metadata"]["resourceVersion"] == rv
+
+
+# ------------------------------------------------------------ mount matrix
+class TestMount:
+    """Reference mount table (notebook_runtime_test.go:29-127,418-531)."""
+
+    def admit(self, store, nb=None):
+        webhook = NotebookMutatingWebhook(store, ControllerConfig())
+        nb = nb or {"apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+                    "metadata": {"name": "nb", "namespace": NS},
+                    "spec": {"template": {"spec": {"containers": [
+                        {"name": "nb", "image": "img"}]}}}}
+        return webhook.handle("CREATE", nb, None)
+
+    def volumes(self, nb):
+        return [v for v in api.notebook_pod_spec(nb).get("volumes", [])
+                if v["name"] == VOL]
+
+    def mounts(self, nb):
+        return [m for m in api.notebook_container(nb).get("volumeMounts", [])
+                if m["name"] == VOL]
+
+    def configmap(self, store, data):
+        store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                      "metadata": {"name": runtime_images.CONFIGMAP_NAME,
+                                   "namespace": NS},
+                      "data": data})
+
+    def test_mounts_when_data_present(self, store):
+        self.configmap(store, {"ds.json": "{}"})
+        out = self.admit(store)
+        vol = self.volumes(out)[0]
+        # optional=true here, unlike the Feast mount (reference
+        # notebook_runtime.go:236-247)
+        assert vol["configMap"] == {
+            "name": runtime_images.CONFIGMAP_NAME, "optional": True}
+        assert self.mounts(out)[0]["mountPath"] == \
+            "/opt/app-root/pipeline-runtimes"
+
+    def test_skips_empty_configmap(self, store):
+        self.configmap(store, {})
+        out = self.admit(store)
+        assert not self.volumes(out) and not self.mounts(out)
+
+    def test_skips_missing_configmap(self, store):
+        out = self.admit(store)
+        assert not self.volumes(out) and not self.mounts(out)
+
+    def test_mount_idempotent(self, store):
+        self.configmap(store, {"ds.json": "{}"})
+        out = self.admit(store)
+        out2 = self.admit(store, out)
+        assert len(self.volumes(out2)) == 1
+        assert len(self.mounts(out2)) == 1

@@ -195,7 +195,8 @@ def test_validating_denies_mlflow_annotation_removal_running():
         names.MLFLOW_INSTANCE_ANNOTATION: "tracking-1"}))
     env = k8s.env_list_to_dict(
         api.notebook_container(store.get(api.KIND, "ns", "nb"))["env"])
-    assert env["MLFLOW_TRACKING_URI"] == "https://gw.example/mlflow/tracking-1"
+    assert env["MLFLOW_TRACKING_URI"] == \
+        "https://gw.example/mlflow-tracking-1"
     store.patch(api.KIND, "ns", "nb",
                 {"metadata": {"annotations": {names.STOP_ANNOTATION: None}}})
     with pytest.raises(AdmissionDenied):
