@@ -56,6 +56,18 @@ class ExtensionReconciler:
             mgr.watch(kind, self.name, mapper=owner_mapper(api.KIND))
         mgr.watch("HTTPRoute", self.name, mapper=self._route_mapper)
         mgr.watch("ConfigMap", self.name, mapper=self._ca_source_mapper)
+        mgr.watch("ReferenceGrant", self.name, mapper=self._grant_mapper)
+
+    def _grant_mapper(self, obj: dict) -> list[Request]:
+        """The shared per-namespace grant has no ownerRef (it outlives any
+        single notebook) — map its events onto every notebook in the
+        namespace so a deleted/drifted grant is restored (reference
+        Watches ReferenceGrant, odh notebook_controller.go:736-884)."""
+        if k8s.name(obj) != routes.REFERENCE_GRANT_NAME:
+            return []
+        ns = k8s.namespace(obj)
+        return [Request(ns, k8s.name(nb))
+                for nb in self.client.list(api.KIND, ns)]
 
     def _route_mapper(self, obj: dict) -> list[Request]:
         nb = k8s.get_label(obj, names.NOTEBOOK_NAME_LABEL)

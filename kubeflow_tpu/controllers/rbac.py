@@ -150,12 +150,17 @@ def reconcile_mlflow_integration(client, notebook: dict,
     # repair drift in subjects/labels/ownerRefs in place, preserving
     # resourceVersion (reference needsUpdate, notebook_mlflow.go:336-357;
     # roleRef is immutable so it is never touched)
-    getters = (lambda o: o.get("subjects"),
-               lambda o: k8s.get_in(o, "metadata", "labels"),
-               lambda o: k8s.get_in(o, "metadata", "ownerReferences"))
-    if any(g(existing) != g(desired) for g in getters):
+    labels = k8s.get_in(existing, "metadata", "labels", default={}) or {}
+    missing_labels = {k: v for k, v in
+                      desired["metadata"]["labels"].items()
+                      if labels.get(k) != v}
+    if existing.get("subjects") != desired["subjects"] or missing_labels \
+            or k8s.get_in(existing, "metadata", "ownerReferences") != \
+            desired["metadata"]["ownerReferences"]:
         existing["subjects"] = desired["subjects"]
-        existing["metadata"]["labels"] = desired["metadata"]["labels"]
+        # merge only OUR label keys — never strip foreign labels
+        labels.update(missing_labels)
+        existing["metadata"]["labels"] = labels
         existing["metadata"]["ownerReferences"] = \
             desired["metadata"]["ownerReferences"]
         client.update(existing)

@@ -151,8 +151,17 @@ def reconcile_reference_grant(client, config: ControllerConfig,
             client.create(desired)
         except errors.AlreadyExistsError:
             pass
-    elif existing.get("spec") != desired["spec"]:
+        return
+    # repair spec AND label drift (reference reconciles both,
+    # odh notebook_controller_test.go:225-271) without clobbering
+    # foreign labels
+    labels = k8s.get_in(existing, "metadata", "labels", default={}) or {}
+    missing = {k: v for k, v in desired["metadata"]["labels"].items()
+               if labels.get(k) != v}
+    if existing.get("spec") != desired["spec"] or missing:
         existing["spec"] = k8s.deepcopy(desired["spec"])
+        labels.update(missing)
+        existing.setdefault("metadata", {})["labels"] = labels
         client.update(existing)
 
 

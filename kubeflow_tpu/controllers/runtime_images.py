@@ -36,31 +36,28 @@ def format_key_name(display_name: str) -> str:
     return f"{s}.json" if s else ""
 
 
-def parse_runtime_image_metadata(raw: str, image_url: str) -> str:
+def parse_runtime_image_metadata(raw: str, image_url: str) -> dict | None:
     """First object of the metadata JSON array with ``metadata.image_name``
     set to the tag's image reference (reference parseRuntimeImageMetadata,
-    notebook_runtime.go:185-208); ``{}`` when unparseable or empty."""
+    notebook_runtime.go:185-208); None when unparseable or empty (the
+    reference's "{}" sentinel — callers skip the entry)."""
     try:
         meta_list = json.loads(raw)
     except ValueError:
-        return "{}"
+        return None
     if not isinstance(meta_list, list) or not meta_list or \
             not isinstance(meta_list[0], dict):
-        return "{}"
+        return None
     first = meta_list[0]
     if isinstance(first.get("metadata"), dict):
         first["metadata"]["image_name"] = image_url
-    return json.dumps(first, sort_keys=True)
+    return first
 
 
-def extract_display_name(metadata_json: str) -> str:
+def extract_display_name(entry: dict | None) -> str:
     """``display_name`` of a parsed entry, "" when absent/not a string
     (reference extractDisplayName, notebook_runtime.go:154-165)."""
-    try:
-        meta = json.loads(metadata_json)
-    except ValueError:
-        return ""
-    display = meta.get("display_name") if isinstance(meta, dict) else None
+    display = entry.get("display_name") if isinstance(entry, dict) else None
     return display if isinstance(display, str) else ""
 
 
@@ -95,7 +92,7 @@ def collect_runtime_images(client, controller_namespace: str) -> dict[str, str]:
                           "ImageStream %s tag %s", k8s.name(stream),
                           tag.get("name", ""))
                 continue
-            out[key] = parsed
+            out[key] = json.dumps(parsed, sort_keys=True)
     return out
 
 

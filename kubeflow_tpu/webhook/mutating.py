@@ -353,11 +353,19 @@ class NotebookMutatingWebhook:
                         "MLFLOW_TRACKING_AUTH"):
                 k8s.remove_env(container, var)
             return
+        from ..cluster import errors
         k8s.upsert_env(container, "MLFLOW_K8S_INTEGRATION", "true")
         k8s.upsert_env(container, "MLFLOW_TRACKING_AUTH",
                        rbac.MLFLOW_TRACKING_AUTH_VALUE)
-        uri = rbac.get_mlflow_tracking_uri(self.client, self.config,
-                                           instance)
+        try:
+            uri = rbac.get_mlflow_tracking_uri(self.client, self.config,
+                                               instance)
+        except errors.ApiError as e:
+            # a failed Gateway/Route lookup must never deny admission —
+            # integration is optional (reference logs and skips,
+            # notebook_mlflow.go:303-310)
+            log.warning("MLflow tracking URI lookup failed: %s", e)
+            uri = None
         if uri is None:
             log.warning("unable to determine MLflow tracking URI, "
                         "skipping injection")

@@ -350,3 +350,139 @@ def test_sar_configmap_drift_repaired(world):
     drain(mgr)
     cm = store.get("ConfigMap", "user-ns", auth.rbac_config_name("nb"))
     assert cm["data"] == original_data  # SAR config restored verbatim
+
+
+# ----------------------------------------- remaining lifecycle spec groups
+# (reference odh notebook_controller_test.go:181-309 ReferenceGrant,
+#  :919-993 NetworkPolicies, :1173-1353 kube-rbac-proxy resources,
+#  :1230-1240 reconciliation lock)
+
+
+def test_reference_grant_recreated_on_delete(world):
+    store, mgr, config = world
+    create_nb(store, mgr)
+    store.delete("ReferenceGrant", "user-ns", routes.REFERENCE_GRANT_NAME)
+    drain(mgr)
+    assert store.get("ReferenceGrant", "user-ns",
+                     routes.REFERENCE_GRANT_NAME)
+
+
+def test_reference_grant_spec_drift_repaired(world):
+    store, mgr, config = world
+    create_nb(store, mgr)
+    grant = store.get("ReferenceGrant", "user-ns",
+                      routes.REFERENCE_GRANT_NAME)
+    grant["spec"]["from"] = [{"group": "evil.example.com",
+                              "kind": "HTTPRoute", "namespace": "evil-ns"}]
+    store.update(grant)
+    drain(mgr)
+    grant = store.get("ReferenceGrant", "user-ns",
+                      routes.REFERENCE_GRANT_NAME)
+    assert grant["spec"]["from"][0]["namespace"] == CENTRAL
+    assert grant["spec"]["from"][0]["group"] == \
+        "gateway.networking.k8s.io"
+
+
+def test_reference_grant_label_drift_repaired(world):
+    store, mgr, config = world
+    create_nb(store, mgr)
+    grant = store.get("ReferenceGrant", "user-ns",
+                      routes.REFERENCE_GRANT_NAME)
+    labels_before = k8s.deepcopy(
+        k8s.get_in(grant, "metadata", "labels", default={}))
+    grant["metadata"]["labels"] = {}
+    store.update(grant)
+    drain(mgr)
+    grant = store.get("ReferenceGrant", "user-ns",
+                      routes.REFERENCE_GRANT_NAME)
+    assert k8s.get_in(grant, "metadata", "labels", default={}) == \
+        labels_before
+
+
+def test_network_policies_recreated_on_delete(world):
+    from kubeflow_tpu.controllers import netpol
+    store, mgr, config = world
+    create_nb(store, mgr, annotations={names.INJECT_AUTH_ANNOTATION: "true"})
+    store.delete("NetworkPolicy", "user-ns", netpol.notebook_policy_name("nb"))
+    store.delete("NetworkPolicy", "user-ns", netpol.auth_policy_name("nb"))
+    drain(mgr)
+    assert store.get("NetworkPolicy", "user-ns",
+                     netpol.notebook_policy_name("nb"))
+    assert store.get("NetworkPolicy", "user-ns",
+                     netpol.auth_policy_name("nb"))
+
+
+def test_auth_proxy_service_recreated_and_drift_repaired(world):
+    store, mgr, config = world
+    create_nb(store, mgr, annotations={names.INJECT_AUTH_ANNOTATION: "true"})
+    svc_name = auth.tls_service_name("nb")
+    store.delete("Service", "user-ns", svc_name)
+    drain(mgr)
+    svc = store.get("Service", "user-ns", svc_name)
+    assert svc["metadata"]["annotations"][
+        "service.beta.openshift.io/serving-cert-secret-name"]
+    svc["spec"]["ports"] = [{"name": "https", "port": 9999,
+                             "targetPort": 9999}]
+    store.update(svc)
+    drain(mgr)
+    svc = store.get("Service", "user-ns", svc_name)
+    # our auth service shape: port 443 → sidecar targetPort 8443
+    assert svc["spec"]["ports"][0]["port"] == 443
+    assert svc["spec"]["ports"][0]["targetPort"] == 8443
+
+
+def test_auth_route_reconciled_and_recreated(world):
+    store, mgr, config = world
+    nb = create_nb(store, mgr,
+                   annotations={names.INJECT_AUTH_ANNOTATION: "true"})
+    route = route_of(store, config, nb)
+    # auth route targets the TLS service (port 443 → sidecar 8443)
+    backend = route["spec"]["rules"][0]["backendRefs"][0]
+    assert backend["name"] == auth.tls_service_name("nb")
+    assert backend["port"] == 443
+    route["spec"]["rules"][0]["backendRefs"][0]["port"] = 80
+    store.update(route)
+    drain(mgr)
+    assert route_of(store, config, nb)["spec"]["rules"][0][
+        "backendRefs"][0]["port"] == 443
+    store.delete("HTTPRoute", CENTRAL, k8s.name(route))
+    drain(mgr)
+    assert route_of(store, config, nb)["spec"]["rules"][0][
+        "backendRefs"][0]["port"] == 443
+
+
+def test_sar_configmap_recreated_on_delete(world):
+    store, mgr, config = world
+    create_nb(store, mgr, annotations={names.INJECT_AUTH_ANNOTATION: "true"})
+    store.delete("ConfigMap", "user-ns", auth.rbac_config_name("nb"))
+    drain(mgr)
+    assert store.get("ConfigMap", "user-ns", auth.rbac_config_name("nb"))
+
+
+def test_reconciliation_lock_removed_after_provisioning(world):
+    store, mgr, config = world
+    nb = create_nb(store, mgr)
+    # the admission-injected lock (stop annotation with the lock value) is
+    # removed once the extension reconciler finishes provisioning
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is None
+    sts = store.get("StatefulSet", "user-ns", "nb")
+    assert sts["spec"]["replicas"] == 1
+
+
+def test_pipeline_rolebinding_gc_with_notebook(world):
+    from kubeflow_tpu.controllers.rbac import PIPELINE_ROLE, pipeline_rb_name
+    store, mgr, _ = world
+    config = ControllerConfig(controller_namespace=CENTRAL,
+                              set_pipeline_rbac=True)
+    mgr2 = setup_controllers(store, config)
+    store.create({"kind": "Role",
+                  "apiVersion": "rbac.authorization.k8s.io/v1",
+                  "metadata": {"name": PIPELINE_ROLE,
+                               "namespace": "user-ns"}})
+    create_nb(store, mgr2)
+    assert store.get("RoleBinding", "user-ns", pipeline_rb_name("nb"))
+    store.delete(api.KIND, "user-ns", "nb")
+    drain(mgr2)
+    # ownerRef GC reaps the RoleBinding with its notebook
+    assert store.get_or_none("RoleBinding", "user-ns",
+                             pipeline_rb_name("nb")) is None

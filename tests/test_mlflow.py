@@ -130,6 +130,24 @@ class TestReconcileRoleBinding:
         rb = store.get("RoleBinding", NS, rbac.mlflow_rb_name("nb"))
         assert rb["subjects"][0]["name"] == "default"
 
+    def test_label_repair_preserves_foreign_labels(self, store):
+        cluster_role(store)
+        nb = store.create(notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}))
+        rbac.reconcile_mlflow_integration(store, nb)
+        rb = store.get("RoleBinding", NS, rbac.mlflow_rb_name("nb"))
+        rb["metadata"]["labels"]["policy.example.com/audit"] = "yes"
+        store.update(rb)
+        rbac.reconcile_mlflow_integration(store, nb)
+        rb = store.get("RoleBinding", NS, rbac.mlflow_rb_name("nb"))
+        # foreign label survives, and no update tug-of-war: a second pass
+        # leaves resourceVersion alone
+        assert rb["metadata"]["labels"]["policy.example.com/audit"] == "yes"
+        rv = rb["metadata"]["resourceVersion"]
+        rbac.reconcile_mlflow_integration(store, nb)
+        assert store.get("RoleBinding", NS, rbac.mlflow_rb_name("nb"))[
+            "metadata"]["resourceVersion"] == rv
+
     def test_stable_rolebinding_not_rewritten(self, store):
         cluster_role(store)
         nb = store.create(notebook(
@@ -261,6 +279,40 @@ class TestEnvInjection:
             annotations={names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}),
             cfg=config(mlflow_enabled=False))
         assert not set(env_of(out)) & set(ENV_VARS)
+
+    def test_failed_route_lookup_never_denies_admission(self, store):
+        """A Forbidden/absent-CRD Route list during hostname discovery must
+        not fail the webhook (reference logs and skips,
+        notebook_mlflow.go:303-310)."""
+        from kubeflow_tpu.cluster import errors
+
+        class RouteForbidden:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, attr):
+                return getattr(self._inner, attr)
+
+            def list(self, kind, *a, **kw):
+                if kind == "Route":
+                    raise errors.ForbiddenError("routes is forbidden")
+                return self._inner.list(kind, *a, **kw)
+
+        # Gateway with a GatewayConfig owner and no hostname forces the
+        # Route-fallback path
+        store.create({"kind": "Gateway",
+                      "apiVersion": "gateway.networking.k8s.io/v1",
+                      "metadata": {"name": GW_NAME, "namespace": GW_NS,
+                                   "ownerReferences": [
+                                       {"kind": "GatewayConfig",
+                                        "name": "gc", "uid": "u"}]},
+                      "spec": {"listeners": [{}]}})
+        webhook = NotebookMutatingWebhook(RouteForbidden(store), config())
+        out = webhook.handle("CREATE", notebook(
+            annotations={names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}), None)
+        env = env_of(out)
+        assert env["MLFLOW_K8S_INTEGRATION"] == "true"
+        assert "MLFLOW_TRACKING_URI" not in env
 
     def test_user_env_preserved_alongside_injection(self, store):
         gateway(store)
