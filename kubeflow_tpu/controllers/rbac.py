@@ -150,17 +150,12 @@ def reconcile_mlflow_integration(client, notebook: dict,
     # repair drift in subjects/labels/ownerRefs in place, preserving
     # resourceVersion (reference needsUpdate, notebook_mlflow.go:336-357;
     # roleRef is immutable so it is never touched)
-    labels = k8s.get_in(existing, "metadata", "labels", default={}) or {}
-    missing_labels = {k: v for k, v in
-                      desired["metadata"]["labels"].items()
-                      if labels.get(k) != v}
-    if existing.get("subjects") != desired["subjects"] or missing_labels \
+    labels_changed = k8s.merge_managed_labels(
+        existing, desired["metadata"]["labels"])
+    if existing.get("subjects") != desired["subjects"] or labels_changed \
             or k8s.get_in(existing, "metadata", "ownerReferences") != \
             desired["metadata"]["ownerReferences"]:
         existing["subjects"] = desired["subjects"]
-        # merge only OUR label keys — never strip foreign labels
-        labels.update(missing_labels)
-        existing["metadata"]["labels"] = labels
         existing["metadata"]["ownerReferences"] = \
             desired["metadata"]["ownerReferences"]
         client.update(existing)

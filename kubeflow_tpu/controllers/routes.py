@@ -101,9 +101,7 @@ def reconcile_httproute(client, config: ControllerConfig, notebook: dict, *,
     if keep.get("spec") != desired["spec"]:
         keep["spec"] = k8s.deepcopy(desired["spec"])
         changed = True
-    want_labels = desired["metadata"]["labels"]
-    if keep["metadata"].get("labels") != want_labels:
-        keep["metadata"]["labels"] = dict(want_labels)
+    if k8s.merge_managed_labels(keep, desired["metadata"]["labels"]):
         changed = True
     if changed:
         client.update(keep)
@@ -155,13 +153,10 @@ def reconcile_reference_grant(client, config: ControllerConfig,
     # repair spec AND label drift (reference reconciles both,
     # odh notebook_controller_test.go:225-271) without clobbering
     # foreign labels
-    labels = k8s.get_in(existing, "metadata", "labels", default={}) or {}
-    missing = {k: v for k, v in desired["metadata"]["labels"].items()
-               if labels.get(k) != v}
-    if existing.get("spec") != desired["spec"] or missing:
+    labels_changed = k8s.merge_managed_labels(
+        existing, desired["metadata"]["labels"])
+    if existing.get("spec") != desired["spec"] or labels_changed:
         existing["spec"] = k8s.deepcopy(desired["spec"])
-        labels.update(missing)
-        existing.setdefault("metadata", {})["labels"] = labels
         client.update(existing)
 
 

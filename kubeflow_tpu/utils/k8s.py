@@ -150,6 +150,20 @@ def is_owned_by(obj: Obj, owner_uid: str) -> bool:
                for r in get_in(obj, "metadata", "ownerReferences", default=[]) or [])
 
 
+def merge_managed_labels(obj: Obj, managed: dict[str, str]) -> bool:
+    """Ensure every managed label key carries its desired value, merging
+    into the object's labels WITHOUT stripping foreign keys (a wholesale
+    replace would tug-of-war with other controllers' labels). Returns True
+    when the object was modified."""
+    labels = get_in(obj, "metadata", "labels", default=None)
+    if labels is None:
+        labels = {}
+        obj.setdefault("metadata", {})["labels"] = labels
+    missing = {k: v for k, v in managed.items() if labels.get(k) != v}
+    labels.update(missing)
+    return bool(missing)
+
+
 def matches_labels(obj: Obj, selector: dict[str, str] | None) -> bool:
     if not selector:
         return True

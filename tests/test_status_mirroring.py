@@ -1,0 +1,163 @@
+"""Notebook status-mirroring spec.
+
+Mirrors the reference's TestCreateNotebookStatus table
+(notebook-controller/controllers/notebook_controller_test.go:94-298) and
+TestNbNameFromInvolvedObject (:22-92): status initialization, readyReplicas
+from the StatefulSet, containerState from the notebook container's status,
+pod-condition mirroring (newest first), and the unschedulable-pod case —
+plus our aggregate SliceReady condition, which the single-pod reference
+doesn't have.
+"""
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.utils import k8s, names
+from tests.conftest import drain
+
+NS = "kubeflow-user"
+
+
+def apply_nb(store, manager, name="test", **kw):
+    store.create(api.new_notebook(name, NS, **kw))
+    drain(manager)
+    return store.get(api.KIND, NS, name)
+
+
+def stage_pod(store, nb_name, *, conditions=None, container_statuses=None,
+              ordinal=0):
+    """A pod as the StatefulSet controller would create it, with a staged
+    status (the in-process store has no kubelet writing real statuses)."""
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": f"{nb_name}-{ordinal}", "namespace": NS,
+                        "labels": {names.NOTEBOOK_NAME_LABEL: nb_name,
+                                   "statefulset": nb_name}},
+           "spec": {"containers": [{"name": nb_name, "image": "img"}]},
+           "status": {}}
+    if conditions is not None:
+        pod["status"]["conditions"] = conditions
+    if container_statuses is not None:
+        pod["status"]["containerStatuses"] = container_statuses
+    existing = store.get_or_none("Pod", NS, pod["metadata"]["name"])
+    if existing is not None:
+        existing["status"] = pod["status"]
+        return store.update(existing)
+    return store.create(pod)
+
+
+def reconciled_status(store, manager, name="test"):
+    store.patch(api.KIND, NS, name, {"metadata": {"labels": {"touch": "x"}}})
+    drain(manager)
+    return store.get(api.KIND, NS, name).get("status", {})
+
+
+def test_status_initialization(store, manager, notebook_reconciler):
+    """No pods, no STS status → zeroed status with only the aggregate
+    SliceReady=False condition."""
+    nb = apply_nb(store, manager)
+    status = nb["status"]
+    assert status["readyReplicas"] == 0
+    assert status["containerState"] == {}
+    (cond,) = status["conditions"]
+    assert cond["type"] == api.CONDITION_SLICE_READY
+    assert cond["status"] == "False"
+    assert cond["reason"] == "WaitingForWorkers"
+
+
+def test_ready_replicas_from_statefulset(store, manager,
+                                         notebook_reconciler):
+    apply_nb(store, manager)
+    sts = store.get("StatefulSet", NS, "test")
+    sts["status"] = {"readyReplicas": 1, "replicas": 1}
+    store.update(sts)
+    status = reconciled_status(store, manager)
+    assert status["readyReplicas"] == 1
+
+
+def test_container_state_from_notebook_container(store, manager,
+                                                 notebook_reconciler):
+    apply_nb(store, manager)
+    stage_pod(store, "test", container_statuses=[
+        {"name": "istio-proxy", "state": {"waiting": {"reason": "Init"}}},
+        {"name": "test",
+         "state": {"running": {"startedAt": "2026-01-01T00:00:00Z"}}}])
+    status = reconciled_status(store, manager)
+    # only the container named after the CR is mirrored
+    assert status["containerState"] == \
+        {"running": {"startedAt": "2026-01-01T00:00:00Z"}}
+
+
+def test_pod_conditions_mirrored_newest_first(store, manager,
+                                              notebook_reconciler):
+    apply_nb(store, manager)
+    stage_pod(store, "test", conditions=[
+        {"type": "Running",
+         "lastTransitionTime": "2022-08-30T01:10:30Z"},
+        {"type": "Waiting", "reason": "PodInitializing",
+         "lastTransitionTime": "2022-08-30T01:10:30Z"}])
+    status = reconciled_status(store, manager)
+    mirrored = [c for c in status["conditions"]
+                if c["type"] != api.CONDITION_SLICE_READY]
+    # reversed relative to the pod's list (reference :322-345)
+    assert [c["type"] for c in mirrored] == ["Waiting", "Running"]
+
+
+def test_unschedulable_pod_condition_mirrored(store, manager,
+                                              notebook_reconciler):
+    apply_nb(store, manager)
+    stage_pod(store, "test", conditions=[
+        {"type": "PodScheduled", "status": "False",
+         "reason": "Unschedulable",
+         "message": "0/3 nodes are available: insufficient google.com/tpu"}])
+    status = reconciled_status(store, manager)
+    sched = next(c for c in status["conditions"]
+                 if c["type"] == "PodScheduled")
+    assert sched["reason"] == "Unschedulable"
+    assert "google.com/tpu" in sched["message"]
+    slice_ready = next(c for c in status["conditions"]
+                       if c["type"] == api.CONDITION_SLICE_READY)
+    assert slice_ready["status"] == "False"
+
+
+def test_slice_ready_requires_all_workers(store, manager,
+                                          notebook_reconciler):
+    """Multi-host slice: SliceReady only flips when EVERY worker pod is
+    Ready — the aggregate condition the single-pod reference lacks
+    (SURVEY §7 hard part #1)."""
+    apply_nb(store, manager, annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"})
+    ready = {"type": "Ready", "status": "True"}
+    for i in range(3):
+        stage_pod(store, "test", conditions=[ready], ordinal=i)
+    status = reconciled_status(store, manager)
+    cond = next(c for c in status["conditions"]
+                if c["type"] == api.CONDITION_SLICE_READY)
+    assert cond["status"] == "False"
+    assert cond["message"] == "3/4 workers ready"
+    stage_pod(store, "test", conditions=[ready], ordinal=3)
+    status = reconciled_status(store, manager)
+    cond = next(c for c in status["conditions"]
+                if c["type"] == api.CONDITION_SLICE_READY)
+    assert cond["status"] == "True"
+    assert cond["reason"] == "AllWorkersReady"
+
+
+def test_status_not_rewritten_when_stable(store, manager,
+                                          notebook_reconciler):
+    """No-op reconciles must not re-issue status writes (reference only
+    updates on semantic change, notebook_controller.go:245-257)."""
+    calls = []
+    orig = store.update_status
+
+    def spy(obj, **kw):
+        if obj.get("kind") == api.KIND:
+            calls.append(k8s.name(obj))
+        return orig(obj, **kw)
+
+    store.update_status = spy
+    apply_nb(store, manager)
+    assert calls == ["test"]  # exactly one initial status write
+    store.patch(api.KIND, NS, "test",
+                {"metadata": {"labels": {"touch": "1"}}})
+    drain(manager)
+    assert calls == ["test"]  # stable status → no second write

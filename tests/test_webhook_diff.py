@@ -1,0 +1,113 @@
+"""Structural-diff reporter + validating-webhook allow-path specs.
+
+Mirrors the reference's TestFirstDifferenceReporter / TestGetStructDiff
+(notebook_mutating_webhook_test.go:680-716) and the validating webhook's
+allow matrix (notebook_validating_webhook_test.go:88-227) — the deny paths
+already live in test_webhook.py / test_extension_matrix.py.
+"""
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webhook import (AdmissionDenied, NotebookMutatingWebhook,
+                                  NotebookValidatingWebhook)
+from kubeflow_tpu.webhook.diff import first_differences
+
+
+# ------------------------------------------------------------ diff reporter
+class TestFirstDifferences:
+    def test_equal_objects_no_diff(self):
+        obj = {"a": 1, "b": [1, 2], "c": {"d": "x"}}
+        assert first_differences(obj, obj) == []
+
+    def test_scalar_change_reports_path(self):
+        assert first_differences({"spec": {"image": "a"}},
+                                 {"spec": {"image": "b"}}) == \
+            ["spec.image: 'a' → 'b'"]
+
+    def test_added_and_removed_keys(self):
+        diffs = first_differences({"keep": 1, "gone": 2},
+                                  {"keep": 1, "new": 3})
+        assert "gone: 2 → <removed>" in diffs
+        assert "new: <absent> → 3" in diffs
+
+    def test_list_length_change_reported_at_list_path(self):
+        assert first_differences({"containers": [1]},
+                                 {"containers": [1, 2]}) == \
+            ["containers: len 1 → 2"]
+
+    def test_nested_list_element_change(self):
+        old = {"spec": {"containers": [{"image": "a"}]}}
+        new = {"spec": {"containers": [{"image": "b"}]}}
+        assert first_differences(old, new) == \
+            ["spec.containers[0].image: 'a' → 'b'"]
+
+    def test_limit_caps_output(self):
+        old = {str(i): i for i in range(20)}
+        new = {str(i): i + 1 for i in range(20)}
+        assert len(first_differences(old, new, limit=5)) == 5
+
+    def test_long_values_truncated(self):
+        old = {"k": "x" * 500}
+        new = {"k": "y"}
+        (line,) = first_differences(old, new)
+        assert len(line) < 200 and "..." in line
+
+    def test_type_change_reported(self):
+        assert first_differences({"v": 1}, {"v": "1"}) == ["v: 1 → '1'"]
+
+
+# ----------------------------------------------- validating allow matrix
+@pytest.fixture
+def world():
+    store = ClusterStore()
+    config = ControllerConfig(mlflow_enabled=True,
+                              gateway_url="gw.example.com")
+    NotebookMutatingWebhook(store, config).install(store)
+    NotebookValidatingWebhook(config).install(store)
+    return store
+
+
+class TestValidatingAllowPaths:
+    """Reference notebook_validating_webhook_test.go:88-227."""
+
+    def running_nb(self, store, annotations=None):
+        store.create(api.new_notebook("nb", "ns", annotations=annotations))
+        # clear the admission-injected reconciliation lock → "running"
+        return store.patch(api.KIND, "ns", "nb", {"metadata": {
+            "annotations": {names.STOP_ANNOTATION: None}}})
+
+    def test_allows_adding_mlflow_annotation_to_running(self, world):
+        self.running_nb(world)
+        out = world.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+            names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}}})
+        assert k8s.get_annotation(
+            out, names.MLFLOW_INSTANCE_ANNOTATION) == "mlflow"
+
+    def test_allows_update_without_touching_annotation(self, world):
+        self.running_nb(world, annotations={
+            names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"})
+        out = world.patch(api.KIND, "ns", "nb", {"metadata": {
+            "labels": {"team": "ds"}}})
+        assert k8s.get_annotation(
+            out, names.MLFLOW_INSTANCE_ANNOTATION) == "mlflow"
+
+    def test_denies_emptying_annotation_on_running(self, world):
+        self.running_nb(world, annotations={
+            names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"})
+        with pytest.raises(AdmissionDenied):
+            world.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+                names.MLFLOW_INSTANCE_ANNOTATION: ""}}})
+
+    def test_allows_removal_when_stopped(self, world):
+        self.running_nb(world, annotations={
+            names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"})
+        world.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+            names.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        out = world.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+            names.MLFLOW_INSTANCE_ANNOTATION: None}}})
+        assert k8s.get_annotation(
+            out, names.MLFLOW_INSTANCE_ANNOTATION) is None
