@@ -261,12 +261,9 @@ def sync_elyra_runtime_secret(client, config: ControllerConfig,
         except errors.AlreadyExistsError:
             pass
     else:
-        labels = k8s.get_in(existing, "metadata", "labels", default={}) or {}
-        if existing.get("data") != desired_data or \
-                labels.get(MANAGED_BY_KEY) != MANAGED_BY_VALUE:
-            # repair only our key — never clobber foreign labels
-            labels[MANAGED_BY_KEY] = MANAGED_BY_VALUE
-            existing.setdefault("metadata", {})["labels"] = labels
+        labels_changed = k8s.merge_managed_labels(
+            existing, {MANAGED_BY_KEY: MANAGED_BY_VALUE})
+        if existing.get("data") != desired_data or labels_changed:
             existing["data"] = desired_data
             client.update(existing)
     return True

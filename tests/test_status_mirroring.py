@@ -9,8 +9,6 @@ plus our aggregate SliceReady condition, which the single-pod reference
 doesn't have.
 """
 
-import pytest
-
 from kubeflow_tpu.api import types as api
 from kubeflow_tpu.utils import k8s, names
 from tests.conftest import drain
