@@ -341,6 +341,24 @@ def bench_decode(info: dict) -> None:
                   "ms_per_token_per_seq": round(per_call / new_tokens * 1e3,
                                                 3)})
 
+    # int8 weight-only serving path (models/quant.py): decode is HBM-bound,
+    # so halving weight bytes is the direct lever
+    from kubeflow_tpu.models.quant import quantize_params
+    qparams = quantize_params(params)
+    sync(gen(qparams, prompts))
+
+    def run_q(n):
+        out = None
+        for _ in range(n):
+            out = gen(qparams, prompts)
+        sync(out)
+    per_q = _timed_iters(run_q, counts=(2, 6))
+    tok_q = batch * new_tokens / per_q
+    _emit(info, metric="decode_int8_tokens_per_sec", value=round(tok_q, 1),
+          unit="tokens/s", vs_baseline=None,
+          detail={"batch": batch,
+                  "speedup_vs_f32": round(per_call / per_q, 3)})
+
 
 # ------------------------------------------------------- control-plane bench
 def _tpu_boot_verification():

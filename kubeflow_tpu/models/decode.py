@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .moe import MoEConfig, moe_mlp_block
+from .quant import wcast
 from .transformer import (TransformerConfig, apply_rope, attention_block,
                           mlp_block, rms_norm, rope_frequencies)
 
@@ -98,7 +99,7 @@ def prefill(params: dict, tokens: jax.Array, config: TransformerConfig):
 
     x, new_cache = lax.scan(layer_body, x, (params["blocks"], cache))
     x = rms_norm(x, params["final_norm"])
-    logits = jnp.einsum("bd,dv->bv", x[:, -1], params["lm_head"].astype(x.dtype))
+    logits = jnp.einsum("bd,dv->bv", x[:, -1], wcast(params["lm_head"], x.dtype))
     return logits.astype(jnp.float32), new_cache
 
 
@@ -136,9 +137,9 @@ def decode_step(params: dict, cache: dict, token: jax.Array,
         layer = jax.tree.map(lambda a: a[i], params["blocks"])
         h = rms_norm(x, layer["attn_norm"])
         dt = h.dtype
-        q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(dt))
-        k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(dt))
-        v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(dt))
+        q = jnp.einsum("bsd,dhk->bshk", h, wcast(layer["wq"], dt))
+        k = jnp.einsum("bsd,dhk->bshk", h, wcast(layer["wk"], dt))
+        v = jnp.einsum("bsd,dhk->bshk", h, wcast(layer["wv"], dt))
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         stacked = _write_cache(stacked, k, v, pos32, layer=i)
@@ -155,11 +156,11 @@ def decode_step(params: dict, cache: dict, token: jax.Array,
         probs = jax.nn.softmax(logits, axis=-1).astype(dt)
         out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, cv).reshape(
             B_, 1, H_, D_)
-        x = x + jnp.einsum("bshk,hkd->bsd", out, layer["wo"].astype(dt))
+        x = x + jnp.einsum("bshk,hkd->bsd", out, wcast(layer["wo"], dt))
         x = _mlp(x, layer, c)
 
     x = rms_norm(x, params["final_norm"])
-    logits = jnp.einsum("bd,dv->bv", x[:, 0], params["lm_head"].astype(x.dtype))
+    logits = jnp.einsum("bd,dv->bv", x[:, 0], wcast(params["lm_head"], x.dtype))
     return logits.astype(jnp.float32), stacked
 
 

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .quant import wcast
+
 from ..parallel.sharding import PartitionRules
 
 
@@ -184,9 +186,9 @@ def attention_block(x, layer, config: TransformerConfig, cos, sin, mesh=None,
     (k, v) — what a decode KV cache stores (models/decode.py prefill)."""
     c = config
     h = rms_norm(x, layer["attn_norm"])
-    q = jnp.einsum("bsd,dhk->bshk", h, layer["wq"].astype(h.dtype))
-    k = jnp.einsum("bsd,dhk->bshk", h, layer["wk"].astype(h.dtype))
-    v = jnp.einsum("bsd,dhk->bshk", h, layer["wv"].astype(h.dtype))
+    q = jnp.einsum("bsd,dhk->bshk", h, wcast(layer["wq"], h.dtype))
+    k = jnp.einsum("bsd,dhk->bshk", h, wcast(layer["wk"], h.dtype))
+    v = jnp.einsum("bsd,dhk->bshk", h, wcast(layer["wv"], h.dtype))
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     kv = (k, v)
@@ -210,17 +212,17 @@ def attention_block(x, layer, config: TransformerConfig, cos, sin, mesh=None,
             out = flash_attention(q, k, v, causal=True)
         else:
             out = xla_attention(q, k, v, causal=True)
-    x = x + jnp.einsum("bshk,hkd->bsd", out, layer["wo"].astype(h.dtype))
+    x = x + jnp.einsum("bshk,hkd->bsd", out, wcast(layer["wo"], h.dtype))
     return (x, kv) if return_kv else x
 
 
 def mlp_block(x, layer, config: TransformerConfig):
     h = rms_norm(x, layer["mlp_norm"])
     dt = h.dtype
-    gate = jnp.einsum("bsd,df->bsf", h, layer["w_gate"].astype(dt))
-    up = jnp.einsum("bsd,df->bsf", h, layer["w_up"].astype(dt))
+    gate = jnp.einsum("bsd,df->bsf", h, wcast(layer["w_gate"], dt))
+    up = jnp.einsum("bsd,df->bsf", h, wcast(layer["w_up"], dt))
     return x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
-                          layer["w_down"].astype(dt))
+                          wcast(layer["w_down"], dt))
 
 
 def forward_hidden(params: dict, tokens: jax.Array,
@@ -258,7 +260,7 @@ def forward(params: dict, tokens: jax.Array, config: TransformerConfig,
             mesh=None, positions: jax.Array | None = None) -> jax.Array:
     """tokens: (batch, seq) int32 → logits (batch, seq, vocab) float32."""
     x = forward_hidden(params, tokens, config, mesh=mesh, positions=positions)
-    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(x.dtype)
+    return jnp.einsum("bsd,dv->bsv", x, wcast(params["lm_head"], x.dtype)
                       ).astype(jnp.float32)
 
 
@@ -291,7 +293,7 @@ def pipelined_forward(params: dict, tokens: jax.Array,
     x = pipeline_apply(stages, x, stage_fn, mesh=mesh,
                        n_microbatches=n_microbatches)
     x = rms_norm(x, params["final_norm"])
-    return jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(x.dtype)
+    return jnp.einsum("bsd,dv->bsv", x, wcast(params["lm_head"], x.dtype)
                       ).astype(jnp.float32)
 
 

@@ -58,7 +58,15 @@ class BatchedGenerator:
     """
 
     def __init__(self, params, config, *, max_batch: int = 8,
-                 max_wait_s: float = 0.01, seed: int = 0):
+                 max_wait_s: float = 0.01, seed: int = 0,
+                 quantize: bool = False):
+        if quantize:
+            # int8 weight-only serving: decode is HBM-bound, so halving
+            # weight bytes is 1.25-1.4x tokens/s on v5e and a 4x smaller
+            # weight footprint (models/quant.py); ~3% logits error,
+            # sampling-grade
+            from ..models.quant import quantize_params
+            params = quantize_params(params)
         self.params = params
         self.config = config
         self.max_batch = max_batch

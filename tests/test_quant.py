@@ -1,0 +1,124 @@
+"""Int8 weight-only serving quantization (models/quant.py).
+
+Runs on the virtual CPU mesh — numerics only; the decode speedup is
+measured on hardware by bench.py (``decode_int8_tokens_per_sec``).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from kubeflow_tpu.models import quant
+from kubeflow_tpu.models.decode import generate
+from kubeflow_tpu.models.moe import MoEConfig, init_moe_params
+from kubeflow_tpu.models.transformer import (TransformerConfig, forward,
+                                             init_params)
+
+CFG = TransformerConfig(vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+                        n_kv_heads=2, d_ff=128, max_seq_len=64,
+                        dtype="float32")
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(jax.random.key(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def qparams(params):
+    return quant.quantize_params(params)
+
+
+def test_roundtrip_error_bounded(params, qparams):
+    for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+        w = params["blocks"][name].astype(jnp.float32)
+        back = quant.wcast(qparams["blocks"][name], jnp.float32)
+        rel = jnp.linalg.norm(w - back) / jnp.linalg.norm(w)
+        assert rel < 0.01, (name, float(rel))
+
+
+def test_scales_keep_dims_for_layer_slicing(qparams):
+    wq = qparams["blocks"]["wq"]
+    assert wq["q"].dtype == jnp.int8
+    assert wq["s"].shape == (CFG.n_layers, 1, CFG.n_heads, CFG.d_head)
+    # per-layer tree slicing (decode_step) must slice q and s coherently
+    layer0 = jax.tree.map(lambda a: a[0], qparams["blocks"])
+    assert layer0["wq"]["q"].shape == (CFG.d_model, CFG.n_heads, CFG.d_head)
+    assert layer0["wq"]["s"].shape == (1, CFG.n_heads, CFG.d_head)
+
+
+def test_unquantized_leaves_untouched(params, qparams):
+    assert qparams["embed"] is params["embed"]
+    assert qparams["blocks"]["attn_norm"] is params["blocks"]["attn_norm"]
+    assert quant.is_quantized(qparams["lm_head"])
+
+
+def test_wcast_plain_array_is_astype():
+    x = jnp.ones((2, 2), jnp.float32)
+    out = quant.wcast(x, jnp.bfloat16)
+    assert out.dtype == jnp.bfloat16
+
+
+def test_forward_logits_close(params, qparams):
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                                CFG.vocab_size)
+    lf = forward(params, tokens, CFG)
+    lq = forward(qparams, tokens, CFG)
+    rel = jnp.linalg.norm(lf - lq) / jnp.linalg.norm(lf)
+    assert rel < 0.05, float(rel)
+
+
+def test_generate_runs_quantized(qparams):
+    prompts = jax.random.randint(jax.random.key(2), (2, 8), 0,
+                                 CFG.vocab_size)
+    out = generate(qparams, prompts, CFG, 8)
+    assert out.shape == (2, 8)
+    assert out.dtype == jnp.int32
+
+
+def test_decode_path_logits_close(params, qparams):
+    """The decode path dequantizes at its own wcast sites (decode_step's
+    unrolled layers + lm head) — pin its numerics against f32, not just
+    transformer.forward's."""
+    from kubeflow_tpu.models.decode import decode_step, prefill
+
+    prompts = jax.random.randint(jax.random.key(3), (2, 8), 0,
+                                 CFG.vocab_size)
+    lf, cf = prefill(params, prompts, CFG)
+    lq, cq = prefill(qparams, prompts, CFG)
+    rel = jnp.linalg.norm(lf - lq) / jnp.linalg.norm(lf)
+    assert rel < 0.05, float(rel)
+    token = jnp.argmax(lf, axis=-1).astype(jnp.int32)
+    sf, _ = decode_step(params, cf, token, jnp.int32(8), CFG)
+    sq, _ = decode_step(qparams, cq, token, jnp.int32(8), CFG)
+    rel = jnp.linalg.norm(sf - sq) / jnp.linalg.norm(sf)
+    assert rel < 0.05, float(rel)
+
+
+def test_quantize_params_idempotent(qparams):
+    assert quant.quantize_params(qparams) is qparams
+
+
+def test_zero_channel_weights_quantize_to_zero():
+    w = jnp.zeros((2, 4, 4), jnp.float32)
+    q = quant.quantize_weight(w, (1,))
+    assert jnp.all(q["q"] == 0)
+    assert jnp.all(jnp.isfinite(q["s"]))
+    assert jnp.all(quant.wcast(q, jnp.float32) == 0.0)
+
+
+def test_moe_params_rejected():
+    moe_cfg = MoEConfig(vocab_size=256, d_model=32, n_layers=2, n_heads=2,
+                        n_kv_heads=2, d_ff=64, max_seq_len=32,
+                        n_experts=4, dtype="float32")
+    moe_params = init_moe_params(jax.random.key(0), moe_cfg)
+    with pytest.raises(NotImplementedError):
+        quant.quantize_params(moe_params)
+
+
+def test_batched_generator_quantize_flag(params):
+    from kubeflow_tpu.runtime.serving import BatchedGenerator
+    with BatchedGenerator(params, CFG, quantize=True) as gen:
+        assert quant.is_quantized(gen.params["lm_head"])
+        out = gen.generate_sync([1, 2, 3], 4)
+        assert out.shape == (4,)
