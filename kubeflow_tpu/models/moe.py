@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.sharding import (PartitionRules, batch_sharding,
                                  param_shardings)
+from .quant import wcast
 from .transformer import (TransformerConfig, attention_block, rms_norm,
                           rope_frequencies)
 
@@ -187,7 +188,7 @@ def moe_mlp_block(x: jax.Array, layer: dict, config: MoEConfig,
     hg = h.reshape(groups, g, D)
     router_logits = jnp.einsum(
         "gnd,de->gne", hg.astype(jnp.float32),
-        layer["router"].astype(jnp.float32))
+        wcast(layer["router"], jnp.float32))
     capacity = expert_capacity(g, c)
     combine, dispatch, aux = jax.vmap(
         lambda logits: route_tokens(logits, c, capacity))(router_logits)
@@ -199,10 +200,10 @@ def moe_mlp_block(x: jax.Array, layer: dict, config: MoEConfig,
     if mesh is not None and mesh.shape.get("ep", 1) > 1:
         expert_in = lax.with_sharding_constraint(
             expert_in, NamedSharding(mesh, P(None, "ep", None, None)))
-    gate = jnp.einsum("gecd,edf->gecf", expert_in, layer["w_gate"].astype(dt))
-    up = jnp.einsum("gecd,edf->gecf", expert_in, layer["w_up"].astype(dt))
+    gate = jnp.einsum("gecd,edf->gecf", expert_in, wcast(layer["w_gate"], dt))
+    up = jnp.einsum("gecd,edf->gecf", expert_in, wcast(layer["w_up"], dt))
     expert_out = jnp.einsum("gecf,efd->gecd", jax.nn.silu(gate) * up,
-                            layer["w_down"].astype(dt))
+                            wcast(layer["w_down"], dt))
     out = jnp.einsum("gnec,gecd->gnd", combine.astype(dt), expert_out)
     return x + out.reshape(B, S, D), aux
 
@@ -237,7 +238,7 @@ def moe_forward(params: dict, tokens: jax.Array, config: MoEConfig,
     """tokens (batch, seq) → (logits (b, s, vocab) f32, aux_loss scalar)."""
     x, aux = moe_forward_hidden(params, tokens, config, mesh=mesh,
                                 positions=positions)
-    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"].astype(x.dtype)
+    logits = jnp.einsum("bsd,dv->bsv", x, wcast(params["lm_head"], x.dtype)
                         ).astype(jnp.float32)
     return logits, aux
 

@@ -41,6 +41,17 @@ _BLOCK_AXES = {
     "w_down": (1,),    # (L, f, d)      contracts f
 }
 
+# MoE expert weights carry an (L, E, ...) experts axis: scales are
+# per-expert per-output-channel. The router projection stays full
+# precision — routing decisions (argmax over E) are far more sensitive to
+# quantization than the expert FFN values, and it is tiny (d x E).
+_MOE_BLOCK_AXES = {
+    **_BLOCK_AXES,     # attention weights are identical in both families
+    "w_gate": (2,),    # (L, E, d, f)   contracts d
+    "w_up": (2,),
+    "w_down": (2,),    # (L, E, f, d)   contracts f
+}
+
 
 def is_quantized(w) -> bool:
     return isinstance(w, dict) and "q" in w and "s" in w
@@ -60,21 +71,20 @@ def quantize_params(params: dict) -> dict:
     returned tree drops the f32 masters for the quantized leaves (the
     memory saving is part of the point: a 4x smaller serving footprint).
 
-    Dense family only: MoE expert weights carry an extra experts axis the
-    per-channel axes above don't describe (and the expert matmuls in
-    moe.py read weights directly)."""
+    MoE params quantize with expert-axis-aware scales (per-expert
+    per-output-channel); the router projection stays full precision —
+    top-k routing decisions are more quantization-sensitive than the
+    expert FFN values, and the router is tiny."""
     if is_quantized(params.get("lm_head")):
         return params  # already quantized: idempotent
     blocks = params["blocks"]
-    if "router" in blocks or getattr(blocks.get("w_gate"), "ndim", 3) == 4:
-        raise NotImplementedError(
-            "int8 weight-only serving supports the dense transformer "
-            "family; MoE expert weights need expert-axis-aware scales")
+    is_moe = "router" in blocks
+    axes_map = _MOE_BLOCK_AXES if is_moe else _BLOCK_AXES
     out = dict(params)
     out["blocks"] = {
-        name: (quantize_weight(w, _BLOCK_AXES[name])
-               if name in _BLOCK_AXES else w)
-        for name, w in params["blocks"].items()
+        name: (quantize_weight(w, axes_map[name])
+               if name in axes_map else w)
+        for name, w in blocks.items()
     }
     out["lm_head"] = quantize_weight(params["lm_head"], (0,))  # (d, v)
     return out

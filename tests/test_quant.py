@@ -107,13 +107,43 @@ def test_zero_channel_weights_quantize_to_zero():
     assert jnp.all(quant.wcast(q, jnp.float32) == 0.0)
 
 
-def test_moe_params_rejected():
-    moe_cfg = MoEConfig(vocab_size=256, d_model=32, n_layers=2, n_heads=2,
-                        n_kv_heads=2, d_ff=64, max_seq_len=32,
-                        n_experts=4, dtype="float32")
-    moe_params = init_moe_params(jax.random.key(0), moe_cfg)
-    with pytest.raises(NotImplementedError):
-        quant.quantize_params(moe_params)
+class TestMoE:
+    CFG = MoEConfig(vocab_size=256, d_model=32, n_layers=2, n_heads=2,
+                    n_kv_heads=2, d_ff=64, max_seq_len=32,
+                    n_experts=4, dtype="float32")
+
+    @pytest.fixture(scope="class")
+    def moe_params(self):
+        return init_moe_params(jax.random.key(0), self.CFG)
+
+    @pytest.fixture(scope="class")
+    def moe_q(self, moe_params):
+        return quant.quantize_params(moe_params)
+
+    def test_expert_scales_are_per_expert(self, moe_q):
+        wg = moe_q["blocks"]["w_gate"]
+        assert wg["q"].dtype == jnp.int8
+        # (L, E, d, f) contracts d → per-expert per-f-channel scales
+        assert wg["s"].shape == (self.CFG.n_layers, self.CFG.n_experts,
+                                 1, self.CFG.d_ff)
+
+    def test_router_stays_full_precision(self, moe_params, moe_q):
+        assert moe_q["blocks"]["router"] is moe_params["blocks"]["router"]
+
+    def test_moe_forward_logits_close(self, moe_params, moe_q):
+        from kubeflow_tpu.models.moe import moe_forward
+        tokens = jax.random.randint(jax.random.key(4), (2, 16), 0,
+                                    self.CFG.vocab_size)
+        lf, _ = moe_forward(moe_params, tokens, self.CFG)
+        lq, _ = moe_forward(moe_q, tokens, self.CFG)
+        rel = jnp.linalg.norm(lf - lq) / jnp.linalg.norm(lf)
+        assert rel < 0.05, float(rel)
+
+    def test_moe_generate_runs_quantized(self, moe_q):
+        prompts = jax.random.randint(jax.random.key(5), (2, 8), 0,
+                                     self.CFG.vocab_size)
+        out = generate(moe_q, prompts, self.CFG, 4)
+        assert out.shape == (2, 4)
 
 
 def test_batched_generator_quantize_flag(params):
