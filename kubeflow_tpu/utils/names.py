@@ -19,8 +19,19 @@ LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION = (
 RESTART_ANNOTATION = "notebooks.opendatahub.io/notebook-restart"
 UPDATE_PENDING_ANNOTATION = "notebooks.opendatahub.io/update-pending"
 INJECT_AUTH_ANNOTATION = "notebooks.opendatahub.io/inject-auth"
+# legacy combined forms (set request AND limit together)
 AUTH_SIDECAR_CPU_ANNOTATION = "notebooks.opendatahub.io/auth-sidecar-cpu"
 AUTH_SIDECAR_MEMORY_ANNOTATION = "notebooks.opendatahub.io/auth-sidecar-memory"
+# reference's split request/limit forms (odh notebook_controller.go:59-66);
+# explicit request/limit annotations win over the combined forms
+AUTH_SIDECAR_CPU_REQUEST_ANNOTATION = \
+    "notebooks.opendatahub.io/auth-sidecar-cpu-request"
+AUTH_SIDECAR_CPU_LIMIT_ANNOTATION = \
+    "notebooks.opendatahub.io/auth-sidecar-cpu-limit"
+AUTH_SIDECAR_MEMORY_REQUEST_ANNOTATION = \
+    "notebooks.opendatahub.io/auth-sidecar-memory-request"
+AUTH_SIDECAR_MEMORY_LIMIT_ANNOTATION = \
+    "notebooks.opendatahub.io/auth-sidecar-memory-limit"
 MLFLOW_INSTANCE_ANNOTATION = "opendatahub.io/mlflow-instance"
 FEAST_LABEL = "opendatahub.io/feast-integration"
 WORKBENCHES_LABEL = "opendatahub.io/workbenches"

@@ -400,11 +400,68 @@ class NotebookMutatingWebhook:
 
     # ------------------------------------------------- sidecar (stage 5)
     def _auth_sidecar_resources(self, nb: dict) -> dict:
-        cpu = k8s.get_annotation(nb, names.AUTH_SIDECAR_CPU_ANNOTATION, "100m")
-        mem = k8s.get_annotation(nb, names.AUTH_SIDECAR_MEMORY_ANNOTATION,
-                                 "64Mi")
-        return {"requests": {"cpu": cpu, "memory": mem},
-                "limits": {"cpu": cpu, "memory": mem}}
+        """Parse + validate the sidecar resource annotations (reference
+        parseAndValidateAuthSidecarResources,
+        notebook_mutating_webhook.go:132-181): defaults 100m/64Mi, the
+        split request/limit annotations (legacy combined forms set both),
+        whitespace trimmed, invalid or negative quantities and
+        request > limit DENY admission — the original notebook is
+        preserved (fail-early, auth_proxy_resources_test.go:509-566)."""
+        from .validating import AdmissionDenied
+
+        explicit = {
+            "cpu-request": names.AUTH_SIDECAR_CPU_REQUEST_ANNOTATION,
+            "cpu-limit": names.AUTH_SIDECAR_CPU_LIMIT_ANNOTATION,
+            "memory-request": names.AUTH_SIDECAR_MEMORY_REQUEST_ANNOTATION,
+            "memory-limit": names.AUTH_SIDECAR_MEMORY_LIMIT_ANNOTATION,
+        }
+        # value + the annotation it came from (for actionable errors)
+        values = {"cpu-request": ("100m", None), "cpu-limit": ("100m", None),
+                  "memory-request": ("64Mi", None),
+                  "memory-limit": ("64Mi", None)}
+        legacy = {"cpu": names.AUTH_SIDECAR_CPU_ANNOTATION,
+                  "memory": names.AUTH_SIDECAR_MEMORY_ANNOTATION}
+        # reference-exact presence rule (notebook_mutating_webhook.go:157):
+        # an EMPTY-STRING annotation is treated as absent (defaults apply);
+        # any non-empty value — including whitespace-only — is trimmed and
+        # validated, so " " denies while "" defaults, matching the Go code
+        for res, ann in legacy.items():
+            raw = k8s.get_annotation(nb, ann)
+            if raw:
+                values[f"{res}-request"] = values[f"{res}-limit"] = (raw, ann)
+        for key, ann in explicit.items():
+            raw = k8s.get_annotation(nb, ann)
+            if raw:
+                values[key] = (raw, ann)
+
+        parsed = {}
+        for key, (raw, source) in values.items():
+            raw = raw.strip()
+            source = source or explicit[key]
+            try:
+                parsed[key] = k8s.parse_quantity(raw)
+            except ValueError as e:
+                raise AdmissionDenied(
+                    "invalid kube-rbac-proxy resource configuration: "
+                    f"invalid value for annotation '{source}': "
+                    f"{raw!r}: {e}")
+            if parsed[key] < 0:
+                raise AdmissionDenied(
+                    "invalid kube-rbac-proxy resource configuration: "
+                    f"annotation '{source}' value '{raw}' cannot be "
+                    "negative")
+            values[key] = (raw, source)
+        for res in ("cpu", "memory"):
+            if parsed[f"{res}-request"] > parsed[f"{res}-limit"]:
+                raise AdmissionDenied(
+                    "invalid kube-rbac-proxy resource configuration: "
+                    f"{res} request ({values[res + '-request'][0]}) "
+                    f"cannot be greater than {res} limit "
+                    f"({values[res + '-limit'][0]})")
+        return {"requests": {"cpu": values["cpu-request"][0],
+                             "memory": values["memory-request"][0]},
+                "limits": {"cpu": values["cpu-limit"][0],
+                           "memory": values["memory-limit"][0]}}
 
     def _inject_auth_proxy(self, nb: dict) -> None:
         """kube-rbac-proxy sidecar (reference InjectKubeRbacProxy, :183-334):

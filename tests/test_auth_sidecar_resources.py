@@ -1,0 +1,212 @@
+"""Auth-sidecar resource validation spec.
+
+Mirrors the reference's ``auth_proxy_resources_test.go`` (420 lines):
+TestParseAndValidateAuthSidecarResources' annotation table (defaults,
+custom values, partial overrides, whitespace trimming, invalid formats,
+negative values, request > limit) and
+TestInjectKubeRbacProxyWithResourceValidation's fail-early contract —
+invalid resources deny admission and the original notebook is preserved.
+"""
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webhook import (AdmissionDenied, NotebookMutatingWebhook,
+                                  NotebookValidatingWebhook)
+from kubeflow_tpu.webhook.mutating import AUTH_PROXY_CONTAINER
+
+CPU_REQ = names.AUTH_SIDECAR_CPU_REQUEST_ANNOTATION
+CPU_LIM = names.AUTH_SIDECAR_CPU_LIMIT_ANNOTATION
+MEM_REQ = names.AUTH_SIDECAR_MEMORY_REQUEST_ANNOTATION
+MEM_LIM = names.AUTH_SIDECAR_MEMORY_LIMIT_ANNOTATION
+
+
+# -------------------------------------------------------- quantity parsing
+class TestParseQuantity:
+    @pytest.mark.parametrize("raw,expected", [
+        ("100m", 0.1),
+        ("1", 1.0),
+        ("2.5", 2.5),
+        ("64Mi", 64 * 2**20),
+        ("1Gi", 2**30),
+        ("128k", 128e3),
+        ("1e3", 1000.0),
+        (" 250m ", 0.25),
+        ("2E", 2e18),      # exa suffix, not an exponent
+        ("1E3", 1000.0),   # exponent (digits follow)
+        ("100n", 1e-7),
+        ("500u", 5e-4),
+    ])
+    def test_valid(self, raw, expected):
+        assert k8s.parse_quantity(raw) == pytest.approx(expected)
+
+    @pytest.mark.parametrize("raw", ["abc", "100x", "Mi", "", "1.2.3",
+                                     "100 m", "1e3Ki"])
+    def test_invalid(self, raw):
+        # same grammar as the CRD schema's quantity pattern: an
+        # exponent+suffix combo like 1e3Ki is rejected, as on a real
+        # apiserver
+        with pytest.raises(ValueError):
+            k8s.parse_quantity(raw)
+
+    def test_negative_parses_as_negative(self):
+        assert k8s.parse_quantity("-100m") == pytest.approx(-0.1)
+
+
+# -------------------------------------------------------- annotation table
+def webhook():
+    return NotebookMutatingWebhook(ClusterStore(), ControllerConfig())
+
+
+def nb(annotations=None):
+    ann = {names.INJECT_AUTH_ANNOTATION: "true"}
+    ann.update(annotations or {})
+    return {"apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+            "metadata": {"name": "nb", "namespace": "ns",
+                         "annotations": ann},
+            "spec": {"template": {"spec": {"containers": [
+                {"name": "nb", "image": "img"}]}}}}
+
+
+def sidecar_resources(out):
+    sidecar = k8s.find_container(api.notebook_pod_spec(out),
+                                 AUTH_PROXY_CONTAINER)
+    assert sidecar is not None
+    return sidecar["resources"]
+
+
+class TestResourceAnnotations:
+    """Reference TestParseAndValidateAuthSidecarResources
+    (auth_proxy_resources_test.go:140-420)."""
+
+    def test_no_annotations_all_defaults(self):
+        res = sidecar_resources(webhook().handle("CREATE", nb(), None))
+        assert res == {"requests": {"cpu": "100m", "memory": "64Mi"},
+                       "limits": {"cpu": "100m", "memory": "64Mi"}}
+
+    def test_all_custom_values(self):
+        out = webhook().handle("CREATE", nb({
+            CPU_REQ: "250m", CPU_LIM: "500m",
+            MEM_REQ: "128Mi", MEM_LIM: "256Mi"}), None)
+        assert sidecar_resources(out) == {
+            "requests": {"cpu": "250m", "memory": "128Mi"},
+            "limits": {"cpu": "500m", "memory": "256Mi"}}
+
+    def test_partial_annotations_keep_defaults(self):
+        out = webhook().handle("CREATE", nb({MEM_LIM: "256Mi"}), None)
+        assert sidecar_resources(out) == {
+            "requests": {"cpu": "100m", "memory": "64Mi"},
+            "limits": {"cpu": "100m", "memory": "256Mi"}}
+
+    def test_whitespace_trimmed(self):
+        out = webhook().handle("CREATE", nb({CPU_REQ: "  50m  "}), None)
+        assert sidecar_resources(out)["requests"]["cpu"] == "50m"
+
+    def test_equal_requests_and_limits_allowed(self):
+        out = webhook().handle("CREATE", nb({
+            CPU_REQ: "200m", CPU_LIM: "200m"}), None)
+        assert sidecar_resources(out)["limits"]["cpu"] == "200m"
+
+    def test_legacy_combined_annotation_sets_both(self):
+        out = webhook().handle("CREATE", nb({
+            names.AUTH_SIDECAR_CPU_ANNOTATION: "300m"}), None)
+        res = sidecar_resources(out)
+        assert res["requests"]["cpu"] == "300m"
+        assert res["limits"]["cpu"] == "300m"
+
+    def test_explicit_wins_over_legacy(self):
+        out = webhook().handle("CREATE", nb({
+            names.AUTH_SIDECAR_CPU_ANNOTATION: "300m",
+            CPU_LIM: "600m"}), None)
+        res = sidecar_resources(out)
+        assert res["requests"]["cpu"] == "300m"
+        assert res["limits"]["cpu"] == "600m"
+
+    @pytest.mark.parametrize("ann,value,fragment", [
+        (CPU_REQ, "invalid", "invalid value"),
+        (MEM_REQ, "64Zi", "invalid value"),
+        (CPU_LIM, "10cores", "invalid value"),
+        (MEM_LIM, "##", "invalid value"),
+        (CPU_REQ, "-100m", "negative"),
+        (MEM_REQ, "-64Mi", "negative"),
+        (CPU_LIM, "-1", "negative"),
+        (MEM_LIM, "-1Gi", "negative"),
+    ])
+    def test_invalid_values_denied(self, ann, value, fragment):
+        with pytest.raises(AdmissionDenied, match=fragment):
+            webhook().handle("CREATE", nb({ann: value}), None)
+
+    @pytest.mark.parametrize("annotations,fragment", [
+        ({CPU_REQ: "500m", CPU_LIM: "250m"}, "cpu request"),
+        ({MEM_REQ: "256Mi", MEM_LIM: "128Mi"}, "memory request"),
+        # request above the DEFAULT limit is also a violation
+        ({CPU_REQ: "2"}, "cpu request"),
+        ({MEM_REQ: "1Gi"}, "memory request"),
+    ])
+    def test_request_greater_than_limit_denied(self, annotations, fragment):
+        with pytest.raises(AdmissionDenied, match=fragment):
+            webhook().handle("CREATE", nb(annotations), None)
+
+    def test_empty_annotation_treated_as_absent(self):
+        """Reference-exact (notebook_mutating_webhook.go:157): '' keeps
+        the defaults while a whitespace-only value trims to '' in
+        ParseQuantity and denies."""
+        out = webhook().handle("CREATE", nb({CPU_REQ: ""}), None)
+        assert sidecar_resources(out)["requests"]["cpu"] == "100m"
+        with pytest.raises(AdmissionDenied):
+            webhook().handle("CREATE", nb({CPU_REQ: "   "}), None)
+
+    def test_units_compared_semantically_not_textually(self):
+        # 0.2 cores < 500m, 100Mi < 1Gi — fine despite mixed suffixes
+        out = webhook().handle("CREATE", nb({
+            CPU_REQ: "0.2", CPU_LIM: "500m",
+            MEM_REQ: "100Mi", MEM_LIM: "1Gi"}), None)
+        assert sidecar_resources(out)["requests"]["cpu"] == "0.2"
+
+
+# ------------------------------------------------------ fail-early contract
+class TestFailEarly:
+    """Reference TestInjectKubeRbacProxyWithResourceValidation
+    (auth_proxy_resources_test.go:28-138) + 'preserve original notebook
+    when resource validation fails' (notebook_mutating_webhook_test.go:509)."""
+
+    def test_invalid_resources_deny_create_through_admission(self):
+        store = ClusterStore()
+        config = ControllerConfig()
+        NotebookMutatingWebhook(store, config).install(store)
+        NotebookValidatingWebhook(config).install(store)
+        with pytest.raises(AdmissionDenied):
+            store.create(api.new_notebook("nb", "ns", annotations={
+                names.INJECT_AUTH_ANNOTATION: "true",
+                CPU_REQ: "totally-invalid"}))
+        assert store.get_or_none(api.KIND, "ns", "nb") is None
+
+    def test_invalid_resources_deny_update_preserving_original(self):
+        store = ClusterStore()
+        config = ControllerConfig()
+        NotebookMutatingWebhook(store, config).install(store)
+        NotebookValidatingWebhook(config).install(store)
+        store.create(api.new_notebook("nb", "ns", annotations={
+            names.INJECT_AUTH_ANNOTATION: "true"}))
+        with pytest.raises(AdmissionDenied):
+            store.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+                CPU_REQ: "900m"}}})  # above default 100m limit
+        current = store.get(api.KIND, "ns", "nb")
+        assert k8s.get_annotation(current, CPU_REQ) is None
+        res = sidecar_resources(current)
+        assert res["requests"]["cpu"] == "100m"  # original untouched
+
+    def test_valid_custom_resources_through_admission(self):
+        store = ClusterStore()
+        config = ControllerConfig()
+        NotebookMutatingWebhook(store, config).install(store)
+        store.create(api.new_notebook("nb", "ns", annotations={
+            names.INJECT_AUTH_ANNOTATION: "true",
+            CPU_REQ: "250m", CPU_LIM: "1",
+            MEM_REQ: "128Mi", MEM_LIM: "512Mi"}))
+        res = sidecar_resources(store.get(api.KIND, "ns", "nb"))
+        assert res == {"requests": {"cpu": "250m", "memory": "128Mi"},
+                       "limits": {"cpu": "1", "memory": "512Mi"}}
