@@ -27,12 +27,10 @@ from __future__ import annotations
 import re
 from typing import Any
 
-# k8s resource.Quantity surface syntax (approximate but accepts everything
-# kubectl does: plain/decimal numbers, binary suffixes Ki..Ei, SI suffixes,
-# scientific notation)
-QUANTITY_PATTERN = (
-    r"^[+-]?([0-9]+(\.[0-9]*)?|\.[0-9]+)"
-    r"(([eE][+-]?[0-9]+)|[kKMGTPE]i?|m|u|n)?$")
+# k8s resource.Quantity surface syntax — single source of truth shared
+# with the webhook's parse_quantity (utils/k8s.py), so CRD validation and
+# admission-time validation can never drift apart
+from ..utils.k8s import QUANTITY_PATTERN  # noqa: E402,F401
 
 _DNS1123_LABEL = r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$"
 

@@ -33,21 +33,50 @@ from .store import WatchEvent
 DEFAULT_DISABLE_FOR = ("Secret", "ConfigMap")
 
 
+LAST_APPLIED_ANNOTATION = "kubectl.kubernetes.io/last-applied-configuration"
+
+
+def _strip_metadata_bulk(obj: dict) -> dict:
+    """Drop managedFields and the kubectl last-applied-configuration
+    annotation (which duplicates the whole payload) while preserving every
+    other label/annotation — the reference's cache transforms do the same
+    (main_test.go:33-45,70-86); tolerates absent/None metadata maps."""
+    meta = obj.get("metadata")
+    if not isinstance(meta, dict):
+        return obj
+    meta = dict(meta)
+    meta.pop("managedFields", None)
+    anns = meta.get("annotations")
+    if isinstance(anns, dict) and LAST_APPLIED_ANNOTATION in anns:
+        anns = dict(anns)
+        anns.pop(LAST_APPLIED_ANNOTATION)
+        meta["annotations"] = anns
+    obj = dict(obj)
+    obj["metadata"] = meta
+    return obj
+
+
 def strip_secret_data(obj: dict) -> dict:
-    """Transform analog of stripSecretData (main.go:95-109)."""
+    """Transform analog of stripSecretData (main.go:95-109): drops data/
+    stringData/managedFields/last-applied, preserves type, labels, and
+    other annotations; non-Secret objects pass through unchanged."""
     if obj.get("kind") == "Secret":
         obj = dict(obj)
         obj.pop("data", None)
         obj.pop("stringData", None)
+        obj = _strip_metadata_bulk(obj)
     return obj
 
 
 def strip_configmap_data(obj: dict) -> dict:
-    """Transform analog of stripConfigMapData (main.go:111-125)."""
+    """Transform analog of stripConfigMapData (main.go:111-125): drops
+    data/binaryData/managedFields/last-applied, preserves labels and other
+    annotations; non-ConfigMap objects pass through unchanged."""
     if obj.get("kind") == "ConfigMap":
         obj = dict(obj)
         obj.pop("data", None)
         obj.pop("binaryData", None)
+        obj = _strip_metadata_bulk(obj)
     return obj
 
 

@@ -151,17 +151,18 @@ def is_owned_by(obj: Obj, owner_uid: str) -> bool:
                for r in get_in(obj, "metadata", "ownerReferences", default=[]) or [])
 
 
-# same grammar as the CRD schema's QUANTITY_PATTERN (api/schema.py:33-35):
-# signed number followed by EITHER an exponent OR a single SI/binary
-# suffix — an exponent+suffix combo like "1e3Ki" is invalid, exactly as a
-# real apiserver treats it
-_QUANTITY_RE = re.compile(
-    r"^[+-]?([0-9]+(?:\.[0-9]*)?|\.[0-9]+)"
-    r"(([eE][+-]?[0-9]+)|[kKMGTPE]i?|m|u|n)?$")
+# THE quantity grammar — one source of truth shared by parse_quantity and
+# the generated CRD schema (api/schema.py imports this): signed number
+# followed by EITHER an exponent OR a single valid suffix (binary Ki..Ei,
+# decimal n/u/m/k/M/G/T/P/E) — never both; lowercase "ki" and bare "K" are
+# rejected, as on a real apiserver
+QUANTITY_PATTERN = (
+    r"^[+-]?([0-9]+(\.[0-9]*)?|\.[0-9]+)"
+    r"(([eE][+-]?[0-9]+)|Ki|Mi|Gi|Ti|Pi|Ei|[numkMGTPE])?$")
+_QUANTITY_RE = re.compile(QUANTITY_PATTERN)
 _QUANTITY_SUFFIX = {
-    "n": 1e-9, "u": 1e-6, "m": 1e-3,
-    "k": 1e3, "K": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15,
-    "E": 1e18,
+    "": 1.0, "n": 1e-9, "u": 1e-6, "m": 1e-3,
+    "k": 1e3, "M": 1e6, "G": 1e9, "T": 1e12, "P": 1e15, "E": 1e18,
     "Ki": 2**10, "Mi": 2**20, "Gi": 2**30, "Ti": 2**40, "Pi": 2**50,
     "Ei": 2**60,
 }
@@ -169,18 +170,18 @@ _QUANTITY_SUFFIX = {
 
 def parse_quantity(value: str) -> float:
     """Kubernetes resource.Quantity → float (canonical units: cores for
-    CPU, bytes for memory). Same grammar as the generated CRD schema's
-    quantity pattern; raises ValueError on anything a real apiserver would
-    reject."""
+    CPU, bytes for memory). Exactly the QUANTITY_PATTERN grammar; raises
+    ValueError on anything outside it (unknown suffixes are a regex
+    non-match, never a silent factor-1 fallback)."""
     text = value.strip()
     m = _QUANTITY_RE.match(text)
     if not m:
         raise ValueError(f"invalid quantity {value!r}")
-    number, tail, exponent = m.group(1), m.group(2) or "", m.group(3)
-    sign = -1.0 if text.lstrip().startswith("-") else 1.0
+    number, tail, exponent = m.group(1), m.group(3) or "", m.group(4)
+    sign = -1.0 if text.startswith("-") else 1.0
     if exponent:  # scientific notation: the whole thing is the number
         return sign * float(number + exponent)
-    return sign * float(number) * _QUANTITY_SUFFIX.get(tail, 1.0)
+    return sign * float(number) * _QUANTITY_SUFFIX[tail]
 
 
 def merge_managed_labels(obj: Obj, managed: dict[str, str]) -> bool:

@@ -36,6 +36,7 @@ from ..tpu.topology import parse_slice_request
 from ..utils import k8s, names, tracing
 from ..utils.config import ControllerConfig
 from .diff import first_differences
+from .validating import AdmissionDenied
 
 log = logging.getLogger("kubeflow_tpu.webhook")
 _tracer = tracing.get_tracer("kubeflow_tpu.webhook")
@@ -407,7 +408,6 @@ class NotebookMutatingWebhook:
         whitespace trimmed, invalid or negative quantities and
         request > limit DENY admission — the original notebook is
         preserved (fail-early, auth_proxy_resources_test.go:509-566)."""
-        from .validating import AdmissionDenied
 
         explicit = {
             "cpu-request": names.AUTH_SIDECAR_CPU_REQUEST_ANNOTATION,

@@ -1,0 +1,82 @@
+"""Generic K8s-resource matcher for specs — the analog of the reference's
+``BeMatchingK8sResource`` gomega matcher (odh matchers_test.go:78-310).
+
+``assert_matches_resource(actual, expected)`` applies SUBSET semantics:
+every field present in ``expected`` must match ``actual`` (extra actual
+fields — server-set metadata, defaulted spec fields — are fine), and a
+failure raises with a MINIMIZED first-differences diff instead of two
+full object dumps, which is the whole point of the reference matcher.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kubeflow_tpu.webhook.diff import first_differences
+
+# server-populated fields never interesting in a spec comparison
+DEFAULT_IGNORED = (
+    ("metadata", "resourceVersion"),
+    ("metadata", "uid"),
+    ("metadata", "creationTimestamp"),
+    ("metadata", "generation"),
+    ("metadata", "managedFields"),
+)
+
+
+def _subset(actual: Any, expected: Any, path: str,
+            mismatches: list[str]) -> None:
+    if isinstance(expected, dict) and isinstance(actual, dict):
+        for key, want in expected.items():
+            sub = f"{path}.{key}" if path else str(key)
+            if key not in actual:
+                mismatches.append(f"{sub}: expected {want!r}, absent")
+            else:
+                _subset(actual[key], want, sub, mismatches)
+    elif isinstance(expected, list) and isinstance(actual, list):
+        if len(expected) != len(actual):
+            mismatches.append(
+                f"{path}: expected {len(expected)} items, got {len(actual)}")
+            return
+        for i, (a, w) in enumerate(zip(actual, expected)):
+            _subset(a, w, f"{path}[{i}]", mismatches)
+    elif actual != expected:
+        mismatches.extend(first_differences(actual, expected, path, limit=3))
+
+
+def _prune_ignored(obj: Any, ignored) -> Any:
+    if not isinstance(obj, dict):
+        return obj
+    out = dict(obj)
+    for trail in ignored:
+        node = out
+        for key in trail[:-1]:
+            child = node.get(key)
+            if not isinstance(child, dict):
+                node = None
+                break
+            node[key] = child = dict(child)
+            node = child
+        if isinstance(node, dict):
+            node.pop(trail[-1], None)
+    return out
+
+
+def assert_matches_resource(actual: dict, expected: dict, *,
+                            ignored=DEFAULT_IGNORED,
+                            max_diffs: int = 5) -> None:
+    """Raise AssertionError with a minimized per-path diff when ``actual``
+    does not carry every field of ``expected``."""
+    actual = _prune_ignored(actual, ignored)
+    expected = _prune_ignored(expected, ignored)
+    mismatches: list[str] = []
+    _subset(actual, expected, "", mismatches)
+    if mismatches:
+        kind = actual.get("kind", "object")
+        name = (actual.get("metadata") or {}).get("name", "?")
+        shown = mismatches[:max_diffs]
+        more = len(mismatches) - len(shown)
+        tail = f"\n  … and {more} more" if more > 0 else ""
+        raise AssertionError(
+            f"{kind}/{name} does not match expected resource:\n  "
+            + "\n  ".join(shown) + tail)

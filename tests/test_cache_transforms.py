@@ -1,0 +1,142 @@
+"""Cache-transform spec — the reference's ``odh main_test.go`` (379 lines)
+analog: stripConfigMapData / stripSecretData tables (payload + managedFields
++ last-applied stripping, nil handling, pass-through of foreign kinds,
+label/annotation/type preservation) plus the CachingClient live-read
+guarantee the transforms exist to protect.
+"""
+
+import pytest
+
+from kubeflow_tpu.cluster.cache import (LAST_APPLIED_ANNOTATION,
+                                        CachingClient, strip_configmap_data,
+                                        strip_secret_data)
+from kubeflow_tpu.cluster.store import ClusterStore
+
+
+def secret(**meta):
+    obj = {"kind": "Secret", "apiVersion": "v1", "type": "Opaque",
+           "metadata": {"name": "s", "namespace": "ns", **meta},
+           "data": {"password": "aHVudGVyMg=="},
+           "stringData": {"token": "plaintext"}}
+    return obj
+
+
+def configmap(**meta):
+    return {"kind": "ConfigMap", "apiVersion": "v1",
+            "metadata": {"name": "cm", "namespace": "ns", **meta},
+            "data": {"config.yaml": "a: 1"},
+            "binaryData": {"blob": "AAAA"}}
+
+
+class TestStripSecretData:
+    """Reference TestStripSecretData (main_test.go:135-241,273-301,
+    330-382)."""
+
+    def test_strips_data_stringdata_managedfields(self):
+        out = strip_secret_data(secret(managedFields=[{"manager": "kubectl"}]))
+        assert "data" not in out
+        assert "stringData" not in out
+        assert "managedFields" not in out["metadata"]
+
+    def test_handles_missing_payload_fields(self):
+        out = strip_secret_data({"kind": "Secret",
+                                 "metadata": {"name": "s"}})
+        assert out["kind"] == "Secret"
+
+    def test_passes_through_non_secret_unchanged(self):
+        pod = {"kind": "Pod", "metadata": {"name": "p"},
+               "data": {"keep": "me"}}
+        assert strip_secret_data(pod) is pod
+
+    def test_handles_missing_annotations_without_error(self):
+        out = strip_secret_data(secret())
+        assert "data" not in out
+
+    def test_strips_last_applied_preserving_others(self):
+        out = strip_secret_data(secret(annotations={
+            LAST_APPLIED_ANNOTATION: '{"huge": "payload"}',
+            "keep.me/here": "yes"}))
+        anns = out["metadata"]["annotations"]
+        assert LAST_APPLIED_ANNOTATION not in anns
+        assert anns["keep.me/here"] == "yes"
+
+    def test_preserves_labels_annotations_and_type(self):
+        out = strip_secret_data(secret(labels={"app": "x"},
+                                       annotations={"a": "b"}))
+        assert out["metadata"]["labels"] == {"app": "x"}
+        assert out["metadata"]["annotations"] == {"a": "b"}
+        assert out["type"] == "Opaque"
+
+    def test_original_object_not_mutated(self):
+        original = secret(managedFields=[{"m": 1}])
+        strip_secret_data(original)
+        assert "data" in original
+        assert "managedFields" in original["metadata"]
+
+
+class TestStripConfigMapData:
+    """Reference TestStripConfigMapData (main_test.go:26-133,243-271,
+    303-328)."""
+
+    def test_strips_data_binarydata_managedfields(self):
+        out = strip_configmap_data(
+            configmap(managedFields=[{"manager": "kubectl"}]))
+        assert "data" not in out
+        assert "binaryData" not in out
+        assert "managedFields" not in out["metadata"]
+
+    def test_handles_missing_payload_fields(self):
+        out = strip_configmap_data({"kind": "ConfigMap",
+                                    "metadata": {"name": "cm"}})
+        assert out["kind"] == "ConfigMap"
+
+    def test_passes_through_non_configmap_unchanged(self):
+        svc = {"kind": "Service", "metadata": {"name": "s"},
+               "data": {"keep": "me"}}
+        assert strip_configmap_data(svc) is svc
+
+    def test_strips_last_applied_preserving_others(self):
+        out = strip_configmap_data(configmap(annotations={
+            LAST_APPLIED_ANNOTATION: "x" * 10_000,
+            "opendatahub.io/managed-by": "workbenches"}))
+        anns = out["metadata"]["annotations"]
+        assert LAST_APPLIED_ANNOTATION not in anns
+        assert anns["opendatahub.io/managed-by"] == "workbenches"
+
+    def test_preserves_labels_and_annotations(self):
+        out = strip_configmap_data(configmap(labels={"l": "v"},
+                                             annotations={"a": "b"}))
+        assert out["metadata"]["labels"] == {"l": "v"}
+        assert out["metadata"]["annotations"] == {"a": "b"}
+
+
+class TestCachingClientGuarantee:
+    """The point of the transforms (reference main.go:248-268): the cache
+    never holds payloads, but client READS return them — reads for the
+    disabled kinds go straight to the store."""
+
+    def test_cached_watch_path_strips_but_reads_stay_live(self):
+        store = ClusterStore()
+        client = CachingClient(store)
+        store.create(secret())
+        live = client.get("Secret", "ns", "s")
+        assert live["data"]["password"] == "aHVudGVyMg=="
+
+    def test_managed_fields_never_reach_cache_consumers(self):
+        """Belt-and-braces: even with the read-bypass disabled (a cached
+        ConfigMap), the transforms keep payload + managedFields +
+        last-applied out of what cache consumers see."""
+        store = ClusterStore()
+        client = CachingClient(store, disable_for=())
+        store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                      "metadata": {"name": "cm", "namespace": "ns",
+                                   "managedFields": [{"manager": "x"}],
+                                   "annotations": {
+                                       LAST_APPLIED_ANNOTATION: "{}",
+                                       "keep": "me"}},
+                      "data": {"k": "v"}})
+        (obj,) = client.list("ConfigMap", "ns")
+        assert "data" not in obj
+        assert "managedFields" not in obj["metadata"]
+        assert LAST_APPLIED_ANNOTATION not in obj["metadata"]["annotations"]
+        assert obj["metadata"]["annotations"]["keep"] == "me"

@@ -1,0 +1,91 @@
+"""Spec for the resource matcher itself (reference matchers_test.go:78-310
+tests its matcher the same way) — plus one real-world use against a
+rendered StatefulSet to prove the subset semantics hold in practice.
+"""
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.controllers import Manager, NotebookReconciler
+from tests.conftest import drain
+from tests.matchers import assert_matches_resource
+
+
+def test_equal_objects_match():
+    obj = {"kind": "Service", "metadata": {"name": "s"},
+           "spec": {"ports": [{"port": 80}]}}
+    assert_matches_resource(obj, obj)
+
+
+def test_subset_semantics_ignore_extra_actual_fields():
+    actual = {"kind": "Service", "metadata": {"name": "s", "labels": {"x": "y"}},
+              "spec": {"type": "ClusterIP", "ports": [{"port": 80,
+                                                       "name": "http"}]}}
+    assert_matches_resource(actual, {"spec": {"type": "ClusterIP"}})
+
+
+def test_server_fields_ignored_on_both_sides():
+    actual = {"kind": "Pod", "metadata": {"name": "p", "uid": "abc",
+                                          "resourceVersion": "42"}}
+    expected = {"kind": "Pod", "metadata": {"name": "p", "uid": "zzz"}}
+    assert_matches_resource(actual, expected)
+
+
+def test_mismatch_reports_minimized_path_diff():
+    actual = {"kind": "Service", "metadata": {"name": "svc"},
+              "spec": {"ports": [{"port": 80}]}}
+    expected = {"spec": {"ports": [{"port": 8080}]}}
+    with pytest.raises(AssertionError) as exc:
+        assert_matches_resource(actual, expected)
+    message = str(exc.value)
+    assert "Service/svc" in message
+    assert "spec.ports[0].port" in message
+    assert "8080" in message
+    # minimized: the matched metadata never appears in the failure
+    assert "metadata" not in message
+
+
+def test_absent_expected_field_reported():
+    with pytest.raises(AssertionError, match="expected 'http-notebook'"):
+        assert_matches_resource(
+            {"kind": "Service", "metadata": {"name": "s"},
+             "spec": {"ports": [{"port": 80}]}},
+            {"spec": {"ports": [{"port": 80, "name": "http-notebook"}]}})
+
+
+def test_list_length_mismatch_reported_at_list_path():
+    with pytest.raises(AssertionError, match="containers: expected 2"):
+        assert_matches_resource(
+            {"kind": "Pod", "metadata": {"name": "p"},
+             "spec": {"containers": [{"name": "a"}]}},
+            {"spec": {"containers": [{"name": "a"}, {"name": "b"}]}})
+
+
+def test_diff_count_capped():
+    actual = {"kind": "ConfigMap", "metadata": {"name": "cm"},
+              "data": {str(i): "a" for i in range(20)}}
+    expected = {"data": {str(i): "b" for i in range(20)}}
+    with pytest.raises(AssertionError) as exc:
+        assert_matches_resource(actual, expected)
+    assert "more" in str(exc.value)
+
+
+def test_against_rendered_statefulset():
+    """Real-world shape: assert the rendered STS against an expected
+    subset the way the reference's specs use BeMatchingK8sResource."""
+    store = ClusterStore()
+    mgr = Manager(store)
+    NotebookReconciler(store).setup(mgr)
+    store.create(api.new_notebook(
+        "nb", "ns", annotations={"tpu.kubeflow.org/accelerator": "v5e-16"}))
+    drain(mgr)
+    sts = store.get("StatefulSet", "ns", "nb")
+    assert_matches_resource(sts, {
+        "kind": "StatefulSet",
+        "spec": {
+            "replicas": 4,  # v5e-16 = 4 workers (no webhook → no lock)
+            "serviceName": "nb-workers",
+            "selector": {"matchLabels": {"statefulset": "nb"}},
+        },
+    })
