@@ -8,8 +8,9 @@ __all__ = ["Manager", "Request", "NotebookReconciler", "CullingReconciler",
 
 
 def setup_controllers(client, config=None, metrics=None, prober=None, *,
-                      extension=True, webhooks=True, leader_elect=False,
-                      health_port=None):
+                      core=True, extension=True, webhooks=True,
+                      leader_elect=False, health_port=None,
+                      lease_name=None):
     """Wire a manager the way the two reference manager binaries do
     (notebook-controller/main.go:58-148 + odh main.go:141-374): admission
     webhooks on the apiserver, core reconciler always, culler only when
@@ -40,16 +41,28 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
         NotebookValidatingWebhook(config).install(client)
     mgr = Manager(client)
     mgr.attach_metrics(metrics)
-    NotebookReconciler(client, config, metrics).setup(mgr)
+    # ``core``/``extension`` mirror the reference's TWO manager binaries:
+    # notebook-controller (core reconciler + culler) and the odh extension
+    # manager (extension reconciler + webhooks) — run split via
+    # ``main.py --components core|extension`` against one shared apiserver,
+    # cooperating only through API state, exactly like the reference pair
+    if core:
+        NotebookReconciler(client, config, metrics).setup(mgr)
+        if config.enable_culling:
+            kwargs = {"prober": prober} if prober is not None else {}
+            CullingReconciler(client, config, metrics, **kwargs).setup(mgr)
     if extension:
         ExtensionReconciler(client, config, metrics).setup(mgr)
-    if config.enable_culling:
-        kwargs = {"prober": prober} if prober is not None else {}
-        CullingReconciler(client, config, metrics, **kwargs).setup(mgr)
     if leader_elect:
+        if lease_name is None:
+            # each reference binary elects on its own Lease: an
+            # extension-only manager must never contend with (or shadow)
+            # a running core manager's lease
+            lease_name = ("kubeflow-tpu-extension-controller-leader"
+                          if extension and not core
+                          else "kubeflow-tpu-notebook-controller-leader")
         mgr.leader_elector = LeaderElector(
-            client, config.controller_namespace,
-            "kubeflow-tpu-notebook-controller-leader",
+            client, config.controller_namespace, lease_name,
             lease_duration=config.leader_lease_duration_s,
             renew_period=config.leader_renew_period_s)
     if health_port is not None:

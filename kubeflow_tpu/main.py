@@ -44,6 +44,7 @@ def build_manager(store=None, config: ControllerConfig | None = None, *,
                   webhook_port: int | None = None,
                   cert_dir: str | None = None,
                   simulate_kubelet: bool = False,
+                  components: str = "all",
                   on_tls_change=None):
     """Compose the full production stack; returns (manager, shutdown_event).
 
@@ -52,6 +53,13 @@ def build_manager(store=None, config: ControllerConfig | None = None, *,
     the reconcilers are identical either way (the reference's controllers are
     equally transport-agnostic behind controller-runtime's client,
     notebook-controller/main.go:95-148).
+
+    ``components`` mirrors the reference's two manager binaries:
+    ``core`` = notebook-controller (core reconciler + culler, no webhooks,
+    own leader Lease), ``extension`` = the odh manager (extension
+    reconciler + admission webhooks, its own Lease), ``all`` = both in one
+    process (the standalone convenience). Split processes cooperate only
+    through apiserver state, exactly like the reference pair (SURVEY §1).
 
     The returned manager's client is the read-cached view (Secret/ConfigMap
     payloads never cached); admission plugins and the optional HTTPS webhook
@@ -64,8 +72,13 @@ def build_manager(store=None, config: ControllerConfig | None = None, *,
     client = CachingClient(store)
     shutdown = threading.Event()
 
+    if components not in ("all", "core", "extension"):
+        raise ValueError(f"unknown components selection: {components!r}")
+    core = components in ("all", "core")
+    extension = components in ("all", "extension")
     mgr = setup_controllers(client, config, leader_elect=leader_elect,
-                            health_port=health_port)
+                            health_port=health_port, core=core,
+                            extension=extension, webhooks=extension)
 
     profile = tls_profile.fetch_apiserver_tls_profile(client)
     watcher = tls_profile.SecurityProfileWatcher(
@@ -73,7 +86,9 @@ def build_manager(store=None, config: ControllerConfig | None = None, *,
         on_change=on_tls_change or shutdown.set)
     watcher.setup()
 
-    if webhook_port is not None:
+    if webhook_port is not None and extension:
+        # the webhook server belongs to the extension manager, as in the
+        # reference (webhooks register on the odh binary, main.go:306-331)
         certfile = f"{cert_dir}/tls.crt" if cert_dir else None
         keyfile = f"{cert_dir}/tls.key" if cert_dir else None
         # same webhook objects the in-process admission plugins use — one
@@ -108,6 +123,14 @@ def build_arg_parser() -> argparse.ArgumentParser:
                          "(absent → plain HTTP, dev only)")
     ap.add_argument("--simulate-kubelet", action="store_true",
                     help="run the StatefulSet/pod simulator (standalone)")
+    ap.add_argument("--components", choices=("all", "core", "extension"),
+                    default="all",
+                    help="which manager to run: 'core' = the "
+                         "notebook-controller binary (core reconciler + "
+                         "culler), 'extension' = the odh manager "
+                         "(extension reconciler + webhooks); the two "
+                         "cooperate through apiserver state like the "
+                         "reference's two Deployments")
     ap.add_argument("--debug-log", action="store_true")
     ap.add_argument("--log-format", choices=("text", "json"), default="text",
                     help="json = zap production-encoder analog (one JSON "
@@ -164,6 +187,7 @@ def main(argv=None) -> int:
         health_port=args.health_port or None,
         webhook_port=args.webhook_port or None,
         cert_dir=args.cert_dir,
+        components=args.components,
         simulate_kubelet=args.simulate_kubelet and client is None)
 
     apiserver = None

@@ -1,0 +1,135 @@
+"""Two-manager split topology — the reference ships TWO manager binaries
+(notebook-controller and odh-notebook-controller) as separate Deployments
+cooperating only through apiserver state (SURVEY §1). ``--components
+core|extension`` reproduces that split; these specs run both halves as
+separate manager processes over one cluster and assert the full
+lock → provision → unlock → scale-up handshake crosses the process
+boundary, plus the independent leader Leases.
+"""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.main import build_manager
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+
+CENTRAL = "kubeflow-tpu-system"
+
+
+def wait_for(fn, timeout=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = fn()
+        if result:
+            return result
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+@pytest.fixture()
+def split_world():
+    """One shared cluster; core and extension managers as separate
+    processes (threaded managers with independent clients/queues)."""
+    store = ClusterStore()
+    config = ControllerConfig(controller_namespace=CENTRAL)
+    core_mgr, _ = build_manager(store, config, components="core",
+                                simulate_kubelet=True)
+    ext_mgr, _ = build_manager(store, config, components="extension")
+    core_mgr.start()
+    ext_mgr.start()
+    yield store, config
+    ext_mgr.stop()
+    core_mgr.stop()
+
+
+def test_lock_handshake_crosses_the_process_boundary(split_world):
+    """Admission (extension half) injects the lock; the CORE manager renders
+    replicas=0; the EXTENSION manager provisions routes/grants and removes
+    the lock; the core manager then scales the slice up — four hops, two
+    processes, no direct calls."""
+    store, config = split_world
+    store.create(api.new_notebook(
+        "nb", "proj", annotations={"tpu.kubeflow.org/accelerator": "v5e-16"}))
+
+    # admission ran in the extension half: the CR was born locked
+    nb = store.get(api.KIND, "proj", "nb")
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) == \
+        names.RECONCILIATION_LOCK_VALUE
+
+    # extension provisioned the cross-namespace resources and removed the
+    # lock; core scaled the STS to the slice's 4 workers
+    wait_for(lambda: store.get_or_none("ReferenceGrant", "proj",
+                                       "notebook-httproute-access"),
+             msg="reference grant")
+    wait_for(lambda: k8s.get_annotation(store.get(api.KIND, "proj", "nb"),
+                                        names.STOP_ANNOTATION) is None,
+             msg="lock removal")
+    wait_for(lambda: store.get("StatefulSet", "proj", "nb")["spec"][
+        "replicas"] == 4, msg="scale-up")
+    wait_for(lambda: any(
+        c.get("type") == api.CONDITION_SLICE_READY
+        and c.get("status") == "True"
+        for c in k8s.get_in(store.get(api.KIND, "proj", "nb"),
+                            "status", "conditions", default=[]) or []),
+        msg="SliceReady")
+
+
+def test_core_only_process_runs_no_extension_resources(split_world):
+    """Sanity of the split: stopping the extension half freezes lock
+    removal (the core half alone cannot unlock), proving the halves own
+    disjoint responsibilities."""
+    store, config = split_world
+    # build a THIRD, isolated cluster with only a core manager
+    lone_store = ClusterStore()
+    core_only, _ = build_manager(lone_store, config, components="core",
+                                 simulate_kubelet=True)
+    core_only.start()
+    try:
+        # no admission in a core-only standalone process: no lock is
+        # injected, the slice starts immediately, but no extension
+        # resources ever appear
+        lone_store.create(api.new_notebook("nb", "proj"))
+        wait_for(lambda: lone_store.get_or_none("StatefulSet", "proj", "nb"),
+                 msg="statefulset")
+        time.sleep(0.5)
+        assert lone_store.get_or_none(
+            "ReferenceGrant", "proj", "notebook-httproute-access") is None
+        assert not lone_store.list("HTTPRoute", CENTRAL)
+    finally:
+        core_only.stop()
+
+
+def test_split_managers_hold_independent_leader_leases(split_world):
+    store, config = split_world
+    core_mgr, _ = build_manager(store, config, components="core",
+                                leader_elect=True)
+    ext_mgr, _ = build_manager(store, config, components="extension",
+                               leader_elect=True)
+    core_mgr.start()
+    ext_mgr.start()
+    try:
+        wait_for(lambda: store.get_or_none(
+            "Lease", CENTRAL, "kubeflow-tpu-notebook-controller-leader"),
+            msg="core lease")
+        wait_for(lambda: store.get_or_none(
+            "Lease", CENTRAL, "kubeflow-tpu-extension-controller-leader"),
+            msg="extension lease")
+        core = store.get("Lease", CENTRAL,
+                         "kubeflow-tpu-notebook-controller-leader")
+        ext = store.get("Lease", CENTRAL,
+                        "kubeflow-tpu-extension-controller-leader")
+        assert core["spec"]["holderIdentity"] != \
+            ext["spec"]["holderIdentity"]
+    finally:
+        ext_mgr.stop()
+        core_mgr.stop()
+
+
+def test_unknown_components_rejected():
+    with pytest.raises(ValueError, match="unknown components"):
+        build_manager(ClusterStore(), ControllerConfig(),
+                      components="everything")
