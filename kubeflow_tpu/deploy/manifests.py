@@ -163,100 +163,126 @@ def culler_configmap() -> dict:
     }
 
 
-def manager_deployment() -> dict:
-    env_from_culler = [
-        {"name": var,
-         "valueFrom": {"configMapKeyRef": {
-             "name": "notebook-controller-culler-config", "key": var,
-             "optional": True}}}
-        for var in ("ENABLE_CULLING", "CULL_IDLE_TIME",
-                    "IDLENESS_CHECK_PERIOD")]
+CORE_DEPLOYMENT = "kubeflow-tpu-notebook-controller"
+EXTENSION_DEPLOYMENT = "kubeflow-tpu-extension-controller"
+
+
+def _manager_deployment(name: str, component: str, *,
+                        webhook: bool, culler_env: bool) -> dict:
+    """One manager Deployment; the reference ships TWO (notebook-controller
+    and odh-notebook-controller config trees) cooperating only through
+    apiserver state — ``--components`` selects the half."""
+    env = [{"name": "K8S_NAMESPACE",
+            "valueFrom": {"fieldRef": {"fieldPath": "metadata.namespace"}}}]
+    if culler_env:
+        env += [
+            {"name": var,
+             "valueFrom": {"configMapKeyRef": {
+                 "name": "notebook-controller-culler-config", "key": var,
+                 "optional": True}}}
+            for var in ("ENABLE_CULLING", "CULL_IDLE_TIME",
+                        "IDLENESS_CHECK_PERIOD")]
+    # flags must exist in kubeflow_tpu/main.py argparse —
+    # tests/test_manifests.py parses them against it.
+    # --in-cluster: ServiceAccount-mount transport to the real apiserver
+    # (cluster/http_client.py); without it the manager would reconcile an
+    # empty in-process store and never touch the cluster
+    args = ["--in-cluster", "--components", component, "--leader-elect",
+            "--health-port", "8081"]
+    ports = [{"containerPort": 8081, "name": "health", "protocol": "TCP"}]
+    volume_mounts, volumes = [], []
+    if webhook:
+        args += ["--webhook-port", "8443", "--cert-dir",
+                 "/etc/webhook/certs"]
+        ports.insert(0, {"containerPort": 8443, "name": "webhook",
+                         "protocol": "TCP"})
+        # --cert-dir above: serving cert materialized by the cluster cert
+        # machinery into this secret
+        volume_mounts = [{"name": "webhook-certs",
+                          "mountPath": "/etc/webhook/certs",
+                          "readOnly": True}]
+        volumes = [{"name": "webhook-certs",
+                    "secret": {"secretName": "kubeflow-tpu-webhook-certs"}}]
+    container = {
+        "name": "manager",
+        "image": DEFAULT_MANAGER_IMAGE,
+        "command": ["python", "-m", "kubeflow_tpu.main"],
+        "args": args,
+        "env": env,
+        "ports": ports,
+        # reference manager probe shape (config/manager/manager.yaml:59-68)
+        "livenessProbe": {
+            "httpGet": {"path": "/healthz", "port": 8081},
+            "initialDelaySeconds": 5, "periodSeconds": 10,
+        },
+        "readinessProbe": {
+            "httpGet": {"path": "/readyz", "port": 8081},
+            "initialDelaySeconds": 5, "periodSeconds": 10,
+        },
+        "resources": {
+            "requests": {"cpu": "100m", "memory": "128Mi"},
+            "limits": {"cpu": "500m", "memory": "512Mi"},
+        },
+    }
+    if volume_mounts:
+        container["volumeMounts"] = volume_mounts
+    pod_spec = {"serviceAccountName": CORE_DEPLOYMENT,
+                "containers": [container]}
+    if volumes:
+        pod_spec["volumes"] = volumes
     return {
         "apiVersion": "apps/v1",
         "kind": "Deployment",
-        "metadata": {"name": "kubeflow-tpu-notebook-controller",
-                     "namespace": NAMESPACE,
-                     "labels": {"app": "kubeflow-tpu-notebook-controller"}},
+        "metadata": {"name": name, "namespace": NAMESPACE,
+                     "labels": {"app": name}},
         "spec": {
             "replicas": 1,
-            "selector": {"matchLabels": {
-                "app": "kubeflow-tpu-notebook-controller"}},
+            "selector": {"matchLabels": {"app": name}},
             "template": {
-                "metadata": {"labels": {
-                    "app": "kubeflow-tpu-notebook-controller"}},
-                "spec": {
-                    "serviceAccountName": "kubeflow-tpu-notebook-controller",
-                    "containers": [{
-                        "name": "manager",
-                        "image": DEFAULT_MANAGER_IMAGE,
-                        # flags must exist in kubeflow_tpu/main.py argparse —
-                        # tests/test_manifests.py parses them against it
-                        "command": ["python", "-m", "kubeflow_tpu.main"],
-                        # --in-cluster: ServiceAccount-mount transport to the
-                        # real apiserver (cluster/http_client.py); without it
-                        # the manager would reconcile an empty in-process
-                        # store and never touch the cluster
-                        "args": ["--in-cluster",
-                                 "--leader-elect",
-                                 "--health-port", "8081",
-                                 "--webhook-port", "8443",
-                                 "--cert-dir", "/etc/webhook/certs"],
-                        "env": [
-                            {"name": "K8S_NAMESPACE",
-                             "valueFrom": {"fieldRef": {
-                                 "fieldPath": "metadata.namespace"}}},
-                            *env_from_culler,
-                        ],
-                        "ports": [
-                            {"containerPort": 8443, "name": "webhook",
-                             "protocol": "TCP"},
-                            {"containerPort": 8081, "name": "health",
-                             "protocol": "TCP"},
-                        ],
-                        # reference manager probe shape
-                        # (config/manager/manager.yaml:59-68)
-                        "livenessProbe": {
-                            "httpGet": {"path": "/healthz", "port": 8081},
-                            "initialDelaySeconds": 5, "periodSeconds": 10,
-                        },
-                        "readinessProbe": {
-                            "httpGet": {"path": "/readyz", "port": 8081},
-                            "initialDelaySeconds": 5, "periodSeconds": 10,
-                        },
-                        "resources": {
-                            "requests": {"cpu": "100m", "memory": "128Mi"},
-                            "limits": {"cpu": "500m", "memory": "512Mi"},
-                        },
-                        "volumeMounts": [{
-                            # --cert-dir above: serving cert materialized by
-                            # the cluster cert machinery into this secret
-                            "name": "webhook-certs",
-                            "mountPath": "/etc/webhook/certs",
-                            "readOnly": True}],
-                    }],
-                    "volumes": [{
-                        "name": "webhook-certs",
-                        "secret": {
-                            "secretName": "kubeflow-tpu-webhook-certs"}}],
-                },
+                "metadata": {"labels": {"app": name}},
+                "spec": pod_spec,
             },
         },
     }
 
 
-def manager_health_service() -> dict:
-    """Health/metrics Service: Prometheus scrape target and the endpoint the
-    chaos experiments' readyz steady-state checks probe."""
+def manager_deployment() -> dict:
+    """Core half: the notebook-controller binary (core reconciler + culler,
+    no webhooks)."""
+    return _manager_deployment(CORE_DEPLOYMENT, "core",
+                               webhook=False, culler_env=True)
+
+
+def extension_deployment() -> dict:
+    """Platform half: the odh manager (extension reconciler + admission
+    webhooks behind the webhook Service)."""
+    return _manager_deployment(EXTENSION_DEPLOYMENT, "extension",
+                               webhook=True, culler_env=False)
+
+
+def _health_service(app: str) -> dict:
     return {
         "apiVersion": "v1", "kind": "Service",
-        "metadata": {"name": "kubeflow-tpu-notebook-controller",
-                     "namespace": NAMESPACE,
-                     "labels": {"app": "kubeflow-tpu-notebook-controller"}},
+        "metadata": {"name": app, "namespace": NAMESPACE,
+                     "labels": {"app": app}},
         "spec": {
             "ports": [{"name": "health", "port": 8081,
                        "targetPort": 8081, "protocol": "TCP"}],
-            "selector": {"app": "kubeflow-tpu-notebook-controller"}},
+            "selector": {"app": app}},
     }
+
+
+def manager_health_service() -> dict:
+    """Core manager's health/metrics Service: Prometheus scrape target and
+    the endpoint the pod-kill/outage chaos steady-state checks probe."""
+    return _health_service(CORE_DEPLOYMENT)
+
+
+def extension_health_service() -> dict:
+    """Extension manager's health/metrics Service — its readyz carries the
+    webhook-listener check (webhook-disrupt's steady-state probe) and its
+    metrics cover the admission + extension-reconciler series."""
+    return _health_service(EXTENSION_DEPLOYMENT)
 
 
 # ---------------------------------------------------------------------- rbac
@@ -330,7 +356,9 @@ def webhook_objects() -> list[dict]:
         "spec": {
             "ports": [{"port": 443, "targetPort": 8443,
                        "protocol": "TCP"}],
-            "selector": {"app": "kubeflow-tpu-notebook-controller"}},
+            # webhooks are served by the EXTENSION manager, as in the
+            # reference (odh main.go:306-331)
+            "selector": {"app": EXTENSION_DEPLOYMENT}},
     }
     rule = {
         "apiGroups": [api.GROUP], "apiVersions": ["v1"],
@@ -391,8 +419,10 @@ def render_kustomize_tree() -> dict[str, object]:
         "crd/bases/kubeflow.org_notebooks.yaml": notebook_crd(),
         "crd/kustomization.yaml":
             _kustomization(["bases/kubeflow.org_notebooks.yaml"]),
-        "manager/manager.yaml": [manager_deployment(), culler_configmap(),
-                                 manager_health_service()],
+        "manager/manager.yaml": [manager_deployment(),
+                                 extension_deployment(), culler_configmap(),
+                                 manager_health_service(),
+                                 extension_health_service()],
         "manager/params.env": params_env(),
         "manager/kustomization.yaml": _kustomization(
             ["manager.yaml"],
@@ -414,12 +444,12 @@ def render_kustomize_tree() -> dict[str, object]:
                 "source": {"kind": "ConfigMap",
                            "name": "kubeflow-tpu-params",
                            "fieldPath": f"data.{MANAGER_IMAGE_PARAM}"},
-                "targets": [{
-                    "select": {"kind": "Deployment",
-                               "name": "kubeflow-tpu-notebook-controller"},
-                    "fieldPaths": [
-                        "spec.template.spec.containers.0.image"],
-                }],
+                "targets": [
+                    {"select": {"kind": "Deployment", "name": name},
+                     "fieldPaths": [
+                         "spec.template.spec.containers.0.image"]}
+                    for name in (CORE_DEPLOYMENT, EXTENSION_DEPLOYMENT)
+                ],
             }]),
         # overlays — feature flags via env patches, as the reference does
         # with its openshift/kubeflow/standalone overlays

@@ -82,17 +82,26 @@ def test_checked_in_manifests_match_generated():
 
 
 def test_deployment_args_parse_against_entrypoint():
-    """The generated Deployment's command/args must be accepted by the REAL
-    kubeflow_tpu.main argparse — a flag mismatch means CrashLoopBackOff in
-    every cluster deployment."""
+    """BOTH generated Deployments' command/args must be accepted by the
+    REAL kubeflow_tpu.main argparse — a flag mismatch means
+    CrashLoopBackOff in every cluster deployment."""
+    from kubeflow_tpu.deploy.manifests import extension_deployment
     from kubeflow_tpu.main import build_arg_parser
-    dep = manager_deployment()
-    c = dep["spec"]["template"]["spec"]["containers"][0]
+
+    core = manager_deployment()
+    c = core["spec"]["template"]["spec"]["containers"][0]
     assert c["command"] == ["python", "-m", "kubeflow_tpu.main"]
     parsed = build_arg_parser().parse_args(c["args"])  # SystemExit on mismatch
-    assert parsed.cert_dir == "/etc/webhook/certs"
+    assert parsed.components == "core"
     assert parsed.leader_elect
     assert parsed.health_port == 8081
+    assert parsed.cert_dir is None  # webhooks live in the extension half
+
+    ext = extension_deployment()
+    c = ext["spec"]["template"]["spec"]["containers"][0]
+    parsed = build_arg_parser().parse_args(c["args"])
+    assert parsed.components == "extension"
+    assert parsed.cert_dir == "/etc/webhook/certs"
     assert parsed.webhook_port == 8443
 
 
@@ -109,3 +118,25 @@ def test_params_env_replacement_targets_exist():
     assert MANAGER_IMAGE_PARAM in params_env()
     dep = manager_deployment()
     assert dep["spec"]["template"]["spec"]["containers"][0]["image"]
+
+
+def test_two_deployment_split_matches_reference_topology():
+    """The reference ships two manager Deployments (notebook-controller +
+    odh-notebook-controller); the webhook Service must front the EXTENSION
+    half and the culler config must feed the CORE half."""
+    from kubeflow_tpu.deploy.manifests import (extension_deployment,
+                                               render_kustomize_tree)
+    tree = render_kustomize_tree()
+    manager_objs = tree["manager/manager.yaml"]
+    deployments = [o for o in manager_objs if o["kind"] == "Deployment"]
+    assert {d["metadata"]["name"] for d in deployments} == {
+        "kubeflow-tpu-notebook-controller",
+        "kubeflow-tpu-extension-controller"}
+    webhook_svc = next(o for o in tree["webhook/webhook.yaml"]
+                       if o["kind"] == "Service")
+    assert webhook_svc["spec"]["selector"] == {
+        "app": "kubeflow-tpu-extension-controller"}
+    ext = extension_deployment()
+    env_names = {e["name"] for e in
+                 ext["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert "ENABLE_CULLING" not in env_names  # culler rides the core half
