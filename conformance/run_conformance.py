@@ -193,8 +193,31 @@ def _kubectl(*args: str, input_: str | None = None) -> str:
     return out.stdout
 
 
+def validate_controllers() -> list[str]:
+    """The reference e2e validates BOTH controller Deployments before any
+    notebook test (testNotebookControllerValidation,
+    e2e/notebook_controller_test.go:11-21): core + extension managers must
+    be Available in the controller namespace."""
+    errors: list[str] = []
+    for name in ("kubeflow-tpu-notebook-controller",
+                 "kubeflow-tpu-extension-controller"):
+        try:
+            out = _kubectl(
+                "get", "deployment", name, "-n", "kubeflow-tpu-system",
+                "-o",
+                "jsonpath={.status.conditions[?(@.type=='Available')].status}")
+        except Exception as e:
+            errors.append(f"deployment {name}: {e}")
+            continue
+        if out.strip() != "True":
+            errors.append(f"deployment {name} not Available")
+    return errors
+
+
 def run_in_cluster(report_dir: str) -> list[dict]:
-    results = []
+    results = [{"config": "controller-validation",
+                "passed": not (errs := validate_controllers()),
+                "errors": errs, "duration_s": 0.0}]
     for cfg in CONFIGS:
         t0 = time.monotonic()
         errors: list[str] = []

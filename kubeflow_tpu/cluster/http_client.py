@@ -124,6 +124,10 @@ class HttpApiClient:
             self._ssl = ctx
         self._stopped = threading.Event()
         self._watch_threads: list[threading.Thread] = []
+        # live watch responses, so close() can unblock readline() NOW
+        # instead of waiting out the server's bookmark interval
+        self._live_streams: set = set()
+        self._streams_lock = threading.Lock()
 
     # ------------------------------------------------------------ factories
     @classmethod
@@ -365,31 +369,47 @@ class HttpApiClient:
                 f"{key}={val}" for key, val in label_selector.items())
         path = self._path(kind, namespace, query=query)
         with self._request("GET", path, timeout=WATCH_READ_TIMEOUT_S) as resp:
-            connected.set()  # server has registered the watch relay
-            # resync AFTER the stream is live (no missable gap): on the
-            # first connect this is informer semantics — initial list →
-            # ADDED for existing objects, as controller-runtime delivers at
-            # boot — and after an outage it is the diff that surfaces
-            # missed changes and deletions. Events racing the resync may
-            # deliver twice (level-based consumers tolerate that); with
-            # unchanged RVs the diff delivers nothing.
-            self._resync(kind, callback, namespace, label_selector, seen)
-            while not self._stopped.is_set():
-                line = resp.readline()
-                if not line:
-                    return  # server closed the stream
-                try:
-                    frame = json.loads(line)
-                    event_type = frame["type"]
-                    obj = frame["object"]
-                except (ValueError, KeyError, TypeError):
-                    # truncated NDJSON frame (apiserver killed mid-write):
-                    # reconnect; the resync re-covers whatever it carried
-                    return
-                if event_type == "BOOKMARK":
-                    continue
-                self._deliver(callback, WatchEvent(event_type, obj), seen)
+            with self._streams_lock:
+                self._live_streams.add(resp)
+            try:
+                connected.set()  # server has registered the watch relay
+                # resync AFTER the stream is live (no missable gap): on the
+                # first connect this is informer semantics — initial list →
+                # ADDED for existing objects, as controller-runtime delivers
+                # at boot — and after an outage it is the diff that surfaces
+                # missed changes and deletions. Events racing the resync may
+                # deliver twice (level-based consumers tolerate that); with
+                # unchanged RVs the diff delivers nothing.
+                self._resync(kind, callback, namespace, label_selector, seen)
+                while not self._stopped.is_set():
+                    line = resp.readline()
+                    if not line:
+                        return  # server closed the stream
+                    try:
+                        frame = json.loads(line)
+                        event_type = frame["type"]
+                        obj = frame["object"]
+                    except (ValueError, KeyError, TypeError):
+                        # truncated NDJSON frame (apiserver killed
+                        # mid-write): reconnect; the resync re-covers
+                        # whatever it carried
+                        return
+                    if event_type == "BOOKMARK":
+                        continue
+                    self._deliver(callback, WatchEvent(event_type, obj), seen)
+            finally:
+                with self._streams_lock:
+                    self._live_streams.discard(resp)
 
     def close(self) -> None:
-        """Stop watch threads (they exit at the next read timeout/bookmark)."""
+        """Stop watch threads NOW: set the stop flag and close any live
+        watch responses so blocked readline() calls return immediately
+        instead of waiting out the server's bookmark interval."""
         self._stopped.set()
+        with self._streams_lock:
+            streams = list(self._live_streams)
+        for resp in streams:
+            try:
+                resp.close()
+            except OSError:
+                pass

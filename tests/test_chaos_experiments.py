@@ -37,8 +37,17 @@ def test_validator_rejects_bad_experiments():
 def test_knowledge_model_declares_tpu_invariants():
     doc = yaml.safe_load(
         (REPO / "chaos" / "knowledge" / "workbenches.yaml").read_text())
-    invariants = {i["name"]
-                  for i in doc["components"][0]["invariants"]}
+    by_name = {c["name"]: c for c in doc["components"]}
+    # the two-Deployment split: core carries the TPU invariants, the
+    # extension component owns the webhooks + fail-closed admission
+    core = by_name["notebook-controller"]
+    invariants = {i["name"] for i in core["invariants"]}
     assert {"slice-atomicity", "stable-worker-identity"} <= invariants
-    hooks = {w["path"] for w in doc["components"][0]["webhooks"]}
+    ext = by_name["extension-controller"]
+    hooks = {w["path"] for w in ext["webhooks"]}
     assert hooks == {"/mutate-notebook-v1", "/validate-notebook-v1"}
+    assert {i["name"] for i in ext["invariants"]} == {"fail-closed-admission"}
+    ext_resources = {(r["kind"], r["name"]) for r in ext["managedResources"]}
+    assert ("Service", "kubeflow-tpu-webhook-service") in ext_resources
+    assert ("Deployment", "kubeflow-tpu-extension-controller") in \
+        ext_resources
