@@ -389,11 +389,9 @@ def _tpu_boot_verification():
 
 
 def measure_once() -> float:
-    from kubeflow_tpu.api import types as api
     from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
     from kubeflow_tpu.cluster.store import ClusterStore
     from kubeflow_tpu.controllers import Manager, NotebookReconciler
-    from kubeflow_tpu.utils import names
 
     store = ClusterStore()
     mgr = Manager(store)
@@ -455,22 +453,27 @@ def measure_once_http() -> float:
 
     store = ClusterStore()
     api.install_notebook_crd(store)
-    sim_mgr = Manager(store)
-    StatefulSetSimulator(store, boot_delay_s=0.0).setup(sim_mgr)
-    sim_mgr.start()
-    proxy = ApiServerProxy(store)
-    proxy.start()
-    client = HttpApiClient(proxy.url)
-    mgr = Manager(client)
-    NotebookReconciler(client).setup(mgr)
-    mgr.start()
+    # LIFO cleanup registered as each component starts: a partial setup
+    # failure must not leak running threads into later (timed) benches
+    cleanups = []
     try:
+        sim_mgr = Manager(store)
+        StatefulSetSimulator(store, boot_delay_s=0.0).setup(sim_mgr)
+        sim_mgr.start()
+        cleanups.append(sim_mgr.stop)
+        proxy = ApiServerProxy(store)
+        proxy.start()
+        cleanups.append(proxy.stop)
+        client = HttpApiClient(proxy.url)
+        cleanups.append(client.close)  # unblocks the watch threads
+        mgr = Manager(client)
+        NotebookReconciler(client).setup(mgr)
+        mgr.start()
+        cleanups.append(mgr.stop)
         return _create_and_await_slice_ready(client)
     finally:
-        mgr.stop()
-        client.close()  # stops the watch threads' reconnect loops
-        proxy.stop()
-        sim_mgr.stop()
+        for cleanup in reversed(cleanups):
+            cleanup()
 
 
 def main() -> None:

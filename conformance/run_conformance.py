@@ -218,6 +218,11 @@ def run_in_cluster(report_dir: str) -> list[dict]:
     results = [{"config": "controller-validation",
                 "passed": not (errs := validate_controllers()),
                 "errors": errs, "duration_s": 0.0}]
+    if errs:
+        # reference semantics: controllers validate BEFORE any notebook
+        # test; with them down every config would just burn its timeout
+        # (e2e notebook_controller_setup_test.go:110-113 aborts the suite)
+        return results
     for cfg in CONFIGS:
         t0 = time.monotonic()
         errors: list[str] = []

@@ -35,6 +35,7 @@ import base64
 import json
 import logging
 import os
+import socket
 import ssl
 import tempfile
 import threading
@@ -315,7 +316,11 @@ class HttpApiClient:
             try:
                 self._watch_stream(kind, callback, namespace, label_selector,
                                    connected, seen)
-            except (urllib.error.URLError, OSError, ApiError) as err:
+            # ValueError: readline() on a response close() tore down under
+            # us ("I/O operation on closed file") — a shutdown race, not a
+            # bug; the loop exits via _stopped below
+            except (urllib.error.URLError, OSError, ApiError,
+                    ValueError) as err:
                 if self._stopped.is_set():
                     return
                 # a timed-out idle stream is the designed reconnect cadence,
@@ -402,14 +407,18 @@ class HttpApiClient:
                     self._live_streams.discard(resp)
 
     def close(self) -> None:
-        """Stop watch threads NOW: set the stop flag and close any live
-        watch responses so blocked readline() calls return immediately
-        instead of waiting out the server's bookmark interval."""
+        """Stop watch threads NOW: set the stop flag and shut down the live
+        watch sockets. A blocked recv() wakes on socket shutdown (returns
+        0 bytes → readline sees EOF); calling resp.close() instead would
+        contend on the BufferedReader lock the reading thread holds and
+        block until the read timeout."""
         self._stopped.set()
         with self._streams_lock:
             streams = list(self._live_streams)
         for resp in streams:
             try:
-                resp.close()
-            except OSError:
+                sock = resp.fp.raw._sock  # noqa: SLF001 — http.client layout
+                sock.shutdown(socket.SHUT_RDWR)
+            except (AttributeError, OSError, ValueError):
+                # already closed / non-socket transport: best effort
                 pass
