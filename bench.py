@@ -473,7 +473,11 @@ def measure_once_http() -> float:
         return _create_and_await_slice_ready(client)
     finally:
         for cleanup in reversed(cleanups):
-            cleanup()
+            try:
+                cleanup()
+            except Exception as e:  # noqa: BLE001 — one failed stop must
+                # not strand the remaining components' threads
+                sys.stderr.write(f"bench: cleanup {cleanup} failed: {e}\n")
 
 
 def main() -> None:

@@ -316,11 +316,7 @@ class HttpApiClient:
             try:
                 self._watch_stream(kind, callback, namespace, label_selector,
                                    connected, seen)
-            # ValueError: readline() on a response close() tore down under
-            # us ("I/O operation on closed file") — a shutdown race, not a
-            # bug; the loop exits via _stopped below
-            except (urllib.error.URLError, OSError, ApiError,
-                    ValueError) as err:
+            except (urllib.error.URLError, OSError, ApiError) as err:
                 if self._stopped.is_set():
                     return
                 # a timed-out idle stream is the designed reconnect cadence,
@@ -387,7 +383,13 @@ class HttpApiClient:
                 # unchanged RVs the diff delivers nothing.
                 self._resync(kind, callback, namespace, label_selector, seen)
                 while not self._stopped.is_set():
-                    line = resp.readline()
+                    try:
+                        line = resp.readline()
+                    except ValueError:
+                        # close()'s fallback path closed the file under us
+                        # ("I/O operation on closed file") — shutdown race,
+                        # scoped here so resync JSON errors stay loud
+                        return
                     if not line:
                         return  # server closed the stream
                     try:
@@ -419,6 +421,12 @@ class HttpApiClient:
             try:
                 sock = resp.fp.raw._sock  # noqa: SLF001 — http.client layout
                 sock.shutdown(socket.SHUT_RDWR)
-            except (AttributeError, OSError, ValueError):
-                # already closed / non-socket transport: best effort
-                pass
+            except (OSError, ValueError):
+                pass  # already closed: nothing left to unblock
+            except AttributeError:
+                # different response internals: fall back to close() —
+                # may block until the read timeout, but never hangs forever
+                try:
+                    resp.close()
+                except OSError:
+                    pass
