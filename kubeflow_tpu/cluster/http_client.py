@@ -316,6 +316,14 @@ class HttpApiClient:
             try:
                 self._watch_stream(kind, callback, namespace, label_selector,
                                    connected, seen)
+            except json.JSONDecodeError as err:
+                # malformed/truncated LIST body during resync (LB error
+                # page, apiserver killed mid-write): reconnect — a dead
+                # watch thread would mean a permanently stale informer.
+                # WARNING, not debug: a persistently malformed server must
+                # stay visible, not loop silently
+                log.warning("watch %s resync body unparseable (%s); "
+                            "reconnecting", kind, err)
             except (urllib.error.URLError, OSError, ApiError) as err:
                 if self._stopped.is_set():
                     return

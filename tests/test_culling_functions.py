@@ -138,11 +138,11 @@ class TestStopAnnotationAndIdleness:
         store.patch(api.KIND, "ns", "nb",
                     {"metadata": {"labels": {"touch": "1"}}})
         drain(mgr)
-        return store, api
+        return store, api, mgr
 
     def test_idle_beyond_threshold_sets_stop_annotation(self):
-        store, api = self.make_world(idle_minutes_ago=120,
-                                     cull_after_min=60)
+        store, api, _ = self.make_world(idle_minutes_ago=120,
+                                        cull_after_min=60)
         nb = store.get(api.KIND, "ns", "nb")
         stop = (nb["metadata"].get("annotations") or {}).get(
             names.STOP_ANNOTATION)
@@ -152,7 +152,8 @@ class TestStopAnnotationAndIdleness:
         parse_time(stop)
 
     def test_recent_activity_does_not_cull(self):
-        store, api = self.make_world(idle_minutes_ago=10, cull_after_min=60)
+        store, api, _ = self.make_world(idle_minutes_ago=10,
+                                        cull_after_min=60)
         nb = store.get(api.KIND, "ns", "nb")
         assert names.STOP_ANNOTATION not in (
             nb["metadata"].get("annotations") or {})
@@ -160,14 +161,16 @@ class TestStopAnnotationAndIdleness:
         assert names.LAST_ACTIVITY_ANNOTATION in nb["metadata"]["annotations"]
 
     def test_already_stopped_notebook_not_reprocessed(self):
-        store, api = self.make_world(idle_minutes_ago=120, cull_after_min=60)
+        from tests.conftest import drain
+        store, api, mgr = self.make_world(idle_minutes_ago=120,
+                                          cull_after_min=60)
         nb = store.get(api.KIND, "ns", "nb")
         stop_value = nb["metadata"]["annotations"][names.STOP_ANNOTATION]
-        from tests.conftest import drain  # noqa: F401
         # re-reconcile: the stop value must not be rewritten (reference
         # StopAnnotationIsSet short-circuits, culling_controller.go:105-118)
         store.patch(api.KIND, "ns", "nb",
                     {"metadata": {"labels": {"touch": "2"}}})
+        drain(mgr)
         nb = store.get(api.KIND, "ns", "nb")
         assert nb["metadata"]["annotations"][names.STOP_ANNOTATION] == \
             stop_value

@@ -85,9 +85,11 @@ def test_reconcilers_run_unmodified_over_http(cluster_server, config):
             sts = kubectl.get_or_none("StatefulSet", "default", "nb-http")
             pod = kubectl.get_or_none("Pod", "default", "nb-http-0")
             return sts and pod
-        # generous timeout: under full-suite CPU contention the manager's
-        # watch threads + reconcile loop share cores with jit compiles
-        wait_for(sts_with_pod, timeout=90, msg="STS + pod via HTTP reconcile")
+        # generous timeout: under full-suite CPU contention (plus a
+        # concurrent bench run) the manager's watch threads + reconcile
+        # loop share cores with jit compiles; observed >90s stalls
+        wait_for(sts_with_pod, timeout=180,
+                 msg="STS + pod via HTTP reconcile")
         # mutating webhook ran server-side: TPU image swap applied
         sts = kubectl.get("StatefulSet", "default", "nb-http")
         image = k8s.get_in(sts, "spec", "template", "spec",
@@ -98,13 +100,14 @@ def test_reconcilers_run_unmodified_over_http(cluster_server, config):
             nb = kubectl.get("Notebook", "default", "nb-http")
             cond = api.get_condition(nb, api.CONDITION_SLICE_READY)
             return cond and cond["status"] == "True"
-        wait_for(ready, timeout=90, msg="slice-ready condition over HTTP")
+        wait_for(ready, timeout=180,
+                 msg="slice-ready condition over HTTP")
 
         # deletion cascades server-side (ownerRef GC)
         kubectl.delete("Notebook", "default", "nb-http")
         wait_for(lambda: kubectl.get_or_none(
             "StatefulSet", "default", "nb-http") is None,
-            timeout=90, msg="cascade delete over HTTP")
+            timeout=180, msg="cascade delete over HTTP")
     finally:
         client.close()
         kubectl.close()

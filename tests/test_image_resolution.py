@@ -211,3 +211,25 @@ def test_selection_targets_first_container_when_name_differs(world):
     out = store.create(nb)
     assert out["spec"]["template"]["spec"]["containers"][0]["image"] == \
         DIGEST_NEW
+
+
+def test_tag_with_empty_items_leaves_image(world):
+    """RHOAIENG-13916 analog (reference table case 'ImageStream with a tag
+    without items'): a status tag that exists but carries no items must
+    resolve to nothing — image untouched, admission succeeds."""
+    store, config = world
+    store.create(imagestream("jupyter-ds",
+                             tags=[{"tag": "2024.2", "items": []}]))
+    nb = store.create(nb_with_selection())
+    assert api.notebook_container(nb)["image"] == "placeholder:latest"
+
+
+def test_item_without_docker_reference_skipped(world):
+    """An item missing dockerImageReference cannot resolve; with no other
+    usable item the image stays untouched."""
+    store, config = world
+    store.create(imagestream("jupyter-ds", tags=[{
+        "tag": "2024.2",
+        "items": [{"created": "2024-06-01T00:00:00Z"}]}]))
+    nb = store.create(nb_with_selection())
+    assert api.notebook_container(nb)["image"] == "placeholder:latest"
