@@ -317,6 +317,8 @@ class HttpApiClient:
                 self._watch_stream(kind, callback, namespace, label_selector,
                                    connected, seen)
             except json.JSONDecodeError as err:
+                if self._stopped.is_set():
+                    return  # close() aborted the read mid-body: not an error
                 # malformed/truncated LIST body during resync (LB error
                 # page, apiserver killed mid-write): reconnect — a dead
                 # watch thread would mean a permanently stale informer.
