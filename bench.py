@@ -5,15 +5,21 @@ metric is "kubectl apply of a Notebook CR yields a ready Jupyter server with
 jax.device_count() parity in <90 s" (BASELINE.json, within the reference's
 3-minute e2e ceiling, odh e2e/notebook_controller_setup_test.go:88-90).
 
-Three benches, each emitted as a JSON line (headline metric printed LAST):
+Eight benches, each emitted as a JSON line (headline metric printed LAST):
 
-1. ``attention``  — flash-attention (pallas) vs XLA attention forward
-   timing at several sequence lengths. TPU-only: off-TPU the pallas kernel
-   runs in interpreter mode, which times the emulator, not the kernel.
-2. ``train_step`` — jitted sharded train-step throughput on the flagship
-   transformer: tokens/s and model FLOPs utilisation (MFU vs the chip's
-   bf16 peak; off-TPU MFU is reported as null — no meaningful peak).
-3. ``notebook_cr_to_slice_ready_p50_s`` (headline) — full control-plane
+1. ``flash_vs_xla_attention_speedup`` — pallas flash vs XLA attention
+   forward timing (TPU-only: interpret mode would time the emulator);
+   geomean over the sequence range the model actually dispatches to flash.
+2. ``train_step_tokens_per_sec`` — jitted sharded train-step throughput on
+   the flagship transformer: tokens/s and MFU vs the chip's bf16 peak
+   (off-TPU MFU is null — no meaningful peak).
+3. ``train_8k_ctx_tokens_per_sec`` / ``train_32k_ctx_tokens_per_sec`` —
+   long-context training on one chip (remat + flash + fused chunked CE).
+4. ``decode_tokens_per_sec`` / ``decode_int8_tokens_per_sec`` — batched
+   autoregressive decode, f32 and int8 weight-only serving.
+5. ``notebook_cr_to_slice_ready_http_p50_s`` — the control-plane loop over
+   the real HTTP wire protocol (no XLA boot in readiness).
+6. ``notebook_cr_to_slice_ready_p50_s`` (headline) — full control-plane
    loop in-process (apiserver, core reconciler, kubelet/STS simulator)
    where a worker pod only becomes Ready once genuine device enumeration +
    a jitted forward step have run, so the latency includes real XLA

@@ -1,0 +1,107 @@
+"""Notebook update-path spec — the reference's "Updating a Notebook" group
+(odh notebook_controller_test.go:699-826): a spec update propagates to the
+rendered StatefulSet, and the trusted-CA bundle is mounted on update when
+the trust source appears after creation.
+"""
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.controllers import setup_controllers
+from kubeflow_tpu.controllers.cacert import TRUSTED_CA_BUNDLE, WORKBENCH_BUNDLE
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+from tests.conftest import drain
+
+CENTRAL = "kubeflow-tpu-system"
+PEM = ("-----BEGIN CERTIFICATE-----\nY2VydGlmaWNhdGUtYnl0ZXM=\n"
+       "-----END CERTIFICATE-----")
+
+
+@pytest.fixture
+def world():
+    store = ClusterStore()
+    config = ControllerConfig(controller_namespace=CENTRAL)
+    mgr = setup_controllers(store, config)
+    return store, mgr
+
+
+def create_nb(store, mgr, **kw):
+    store.create(api.new_notebook("nb", "user-ns", **kw))
+    drain(mgr)
+    return store.get(api.KIND, "user-ns", "nb")
+
+
+def stopped(store, mgr):
+    """Webhook mutations apply immediately on a stopped notebook (no
+    restart-gating deferral)."""
+    store.patch(api.KIND, "user-ns", "nb", {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+    drain(mgr)
+
+
+def test_spec_update_propagates_to_statefulset(world):
+    """Reference 'Should update the Notebook specification'
+    (:707-730): the user edits the pod template; copy-fields pushes it
+    into the rendered StatefulSet."""
+    store, mgr = world
+    create_nb(store, mgr, image="jupyter:2024a")
+    nb = store.get(api.KIND, "user-ns", "nb")
+    api.notebook_container(nb)["image"] = "jupyter:2024b"
+    api.notebook_pod_spec(nb)["containers"][0].setdefault("env", []).append(
+        {"name": "NEW_VAR", "value": "yes"})
+    store.update(nb)
+    drain(mgr)
+    sts = store.get("StatefulSet", "user-ns", "nb")
+    container = k8s.get_in(sts, "spec", "template", "spec", "containers")[0]
+    assert container["image"] == "jupyter:2024b"
+    assert {"name": "NEW_VAR", "value": "yes"} in container["env"]
+
+
+def test_replica_edit_on_sts_repaired_slice_atomically(world):
+    """Hand-scaling the STS to a partial worker count is drift the
+    reconciler repairs (slice atomicity: 0 or full, never partial)."""
+    store, mgr = world
+    create_nb(store, mgr, annotations={
+        "tpu.kubeflow.org/accelerator": "v5e-16"})
+    sts = store.get("StatefulSet", "user-ns", "nb")
+    assert sts["spec"]["replicas"] == 4
+    sts["spec"]["replicas"] = 2  # partial scale: forbidden state
+    store.update(sts)
+    drain(mgr)
+    assert store.get("StatefulSet", "user-ns", "nb")["spec"][
+        "replicas"] == 4
+
+
+def test_trusted_ca_mounted_on_update_when_source_appears_later(world):
+    """Reference 'When notebook CR is updated, should mount a trusted-ca
+    if it exists on the given namespace' (:731-825): creation happens
+    without trust config; the admin later supplies odh-trusted-ca-bundle;
+    the next notebook update picks up the mount."""
+    store, mgr = world
+    create_nb(store, mgr)
+    stopped(store, mgr)
+    nb = store.get(api.KIND, "user-ns", "nb")
+    assert not any(v.get("name") == "trusted-ca"
+                   for v in api.notebook_pod_spec(nb).get("volumes", []))
+
+    store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                  "metadata": {"name": TRUSTED_CA_BUNDLE,
+                               "namespace": CENTRAL},
+                  "data": {"ca-bundle.crt": PEM}})
+    # extension reconciler projects the per-namespace bundle
+    store.patch(api.KIND, "user-ns", "nb",
+                {"metadata": {"labels": {"touch": "1"}}})
+    drain(mgr)
+    assert store.get("ConfigMap", "user-ns", WORKBENCH_BUNDLE)
+    # the NEXT update re-admits the pod spec → CA mount applied
+    store.patch(api.KIND, "user-ns", "nb",
+                {"metadata": {"labels": {"touch": "2"}}})
+    drain(mgr)
+    nb = store.get(api.KIND, "user-ns", "nb")
+    assert any(v.get("name") == "trusted-ca"
+               for v in api.notebook_pod_spec(nb).get("volumes", []))
+    mounts = api.notebook_container(nb).get("volumeMounts", [])
+    assert any(m.get("mountPath", "").startswith("/etc/pki/tls")
+               for m in mounts)
