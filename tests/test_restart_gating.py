@@ -1,0 +1,115 @@
+"""Restart-gating matrix — the subtlest reference behavior
+(maybeRestartRunningNotebook, odh notebook_mutating_webhook.go:518-581;
+SURVEY §7 hard part #3): webhook-caused pod-template changes on a RUNNING
+notebook park in ``update-pending`` instead of silently bouncing the live
+slice; user changes always pass through; stopped notebooks take
+everything; the pending diff clears once applied.
+"""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webhook.mutating import NotebookMutatingWebhook
+
+NS = "proj"
+
+
+@pytest.fixture
+def world():
+    store = ClusterStore()
+    config = ControllerConfig(mlflow_enabled=True,
+                              gateway_url="gw.example.com")
+    NotebookMutatingWebhook(store, config).install(store)
+    return store
+
+
+def running_nb(store):
+    """A RUNNING notebook: created through admission, then the lock
+    (admission-injected stop annotation) removed, as the extension
+    reconciler would."""
+    store.create(api.new_notebook("nb", NS, image="jupyter/base:latest"))
+    return store.patch(api.KIND, NS, "nb", {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: None}}})
+
+
+def pending_of(nb):
+    raw = k8s.get_annotation(nb, names.UPDATE_PENDING_ANNOTATION)
+    return json.loads(raw) if raw else None
+
+
+class TestRunningNotebook:
+    def test_user_change_passes_through(self, world):
+        store = world
+        running_nb(store)
+        out = store.patch(api.KIND, NS, "nb", {"spec": {"template": {"spec": {
+            "containers": [{"name": "nb", "image": "jupyter/base:2024b"}]}}}})
+        assert api.notebook_container(out)["image"] == "jupyter/base:2024b"
+        assert pending_of(out) is None
+
+    def test_webhook_mutation_parked_with_diff(self, world):
+        """Flipping the MLflow annotation on a RUNNING notebook would
+        inject env vars (a pod-template change) — parked, not applied."""
+        store = world
+        running_nb(store)
+        out = store.patch(api.KIND, NS, "nb", {"metadata": {"annotations": {
+            names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}}})
+        env = {e["name"] for e in
+               api.notebook_container(out).get("env", [])}
+        assert "MLFLOW_TRACKING_URI" not in env  # not silently applied
+        diffs = pending_of(out)
+        assert diffs and any("env" in d for d in diffs)
+
+    def test_mixed_change_applies_user_part_parks_webhook_part(self, world):
+        """One update carrying BOTH a user image edit and an annotation
+        that triggers webhook mutations: the user part lands, the webhook
+        part parks (the reference's three-way old/incoming/mutated diff)."""
+        store = world
+        running_nb(store)
+        out = store.patch(api.KIND, NS, "nb", {
+            "metadata": {"annotations": {
+                names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}},
+            "spec": {"template": {"spec": {"containers": [
+                {"name": "nb", "image": "jupyter/base:2024c"}]}}}})
+        assert api.notebook_container(out)["image"] == "jupyter/base:2024c"
+        assert "MLFLOW_TRACKING_URI" not in {
+            e["name"] for e in api.notebook_container(out).get("env", [])}
+        assert pending_of(out)
+
+    def test_auth_sidecar_injection_parked_on_running(self, world):
+        store = world
+        running_nb(store)
+        out = store.patch(api.KIND, NS, "nb", {"metadata": {"annotations": {
+            names.INJECT_AUTH_ANNOTATION: "true"}}})
+        containers = {c["name"] for c in
+                      api.notebook_pod_spec(out)["containers"]}
+        assert "kube-rbac-proxy" not in containers  # no silent bounce
+        assert pending_of(out)
+
+
+class TestStoppedNotebook:
+    def test_stopped_takes_webhook_mutations_and_clears_pending(self, world):
+        store = world
+        running_nb(store)
+        # park a webhook change first
+        out = store.patch(api.KIND, NS, "nb", {"metadata": {"annotations": {
+            names.MLFLOW_INSTANCE_ANNOTATION: "mlflow"}}})
+        assert pending_of(out)
+        # stop → the next admission applies everything and clears pending
+        out = store.patch(api.KIND, NS, "nb", {"metadata": {"annotations": {
+            names.STOP_ANNOTATION: "2026-01-01T00:00:00Z"}}})
+        env = {e["name"] for e in
+               api.notebook_container(out).get("env", [])}
+        assert "MLFLOW_TRACKING_URI" in env
+        assert pending_of(out) is None
+
+    def test_no_spurious_pending_on_noop_update(self, world):
+        store = world
+        running_nb(store)
+        out = store.patch(api.KIND, NS, "nb",
+                          {"metadata": {"labels": {"touch": "1"}}})
+        assert pending_of(out) is None
