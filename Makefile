@@ -5,7 +5,8 @@ PYTHON ?= python
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: help test test-fast test-chaos test-transport lint manifests \
-        manifests-check check-license bench numerics dryrun loadtest run
+        manifests-check check-license bench numerics dryrun loadtest run \
+        run-split
 
 help: ## Display this help.
 	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_-]+:.*?##/ {printf "  %-16s %s\n", $$1, $$2}' $(MAKEFILE_LIST)
@@ -48,3 +49,8 @@ loadtest: ## 100-notebook control-plane fan-out, in-process.
 
 run: ## Standalone control plane: apiserver on :6443 + kubelet simulator.
 	$(PYTHON) -m kubeflow_tpu.main --serve-apiserver 6443 --simulate-kubelet
+
+run-split: ## The reference's two-binary topology: extension serves the cluster, core joins over HTTP.
+	@bash -c 'trap "kill 0" EXIT; \
+	  $(PYTHON) -m kubeflow_tpu.main --serve-apiserver 6443 --components extension --simulate-kubelet --health-port 8081 & \
+	  $(PYTHON) -m kubeflow_tpu.main --api-server http://127.0.0.1:6443 --components core --health-port 8084'
