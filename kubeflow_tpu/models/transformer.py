@@ -116,11 +116,45 @@ def init_params(key: jax.Array, config: TransformerConfig) -> dict:
 
 
 # ------------------------------------------------------------------- layers
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
-    dt = x.dtype
+def _rms_norm_impl(x, weight, eps):
+    """One shared primal body for both the plain and the grad-traced
+    forward — they must never diverge. Returns (y, inv)."""
     x32 = x.astype(jnp.float32)
-    x32 = x32 * lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
-    return (x32 * weight.astype(jnp.float32)).astype(dt)
+    inv = lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (x32 * inv * weight.astype(jnp.float32)).astype(x.dtype), inv
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-6) -> jax.Array:
+    """RMSNorm in f32 with a custom VJP: autodiff of the naive form saves
+    the full f32 normalized intermediate per call — 2 per layer, observed
+    as f32[L,b,s,d] HLO temps that dominate HBM at batch>=16 and push XLA
+    into slower memory-pressure schedules. The VJP saves only (x, w, inv)
+    where inv is the per-ROW rsqrt scalar, d× smaller, and recomputes the
+    rest in backward."""
+    return _rms_norm_impl(x, weight, eps)[0]
+
+
+def _rms_norm_fwd(x, weight, eps):
+    y, inv = _rms_norm_impl(x, weight, eps)
+    return y, (x, weight, inv)
+
+
+def _rms_norm_bwd(eps, res, g):
+    x, weight, inv = res
+    x32 = x.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    w32 = weight.astype(jnp.float32)
+    d = x.shape[-1]
+    wg = g32 * w32
+    # d(x·inv(x))·wg: product rule through the rsqrt(mean(x²)) term
+    dot = jnp.sum(x32 * wg, axis=-1, keepdims=True)
+    dx = inv * wg - (inv ** 3) * x32 * dot / d
+    dw = jnp.sum(g32 * x32 * inv, axis=tuple(range(x.ndim - 1)))
+    return dx.astype(x.dtype), dw.astype(weight.dtype)
+
+
+rms_norm.defvjp(_rms_norm_fwd, _rms_norm_bwd)
 
 
 def rope_frequencies(config: TransformerConfig, positions: jax.Array):

@@ -35,7 +35,11 @@ _NEG_INF = -1e30
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 512
 # per-d_head blocks measured on a real v5e chip (ci/tpu_numerics.py sweep,
-# recorded in TPU_NUMERICS.json): 21-28% faster than the generic defaults
+# recorded in TPU_NUMERICS.json): 21-28% faster than the generic defaults.
+# NOTE: the sweep's top candidates — (256,1024) and (512,1024) for both
+# d_heads — flip rank between runs (tunnel timing noise of the same order
+# as their gap); any of them is within ~25% of the per-run fastest, so the
+# pins below are stable choices, not a per-run argmax.
 TUNED_BLOCKS = {64: (256, 1024), 128: (512, 1024)}
 _LANES = 128  # per-row stats are stored lane-replicated for (8,128) tiling
 
