@@ -220,13 +220,21 @@ def moe_forward_hidden(params: dict, tokens: jax.Array, config: MoEConfig,
         positions = jnp.broadcast_to(positions, tokens.shape)
     cos, sin = rope_frequencies(c, positions)
 
+    # remat="mlp": checkpoint only the expert FFN (dispatch/combine +
+    # expert matmuls dominate saved activations); c/mesh are captured
+    # statically by the closure, not traced through the checkpoint
+    expert_mlp = (jax.checkpoint(
+        lambda x, layer: moe_mlp_block(x, layer, c, mesh=mesh))
+        if c.remat == "mlp"
+        else (lambda x, layer: moe_mlp_block(x, layer, c, mesh=mesh)))
+
     def layer_body(carry, layer):
         x, aux = carry
         x = attention_block(x, layer, c, cos, sin, mesh=mesh)
-        x, layer_aux = moe_mlp_block(x, layer, c, mesh=mesh)
+        x, layer_aux = expert_mlp(x, layer)
         return (x, aux + layer_aux), None
 
-    body = jax.checkpoint(layer_body) if c.remat else layer_body
+    body = jax.checkpoint(layer_body) if c.remat is True else layer_body
     (x, aux), _ = lax.scan(body, (x, jnp.float32(0.0)), params["blocks"])
 
     return rms_norm(x, params["final_norm"]), aux / c.n_layers

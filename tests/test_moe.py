@@ -165,3 +165,20 @@ def test_grouped_ep_sharded_step_still_trains():
     targets = jnp.roll(tokens, -1, axis=1)
     _, _, loss = step_fn(params, opt, tokens, targets)
     assert jnp.isfinite(loss)
+
+
+def test_moe_remat_policies_match():
+    """remat False / True / 'mlp' (expert-FFN-only) are numerically
+    identical on the MoE family too."""
+    import numpy as np
+    cfg = tiny_config()
+    params = init_moe_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                                cfg.vocab_size)
+    base_logits, base_aux = moe_forward(params, tokens, cfg)
+    for policy in (True, "mlp"):
+        logits, aux = moe_forward(params, tokens,
+                                  tiny_config(remat=policy))
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(base_logits), rtol=1e-6)
+        np.testing.assert_allclose(float(aux), float(base_aux), rtol=1e-6)
