@@ -26,6 +26,7 @@ import logging
 import queue
 import ssl
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -143,6 +144,34 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length", 0))
         return json.loads(self.rfile.read(length) or b"{}")
 
+    def send_response(self, code, message=None):  # noqa: D102 — audit tap
+        self._last_status = code
+        super().send_response(code, message)
+
+    def _audit(self, method: str, path: str) -> None:
+        """One NDJSON line per mutating request (verb, path, peer, the
+        RESPONSE status so denied/failed mutations are distinguishable,
+        RFC3339 timestamp) — the analog of the reference test suite's
+        optional apiserver audit log (odh suite_test.go:127-157). Reads
+        are skipped (GET/watch volume would drown the trail) and an audit
+        write failure must never break serving."""
+        audit = getattr(self.server, "audit_log", None)
+        if audit is None or method == "GET":
+            return
+        line = json.dumps({
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+            "verb": method, "path": path,
+            "status": getattr(self, "_last_status", None),
+            "peer": self.address_string(),
+        }) + "\n"
+        try:
+            with self.server.audit_lock:  # type: ignore[attr-defined]
+                audit.write(line)
+                audit.flush()
+        except (OSError, ValueError) as exc:
+            # disk full, or stop() closed the file under a late handler
+            log.warning("audit write failed: %s", exc)
+
     def _dispatch(self, method: str) -> None:
         if not self._authorized():
             self._send_error_status(401, "Unauthorized", "invalid bearer token")
@@ -170,6 +199,9 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as exc:  # noqa: BLE001 — surface as 500 Status
             log.exception("handler error on %s %s", method, self.path)
             self._send_error_status(500, "InternalError", str(exc))
+        finally:
+            # AFTER the response: the audit line carries the actual status
+            self._audit(method, parsed.path)
 
     do_GET = lambda self: self._dispatch("GET")            # noqa: E731
     do_POST = lambda self: self._dispatch("POST")          # noqa: E731
@@ -289,13 +321,19 @@ class ApiServerProxy:
 
     def __init__(self, store, port: int = 0, host: str = "127.0.0.1",
                  token: str | None = None, certfile: str | None = None,
-                 keyfile: str | None = None) -> None:
+                 keyfile: str | None = None,
+                 audit_log: str | None = None) -> None:
         self.store = store
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.store = store  # type: ignore[attr-defined]
         self._httpd.token = token  # type: ignore[attr-defined]
         self._httpd.shutting_down = False  # type: ignore[attr-defined]
+        # optional mutating-request audit trail (suite_test.go:127-157
+        # analog); opened append so restarts extend the trail
+        self._audit_file = open(audit_log, "a") if audit_log else None
+        self._httpd.audit_log = self._audit_file  # type: ignore[attr-defined]
+        self._httpd.audit_lock = threading.Lock()  # type: ignore[attr-defined]
         self.scheme = "http"
         if certfile:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -326,3 +364,10 @@ class ApiServerProxy:
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+        if self._audit_file is not None:
+            # under the lock so a late handler's write either lands before
+            # the close or hits the guarded ValueError path, never a race
+            with self._httpd.audit_lock:  # type: ignore[attr-defined]
+                self._httpd.audit_log = None  # type: ignore[attr-defined]
+                self._audit_file.close()
+                self._audit_file = None

@@ -154,6 +154,11 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="bind address for --serve-apiserver; non-loopback "
                          "requires --apiserver-token (the facade grants "
                          "full cluster read/write, Secrets included)")
+    ap.add_argument("--audit-log", default=None, metavar="PATH",
+                    help="with --serve-apiserver: append one NDJSON line "
+                         "per mutating request (who changed what) — the "
+                         "reference test suite's apiserver audit-log debug "
+                         "hook")
     ap.add_argument("--apiserver-token", default=None,
                     help="bearer token required by --serve-apiserver "
                          "(env APISERVER_TOKEN also honored); TLS via "
@@ -209,7 +214,8 @@ def main(argv=None) -> int:
             mgr.client.store, port=args.serve_apiserver,
             host=args.apiserver_bind, token=token,
             certfile=f"{args.cert_dir}/tls.crt" if args.cert_dir else None,
-            keyfile=f"{args.cert_dir}/tls.key" if args.cert_dir else None)
+            keyfile=f"{args.cert_dir}/tls.key" if args.cert_dir else None,
+            audit_log=args.audit_log)
         apiserver.start()
         log.info("apiserver facade listening on %s (auth=%s)",
                  apiserver.url, "token" if token else "none/loopback")

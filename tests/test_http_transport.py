@@ -325,3 +325,34 @@ def test_rest_client_requests_metric(server):
         assert "rest_client_requests_total" in registry.expose()
     finally:
         client.close()
+
+
+def test_audit_log_records_mutating_requests(tmp_path, store):
+    """The reference envtest suite's optional apiserver audit log
+    (odh suite_test.go:127-157 analog): mutating verbs leave an NDJSON
+    trail, reads do not."""
+    import json as _json
+
+    from kubeflow_tpu.api import types as api
+
+    path = tmp_path / "audit.ndjson"
+    proxy = ApiServerProxy(store, audit_log=str(path))
+    proxy.start()
+    try:
+        client = HttpApiClient(proxy.url)
+        client.create(api.new_notebook("nb", "ns"))
+        client.get("Notebook", "ns", "nb")           # read: not audited
+        client.patch("Notebook", "ns", "nb",
+                     {"metadata": {"labels": {"x": "1"}}})
+        client.delete("Notebook", "ns", "nb")
+        client.close()
+    finally:
+        proxy.stop()
+    entries = [_json.loads(line) for line in path.read_text().splitlines()]
+    verbs = [e["verb"] for e in entries]
+    assert verbs == ["POST", "PATCH", "DELETE"]
+    assert all("/namespaces/ns/" in e["path"] for e in entries)
+    # the line carries the RESPONSE status (denied mutations must be
+    # distinguishable) and an RFC3339 timestamp
+    assert [e["status"] for e in entries] == [201, 200, 200]
+    assert all(e["ts"].endswith("Z") for e in entries)
