@@ -40,14 +40,19 @@ log = logging.getLogger("kubeflow_tpu.apiserver")
 WATCH_BOOKMARK_INTERVAL_S = 10.0
 
 
-def _parse_label_selector(raw: str | None) -> dict[str, str] | None:
+def _parse_label_selector(raw: str | None) -> dict[str, str | None] | None:
+    """``key=value`` equality terms plus bare ``key`` existence terms
+    (mapped to value ``None``, matching k8s.matches_labels)."""
     if not raw:
         return None
-    out = {}
+    out: dict[str, str | None] = {}
     for part in raw.split(","):
+        part = part.strip()
         if "=" in part:
             key, _, val = part.partition("=")
             out[key.strip()] = val.strip()
+        elif part:
+            out[part] = None
     return out or None
 
 

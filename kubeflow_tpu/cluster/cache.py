@@ -90,10 +90,18 @@ class CachingClient:
     def __init__(self, store,
                  transforms: Iterable[Callable[[dict], dict]] =
                  DEFAULT_TRANSFORMS,
-                 disable_for: Iterable[str] = DEFAULT_DISABLE_FOR) -> None:
+                 disable_for: Iterable[str] = DEFAULT_DISABLE_FOR,
+                 auto_informer: bool = True) -> None:
         self.store = store
         self.transforms = tuple(transforms)
         self.disable_for = frozenset(disable_for)
+        # auto_informer=False: the cache opens NO watch streams of its own —
+        # it is fed from watches its owner already holds (``feed``) plus an
+        # explicit ``backfill`` per kind. This is how a reconciler shares
+        # its manager watch streams with its read cache instead of
+        # duplicating every stream + LIST (the reference likewise has ONE
+        # informer layer serving both dispatch and cached reads).
+        self.auto_informer = auto_informer
         self._cache: dict[tuple[str, str, str], dict] = {}
         # key → deletion time for keys DELETED by the watch stream; guards
         # the backfill (and the cache-miss fall-through) against resurrecting
@@ -104,6 +112,11 @@ class CachingClient:
         self._tombstones: dict[tuple[str, str, str], float] = {}
         self._lock = threading.Lock()
         self._watched: set[str] = set()
+        # kinds whose backfill LIST has completed: for these a cache miss is
+        # an authoritative NotFound (informer semantics) — falling through
+        # to a live GET would re-create the per-frame GET storm for every
+        # lookup of a deleted object (e.g. Events outliving their Pod)
+        self._warm: set[str] = set()
 
     # ------------------------------------------------------------- ingest
     def _transform(self, obj: dict) -> dict:
@@ -112,6 +125,8 @@ class CachingClient:
         return obj
 
     def _ensure_informer(self, kind: str) -> None:
+        if not self.auto_informer:
+            return  # externally fed: owner registers watches + backfills
         with self._lock:
             if kind in self._watched:
                 return
@@ -125,6 +140,28 @@ class CachingClient:
         self.store.watch(kind, self._on_event)
         for obj in self.store.list(kind):
             self._ingest(obj)
+        with self._lock:
+            self._warm.add(kind)
+
+    # ---------------------------------------------------- external feeding
+    def feed(self, event: WatchEvent) -> None:
+        """Ingest one watch event from a stream the OWNER holds (tee from a
+        manager watch). Only meaningful with auto_informer=False."""
+        self._on_event(event)
+
+    def backfill(self, kind: str) -> None:
+        """Snapshot-list ``kind`` into the cache and mark it warm. Call
+        AFTER the external watch feeding this cache is registered (same
+        watch-then-list ordering _ensure_informer uses, same staleness
+        guards). Clients whose watch streams already deliver the initial
+        state as ADDED events on connect (HttpApiClient's resync) skip the
+        redundant LIST — the tee has fed (or is feeding) the same objects."""
+        if not getattr(self.store, "watch_delivers_initial_state", False):
+            for obj in self.store.list(kind):
+                self._ingest(obj)
+        with self._lock:
+            self._watched.add(kind)
+            self._warm.add(kind)
 
     TOMBSTONE_TTL_S = 10.0
 
@@ -174,12 +211,26 @@ class CachingClient:
     def get(self, kind: str, namespace: str, name: str) -> dict:
         if kind in self.disable_for:
             return self.store.get(kind, namespace, name)  # live read
+        with self._lock:
+            unfed = kind not in self._watched
+        if unfed and not self.auto_informer:
+            # nobody feeds this kind: live read WITHOUT ingest — a cached
+            # copy no watch updates would be served stale forever
+            return self.store.get(kind, namespace, name)
         self._ensure_informer(kind)
         with self._lock:
             obj = self._cache.get((kind, namespace, name))
+            warm = kind in self._warm
         if obj is not None:
             return k8s.deepcopy(obj)
-        # cache miss (first read before any event): fall through live, ingest
+        if warm:
+            # informer-authoritative miss: the kind is fully backfilled and
+            # watch-fed, so absence from the cache IS NotFound. Falling
+            # through live would issue one GET per lookup of every deleted
+            # object — the teardown-storm case (Events outlive their Pod).
+            from .errors import NotFoundError
+            raise NotFoundError(f"{kind} {namespace}/{name}")
+        # not yet warm (external-feed kind before backfill): live, ingest
         obj = self.store.get(kind, namespace, name)
         self._ingest(obj)
         return self._transform(k8s.deepcopy(obj))
@@ -193,7 +244,11 @@ class CachingClient:
 
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict | None = None) -> list[dict]:
-        if kind in self.disable_for:
+        with self._lock:
+            unfed = kind not in self._watched
+        if kind in self.disable_for or (unfed and not self.auto_informer):
+            # external-feed mode never auto-opens informers, so a LIST of a
+            # kind nobody backfilled must go live, not return an empty cache
             return self.store.list(kind, namespace, label_selector)
         self._ensure_informer(kind)
         # filter first, deepcopy only the matches, and do the copying
@@ -202,9 +257,7 @@ class CachingClient:
             matched = [o for (k, ns, _), o in self._cache.items()
                        if k == kind
                        and (namespace is None or ns == namespace)
-                       and (not label_selector
-                            or all(k8s.get_label(o, lk) == lv
-                                   for lk, lv in label_selector.items()))]
+                       and k8s.matches_labels(o, label_selector)]
         return [k8s.deepcopy(o) for o in matched]
 
     # ---------------------------------------- writes + watches: passthrough

@@ -74,6 +74,13 @@ WATCH_READ_TIMEOUT_S = WATCH_SERVER_TIMEOUT_S + 10.0
 WATCH_RECONNECT_DELAY_S = 1.0
 
 
+def _serialize_selector(selector: dict) -> str:
+    """k8s labelSelector grammar subset: ``key=value`` equality terms plus
+    bare ``key`` existence terms (value ``None``)."""
+    return ",".join(key if val is None else f"{key}={val}"
+                    for key, val in selector.items())
+
+
 def _error_from_response(code: int, body: bytes) -> ApiError:
     reason, message = "", ""
     try:
@@ -103,6 +110,10 @@ class HttpApiClient:
     """Client protocol implementation over HTTP(S)."""
 
     supports_inprocess_admission = False
+    # watch() resyncs on connect: existing objects arrive as ADDED events
+    # (informer boot semantics) — consumers backfilling a cache off these
+    # streams (CachingClient.backfill) need no extra LIST
+    watch_delivers_initial_state = True
 
     def __init__(self, base_url: str, token: str | None = None,
                  ca_cert: str | None = None, client_cert: str | None = None,
@@ -236,8 +247,7 @@ class HttpApiClient:
              label_selector: dict[str, str] | None = None) -> list[dict]:
         query = {}
         if label_selector:
-            query["labelSelector"] = ",".join(
-                f"{key}={val}" for key, val in label_selector.items())
+            query["labelSelector"] = _serialize_selector(label_selector)
         path = self._path(kind, namespace, query=query or None)
         return self._json("GET", path).get("items", [])
 
@@ -376,8 +386,7 @@ class HttpApiClient:
         query = {"watch": "true",
                  "timeoutSeconds": str(WATCH_SERVER_TIMEOUT_S)}
         if label_selector:
-            query["labelSelector"] = ",".join(
-                f"{key}={val}" for key, val in label_selector.items())
+            query["labelSelector"] = _serialize_selector(label_selector)
         path = self._path(kind, namespace, query=query)
         with self._request("GET", path, timeout=WATCH_READ_TIMEOUT_S) as resp:
             with self._streams_lock:

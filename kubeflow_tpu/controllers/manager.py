@@ -126,12 +126,22 @@ class Manager:
 
     def watch(self, kind: str, controller: str,
               mapper: Callable[[dict], list[Request]] | None = None,
-              predicate: Callable[[WatchEvent], bool] | None = None) -> None:
+              predicate: Callable[[WatchEvent], bool] | None = None,
+              tee: Callable[[WatchEvent], None] | None = None) -> None:
         """Wire a store watch into a controller's queue. ``mapper`` converts
         the observed object into reconcile requests (handler.EnqueueRequestsFromMapFunc);
         default maps to the object's own key (EnqueueRequestForObject /
-        Owns-style mapping is provided by owner_mapper below)."""
+        Owns-style mapping is provided by owner_mapper below). ``tee``
+        observes every event BEFORE predicate/mapper run — how a
+        reconciler's read cache shares the one watch stream instead of
+        opening a duplicate (the reference's informer layer serves both
+        dispatch and cached reads)."""
         def cb(event: WatchEvent) -> None:
+            if tee is not None:
+                try:
+                    tee(event)
+                except Exception:  # cache feeding must never break dispatch
+                    log.exception("watch tee failed for %s", kind)
             if predicate is not None and not predicate(event):
                 return
             reqs = (mapper(event.obj) if mapper is not None

@@ -55,6 +55,9 @@ class NotebookReconciler:
         self.metrics = metrics or MetricsRegistry()
         self.metrics.on_scrape(self._scrape_running)
         self.recorder = events.EventRecorder(client, component=self.name)
+        # watch-fed read cache for the Event predicate (built in setup();
+        # reconcilers constructed without setup() fall back to live reads)
+        self._read_cache = None
 
     # ------------------------------------------------------------- wiring
     def setup(self, mgr: Manager) -> None:
@@ -62,10 +65,30 @@ class NotebookReconciler:
         (notebook_controller.go:778-826): own Notebook, own STS/Service,
         map Pods via the notebook-name label."""
         mgr.register(self)
-        mgr.watch(api.KIND, self.name)
-        mgr.watch("StatefulSet", self.name, mapper=owner_mapper(api.KIND))
+        # The Event predicate resolves involvedObject → Notebook on EVERY
+        # delivered Event frame; the reference answers that from its
+        # informer cache (notebook_controller.go:739-767). Over a real wire
+        # client each lookup would otherwise be 1-2 API GETs per frame — a
+        # hot namespace turns every Pod event into a GET storm. The read
+        # cache is fed by TEEING the very watch streams this reconciler
+        # already holds (no duplicate streams; backfill LISTs only for
+        # clients whose watch doesn't resync initial state), and a warm
+        # miss is an authoritative NotFound so deleted objects don't
+        # regress to per-frame GETs.
+        from ..cluster.cache import CachingClient
+        cache = CachingClient(self.client, disable_for=(),
+                              auto_informer=False)
+        self._read_cache = cache
+        mgr.watch(api.KIND, self.name, tee=cache.feed)
+        mgr.watch("StatefulSet", self.name, mapper=owner_mapper(api.KIND),
+                  tee=cache.feed)
         mgr.watch("Service", self.name, mapper=owner_mapper(api.KIND))
-        mgr.watch("Pod", self.name, mapper=label_mapper(names.NOTEBOOK_NAME_LABEL))
+        mgr.watch("Pod", self.name, mapper=label_mapper(names.NOTEBOOK_NAME_LABEL),
+                  tee=cache.feed)
+        # backfill AFTER the watches above are live (watch-then-list: no
+        # missable gap; rv guard + tombstones make the overlap safe)
+        for kind in (api.KIND, "StatefulSet", "Pod"):
+            cache.backfill(kind)
         # Events of known notebooks' Pods/STSs share the Notebook queue and
         # are re-emitted on the CR (reference predNBEvents + mapEventToRequest,
         # notebook_controller.go:739-767,780-800; delete events are ignored)
@@ -80,21 +103,25 @@ class NotebookReconciler:
         obj = watch_event.obj
         if not events.is_sts_or_pod_event(obj):
             return False
+        reader = self._read_cache or self.client
         nb_name = events.nb_name_from_involved_object(
-            self.client, obj, names.NOTEBOOK_NAME_LABEL)
+            reader, obj, names.NOTEBOOK_NAME_LABEL)
         if nb_name is None:
             return False
-        return self.client.get_or_none(api.KIND, k8s.namespace(obj),
-                                       nb_name) is not None
+        return reader.get_or_none(api.KIND, k8s.namespace(obj),
+                                  nb_name) is not None
 
     def _scrape_running(self) -> None:
-        """notebook_running is computed at scrape time by listing STSs with
-        the notebook-name label (reference pkg/metrics/metrics.go:60-99)."""
-        stss = self.client.list("StatefulSet",
-                                label_selector=None)
+        """notebook_running is computed at scrape time by listing STSs
+        carrying the notebook-name label (reference pkg/metrics/
+        metrics.go:60-99 uses client.HasLabels) — the existence selector
+        runs server-side so a scrape is never an unbounded full-cluster
+        LIST over the wire."""
+        stss = self.client.list(
+            "StatefulSet",
+            label_selector={names.NOTEBOOK_NAME_LABEL: None})
         running = sum(1 for s in stss
-                      if k8s.get_label(s, names.NOTEBOOK_NAME_LABEL)
-                      and k8s.get_in(s, "status", "readyReplicas", default=0))
+                      if k8s.get_in(s, "status", "readyReplicas", default=0))
         self.metrics.notebook_running.set(running)
 
     # ---------------------------------------------------------- reconcile
@@ -136,11 +163,12 @@ class NotebookReconciler:
         watch (predicate only passes Pod/STS events)."""
         if not events.is_sts_or_pod_event(event):
             return
+        reader = self._read_cache or self.client
         nb_name = events.nb_name_from_involved_object(
-            self.client, event, names.NOTEBOOK_NAME_LABEL)
+            reader, event, names.NOTEBOOK_NAME_LABEL)
         if nb_name is None:
             return
-        notebook = self.client.get_or_none(api.KIND, namespace, nb_name)
+        notebook = reader.get_or_none(api.KIND, namespace, nb_name)
         if notebook is None:
             return
         involved = event.get("involvedObject", {})

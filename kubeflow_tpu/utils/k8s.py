@@ -198,11 +198,17 @@ def merge_managed_labels(obj: Obj, managed: dict[str, str]) -> bool:
     return bool(missing)
 
 
-def matches_labels(obj: Obj, selector: dict[str, str] | None) -> bool:
+def matches_labels(obj: Obj, selector: dict[str, str | None] | None) -> bool:
+    """Equality selector; a ``None`` value means existence (the ``key``
+    form of a k8s label selector) — used by the metrics scrape to LIST
+    only labelled StatefulSets server-side instead of filtering a
+    full-cluster LIST in Python (reference pkg/metrics/metrics.go:60-99
+    lists with client.HasLabels)."""
     if not selector:
         return True
     have = get_in(obj, "metadata", "labels", default={}) or {}
-    return all(have.get(k) == v for k, v in selector.items())
+    return all(k in have if v is None else have.get(k) == v
+               for k, v in selector.items())
 
 
 def json_merge_patch(target: Obj, patch: Obj) -> Obj:

@@ -39,6 +39,13 @@ class _Metric:
         with self._lock:
             return self._values.get(self._labels_key(labels), 0.0)
 
+    def total(self) -> float:
+        """Sum over every label combination — e.g. all verbs/codes of
+        rest_client_requests_total (what the loadtest's requests-per-
+        notebook bound is computed from)."""
+        with self._lock:
+            return sum(self._values.values())
+
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.type}"]

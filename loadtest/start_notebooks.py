@@ -124,6 +124,81 @@ def run_inprocess(count: int, namespace: str, accelerator: str,
     return 0
 
 
+def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
+             max_requests_per_nb: float | None = None) -> int:
+    """Controller wire-cost measurement: the full controller stack runs
+    over a real HTTP apiserver while the load generator drives the store
+    directly, so ``rest_client_requests_total`` counts ONLY controller
+    traffic. Reports apiserver requests per notebook — the number the
+    reference's informer-cache architecture keeps small, and the regression
+    guard for full-LIST/GET-storm patterns on the hot paths (metrics
+    scrape, Event predicate)."""
+    from kubeflow_tpu.api import types as api
+    from kubeflow_tpu.cluster.apiserver import ApiServerProxy
+    from kubeflow_tpu.cluster.http_client import HttpApiClient
+    from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
+    from kubeflow_tpu.cluster.store import ClusterStore
+    from kubeflow_tpu.controllers import Manager, setup_controllers
+    from kubeflow_tpu.utils import names
+    from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+    store = ClusterStore()
+    api.install_notebook_crd(store)
+    cleanups = []
+    try:
+        sim_mgr = Manager(store)
+        StatefulSetSimulator(store, boot_delay_s=0.0).setup(sim_mgr)
+        sim_mgr.start()
+        cleanups.append(sim_mgr.stop)
+        proxy = ApiServerProxy(store)
+        proxy.start()
+        cleanups.append(proxy.stop)
+        client = HttpApiClient(proxy.url)
+        cleanups.append(client.close)
+        metrics = MetricsRegistry()
+        mgr = setup_controllers(client, metrics=metrics)
+        mgr.start()
+        cleanups.append(mgr.stop)
+        requests = metrics.counter("rest_client_requests_total", "")
+        # let the watch backfills settle so the baseline excludes boot cost
+        time.sleep(0.3)
+        baseline = requests.total()
+        t0 = time.monotonic()
+        for i in range(count):
+            store.create(api.new_notebook(
+                f"loadtest-nb-{i}", namespace,
+                annotations={names.TPU_ACCELERATOR_ANNOTATION: accelerator}))
+        ready = 0
+        deadline = time.monotonic() + timeout
+        while ready < count and time.monotonic() < deadline:
+            ready = sum(
+                1 for nb in store.list(api.KIND, namespace)
+                if (api.get_condition(nb, api.CONDITION_SLICE_READY) or {})
+                .get("status") == "True")
+            time.sleep(0.02)
+        wall = time.monotonic() - t0
+        # one metrics scrape, so the notebook_running LIST cost is included
+        metrics.expose()
+        per_nb = (requests.total() - baseline) / max(count, 1)
+        if ready < count:
+            print(f"FAIL: only {ready}/{count} notebooks became SliceReady "
+                  f"within {timeout}s")
+            return 1
+        print(f"notebooks: {count}  wall: {wall:.2f}s  "
+              f"controller apiserver requests/notebook: {per_nb:.1f}")
+        if max_requests_per_nb is not None and per_nb > max_requests_per_nb:
+            print(f"FAIL: {per_nb:.1f} requests/notebook exceeds bound "
+                  f"{max_requests_per_nb}")
+            return 1
+        return 0
+    finally:
+        for cleanup in reversed(cleanups):
+            try:
+                cleanup()
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"loadtest: cleanup failed: {e}\n")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--count", type=int, default=50)
@@ -135,6 +210,12 @@ def main() -> int:
     ap.add_argument("--server", default=None,
                     help="drive a running apiserver over HTTP instead of "
                          "the in-process stack (URL)")
+    ap.add_argument("--wire", action="store_true",
+                    help="run the controllers over a local HTTP apiserver "
+                         "and report apiserver requests per notebook")
+    ap.add_argument("--max-requests-per-nb", type=float, default=None,
+                    help="with --wire: fail if controller apiserver "
+                         "requests per notebook exceed this bound")
     args = ap.parse_args()
     if args.emit_yaml:
         try:
@@ -144,6 +225,10 @@ def main() -> int:
         except BrokenPipeError:
             pass  # downstream consumer (head, kubectl) closed the pipe
         return 0
+    if args.wire:
+        return run_wire(args.count, args.namespace, args.accelerator,
+                        args.timeout,
+                        max_requests_per_nb=args.max_requests_per_nb)
     return run_inprocess(args.count, args.namespace, args.accelerator,
                          args.timeout, server=args.server)
 

@@ -1,0 +1,248 @@
+"""Bench-capture resilience: the driver's artifact must carry TPU numbers
+even through an axon-tunnel outage (VERDICT r2 #1).
+
+Two mechanisms under test, both in ``bench.py``:
+
+1. ``probe_backend`` retries across a configurable window with exponential
+   backoff instead of giving up after 2 fixed attempts (rounds 1 and 2 both
+   lost their official perf record to outages longer than ~3 minutes).
+2. On exhaustion, ``_emit_archived_tpu_lines`` re-emits the last-good
+   on-chip run from ``BENCH_TPU_LAST_GOOD.json`` tagged ``archived: true``
+   + capture timestamp — explicit provenance, never masquerading as live —
+   and ``_refresh_archive`` keeps that file current after live TPU runs.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def bench(monkeypatch, tmp_path):
+    """Import bench.py as an isolated module with the archive redirected to
+    a tmp file (the real BENCH_TPU_LAST_GOOD.json must not be touched)."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", REPO / "bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.ARCHIVE_PATH = tmp_path / "BENCH_TPU_LAST_GOOD.json"
+    mod._EMITTED.clear()
+    return mod
+
+
+class _FakeTime:
+    """Deterministic clock swapped in for bench.time: sleeps and scripted
+    per-attempt durations advance it instantly (the real probe loop runs
+    against wall-clock windows of minutes)."""
+
+    def __init__(self):
+        self.t = 1000.0
+        self.slept: list[float] = []
+
+    def monotonic(self):
+        return self.t
+
+    def sleep(self, s):
+        self.slept.append(s)
+        self.t += s
+
+    # passthroughs bench.py uses elsewhere
+    def strftime(self, *a):
+        return time.strftime(*a)
+
+    def gmtime(self):
+        return time.gmtime()
+
+
+def _fake_run_factory(clock, outcomes, attempt_cost=1.0):
+    """subprocess.run stand-in consuming scripted outcomes: 'timeout',
+    'fail', or ('ok', stdout); each call advances the fake clock."""
+    import subprocess
+    calls = []
+
+    def fake_run(cmd, timeout=None, capture_output=True, text=True):
+        calls.append(clock.t)
+        clock.t += attempt_cost
+        out = outcomes.pop(0) if outcomes else "timeout"
+        if out == "timeout":
+            clock.t += max(0.0, (timeout or 0) - attempt_cost)
+            raise subprocess.TimeoutExpired(cmd, timeout)
+        if out == "fail":
+            return subprocess.CompletedProcess(cmd, 1, "", "boom")
+        _, stdout = out
+        return subprocess.CompletedProcess(cmd, 0, stdout, "")
+    fake_run.calls = calls
+    return fake_run
+
+
+@pytest.fixture()
+def clock(bench, monkeypatch):
+    fake = _FakeTime()
+    monkeypatch.setattr(bench, "time", fake)
+    return fake
+
+
+def test_probe_retries_until_success_within_window(bench, clock, monkeypatch):
+    import subprocess
+    fake = _fake_run_factory(clock, ["fail", "fail", ("ok", "tpu 1 TPU v5e")])
+    monkeypatch.setattr(subprocess, "run", fake)
+    info = bench.probe_backend(attempt_timeout_s=90.0, window_s=600.0)
+    assert info["backend"] == "tpu"
+    assert info["fallback"] is False
+    assert info["device_kind"] == "TPU v5e"
+    assert len(fake.calls) == 3
+
+
+def test_probe_honors_window_and_falls_back(bench, clock, monkeypatch, capsys):
+    import subprocess
+    fake = _fake_run_factory(clock, [])  # every attempt times out
+    monkeypatch.setattr(subprocess, "run", fake)
+    info = bench.probe_backend(attempt_timeout_s=90.0, window_s=600.0)
+    assert info["backend"] == "cpu"
+    assert info["fallback"] is True
+    assert "timed out" in info["probe_error"]
+    # the window was actually honored: attempts span < window + one budget
+    assert clock.t - 1000.0 <= 600.0 + 90.0
+    assert len(fake.calls) >= 3  # retried well past the old 2-attempt cap
+    # per-attempt diagnostics hit stderr
+    err = capsys.readouterr().err
+    assert "probe attempt 1" in err
+    assert f"probe attempt {len(fake.calls)}" in err
+    assert "falling back to CPU" in err
+
+
+def test_probe_backoff_grows_exponentially(bench, clock, monkeypatch):
+    import subprocess
+    fake = _fake_run_factory(clock, [])  # always timeout
+    monkeypatch.setattr(subprocess, "run", fake)
+    bench.probe_backend(attempt_timeout_s=30.0, window_s=3000.0)
+    assert len(clock.slept) >= 3
+    assert clock.slept[0] == pytest.approx(5.0)
+    # doubling until the 60s cap
+    for a, b in zip(clock.slept, clock.slept[1:]):
+        assert b == pytest.approx(min(a * 2, 60.0))
+
+
+def test_probe_window_env_override(bench, clock, monkeypatch):
+    import subprocess
+    monkeypatch.setenv("BENCH_PROBE_WINDOW_S", "0")
+    fake = _fake_run_factory(clock, [])
+    monkeypatch.setattr(subprocess, "run", fake)
+    info = bench.probe_backend(attempt_timeout_s=90.0)
+    assert info["fallback"] is True
+    assert len(fake.calls) == 1  # one full-budget attempt, then window gone
+
+
+def test_archived_lines_emitted_with_provenance(bench, capsys):
+    bench.ARCHIVE_PATH.write_text(json.dumps({
+        "captured_at": "2026-07-30T12:40:00Z",
+        "lines": [
+            {"metric": "train_step_tokens_per_sec", "value": 68602.8,
+             "unit": "tokens/s", "mfu": 0.4628,
+             "backend": "tpu", "fallback": False},
+            {"metric": "decode_int8_tokens_per_sec", "value": 11996.6,
+             "unit": "tokens/s", "backend": "tpu", "fallback": False},
+        ]}))
+    bench._emit_archived_tpu_lines()
+    out = [json.loads(line) for line in
+           capsys.readouterr().out.strip().splitlines()]
+    assert len(out) == 2
+    for line in out:
+        assert line["archived"] is True
+        assert line["captured_at"] == "2026-07-30T12:40:00Z"
+        assert line["backend"] == "tpu"
+        # the honesty contract predating this feature: fallback==false
+        # means LIVE measurement, so re-emitted archives must set it true
+        assert line["fallback"] is True
+    assert out[0]["mfu"] == 0.4628
+
+
+def test_archived_emission_survives_missing_archive(bench, capsys):
+    assert not bench.ARCHIVE_PATH.exists()
+    bench._emit_archived_tpu_lines()  # must not raise
+    assert capsys.readouterr().out.strip() == ""
+
+
+def test_refresh_archive_persists_only_live_tpu_compute_lines(bench):
+    info = {"backend": "tpu", "fallback": False, "device_kind": "TPU v5e"}
+    bench._emit(info, metric="train_step_tokens_per_sec", value=71300.0,
+                unit="tokens/s", mfu=0.481)
+    bench._emit(info, metric="train_8k_ctx_tokens_per_sec", value=None,
+                unit="error")  # failed bench: not archived
+    # control-plane metric: backend-INdependent, a fallback run re-measures
+    # it live — archiving would produce stale duplicates next to live lines
+    bench._emit(info, metric="notebook_cr_to_slice_ready_p50_s", value=0.98,
+                unit="s")
+    cpu = {"backend": "cpu", "fallback": True}
+    bench._emit(cpu, metric="decode_tokens_per_sec", value=1.0, unit="x")
+    bench._refresh_archive(info)
+    payload = json.loads(bench.ARCHIVE_PATH.read_text())
+    metrics = [line["metric"] for line in payload["lines"]]
+    assert metrics == ["train_step_tokens_per_sec"]
+    assert payload["captured_at"]  # timestamped
+    assert payload["device_kind"] == "TPU v5e"
+
+
+def test_refresh_archive_merges_per_metric(bench):
+    """A partially-failed live run must not wipe previously-archived
+    metrics it failed to re-measure; carried-forward lines keep their own
+    older captured_at."""
+    bench.ARCHIVE_PATH.write_text(json.dumps({
+        "captured_at": "2026-07-01T00:00:00Z",
+        "lines": [
+            {"metric": "decode_tokens_per_sec", "value": 9357.7,
+             "unit": "tokens/s", "backend": "tpu", "fallback": False},
+            {"metric": "train_step_tokens_per_sec", "value": 60000.0,
+             "unit": "tokens/s", "backend": "tpu", "fallback": False},
+        ]}))
+    info = {"backend": "tpu", "fallback": False, "device_kind": "TPU v5e"}
+    # this run re-measured train (better) but decode crashed (not emitted)
+    bench._emit(info, metric="train_step_tokens_per_sec", value=71300.0,
+                unit="tokens/s")
+    bench._refresh_archive(info)
+    payload = json.loads(bench.ARCHIVE_PATH.read_text())
+    by_metric = {line["metric"]: line for line in payload["lines"]}
+    assert by_metric["train_step_tokens_per_sec"]["value"] == 71300.0
+    assert by_metric["train_step_tokens_per_sec"]["captured_at"] \
+        == payload["captured_at"]
+    assert by_metric["decode_tokens_per_sec"]["value"] == 9357.7
+    assert by_metric["decode_tokens_per_sec"]["captured_at"] \
+        == "2026-07-01T00:00:00Z"
+
+
+def test_roundtrip_refresh_then_reemit(bench, capsys):
+    """A live run's archive is exactly what a later outage run re-emits."""
+    info = {"backend": "tpu", "fallback": False, "device_kind": "TPU v5e"}
+    bench._emit(info, metric="flash_vs_xla_attention_speedup", value=5.905,
+                unit="x")
+    bench._refresh_archive(info)
+    bench._EMITTED.clear()
+    capsys.readouterr()
+    bench._emit_archived_tpu_lines()
+    out = [json.loads(line) for line in
+           capsys.readouterr().out.strip().splitlines()]
+    assert out[0]["metric"] == "flash_vs_xla_attention_speedup"
+    assert out[0]["value"] == 5.905
+    assert out[0]["archived"] is True
+
+
+def test_shipped_archive_is_valid_and_tpu_only(bench):
+    """The committed seed archive must parse and contain only live TPU
+    compute lines — a CPU or control-plane line here would launder a
+    fallback/stale value into the record."""
+    payload = json.loads((REPO / "BENCH_TPU_LAST_GOOD.json").read_text())
+    assert payload["captured_at"]
+    assert payload["lines"]
+    for line in payload["lines"]:
+        assert line["backend"] == "tpu"
+        assert not line.get("fallback")
+        assert line.get("value") is not None
+        assert line["metric"] in bench.ARCHIVE_METRICS

