@@ -57,6 +57,7 @@ ARCHIVE_METRICS = frozenset({
     "flash_vs_xla_attention_speedup",
     "train_step_tokens_per_sec",
     "train_8k_ctx_tokens_per_sec",
+    "train_16k_ctx_tokens_per_sec",
     "train_32k_ctx_tokens_per_sec",
     "decode_tokens_per_sec",
     "decode_int8_tokens_per_sec",
@@ -218,7 +219,10 @@ def _refresh_archive(info: dict) -> None:
     re-measure — each carried-forward line keeps its own older
     ``captured_at``."""
     now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    good = {line["metric"]: {**line, "captured_at": now}
+    # per-line capture metadata: carried-forward lines from a previous run
+    # keep their own timestamp AND device_kind (the chips may differ)
+    good = {line["metric"]: {**line, "captured_at": now,
+                             "device_kind": info.get("device_kind")}
             for line in _EMITTED
             if line.get("backend") != "cpu" and not line.get("fallback")
             and line.get("value") is not None
@@ -233,7 +237,9 @@ def _refresh_archive(info: dict) -> None:
             if metric in ARCHIVE_METRICS and metric not in good:
                 good[metric] = {**line,
                                 "captured_at": line.get("captured_at")
-                                or prev_captured}
+                                or prev_captured,
+                                "device_kind": line.get("device_kind")
+                                or prev.get("device_kind")}
     except (OSError, ValueError):
         pass  # no previous archive (or unreadable): write what we have
     payload = {
@@ -355,8 +361,11 @@ def bench_train_step(info: dict) -> None:
                                    max_seq_len=256, dtype="float32")
         batch, seq, steps = 4, 256, 3
 
+    from kubeflow_tpu.models.train import TrainConfig as TC
     mesh = build_mesh(MeshConfig.auto(1), devices=jax.devices()[:1])
-    init_fn, step_fn = make_sharded_train_step(mesh, config)
+    # bf16 params + f32 master: halves weight+grad HBM traffic per step
+    init_fn, step_fn = make_sharded_train_step(
+        mesh, config, TC(bf16_params=on_tpu))
     params, opt_state = init_fn(jax.random.key(0))
     tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
                                 config.vocab_size)
@@ -382,19 +391,21 @@ def bench_train_step(info: dict) -> None:
           unit="tokens/s", vs_baseline=None, mfu=mfu,
           model_tflops_per_sec=round(achieved / 1e12, 3),
           detail={"batch": batch, "seq": seq, "steps": steps,
-                  "loss": round(float(loss), 4)})
+                  "bf16_params": on_tpu, "loss": round(float(loss), 4)})
 
 
 def _bench_context_train(info: dict, metric: str, seq: int,
                          batch: int, counts: tuple) -> None:
     """Shared long-context train bench body: flagship config stretched to
-    ``seq`` with per-layer remat (saved activations exceed HBM otherwise;
-    jax.checkpoint on the scanned layer body trades ~1.2x FLOPs for the
-    fit), flash attention streaming the O(s²) term, and the fused chunked
-    CE never materializing the multi-GB logits tensor (models/train.py;
-    the whole-logits path fails to compile at these shapes). MFU drops
-    with context because the attention share grows quadratically — the
-    headline is that the shape RUNS on one chip, and its tokens/s."""
+    ``seq`` with the ``remat="attn"`` policy (whole-layer remat except the
+    attention output stays saved, so backward recomputes norms/FFN but
+    never re-runs the O(s²) attention forward — models/transformer.py
+    resolve_layer_remat), flash attention streaming the O(s²) term, and
+    the fused chunked CE never materializing the multi-GB logits tensor
+    (models/train.py; the whole-logits path fails to compile at these
+    shapes). MFU drops with context because the attention share grows
+    quadratically — the headline is that the shape RUNS on one chip, and
+    its tokens/s."""
     if info["backend"] == "cpu":
         _emit(info, metric=metric, value=None, unit="tokens/s",
               vs_baseline=None,
@@ -411,9 +422,11 @@ def _bench_context_train(info: dict, metric: str, seq: int,
     from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
 
     config = dataclasses.replace(_flagship_config(), max_seq_len=seq,
-                                 remat=True)
+                                 remat="attn")
+    from kubeflow_tpu.models.train import TrainConfig as TC
     mesh = build_mesh(MeshConfig.auto(1), devices=jax.devices()[:1])
-    init_fn, step_fn = make_sharded_train_step(mesh, config)
+    init_fn, step_fn = make_sharded_train_step(
+        mesh, config, TC(bf16_params=True))
     params, opt_state = init_fn(jax.random.key(0))
     tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
                                 config.vocab_size)
@@ -435,13 +448,18 @@ def _bench_context_train(info: dict, metric: str, seq: int,
     _emit(info, metric=metric, value=round(tok_s, 1), unit="tokens/s",
           vs_baseline=None,
           mfu=round(achieved / peak, 4) if peak else None,
-          detail={"batch": batch, "seq": seq, "remat": True,
-                  "fused_ce": True})
+          detail={"batch": batch, "seq": seq, "remat": "attn",
+                  "bf16_params": True, "fused_ce": True})
 
 
 def bench_long_context_train(info: dict) -> None:
     _bench_context_train(info, "train_8k_ctx_tokens_per_sec",
                          seq=8192, batch=4, counts=(2, 8))
+
+
+def bench_16k_context_train(info: dict) -> None:
+    _bench_context_train(info, "train_16k_ctx_tokens_per_sec",
+                         seq=16_384, batch=2, counts=(2, 6))
 
 
 def bench_32k_context_train(info: dict) -> None:
@@ -629,6 +647,8 @@ def main() -> None:
                           (bench_train_step, "train_step_tokens_per_sec"),
                           (bench_long_context_train,
                            "train_8k_ctx_tokens_per_sec"),
+                          (bench_16k_context_train,
+                           "train_16k_ctx_tokens_per_sec"),
                           (bench_32k_context_train,
                            "train_32k_ctx_tokens_per_sec"),
                           (bench_decode, "decode_tokens_per_sec")):

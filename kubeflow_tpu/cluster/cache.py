@@ -153,12 +153,17 @@ class CachingClient:
         """Snapshot-list ``kind`` into the cache and mark it warm. Call
         AFTER the external watch feeding this cache is registered (same
         watch-then-list ordering _ensure_informer uses, same staleness
-        guards). Clients whose watch streams already deliver the initial
-        state as ADDED events on connect (HttpApiClient's resync) skip the
-        redundant LIST — the tee has fed (or is feeding) the same objects."""
-        if not getattr(self.store, "watch_delivers_initial_state", False):
-            for obj in self.store.list(kind):
-                self._ingest(obj)
+        guards).
+
+        The LIST always runs, even for clients whose watch streams resync
+        initial state on connect (HttpApiClient): warm means "a complete
+        snapshot has landed", and the resync is delivered asynchronously
+        AFTER watch() returns — marking warm on the promise of a resync
+        would turn existing objects into authoritative NotFounds for the
+        gap (and for the whole outage if the stream never connected). The
+        overlap with a delivered resync is idempotent ingestion."""
+        for obj in self.store.list(kind):
+            self._ingest(obj)
         with self._lock:
             self._watched.add(kind)
             self._warm.add(kind)

@@ -110,10 +110,6 @@ class HttpApiClient:
     """Client protocol implementation over HTTP(S)."""
 
     supports_inprocess_admission = False
-    # watch() resyncs on connect: existing objects arrive as ADDED events
-    # (informer boot semantics) — consumers backfilling a cache off these
-    # streams (CachingClient.backfill) need no extra LIST
-    watch_delivers_initial_state = True
 
     def __init__(self, base_url: str, token: str | None = None,
                  ca_cert: str | None = None, client_cert: str | None = None,

@@ -71,10 +71,9 @@ class NotebookReconciler:
         # client each lookup would otherwise be 1-2 API GETs per frame — a
         # hot namespace turns every Pod event into a GET storm. The read
         # cache is fed by TEEING the very watch streams this reconciler
-        # already holds (no duplicate streams; backfill LISTs only for
-        # clients whose watch doesn't resync initial state), and a warm
-        # miss is an authoritative NotFound so deleted objects don't
-        # regress to per-frame GETs.
+        # already holds (no duplicate streams; one snapshot LIST per kind
+        # at setup), and a warm miss is an authoritative NotFound so
+        # deleted objects don't regress to per-frame GETs.
         from ..cluster.cache import CachingClient
         cache = CachingClient(self.client, disable_for=(),
                               auto_informer=False)

@@ -32,8 +32,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..parallel.sharding import (PartitionRules, batch_sharding,
                                  param_shardings)
 from .quant import wcast
-from .transformer import (TransformerConfig, attention_block, rms_norm,
-                          rope_frequencies)
+from .transformer import (TransformerConfig, attention_block,
+                          resolve_layer_remat, rms_norm, rope_frequencies,
+                          tag_attn_out)
 
 
 @dataclass(frozen=True)
@@ -231,10 +232,11 @@ def moe_forward_hidden(params: dict, tokens: jax.Array, config: MoEConfig,
     def layer_body(carry, layer):
         x, aux = carry
         x = attention_block(x, layer, c, cos, sin, mesh=mesh)
+        x = tag_attn_out(x)
         x, layer_aux = expert_mlp(x, layer)
         return (x, aux + layer_aux), None
 
-    body = jax.checkpoint(layer_body) if c.remat is True else layer_body
+    body = resolve_layer_remat(c, layer_body)
     (x, aux), _ = lax.scan(body, (x, jnp.float32(0.0)), params["blocks"])
 
     return rms_norm(x, params["final_norm"]), aux / c.n_layers

@@ -46,18 +46,22 @@ class TransformerConfig:
     rope_theta: float = 10_000.0
     dtype: str = "bfloat16"      # activation/compute dtype
     param_dtype: str = "float32"
-    # False: save everything (fastest while it fits); True: remat the whole
-    # layer (longest contexts); "mlp": remat only the FFN — the saved bf16
-    # [L,b,s,d_ff] gate/up activations dominate HBM, and recomputing just
-    # them holds ~47% MFU at batches that OOM un-remated (v5e, d1024
-    # flagship: b16/b32 run at 69.7k/67.6k tokens/s vs OOM)
+    # False: save everything (fastest while it fits); "mlp": remat only the
+    # FFN — the saved bf16 [L,b,s,d_ff] gate/up activations dominate HBM,
+    # and recomputing just them holds ~47% MFU at batches that OOM
+    # un-remated (v5e, d1024 flagship: b16/b32 run at 69.7k/67.6k tokens/s
+    # vs OOM); "attn": remat the whole layer EXCEPT the attention output —
+    # backward recomputes norms/projections/FFN but never re-runs the
+    # O(s²) attention forward, the right point for long contexts where
+    # whole-layer remat's attention recompute dominates; True: remat the
+    # whole layer (absolute smallest footprint)
     remat: bool | str = False
     attention: str = "auto"      # auto | xla | ring | ulysses | flash
 
     def __post_init__(self):
-        if self.remat not in (False, True, "mlp"):
-            raise ValueError(
-                f"remat must be False, True, or 'mlp'; got {self.remat!r}")
+        if self.remat not in (False, True, "mlp", "attn"):
+            raise ValueError(f"remat must be False, True, 'mlp', or "
+                             f"'attn'; got {self.remat!r}")
 
     @property
     def d_head(self) -> int:
@@ -133,6 +137,36 @@ def resolve_remat_mlp(config, mlp_fn):
     if config.remat == "mlp":
         return jax.checkpoint(mlp_fn, static_argnums=(2,))
     return mlp_fn
+
+
+def tag_attn_out(x: jax.Array) -> jax.Array:
+    """Name the post-attention residual stream for the ``remat="attn"``
+    policy (identity under every other policy)."""
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(x, "attn_out")
+
+
+def resolve_layer_remat(config, body):
+    """One resolution of the whole-layer remat policies for a scanned
+    layer body whose attention output is tagged via ``tag_attn_out``:
+
+    - True   → checkpoint everything (smallest footprint; backward re-runs
+               the full layer forward including O(s²) attention);
+    - "attn" → checkpoint everything EXCEPT the tagged attention output:
+               backward recomputes norms/projections/FFN from the saved
+               tensor but never re-runs the attention forward. Costs one
+               extra (b, s, d_model) save per layer over True — the right
+               trade at long context where attention recompute dominates
+               (the attention VJP itself still streams its own O(s²) pass,
+               as flash backward always does).
+    """
+    if config.remat is True:
+        return jax.checkpoint(body)
+    if config.remat == "attn":
+        return jax.checkpoint(
+            body,
+            policy=jax.checkpoint_policies.save_only_these_names("attn_out"))
+    return body
 
 
 def _rms_norm_impl(x, weight, eps):
@@ -234,9 +268,15 @@ def _select_attention(config: TransformerConfig, mesh, seq_len: int) -> str:
 
 
 def attention_block(x, layer, config: TransformerConfig, cos, sin, mesh=None,
-                    return_kv: bool = False):
+                    return_kv: bool = False,
+                    manual_sp: tuple[str, int] | None = None):
     """``return_kv=True`` additionally returns the post-RoPE, pre-GQA-repeat
-    (k, v) — what a decode KV cache stores (models/decode.py prefill)."""
+    (k, v) — what a decode KV cache stores (models/decode.py prefill).
+
+    ``manual_sp=(axis_name, axis_size)``: the caller is ALREADY inside a
+    shard_map region where the sequence axis is manual (pipeline stages
+    with sp>1) — run the per-device ring-attention body directly (bare
+    ppermute over that axis) instead of opening a nested shard_map."""
     c = config
     h = rms_norm(x, layer["attn_norm"])
     q = jnp.einsum("bsd,dhk->bshk", h, wcast(layer["wq"], h.dtype))
@@ -246,6 +286,15 @@ def attention_block(x, layer, config: TransformerConfig, cos, sin, mesh=None,
     k = apply_rope(k, cos, sin)
     kv = (k, v)
     n_rep = c.n_heads // c.n_kv_heads
+
+    if manual_sp is not None:
+        from ..parallel.ring import _ring_local
+        axis_name, axis_size = manual_sp
+        out = _ring_local(q, repeat_kv(k, n_rep), repeat_kv(v, n_rep),
+                          axis_name=axis_name, axis_size=axis_size,
+                          causal=True)
+        x = x + jnp.einsum("bshk,hkd->bsd", out, wcast(layer["wo"], h.dtype))
+        return (x, kv) if return_kv else x
 
     kind = _select_attention(c, mesh, x.shape[1])
     if kind == "ulysses":
@@ -300,12 +349,11 @@ def forward_hidden(params: dict, tokens: jax.Array,
 
     def layer_body(x, layer):
         x = attention_block(x, layer, c, cos, sin, mesh=mesh)
+        x = tag_attn_out(x)
         x = mlp(x, layer, c)
         return x, None
 
-    body = layer_body
-    if c.remat is True:
-        body = jax.checkpoint(layer_body)
+    body = resolve_layer_remat(c, layer_body)
     x, _ = lax.scan(body, x, params["blocks"])
 
     return rms_norm(x, params["final_norm"])
@@ -326,7 +374,15 @@ def pipelined_forward(params: dict, tokens: jax.Array,
     (parallel/pipeline.py). Embedding and LM head run outside the pipeline
     (they live on every stage's data shards); the blocks are split into
     contiguous stages. RoPE tables are position-only (batch-size 1) so they
-    broadcast across microbatches."""
+    broadcast across microbatches.
+
+    Composes with sequence parallelism: when the mesh has sp>1 the manual
+    region extends over (pp, sp) — each stage runs ring attention via bare
+    ppermute on sp while activations stay sequence-sharded; the RoPE
+    tables ride along as sharded extra args so every stage sees its
+    shard's global positions."""
+    from jax.sharding import PartitionSpec as P
+
     from ..parallel.pipeline import pipeline_apply, split_stages
 
     c = config
@@ -337,18 +393,31 @@ def pipelined_forward(params: dict, tokens: jax.Array,
     stages = split_stages(params["blocks"], mesh.shape["pp"])
 
     mlp = resolve_remat_mlp(c, mlp_block)
+    sp = mesh.shape.get("sp", 1)
+    manual_sp = ("sp", sp) if sp > 1 else None
 
-    def stage_fn(stage_layers, act):
+    def stage_fn(stage_layers, act, cos, sin):
         def body(h, layer):
-            h = attention_block(h, layer, c, cos, sin, mesh=None)
+            h = attention_block(h, layer, c, cos, sin, mesh=None,
+                                manual_sp=manual_sp)
+            h = tag_attn_out(h)
             h = mlp(h, layer, c)
             return h, None
-        body_fn = jax.checkpoint(body) if c.remat is True else body
+        body_fn = resolve_layer_remat(c, body)
         act, _ = lax.scan(body_fn, act, stage_layers)
         return act
 
-    x = pipeline_apply(stages, x, stage_fn, mesh=mesh,
-                       n_microbatches=n_microbatches)
+    if manual_sp is not None:
+        x = pipeline_apply(
+            stages, x, stage_fn, mesh=mesh, n_microbatches=n_microbatches,
+            manual_axes=("pp", "sp"),
+            act_spec=P(None, "sp", None),          # (batch, seq, d_model)
+            extra_args=(cos, sin),
+            extra_specs=(P(None, "sp", None), P(None, "sp", None)))
+    else:
+        x = pipeline_apply(stages, x, stage_fn, mesh=mesh,
+                           n_microbatches=n_microbatches,
+                           extra_args=(cos, sin), extra_specs=(P(), P()))
     x = rms_norm(x, params["final_norm"])
     return jnp.einsum("bsd,dv->bsv", x, wcast(params["lm_head"], x.dtype)
                       ).astype(jnp.float32)

@@ -41,27 +41,38 @@ def split_stages(stacked_params, n_stages: int):
 
 
 def pipeline_apply(stage_params, x: jax.Array, stage_fn, *, mesh: Mesh,
-                   n_microbatches: int) -> jax.Array:
-    """Run ``stage_fn(stage_params_i, activation) -> activation`` through the
-    pp ring. ``x``: (batch, ...) activations entering stage 0; returns stage
-    S-1's output, replicated over pp. Activation shape must be uniform across
-    stages (true for transformer blocks)."""
-    # NOTE: partial-manual shard_map (axis_names={'pp'}) requires a jit
-    # context — call this from inside jit (the train step always is).
+                   n_microbatches: int, manual_axes: tuple = ("pp",),
+                   act_spec: P = P(), extra_args: tuple = (),
+                   extra_specs: tuple = ()) -> jax.Array:
+    """Run ``stage_fn(stage_params_i, activation, *extra) -> activation``
+    through the pp ring. ``x``: (batch, ...) activations entering stage 0;
+    returns stage S-1's output, replicated over pp. Activation shape must
+    be uniform across stages (true for transformer blocks).
+
+    ``manual_axes`` extends the manual region beyond pp — pass
+    ``("pp", "sp")`` with ``act_spec`` sharding the sequence axis to run
+    sequence-parallel stage bodies (ring attention via bare ppermute over
+    sp, see models/transformer.pipelined_forward). ``extra_args`` are
+    broadcast to every tick (e.g. RoPE tables), split per
+    ``extra_specs``."""
+    # NOTE: partial-manual shard_map (axis_names={'pp', ...}) requires a
+    # jit context — call this from inside jit (the train step always is).
     n_stages = mesh.shape["pp"]
     if n_stages == 1:
         params0 = jax.tree.map(lambda p: p[0], stage_params)
-        return stage_fn(params0, x)
+        return stage_fn(params0, x, *extra_args)
     batch = x.shape[0]
     if batch % n_microbatches:
         raise ValueError(f"batch {batch} not divisible by "
                          f"{n_microbatches} microbatches")
     mb = batch // n_microbatches
     micro = x.reshape(n_microbatches, mb, *x.shape[1:])
+    micro_spec = P(None, *act_spec)  # leading microbatch axis: unsharded
 
-    @partial(shard_map, mesh=mesh, axis_names={"pp"},
-             in_specs=(P("pp"), P()), out_specs=P(), check_vma=False)
-    def run(params_local, micro_all):
+    @partial(shard_map, mesh=mesh, axis_names=set(manual_axes),
+             in_specs=(P("pp"), micro_spec, *extra_specs),
+             out_specs=micro_spec, check_vma=False)
+    def run(params_local, micro_all, *extra):
         # params_local leaves: (1, L/S, ...) — drop the sharded stage axis
         params_local = jax.tree.map(lambda p: p[0], params_local)
         stage = lax.axis_index("pp")
@@ -76,7 +87,7 @@ def pipeline_apply(stage_params, x: jax.Array, stage_fn, *, mesh: Mesh,
             state, out_buf = carry
             in_idx = jnp.clip(t, 0, n_microbatches - 1)
             inp = jnp.where(stage == 0, micro_all[in_idx], state)
-            out = stage_fn(params_local, inp)
+            out = stage_fn(params_local, inp, *extra)
             out_idx = t - last
             written = out_buf.at[jnp.clip(out_idx, 0, n_microbatches - 1)
                                  ].set(out)
@@ -90,5 +101,5 @@ def pipeline_apply(stage_params, x: jax.Array, stage_fn, *, mesh: Mesh,
         # replicate the last stage's result to every pp rank
         return lax.psum(jnp.where(stage == last, out_buf, 0.0), "pp")
 
-    y = run(stage_params, micro)
+    y = run(stage_params, micro, *extra_args)
     return y.reshape(batch, *x.shape[1:])

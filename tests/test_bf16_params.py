@@ -1,0 +1,98 @@
+"""bf16 model params + f32 master copies (VERDICT r2 next #2).
+
+Forward/backward read half the weight+grad HBM bytes while the optimizer
+accumulates in f32 on a master copy (models/train.py MasterOptState).
+Pins: dtype invariants, sharded-step integration, and short-horizon loss
+parity with the all-f32 step.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.train import (MasterOptState, TrainConfig,
+                                       make_sharded_train_step)
+from kubeflow_tpu.models.transformer import TransformerConfig
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+pytestmark = pytest.mark.skipif(jax.device_count() < 8,
+                                reason="needs the 8-device CPU mesh")
+
+
+def _cfg():
+    return TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                             n_heads=4, n_kv_heads=2, d_ff=128,
+                             max_seq_len=64, dtype="float32")
+
+
+def _batch(cfg, batch=8, seq=32):
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                cfg.vocab_size)
+    return tokens, jnp.roll(tokens, -1, axis=1)
+
+
+def test_bf16_params_dtypes_and_state_shape():
+    mesh = build_mesh(MeshConfig.auto(8, tp=2, fsdp=2))
+    cfg = _cfg()
+    init_fn, step_fn = make_sharded_train_step(
+        mesh, cfg, TrainConfig(bf16_params=True))
+    params, opt_state = init_fn(jax.random.key(0))
+    assert isinstance(opt_state, MasterOptState)
+    for leaf in jax.tree.leaves(params):
+        assert leaf.dtype == jnp.bfloat16
+    for leaf in jax.tree.leaves(opt_state.master):
+        assert leaf.dtype == jnp.float32
+    tokens, targets = _batch(cfg)
+    params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
+    assert np.isfinite(float(loss))
+    # params remain bf16 after the update; master remains f32
+    for leaf in jax.tree.leaves(params):
+        assert leaf.dtype == jnp.bfloat16
+    for leaf in jax.tree.leaves(opt_state.master):
+        assert leaf.dtype == jnp.float32
+
+
+def test_bf16_params_master_matches_params():
+    """After each step the bf16 params ARE the rounded master copy —
+    nothing updates the model weights except the master cast."""
+    mesh = build_mesh(MeshConfig.auto(8))
+    cfg = _cfg()
+    init_fn, step_fn = make_sharded_train_step(
+        mesh, cfg, TrainConfig(bf16_params=True))
+    params, opt_state = init_fn(jax.random.key(0))
+    tokens, targets = _batch(cfg)
+    for _ in range(3):
+        params, opt_state, _ = step_fn(params, opt_state, tokens, targets)
+    for p, m in zip(jax.tree.leaves(params),
+                    jax.tree.leaves(opt_state.master)):
+        np.testing.assert_array_equal(np.asarray(p),
+                                      np.asarray(m.astype(jnp.bfloat16)))
+
+
+def test_bf16_params_loss_tracks_f32_step():
+    """Short-horizon loss parity: bf16 weights round the forward but the
+    f32 master keeps optimizer accumulation exact, so a few steps stay
+    close to the all-f32 trajectory (this is the guard against e.g.
+    accidentally accumulating adam moments in bf16)."""
+    mesh = build_mesh(MeshConfig.auto(8))
+    cfg = _cfg()
+    tokens, targets = _batch(cfg)
+
+    def run(tc):
+        init_fn, step_fn = make_sharded_train_step(mesh, cfg, tc)
+        params, opt_state = init_fn(jax.random.key(0))
+        losses = []
+        for _ in range(5):
+            params, opt_state, loss = step_fn(params, opt_state, tokens,
+                                              targets)
+            losses.append(float(loss))
+        return losses
+
+    ref = run(TrainConfig())
+    mixed = run(TrainConfig(bf16_params=True))
+    assert np.allclose(mixed, ref, rtol=2e-2), (mixed, ref)
+    # and training actually progresses
+    assert mixed[-1] < mixed[0]

@@ -168,15 +168,15 @@ def test_grouped_ep_sharded_step_still_trains():
 
 
 def test_moe_remat_policies_match():
-    """remat False / True / 'mlp' (expert-FFN-only) are numerically
-    identical on the MoE family too."""
+    """remat False / True / 'mlp' / 'attn' are numerically identical on
+    the MoE family too."""
     import numpy as np
     cfg = tiny_config()
     params = init_moe_params(jax.random.key(0), cfg)
     tokens = jax.random.randint(jax.random.key(1), (2, 16), 0,
                                 cfg.vocab_size)
     base_logits, base_aux = moe_forward(params, tokens, cfg)
-    for policy in (True, "mlp"):
+    for policy in (True, "mlp", "attn"):
         logits, aux = moe_forward(params, tokens,
                                   tiny_config(remat=policy))
         np.testing.assert_allclose(np.asarray(logits),

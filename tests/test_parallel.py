@@ -147,14 +147,14 @@ def test_remat_rejects_unknown_policy():
 
 
 def test_remat_matches():
-    """All three remat policies (off, whole-layer, FFN-only) produce the
-    same forward AND gradients — remat is a memory/compute trade, never a
-    numerics change."""
+    """All remat policies (off, whole-layer, FFN-only, save-attn-output)
+    produce the same forward AND gradients — remat is a memory/compute
+    trade, never a numerics change."""
     cfg = small_config()
     params = init_params(jax.random.key(0), cfg)
     tokens = jax.random.randint(jax.random.key(1), (1, 8), 0, cfg.vocab_size)
     a = forward(params, tokens, cfg)
-    for policy in (True, "mlp"):
+    for policy in (True, "mlp", "attn"):
         b = forward(params, tokens, cfg.replace(remat=policy))
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
 
@@ -163,7 +163,7 @@ def test_remat_matches():
                        .astype(jnp.float32) ** 2)
 
     g0 = jax.tree.leaves(jax.grad(lambda p: loss(p, False))(params))
-    for policy in (True, "mlp"):
+    for policy in (True, "mlp", "attn"):
         g1 = jax.tree.leaves(jax.grad(lambda p: loss(p, policy))(params))
         for x, y in zip(g0, g1):
             np.testing.assert_allclose(np.asarray(x), np.asarray(y),

@@ -1,0 +1,130 @@
+"""Pipeline-parallelism gradient-parity pins (VERDICT r2 weak #6, ask #9).
+
+Ring and Ulysses carry direct gradient-parity pins; until now pp was only
+covered by train-step smokes — a silently-wrong ppermute transpose in the
+GPipe loop would have passed. These tests pin ``pipeline_apply`` (pure
+stage function) and ``pipelined_forward`` (full transformer, with and
+without sequence parallelism in the stages) against the unsharded stack,
+values AND gradients, on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.transformer import (TransformerConfig, forward,
+                                             init_params)
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubeflow_tpu.parallel.pipeline import pipeline_apply, split_stages
+
+pytestmark = pytest.mark.skipif(jax.device_count() < 8,
+                                reason="needs the 8-device CPU mesh")
+
+
+def _tree_allclose(got, want, rtol, atol):
+    flat_got, _ = jax.tree.flatten(got)
+    flat_want, _ = jax.tree.flatten(want)
+    assert len(flat_got) == len(flat_want)
+    for a, b in zip(flat_got, flat_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=rtol, atol=atol)
+
+
+def test_pipeline_apply_gradients_match_sequential():
+    """Grad through the GPipe fill-and-drain loop (masked buffer writes +
+    ppermute transposes) must equal the plain sequential layer stack's —
+    w.r.t. BOTH the input and every stacked parameter."""
+    mesh = build_mesh(MeshConfig(pp=4, dp=2))
+    L, d, batch = 4, 8, 8
+    keys = jax.random.split(jax.random.key(0), 4)
+    params = {"w": jax.random.normal(keys[0], (L, d, d)) / np.sqrt(d),
+              "b": jax.random.normal(keys[1], (L, d)) * 0.1}
+    x = jax.random.normal(keys[2], (batch, d))
+    w_cot = jax.random.normal(keys[3], (batch, d))  # non-uniform cotangent
+
+    def apply_layer(layer, h):
+        return jnp.tanh(h @ layer["w"] + layer["b"])
+
+    def loss_seq(params, x):
+        h = x
+        for i in range(L):
+            h = apply_layer(jax.tree.map(lambda p: p[i], params), h)
+        return jnp.sum(h * w_cot)
+
+    def stage_fn(stage_layers, h):
+        # stage_layers leaves: (L/S, ...) — scan the stage's layer block
+        def body(h, layer):
+            return apply_layer(layer, h), None
+        h, _ = jax.lax.scan(body, h, stage_layers)
+        return h
+
+    def loss_pp(params, x):
+        stages = split_stages(params, 4)
+        y = pipeline_apply(stages, x, stage_fn, mesh=mesh, n_microbatches=4)
+        return jnp.sum(y * w_cot)
+
+    val_ref, grads_ref = jax.value_and_grad(loss_seq, argnums=(0, 1))(
+        params, x)
+    val_pp, grads_pp = jax.jit(
+        jax.value_and_grad(loss_pp, argnums=(0, 1)))(params, x)
+    np.testing.assert_allclose(float(val_pp), float(val_ref), rtol=1e-5)
+    _tree_allclose(grads_pp, grads_ref, rtol=2e-5, atol=2e-5)
+
+
+def _tiny_config():
+    return TransformerConfig(vocab_size=128, d_model=32, n_layers=4,
+                             n_heads=4, n_kv_heads=2, d_ff=64,
+                             max_seq_len=64, dtype="float32")
+
+
+def _forward_parity(mesh, n_microbatches, seq=32, batch=4,
+                    rtol=3e-5, atol=3e-5):
+    from kubeflow_tpu.models.transformer import pipelined_forward
+
+    config = _tiny_config()
+    params = init_params(jax.random.key(0), config)
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                config.vocab_size)
+    w_cot = jax.random.normal(jax.random.key(2),
+                              (batch, seq, config.vocab_size))
+
+    def loss_ref(params):
+        return jnp.sum(forward(params, tokens, config) * w_cot)
+
+    def loss_pp(params):
+        logits = pipelined_forward(params, tokens, config, mesh,
+                                   n_microbatches=n_microbatches)
+        return jnp.sum(logits * w_cot)
+
+    val_ref, g_ref = jax.value_and_grad(loss_ref)(params)
+    val_pp, g_pp = jax.jit(jax.value_and_grad(loss_pp))(params)
+    np.testing.assert_allclose(float(val_pp), float(val_ref),
+                               rtol=1e-4, atol=1e-4)
+    _tree_allclose(g_pp, g_ref, rtol=rtol, atol=atol)
+
+
+def test_pipelined_forward_gradients_match_forward():
+    """Full-model pin: embedding outside, 2 stages of 2 layers, LM head
+    outside — grads w.r.t. every param must match the unsharded model."""
+    _forward_parity(build_mesh(MeshConfig(pp=2, tp=2, dp=2)),
+                    n_microbatches=2)
+
+
+def test_pipelined_forward_with_sp_gradients_match_forward():
+    """pp × sp composition: stages run ring attention via bare ppermute
+    over the manual sp axis with sharded RoPE tables. Values and grads
+    must match the unsharded model — this is the pin that a wrong
+    position offset or ring rotation inside the pipeline would fail."""
+    _forward_parity(build_mesh(MeshConfig(pp=2, sp=2, dp=2)),
+                    n_microbatches=2)
+
+
+def test_pipelined_forward_sp_with_tp_axis_present():
+    """sp body under a mesh that also carries tp>1 (the 16-device layout
+    shape, folded to 8 devices): exercises the spec plumbing with every
+    axis present."""
+    _forward_parity(build_mesh(MeshConfig(pp=2, sp=2, tp=2)),
+                    n_microbatches=2)
