@@ -72,6 +72,15 @@ PEAK_FLOPS = (
     ("v4", 275e12),
 )
 
+# HBM bandwidth per chip, bytes/s (public TPU specs) — the decode roofline.
+HBM_BW = (
+    ("v6", 1640e9),
+    ("v5p", 2765e9),
+    ("v5e", 819e9),
+    ("v5", 819e9),
+    ("v4", 1228e9),
+)
+
 
 # --------------------------------------------------------------- backend probe
 def probe_backend(attempt_timeout_s: float = 90.0,
@@ -190,14 +199,24 @@ def _timed_iters(run_n, counts=(5, 25)) -> float:
     return max((t2 - t1) / (n2 - n1), 1e-9)
 
 
-def _peak_flops(device_kind: str) -> float | None:
+def _spec_lookup(device_kind: str, table) -> float | None:
+    """Ordered substring match over a chip-spec table; unrecognized TPU
+    kinds fall back to the table's v5e row (conservative)."""
     kind = device_kind.lower()
-    for key, peak in PEAK_FLOPS:
+    for key, val in table:
         if key in kind:
-            return peak
+            return val
     if "tpu" in kind or "axon" in kind:
-        return PEAK_FLOPS[2][1]  # conservative: v5e
+        return dict(table)["v5e"]
     return None
+
+
+def _peak_flops(device_kind: str) -> float | None:
+    return _spec_lookup(device_kind, PEAK_FLOPS)
+
+
+def _hbm_bw(device_kind: str) -> float | None:
+    return _spec_lookup(device_kind, HBM_BW)
 
 
 _EMITTED: list[dict] = []
@@ -508,23 +527,48 @@ def bench_decode(info: dict) -> None:
                   "ms_per_token_per_seq": round(per_call / new_tokens * 1e3,
                                                 3)})
 
-    # int8 weight-only serving path (models/quant.py): decode is HBM-bound,
-    # so halving weight bytes is the direct lever
+    # int8 serving path: weights (models/quant.py) AND KV cache
+    # (models/decode.py kv_quant) quantize — decode is HBM-bound, so
+    # halving both traffic streams is the direct lever
     from kubeflow_tpu.models.quant import quantize_params
     qparams = quantize_params(params)
-    sync(gen(qparams, prompts))
+    gen_q = jax.jit(lambda p, t: generate(p, t, config, new_tokens,
+                                          kv_quant=True))
+    sync(gen_q(qparams, prompts))
 
     def run_q(n):
         out = None
         for _ in range(n):
-            out = gen(qparams, prompts)
+            out = gen_q(qparams, prompts)
         sync(out)
     per_q = _timed_iters(run_q, counts=(2, 6))
     tok_q = batch * new_tokens / per_q
+
+    # weight-traffic roofline: every decode step re-reads the full weight
+    # set once (batch amortizes it over `batch` tokens) plus the live KV
+    # bytes; % of HBM bandwidth says how close to memory-bound we run
+    weight_bytes = sum(leaf.nbytes for key in qparams if key != "embed"
+                       for leaf in jax.tree.leaves(qparams[key]))
+    c = config
+    # KV traffic per step depends on the attention path actually taken:
+    # the einsum path contracts over the FULL static max_seq_len cache
+    # every step; the flash-decode kernel (auto at >= 2048 on TPU) skips
+    # blocks past the live frontier, so it reads ~the average live prefix
+    flash = info["backend"] != "cpu" and c.max_seq_len >= 2048 \
+        and c.decode_attention != "xla"
+    span = (prompt_len + new_tokens / 2) if flash else c.max_seq_len
+    kv_bytes = batch * c.n_layers * 2 * span * c.n_kv_heads * \
+        (c.d_head * 1 + 4)  # int8 values + f32 scale per position
+    steps_per_s = tok_q / batch
+    bw = _hbm_bw(info["device_kind"]) if info["backend"] != "cpu" else None
+    pct = round(steps_per_s * (weight_bytes + kv_bytes) / bw, 4) \
+        if bw else None
     _emit(info, metric="decode_int8_tokens_per_sec", value=round(tok_q, 1),
           unit="tokens/s", vs_baseline=None,
-          detail={"batch": batch,
-                  "speedup_vs_f32": round(per_call / per_q, 3)})
+          detail={"batch": batch, "kv_quant": True,
+                  "speedup_vs_f32": round(per_call / per_q, 3),
+                  "weight_bytes_mb": round(weight_bytes / 1e6, 1),
+                  "pct_hbm_roofline": pct})
 
 
 # ------------------------------------------------------- control-plane bench

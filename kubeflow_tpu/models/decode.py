@@ -45,15 +45,40 @@ def _mlp(x: jax.Array, layer: dict, config: TransformerConfig) -> jax.Array:
 
 
 # ------------------------------------------------------------------- cache
-def init_kv_cache(config: TransformerConfig, batch: int) -> dict:
-    """Zeroed (layers, batch, max_seq, kv_heads, d_head) K/V buffers in the
-    compute dtype."""
+def init_kv_cache(config: TransformerConfig, batch: int,
+                  kv_quant: bool = False) -> dict:
+    """Zeroed (layers, batch, max_seq, kv_heads, d_head) K/V buffers.
+
+    ``kv_quant``: int8 buffers + per-(position, kv_head) f32 scales.
+    Decode attention is KV-bandwidth bound at long context (the cache is
+    re-read every token); int8 halves those bytes while weights quantize
+    independently (models/quant.py). Scales are amax over d_head at write
+    time — one scalar per written position per kv head."""
     c = config
     shape = (c.n_layers, batch, c.max_seq_len, c.n_kv_heads, c.d_head)
+    if not kv_quant:
+        return {
+            "k": jnp.zeros(shape, c.compute_dtype),
+            "v": jnp.zeros(shape, c.compute_dtype),
+        }
     return {
-        "k": jnp.zeros(shape, c.compute_dtype),
-        "v": jnp.zeros(shape, c.compute_dtype),
+        "k": jnp.zeros(shape, jnp.int8),
+        "v": jnp.zeros(shape, jnp.int8),
+        "k_scale": jnp.zeros(shape[:-1], jnp.float32),
+        "v_scale": jnp.zeros(shape[:-1], jnp.float32),
     }
+
+
+def is_kv_quantized(cache: dict) -> bool:
+    return "k_scale" in cache
+
+
+def _quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """(..., d_head) → int8 values + (...,) f32 amax/127 scales."""
+    x32 = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(x32), axis=-1), 1e-12) / 127.0
+    q = jnp.clip(jnp.round(x32 / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
 
 
 def _write_cache(cache_layer: dict, k: jax.Array, v: jax.Array,
@@ -61,20 +86,66 @@ def _write_cache(cache_layer: dict, k: jax.Array, v: jax.Array,
     """Write (b, s, h, d) K/V into a layer cache at sequence offset
     ``start``. With ``layer`` set, the cache is the stacked
     (L, b, max_seq, h, d) form and the write targets that layer (the
-    decode_step unrolled-loop path)."""
+    decode_step unrolled-loop path). Quantized caches quantize at the
+    write and store the per-position scales alongside."""
     zero = jnp.int32(0)
     idx = (zero, jnp.asarray(start, jnp.int32), zero, zero)
+    sidx = idx[:-1]
     if layer is not None:
         idx = (jnp.int32(layer), *idx)
+        sidx = (jnp.int32(layer), *sidx)
         k, v = k[None], v[None]
+    if not is_kv_quantized(cache_layer):
+        return {
+            "k": lax.dynamic_update_slice(cache_layer["k"], k, idx),
+            "v": lax.dynamic_update_slice(cache_layer["v"], v, idx),
+        }
+    qk, sk = _quantize_kv(k)
+    qv, sv = _quantize_kv(v)
     return {
-        "k": lax.dynamic_update_slice(cache_layer["k"], k, idx),
-        "v": lax.dynamic_update_slice(cache_layer["v"], v, idx),
+        "k": lax.dynamic_update_slice(cache_layer["k"], qk, idx),
+        "v": lax.dynamic_update_slice(cache_layer["v"], qv, idx),
+        "k_scale": lax.dynamic_update_slice(cache_layer["k_scale"], sk, sidx),
+        "v_scale": lax.dynamic_update_slice(cache_layer["v_scale"], sv, sidx),
     }
 
 
+def _write_cache_rows(stacked: dict, k: jax.Array, v: jax.Array,
+                      pos: jax.Array, layer: int) -> dict:
+    """Per-row single-position write: (b, 1, h, d) K/V lands at row b's own
+    ``pos[b]`` (continuous batching — every sequence is at a different
+    depth). Scatter via advanced indexing; XLA lowers it in place."""
+    rows = jnp.arange(k.shape[0])
+    if not is_kv_quantized(stacked):
+        return {
+            "k": stacked["k"].at[layer, rows, pos].set(k[:, 0]),
+            "v": stacked["v"].at[layer, rows, pos].set(v[:, 0]),
+        }
+    qk, sk = _quantize_kv(k)
+    qv, sv = _quantize_kv(v)
+    return {
+        "k": stacked["k"].at[layer, rows, pos].set(qk[:, 0]),
+        "v": stacked["v"].at[layer, rows, pos].set(qv[:, 0]),
+        "k_scale": stacked["k_scale"].at[layer, rows, pos].set(sk[:, 0]),
+        "v_scale": stacked["v_scale"].at[layer, rows, pos].set(sv[:, 0]),
+    }
+
+
+def _read_cache_layer(stacked: dict, i: int, dt) -> tuple[jax.Array,
+                                                          jax.Array]:
+    """Layer ``i``'s (B, S, G, D) K/V in compute dtype. Quantized caches
+    dequantize here — XLA fuses convert+scale into the attention matmul's
+    operand load, so HBM traffic is the int8 bytes."""
+    ck, cv = stacked["k"][i], stacked["v"][i]
+    if is_kv_quantized(stacked):
+        ck = ck.astype(dt) * stacked["k_scale"][i][..., None].astype(dt)
+        cv = cv.astype(dt) * stacked["v_scale"][i][..., None].astype(dt)
+    return ck, cv
+
+
 # ----------------------------------------------------------------- prefill
-def prefill(params: dict, tokens: jax.Array, config: TransformerConfig):
+def prefill(params: dict, tokens: jax.Array, config: TransformerConfig,
+            kv_quant: bool = False):
     """Run the prompt through a fresh KV cache.
 
     tokens: (batch, prompt_len) → (logits (batch, vocab) for the LAST
@@ -84,7 +155,7 @@ def prefill(params: dict, tokens: jax.Array, config: TransformerConfig):
     flash_attention itself (ops/attention.py _pick_block)."""
     c = config
     B, S = tokens.shape
-    cache = init_kv_cache(c, B)
+    cache = init_kv_cache(c, B, kv_quant=kv_quant)
     x = params["embed"].astype(c.compute_dtype)[tokens]
     positions = jnp.broadcast_to(
         jnp.arange(S, dtype=jnp.int32)[None, :], tokens.shape)
@@ -108,9 +179,10 @@ def decode_step(params: dict, cache: dict, token: jax.Array,
                 pos: jax.Array, config: TransformerConfig):
     """One token in, next-token logits out.
 
-    token: (batch,) int32; pos: scalar int32, the sequence position being
-    written (prompt_len for the first generated token). Attention runs over
-    the full static cache with a ``<= pos`` mask.
+    token: (batch,) int32; pos: scalar int32 (all rows at the same depth —
+    the generate loop) or (batch,) int32 per-row positions (continuous
+    batching: every sequence at its own depth). Attention runs over the
+    full static cache with a ``<= pos`` mask.
 
     The layer loop is UNROLLED (not lax.scan): scanning over the stacked
     (L, B, S, G, D) cache forces per-layer dynamic-slice reads, a restacking
@@ -123,15 +195,31 @@ def decode_step(params: dict, cache: dict, token: jax.Array,
     c = config
     B = token.shape[0]
     pos32 = jnp.asarray(pos, jnp.int32)
+    per_row = pos32.ndim == 1
     x = params["embed"].astype(c.compute_dtype)[token][:, None, :]  # (B,1,D)
-    positions = jnp.broadcast_to(pos32[None, None], (B, 1))
+    if per_row:
+        positions = pos32[:, None]                           # (B, 1)
+        valid = jnp.arange(c.max_seq_len, dtype=jnp.int32)[None, None,
+                                                           None, :] \
+            <= pos32[:, None, None, None]                    # (B,1,1,S)
+    else:
+        positions = jnp.broadcast_to(pos32[None, None], (B, 1))
+        valid = jnp.arange(c.max_seq_len, dtype=jnp.int32)[None, None,
+                                                           None, :] \
+            <= pos32                                         # (1,1,1,S)
     cos, sin = rope_frequencies(c, positions)
     scale = 1.0 / math.sqrt(c.d_head)
-    valid = jnp.arange(c.max_seq_len, dtype=jnp.int32)[None, None, None, :] \
-        <= pos32                                             # (1,1,1,S)
 
     rep = c.n_heads // c.n_kv_heads
-    stacked = {"k": cache["k"], "v": cache["v"]}     # (L, B, S, G, D)
+    stacked = dict(cache)                            # (L, B, S, G, D) (+scales)
+    # flash-decode: stream the cache through the Pallas kernel instead of
+    # materializing (B, G, rep, 1, S) logits — the long-KV bandwidth path.
+    # "auto" engages on TPU once the cache is long enough for the einsum's
+    # extra HBM round-trip to matter.
+    use_flash = c.decode_attention == "flash" or (
+        c.decode_attention == "auto" and jax.default_backend() == "tpu"
+        and c.max_seq_len >= 2048)
+    pos_vec = pos32 if per_row else jnp.broadcast_to(pos32, (B,))
 
     for i in range(c.n_layers):
         layer = jax.tree.map(lambda a: a[i], params["blocks"])
@@ -142,20 +230,33 @@ def decode_step(params: dict, cache: dict, token: jax.Array,
         v = jnp.einsum("bsd,dhk->bshk", h, wcast(layer["wv"], dt))
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        stacked = _write_cache(stacked, k, v, pos32, layer=i)
+        if per_row:
+            stacked = _write_cache_rows(stacked, k, v, pos32, layer=i)
+        else:
+            stacked = _write_cache(stacked, k, v, pos32, layer=i)
         # grouped GQA: q heads fold to (kv_heads, rep) and contract against
         # the UN-repeated cache — head h reads kv head h//rep, matching
         # repeat_kv's layout, without materializing a rep× cache copy (the
         # KV-bandwidth saving is the point of GQA)
         B_, _, H_, D_ = q.shape
-        qg = q.reshape(B_, 1, c.n_kv_heads, rep, D_)
-        ck, cv = stacked["k"][i], stacked["v"][i]    # (B, S, G, D) views
-        logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ck,
-                            preferred_element_type=jnp.float32) * scale
-        logits = jnp.where(valid[:, :, None], logits, -jnp.inf)
-        probs = jax.nn.softmax(logits, axis=-1).astype(dt)
-        out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, cv).reshape(
-            B_, 1, H_, D_)
+        if use_flash:
+            from ..ops.decode_attention import flash_decode_attention
+            quant = is_kv_quantized(stacked)
+            out = flash_decode_attention(
+                q[:, 0].reshape(B_, c.n_kv_heads, rep, D_),
+                stacked["k"][i], stacked["v"][i], pos_vec,
+                k_scale=stacked["k_scale"][i] if quant else None,
+                v_scale=stacked["v_scale"][i] if quant else None)
+            out = out.reshape(B_, H_, D_)[:, None].astype(dt)
+        else:
+            qg = q.reshape(B_, 1, c.n_kv_heads, rep, D_)
+            ck, cv = _read_cache_layer(stacked, i, dt)   # (B, S, G, D)
+            logits = jnp.einsum("bqgrd,bkgd->bgrqk", qg, ck,
+                                preferred_element_type=jnp.float32) * scale
+            logits = jnp.where(valid[:, :, None], logits, -jnp.inf)
+            probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+            out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, cv).reshape(
+                B_, 1, H_, D_)
         x = x + jnp.einsum("bshk,hkd->bsd", out, wcast(layer["wo"], dt))
         x = _mlp(x, layer, c)
 
@@ -192,12 +293,28 @@ def top_k_top_p_mask(logits: jax.Array, top_k: jax.Array,
     return jnp.where(keep, logits, -jnp.inf)
 
 
-@partial(jax.jit, static_argnames=("config", "max_new_tokens"))
+def sample_token(logits: jax.Array, key: jax.Array,
+                 temperature: jax.Array, top_k: jax.Array,
+                 top_p: jax.Array) -> jax.Array:
+    """One sampling decision per row: greedy at temperature 0, else
+    temperature-scaled top-k/top-p sampling. All knobs are traced (batch,)
+    vectors — mixed greedy/sampled batches share one executable. Shared by
+    ``generate``'s scan and the continuous-batching engine."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # temperature first, THEN the k/p cuts (the standard order: the
+    # nucleus is computed on the temperature-scaled distribution)
+    scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
+    filtered = top_k_top_p_mask(scaled, top_k, top_p)
+    sampled = jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0.0, sampled, greedy)
+
+
+@partial(jax.jit, static_argnames=("config", "max_new_tokens", "kv_quant"))
 def generate(params: dict, prompt: jax.Array, config: TransformerConfig,
              max_new_tokens: int, temperature: float = 0.0,
              key: jax.Array | None = None, top_k: int = 0,
              top_p: float = 1.0, eos_id: int | None = None,
-             pad_id: int = 0) -> jax.Array:
+             pad_id: int = 0, kv_quant: bool = False) -> jax.Array:
     """Greedy (temperature=0), temperature, top-k, and/or nucleus sampling.
 
     prompt: (batch, prompt_len) → (batch, max_new_tokens). One prefill pass,
@@ -209,7 +326,11 @@ def generate(params: dict, prompt: jax.Array, config: TransformerConfig,
 
     ``eos_id``: sequences that emit it keep their static shape — every
     position after the first EOS holds ``pad_id`` (the loop still runs
-    max_new_tokens steps; per-row early exit would be a dynamic shape)."""
+    max_new_tokens steps; per-row early exit would be a dynamic shape).
+
+    ``kv_quant``: int8 KV cache with per-position scales (activations stay
+    bf16) — half the cache bytes re-read every token, the long-KV decode
+    bandwidth lever; composes with int8 weights (models/quant.py)."""
     c = config
     B, prompt_len = prompt.shape
     if prompt_len + max_new_tokens > c.max_seq_len:
@@ -223,17 +344,10 @@ def generate(params: dict, prompt: jax.Array, config: TransformerConfig,
     top_k = jnp.broadcast_to(jnp.asarray(top_k, jnp.int32), (B,))
     top_p = jnp.broadcast_to(jnp.asarray(top_p, jnp.float32), (B,))
 
-    logits, cache = prefill(params, prompt, c)
+    logits, cache = prefill(params, prompt, c, kv_quant=kv_quant)
 
     def pick(logits, k):
-        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        # temperature first, THEN the k/p cuts (the standard order: the
-        # nucleus is computed on the temperature-scaled distribution)
-        scaled = logits / jnp.maximum(temperature, 1e-6)[:, None]
-        filtered = top_k_top_p_mask(scaled, top_k, top_p)
-        sampled = jax.random.categorical(k, filtered,
-                                         axis=-1).astype(jnp.int32)
-        return jnp.where(temperature > 0.0, sampled, greedy)
+        return sample_token(logits, k, temperature, top_k, top_p)
 
     def step(carry, i):
         logits, cache, key, done = carry

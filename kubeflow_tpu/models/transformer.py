@@ -57,11 +57,19 @@ class TransformerConfig:
     # whole layer (absolute smallest footprint)
     remat: bool | str = False
     attention: str = "auto"      # auto | xla | ring | ulysses | flash
+    # decode-time attention over the KV cache: "flash" streams the cache
+    # through the Pallas flash-decode kernel (ops/decode_attention.py);
+    # "auto" engages it on TPU at long max_seq_len where the cache read
+    # dominates the step; "xla" keeps the einsum path
+    decode_attention: str = "auto"
 
     def __post_init__(self):
         if self.remat not in (False, True, "mlp", "attn"):
             raise ValueError(f"remat must be False, True, 'mlp', or "
                              f"'attn'; got {self.remat!r}")
+        if self.decode_attention not in ("auto", "xla", "flash"):
+            raise ValueError(f"decode_attention must be auto, xla, or "
+                             f"flash; got {self.decode_attention!r}")
 
     @property
     def d_head(self) -> int:

@@ -1,0 +1,185 @@
+"""Pallas flash-decode attention: one query token against a long KV cache.
+
+Decode attention at long context is pure KV-bandwidth: every generated
+token re-reads the whole (B, S, G, D) cache. The XLA einsum path
+materializes (B, G, rep, 1, S) logits in HBM between two kernels and
+re-reads them for the softmax/PV contraction; this kernel streams the
+cache HBM→VMEM once per step in the canonical flash form instead —
+grid (batch, kv_head, kv_blocks) with the kv axis innermost/sequential,
+a running (max, sum, acc) recurrence in VMEM scratch, and position-masked
+blocks past ``pos`` skipped entirely via pl.when (the cache is allocated
+at max_seq_len but only ``pos+1`` entries are live).
+
+GQA-native: the query arrives grouped (B, G, rep, D) and contracts
+directly against the UN-repeated cache — the rep axis rides the sublanes
+of one small matmul per block, so the cache is never materialized
+rep× wide.
+
+int8 KV composes: pass the per-position scales and the kernel dequantizes
+in-register after the int8 block load — HBM sees half the bytes
+(models/decode.py int8 KV cache).
+
+On non-TPU backends the kernel runs in interpreter mode for tests.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANES = 128
+DEFAULT_BLOCK_K = 1024
+
+
+def _decode_kernel(pos_ref, q_ref, k_ref, v_ref, *rest, scale: float,
+                   num_kv: int, block_k: int, quantized: bool):
+    # operand list is conditional: scale refs exist only for int8 caches
+    # (an unquantized call must not DMA dummy scale blocks every step)
+    if quantized:
+        ks_ref, vs_ref, o_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        ks_ref = vs_ref = None
+        o_ref, m_scr, l_scr, acc_scr = rest
+    kj = pl.program_id(2)
+    pos = pos_ref[0, 0]
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    def compute():
+        q = q_ref[0, 0].astype(jnp.float32) * scale          # (rep, d)
+        k = k_ref[0, 0].astype(jnp.float32)                  # (bk, d)
+        v = v_ref[0, 0].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (rep, bk)
+        if quantized:
+            # scales fold OUTSIDE the matmuls (per-kv-position, so they
+            # distribute over the d contraction): logits pick up the K
+            # scale; P picks up the V scale before the PV product. Keeps
+            # the scale operand (1, bk)-shaped — lane-dim friendly.
+            logits = logits * ks_ref[0, 0]                   # (1, bk)
+        s_idx = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1)
+        valid = s_idx <= pos
+        logits = jnp.where(valid, logits, _NEG_INF)
+        m_prev = m_scr[:, :1]
+        row_max = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, row_max)
+        p = jnp.where(valid, jnp.exp(logits - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True),
+            l_scr.shape)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        if quantized:
+            # V's per-position scale joins AFTER the softmax-denominator
+            # sum (it belongs to V, not to the probabilities)
+            p = p * vs_ref[0, 0]                             # (1, bk)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot(
+            p.astype(jnp.float32), v, preferred_element_type=jnp.float32)
+
+    # blocks entirely past the live cache frontier contribute nothing —
+    # skipping them makes step cost track pos, not max_seq_len
+    pl.when(kj * block_k <= pos)(compute)
+
+    @pl.when(kj == num_kv - 1)
+    def _finalize():
+        denom = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / denom).astype(o_ref.dtype)
+
+
+def _pick_block_k(S: int, want: int) -> int:
+    """Largest divisor of S <= want, preferring 128-lane multiples. The
+    auto path must never raise on a valid cache length — an odd
+    max_seq_len just gets a less-ideal block."""
+    if S <= want:
+        return S
+    for b in range(want, 127, -1):
+        if S % b == 0 and b % 128 == 0:
+            return b
+    for b in range(want, 0, -1):
+        if S % b == 0:
+            return b
+    return S
+
+
+def flash_decode_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                           pos: jax.Array, *,
+                           k_scale: jax.Array | None = None,
+                           v_scale: jax.Array | None = None,
+                           block_k: int | None = None,
+                           interpret: bool | None = None) -> jax.Array:
+    """q: (B, G, rep, D) one grouped query token; k/v: (B, S, G, D) cache
+    (int8 when ``k_scale``/``v_scale`` (B, S, G) are given, else compute
+    dtype); pos: (B,) int32 — entries at s <= pos[b] are live. Returns
+    (B, G, rep, D) in q's dtype. ``block_k=None`` picks the largest
+    S-divisor <= DEFAULT_BLOCK_K; an explicit block must divide S."""
+    B, G, rep, D = q.shape
+    S = k.shape[1]
+    quantized = k_scale is not None
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if block_k is None:
+        block_k = _pick_block_k(S, DEFAULT_BLOCK_K)
+    block_k = min(block_k, S)
+    if S % block_k:
+        raise ValueError(f"cache length {S} not divisible by block_k "
+                         f"{block_k}")
+    num_kv = S // block_k
+    scale = 1.0 / math.sqrt(D)
+    kt = k.transpose(0, 2, 1, 3)                             # (B, G, S, D)
+    vt = v.transpose(0, 2, 1, 3)
+    pos2 = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(B, 1),
+                            (B, 1))
+    operands = [pos2, q, kt, vt]
+    in_specs = [
+        pl.BlockSpec((1, 1), lambda b, g, kj: (b, 0)),               # pos
+        pl.BlockSpec((1, 1, rep, D), lambda b, g, kj: (b, g, 0, 0)),
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, g, kj: (b, g, kj, 0)),                # k
+        pl.BlockSpec((1, 1, block_k, D),
+                     lambda b, g, kj: (b, g, kj, 0)),                # v
+    ]
+    if quantized:
+        # (B, S, G) → (B, G, 1, S): the kernel folds these into the
+        # (rep, bk) logits/probs, so the kv axis rides the 128-lane dim
+        operands.append(
+            k_scale.transpose(0, 2, 1)[:, :, None, :].astype(jnp.float32))
+        operands.append(
+            v_scale.transpose(0, 2, 1)[:, :, None, :].astype(jnp.float32))
+        in_specs.extend([
+            pl.BlockSpec((1, 1, 1, block_k),
+                         lambda b, g, kj: (b, g, 0, kj)),            # ks
+            pl.BlockSpec((1, 1, 1, block_k),
+                         lambda b, g, kj: (b, g, 0, kj)),            # vs
+        ])
+
+    grid = (B, G, num_kv)
+    kernel = functools.partial(_decode_kernel, scale=scale, num_kv=num_kv,
+                               block_k=block_k, quantized=quantized)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, rep, D), lambda b, g, kj: (b, g, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, G, rep, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((rep, _LANES), jnp.float32),
+            pltpu.VMEM((rep, _LANES), jnp.float32),
+            pltpu.VMEM((rep, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(*operands)
+    return out

@@ -28,10 +28,12 @@ import threading
 import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
+from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..models.decode import generate
 
@@ -220,3 +222,269 @@ class BatchedGenerator:
         out = np.asarray(out)
         for i, req in enumerate(batch):
             req.future.set_result(out[i])
+
+
+# ===================================================== continuous batching
+@dataclass
+class _Slot:
+    """Host-side bookkeeping for one engine row."""
+    req: GenerateRequest | None = None
+    target: int = 0          # tokens to emit for the current request
+
+
+class ContinuousBatchedGenerator:
+    """Continuous-batching serving engine: requests join and leave a
+    RUNNING batch at token boundaries instead of waiting for a bucket to
+    drain (``BatchedGenerator`` runs each batch to completion —
+    fine for bench loops, wrong for a serving stack whose arrivals are
+    Poisson, not phased).
+
+    Engine design (TPU-first):
+    - a fixed pool of ``n_slots`` rows shares ONE KV cache and ONE
+      compiled decode step; per-row positions drive the cache writes and
+      causal masks (models/decode.decode_step with vector ``pos``), so
+      rows at different depths coexist in a step;
+    - admission = a single-prompt prefill written into the slot's cache
+      rows via dynamic_update_slice, plus slot-state updates — one
+      compile per distinct prompt length (templated notebook prompts);
+    - generated ids accumulate in a device-side (slots, cap) buffer;
+      the host reads a row back only at completion (the per-step host
+      sync is two tiny (slots,) flag vectors — the decode matmuls
+      dominate);
+    - free slots run the step as masked dummy rows (static shapes; the
+      idle-row compute is the price of never recompiling).
+
+    ``submit`` returns a Future resolving to the (max_new_tokens,) ids.
+    """
+
+    def __init__(self, params, config, *, n_slots: int = 8,
+                 max_new_cap: int | None = None, seed: int = 0,
+                 quantize: bool = False, kv_quant: bool = False,
+                 eos_id: int | None = None, pad_id: int = 0):
+        from ..models.decode import init_kv_cache
+        if quantize:
+            from ..models.quant import quantize_params
+            params = quantize_params(params)
+        self.params = params
+        self.config = config
+        self.n_slots = n_slots
+        self.cap = max_new_cap or config.max_seq_len
+        self.eos_id = eos_id
+        self.pad_id = pad_id
+        self.kv_quant = kv_quant
+        self._queue: queue.Queue = queue.Queue()
+        self._slots = [_Slot() for _ in range(n_slots)]
+        self._key = jax.random.key(seed)
+        self._closed = False
+        self._lifecycle = threading.Lock()
+        # metrics: the serving-test observable — how many requests were
+        # admitted while other rows were mid-generation
+        self.admitted_total = 0
+        self.admitted_while_running = 0
+        self.steps_total = 0
+        self._state = {
+            "cache": init_kv_cache(config, n_slots, kv_quant=kv_quant),
+            "logits": jnp.zeros((n_slots, config.vocab_size), jnp.float32),
+            "pos": jnp.zeros((n_slots,), jnp.int32),
+            "active": jnp.zeros((n_slots,), bool),
+            "done": jnp.zeros((n_slots,), bool),
+            "out": jnp.zeros((n_slots, self.cap), jnp.int32),
+            "n_out": jnp.zeros((n_slots,), jnp.int32),
+            "temp": jnp.zeros((n_slots,), jnp.float32),
+            "top_k": jnp.zeros((n_slots,), jnp.int32),
+            "top_p": jnp.ones((n_slots,), jnp.float32),
+        }
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="kubeflow-tpu-cbatch")
+        self._thread.start()
+
+    # ----------------------------------------------------------------- API
+    def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
+               top_k: int = 0, top_p: float = 1.0) -> Future:
+        if max_new_tokens > self.cap:
+            raise ValueError(f"max_new_tokens {max_new_tokens} exceeds "
+                             f"engine cap {self.cap}")
+        req = GenerateRequest(np.asarray(prompt, np.int32), max_new_tokens,
+                              temperature, top_k, top_p)
+        if len(req.prompt) + max_new_tokens > self.config.max_seq_len:
+            raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        with self._lifecycle:
+            if self._closed:
+                raise RuntimeError("generator is closed")
+            self._queue.put(req)
+        return req.future
+
+    def generate_sync(self, prompt, max_new_tokens: int,
+                      temperature: float = 0.0, *, top_k: int = 0,
+                      top_p: float = 1.0, timeout: float = 120.0):
+        return self.submit(prompt, max_new_tokens, temperature, top_k,
+                           top_p).result(timeout=timeout)
+
+    def close(self) -> None:
+        with self._lifecycle:
+            if self._closed:
+                return
+            self._closed = True
+            self._queue.put(None)
+        self._thread.join(timeout=30)
+
+    def __enter__(self) -> "ContinuousBatchedGenerator":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------- jitted kernels
+    @staticmethod
+    @partial(jax.jit, static_argnames=("config", "kv_quant"))
+    def _admit_jit(params, state, prompt, slot, temp, top_k, top_p,
+                   config, kv_quant):
+        """Prefill one prompt and splice it into ``slot``'s row of the
+        engine state. One compile per distinct prompt length."""
+        from ..models.decode import prefill
+        logits_row, row_cache = prefill(params, prompt[None], config,
+                                        kv_quant=kv_quant)
+        slot32 = jnp.asarray(slot, jnp.int32)
+        cache = dict(state["cache"])
+        for name, buf in row_cache.items():
+            # (L, 1, S, ...) row → engine (L, n_slots, S, ...) at [:, slot]
+            cache[name] = lax.dynamic_update_slice(
+                state["cache"][name], buf,
+                (jnp.int32(0), slot32) + (jnp.int32(0),) * (buf.ndim - 2))
+        return {
+            **state,
+            "cache": cache,
+            "logits": state["logits"].at[slot32].set(logits_row[0]),
+            "pos": state["pos"].at[slot32].set(prompt.shape[0]),
+            "active": state["active"].at[slot32].set(True),
+            "done": state["done"].at[slot32].set(False),
+            "n_out": state["n_out"].at[slot32].set(0),
+            "out": state["out"].at[slot32].set(0),
+            "temp": state["temp"].at[slot32].set(temp),
+            "top_k": state["top_k"].at[slot32].set(top_k),
+            "top_p": state["top_p"].at[slot32].set(top_p),
+        }
+
+    @staticmethod
+    @partial(jax.jit, static_argnames=("config", "eos_id", "pad_id"))
+    def _step_jit(params, state, key, config, eos_id, pad_id):
+        """One engine tick: sample a token for every active row from the
+        carried logits, record it, and run one decode step at per-row
+        positions. Inactive rows ride along masked."""
+        from ..models.decode import decode_step, sample_token
+        active = state["active"]
+        token = sample_token(state["logits"], key, state["temp"],
+                             state["top_k"], state["top_p"])
+        if eos_id is not None:
+            token = jnp.where(state["done"], jnp.int32(pad_id), token)
+        token = jnp.where(active, token, jnp.int32(pad_id))
+        rows = jnp.arange(token.shape[0])
+        out = state["out"].at[rows, state["n_out"]].set(
+            jnp.where(active, token, state["out"][rows, state["n_out"]]))
+        n_out = state["n_out"] + active.astype(jnp.int32)
+        done = state["done"]
+        if eos_id is not None:
+            done = done | (active & (token == eos_id))
+        logits, cache = decode_step(params, state["cache"], token,
+                                    state["pos"], config)
+        # inactive rows keep their carried logits; their cache writes land
+        # at their stale pos but are never read (mask is per-row)
+        logits = jnp.where(active[:, None], logits, state["logits"])
+        pos = state["pos"] + active.astype(jnp.int32)
+        return {**state, "cache": cache, "logits": logits, "pos": pos,
+                "active": active, "done": done, "out": out, "n_out": n_out}
+
+    # -------------------------------------------------------------- engine
+    def _free_slots(self) -> list[int]:
+        return [i for i, s in enumerate(self._slots) if s.req is None]
+
+    def _any_active(self) -> bool:
+        return any(s.req is not None for s in self._slots)
+
+    def _admit(self, req: GenerateRequest, slot: int) -> None:
+        self._state = self._admit_jit(
+            self.params, self._state, jnp.asarray(req.prompt),
+            slot, jnp.float32(req.temperature), jnp.int32(req.top_k),
+            jnp.float32(req.top_p), self.config, self.kv_quant)
+        self._slots[slot] = _Slot(req=req, target=req.max_new_tokens)
+        self.admitted_total += 1
+        if sum(s.req is not None for s in self._slots) > 1:
+            self.admitted_while_running += 1
+
+    def _collect_finished(self) -> None:
+        n_out = np.asarray(self._state["n_out"])
+        done = np.asarray(self._state["done"])
+        deactivate = []
+        for i, slot in enumerate(self._slots):
+            if slot.req is None:
+                continue
+            if n_out[i] >= slot.target or done[i]:
+                ids = np.asarray(self._state["out"][i, :slot.target])
+                if n_out[i] < slot.target:  # EOS'd early: pad the tail
+                    ids = ids.copy()
+                    ids[int(n_out[i]):] = self.pad_id
+                slot.req.future.set_result(ids.astype(np.int32))
+                self._slots[i] = _Slot()
+                deactivate.append(i)
+        if deactivate:
+            active = self._state["active"].at[
+                jnp.asarray(deactivate, jnp.int32)].set(False)
+            self._state = {**self._state, "active": active}
+
+    def _loop(self) -> None:
+        draining = False
+        while True:
+            # admit as many arrivals as there are free slots; block for
+            # work only when fully idle
+            block = not draining and not self._any_active()
+            while not draining:
+                free = self._free_slots()
+                if not free:
+                    break
+                try:
+                    req = self._queue.get(block=block, timeout=None)
+                except queue.Empty:
+                    break
+                block = False
+                if req is None:
+                    # close(): finish what's running (like BatchedGenerator
+                    # draining its current batch), admit nothing new
+                    draining = True
+                    break
+                try:
+                    self._admit(req, free[0])
+                except BaseException as exc:  # noqa: BLE001
+                    if not req.future.done():
+                        req.future.set_exception(exc)
+            if not self._any_active():
+                if draining:
+                    self._shutdown()
+                    return
+                continue
+            try:
+                self._key, sub = jax.random.split(self._key)
+                self._state = self._step_jit(self.params, self._state, sub,
+                                             self.config, self.eos_id,
+                                             self.pad_id)
+                self.steps_total += 1
+                self._collect_finished()
+            except BaseException as exc:  # noqa: BLE001 — fail the batch
+                for i, slot in enumerate(self._slots):
+                    if slot.req is not None and not slot.req.future.done():
+                        slot.req.future.set_exception(exc)
+                    self._slots[i] = _Slot()
+                self._state = {**self._state,
+                               "active": jnp.zeros((self.n_slots,), bool)}
+
+    def _shutdown(self) -> None:
+        stragglers = [s.req for s in self._slots if s.req is not None]
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                stragglers.append(req)
+        for req in stragglers:
+            if not req.future.done():
+                req.future.set_exception(RuntimeError("generator closed"))
