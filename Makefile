@@ -47,6 +47,9 @@ dryrun: ## Multi-chip sharding dryrun on 8 virtual CPU devices.
 loadtest: ## 100-notebook control-plane fan-out, in-process.
 	$(PYTHON) loadtest/start_notebooks.py --count 100
 
+release: ## Tag release. VERSION=x.y.z [DRY_RUN=1] [PUSH=1] [ALLOW_MISSING_ENGINE=1]
+	$(PYTHON) ci/release.py --version $(VERSION)$(if $(DRY_RUN), --dry-run,)$(if $(PUSH), --push,)$(if $(ALLOW_MISSING_ENGINE), --allow-missing-engine,)
+
 run: ## Standalone control plane: apiserver on :6443 + kubelet simulator.
 	$(PYTHON) -m kubeflow_tpu.main --serve-apiserver 6443 --simulate-kubelet
 

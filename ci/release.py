@@ -1,0 +1,193 @@
+#!/usr/bin/env python3
+"""Release pipeline: tag → images → pinned params.env → kustomize bundle.
+
+Reference analog: /root/reference/releasing/ (version bumps + manifest
+pinning) plus the image-updater workflows and .tekton pipelines that
+build the controller images and stamp their digests into
+config/base/params.env (odh config/base/params.env:1-6). This repo's
+single-entry equivalent:
+
+    make release VERSION=1.2.3            # full run (builds if docker/podman)
+    make release VERSION=1.2.3 DRY_RUN=1  # no container engine needed
+
+Steps, each idempotent:
+1. build both images (images/Dockerfile.controller, .jax-notebook)
+   tagged ``{registry}/{name}:v{VERSION}`` with the engine found on PATH
+   (docker, then podman); --dry-run (or no engine + --allow-missing-engine)
+   records the would-be tag and a deterministic placeholder digest instead;
+2. stamp the resulting image references (digest-pinned when built,
+   tag-pinned in dry runs) into config/manager/params.env;
+3. regenerate config/ (ci/generate_manifests.py) so every manifest
+   carries the pinned references — the same drift gate CI enforces;
+4. bundle config/ + VERSION into dist/kubeflow-tpu-{VERSION}.tar.gz and
+   write dist/RELEASE.json (version, images, digests, git rev).
+
+Exit 0 = bundle written. The release workflow
+(.github/workflows/release.yaml) runs exactly this on tag push.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import io
+import json
+import re
+import shutil
+import subprocess
+import sys
+import tarfile
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+IMAGES = {
+    # params.env key → (dockerfile, image name)
+    "kubeflow-tpu-notebook-controller": (
+        "images/Dockerfile.controller", "notebook-controller"),
+    "tpu-notebook-image": (
+        "images/Dockerfile.jax-notebook", "jax-notebook"),
+}
+
+VERSION_RE = re.compile(r"^\d+\.\d+\.\d+(-[0-9A-Za-z.-]+)?$")
+
+
+def find_engine() -> str | None:
+    for engine in ("docker", "podman"):
+        if shutil.which(engine):
+            return engine
+    return None
+
+
+def git_rev() -> str:
+    try:
+        return subprocess.run(["git", "rev-parse", "HEAD"], cwd=REPO,
+                              capture_output=True, text=True,
+                              check=True).stdout.strip()
+    except Exception:  # noqa: BLE001 — releases from tarballs have no git
+        return "unknown"
+
+
+def build_image(engine: str | None, dockerfile: str, ref: str,
+                dry_run: bool, push: bool) -> dict:
+    """Build (and with ``push`` publish) one image. Returns
+    ``{ref, pinned_by, digest?, digest_kind}`` — registry digests exist
+    ONLY after a push (a local-only image has no RepoDigests), so
+    digest-pinning requires ``--push``; everything else is explicitly
+    tag-pinned with an honest ``digest_kind`` marker, never a placeholder
+    masquerading as a registry digest."""
+    content = (REPO / dockerfile).read_bytes()
+    content_hash = "sha256:" + hashlib.sha256(content).hexdigest()
+    if dry_run or engine is None:
+        print(f"[release] DRY RUN: would build {ref} from {dockerfile}")
+        return {"ref": ref, "pinned_by": "tag",
+                "digest": content_hash,
+                "digest_kind": "dockerfile-content-placeholder"}
+    print(f"[release] {engine} build -f {dockerfile} -t {ref}")
+    subprocess.run([engine, "build", "-f", str(REPO / dockerfile),
+                    "-t", ref, str(REPO)], check=True)
+    if push:
+        print(f"[release] {engine} push {ref}")
+        subprocess.run([engine, "push", ref], check=True)
+        out = subprocess.run(
+            [engine, "image", "inspect", ref,
+             "--format", "{{index .RepoDigests 0}}"],
+            capture_output=True, text=True)
+        if out.returncode == 0 and "@sha256:" in out.stdout:
+            pinned = out.stdout.strip()
+            return {"ref": pinned, "pinned_by": "digest",
+                    "digest": pinned.split("@", 1)[1],
+                    "digest_kind": "registry"}
+        print(f"[release] WARNING: pushed {ref} but no RepoDigest "
+              f"reported; pinning by tag", file=sys.stderr)
+    return {"ref": ref, "pinned_by": "tag", "digest": content_hash,
+            "digest_kind": "dockerfile-content-placeholder"}
+
+
+def stamp_params_env(pins: dict[str, str]) -> None:
+    """Rewrite the image entries of config/manager/params.env in place,
+    preserving every non-image parameter (gateway names etc.) — parsing
+    and formatting via THE shared helpers in deploy/manifests.py, so the
+    stamper and the pin-preserving generator can never drift."""
+    sys.path.insert(0, str(REPO))
+    from kubeflow_tpu.deploy.manifests import (format_params_env,
+                                               params_env_path,
+                                               parse_params_env)
+    path = params_env_path(REPO)
+    params = parse_params_env(path.read_text())
+    params.update(pins)
+    path.write_text(format_params_env(params))
+    print(f"[release] stamped {', '.join(pins)} into {path}")
+
+
+def regenerate_manifests() -> None:
+    subprocess.run([sys.executable, str(REPO / "ci/generate_manifests.py")],
+                   check=True, cwd=REPO)
+
+
+def bundle(version: str, images: dict[str, dict]) -> Path:
+    dist = REPO / "dist"
+    dist.mkdir(exist_ok=True)
+    meta = {
+        "version": version,
+        "git_rev": git_rev(),
+        "built_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        # per-image provenance: ref, pinned_by (digest|tag), digest,
+        # digest_kind (registry | dockerfile-content-placeholder)
+        "images": images,
+    }
+    out = dist / f"kubeflow-tpu-{version}.tar.gz"
+    with tarfile.open(out, "w:gz") as tar:
+        tar.add(REPO / "config", arcname="kubeflow-tpu/config")
+        blob = json.dumps(meta, indent=1).encode()
+        info = tarfile.TarInfo("kubeflow-tpu/RELEASE.json")
+        info.size = len(blob)
+        tar.addfile(info, io.BytesIO(blob))
+    (dist / "RELEASE.json").write_text(json.dumps(meta, indent=1) + "\n")
+    print(f"[release] bundle: {out} ({out.stat().st_size} bytes)")
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--version", required=True,
+                    help="semver release version (e.g. 1.2.3)")
+    ap.add_argument("--registry", default="us-docker.pkg.dev/kubeflow-tpu",
+                    help="image registry prefix")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="skip container builds; pin by tag with "
+                         "deterministic placeholder digests")
+    ap.add_argument("--push", action="store_true",
+                    help="push images after building — REQUIRED for "
+                         "digest pinning (registry digests only exist "
+                         "after a push)")
+    ap.add_argument("--allow-missing-engine", action="store_true",
+                    help="fall back to dry-run pinning when neither docker "
+                         "nor podman is on PATH")
+    args = ap.parse_args()
+    version = args.version.lstrip("v")
+    if not VERSION_RE.match(version):
+        print(f"[release] invalid version {args.version!r} "
+              f"(want semver like 1.2.3)", file=sys.stderr)
+        return 2
+    engine = find_engine()
+    if engine is None and not (args.dry_run or args.allow_missing_engine):
+        print("[release] no docker/podman on PATH (use --dry-run or "
+              "--allow-missing-engine)", file=sys.stderr)
+        return 2
+
+    images: dict[str, dict] = {}
+    for key, (dockerfile, name) in IMAGES.items():
+        ref = f"{args.registry}/{name}:v{version}"
+        images[key] = build_image(engine, dockerfile, ref, args.dry_run,
+                                  push=args.push)
+    stamp_params_env({key: meta["ref"] for key, meta in images.items()})
+    regenerate_manifests()
+    bundle(version, images)
+    print(f"[release] v{version} complete")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -133,16 +133,63 @@ def notebook_crd() -> dict:
 
 # ------------------------------------------------------------------- manager
 
+def parse_params_env(text: str) -> dict[str, str]:
+    """THE params.env parser — shared with ci/release.py's stamping so the
+    two can never drift on format (comments skipped, key=value only)."""
+    out: dict[str, str] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, sep, value = line.partition("=")
+        if sep:
+            out[key.strip()] = value.strip()
+    return out
+
+
+def format_params_env(params: dict[str, str]) -> str:
+    return "".join(f"{key}={value}\n" for key, value in params.items())
+
+
+def params_env_path(repo_root: Path | None = None) -> Path:
+    root = repo_root or Path(__file__).resolve().parents[2]
+    return root / "config/manager/params.env"
+
+
+def _committed_image_pins() -> dict[str, str]:
+    """Image references already pinned in the committed params.env (the
+    release pipeline stamps digest-pinned refs there, ci/release.py). The
+    generator preserves them so `make manifests` / the drift gate never
+    silently un-pins a release — the reference's params.env works the same
+    way: committed pins are the source of truth, updated by its
+    image-updater workflows. A missing file is the bootstrap case (first
+    generation into a fresh tree) — any other read error must surface."""
+    path = params_env_path()
+    if not path.exists():
+        return {}
+    return parse_params_env(path.read_text())
+
+
 def params_env() -> str:
     """odh config/base/params.env analog: image + per-feature flags pinned in
-    one file, piped into the Deployment by kustomize replacements."""
-    return (
-        f"{MANAGER_IMAGE_PARAM}={DEFAULT_MANAGER_IMAGE}\n"
-        "tpu-notebook-image=us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest\n"
-        "auth-proxy-image=kube-rbac-proxy:latest\n"
-        "notebook-gateway-name=data-science-gateway\n"
-        "notebook-gateway-namespace=openshift-ingress\n"
-    )
+    one file, piped into the Deployment by kustomize replacements. Image
+    keys keep any committed (release-stamped) pin; everything else is
+    generator-owned."""
+    defaults = {
+        MANAGER_IMAGE_PARAM: DEFAULT_MANAGER_IMAGE,
+        "tpu-notebook-image":
+            "us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest",
+        "auth-proxy-image": "kube-rbac-proxy:latest",
+        "notebook-gateway-name": "data-science-gateway",
+        "notebook-gateway-namespace": "openshift-ingress",
+    }
+    image_keys = (MANAGER_IMAGE_PARAM, "tpu-notebook-image",
+                  "auth-proxy-image")
+    committed = _committed_image_pins()
+    merged = {key: committed.get(key, default) if key in image_keys
+              else default
+              for key, default in defaults.items()}
+    return "".join(f"{key}={value}\n" for key, value in merged.items())
 
 
 def culler_configmap() -> dict:

@@ -163,6 +163,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="bearer token required by --serve-apiserver "
                          "(env APISERVER_TOKEN also honored); TLS via "
                          "--cert-dir")
+    ap.add_argument("--otlp-endpoint", default=None, metavar="URL",
+                    help="export admission/controller spans as "
+                         "OTLP/HTTP JSON to this collector base URL "
+                         "(POSTs {URL}/v1/traces, like the reference's "
+                         "OTel webhook instrumentation); absent → the "
+                         "no-op provider")
     return ap
 
 
@@ -184,6 +190,13 @@ def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
     from .utils.logging import setup_logging
     setup_logging(debug=args.debug_log, fmt=args.log_format)
+
+    otlp = None
+    if args.otlp_endpoint:
+        from .utils import tracing
+        otlp = tracing.OtlpHttpExporter(args.otlp_endpoint)
+        tracing.set_provider(tracing.SDKProvider(otlp))
+        log.info("tracing: OTLP export to %s", args.otlp_endpoint)
 
     client = build_client_from_args(args)
     mgr, shutdown = build_manager(
@@ -235,6 +248,8 @@ def main(argv=None) -> int:
     if client is not None:
         client.close()
     mgr.stop()
+    if otlp is not None:
+        otlp.shutdown()  # final span flush to the collector
     return 0
 
 

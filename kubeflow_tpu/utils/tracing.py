@@ -113,6 +113,150 @@ class InMemorySpanExporter:
             self._spans.clear()
 
 
+class OtlpHttpExporter:
+    """Production exporter: OTLP/HTTP JSON to a collector endpoint, stdlib
+    only (the image carries no opentelemetry SDK). The reference's webhook
+    emits real OTel spans a collector can receive (odh
+    notebook_mutating_webhook.go:74-76); this is that wire format —
+    POST ``{endpoint}/v1/traces`` with an ExportTraceServiceRequest JSON
+    body (resourceSpans → scopeSpans → spans, ids as hex, times in unix
+    nanos).
+
+    Spans buffer and a daemon thread flushes them in batches (size- or
+    interval-triggered) so the admission hot path never blocks on the
+    collector; a dead collector drops batches with one rate-limited
+    stderr note, never an exception into the webhook."""
+
+    def __init__(self, endpoint: str, service_name: str = "kubeflow-tpu",
+                 timeout_s: float = 5.0, batch_size: int = 64,
+                 flush_interval_s: float = 2.0) -> None:
+        self.endpoint = endpoint.rstrip("/")
+        self.service_name = service_name
+        self.timeout_s = timeout_s
+        self.batch_size = batch_size
+        self.flush_interval_s = flush_interval_s
+        self._buf: list[Span] = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._closed = False
+        self._last_error_t = 0.0
+        self.exported_total = 0
+        self.failed_total = 0
+        self._thread = threading.Thread(target=self._flusher, daemon=True,
+                                        name="kubeflow-tpu-otlp")
+        self._thread.start()
+
+    # ------------------------------------------------------------- export
+    def export(self, span: Span) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._buf.append(span)
+            full = len(self._buf) >= self.batch_size
+        if full:
+            self._wake.set()
+
+    def force_flush(self) -> None:
+        self._flush()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+        self._wake.set()
+        # the flusher may be mid-POST (up to timeout_s) AND still owe the
+        # final flush (another timeout_s) — give it both before bailing
+        self._thread.join(timeout=2 * self.timeout_s + 1)
+        self._flush()
+
+    def _flusher(self) -> None:
+        while True:
+            self._wake.wait(self.flush_interval_s)
+            self._wake.clear()
+            with self._lock:
+                closed = self._closed
+            self._flush()
+            if closed:
+                return
+
+    def _flush(self) -> None:
+        with self._lock:
+            batch, self._buf = self._buf, []
+        if not batch:
+            return
+        import json
+        import urllib.request
+        body = json.dumps(self._encode(batch)).encode()
+        req = urllib.request.Request(
+            self.endpoint + "/v1/traces", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                pass
+            self.exported_total += len(batch)
+        except Exception as e:  # noqa: BLE001 — telemetry must never raise
+            self.failed_total += len(batch)
+            now = time.time()
+            if now - self._last_error_t > 30:
+                self._last_error_t = now
+                import sys
+                sys.stderr.write(
+                    f"otlp: export of {len(batch)} spans to "
+                    f"{self.endpoint} failed: {e}\n")
+
+    # ------------------------------------------------------------- encode
+    @staticmethod
+    def _attr_value(value: object) -> dict:
+        if isinstance(value, bool):
+            return {"boolValue": value}
+        if isinstance(value, int):
+            return {"intValue": str(value)}
+        if isinstance(value, float):
+            return {"doubleValue": value}
+        return {"stringValue": str(value)}
+
+    @classmethod
+    def _attrs(cls, attributes: dict) -> list[dict]:
+        return [{"key": k, "value": cls._attr_value(v)}
+                for k, v in attributes.items()]
+
+    def _encode(self, batch: list[Span]) -> dict:
+        by_tracer: dict[str, list[Span]] = {}
+        for span in batch:
+            by_tracer.setdefault(span.tracer, []).append(span)
+        status_code = {STATUS_UNSET: 0, STATUS_OK: 1, STATUS_ERROR: 2}
+        scope_spans = []
+        for tracer, spans in by_tracer.items():
+            scope_spans.append({
+                "scope": {"name": tracer},
+                "spans": [{
+                    "traceId": f"{span.trace_id:032x}",
+                    "spanId": f"{span.span_id:016x}",
+                    **({"parentSpanId": f"{span.parent_id:016x}"}
+                       if span.parent_id is not None else {}),
+                    "name": span.name,
+                    "kind": 1,  # SPAN_KIND_INTERNAL
+                    "startTimeUnixNano": str(int(span.start_time * 1e9)),
+                    "endTimeUnixNano": str(int(span.end_time * 1e9)),
+                    "attributes": self._attrs(span.attributes),
+                    "events": [{
+                        "timeUnixNano": str(int(ev.timestamp * 1e9)),
+                        "name": ev.name,
+                        "attributes": self._attrs(ev.attributes),
+                    } for ev in span.events],
+                    "status": {
+                        "code": status_code.get(span.status, 0),
+                        **({"message": span.status_description}
+                           if span.status_description else {}),
+                    },
+                } for span in spans],
+            })
+        return {"resourceSpans": [{
+            "resource": {"attributes": self._attrs(
+                {"service.name": self.service_name})},
+            "scopeSpans": scope_spans,
+        }]}
+
+
 class NoopProvider:
     recording = False
 
@@ -124,11 +268,14 @@ class NoopProvider:
 
 class SDKProvider:
     """Recording provider: spans export on end, parentage via a context stack
-    (thread-local, like OTel context propagation)."""
+    (thread-local, like OTel context propagation). ``exporter`` is anything
+    with ``export(span)`` — the in-memory test exporter or the production
+    OTLP/HTTP one."""
 
     recording = True
 
-    def __init__(self, exporter: InMemorySpanExporter) -> None:
+    def __init__(self, exporter: InMemorySpanExporter | OtlpHttpExporter) \
+            -> None:
         self.exporter = exporter
         self._local = threading.local()
         self._lock = threading.Lock()

@@ -1,0 +1,148 @@
+"""OTLP/HTTP span export (VERDICT r2 missing #4 / ask #7).
+
+The verdict's done-criteria: spans from one admission visible in a
+captured OTLP POST. A local HTTP server plays the collector; the webhook
+runs a real mutating admission with the OTLP exporter installed; the
+captured request body must be a valid ExportTraceServiceRequest carrying
+the admission root span.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.utils import tracing
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.webhook import NotebookMutatingWebhook
+
+
+@pytest.fixture()
+def collector():
+    """Minimal OTLP collector: captures POST bodies to /v1/traces."""
+    received: list[dict] = []
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            body = self.rfile.read(int(self.headers["Content-Length"]))
+            received.append({"path": self.path,
+                             "content_type": self.headers["Content-Type"],
+                             "body": json.loads(body)})
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.end_headers()
+            self.wfile.write(b"{}")
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = HTTPServer(("127.0.0.1", 0), Handler)
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        yield f"http://127.0.0.1:{srv.server_port}", received
+    finally:
+        srv.shutdown()
+        tracing.set_provider(tracing.NoopProvider())
+
+
+def _find_spans(received, name=None):
+    spans = []
+    for req in received:
+        for rs in req["body"]["resourceSpans"]:
+            for ss in rs["scopeSpans"]:
+                for span in ss["spans"]:
+                    if name is None or span["name"] == name:
+                        spans.append((rs, ss, span))
+    return spans
+
+
+def test_admission_span_lands_in_captured_otlp_post(collector):
+    url, received = collector
+    exporter = tracing.OtlpHttpExporter(url, service_name="kubeflow-tpu",
+                                        flush_interval_s=0.1)
+    tracing.set_provider(tracing.SDKProvider(exporter))
+    store = ClusterStore()
+    api.install_notebook_crd(store)
+    webhook = NotebookMutatingWebhook(store, ControllerConfig())
+    nb = api.new_notebook("traced-nb", "ns1")
+    webhook.handle("CREATE", nb, None)
+    exporter.force_flush()
+
+    assert received, "collector received no POST"
+    assert received[0]["path"] == "/v1/traces"
+    assert received[0]["content_type"] == "application/json"
+    matches = _find_spans(received, "notebook-mutating-webhook")
+    assert matches, "admission root span missing from OTLP payload"
+    rs, ss, span = matches[0]
+    # resource carries the service name
+    res_attrs = {a["key"]: a["value"] for a in rs["resource"]["attributes"]}
+    assert res_attrs["service.name"] == {"stringValue": "kubeflow-tpu"}
+    assert ss["scope"]["name"] == "kubeflow_tpu.webhook"
+    attrs = {a["key"]: a["value"] for a in span["attributes"]}
+    assert attrs["notebook.name"] == {"stringValue": "traced-nb"}
+    assert attrs["notebook.namespace"] == {"stringValue": "ns1"}
+    assert attrs["admission.operation"] == {"stringValue": "CREATE"}
+    # OTLP shape essentials: hex ids, nano timestamps, status code
+    assert len(span["traceId"]) == 32 and len(span["spanId"]) == 16
+    assert int(span["endTimeUnixNano"]) >= int(span["startTimeUnixNano"])
+    assert span["status"]["code"] in (0, 1, 2)
+
+
+def test_child_spans_share_trace_and_parent(collector):
+    url, received = collector
+    exporter = tracing.OtlpHttpExporter(url, flush_interval_s=0.1)
+    tracing.set_provider(tracing.SDKProvider(exporter))
+    tracer = tracing.get_tracer("t")
+    with tracer.start_span("root"):
+        with tracer.start_span("child") as child:
+            child.add_event("evt", {"k": "v", "n": 3, "ok": True})
+    exporter.force_flush()
+    (_, _, root) = _find_spans(received, "root")[0]
+    (_, _, child) = _find_spans(received, "child")[0]
+    assert child["traceId"] == root["traceId"]
+    assert child["parentSpanId"] == root["spanId"]
+    ev = child["events"][0]
+    ev_attrs = {a["key"]: a["value"] for a in ev["attributes"]}
+    assert ev_attrs == {"k": {"stringValue": "v"}, "n": {"intValue": "3"},
+                        "ok": {"boolValue": True}}
+
+
+def test_dead_collector_never_raises_into_the_hot_path():
+    exporter = tracing.OtlpHttpExporter("http://127.0.0.1:1",  # nothing there
+                                        timeout_s=0.2, flush_interval_s=0.05)
+    tracing.set_provider(tracing.SDKProvider(exporter))
+    try:
+        tracer = tracing.get_tracer("t")
+        for _ in range(5):
+            with tracer.start_span("s"):
+                pass
+        exporter.force_flush()  # swallows the connection error
+        assert exporter.failed_total >= 1
+        assert exporter.exported_total == 0
+    finally:
+        tracing.set_provider(tracing.NoopProvider())
+        exporter.shutdown()
+
+
+def test_batching_flushes_on_size(collector):
+    url, received = collector
+    exporter = tracing.OtlpHttpExporter(url, batch_size=3,
+                                        flush_interval_s=60.0)
+    tracing.set_provider(tracing.SDKProvider(exporter))
+    tracer = tracing.get_tracer("t")
+    for i in range(3):
+        with tracer.start_span(f"s{i}"):
+            pass
+    deadline = threading.Event()
+    for _ in range(100):
+        if received:
+            break
+        deadline.wait(0.05)
+    assert received, "size-triggered flush never fired"
+    assert len(_find_spans(received)) == 3

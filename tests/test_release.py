@@ -1,0 +1,121 @@
+"""Release pipeline (VERDICT r2 missing #2 / ask #6): dry-runnable
+``make release VERSION=x`` — images pinned into params.env, manifests
+regenerated without drift, versioned kustomize bundle with provenance.
+Run against a COPY of the repo's config tree so the working tree stays
+untouched."""
+
+from __future__ import annotations
+
+import json
+import shutil
+import subprocess
+import sys
+import tarfile
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def repo_copy(tmp_path):
+    """Minimal repo clone: the files release.py touches."""
+    for rel in ("ci", "images", "config", "kubeflow_tpu", "Makefile"):
+        src = REPO / rel
+        if src.is_dir():
+            shutil.copytree(src, tmp_path / rel,
+                            ignore=shutil.ignore_patterns("__pycache__"))
+        else:
+            shutil.copy(src, tmp_path / rel)
+    return tmp_path
+
+
+def _run_release(repo, *extra):
+    return subprocess.run(
+        [sys.executable, "ci/release.py", "--version", "1.2.3",
+         "--dry-run", *extra],
+        cwd=repo, capture_output=True, text=True)
+
+
+def test_dry_run_release_end_to_end(repo_copy):
+    r = _run_release(repo_copy)
+    assert r.returncode == 0, r.stderr + r.stdout
+    # params.env pinned to the release tags, non-image params untouched
+    params = dict(
+        line.split("=", 1)
+        for line in (repo_copy / "config/manager/params.env")
+        .read_text().splitlines())
+    assert params["kubeflow-tpu-notebook-controller"].endswith(
+        "/notebook-controller:v1.2.3")
+    assert params["tpu-notebook-image"].endswith("/jax-notebook:v1.2.3")
+    assert params["notebook-gateway-name"] == "data-science-gateway"
+    # regenerated manifests keep the pin (pin-preserving generator) —
+    # the drift gate must pass on the pinned tree
+    check = subprocess.run(
+        [sys.executable, "ci/generate_manifests.py", "--check"],
+        cwd=repo_copy, capture_output=True, text=True)
+    assert check.returncode == 0, check.stdout + check.stderr
+    # bundle exists with config tree + provenance
+    bundle = repo_copy / "dist/kubeflow-tpu-1.2.3.tar.gz"
+    assert bundle.exists()
+    with tarfile.open(bundle) as tar:
+        names = tar.getnames()
+        assert "kubeflow-tpu/RELEASE.json" in names
+        assert any(n.endswith("kubeflow.org_notebooks.yaml")
+                   for n in names)
+        meta = json.load(tar.extractfile("kubeflow-tpu/RELEASE.json"))
+    assert meta["version"] == "1.2.3"
+    assert set(meta["images"]) == {"kubeflow-tpu-notebook-controller",
+                                   "tpu-notebook-image"}
+    # dry-run provenance must be HONEST: tag-pinned with placeholder
+    # digests explicitly marked as such, never fake registry digests
+    for img in meta["images"].values():
+        assert img["pinned_by"] == "tag"
+        assert img["digest_kind"] == "dockerfile-content-placeholder"
+        assert img["digest"].startswith("sha256:")
+
+
+def test_release_is_idempotent(repo_copy):
+    assert _run_release(repo_copy).returncode == 0
+    first = (repo_copy / "config/manager/params.env").read_text()
+    assert _run_release(repo_copy).returncode == 0
+    assert (repo_copy / "config/manager/params.env").read_text() == first
+
+
+def test_release_rejects_bad_version(repo_copy):
+    r = subprocess.run(
+        [sys.executable, "ci/release.py", "--version", "not-a-version",
+         "--dry-run"], cwd=repo_copy, capture_output=True, text=True)
+    assert r.returncode == 2
+    assert "invalid version" in r.stderr
+
+
+def test_release_version_bump_repins(repo_copy):
+    assert _run_release(repo_copy).returncode == 0
+    r = subprocess.run(
+        [sys.executable, "ci/release.py", "--version", "2.0.0", "--dry-run"],
+        cwd=repo_copy, capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    params = (repo_copy / "config/manager/params.env").read_text()
+    assert ":v2.0.0" in params and ":v1.2.3" not in params
+
+
+def test_workflow_runs_the_same_entrypoint():
+    wf = (REPO / ".github/workflows/release.yaml").read_text()
+    assert "ci/release.py" in wf
+    assert "tags:" in wf
+    assert "generate_manifests.py --check" in wf  # drift gate post-pin
+    assert "--push" in wf  # digest pinning requires push-before-inspect
+
+
+def test_missing_engine_requires_explicit_opt_in(repo_copy, monkeypatch):
+    """Without docker/podman, a FULL release must fail loudly — never
+    silently degrade to placeholder pinning (that ships manifests
+    referencing images that were never built)."""
+    r = subprocess.run(
+        [sys.executable, "ci/release.py", "--version", "1.2.3"],
+        cwd=repo_copy, capture_output=True, text=True,
+        env={"PATH": "/nonexistent"})
+    assert r.returncode == 2
+    assert "no docker/podman" in r.stderr
