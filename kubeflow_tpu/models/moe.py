@@ -33,8 +33,8 @@ from ..parallel.sharding import (PartitionRules, batch_sharding,
                                  param_shardings)
 from .quant import wcast
 from .transformer import (TransformerConfig, attention_block,
-                          resolve_layer_remat, rms_norm, rope_frequencies,
-                          tag_attn_out)
+                          lm_head_logits, resolve_layer_remat, rms_norm,
+                          rope_frequencies, tag_attn_out)
 
 
 @dataclass(frozen=True)
@@ -242,30 +242,101 @@ def moe_forward_hidden(params: dict, tokens: jax.Array, config: MoEConfig,
     return rms_norm(x, params["final_norm"]), aux / c.n_layers
 
 
+def pipelined_moe_forward_hidden(params: dict, tokens: jax.Array,
+                                 config: MoEConfig, mesh: Mesh,
+                                 n_microbatches: int):
+    """MoE forward with the layer stack pipelined over ``pp`` — the MoE
+    counterpart of transformer.pipelined_forward. The stage activation is
+    a PYTREE {x, aux}: the router load-balance loss accumulates per
+    microbatch as it traverses the stages (pipeline_apply carries pytrees
+    leaf-by-leaf through the ppermute ring). The expert all-to-all stays
+    a GSPMD auto-axis collective inside the pp-manual region: ep is NOT a
+    manual axis, so moe_mlp_block's with_sharding_constraint over ep
+    works unchanged per stage. pp x sp for MoE is not supported (the
+    pytree activation shares one act_spec)."""
+    from ..parallel.pipeline import pipeline_apply, split_stages
+
+    c = config
+    if mesh.shape.get("sp", 1) > 1:
+        raise NotImplementedError("MoE + pp + sp not supported; "
+                                  "use pp x ep x tp (+dp/fsdp)")
+    # Routing must be MICROBATCH-INVARIANT: groups/capacity are computed
+    # from the local token set, so if microbatching changes the effective
+    # group size, the same config would train differently on a pp mesh
+    # than off it (different overflow drops, different aux statistics) —
+    # with n_microbatches, a pure-parallelism knob, silently steering the
+    # loss. Demand group sizes that agree and fail loudly otherwise.
+    B, S = tokens.shape
+    mb = B // n_microbatches
+    g_full = (B * S) // num_route_groups(B * S, c.route_group_size)
+    g_micro = (mb * S) // num_route_groups(mb * S, c.route_group_size)
+    if g_full != g_micro:
+        raise ValueError(
+            f"pipelined MoE routing would not be microbatch-invariant: "
+            f"effective group size {g_full} (full batch) vs {g_micro} "
+            f"(microbatch of {mb}x{S} tokens). Pick route_group_size so "
+            f"groups align within one microbatch — e.g. "
+            f"route_group_size=seq_len ({S}) routes per sequence on any "
+            f"mesh.")
+    x = params["embed"].astype(c.compute_dtype)[tokens]
+    positions = jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :]
+    cos, sin = rope_frequencies(c, positions)
+    stages = split_stages(params["blocks"], mesh.shape["pp"])
+
+    expert_mlp = (jax.checkpoint(
+        lambda x, layer: moe_mlp_block(x, layer, c, mesh=mesh))
+        if c.remat == "mlp"
+        else (lambda x, layer: moe_mlp_block(x, layer, c, mesh=mesh)))
+
+    def stage_fn(stage_layers, act, cos, sin):
+        def body(carry, layer):
+            h, aux = carry
+            h = attention_block(h, layer, c, cos, sin, mesh=None)
+            h = tag_attn_out(h)
+            h, layer_aux = expert_mlp(h, layer)
+            return (h, aux + layer_aux), None
+        body_fn = resolve_layer_remat(c, body)
+        (h, aux), _ = lax.scan(body_fn, (act["x"], act["aux"]),
+                               stage_layers)
+        return {"x": h, "aux": aux}
+
+    B = tokens.shape[0]
+    act = {"x": x, "aux": jnp.zeros((B, 1), jnp.float32)}
+    out = pipeline_apply(stages, act, stage_fn, mesh=mesh,
+                         n_microbatches=n_microbatches,
+                         extra_args=(cos, sin), extra_specs=(P(), P()))
+    # per-microbatch scalar aux rode row 0 of each (mb, 1) leaf slice; it
+    # is identical across a microbatch's rows by construction (the scan
+    # adds the same layer_aux scalar) — mean over batch recovers it
+    aux = out["aux"].mean() / c.n_layers
+    return rms_norm(out["x"], params["final_norm"]), aux
+
+
 def moe_forward(params: dict, tokens: jax.Array, config: MoEConfig,
                 mesh: Mesh | None = None,
                 positions: jax.Array | None = None):
     """tokens (batch, seq) → (logits (b, s, vocab) f32, aux_loss scalar)."""
     x, aux = moe_forward_hidden(params, tokens, config, mesh=mesh,
                                 positions=positions)
-    logits = jnp.einsum("bsd,dv->bsv", x, wcast(params["lm_head"], x.dtype)
-                        ).astype(jnp.float32)
-    return logits, aux
+    return lm_head_logits(x, params["lm_head"]), aux
 
 
 # ----------------------------------------------------------------- training
 def moe_loss_fn(params, tokens, targets, config: MoEConfig, mesh=None,
-                ce_chunk_tokens: int = 0):
+                ce_chunk_tokens: int = 0, hidden_impl=None):
     """Next-token CE + router load-balance aux. ``ce_chunk_tokens`` > 0
     switches to the fused chunked CE (train.chunked_softmax_ce) so long
-    contexts never materialize the full logits tensor."""
+    contexts never materialize the full logits tensor. ``hidden_impl``
+    swaps the forward (the pipelined stack for pp meshes); default is the
+    scanned ``moe_forward_hidden``."""
+    hidden_impl = hidden_impl or moe_forward_hidden
+    x, aux = hidden_impl(params, tokens, config, mesh=mesh)
     if ce_chunk_tokens:
         from .train import chunked_softmax_ce
-        x, aux = moe_forward_hidden(params, tokens, config, mesh=mesh)
         ce = chunked_softmax_ce(x, params["lm_head"], targets,
                                 ce_chunk_tokens)
         return ce + config.router_aux_coef * aux
-    logits, aux = moe_forward(params, tokens, config, mesh=mesh)
+    logits = lm_head_logits(x, params["lm_head"])
     valid = targets >= 0
     safe_targets = jnp.where(valid, targets, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -278,17 +349,26 @@ def moe_loss_fn(params, tokens, targets, config: MoEConfig, mesh=None,
 
 def make_sharded_moe_train_step(mesh: Mesh, config: MoEConfig,
                                 tc=None, rules: PartitionRules | None = None,
-                                accum_steps: int = 1):
-    """(init_fn, step_fn) jitted over ``mesh`` with dp/fsdp/tp/sp/ep
-    shardings — the MoE counterpart of train.make_sharded_train_step (which
-    documents the opt-state sharding scheme and the accum_steps microbatch
-    contract; pp is a dense-model feature)."""
+                                accum_steps: int = 1,
+                                n_microbatches: int | None = None):
+    """(init_fn, step_fn) jitted over ``mesh`` with dp/fsdp/tp/sp/ep/pp
+    shardings — the MoE counterpart of train.make_sharded_train_step
+    (which documents the opt-state sharding scheme and the accum_steps
+    microbatch contract). With pp>1 the layer stack shards over pp and
+    the forward pipelines (pipelined_moe_forward_hidden); the expert
+    all-to-all stays an auto-axis collective inside each stage."""
     from .train import (TrainConfig, accumulated_value_and_grad,
-                        apply_update, make_optimizer, opt_state_shardings)
+                        apply_update, make_optimizer, opt_state_shardings,
+                        pipeline_rules)
 
-    if mesh.shape.get("pp", 1) > 1:
-        raise NotImplementedError("MoE + pipeline parallelism not supported; "
-                                  "use dp/fsdp/tp/sp/ep meshes")
+    pp = mesh.shape.get("pp", 1)
+    hidden_impl = None
+    if pp > 1:
+        rules = rules or pipeline_rules()
+        n_micro = n_microbatches or 2 * pp
+
+        def hidden_impl(p, t, c, mesh=mesh):
+            return pipelined_moe_forward_hidden(p, t, c, mesh, n_micro)
     tc = tc or TrainConfig()
     rules = rules or PartitionRules()
     optimizer = make_optimizer(tc)
@@ -307,7 +387,8 @@ def make_sharded_moe_train_step(mesh: Mesh, config: MoEConfig,
     def step_loss(p, t, tg):
         from .train import ce_chunk_for  # one shared engagement policy
         chunk = ce_chunk_for(tc, t, config.vocab_size)
-        return moe_loss_fn(p, t, tg, config, mesh, ce_chunk_tokens=chunk)
+        return moe_loss_fn(p, t, tg, config, mesh, ce_chunk_tokens=chunk,
+                           hidden_impl=hidden_impl)
 
     @partial(jax.jit,
              in_shardings=(p_shardings, opt_shardings, batch_sh, batch_sh),

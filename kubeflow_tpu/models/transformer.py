@@ -367,12 +367,19 @@ def forward_hidden(params: dict, tokens: jax.Array,
     return rms_norm(x, params["final_norm"])
 
 
+def lm_head_logits(x: jax.Array, lm_head) -> jax.Array:
+    """THE final projection: (b, s, d) hidden → (b, s, vocab) f32 logits.
+    One definition shared by every forward path (dense, pipelined, MoE) —
+    the f32 cast here is what CE numerics depend on."""
+    return jnp.einsum("bsd,dv->bsv", x, wcast(lm_head, x.dtype)
+                      ).astype(jnp.float32)
+
+
 def forward(params: dict, tokens: jax.Array, config: TransformerConfig,
             mesh=None, positions: jax.Array | None = None) -> jax.Array:
     """tokens: (batch, seq) int32 → logits (batch, seq, vocab) float32."""
     x = forward_hidden(params, tokens, config, mesh=mesh, positions=positions)
-    return jnp.einsum("bsd,dv->bsv", x, wcast(params["lm_head"], x.dtype)
-                      ).astype(jnp.float32)
+    return lm_head_logits(x, params["lm_head"])
 
 
 def pipelined_forward(params: dict, tokens: jax.Array,
@@ -427,8 +434,7 @@ def pipelined_forward(params: dict, tokens: jax.Array,
                            n_microbatches=n_microbatches,
                            extra_args=(cos, sin), extra_specs=(P(), P()))
     x = rms_norm(x, params["final_norm"])
-    return jnp.einsum("bsd,dv->bsv", x, wcast(params["lm_head"], x.dtype)
-                      ).astype(jnp.float32)
+    return lm_head_logits(x, params["lm_head"])
 
 
 def count_params(params) -> int:

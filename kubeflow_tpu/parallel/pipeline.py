@@ -54,24 +54,33 @@ def pipeline_apply(stage_params, x: jax.Array, stage_fn, *, mesh: Mesh,
     sequence-parallel stage bodies (ring attention via bare ppermute over
     sp, see models/transformer.pipelined_forward). ``extra_args`` are
     broadcast to every tick (e.g. RoPE tables), split per
-    ``extra_specs``."""
+    ``extra_specs``.
+
+    ``x`` may be a PYTREE of (batch, ...) arrays — e.g. the MoE stage
+    carries {activation, per-microbatch aux-loss accumulator}; every leaf
+    hops the ring together. ``act_spec`` applies to every leaf (ranks
+    permitting), so pytree activations compose with pp but not (yet)
+    with a sequence-sharded act_spec."""
     # NOTE: partial-manual shard_map (axis_names={'pp', ...}) requires a
     # jit context — call this from inside jit (the train step always is).
     n_stages = mesh.shape["pp"]
     if n_stages == 1:
         params0 = jax.tree.map(lambda p: p[0], stage_params)
         return stage_fn(params0, x, *extra_args)
-    batch = x.shape[0]
+    leaves = jax.tree.leaves(x)
+    batch = leaves[0].shape[0]
     if batch % n_microbatches:
         raise ValueError(f"batch {batch} not divisible by "
                          f"{n_microbatches} microbatches")
     mb = batch // n_microbatches
-    micro = x.reshape(n_microbatches, mb, *x.shape[1:])
+    micro = jax.tree.map(
+        lambda a: a.reshape(n_microbatches, mb, *a.shape[1:]), x)
     micro_spec = P(None, *act_spec)  # leading microbatch axis: unsharded
+    micro_specs = jax.tree.map(lambda _: micro_spec, x)
 
     @partial(shard_map, mesh=mesh, axis_names=set(manual_axes),
-             in_specs=(P("pp"), micro_spec, *extra_specs),
-             out_specs=micro_spec, check_vma=False)
+             in_specs=(P("pp"), micro_specs, *extra_specs),
+             out_specs=micro_specs, check_vma=False)
     def run(params_local, micro_all, *extra):
         # params_local leaves: (1, L/S, ...) — drop the sharded stage axis
         params_local = jax.tree.map(lambda p: p[0], params_local)
@@ -80,26 +89,33 @@ def pipeline_apply(stage_params, x: jax.Array, stage_fn, *, mesh: Mesh,
         ticks = n_microbatches + n_stages - 1
         perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
-        state = jnp.zeros_like(micro_all[0])
-        out_buf = jnp.zeros_like(micro_all)
+        state = jax.tree.map(lambda m: jnp.zeros_like(m[0]), micro_all)
+        out_buf = jax.tree.map(jnp.zeros_like, micro_all)
 
         def tick(t, carry):
             state, out_buf = carry
             in_idx = jnp.clip(t, 0, n_microbatches - 1)
-            inp = jnp.where(stage == 0, micro_all[in_idx], state)
+            inp = jax.tree.map(
+                lambda m, s: jnp.where(stage == 0, m[in_idx], s),
+                micro_all, state)
             out = stage_fn(params_local, inp, *extra)
             out_idx = t - last
-            written = out_buf.at[jnp.clip(out_idx, 0, n_microbatches - 1)
-                                 ].set(out)
+            safe_idx = jnp.clip(out_idx, 0, n_microbatches - 1)
             take = jnp.logical_and(stage == last, out_idx >= 0)
-            out_buf = jnp.where(take, written, out_buf)
-            state = lax.ppermute(out, "pp", perm)
+            out_buf = jax.tree.map(
+                lambda buf, o: jnp.where(take, buf.at[safe_idx].set(o),
+                                         buf),
+                out_buf, out)
+            state = jax.tree.map(lambda o: lax.ppermute(o, "pp", perm), out)
             return state, out_buf
 
         _, out_buf = lax.fori_loop(0, ticks, tick, (state, out_buf),
                                    unroll=False)
         # replicate the last stage's result to every pp rank
-        return lax.psum(jnp.where(stage == last, out_buf, 0.0), "pp")
+        return jax.tree.map(
+            lambda buf: lax.psum(jnp.where(stage == last, buf, 0.0), "pp"),
+            out_buf)
 
     y = run(stage_params, micro, *extra_args)
-    return y.reshape(batch, *x.shape[1:])
+    return jax.tree.map(
+        lambda buf, orig: buf.reshape(batch, *orig.shape[1:]), y, x)

@@ -296,9 +296,12 @@ def test_manager_recovers_from_apiserver_outage(config, monkeypatch):
     mgr, _ = build_manager(store=client, config=config)
     mgr.start()
     try:
+        # 120s ceilings: under extreme CPU contention (parallel suite +
+        # jax imports elsewhere on the box) the default 30s has flaked —
+        # same hardening the sibling over-HTTP test carries
         store.create(notebook("nb-before"))
         wait_for(lambda: store.get_or_none("Pod", "default", "nb-before-0"),
-                 msg="baseline reconcile over HTTP")
+                 timeout=120, msg="baseline reconcile over HTTP")
         proxy.stop()  # apiserver outage
         store.create(notebook("nb-during"))  # work arrives during the outage
         time.sleep(1.0)
@@ -306,6 +309,7 @@ def test_manager_recovers_from_apiserver_outage(config, monkeypatch):
         proxy = ApiServerProxy(store, port=port)
         proxy.start()  # apiserver returns on the same endpoint
         wait_for(lambda: store.get_or_none("Pod", "default", "nb-during-0"),
+                 timeout=120,
                  msg="outage-time notebook reconciled after recovery")
     finally:
         client.close()

@@ -115,12 +115,20 @@ def test_ep_sharded_train_step():
     assert not jnp.allclose(before, jax.device_get(params["blocks"]["router"]))
 
 
-def test_moe_rejects_pipeline_mesh():
+def test_moe_rejects_pp_with_sp():
+    """pp is supported for MoE (pipelined_moe_forward_hidden); the one
+    remaining unsupported composition is pp x sp (the pytree activation
+    shares a single act_spec) — and that must fail loudly, not silently
+    compute wrong attention over a sequence shard."""
+    from kubeflow_tpu.models.moe import pipelined_moe_forward_hidden
     cfg = tiny_config()
-    mesh = build_mesh(MeshConfig.auto(8, pp=2, tp=2),
+    mesh = build_mesh(MeshConfig(pp=2, sp=2, dp=2),
                       devices=jax.devices()[:8])
+    params = init_moe_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((4, 16), jnp.int32)
     with pytest.raises(NotImplementedError):
-        make_sharded_moe_train_step(mesh, cfg)
+        pipelined_moe_forward_hidden(params, tokens, cfg, mesh,
+                                     n_microbatches=2)
 
 
 def test_grouped_routing_memory_is_linear_in_tokens():
@@ -182,3 +190,86 @@ def test_moe_remat_policies_match():
         np.testing.assert_allclose(np.asarray(logits),
                                    np.asarray(base_logits), rtol=1e-6)
         np.testing.assert_allclose(float(aux), float(base_aux), rtol=1e-6)
+
+
+def test_pipelined_moe_matches_unsharded():
+    """MoE + pipeline parallelism (removes the round-2 documented
+    constraint): pipelined hidden states AND the aux loss must match the
+    scanned stack, values and gradients — the pytree activation (x, aux
+    accumulator) hops the ppermute ring together. route_group_size=seq
+    pins routing groups to sequence boundaries so microbatching cannot
+    change group membership."""
+    import numpy as np
+
+    from kubeflow_tpu.models.moe import (moe_forward_hidden,
+                                         pipelined_moe_forward_hidden)
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    seq = 16
+    cfg = tiny_config(n_layers=4, route_group_size=seq,
+                      capacity_factor=4.0)
+    params = init_moe_params(jax.random.key(0), cfg)
+    tokens = jax.random.randint(jax.random.key(1), (4, seq), 0,
+                                cfg.vocab_size)
+    mesh = build_mesh(MeshConfig(pp=2, ep=2, dp=2))
+    w = jax.random.normal(jax.random.key(2), (4, seq, cfg.d_model))
+
+    def loss_ref(p):
+        x, aux = moe_forward_hidden(p, tokens, cfg)
+        return jnp.sum(x * w) + aux
+
+    def loss_pp(p):
+        x, aux = pipelined_moe_forward_hidden(p, tokens, cfg, mesh,
+                                              n_microbatches=2)
+        return jnp.sum(x * w) + aux
+
+    val_ref, g_ref = jax.value_and_grad(loss_ref)(params)
+    val_pp, g_pp = jax.jit(jax.value_and_grad(loss_pp))(params)
+    np.testing.assert_allclose(float(val_pp), float(val_ref),
+                               rtol=2e-4, atol=2e-4)
+    for a, b in zip(jax.tree.leaves(g_pp), jax.tree.leaves(g_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=3e-4, atol=3e-4)
+
+
+def test_moe_pp_train_step_runs():
+    import numpy as np
+
+    from kubeflow_tpu.models.moe import make_sharded_moe_train_step
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = tiny_config(n_layers=2, route_group_size=16)
+    mesh = build_mesh(MeshConfig(pp=2, ep=2, tp=2))
+    init_fn, step_fn = make_sharded_moe_train_step(mesh, cfg)
+    params, opt = init_fn(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0,
+                                cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    losses = []
+    for _ in range(3):
+        params, opt, loss = step_fn(params, opt, tokens, targets)
+        losses.append(float(loss))
+    assert all(np.isfinite(ls) for ls in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_pipelined_moe_guards_microbatch_variant_routing():
+    """A route_group_size whose effective group differs between the full
+    batch and a microbatch must fail loudly — n_microbatches is a
+    parallelism knob and must never silently change training semantics."""
+    from kubeflow_tpu.models.moe import pipelined_moe_forward_hidden
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    cfg = tiny_config(route_group_size=64)  # groups span sequences (S=16)
+    params = init_moe_params(jax.random.key(0), cfg)
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    mesh = build_mesh(MeshConfig(pp=2, dp=4))
+    with pytest.raises(ValueError, match="microbatch-invariant"):
+        pipelined_moe_forward_hidden(params, tokens, cfg, mesh,
+                                     n_microbatches=2)
