@@ -85,6 +85,20 @@ class TrainCheckpointer:
                 opt_state=ocp.args.StandardRestore(abstract_opt_state)))
         return step, out["params"], out["opt_state"]
 
+    def restore_params(self, abstract_params, step: int | None = None):
+        """Params-only restore from the same layout (serving does not
+        carry optimizer state — runtime/server.py). Returns (step, params)
+        or None when no checkpoint exists."""
+        if step is None:
+            step = self._mngr.latest_step()
+        if step is None or step not in self._mngr.all_steps():
+            return None
+        out = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(abstract_params)))
+        return step, out["params"]
+
     # -------------------------------------------------------------- lifecycle
     def all_steps(self) -> list[int]:
         return sorted(self._mngr.all_steps())

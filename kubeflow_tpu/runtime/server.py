@@ -1,0 +1,257 @@
+"""HTTP serving endpoint for the generation engines.
+
+The last mile of the serving story: a provisioned notebook that serves
+its model needs a wire protocol, not just a Python API. This is a
+stdlib-only JSON-over-HTTP server in the shape such endpoints take:
+
+    POST /v1/generate   {"prompt": [ids...], "max_new_tokens": N,
+                         "temperature": t, "top_k": k, "top_p": p}
+                      → {"ids": [ids...]}
+    GET  /healthz       liveness + engine stats (what the culler's
+                        activity probe and the auth sidecar front)
+    GET  /v1/models     the serving configuration (model shape, engine,
+                        quantization), for client capability discovery
+
+Backed by either generator (``ContinuousBatchedGenerator`` by default —
+a serving endpoint lives on continuous batching; ``BatchedGenerator``
+for phased/templated load). Requests block on the engine future, so the
+HTTP layer is a ThreadingHTTPServer: one thread per in-flight request,
+all batching intelligence stays in the engine.
+
+Run standalone (inside the provisioned container):
+
+    python -m kubeflow_tpu.runtime.server --config model.json \
+        --checkpoint /ckpt --port 8890 --kv-quant --quantize
+
+The reference has no model code (SURVEY §2d) — this is part of the TPU
+workload layer its Jupyter images leave to the user.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+log = logging.getLogger("kubeflow_tpu.serving_server")
+
+MAX_BODY_BYTES = 8 << 20  # an 8 MB prompt is a client error, not an OOM
+
+
+class ServingServer:
+    """HTTP front for a generation engine. ``generator`` is either
+    engine class (both expose submit/generate_sync/close)."""
+
+    def __init__(self, generator, config, *, host: str = "127.0.0.1",
+                 port: int = 8890, request_timeout_s: float = 300.0):
+        self.generator = generator
+        self.config = config
+        self.request_timeout_s = request_timeout_s
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                log.debug("http: " + fmt, *args)
+
+            def _json(self, code: int, payload: dict) -> None:
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._json(200, server.health())
+                elif self.path == "/v1/models":
+                    self._json(200, server.model_info())
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/v1/generate":
+                    self._json(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    if length < 0 or length > MAX_BODY_BYTES:
+                        # a negative (lying) Content-Length must not reach
+                        # rfile.read(-1) — that reads until EOF, unbounded
+                        self._json(413, {"error": "invalid request size"})
+                        return
+                    req = json.loads(self.rfile.read(length))
+                    out = server.generate(req)
+                    self._json(200, out)
+                except (ValueError, KeyError, TypeError) as e:
+                    self._json(400, {"error": str(e)})
+                except TimeoutError:
+                    self._json(504, {"error": "generation timed out"})
+                except RuntimeError as e:  # engine closed mid-request
+                    self._json(503, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 — an engine error
+                    # (e.g. XLA OOM) must surface as a JSON 500, not a
+                    # dropped connection with a server-side traceback
+                    log.exception("generate failed")
+                    self._json(500, {"error":
+                                     f"{type(e).__name__}: {e}"})
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self._started = False
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name="kubeflow-tpu-serving-http")
+
+    # ------------------------------------------------------------ lifecycle
+    @property
+    def port(self) -> int:
+        return self._httpd.server_port
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ServingServer":
+        self._started = True
+        self._thread.start()
+        log.info("serving endpoint on %s", self.url)
+        return self
+
+    def stop(self) -> None:
+        if self._started:
+            # shutdown() waits on an event only serve_forever() sets —
+            # calling it on a never-started server would block forever
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        self.generator.close()
+
+    def __enter__(self) -> "ServingServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------- handlers
+    def generate(self, req: dict) -> dict:
+        prompt = req.get("prompt")
+        if not isinstance(prompt, list) or not prompt or \
+                not all(isinstance(t, int) for t in prompt):
+            raise ValueError("'prompt' must be a non-empty list of "
+                             "token ids")
+        max_new = req.get("max_new_tokens", 64)
+        if not isinstance(max_new, int) or max_new < 1:
+            raise ValueError("'max_new_tokens' must be a positive integer")
+        ids = self.generator.generate_sync(
+            np.asarray(prompt, np.int32), max_new,
+            float(req.get("temperature", 0.0)),
+            top_k=int(req.get("top_k", 0)),
+            top_p=float(req.get("top_p", 1.0)),
+            timeout=self.request_timeout_s)
+        return {"ids": [int(t) for t in ids]}
+
+    def health(self) -> dict:
+        gen = self.generator
+        out = {"status": "ok", "engine": type(gen).__name__}
+        for attr in ("requests_total", "batches_total", "admitted_total",
+                     "admitted_while_running", "steps_total"):
+            if hasattr(gen, attr):
+                out[attr] = getattr(gen, attr)
+        return out
+
+    def model_info(self) -> dict:
+        c = self.config
+        return {
+            "engine": type(self.generator).__name__,
+            "model": {
+                "d_model": c.d_model, "n_layers": c.n_layers,
+                "n_heads": c.n_heads, "n_kv_heads": c.n_kv_heads,
+                "vocab_size": c.vocab_size, "max_seq_len": c.max_seq_len,
+            },
+        }
+
+
+# -------------------------------------------------------------- entrypoint
+def build_generator(params, config, args):
+    from .serving import BatchedGenerator, ContinuousBatchedGenerator
+    if args.engine == "bucketed":
+        if args.kv_quant or args.eos_id >= 0:
+            # refuse rather than silently ignore: the operator asked for
+            # behavior this engine does not implement
+            raise SystemExit("--kv-quant/--eos-id require "
+                             "--engine continuous")
+        return BatchedGenerator(params, config, max_batch=args.slots,
+                                quantize=args.quantize)
+    return ContinuousBatchedGenerator(
+        params, config, n_slots=args.slots, quantize=args.quantize,
+        kv_quant=args.kv_quant,
+        eos_id=args.eos_id if args.eos_id >= 0 else None)
+
+
+def main(argv=None) -> int:
+    from ..models.transformer import TransformerConfig, init_params
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", required=True,
+                    help="JSON file of TransformerConfig fields")
+    ap.add_argument("--checkpoint", default=None,
+                    help="TrainCheckpointer directory (runtime/"
+                         "checkpoint.py layout; latest step's params are "
+                         "restored); absent → randomly initialized "
+                         "params (dev only)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8890)
+    ap.add_argument("--engine", choices=("continuous", "bucketed"),
+                    default="continuous")
+    ap.add_argument("--slots", type=int, default=8,
+                    help="engine slots / max batch")
+    ap.add_argument("--quantize", action="store_true",
+                    help="int8 weight-only serving")
+    ap.add_argument("--kv-quant", action="store_true",
+                    help="int8 KV cache (continuous engine)")
+    ap.add_argument("--eos-id", type=int, default=-1)
+    ap.add_argument("--platform", default=None,
+                    help="force the jax platform (e.g. 'cpu' for dev "
+                         "boxes): applied via jax.config BEFORE backend "
+                         "init — a JAX_PLATFORMS env var can be "
+                         "re-asserted by the image and is not sufficient")
+    args = ap.parse_args(argv)
+
+    with open(args.config) as fh:
+        config = TransformerConfig(**json.load(fh))
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    if args.checkpoint:
+        from .checkpoint import TrainCheckpointer, abstract_state
+        abstract = abstract_state(
+            jax.eval_shape(lambda: init_params(jax.random.key(0), config)))
+        with TrainCheckpointer(args.checkpoint) as ckpt:
+            restored = ckpt.restore_params(abstract)
+        if restored is None:
+            raise SystemExit(f"no checkpoint found in {args.checkpoint}")
+        step, params = restored
+        log.info("restored params from step %d", step)
+    else:
+        log.warning("no --checkpoint: serving randomly initialized params")
+        params = init_params(jax.random.key(0), config)
+
+    server = ServingServer(build_generator(params, config, args), config,
+                           host=args.host, port=args.port).start()
+    log.info("ready on %s", server.url)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    logging.basicConfig(level=logging.INFO)
+    sys.exit(main())
