@@ -41,8 +41,9 @@ bench: ## Benchmarks (JSON lines; real TPU when the tunnel is live).
 numerics: ## On-chip Pallas kernel validation (requires a live TPU).
 	$(PYTHON) ci/tpu_numerics.py
 
-dryrun: ## Multi-chip sharding dryrun on 8 virtual CPU devices.
+dryrun: ## Multi-chip sharding dryrun on 8 + 16 virtual CPU devices.
 	$(PYTHON) __graft_entry__.py 8
+	$(PYTHON) __graft_entry__.py 16
 
 loadtest: ## 100-notebook control-plane fan-out, in-process.
 	$(PYTHON) loadtest/start_notebooks.py --count 100
