@@ -146,22 +146,34 @@ class CachingClient:
     # ---------------------------------------------------- external feeding
     def feed(self, event: WatchEvent) -> None:
         """Ingest one watch event from a stream the OWNER holds (tee from a
-        manager watch). Only meaningful with auto_informer=False."""
+        manager watch). Only meaningful with auto_informer=False.
+        disable_for kinds are dropped at the door: their reads always go
+        live, so caching them (hot Event streams especially) would grow
+        memory for objects never served."""
+        if event.obj.get("kind") in self.disable_for:
+            return
         self._on_event(event)
 
     def backfill(self, kind: str) -> None:
         """Snapshot-list ``kind`` into the cache and mark it warm. Call
         AFTER the external watch feeding this cache is registered (same
         watch-then-list ordering _ensure_informer uses, same staleness
-        guards).
+        guards). Idempotent: a kind already warm (a second controller
+        watching it) skips the redundant LIST.
 
-        The LIST always runs, even for clients whose watch streams resync
-        initial state on connect (HttpApiClient): warm means "a complete
-        snapshot has landed", and the resync is delivered asynchronously
-        AFTER watch() returns — marking warm on the promise of a resync
-        would turn existing objects into authoritative NotFounds for the
-        gap (and for the whole outage if the stream never connected). The
-        overlap with a delivered resync is idempotent ingestion."""
+        The LIST always runs on first backfill, even for clients whose
+        watch streams resync initial state on connect (HttpApiClient):
+        warm means "a complete snapshot has landed", and the resync is
+        delivered asynchronously AFTER watch() returns — marking warm on
+        the promise of a resync would turn existing objects into
+        authoritative NotFounds for the gap (and for the whole outage if
+        the stream never connected). The overlap with a delivered resync
+        is idempotent ingestion."""
+        if kind in self.disable_for:
+            return  # payload kinds are live-read by design; never warm
+        with self._lock:
+            if kind in self._warm:
+                return
         for obj in self.store.list(kind):
             self._ingest(obj)
         with self._lock:
@@ -204,8 +216,14 @@ class CachingClient:
                     time.monotonic() - self.TOMBSTONE_TTL_S:
                 return  # stale snapshot of a deleted object
             cached = self._cache.get(key)
-            if cached is not None and self._rv(cached) > self._rv(obj):
-                return  # never replace a newer watched copy with older state
+            if cached is not None:
+                cached_rv, new_rv = self._rv(cached), self._rv(obj)
+                # never replace a newer watched copy with older state; and
+                # skip EQUAL-rv re-ingestion — several controllers watching
+                # one kind deliver the same frame once per stream, and
+                # re-transform/re-store under the lock is pure waste
+                if cached_rv and new_rv and cached_rv >= new_rv:
+                    return
             self._cache[key] = self._transform(obj)
 
     @staticmethod

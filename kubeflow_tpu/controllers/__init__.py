@@ -10,15 +10,24 @@ __all__ = ["Manager", "Request", "NotebookReconciler", "CullingReconciler",
 def setup_controllers(client, config=None, metrics=None, prober=None, *,
                       core=True, extension=True, webhooks=True,
                       leader_elect=False, health_port=None,
-                      lease_name=None):
+                      lease_name=None, cached_reads=True):
     """Wire a manager the way the two reference manager binaries do
     (notebook-controller/main.go:58-148 + odh main.go:141-374): admission
     webhooks on the apiserver, core reconciler always, culler only when
     ENABLE_CULLING (main.go:111-123), extension reconciler for
     routes/auth/CA/RBAC; optional leader election (--leader-elect,
     main.go:87-94) and healthz/readyz+metrics endpoints (main.go:125-133).
-    Returns the manager (not started)."""
+    Returns the manager (not started).
+
+    ``cached_reads`` installs the manager read cache (the reference's
+    manager cache + client.Options.Cache.DisableFor, odh main.go:236-268):
+    every kind the manager watches is served to reconcilers from a
+    watch-fed cache — one informer layer, no per-reconcile GET storms —
+    while Secret/ConfigMap payload reads and Events stay live. Writes
+    always pass through; conflict-retried updates absorb the staleness,
+    exactly as in the reference."""
     from ..api.types import install_notebook_crd
+    from ..cluster.cache import CachingClient
     from ..utils.config import ControllerConfig
     from ..utils.health import HealthServer
     from ..utils.metrics import MetricsRegistry
@@ -36,10 +45,20 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
     if inprocess_admission:
         install_notebook_crd(client)
     if webhooks and inprocess_admission:
-        # mutating runs before validating, as in the apiserver's phase order
+        # mutating runs before validating, as in the apiserver's phase
+        # order; admission always reads/writes the LIVE client — mutating
+        # on cached state would be a correctness hazard
         NotebookMutatingWebhook(client, config).install(client)
         NotebookValidatingWebhook(config).install(client)
-    mgr = Manager(client)
+    if cached_reads:
+        read_client = CachingClient(
+            client, auto_informer=False,
+            disable_for=("Secret", "ConfigMap", "Event"))
+        mgr = Manager(read_client, read_cache=read_client)
+    else:
+        read_client = client
+        mgr = Manager(read_client)
+    client = read_client  # reconcilers below read cached, write through
     mgr.attach_metrics(metrics)
     # ``core``/``extension`` mirror the reference's TWO manager binaries:
     # notebook-controller (core reconciler + culler) and the odh extension

@@ -63,8 +63,13 @@ class Manager:
     ERROR_BACKOFF_BASE = 0.005   # fast in-process analog of the 5ms rate-limiter base
     ERROR_BACKOFF_MAX = 2.0
 
-    def __init__(self, client) -> None:
+    def __init__(self, client, read_cache=None) -> None:
         self.client = client
+        # shared informer layer (reference: the manager cache) — when set,
+        # every watch this manager registers tees its events into the
+        # cache and backfills the kind, so reconciler reads through the
+        # cache are watch-fed without duplicate streams or GET storms
+        self.read_cache = read_cache
         self._reconcilers: dict[str, Reconciler] = {}
         self._queue: list[_QueueItem] = []
         self._queued: set[tuple[str, Request]] = set()
@@ -136,7 +141,14 @@ class Manager:
         reconciler's read cache shares the one watch stream instead of
         opening a duplicate (the reference's informer layer serves both
         dispatch and cached reads)."""
+        cache = self.read_cache
+
         def cb(event: WatchEvent) -> None:
+            if cache is not None:
+                try:
+                    cache.feed(event)
+                except Exception:  # cache feeding must never break dispatch
+                    log.exception("cache feed failed for %s", kind)
             if tee is not None:
                 try:
                     tee(event)
@@ -149,6 +161,14 @@ class Manager:
             for req in reqs:
                 self.enqueue(controller, req)
         self.client.watch(kind, cb)
+        if cache is not None:
+            try:
+                cache.backfill(kind)  # idempotent; after the stream is live
+            except Exception:  # noqa: BLE001 — a transient LIST failure at
+                # boot must degrade to live reads for this kind (correct,
+                # just slower), never crash manager setup
+                log.warning("read-cache backfill for %s failed; reads stay "
+                            "live", kind, exc_info=True)
 
     def enqueue(self, controller: str, req: Request, after: float = 0.0) -> None:
         with self._cv:

@@ -69,25 +69,38 @@ class NotebookReconciler:
         # delivered Event frame; the reference answers that from its
         # informer cache (notebook_controller.go:739-767). Over a real wire
         # client each lookup would otherwise be 1-2 API GETs per frame — a
-        # hot namespace turns every Pod event into a GET storm. The read
-        # cache is fed by TEEING the very watch streams this reconciler
-        # already holds (no duplicate streams; one snapshot LIST per kind
-        # at setup), and a warm miss is an authoritative NotFound so
-        # deleted objects don't regress to per-frame GETs.
+        # hot namespace turns every Pod event into a GET storm. When the
+        # manager carries the shared read cache (setup_controllers
+        # cached_reads), that IS the informer layer and it is fed/backfilled
+        # by mgr.watch below; a standalone reconciler (tests, custom
+        # wiring) builds its own cache teed off the same streams. Either
+        # way: no duplicate streams, one snapshot LIST per kind, and a
+        # warm miss is an authoritative NotFound so deleted objects don't
+        # regress to per-frame GETs.
         from ..cluster.cache import CachingClient
-        cache = CachingClient(self.client, disable_for=(),
-                              auto_informer=False)
+        if mgr.read_cache is not None:
+            cache, tee = mgr.read_cache, None
+        else:
+            cache = CachingClient(self.client, disable_for=(),
+                                  auto_informer=False)
+            tee = cache.feed
         self._read_cache = cache
-        mgr.watch(api.KIND, self.name, tee=cache.feed)
+        mgr.watch(api.KIND, self.name, tee=tee)
         mgr.watch("StatefulSet", self.name, mapper=owner_mapper(api.KIND),
-                  tee=cache.feed)
+                  tee=tee)
         mgr.watch("Service", self.name, mapper=owner_mapper(api.KIND))
         mgr.watch("Pod", self.name, mapper=label_mapper(names.NOTEBOOK_NAME_LABEL),
-                  tee=cache.feed)
+                  tee=tee)
         # backfill AFTER the watches above are live (watch-then-list: no
-        # missable gap; rv guard + tombstones make the overlap safe)
+        # missable gap; rv guard + tombstones make the overlap safe);
+        # idempotent when the manager already backfilled the kind, and a
+        # transient LIST failure degrades to live reads, never a crash
         for kind in (api.KIND, "StatefulSet", "Pod"):
-            cache.backfill(kind)
+            try:
+                cache.backfill(kind)
+            except Exception:  # noqa: BLE001 — see manager.watch
+                log.warning("read-cache backfill for %s failed; reads "
+                            "stay live", kind, exc_info=True)
         # Events of known notebooks' Pods/STSs share the Notebook queue and
         # are re-emitted on the CR (reference predNBEvents + mapEventToRequest,
         # notebook_controller.go:739-767,780-800; delete events are ignored)

@@ -29,7 +29,6 @@ import logging
 import signal
 import threading
 
-from .cluster.cache import CachingClient
 from .cluster.store import ClusterStore
 from .controllers import setup_controllers
 from .utils import tls_profile
@@ -69,20 +68,23 @@ def build_manager(store=None, config: ControllerConfig | None = None, *,
     """
     store = store if store is not None else ClusterStore()
     config = config or ControllerConfig.from_env()
-    client = CachingClient(store)
     shutdown = threading.Event()
 
     if components not in ("all", "core", "extension"):
         raise ValueError(f"unknown components selection: {components!r}")
     core = components in ("all", "core")
     extension = components in ("all", "extension")
-    mgr = setup_controllers(client, config, leader_elect=leader_elect,
+    # setup_controllers owns the ONE read-cache layer (cached_reads):
+    # wrapping here as well would stack two informer sets with duplicate
+    # watch streams and snapshot LISTs
+    mgr = setup_controllers(store, config, leader_elect=leader_elect,
                             health_port=health_port, core=core,
                             extension=extension, webhooks=extension)
+    client = mgr.client  # the cached view (Secret/CM/Event reads stay live)
 
-    profile = tls_profile.fetch_apiserver_tls_profile(client)
+    profile = tls_profile.fetch_apiserver_tls_profile(store)
     watcher = tls_profile.SecurityProfileWatcher(
-        client, profile,
+        store, profile,
         on_change=on_tls_change or shutdown.set)
     watcher.setup()
 

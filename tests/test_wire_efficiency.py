@@ -245,6 +245,71 @@ def test_event_predicate_cache_tracks_new_notebooks(http_stack):
     assert rec._pred_nb_events(WatchEvent("ADDED", event)) is True
 
 
+# ------------------------------------------------- shared manager read cache
+def test_manager_read_cache_eliminates_reconcile_get_storm(http_stack):
+    """setup_controllers wires the shared read cache (reference: manager
+    cache + DisableFor): reconciler GETs of watched kinds are served
+    watch-fed, so steady-state reconciles stop hammering the apiserver."""
+    from kubeflow_tpu.controllers import setup_controllers
+    store, client = http_stack
+    metrics = MetricsRegistry()
+    mgr = setup_controllers(client, metrics=metrics)
+    assert mgr.read_cache is not None
+    # Secrets/ConfigMaps payloads + Events stay live by design
+    assert {"Secret", "ConfigMap", "Event"} <= set(
+        mgr.read_cache.disable_for)
+    mgr.start()
+    try:
+        requests = metrics.counter("rest_client_requests_total", "")
+        store.create(api.new_notebook("cached", "default"))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            nb = store.get_or_none(api.KIND, "default", "cached")
+            if nb and api.get_condition(nb, "Created"):
+                break
+            time.sleep(0.05)
+        settled = requests.total()
+        time.sleep(1.0)  # steady state: no reconcile-driven GET churn
+        assert requests.total() - settled <= 2
+    finally:
+        mgr.stop()
+
+
+def test_backfill_failure_degrades_to_live_reads(http_stack, monkeypatch):
+    """A transient LIST failure during the read-cache backfill at boot
+    must leave that kind on live reads — never crash manager setup (over
+    a real wire, boot-time blips happen; the chaos suite injects
+    exactly this). Injection targets backfill ITSELF, not client.list —
+    the watch threads' resync LISTs run concurrently at boot and would
+    otherwise race to consume the injected failures."""
+    from kubeflow_tpu.cluster.cache import CachingClient
+    from kubeflow_tpu.controllers import setup_controllers
+    store, client = http_stack
+    calls = {"n": 0}
+    orig_backfill = CachingClient.backfill
+
+    def flaky(self, kind):
+        calls["n"] += 1
+        if calls["n"] <= 2:  # the first backfills blow up
+            raise OSError("boot-time blip")
+        return orig_backfill(self, kind)
+    monkeypatch.setattr(CachingClient, "backfill", flaky)
+    mgr = setup_controllers(client)  # must not raise
+    assert calls["n"] >= 2  # the failure path genuinely ran
+    mgr.start()
+    try:
+        store.create(api.new_notebook("survivor", "default"))
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if store.get_or_none("StatefulSet", "default", "survivor"):
+                break
+            time.sleep(0.05)
+        assert store.get_or_none("StatefulSet", "default", "survivor"), \
+            "reconciliation never happened after backfill failure"
+    finally:
+        mgr.stop()
+
+
 # ------------------------------------------------------ loadtest request bound
 def test_loadtest_wire_requests_per_notebook_bounded():
     spec = importlib.util.spec_from_file_location(
