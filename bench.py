@@ -68,6 +68,7 @@ ARCHIVE_METRICS = frozenset({
     "train_32k_ctx_tokens_per_sec",
     "decode_tokens_per_sec",
     "decode_int8_tokens_per_sec",
+    "decode_long_ctx_tokens_per_sec",
 })
 
 # bf16 peak FLOP/s per chip, by device_kind substring (public TPU specs).
@@ -550,6 +551,34 @@ def bench_decode(info: dict) -> None:
         sync(out)
     per_q = _timed_iters(run_q, counts=(2, 6))
     tok_q = batch * new_tokens / per_q
+
+    if on_tpu:
+        # long-KV decode: the flash-decode kernel's case — an 8k cache
+        # (auto-engaged at max_seq_len >= 2048) with a 4k prompt, int8
+        # weights AND int8 KV. The einsum path re-reads the whole static
+        # cache per token; the kernel streams only the live prefix.
+        import dataclasses
+        c8k = dataclasses.replace(config, max_seq_len=8192)
+        long_prompt, long_new, long_batch = 4096, 64, 4
+        prompts8k = jax.random.randint(jax.random.key(2),
+                                       (long_batch, long_prompt), 0,
+                                       config.vocab_size)
+        gen_l = jax.jit(lambda p, t: generate(p, t, c8k, long_new,
+                                              kv_quant=True))
+        sync(gen_l(qparams, prompts8k))
+
+        def run_l(n):
+            out = None
+            for _ in range(n):
+                out = gen_l(qparams, prompts8k)
+            sync(out)
+        per_l = _timed_iters(run_l, counts=(2, 5))
+        tok_l = long_batch * long_new / per_l
+        _emit(info, metric="decode_long_ctx_tokens_per_sec",
+              value=round(tok_l, 1), unit="tokens/s", vs_baseline=None,
+              detail={"batch": long_batch, "prompt_len": long_prompt,
+                      "new_tokens": long_new, "max_seq_len": 8192,
+                      "kv_quant": True, "flash_decode": True})
 
     # weight-traffic roofline: every decode step re-reads the full weight
     # set once (batch amortizes it over `batch` tokens) plus the live KV

@@ -218,11 +218,14 @@ class CachingClient:
             cached = self._cache.get(key)
             if cached is not None:
                 cached_rv, new_rv = self._rv(cached), self._rv(obj)
-                # never replace a newer watched copy with older state; and
-                # skip EQUAL-rv re-ingestion — several controllers watching
-                # one kind deliver the same frame once per stream, and
-                # re-transform/re-store under the lock is pure waste
-                if cached_rv and new_rv and cached_rv >= new_rv:
+                # never replace a newer watched copy with older state — an
+                # rv-less snapshot (rv 0) must NOT clobber a versioned one
+                if cached_rv > new_rv:
+                    return
+                # and skip EQUAL-rv re-ingestion (both versioned): several
+                # controllers watching one kind deliver the same frame once
+                # per stream; re-transform/re-store under the lock is waste
+                if new_rv and cached_rv == new_rv:
                     return
             self._cache[key] = self._transform(obj)
 

@@ -97,8 +97,12 @@ def build_manager(store=None, config: ControllerConfig | None = None, *,
         # code path for cluster (HTTPS) and standalone (in-process) modes
         from .webhook import (NotebookMutatingWebhook,
                               NotebookValidatingWebhook)
+        # admission reads/writes the LIVE store, never the manager cache:
+        # mutating on a watch-fed view (e.g. resolving an ImageStream that
+        # was updated milliseconds ago) would be a correctness hazard —
+        # same invariant as the in-process admission plugins
         mgr.webhook_server = AdmissionServer(
-            NotebookMutatingWebhook(client, config),
+            NotebookMutatingWebhook(store, config),
             NotebookValidatingWebhook(config),
             port=webhook_port, certfile=certfile, keyfile=keyfile,
             tls_profile=profile)
