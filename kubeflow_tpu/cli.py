@@ -119,15 +119,23 @@ def _ready_of(obj: dict) -> str:
     return ""
 
 
+def _get_or_complain(client, kind: str, ns: str, name: str):
+    """THE fetch-or-report-not-found step shared by get/describe/delete —
+    one place owns the kubectl-style error message."""
+    try:
+        return client.get(kind, ns, name)
+    except NotFoundError:
+        print(f"Error: {kind.lower()} {ns}/{name} not found",
+              file=sys.stderr)
+        return None
+
+
 def cmd_get(client, args) -> int:
     kind = resolve_kind(args.resource)
     if args.name:
         ns, name = split_ref(args.name, args.namespace)
-        try:
-            obj = client.get(kind, ns, name)
-        except NotFoundError:
-            print(f"Error: {kind.lower()} {ns}/{name} not found",
-                  file=sys.stderr)
+        obj = _get_or_complain(client, kind, ns, name)
+        if obj is None:
             return 1
         _print_objs(args.output, obj, [obj])
         return 0
@@ -165,7 +173,8 @@ def cmd_delete(client, args) -> int:
     try:
         client.delete(kind, ns, name)
     except NotFoundError:
-        print(f"Error: {kind.lower()} {ns}/{name} not found", file=sys.stderr)
+        print(f"Error: {kind.lower()} {ns}/{name} not found",
+              file=sys.stderr)
         return 1
     print(f"{kind.lower()}/{name} deleted")
     return 0
@@ -184,6 +193,84 @@ def cmd_resume(client, args) -> int:
     client.patch("Notebook", ns, name, {"metadata": {"annotations": {
         names.STOP_ANNOTATION: None}}})
     print(f"notebook/{name} resumed")
+    return 0
+
+
+def cmd_restart(client, args) -> int:
+    """Set the restart annotation — the reference's dashboard workflow
+    (upstream reconciler deletes the pod and strips the annotation,
+    notebook_controller.go:259-294); also how parked ``update-pending``
+    webhook mutations get applied."""
+    ns, name = split_ref(args.name, args.namespace)
+    client.patch("Notebook", ns, name, {"metadata": {"annotations": {
+        names.RESTART_ANNOTATION: "true"}}})
+    print(f"notebook/{name} restart requested")
+    return 0
+
+
+def cmd_describe(client, args) -> int:
+    """kubectl-describe analog: metadata, conditions, and the Events whose
+    involvedObject is this resource (the reference re-emits pod/STS events
+    onto the CR, so this is where slice failures surface)."""
+    kind = resolve_kind(args.resource)
+    ns, name = split_ref(args.name, args.namespace)
+    obj = _get_or_complain(client, kind, ns, name)
+    if obj is None:
+        return 1
+    print(f"Name:         {name}")
+    print(f"Namespace:    {ns}")
+    print(f"Kind:         {kind}")
+    labels = k8s.get_in(obj, "metadata", "labels", default={}) or {}
+    anns = k8s.get_in(obj, "metadata", "annotations", default={}) or {}
+    print("Labels:       " + (", ".join(f"{k}={v}" for k, v in
+                                        sorted(labels.items())) or "<none>"))
+    print("Annotations:  " + (", ".join(f"{k}={v}" for k, v in
+                                        sorted(anns.items())) or "<none>"))
+    conditions = k8s.get_in(obj, "status", "conditions", default=[]) or []
+    if conditions:
+        print("Conditions:")
+        for cond in conditions:
+            print(f"  {cond.get('type', '?'):<16} "
+                  f"{cond.get('status', '?'):<8} "
+                  f"{cond.get('reason', '')} {cond.get('message', '')}"
+                  .rstrip())
+    events = [ev for ev in client.list("Event", ns)
+              if ev.get("involvedObject", {}).get("name") == name
+              and ev.get("involvedObject", {}).get("kind") == kind]
+    print("Events:" if events else "Events:       <none>")
+    for ev in events:
+        print(f"  {ev.get('type', ''):<8} {ev.get('reason', ''):<20} "
+              f"x{ev.get('count', 1)}  {ev.get('message', '')}".rstrip())
+    return 0
+
+
+def cmd_watch(client, args) -> int:
+    """Stream watch events as table rows (kubectl get -w): the resync on
+    connect lists current state as ADDED rows, then live changes follow
+    until interrupted, the downstream pipe closes (head/less), or
+    --timeout (for scripts)."""
+    import threading
+
+    kind = resolve_kind(args.resource)
+    stop = threading.Event()
+
+    def on_event(ev) -> None:
+        if stop.is_set():
+            return
+        try:
+            print(f"{ev.type:<9} {k8s.namespace(ev.obj) or '-':<12} "
+                  f"{k8s.name(ev.obj):<24} {_ready_of(ev.obj) or '-'}",
+                  flush=True)
+        except BrokenPipeError:
+            # prints happen on the watch thread — main()'s handler can't
+            # see this; signal the wait below instead of letting the
+            # delivery loop log-and-retry the same event forever
+            stop.set()
+    client.watch(kind, on_event, namespace=args.namespace or None)
+    try:
+        stop.wait(args.timeout)
+    except KeyboardInterrupt:
+        pass
     return 0
 
 
@@ -211,18 +298,39 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p_del.add_argument("resource")
     p_del.add_argument("name")
 
-    for verb in ("stop", "resume"):
+    for verb in ("stop", "resume", "restart"):
         p = sub.add_parser(verb, help=f"{verb} a notebook (slice-atomic)")
         p.add_argument("resource", choices=("notebook", "nb"))
         p.add_argument("name")
+
+    p_desc = sub.add_parser("describe",
+                            help="metadata + conditions + events")
+    p_desc.add_argument("resource")
+    p_desc.add_argument("name")
+
+    p_watch = sub.add_parser("watch", help="stream watch events (get -w)")
+    p_watch.add_argument("resource")
+    p_watch.add_argument("--timeout", type=float, default=None,
+                         help="exit after N seconds (default: forever)")
     return ap
 
 
 def main(argv=None) -> int:
     args = build_arg_parser().parse_args(argv)
     client = build_client(args)
+    try:
+        return _dispatch(client, args)
+    finally:
+        # stop any watch threads — an in-process caller (tests, notebooks)
+        # would otherwise leak a reconnecting stream past this command
+        client.close()
+
+
+def _dispatch(client, args) -> int:
     handler = {"apply": cmd_apply, "get": cmd_get, "delete": cmd_delete,
-               "stop": cmd_stop, "resume": cmd_resume}[args.command]
+               "stop": cmd_stop, "resume": cmd_resume,
+               "restart": cmd_restart, "describe": cmd_describe,
+               "watch": cmd_watch}[args.command]
     try:
         return handler(client, args)
     except ApiError as err:
@@ -231,6 +339,13 @@ def main(argv=None) -> int:
     except KeyError as err:  # restmapper: kind without a REST mapping
         print(f"Error: {err.args[0]}", file=sys.stderr)
         return 1
+    except BrokenPipeError:
+        # downstream consumer (head, less) closed the pipe — normal CLI
+        # usage, not an error; point stdout at devnull so the interpreter's
+        # exit flush doesn't print a second traceback
+        import os
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 0
 
 
 if __name__ == "__main__":

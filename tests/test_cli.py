@@ -118,3 +118,72 @@ def test_get_output_yaml(server, store, tmp_path, capsys):
     import yaml as yaml_mod
     obj = yaml_mod.safe_load(capsys.readouterr().out)
     assert k8s.name(obj) == "demo"
+
+
+def test_restart_sets_annotation(server, store, tmp_path, capsys):
+    f = tmp_path / "nb.yaml"
+    f.write_text(NB_YAML)
+    run(server, "apply", "-f", str(f))
+    rc = run(server, "restart", "notebook", "proj/demo")
+    assert rc == 0
+    nb = store.get(api.KIND, "proj", "demo")
+    assert k8s.get_annotation(nb, names.RESTART_ANNOTATION) == "true"
+    assert "restart requested" in capsys.readouterr().out
+
+
+def test_describe_shows_conditions_and_events(server, store, tmp_path,
+                                              capsys):
+    f = tmp_path / "nb.yaml"
+    f.write_text(NB_YAML)
+    run(server, "apply", "-f", str(f))
+    # give the CR a condition and an event, as the controllers would
+    nb = store.get(api.KIND, "proj", "demo")
+    nb.setdefault("status", {})["conditions"] = [
+        {"type": "SliceReady", "status": "False", "reason": "Booting",
+         "message": "0/4 workers ready"}]
+    store.update_status(nb)
+    store.create({"apiVersion": "v1", "kind": "Event",
+                  "metadata": {"name": "demo.ev1", "namespace": "proj"},
+                  "involvedObject": {"kind": "Notebook", "name": "demo",
+                                     "namespace": "proj"},
+                  "reason": "SliceBooting", "message": "waiting for TPUs",
+                  "type": "Normal", "count": 2})
+    rc = run(server, "describe", "notebook", "proj/demo")
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "SliceReady" in out and "Booting" in out
+    assert "SliceBooting" in out and "waiting for TPUs" in out
+    assert "tpu.kubeflow.org/accelerator=v5e-4" in out
+
+
+def test_describe_missing_is_error(server, capsys):
+    rc = run(server, "describe", "notebook", "proj/ghost")
+    assert rc == 1
+    assert "not found" in capsys.readouterr().err
+
+
+def test_watch_streams_initial_state_and_changes(server, store, tmp_path,
+                                                 capsys):
+    f = tmp_path / "nb.yaml"
+    f.write_text(NB_YAML)
+    run(server, "apply", "-f", str(f))
+    import threading
+    import time
+    results = {}
+
+    def runner():
+        results["rc"] = run(server, "-n", "proj", "watch", "notebooks",
+                            "--timeout", "4")
+    t = threading.Thread(target=runner)
+    t.start()
+    # a LIVE change while the watch runs must stream as MODIFIED (the
+    # initial resync only proves the ADDED backfill)
+    time.sleep(1.0)
+    nb = store.get(api.KIND, "proj", "demo")
+    nb["metadata"].setdefault("labels", {})["touched"] = "yes"
+    store.update(nb)
+    t.join(timeout=30)
+    assert not t.is_alive() and results["rc"] == 0
+    out = capsys.readouterr().out
+    assert "ADDED" in out and "demo" in out
+    assert "MODIFIED" in out
