@@ -114,3 +114,51 @@ def test_restore_evicted_step_returns_none(tmp_path):
         assert ckpt.all_steps() == [1]
         assert ckpt.restore(abstract_state(params), abstract_state(opt_state),
                             step=0) is None
+
+
+def test_bf16_master_state_roundtrips_and_resumes(tmp_path):
+    """bf16 params + f32 master copies (MasterOptState) through orbax:
+    dtypes survive the roundtrip, training resumes bit-identically on the
+    restored state, and a CROSS-MESH restore reshards the master copy
+    like any param tree."""
+    mesh_cfg = MeshConfig.auto(8, tp=2)
+    mesh = build_mesh(mesh_cfg, devices=jax.devices()[:8])
+    tc = TrainConfig(warmup_steps=1, bf16_params=True)
+    init_fn, step_fn = make_sharded_train_step(mesh, tiny_config(), tc=tc)
+    params, opt_state = init_fn(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (4, 16), 0, 128)
+    targets = jnp.roll(tokens, -1, axis=1)
+    params, opt_state, _ = step_fn(params, opt_state, tokens, targets)
+
+    with TrainCheckpointer(tmp_path / "ckpt") as ckpt:
+        assert ckpt.save(1, params, opt_state)
+        ckpt.wait()
+    # the post-save step: the reference trajectory the resume must match
+    params2, opt_state2, loss_ref = step_fn(params, opt_state, tokens,
+                                            targets)
+    ref_leaf = np.asarray(jax.device_get(
+        jax.tree.leaves(params2)[0]).astype(np.float32))
+
+    # restore onto a DIFFERENT mesh layout (tp=4 instead of tp=2, so the
+    # batch axis still divides dp x fsdp)
+    mesh2 = build_mesh(MeshConfig.auto(8, tp=4),
+                       devices=jax.devices()[:8])
+    init2, step2 = make_sharded_train_step(mesh2, tiny_config(), tc=tc)
+    ab_params, ab_opt = jax.eval_shape(init2, jax.random.key(0))
+    from kubeflow_tpu.models.train import MasterOptState
+    with TrainCheckpointer(tmp_path / "ckpt") as ckpt:
+        step, rparams, ropt = ckpt.restore(
+            abstract_state(ab_params), abstract_state(ab_opt))
+    assert step == 1
+    assert isinstance(ropt, MasterOptState) or hasattr(ropt, "master")
+    for leaf in jax.tree.leaves(rparams):
+        assert leaf.dtype == jnp.bfloat16
+    for leaf in jax.tree.leaves(ropt.master):
+        assert leaf.dtype == jnp.float32
+    # resumed step matches the uninterrupted trajectory
+    rparams2, ropt2, loss_resumed = step2(rparams, ropt, tokens, targets)
+    np.testing.assert_allclose(float(loss_resumed), float(loss_ref),
+                               rtol=1e-6)
+    got_leaf = np.asarray(jax.device_get(
+        jax.tree.leaves(rparams2)[0]).astype(np.float32))
+    np.testing.assert_array_equal(got_leaf, ref_leaf)
