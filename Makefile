@@ -4,7 +4,7 @@
 PYTHON ?= python
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
-.PHONY: help test test-fast test-chaos test-transport lint manifests \
+.PHONY: help test test-fast test-chaos test-transport gate lint manifests \
         manifests-check check-license bench numerics dryrun loadtest run \
         run-split
 
@@ -13,6 +13,9 @@ help: ## Display this help.
 
 test: ## Run the full suite on the virtual 8-device CPU mesh.
 	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q
+
+gate: ## Full suite via ci/gate.py — stamps CI_STATUS.json, exits nonzero on red.
+	$(PYTHON) ci/gate.py
 
 test-fast: ## Suite minus the subprocess/multi-process tests.
 	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q -k "not slow"

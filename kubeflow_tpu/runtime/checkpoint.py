@@ -28,10 +28,18 @@ def abstract_state(state, shardings=None):
     """ShapeDtypeStruct skeleton of ``state`` (any pytree of arrays), with
     ``shardings`` (a matching pytree of NamedShardings) attached when given —
     the restore target for cross-mesh resume. ``state`` may itself already be
-    abstract (e.g. from jax.eval_shape)."""
+    abstract (e.g. from jax.eval_shape).
+
+    Without an explicit ``shardings`` tree, each leaf's own sharding is
+    preserved when it has one: jax.eval_shape on a jitted init attaches the
+    out_shardings (the *target* mesh layout), and dropping them here made
+    orbax fall back to the sharding file — i.e. the SAVED mesh — so a
+    cross-mesh restore returned arrays the target-mesh step rejected."""
     if shardings is None:
         return jax.tree.map(
-            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state)
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)),
+            state)
     return jax.tree.map(
         lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
         state, shardings)

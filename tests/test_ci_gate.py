@@ -1,0 +1,52 @@
+"""The CI gate itself is under test: round 3 shipped a red suite because the
+gate was a convention, not a checked behavior. These tests pin that
+``ci/gate.py`` (a) fails on a red suite, (b) fails on an empty run, and
+(c) passes and stamps CI_STATUS.json on a green one."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+GATE = REPO / "ci" / "gate.py"
+
+
+def _run_gate(tmp_path, test_body: str):
+    suite = tmp_path / "minisuite"
+    suite.mkdir()
+    (suite / "test_mini.py").write_text(test_body)
+    status = tmp_path / "status.json"
+    proc = subprocess.run(
+        [sys.executable, str(GATE), "--tests", str(suite),
+         "--status-file", str(status), "-p", "no:cacheprovider"],
+        capture_output=True, text=True, timeout=120)
+    return proc, json.loads(status.read_text())
+
+
+def test_gate_fails_on_red_suite(tmp_path):
+    proc, status = _run_gate(
+        tmp_path,
+        "def test_green():\n    assert True\n"
+        "def test_red():\n    assert False, 'deliberate'\n")
+    assert proc.returncode != 0
+    assert status["ok"] is False
+    assert status["failed"] == 1 and status["passed"] == 1
+    # a red gate must surface the traceback, not just the verdict
+    assert "deliberate" in proc.stderr
+
+
+def test_gate_fails_on_empty_run(tmp_path):
+    proc, status = _run_gate(tmp_path, "# no tests here\n")
+    assert proc.returncode != 0
+    assert status["ok"] is False and status["passed"] == 0
+
+
+def test_gate_passes_and_stamps_on_green(tmp_path):
+    proc, status = _run_gate(
+        tmp_path, "def test_green():\n    assert True\n")
+    assert proc.returncode == 0
+    assert status["ok"] is True and status["passed"] == 1
+    # the stamp records which tree the gate ran on
+    assert status["commit"]
+    assert "dirty" in status
