@@ -7,6 +7,9 @@ stdlib-only JSON-over-HTTP server in the shape such endpoints take:
     POST /v1/generate   {"prompt": [ids...], "max_new_tokens": N,
                          "temperature": t, "top_k": k, "top_p": p}
                       → {"ids": [ids...]}
+                        with "stream": true → text/event-stream, one
+                        data: {"token": id} event per token as generated,
+                        then data: {"done": true, "ids": [...]}
     GET  /healthz       liveness + engine stats (what the culler's
                         activity probe and the auth sidecar front)
     GET  /v1/models     the serving configuration (model shape, engine,
@@ -32,7 +35,9 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -85,6 +90,14 @@ class ServingServer:
                         self._json(413, {"error": "invalid request size"})
                         return
                     req = json.loads(self.rfile.read(length))
+                    stream = req.get("stream", False)
+                    if not isinstance(stream, bool):
+                        # '"stream": "false"' is a client bug; guessing a
+                        # truthiness here silently switches content types
+                        raise ValueError("'stream' must be a boolean")
+                    if stream:
+                        server.stream_generate(req, self)
+                        return
                     out = server.generate(req)
                     self._json(200, out)
                 except (ValueError, KeyError, TypeError) as e:
@@ -138,7 +151,8 @@ class ServingServer:
         self.stop()
 
     # ------------------------------------------------------------- handlers
-    def generate(self, req: dict) -> dict:
+    @staticmethod
+    def _validate(req: dict):
         prompt = req.get("prompt")
         if not isinstance(prompt, list) or not prompt or \
                 not all(isinstance(t, int) for t in prompt):
@@ -147,13 +161,88 @@ class ServingServer:
         max_new = req.get("max_new_tokens", 64)
         if not isinstance(max_new, int) or max_new < 1:
             raise ValueError("'max_new_tokens' must be a positive integer")
+        return (np.asarray(prompt, np.int32), max_new,
+                float(req.get("temperature", 0.0)),
+                int(req.get("top_k", 0)), float(req.get("top_p", 1.0)))
+
+    def generate(self, req: dict) -> dict:
+        prompt, max_new, temp, top_k, top_p = self._validate(req)
         ids = self.generator.generate_sync(
-            np.asarray(prompt, np.int32), max_new,
-            float(req.get("temperature", 0.0)),
-            top_k=int(req.get("top_k", 0)),
-            top_p=float(req.get("top_p", 1.0)),
+            prompt, max_new, temp, top_k=top_k, top_p=top_p,
             timeout=self.request_timeout_s)
         return {"ids": [int(t) for t in ids]}
+
+    def stream_generate(self, req: dict, handler) -> None:
+        """``"stream": true``: per-token SSE emission. The engine already
+        works at token boundaries (ContinuousBatchedGenerator admits and
+        samples per step); this hands each sampled id straight to the wire
+        instead of parking it until completion — time-to-first-token
+        becomes prefill + one step, not the full generation.
+
+        Wire format: ``Content-Type: text/event-stream``, one
+        ``data: {"token": id}`` event per token actually SAMPLED — when
+        the engine stops at an EOS id, the token events end there — then a
+        final ``data: {"done": true, "n_tokens": n, "ids": [...]}`` event
+        whose ``ids`` is the engine's result exactly as the non-streaming
+        response would return it (padded to max_new_tokens after an early
+        EOS) and ``n_tokens`` counts the token events that preceded it.
+        The response is delimited by connection close (no
+        Content-Length)."""
+        prompt, max_new, temp, top_k, top_p = self._validate(req)
+        if not getattr(self.generator, "supports_streaming", False):
+            raise ValueError(
+                f"engine {type(self.generator).__name__} does not "
+                f"support streaming; use the continuous engine")
+        q: queue.Queue = queue.Queue()
+        future = self.generator.submit(prompt, max_new, temp, top_k=top_k,
+                                       top_p=top_p, on_token=q.put)
+
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-cache")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+
+        def event(payload: dict) -> bool:
+            try:
+                handler.wfile.write(
+                    b"data: " + json.dumps(payload).encode() + b"\n\n")
+                handler.wfile.flush()
+                return True
+            except OSError:   # client went away; the engine finishes the
+                return False  # request (no cancellation at token level)
+
+        t_end = time.monotonic() + self.request_timeout_s
+        n_tokens = 0
+        while True:
+            try:
+                tok = q.get(timeout=min(0.25, max(0.0, t_end -
+                                                  time.monotonic())))
+                if not event({"token": tok}):
+                    return
+                n_tokens += 1
+                continue
+            except queue.Empty:
+                pass
+            if future.done():
+                # drain ids emitted between the last get and completion
+                while True:
+                    try:
+                        tok = q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if not event({"token": tok}):
+                        return
+                    n_tokens += 1
+                break
+            if time.monotonic() >= t_end:
+                event({"error": "generation timed out"})
+                return
+        try:
+            ids = [int(t) for t in future.result(timeout=0)]
+            event({"done": True, "n_tokens": n_tokens, "ids": ids})
+        except Exception as e:  # noqa: BLE001 — surface as a final event
+            event({"error": f"{type(e).__name__}: {e}"})
 
     def health(self) -> dict:
         gen = self.generator

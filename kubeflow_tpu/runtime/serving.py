@@ -46,6 +46,10 @@ class GenerateRequest:
     top_k: int = 0                    # 0 disables the k-cut
     top_p: float = 1.0                # 1.0 disables the nucleus cut
     future: Future = field(default_factory=Future)
+    # streaming: called with each generated token id (int), on the engine
+    # thread, BEFORE the future resolves — must be cheap and non-blocking
+    # (hand the id to a queue; never do IO here)
+    on_token: object | None = None
 
     @property
     def shape_key(self) -> tuple:
@@ -255,7 +259,13 @@ class ContinuousBatchedGenerator:
       idle-row compute is the price of never recompiling).
 
     ``submit`` returns a Future resolving to the (max_new_tokens,) ids.
+    Passing ``on_token`` streams each sampled id to the caller at the token
+    boundary it was generated on — the engine already schedules per token,
+    so streaming costs one extra (slots,) readback per step, and only on
+    steps where a streaming request is in flight.
     """
+
+    supports_streaming = True
 
     def __init__(self, params, config, *, n_slots: int = 8,
                  max_new_cap: int | None = None, seed: int = 0,
@@ -300,12 +310,14 @@ class ContinuousBatchedGenerator:
 
     # ----------------------------------------------------------------- API
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
-               top_k: int = 0, top_p: float = 1.0) -> Future:
+               top_k: int = 0, top_p: float = 1.0, *,
+               on_token=None) -> Future:
         if max_new_tokens > self.cap:
             raise ValueError(f"max_new_tokens {max_new_tokens} exceeds "
                              f"engine cap {self.cap}")
         req = GenerateRequest(np.asarray(prompt, np.int32), max_new_tokens,
-                              temperature, top_k, top_p)
+                              temperature, top_k, top_p,
+                              on_token=on_token)
         if len(req.prompt) + max_new_tokens > self.config.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
         with self._lifecycle:
@@ -391,8 +403,12 @@ class ContinuousBatchedGenerator:
         # at their stale pos but are never read (mask is per-row)
         logits = jnp.where(active[:, None], logits, state["logits"])
         pos = state["pos"] + active.astype(jnp.int32)
+        # the sampled (slots,) tokens ride out alongside the state so a
+        # streaming caller can read them without indexing the out buffer
+        # (one fused readback instead of per-slot gathers)
         return {**state, "cache": cache, "logits": logits, "pos": pos,
-                "active": active, "done": done, "out": out, "n_out": n_out}
+                "active": active, "done": done, "out": out,
+                "n_out": n_out}, token
 
     # -------------------------------------------------------------- engine
     def _free_slots(self) -> list[int]:
@@ -410,6 +426,24 @@ class ContinuousBatchedGenerator:
         self.admitted_total += 1
         if sum(s.req is not None for s in self._slots) > 1:
             self.admitted_while_running += 1
+
+    def _emit_tokens(self, token) -> None:
+        """Deliver this step's sampled ids to streaming requests. The
+        readback happens only when a streaming request is in flight; a
+        raising callback loses its own stream, never the engine loop.
+        Every slot holding a request is active (collection frees done rows
+        at the same tick they finish), so each such row sampled a real
+        token this step."""
+        if not any(s.req is not None and s.req.on_token is not None
+                   for s in self._slots):
+            return
+        ids = np.asarray(token)
+        for i, slot in enumerate(self._slots):
+            if slot.req is not None and slot.req.on_token is not None:
+                try:
+                    slot.req.on_token(int(ids[i]))
+                except Exception:  # noqa: BLE001
+                    slot.req.on_token = None
 
     def _collect_finished(self) -> None:
         n_out = np.asarray(self._state["n_out"])
@@ -463,10 +497,13 @@ class ContinuousBatchedGenerator:
                 continue
             try:
                 self._key, sub = jax.random.split(self._key)
-                self._state = self._step_jit(self.params, self._state, sub,
-                                             self.config, self.eos_id,
-                                             self.pad_id)
+                self._state, token = self._step_jit(
+                    self.params, self._state, sub, self.config, self.eos_id,
+                    self.pad_id)
                 self.steps_total += 1
+                # stream BEFORE collection so every token is delivered
+                # before the request's future resolves
+                self._emit_tokens(token)
                 self._collect_finished()
             except BaseException as exc:  # noqa: BLE001 — fail the batch
                 for i, slot in enumerate(self._slots):

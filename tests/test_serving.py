@@ -118,3 +118,46 @@ def test_batch_padding_clamped_to_max_batch():
         futs = [gen.submit(p, max_new_tokens=4) for p in prompts(3)]
         outs = [f.result(timeout=120) for f in futs]
     assert len(outs) == 3  # 3 > bucket 2, cap 3 < bucket 4 → padded to 3
+
+
+def test_continuous_on_token_streams_before_completion():
+    """Engine streaming contract: every id reaches on_token on the token
+    boundary it was sampled at — i.e. BEFORE the future resolves — and in
+    generation order."""
+    import jax
+    from kubeflow_tpu.models.transformer import TransformerConfig, init_params
+    from kubeflow_tpu.runtime.serving import ContinuousBatchedGenerator
+    cfg = TransformerConfig(vocab_size=96, d_model=32, n_layers=1, n_heads=4,
+                            n_kv_heads=2, d_ff=48, dtype="float32",
+                            max_seq_len=48)
+    params = init_params(jax.random.key(0), cfg)
+    seen = []  # (token, future_done_at_emission)
+    holder = {}  # bound before the engine can emit; avoids a closure race
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2) as gen:
+        holder["fut"] = fut = gen.submit(
+            [3, 1, 4], 12,
+            on_token=lambda t: seen.append(
+                (t, bool(holder["fut"].done()) if "fut" in holder
+                 else False)))
+        ids = fut.result(timeout=120)
+    assert [t for t, _ in seen] == [int(t) for t in ids]
+    assert not any(done for _, done in seen)
+
+
+def test_continuous_on_token_raising_callback_loses_stream_not_engine():
+    import jax
+    from kubeflow_tpu.models.transformer import TransformerConfig, init_params
+    from kubeflow_tpu.runtime.serving import ContinuousBatchedGenerator
+    cfg = TransformerConfig(vocab_size=96, d_model=32, n_layers=1, n_heads=4,
+                            n_kv_heads=2, d_ff=48, dtype="float32",
+                            max_seq_len=48)
+    params = init_params(jax.random.key(0), cfg)
+
+    def bomb(tok):
+        raise RuntimeError("consumer bug")
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2) as gen:
+        fut = gen.submit([3, 1, 4], 8, on_token=bomb)
+        ids = fut.result(timeout=120)      # request still completes
+        assert len(ids) == 8
+        # engine still serves subsequent requests
+        assert len(gen.generate_sync([5, 6], 4, timeout=120)) == 4
