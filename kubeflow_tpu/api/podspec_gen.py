@@ -21,9 +21,6 @@ is regenerated via ``make manifests`` and drift-gated in CI.
 
 from __future__ import annotations
 
-PRESERVE = "x-kubernetes-preserve-unknown-fields"
-
-
 # ----------------------------------------------------------- leaf helpers
 def S(**kw) -> dict:
     return {"type": "string", **kw}
@@ -323,7 +320,70 @@ def affinity() -> dict:
     })
 
 
+def ephemeral_container() -> dict:
+    """core/v1 EphemeralContainer: EphemeralContainerCommon embeds the
+    Container field set (the SCHEMA carries probes/lifecycle/ports even
+    though admission rejects them on ephemeral containers — same shape the
+    reference CRD expansion emits) plus ``targetContainerName``."""
+    schema = container_full()
+    schema["properties"]["targetContainerName"] = S()
+    return schema
+
+
 # ----------------------------------------------------------------- volumes
+def persistent_volume_claim_spec() -> dict:
+    """core/v1 PersistentVolumeClaimSpec — the payload of the ``ephemeral``
+    volume source's claim template."""
+    typed_ref = OBJ({"apiGroup": S(), "kind": S(), "name": S()},
+                    required=["kind", "name"])
+    return OBJ({
+        "accessModes": ARR(S()),
+        "dataSource": typed_ref,
+        "dataSourceRef": OBJ({"apiGroup": S(), "kind": S(), "name": S(),
+                              "namespace": S()},
+                             required=["kind", "name"]),
+        "resources": OBJ({
+            "limits": {"type": "object", "additionalProperties": QUANTITY()},
+            "requests": {"type": "object",
+                         "additionalProperties": QUANTITY()},
+        }),
+        "selector": label_selector(),
+        "storageClassName": S(),
+        "volumeAttributesClassName": S(),
+        "volumeMode": S(enum=["Block", "Filesystem"]),
+        "volumeName": S(),
+    })
+
+
+def ephemeral_volume_source() -> dict:
+    """core/v1 EphemeralVolumeSource: an inline PVC template. The template
+    metadata is the restricted embedded form (labels/annotations etc., not
+    a full ObjectMeta)."""
+    return OBJ({
+        "volumeClaimTemplate": OBJ({
+            "metadata": OBJ({
+                "annotations": STR_MAP(),
+                "finalizers": ARR(S()),
+                "labels": STR_MAP(),
+                "name": S(),
+                "namespace": S(),
+            }),
+            "spec": persistent_volume_claim_spec(),
+        }, required=["spec"]),
+    })
+
+
+def cluster_trust_bundle_projection() -> dict:
+    """core/v1 ClusterTrustBundleProjection (projected-volume source)."""
+    return OBJ({
+        "labelSelector": label_selector(),
+        "name": S(),
+        "optional": B(),
+        "path": S(),
+        "signerName": S(),
+    }, required=["path"])
+
+
 def downward_api_items() -> dict:
     return ARR(OBJ({
         "fieldRef": object_field_selector(),
@@ -334,10 +394,9 @@ def downward_api_items() -> dict:
 
 
 def volume_full() -> dict:
-    """Every core/v1 volume source, with the sources notebooks actually
-    mount fully typed and the exotic remainder typed as objects (shape
-    checked, contents preserved) — the practical line controller-gen's
-    expansion draws with its own preserve-unknown escape hatches."""
+    """Every core/v1 volume source, fully typed — including the legacy
+    cloud tail — matching the reference CRD's complete controller-gen
+    expansion (kubeflow.org_notebooks.yaml)."""
     typed_sources = {
         "configMap": OBJ({"defaultMode": I(), "items": ARR(key_to_path()),
                           "name": S(), "optional": B()}),
@@ -354,7 +413,7 @@ def volume_full() -> dict:
         "projected": OBJ({
             "defaultMode": I(),
             "sources": ARR(OBJ({
-                "clusterTrustBundle": {"type": "object", PRESERVE: True},
+                "clusterTrustBundle": cluster_trust_bundle_projection(),
                 "configMap": OBJ({"items": ARR(key_to_path()), "name": S(),
                                   "optional": B()}),
                 "downwardAPI": OBJ({"items": downward_api_items()}),
@@ -370,22 +429,82 @@ def volume_full() -> dict:
                     "nodePublishSecretRef": local_object_reference(),
                     "readOnly": B(),
                     "volumeAttributes": STR_MAP()}, required=["driver"]),
-        "ephemeral": {"type": "object", PRESERVE: True},
+        "ephemeral": ephemeral_volume_source(),
         "image": OBJ({"pullPolicy": S(enum=["Always", "IfNotPresent",
                                             "Never"]),
                       "reference": S()}),
     }
-    opaque_sources = (
-        "awsElasticBlockStore", "azureDisk", "azureFile", "cephfs",
-        "cinder", "fc", "flexVolume", "flocker", "gcePersistentDisk",
-        "gitRepo", "glusterfs", "iscsi", "photonPersistentDisk",
-        "portworxVolume", "quobyte", "rbd", "scaleIO", "storageos",
-        "vsphereVolume",
-    )
+    # the legacy/out-of-tree cloud sources, typed from the public core/v1
+    # spec like everything else (the reference's expansion types all of
+    # them; none is consumed by the controllers)
+    legacy_sources = {
+        "awsElasticBlockStore": OBJ({"fsType": S(), "partition": I(),
+                                     "readOnly": B(), "volumeID": S()},
+                                    required=["volumeID"]),
+        "azureDisk": OBJ({"cachingMode": S(), "diskName": S(),
+                          "diskURI": S(), "fsType": S(), "kind": S(),
+                          "readOnly": B()},
+                         required=["diskName", "diskURI"]),
+        "azureFile": OBJ({"readOnly": B(), "secretName": S(),
+                          "shareName": S()},
+                         required=["secretName", "shareName"]),
+        "cephfs": OBJ({"monitors": ARR(S()), "path": S(), "readOnly": B(),
+                       "secretFile": S(),
+                       "secretRef": local_object_reference(), "user": S()},
+                      required=["monitors"]),
+        "cinder": OBJ({"fsType": S(), "readOnly": B(),
+                       "secretRef": local_object_reference(),
+                       "volumeID": S()}, required=["volumeID"]),
+        "fc": OBJ({"fsType": S(), "lun": I(), "readOnly": B(),
+                   "targetWWNs": ARR(S()), "wwids": ARR(S())}),
+        "flexVolume": OBJ({"driver": S(), "fsType": S(),
+                           "options": STR_MAP(), "readOnly": B(),
+                           "secretRef": local_object_reference()},
+                          required=["driver"]),
+        "flocker": OBJ({"datasetName": S(), "datasetUUID": S()}),
+        "gcePersistentDisk": OBJ({"fsType": S(), "partition": I(),
+                                  "pdName": S(), "readOnly": B()},
+                                 required=["pdName"]),
+        "gitRepo": OBJ({"directory": S(), "repository": S(),
+                        "revision": S()}, required=["repository"]),
+        "glusterfs": OBJ({"endpoints": S(), "path": S(), "readOnly": B()},
+                         required=["endpoints", "path"]),
+        "iscsi": OBJ({"chapAuthDiscovery": B(), "chapAuthSession": B(),
+                      "fsType": S(), "initiatorName": S(), "iqn": S(),
+                      "iscsiInterface": S(), "lun": I(),
+                      "portals": ARR(S()), "readOnly": B(),
+                      "secretRef": local_object_reference(),
+                      "targetPortal": S()},
+                     required=["iqn", "lun", "targetPortal"]),
+        "photonPersistentDisk": OBJ({"fsType": S(), "pdID": S()},
+                                    required=["pdID"]),
+        "portworxVolume": OBJ({"fsType": S(), "readOnly": B(),
+                               "volumeID": S()}, required=["volumeID"]),
+        "quobyte": OBJ({"group": S(), "readOnly": B(), "registry": S(),
+                        "tenant": S(), "user": S(), "volume": S()},
+                       required=["registry", "volume"]),
+        "rbd": OBJ({"fsType": S(), "image": S(), "keyring": S(),
+                    "monitors": ARR(S()), "pool": S(), "readOnly": B(),
+                    "secretRef": local_object_reference(), "user": S()},
+                   required=["image", "monitors"]),
+        "scaleIO": OBJ({"fsType": S(), "gateway": S(),
+                        "protectionDomain": S(), "readOnly": B(),
+                        "secretRef": local_object_reference(),
+                        "sslEnabled": B(), "storageMode": S(),
+                        "storagePool": S(), "system": S(),
+                        "volumeName": S()},
+                       required=["gateway", "secretRef", "system"]),
+        "storageos": OBJ({"fsType": S(), "readOnly": B(),
+                          "secretRef": local_object_reference(),
+                          "volumeName": S(), "volumeNamespace": S()}),
+        "vsphereVolume": OBJ({"fsType": S(), "storagePolicyID": S(),
+                              "storagePolicyName": S(),
+                              "volumePath": S()},
+                             required=["volumePath"]),
+    }
     props = {"name": S(minLength=1)}
     props.update(typed_sources)
-    for src in opaque_sources:
-        props[src] = {"type": "object", PRESERVE: True}
+    props.update(legacy_sources)
     return OBJ(props, required=["name"])
 
 
@@ -449,7 +568,7 @@ def pod_spec_schema_full() -> dict:
         "dnsPolicy": S(enum=["ClusterFirst", "ClusterFirstWithHostNet",
                              "Default", "None"]),
         "enableServiceLinks": B(),
-        "ephemeralContainers": ARR({"type": "object", PRESERVE: True}),
+        "ephemeralContainers": ARR(ephemeral_container()),
         "hostAliases": ARR(OBJ({"hostnames": ARR(S()), "ip": S()},
                                required=["ip"])),
         "hostIPC": B(),
