@@ -52,8 +52,20 @@ class StatefulSetSimulator:
                               default={}) or {}
         desired_template = k8s.get_in(sts, "spec", "template", default={})
 
+        # list by spec.selector.matchLabels — IMMUTABLE in real apps/v1,
+        # unlike the template labels, which the notebook reconciler
+        # rewrites on label edits (copy_statefulset_fields) — so the
+        # per-reconcile cost is O(this STS's pods), not O(pods in ns):
+        # the informer-index shape of the real STS controller. At a 500-
+        # notebook fan-out the unselected list made the simulator O(N²)
+        # and dominated the loadtest wall clock. Ownership stays the
+        # source of truth; an empty selector falls back to the full list.
+        pod_selector = k8s.get_in(sts, "spec", "selector", "matchLabels",
+                                  default=None) or None
         requeue: float | None = None
-        existing = {k8s.name(p): p for p in self.client.list("Pod", ns)
+        existing = {k8s.name(p): p
+                    for p in self.client.list("Pod", ns,
+                                              label_selector=pod_selector)
                     if k8s.is_owned_by(p, k8s.uid(sts))}
 
         # reap pods beyond replicas (highest ordinals first — STS semantics)
@@ -95,7 +107,8 @@ class StatefulSetSimulator:
                 else:
                     requeue = max(self.boot_delay_s / 4, 0.001)
 
-        ready = sum(1 for p in (self.client.list("Pod", ns))
+        ready = sum(1 for p in self.client.list(
+                        "Pod", ns, label_selector=pod_selector)
                     if k8s.is_owned_by(p, k8s.uid(sts)) and _pod_is_ready(p))
         if k8s.get_in(sts, "status", "readyReplicas") != ready or \
                 k8s.get_in(sts, "status", "replicas") != replicas:

@@ -115,13 +115,19 @@ def run_inprocess(count: int, namespace: str, accelerator: str,
         print(f"FAIL: only {len(ready)}/{count} notebooks became SliceReady "
               f"within {timeout}s")
         return 1
-    lat = sorted(ready.values())
     print(f"notebooks: {count}  wall: {total:.2f}s  "
           f"throughput: {count/total:.1f} nb/s")
+    _print_latencies(sorted(ready.values()))
+    return 0
+
+
+def _print_latencies(lat: list[float]) -> None:
+    """The shared create→SliceReady percentile line (both modes)."""
+    if not lat:
+        return
     print(f"create→SliceReady  p50: {statistics.median(lat)*1000:.1f}ms  "
           f"p95: {lat[int(0.95*(len(lat)-1))]*1000:.1f}ms  "
           f"max: {lat[-1]*1000:.1f}ms")
-    return 0
 
 
 def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
@@ -163,19 +169,39 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
         # let the watch backfills settle so the baseline excludes boot cost
         time.sleep(0.3)
         baseline = requests.total()
+        # per-notebook create→SliceReady latency, observed via a store
+        # watch — a tight full-LIST poll at a 500-notebook fan-out costs
+        # ~17 ms/scan of deep copies and perturbs the very system under
+        # measurement (it pins a core against the controllers' GIL time)
+        import threading
+        ready_at: dict[str, float] = {}
+        all_ready = threading.Event()
+
+        def on_event(ev):
+            nb = ev.obj
+            name = nb["metadata"]["name"]
+            if name not in ready_at and \
+                    (api.get_condition(nb, api.CONDITION_SLICE_READY)
+                     or {}).get("status") == "True":
+                ready_at[name] = time.monotonic()
+                if len(ready_at) >= count:
+                    all_ready.set()
+        store.watch(api.KIND, on_event, namespace=namespace)
+
+        if count <= 0:
+            print("notebooks: 0 — nothing to do")
+            return 0
         t0 = time.monotonic()
+        created_at = {}
         for i in range(count):
+            name = f"loadtest-nb-{i}"
+            created_at[name] = time.monotonic()
             store.create(api.new_notebook(
-                f"loadtest-nb-{i}", namespace,
+                name, namespace,
                 annotations={names.TPU_ACCELERATOR_ANNOTATION: accelerator}))
-        ready = 0
-        deadline = time.monotonic() + timeout
-        while ready < count and time.monotonic() < deadline:
-            ready = sum(
-                1 for nb in store.list(api.KIND, namespace)
-                if (api.get_condition(nb, api.CONDITION_SLICE_READY) or {})
-                .get("status") == "True")
-            time.sleep(0.02)
+        all_ready.wait(timeout)
+        store.unwatch(on_event)
+        ready = len(ready_at)
         wall = time.monotonic() - t0
         # one metrics scrape, so the notebook_running LIST cost is included
         metrics.expose()
@@ -186,6 +212,8 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
             return 1
         print(f"notebooks: {count}  wall: {wall:.2f}s  "
               f"controller apiserver requests/notebook: {per_nb:.1f}")
+        _print_latencies(sorted(ready_at[n] - created_at[n]
+                                for n in ready_at))
         if max_requests_per_nb is not None and per_nb > max_requests_per_nb:
             print(f"FAIL: {per_nb:.1f} requests/notebook exceeds bound "
                   f"{max_requests_per_nb}")

@@ -352,3 +352,38 @@ def test_worker_env_stable_across_stop_resume_cycles(store, manager,
         assert svc_resumed == svc0
     # headless service survives the cycles (worker DNS never disappears)
     assert store.get("Service", "ns", "cyc-workers")
+
+
+def test_notebook_label_edit_keeps_pods_visible_to_simulator(
+        store, manager, notebook_reconciler):
+    """A notebook label edit rewrites the STS template labels (the
+    selector is immutable). The simulator must keep finding the existing
+    pods through spec.selector.matchLabels — filtering by the now-changed
+    template labels would orphan every running pod: readyReplicas 0,
+    SliceReady False, and a delete/recreate churn loop."""
+    sim = StatefulSetSimulator(store, boot_delay_s=0.0)
+    sim.setup(manager)
+    store.create(api.new_notebook("mynb", "ns", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-4"}))
+    drain(manager, include_delayed_under=0.1)
+    pod = store.list("Pod", "ns", {names.NOTEBOOK_NAME_LABEL: "mynb"})[0]
+    first_uid = k8s.uid(pod)
+    cond = api.get_condition(store.get(api.KIND, "ns", "mynb"),
+                             api.CONDITION_SLICE_READY)
+    assert cond and cond["status"] == "True"
+
+    store.patch(api.KIND, "ns", "mynb",
+                {"metadata": {"labels": {"team": "research"}}})
+    drain(manager, include_delayed_under=0.1)
+    # template labels now carry the new label; the pod (created pre-edit)
+    # does not — it must still be owned, counted ready, and NOT restarted
+    # by the label change alone (template containers are unchanged)
+    sts = store.get("StatefulSet", "ns", "mynb")
+    assert sts["spec"]["template"]["metadata"]["labels"]["team"] == \
+        "research"
+    pods = store.list("Pod", "ns", {names.NOTEBOOK_NAME_LABEL: "mynb"})
+    assert len(pods) == 1 and k8s.uid(pods[0]) == first_uid
+    assert sts["status"]["readyReplicas"] == 1
+    cond = api.get_condition(store.get(api.KIND, "ns", "mynb"),
+                             api.CONDITION_SLICE_READY)
+    assert cond["status"] == "True"
