@@ -5,8 +5,8 @@ PYTHON ?= python
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: help test test-fast test-chaos test-transport gate lint manifests \
-        manifests-check check-license bench numerics dryrun loadtest run \
-        run-split
+        manifests-check check-license bench numerics ctx-sweep mfu-ab \
+        dryrun loadtest run run-split
 
 help: ## Display this help.
 	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_-]+:.*?##/ {printf "  %-16s %s\n", $$1, $$2}' $(MAKEFILE_LIST)
@@ -43,6 +43,12 @@ bench: ## Benchmarks (JSON lines; real TPU when the tunnel is live).
 
 numerics: ## On-chip Pallas kernel validation (requires a live TPU).
 	$(PYTHON) ci/tpu_numerics.py
+
+ctx-sweep: ## remat × CE-chunk × context grid on chip (requires a live TPU).
+	$(PYTHON) ci/tpu_ctx_sweep.py
+
+mfu-ab: ## Per-lever train-step MFU A/B on chip (requires a live TPU).
+	$(PYTHON) ci/tpu_mfu_ab.py
 
 dryrun: ## Multi-chip sharding dryrun on 8 + 16 virtual CPU devices.
 	$(PYTHON) __graft_entry__.py 8

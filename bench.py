@@ -49,6 +49,14 @@ import time
 BASELINE_SECONDS = 90.0
 RUNS = 5
 
+# Persistent XLA compile cache: a bench restart or A/B harness run re-pays
+# multi-minute tunnel compiles otherwise. Must be set before jax imports
+# anywhere in this process; the scratch dir is gitignored (_tpu_capture/).
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    str(pathlib.Path(__file__).resolve().parent / "_tpu_capture" /
+        "xla_cache"))
+
 # Last-good on-chip run, refreshed automatically whenever a live TPU run
 # completes (see main()). When the axon tunnel is down for the whole probe
 # window, these lines are re-emitted with ``archived: true`` + their capture
@@ -69,6 +77,7 @@ ARCHIVE_METRICS = frozenset({
     "decode_tokens_per_sec",
     "decode_int8_tokens_per_sec",
     "decode_long_ctx_tokens_per_sec",
+    "serving_tokens_per_sec",
 })
 
 # bf16 peak FLOP/s per chip, by device_kind substring (public TPU specs).
@@ -607,6 +616,127 @@ def bench_decode(info: dict) -> None:
                   "pct_hbm_roofline": pct})
 
 
+def bench_serving(info: dict) -> None:
+    """Continuous-vs-bucket batching under Poisson arrivals — the serving
+    claim as a measurement (round-3 VERDICT weak #5). Both engines face the
+    SAME arrival schedule (same seed) at each load point; the metric is end
+    -to-end generated tokens/s over the makespan (first submit → last
+    completion). Also times the engine's per-tick host sync — one packed
+    (3, slots) readback over the tunnel (runtime/serving.py _step_jit) —
+    against the unloaded decode-step time, so the "matmuls dominate" design
+    note is a number, not a hope."""
+    if info["backend"] == "cpu":
+        _emit(info, metric="serving_tokens_per_sec", value=None,
+              unit="tokens/s", vs_baseline=None,
+              skipped="serving engine bench is TPU-only")
+        return
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _flagship_config
+    from kubeflow_tpu.models.transformer import init_params
+    from kubeflow_tpu.runtime.serving import (BatchedGenerator,
+                                              ContinuousBatchedGenerator)
+
+    config = _flagship_config()
+    params = init_params(jax.random.key(0), config)
+    P, N, SLOTS = 64, 64, 8
+    rng = np.random.default_rng(0)
+
+    # per-tick host-sync cost: dispatch + readback of a FRESH packed flags
+    # buffer each rep — jax.Array caches its numpy value after the first
+    # conversion, so re-reading one buffer would time the cache, not the
+    # tunnel. The inc keeps each rep's array new, matching the engine's
+    # real per-tick shape (one step dispatch, one (3, slots) readback).
+    inc = jax.jit(lambda x: x + 1)
+    buf = jax.device_put(jnp.zeros((3, SLOTS), jnp.int32))
+    np.asarray(inc(buf))  # compile + warm the path
+    t0 = time.perf_counter()
+    reps = 50
+    for _ in range(reps):
+        buf = inc(buf)
+        np.asarray(buf)
+    sync_ms = (time.perf_counter() - t0) / reps * 1e3
+
+    def run_point(make_engine, lam_req_s: float, n_req: int,
+                  seed: int) -> dict:
+        arrivals = np.random.default_rng(seed).exponential(
+            1.0 / lam_req_s, n_req)
+        eng = make_engine()
+        try:
+            # compile warmup outside the timed window: the continuous
+            # engine compiles admit+step; the bucket engine compiles one
+            # executable per power-of-two bucket it will see under load
+            eng.generate_sync(rng.integers(0, config.vocab_size, P), N)
+            if isinstance(eng, BatchedGenerator):
+                for b in (2, 4, 8):
+                    futs = [eng.submit(
+                        rng.integers(0, config.vocab_size, P), N)
+                        for _ in range(b)]
+                    for f in futs:
+                        f.result(timeout=600)
+            futs = []
+            lat = []
+            t_start = time.perf_counter()
+            for i in range(n_req):
+                time.sleep(arrivals[i])
+                t_sub = time.perf_counter()
+                fut = eng.submit(
+                    np.random.default_rng(1000 + i).integers(
+                        0, config.vocab_size, P).astype(np.int32), N)
+                fut.add_done_callback(
+                    lambda f, t=t_sub: lat.append(time.perf_counter() - t))
+                futs.append(fut)
+            for f in futs:
+                f.result(timeout=600)
+            makespan = time.perf_counter() - t_start
+            # set_result wakes waiters before running done-callbacks: give
+            # the engine thread a beat to finish appending latencies
+            deadline = time.monotonic() + 5.0
+            while len(lat) < n_req and time.monotonic() < deadline:
+                time.sleep(0.01)
+            lat.sort()
+            return {"tokens_per_sec": round(n_req * N / makespan, 1),
+                    "makespan_s": round(makespan, 2),
+                    "latency_p50_s": round(lat[len(lat) // 2], 3),
+                    "latency_p95_s": round(lat[int(len(lat) * 0.95)], 3)}
+        finally:
+            eng.close()
+
+    # capacity probe: saturate the continuous engine (all requests at once)
+    # to place the load points — λ in requests/s of N-token completions
+    n_req = int(os.environ.get("BENCH_SERVING_NREQ", "32"))  # smoke knob
+    sat = run_point(lambda: ContinuousBatchedGenerator(
+        params, config, n_slots=SLOTS), lam_req_s=1e4,
+        n_req=min(24, n_req), seed=1)
+    cap_req_s = sat["tokens_per_sec"] / N
+
+    detail = {"prompt_len": P, "new_tokens": N, "n_slots": SLOTS,
+              "host_sync_ms_per_tick": round(sync_ms, 3),
+              "saturated": sat, "points": {}}
+    best_ratio = None
+    headline = None
+    for label, lam in (("lo_0.5x", 0.5 * cap_req_s),
+                       ("hi_0.9x", 0.9 * cap_req_s)):
+        cont = run_point(lambda: ContinuousBatchedGenerator(
+            params, config, n_slots=SLOTS), lam, n_req, seed=2)
+        buck = run_point(lambda: BatchedGenerator(
+            params, config, max_batch=SLOTS), lam, n_req, seed=2)
+        ratio = round(cont["tokens_per_sec"] /
+                      max(buck["tokens_per_sec"], 1e-9), 3)
+        detail["points"][label] = {"lambda_req_s": round(lam, 2),
+                                   "continuous": cont, "bucket": buck,
+                                   "continuous_vs_bucket": ratio}
+        best_ratio = max(best_ratio or ratio, ratio)
+        headline = cont["tokens_per_sec"]
+    _emit(info, metric="serving_tokens_per_sec", value=headline,
+          unit="tokens/s", vs_baseline=best_ratio, detail=detail,
+          note="value = continuous engine at the 0.9x-capacity load point; "
+               "vs_baseline = best continuous/bucket throughput ratio")
+
+
 # ------------------------------------------------------- control-plane bench
 def _tpu_boot_verification():
     """What a JAX notebook container does at boot: enumerate devices, form
@@ -731,7 +861,8 @@ def main() -> None:
                            "train_16k_ctx_tokens_per_sec"),
                           (bench_32k_context_train,
                            "train_32k_ctx_tokens_per_sec"),
-                          (bench_decode, "decode_tokens_per_sec")):
+                          (bench_decode, "decode_tokens_per_sec"),
+                          (bench_serving, "serving_tokens_per_sec")):
         try:
             bench(info)
         except Exception as e:  # a compute bench must never eat the headline

@@ -1,0 +1,173 @@
+"""On-chip MFU lever A/B for the flagship train step (VERDICT r3 next #3).
+
+The round-3 roofline decomposition (PERF.md) located ~26-38 ms of
+VPU/scheduling residue in the 114.9 ms step and named the levers; this
+harness measures each one on real hardware, one subprocess per
+configuration (XLA flags must be set before backend init, so in-process
+toggling is impossible):
+
+- ``f32``        — bf16_params off (the r2 baseline configuration);
+- ``base``       — bf16_params on (what bench.py ships);
+- ``lhs``        — + ``--xla_tpu_enable_latency_hiding_scheduler=true``;
+- ``vmem``       — + scoped VMEM raised to 96 MiB (deeper software
+                   pipelining headroom for the fused VPU chains);
+- ``fused_opt``  — + single-pass clip+adamw (models/train.py
+                   fused_clip_adamw) replacing optax.chain's staged trees;
+- ``combo``      — every lever that helped, together.
+
+Timing is the bench.py recipe (readback-anchored, two differenced
+iteration counts). Output: one JSON report on stdout with per-config
+tokens/s + MFU + delta vs ``base``. Usage:
+    python ci/tpu_mfu_ab.py            # full grid
+    python ci/tpu_mfu_ab.py --one '<json>'   # internal: child mode
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: F401, E402 — sets JAX_COMPILATION_CACHE_DIR before any
+# jax init; the child subprocesses inherit it, so each lever's recompile of
+# the shared (identical-HLO) parts hits the cache
+
+LHS_FLAG = "--xla_tpu_enable_latency_hiding_scheduler=true"
+VMEM_FLAG = "--xla_tpu_scoped_vmem_limit_kib=98304"
+
+CONFIGS = [
+    {"name": "f32", "bf16_params": False, "fused_adamw": False, "flags": ""},
+    {"name": "base", "bf16_params": True, "fused_adamw": False, "flags": ""},
+    {"name": "lhs", "bf16_params": True, "fused_adamw": False,
+     "flags": LHS_FLAG},
+    {"name": "vmem", "bf16_params": True, "fused_adamw": False,
+     "flags": VMEM_FLAG},
+    {"name": "fused_opt", "bf16_params": True, "fused_adamw": True,
+     "flags": ""},
+]
+
+
+def run_one(spec: dict) -> None:
+    """Child: measure the flagship step under THIS process's XLA flags."""
+    import jax
+    import jax.numpy as jnp
+
+    from __graft_entry__ import _flagship_config
+    from bench import _make_syncer, _peak_flops, _timed_iters
+    from kubeflow_tpu.models.train import (TrainConfig,
+                                           make_sharded_train_step)
+    from kubeflow_tpu.models.transformer import model_flops_per_token
+    from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+    if jax.default_backend() not in ("tpu", "axon"):
+        print(json.dumps({"error": "not on TPU"}))
+        return
+    config = _flagship_config()
+    batch, seq = 8, 1024
+    mesh = build_mesh(MeshConfig.auto(1), devices=jax.devices()[:1])
+    init_fn, step_fn = make_sharded_train_step(
+        mesh, config, TrainConfig(bf16_params=spec["bf16_params"],
+                                  fused_adamw=spec["fused_adamw"]))
+    params, opt_state = init_fn(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (batch, seq), 0,
+                                config.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+    t_c0 = time.perf_counter()
+    params, opt_state, loss = step_fn(params, opt_state, tokens, targets)
+    state = {"p": params, "o": opt_state}
+    sync = _make_syncer()
+    sync(loss)
+    compile_s = time.perf_counter() - t_c0
+
+    def run_n(n):
+        for _ in range(n):
+            state["p"], state["o"], loss = step_fn(state["p"], state["o"],
+                                                   tokens, targets)
+        sync(loss)
+    per_step = _timed_iters(run_n, counts=(3, 23))
+    tok_s = batch * seq / per_step
+    kind = getattr(jax.devices()[0], "device_kind", "tpu")
+    peak = _peak_flops(kind)
+    achieved = 3 * model_flops_per_token(config) * tok_s
+    print(json.dumps({
+        "tokens_per_sec": round(tok_s, 1),
+        "step_ms": round(per_step * 1e3, 3),
+        "mfu": round(achieved / peak, 4) if peak else None,
+        "compile_s": round(compile_s, 1),
+        "device_kind": kind,
+    }))
+
+
+def main() -> int:
+    if "--one" in sys.argv:
+        run_one(json.loads(sys.argv[sys.argv.index("--one") + 1]))
+        return 0
+
+    results = {}
+    for spec in CONFIGS:
+        env = dict(os.environ)
+        if spec["flags"]:
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " +
+                                spec["flags"]).strip()
+        t0 = time.time()
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one",
+             json.dumps(spec)],
+            env=env, capture_output=True, text=True, timeout=900)
+        out = (r.stdout or "").strip().splitlines()
+        try:
+            results[spec["name"]] = json.loads(out[-1])
+        except (IndexError, ValueError):
+            results[spec["name"]] = {
+                "error": f"rc={r.returncode}: "
+                         f"{(r.stderr or '').strip()[-300:]}"}
+        results[spec["name"]]["wall_s"] = round(time.time() - t0, 1)
+        print(json.dumps({"progress": {spec["name"]:
+                                       results[spec["name"]]}}),
+              file=sys.stderr)
+
+    # combo: every lever that beat base re-measured together (with a single
+    # winner the combo IS that config — reuse its result, skip the chip run)
+    base = results.get("base", {}).get("tokens_per_sec")
+    winners = [s for s in CONFIGS[2:]
+               if results.get(s["name"], {}).get("tokens_per_sec", 0)
+               and base and results[s["name"]]["tokens_per_sec"] > base]
+    if base and len(winners) == 1:
+        results["combo"] = dict(results[winners[0]["name"]],
+                                levers=[winners[0]["name"]])
+    elif base and winners:
+        combo = {"name": "combo", "bf16_params": True,
+                 "fused_adamw": any(s["fused_adamw"] for s in winners),
+                 "flags": " ".join(s["flags"] for s in winners
+                                   if s["flags"])}
+        env = dict(os.environ)
+        if combo["flags"]:
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " +
+                                combo["flags"]).strip()
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--one",
+             json.dumps(combo)],
+            env=env, capture_output=True, text=True, timeout=900)
+        try:
+            results["combo"] = json.loads(r.stdout.strip().splitlines()[-1])
+            results["combo"]["levers"] = [s["name"] for s in winners]
+        except (IndexError, ValueError):
+            results["combo"] = {"error": (r.stderr or "")[-300:]}
+
+    if base:
+        for name, r in results.items():
+            if r.get("tokens_per_sec"):
+                r["vs_base"] = round(r["tokens_per_sec"] / base, 4)
+    print(json.dumps({"configs": results,
+                      "batch_seq": [8, 1024],
+                      "note": "flagship train step; vs_base keyed to "
+                              "bf16_params-on/optax configuration"},
+                     indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -20,13 +20,15 @@ Usage:  python ci/tpu_numerics.py [--quick]
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench  # noqa: F401, E402 — sets JAX_COMPILATION_CACHE_DIR pre-jax
+
 import jax
 import jax.numpy as jnp
-
-sys.path.insert(0, ".")  # repo root
 
 ATOL = 2e-2  # bf16 inputs: tolerance covers bf16 rounding of large sums
 RTOL = 2e-2
@@ -135,6 +137,78 @@ def sweep_blocks(quick: bool) -> dict:
     return best
 
 
+def check_decode_numerics(quick: bool, S: int = 8192,
+                          positions: list | None = None,
+                          dims: tuple = (2, 4, 2, 128)) -> list[dict]:
+    """Flash-decode kernel (ops/decode_attention.py) on hardware vs the XLA
+    einsum path models/decode.py:252-259 dispatches to below the flash
+    threshold. Interpreter mode never exercised the TPU grid/DMA behavior —
+    in particular the ``pl.when`` block-skip past ``pos`` (round-3 VERDICT
+    weak #6). Cases: bf16 cache and int8 cache (in-register dequant), at
+    live frontiers pos ∈ {512, 4096, 8191} inside an 8192-entry cache,
+    plus a non-uniform per-batch pos vector (each row masks differently)."""
+    from kubeflow_tpu.models.decode import _quantize_kv
+    from kubeflow_tpu.ops.decode_attention import flash_decode_attention
+
+    B, G, rep, D = dims
+
+    def xla_reference(q, k, v, pos):
+        # mirrors models/decode.py einsum path, f32 accumulation
+        qf = q.astype(jnp.float32) / jnp.sqrt(jnp.float32(D))
+        kf = k.astype(jnp.float32)
+        vf = v.astype(jnp.float32)
+        logits = jnp.einsum("bgrd,bsgd->bgrs", qf, kf)
+        valid = jnp.arange(S)[None, None, None, :] <= \
+            pos[:, None, None, None]
+        logits = jnp.where(valid, logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bgrs,bsgd->bgrd", probs, vf)
+
+    key = jax.random.key(7)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, G, rep, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, G, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, G, D), jnp.bfloat16)
+    k8, ks = _quantize_kv(k)
+    v8, vs = _quantize_kv(v)
+
+    results = []
+    if positions is None:
+        positions = [512, 8191] if quick else [512, 4096, 8191]
+    cases = [("pos_uniform", p) for p in positions]
+    # ragged batch: the per-row mask is where a wrong iota axis would hide
+    cases.append(("pos_ragged", None))
+    ragged = [positions[0] + 5, positions[-1] // 2 + 3]
+    for name, p in cases:
+        pos = jnp.full((B,), p, jnp.int32) if p is not None else \
+            jnp.array(ragged, jnp.int32)
+        ref = jax.jit(xla_reference)(q, k, v, pos)
+        for variant, kwargs in (
+                ("bf16", dict()),
+                ("int8", dict(k_scale=ks, v_scale=vs))):
+            kc, vc = (k8, v8) if variant == "int8" else (k, v)
+            out = jax.jit(lambda q, kc, vc, pos, kw=kwargs:
+                          flash_decode_attention(q, kc, vc, pos, **kw))(
+                              q, kc, vc, pos)
+            if variant == "int8":
+                # int8 reference: dequantized cache through the einsum path
+                kd = k8.astype(jnp.float32) * ks[..., None]
+                vd = v8.astype(jnp.float32) * vs[..., None]
+                ref_v = jax.jit(xla_reference)(q, kd, vd, pos)
+            else:
+                ref_v = ref
+            err = _max_err(out, ref_v)
+            entry = {"kernel": "flash_decode", "case": name,
+                     "pos": p if p is not None else ragged,
+                     "cache": variant, "S": S,
+                     "fwd_rel_err": round(err, 5), "ok": err < ATOL}
+            results.append(entry)
+            print(f"  decode {name} pos={entry['pos']} {variant}: "
+                  f"{err:.2e} {'OK' if entry['ok'] else 'FAIL'}",
+                  file=sys.stderr)
+    return results
+
+
 def long_context(quick: bool) -> dict:
     """Long-sequence capability on one chip: the streaming kernel's whole
     point is that KV never materializes as an s×s matrix, so sequences far
@@ -179,15 +253,18 @@ def main() -> int:
         return 2
     print(f"backend={backend} devices={devices}", file=sys.stderr)
     numerics = check_numerics(quick)
+    decode = check_decode_numerics(quick)
     blocks = sweep_blocks(quick)
     long_ctx = long_context(quick)
     ok = all(r["ok"] for r in numerics) and \
+        all(r["ok"] for r in decode) and \
         all(r.get("ok", r.get("finite")) for r in long_ctx.values())
     print(json.dumps({
         "backend": backend,
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "numerics_ok": ok,
         "numerics": numerics,
+        "decode_numerics": decode,
         "block_sweep": blocks,
         "long_context": long_ctx,
         "wall_s": round(time.time() - t0, 1),

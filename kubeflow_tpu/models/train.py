@@ -46,6 +46,14 @@ class TrainConfig:
     # (batch 4 x seq 8192 x vocab 32k = 4 GB logits OOMs; fused runs it).
     # 0 disables fusion entirely.
     ce_chunk_tokens: int = 512
+    # single-pass clip+adamw: one tree traversal computes the clip scale
+    # application, both moment updates, bias correction, weight decay, and
+    # the parameter delta per leaf, instead of optax.chain's staged trees
+    # (clip's scaled-grad tree, adamw's mu_hat/nu_hat/update trees). Same
+    # math to float tolerance (pinned by tests/test_train.py); exists as a
+    # measured MFU lever — whether XLA already fuses optax's stages is a
+    # hardware question, answered by ci/tpu_mfu_ab.py.
+    fused_adamw: bool = False
 
 
 # above this per-step logits size the fused chunked CE engages (see
@@ -57,11 +65,78 @@ CE_FUSE_THRESHOLD_BYTES = 1.5e9
 def make_optimizer(tc: TrainConfig) -> optax.GradientTransformation:
     schedule = optax.warmup_cosine_decay_schedule(
         0.0, tc.learning_rate, tc.warmup_steps, 10_000)
+    if tc.fused_adamw:
+        return fused_clip_adamw(schedule, b1=tc.b1, b2=tc.b2,
+                                weight_decay=tc.weight_decay,
+                                grad_clip=tc.grad_clip)
     return optax.chain(
         optax.clip_by_global_norm(tc.grad_clip),
         optax.adamw(schedule, b1=tc.b1, b2=tc.b2,
                     weight_decay=tc.weight_decay),
     )
+
+
+class FusedAdamWState(NamedTuple):
+    """State of fused_clip_adamw: step count + first/second moments, the
+    moment trees shaped like the params (so opt_state_shardings maps them
+    onto the param shardings by path suffix, same as optax's mu/nu)."""
+    count: jax.Array
+    mu: object
+    nu: object
+
+
+def fused_clip_adamw(schedule, *, b1: float, b2: float,
+                     weight_decay: float, grad_clip: float,
+                     eps: float = 1e-8) -> optax.GradientTransformation:
+    """clip_by_global_norm + adamw in ONE pass per leaf.
+
+    optax.chain materializes a full intermediate tree per stage (the
+    clipped grads, mu_hat, nu_hat, the pre-decay updates, the decayed
+    updates); each is an extra HBM round-trip per parameter unless XLA
+    fuses across the stages. This transform computes the global norm
+    (the one unavoidable all-leaf reduction), then produces the update
+    and both new moments in a single jax.tree.map whose per-leaf body is
+    one elementwise chain — trivially one fusion per parameter. Matches
+    optax.chain(clip_by_global_norm, adamw) to float tolerance
+    (tests/test_train.py pins parity)."""
+
+    def init(params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        return FusedAdamWState(count=jnp.zeros((), jnp.int32),
+                               mu=zeros,
+                               nu=jax.tree.map(jnp.zeros_like, params))
+
+    def update(grads, state, params):
+        if params is None:
+            raise ValueError("fused_clip_adamw requires params "
+                             "(weight decay)")
+        gnorm = optax.global_norm(grads)
+        # optax.clip_by_global_norm semantics: scale only when over
+        scale = jnp.minimum(1.0, grad_clip / jnp.maximum(gnorm, 1e-16))
+        count = state.count + 1
+        # optax.scale_by_schedule evaluates at the PRE-increment count
+        # (first step uses schedule(0)); bias correction uses the
+        # post-increment count (first step corrects with power 1)
+        lr = schedule(state.count)
+        # bias correction folded into scalar multipliers, computed once
+        c1 = 1.0 / (1.0 - b1 ** count.astype(jnp.float32))
+        c2 = 1.0 / (1.0 - b2 ** count.astype(jnp.float32))
+
+        def leaf(g, m, v, p):
+            g = g * scale
+            m2 = b1 * m + (1.0 - b1) * g
+            v2 = b2 * v + (1.0 - b2) * g * g
+            upd = -lr * ((m2 * c1) / (jnp.sqrt(v2 * c2) + eps)
+                         + weight_decay * p)
+            return upd, m2, v2
+
+        out = jax.tree.map(leaf, grads, state.mu, state.nu, params)
+        three = jax.tree.transpose(
+            jax.tree.structure(grads), jax.tree.structure((0, 0, 0)), out)
+        updates, mu, nu = three
+        return updates, FusedAdamWState(count=count, mu=mu, nu=nu)
+
+    return optax.GradientTransformation(init, update)
 
 
 def loss_fn(params, tokens, targets, config: TransformerConfig, mesh=None,

@@ -252,17 +252,17 @@ class ContinuousBatchedGenerator:
       rows via dynamic_update_slice, plus slot-state updates — one
       compile per distinct prompt length (templated notebook prompts);
     - generated ids accumulate in a device-side (slots, cap) buffer;
-      the host reads a row back only at completion (the per-step host
-      sync is two tiny (slots,) flag vectors — the decode matmuls
-      dominate);
+      the host reads a row back only at completion. The per-step host
+      sync is ONE packed (3, slots) int32 readback (n_out / done /
+      sampled ids fused in _step_jit) — a single tunnel round-trip per
+      token, sized so the decode matmuls dominate;
     - free slots run the step as masked dummy rows (static shapes; the
       idle-row compute is the price of never recompiling).
 
     ``submit`` returns a Future resolving to the (max_new_tokens,) ids.
     Passing ``on_token`` streams each sampled id to the caller at the token
-    boundary it was generated on — the engine already schedules per token,
-    so streaming costs one extra (slots,) readback per step, and only on
-    steps where a streaming request is in flight.
+    boundary it was generated on — the ids already ride the per-step packed
+    readback, so streaming adds no extra device traffic.
     """
 
     supports_streaming = True
@@ -403,12 +403,15 @@ class ContinuousBatchedGenerator:
         # at their stale pos but are never read (mask is per-row)
         logits = jnp.where(active[:, None], logits, state["logits"])
         pos = state["pos"] + active.astype(jnp.int32)
-        # the sampled (slots,) tokens ride out alongside the state so a
-        # streaming caller can read them without indexing the out buffer
-        # (one fused readback instead of per-slot gathers)
+        # everything the host needs per tick rides ONE packed (3, slots)
+        # buffer — n_out, done, and the sampled tokens — so the scheduler
+        # pays a single device→host round-trip per token instead of three
+        # (over the axon tunnel each readback is ~ms; at decode step times
+        # of a few ms, separate readbacks would dominate the step)
+        flags = jnp.stack([n_out, done.astype(jnp.int32), token])
         return {**state, "cache": cache, "logits": logits, "pos": pos,
                 "active": active, "done": done, "out": out,
-                "n_out": n_out}, token
+                "n_out": n_out}, flags
 
     # -------------------------------------------------------------- engine
     def _free_slots(self) -> list[int]:
@@ -427,17 +430,12 @@ class ContinuousBatchedGenerator:
         if sum(s.req is not None for s in self._slots) > 1:
             self.admitted_while_running += 1
 
-    def _emit_tokens(self, token) -> None:
-        """Deliver this step's sampled ids to streaming requests. The
-        readback happens only when a streaming request is in flight; a
-        raising callback loses its own stream, never the engine loop.
-        Every slot holding a request is active (collection frees done rows
-        at the same tick they finish), so each such row sampled a real
-        token this step."""
-        if not any(s.req is not None and s.req.on_token is not None
-                   for s in self._slots):
-            return
-        ids = np.asarray(token)
+    def _emit_tokens(self, ids: np.ndarray) -> None:
+        """Deliver this step's sampled ids (already on host via the packed
+        flags readback) to streaming requests. A raising callback loses its
+        own stream, never the engine loop. Every slot holding a request is
+        active (collection frees done rows at the same tick they finish),
+        so each such row sampled a real token this step."""
         for i, slot in enumerate(self._slots):
             if slot.req is not None and slot.req.on_token is not None:
                 try:
@@ -445,9 +443,8 @@ class ContinuousBatchedGenerator:
                 except Exception:  # noqa: BLE001
                     slot.req.on_token = None
 
-    def _collect_finished(self) -> None:
-        n_out = np.asarray(self._state["n_out"])
-        done = np.asarray(self._state["done"])
+    def _collect_finished(self, n_out: np.ndarray,
+                          done: np.ndarray) -> None:
         deactivate = []
         for i, slot in enumerate(self._slots):
             if slot.req is None:
@@ -497,14 +494,16 @@ class ContinuousBatchedGenerator:
                 continue
             try:
                 self._key, sub = jax.random.split(self._key)
-                self._state, token = self._step_jit(
+                self._state, flags = self._step_jit(
                     self.params, self._state, sub, self.config, self.eos_id,
                     self.pad_id)
                 self.steps_total += 1
+                # ONE host sync per tick: the packed (3, slots) buffer
+                host = np.asarray(flags)
                 # stream BEFORE collection so every token is delivered
                 # before the request's future resolves
-                self._emit_tokens(token)
-                self._collect_finished()
+                self._emit_tokens(host[2])
+                self._collect_finished(host[0], host[1] != 0)
             except BaseException as exc:  # noqa: BLE001 — fail the batch
                 for i, slot in enumerate(self._slots):
                     if slot.req is not None and not slot.req.future.done():

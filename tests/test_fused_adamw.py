@@ -1,0 +1,100 @@
+"""Fused single-pass clip+adamw (models/train.py fused_clip_adamw).
+
+The MFU lever named in PERF.md's roofline decomposition: one tree
+traversal instead of optax.chain's staged intermediate trees. It must be
+a pure performance change — these tests pin exact update parity against
+optax.chain(clip_by_global_norm, adamw) step by step, plus integration
+through the sharded train step (incl. the bf16-master configuration the
+flagship bench runs).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from kubeflow_tpu.models.train import (TrainConfig, fused_clip_adamw,
+                                       make_optimizer,
+                                       make_sharded_train_step)
+from kubeflow_tpu.models.transformer import TransformerConfig
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+
+
+def _tree(key, scale=1.0):
+    k1, k2, k3 = jax.random.split(key, 3)
+    return {"w": jax.random.normal(k1, (8, 16)) * scale,
+            "b": jax.random.normal(k2, (16,)) * scale,
+            "blocks": {"deep": jax.random.normal(k3, (4, 8, 8)) * scale}}
+
+
+def _reference(schedule, tc: TrainConfig):
+    return optax.chain(
+        optax.clip_by_global_norm(tc.grad_clip),
+        optax.adamw(schedule, b1=tc.b1, b2=tc.b2,
+                    weight_decay=tc.weight_decay))
+
+
+@pytest.mark.parametrize("grad_scale", [1.0, 50.0])  # 50: clip engages
+def test_updates_match_optax_chain_step_by_step(grad_scale):
+    tc = TrainConfig()
+    schedule = optax.warmup_cosine_decay_schedule(
+        0.0, tc.learning_rate, tc.warmup_steps, 10_000)
+    fused = fused_clip_adamw(schedule, b1=tc.b1, b2=tc.b2,
+                             weight_decay=tc.weight_decay,
+                             grad_clip=tc.grad_clip)
+    ref = _reference(schedule, tc)
+    params = _tree(jax.random.key(0))
+    sf = fused.init(params)
+    sr = ref.init(params)
+    p_f = params
+    p_r = jax.tree.map(jnp.array, params)
+    for step in range(5):
+        grads = _tree(jax.random.key(10 + step), scale=grad_scale)
+        uf, sf = fused.update(grads, sf, p_f)
+        ur, sr = ref.update(grads, sr, p_r)
+        for a, b in zip(jax.tree.leaves(uf), jax.tree.leaves(ur)):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-8)
+        p_f = optax.apply_updates(p_f, uf)
+        p_r = optax.apply_updates(p_r, ur)
+    for a, b in zip(jax.tree.leaves(p_f), jax.tree.leaves(p_r)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-8)
+
+
+def test_requires_params():
+    fused = make_optimizer(TrainConfig(fused_adamw=True))
+    params = _tree(jax.random.key(0))
+    state = fused.init(params)
+    with pytest.raises(ValueError, match="params"):
+        fused.update(params, state, None)
+
+
+@pytest.mark.skipif(jax.device_count() < 8,
+                    reason="needs the 8-device CPU mesh")
+def test_sharded_step_loss_parity_fused_vs_optax():
+    """The flagship configuration's step (bf16 master + fused adamw) must
+    track the optax step's loss trajectory — same math, one traversal."""
+    cfg = TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                            n_heads=4, n_kv_heads=2, d_ff=128,
+                            max_seq_len=64, dtype="float32")
+    mesh = build_mesh(MeshConfig.auto(8, tp=2, fsdp=2))
+    tokens = jax.random.randint(jax.random.key(1), (8, 32), 0,
+                                cfg.vocab_size)
+    targets = jnp.roll(tokens, -1, axis=1)
+
+    losses = {}
+    for fused in (False, True):
+        init_fn, step_fn = make_sharded_train_step(
+            mesh, cfg, TrainConfig(bf16_params=True, fused_adamw=fused))
+        params, opt = init_fn(jax.random.key(0))
+        trace = []
+        for _ in range(4):
+            params, opt, loss = step_fn(params, opt, tokens, targets)
+            trace.append(float(loss))
+        losses[fused] = trace
+    # bf16 rounding of the params makes bit-exactness too strict; the
+    # trajectories must agree to bf16-grade tolerance at every step
+    np.testing.assert_allclose(losses[True], losses[False],
+                               rtol=5e-3, atol=5e-3)
