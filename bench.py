@@ -668,8 +668,10 @@ def bench_serving(info: dict) -> None:
         try:
             # compile warmup outside the timed window: the continuous
             # engine compiles admit+step; the bucket engine compiles one
-            # executable per power-of-two bucket it will see under load
-            eng.generate_sync(rng.integers(0, config.vocab_size, P), N)
+            # executable per power-of-two bucket it will see under load.
+            # Cold-cache tunnel compiles are multi-minute: 600 s budget.
+            eng.generate_sync(rng.integers(0, config.vocab_size, P), N,
+                              timeout=600.0)
             if isinstance(eng, BatchedGenerator):
                 for b in (2, 4, 8):
                     futs = [eng.submit(

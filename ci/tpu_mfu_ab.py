@@ -113,17 +113,23 @@ def main() -> int:
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " +
                                 spec["flags"]).strip()
         t0 = time.time()
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--one",
-             json.dumps(spec)],
-            env=env, capture_output=True, text=True, timeout=900)
-        out = (r.stdout or "").strip().splitlines()
         try:
-            results[spec["name"]] = json.loads(out[-1])
-        except (IndexError, ValueError):
-            results[spec["name"]] = {
-                "error": f"rc={r.returncode}: "
-                         f"{(r.stderr or '').strip()[-300:]}"}
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--one",
+                 json.dumps(spec)],
+                env=env, capture_output=True, text=True, timeout=900)
+            out = (r.stdout or "").strip().splitlines()
+            try:
+                results[spec["name"]] = json.loads(out[-1])
+            except (IndexError, ValueError):
+                results[spec["name"]] = {
+                    "error": f"rc={r.returncode}: "
+                             f"{(r.stderr or '').strip()[-300:]}"}
+        except subprocess.TimeoutExpired:
+            # one hung lever (the flag configs are exactly the risky ones)
+            # must not eat the other configs' results
+            results[spec["name"]] = {"error": "timeout after 900s "
+                                              "(compile/tunnel hang)"}
         results[spec["name"]]["wall_s"] = round(time.time() - t0, 1)
         print(json.dumps({"progress": {spec["name"]:
                                        results[spec["name"]]}}),
@@ -147,13 +153,15 @@ def main() -> int:
         if combo["flags"]:
             env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " +
                                 combo["flags"]).strip()
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__), "--one",
-             json.dumps(combo)],
-            env=env, capture_output=True, text=True, timeout=900)
         try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--one",
+                 json.dumps(combo)],
+                env=env, capture_output=True, text=True, timeout=900)
             results["combo"] = json.loads(r.stdout.strip().splitlines()[-1])
             results["combo"]["levers"] = [s["name"] for s in winners]
+        except subprocess.TimeoutExpired:
+            results["combo"] = {"error": "timeout after 900s"}
         except (IndexError, ValueError):
             results["combo"] = {"error": (r.stderr or "")[-300:]}
 

@@ -171,6 +171,18 @@ def check_decode_numerics(quick: bool, S: int = 8192,
     v = jax.random.normal(kv, (B, S, G, D), jnp.bfloat16)
     k8, ks = _quantize_kv(k)
     v8, vs = _quantize_kv(v)
+    # dequantized int8 cache for the reference path, computed once
+    kd = k8.astype(jnp.float32) * ks[..., None]
+    vd = v8.astype(jnp.float32) * vs[..., None]
+
+    # one jitted callable per (variant) — pos is traced, so every case
+    # below reuses these three compiles instead of re-tracing per case
+    jit_ref = jax.jit(xla_reference)
+    jit_bf16 = jax.jit(lambda q, k, v, pos:
+                       flash_decode_attention(q, k, v, pos))
+    jit_int8 = jax.jit(lambda q, k, v, pos:
+                       flash_decode_attention(q, k, v, pos,
+                                              k_scale=ks, v_scale=vs))
 
     results = []
     if positions is None:
@@ -182,21 +194,13 @@ def check_decode_numerics(quick: bool, S: int = 8192,
     for name, p in cases:
         pos = jnp.full((B,), p, jnp.int32) if p is not None else \
             jnp.array(ragged, jnp.int32)
-        ref = jax.jit(xla_reference)(q, k, v, pos)
-        for variant, kwargs in (
-                ("bf16", dict()),
-                ("int8", dict(k_scale=ks, v_scale=vs))):
-            kc, vc = (k8, v8) if variant == "int8" else (k, v)
-            out = jax.jit(lambda q, kc, vc, pos, kw=kwargs:
-                          flash_decode_attention(q, kc, vc, pos, **kw))(
-                              q, kc, vc, pos)
+        for variant in ("bf16", "int8"):
             if variant == "int8":
-                # int8 reference: dequantized cache through the einsum path
-                kd = k8.astype(jnp.float32) * ks[..., None]
-                vd = v8.astype(jnp.float32) * vs[..., None]
-                ref_v = jax.jit(xla_reference)(q, kd, vd, pos)
+                out = jit_int8(q, k8, v8, pos)
+                ref_v = jit_ref(q, kd, vd, pos)
             else:
-                ref_v = ref
+                out = jit_bf16(q, k, v, pos)
+                ref_v = jit_ref(q, k, v, pos)
             err = _max_err(out, ref_v)
             entry = {"kernel": "flash_decode", "case": name,
                      "pos": p if p is not None else ragged,

@@ -1,8 +1,11 @@
 from .transformer import TransformerConfig, init_params, forward, param_logical_specs
 from .moe import MoEConfig, init_moe_params, moe_forward, moe_param_logical_specs
-from .decode import init_kv_cache, prefill, decode_step, generate
+from .decode import (init_kv_cache, prefill, decode_step, decode_window,
+                     generate)
+from .speculative import SpecStats, speculative_generate
 
 __all__ = ["TransformerConfig", "init_params", "forward", "param_logical_specs",
            "MoEConfig", "init_moe_params", "moe_forward",
            "moe_param_logical_specs",
-           "init_kv_cache", "prefill", "decode_step", "generate"]
+           "init_kv_cache", "prefill", "decode_step", "decode_window",
+           "generate", "SpecStats", "speculative_generate"]

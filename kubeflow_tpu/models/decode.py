@@ -265,6 +265,87 @@ def decode_step(params: dict, cache: dict, token: jax.Array,
     return logits.astype(jnp.float32), stacked
 
 
+def _write_cache_window_rows(stacked: dict, k: jax.Array, v: jax.Array,
+                             pos: jax.Array, layer: int) -> dict:
+    """Per-row W-position write: (B, W, h, d) K/V lands at row b's
+    ``pos[b] .. pos[b]+W-1`` (the speculative-verify window — every row at
+    its own depth). Advanced-indexing scatter like _write_cache_rows."""
+    B, W = k.shape[:2]
+    rows = jnp.arange(B)[:, None]
+    cols = pos[:, None] + jnp.arange(W)[None, :]
+    if not is_kv_quantized(stacked):
+        return {
+            "k": stacked["k"].at[layer, rows, cols].set(k),
+            "v": stacked["v"].at[layer, rows, cols].set(v),
+        }
+    qk, sk = _quantize_kv(k)
+    qv, sv = _quantize_kv(v)
+    return {
+        "k": stacked["k"].at[layer, rows, cols].set(qk),
+        "v": stacked["v"].at[layer, rows, cols].set(qv),
+        "k_scale": stacked["k_scale"].at[layer, rows, cols].set(sk),
+        "v_scale": stacked["v_scale"].at[layer, rows, cols].set(sv),
+    }
+
+
+def decode_window(params: dict, cache: dict, tokens: jax.Array,
+                  pos: jax.Array, config: TransformerConfig):
+    """W tokens in, W next-token logits out — the speculative-verify step.
+
+    tokens: (B, W) consumed at positions ``pos[b] .. pos[b]+W-1``;
+    logits[:, i] is the next-token distribution after consuming
+    tokens[:, :i+1] (so ``decode_step`` is the W=1 case). One batched
+    MXU-friendly forward scores a whole drafted block — the reason
+    speculative decoding pays: W sequential target decode steps collapse
+    into one pass whose matmuls re-read the weights ONCE.
+
+    Attention is the einsum path with a two-part mask: full prefix
+    (``s <= pos+i``) plus causal structure inside the window. W is small
+    (the draft depth + 1), so the (B, G, rep, W, S) logits tensor stays
+    tiny — the flash-decode kernel's streaming form isn't needed here.
+    """
+    c = config
+    B, W = tokens.shape
+    pos32 = jnp.asarray(pos, jnp.int32)
+    if pos32.ndim == 0:
+        pos32 = jnp.broadcast_to(pos32, (B,))
+    x = params["embed"].astype(c.compute_dtype)[tokens]        # (B, W, D)
+    positions = pos32[:, None] + jnp.arange(W, dtype=jnp.int32)[None, :]
+    cos, sin = rope_frequencies(c, positions)
+    scale = 1.0 / math.sqrt(c.d_head)
+    # key position s is visible to window query i iff s <= pos + i
+    s_idx = jnp.arange(c.max_seq_len, dtype=jnp.int32)
+    valid = s_idx[None, None, :] <= positions[:, :, None]      # (B, W, S)
+
+    rep = c.n_heads // c.n_kv_heads
+    stacked = dict(cache)
+    for i in range(c.n_layers):
+        layer = jax.tree.map(lambda a: a[i], params["blocks"])
+        h = rms_norm(x, layer["attn_norm"])
+        dt = h.dtype
+        q = jnp.einsum("bsd,dhk->bshk", h, wcast(layer["wq"], dt))
+        k = jnp.einsum("bsd,dhk->bshk", h, wcast(layer["wk"], dt))
+        v = jnp.einsum("bsd,dhk->bshk", h, wcast(layer["wv"], dt))
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        stacked = _write_cache_window_rows(stacked, k, v, pos32, layer=i)
+        B_, _, H_, D_ = q.shape
+        qg = q.reshape(B_, W, c.n_kv_heads, rep, D_)
+        ck, cv = _read_cache_layer(stacked, i, dt)             # (B, S, G, D)
+        logits = jnp.einsum("bwgrd,bsgd->bgrws", qg, ck,
+                            preferred_element_type=jnp.float32) * scale
+        logits = jnp.where(valid[:, None, None], logits, -jnp.inf)
+        probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+        out = jnp.einsum("bgrws,bsgd->bwgrd", probs, cv).reshape(
+            B_, W, H_, D_)
+        x = x + jnp.einsum("bshk,hkd->bsd", out, wcast(layer["wo"], dt))
+        x = _mlp(x, layer, c)
+
+    x = rms_norm(x, params["final_norm"])
+    logits = jnp.einsum("bwd,dv->bwv", x, wcast(params["lm_head"], x.dtype))
+    return logits.astype(jnp.float32), stacked
+
+
 # ---------------------------------------------------------------- generate
 def top_k_top_p_mask(logits: jax.Array, top_k: jax.Array,
                      top_p: jax.Array) -> jax.Array:

@@ -1,0 +1,176 @@
+"""Speculative decoding: a small draft model proposes, the target verifies.
+
+Decode throughput is weight-bandwidth bound: every generated token re-reads
+the target model's weights once (PERF.md rule 4). Speculative decoding
+breaks that coupling — a cheap draft model proposes ``k`` tokens
+sequentially, then ONE target forward (models/decode.decode_window) scores
+the whole block, so the target's weights are read once per accepted-block
+instead of once per token. With a well-matched draft, accepted blocks
+average well above 1 token, multiplying target-model tokens/s.
+
+TPU-first shape of the loop:
+- everything runs under one jit: a ``lax.while_loop`` whose carry holds
+  both KV caches, the per-row output cursor, and the emit buffer — no
+  per-iteration host round-trips, no dynamic shapes;
+- acceptance is per-row (rows advance at their own rate, like continuous
+  batching), so the emit scatter uses per-row cursors with mode="drop"
+  masking instead of ragged shapes;
+- rejected draft/verify cache rows are never rolled back: positions are
+  masked by each row's live frontier, and the next block's writes overwrite
+  the stale rows in place (the same static-shape discipline as the decode
+  cache itself).
+
+Greedy only (temperature 0): acceptance is exact token match, which makes
+speculative output IDENTICAL to ``generate``'s greedy output — pinned by
+tests/test_speculative.py. Sampled speculative decoding (Leviathan-style
+accept/reject on probability ratios) is a planned extension; the verify
+window already returns full distributions.
+
+The reference (a notebook provisioning controller) has no decode path;
+this belongs to the TPU workload layer (SURVEY §2d serving).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .decode import decode_step, decode_window, prefill
+from .transformer import TransformerConfig
+
+
+class SpecStats(NamedTuple):
+    """Observability for the acceptance dynamics (per batch, summed)."""
+    blocks: jax.Array          # verify iterations run
+    drafted: jax.Array         # draft tokens proposed
+    accepted: jax.Array        # draft tokens accepted
+
+
+@partial(jax.jit,
+         static_argnames=("config", "draft_config", "max_new_tokens",
+                          "k", "eos_id", "pad_id"))
+def speculative_generate(params: dict, draft_params: dict,
+                         prompt: jax.Array, config: TransformerConfig,
+                         draft_config: TransformerConfig,
+                         max_new_tokens: int, k: int = 4,
+                         eos_id: int | None = None,
+                         pad_id: int = 0) -> tuple[jax.Array, SpecStats]:
+    """Greedy speculative decode: (batch, max_new_tokens) ids + SpecStats.
+
+    Contract matches ``generate(..., temperature=0)`` exactly, including
+    the EOS semantics (positions after a row's first EOS hold ``pad_id``).
+    Requires ``prompt_len + max_new_tokens + k <= max_seq_len`` on BOTH
+    configs (the verify window may overhang the last emitted position by
+    up to ``k`` rejected rows before they are overwritten).
+    """
+    tc, dc = config, draft_config
+    B, P = prompt.shape
+    if P + max_new_tokens + k > min(tc.max_seq_len, dc.max_seq_len):
+        raise ValueError(
+            f"prompt_len {P} + max_new_tokens {max_new_tokens} + k {k} "
+            f"exceeds max_seq_len {min(tc.max_seq_len, dc.max_seq_len)}")
+    if k < 1:
+        raise ValueError("k must be >= 1")
+
+    t_logits, t_cache = prefill(params, prompt, tc)
+    _, d_cache = prefill(draft_params, prompt, dc)
+
+    # the first generated token comes straight from the target's prefill
+    # logits — no draft needed, and it seeds the block loop's `last`
+    first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+    done0 = jnp.zeros((B,), bool)
+    if eos_id is not None:
+        done0 = first == eos_id
+    # emit buffer overhangs by k+1: a block may complete a row past
+    # max_new_tokens; the result is sliced back to max_new_tokens
+    out0 = jnp.full((B, max_new_tokens + k + 1), pad_id, jnp.int32)
+    out0 = out0.at[:, 0].set(first)
+
+    class Carry(NamedTuple):
+        t_cache: dict
+        d_cache: dict
+        last: jax.Array        # (B,) newest emitted token, not yet consumed
+        n_out: jax.Array       # (B,) tokens emitted so far
+        out: jax.Array         # (B, max_new + k + 1)
+        done: jax.Array        # (B,) row hit EOS
+        stats: SpecStats
+
+    def draft_block(d_cache, last, q_pos):
+        """k+1 sequential greedy draft steps consuming
+        [last, d_0 .. d_{k-1}] at positions q_pos .. q_pos+k → (B, k)
+        proposals + advanced cache. The extra step exists for the cache,
+        not the proposal: when all k drafts are accepted the next block
+        starts at q_pos+k+1, so the draft cache must already hold
+        d_{k-1}'s K/V at q_pos+k — without consuming it, that row would
+        be a permanent hole the draft then attends through."""
+        def body(carry, j):
+            cache, tok = carry
+            logits, cache = decode_step(draft_params, cache, tok,
+                                        q_pos + j, dc)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (cache, nxt), nxt
+        (d_cache, _), drafts = lax.scan(
+            body, (d_cache, last), jnp.arange(k + 1, dtype=jnp.int32))
+        return d_cache, jnp.moveaxis(drafts[:k], 0, 1)      # (B, k)
+
+    def block(carry: Carry) -> Carry:
+        q_pos = P + carry.n_out - 1          # (B,) position of `last`
+        d_cache, drafts = draft_block(carry.d_cache, carry.last, q_pos)
+        window = jnp.concatenate([carry.last[:, None], drafts], axis=1)
+        t_logits, t_cache = decode_window(params, carry.t_cache, window,
+                                          q_pos, tc)
+        greedy = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # (B, k+1)
+        # accept drafts while they match the target's greedy pick given
+        # the (known-correct) prefix; the first mismatch position gets the
+        # target's own token as the bonus emission
+        match = drafts == greedy[:, :k]                      # (B, k)
+        n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                        axis=1)                              # (B,) in [0, k]
+        # emitted block: drafts[0..n_acc-1] then greedy[n_acc]
+        j = jnp.arange(k + 1, dtype=jnp.int32)[None, :]      # (1, k+1)
+        emit = jnp.where(j < n_acc[:, None],
+                         jnp.pad(drafts, ((0, 0), (0, 1))),
+                         jnp.take_along_axis(greedy, jnp.minimum(
+                             j, n_acc[:, None]), axis=1))
+        emit_len = jnp.where(carry.done, 0, n_acc + 1)
+        if eos_id is not None:
+            # truncate the block at its first EOS: everything after it in
+            # THIS block is suppressed, and the row goes done
+            is_eos = (emit == eos_id) & (j < emit_len[:, None])
+            eos_before = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) \
+                - is_eos.astype(jnp.int32)
+            emit = jnp.where(eos_before > 0, pad_id, emit)
+            new_done = carry.done | jnp.any(is_eos, axis=1)
+        else:
+            new_done = carry.done
+        # scatter the block at each row's cursor; finished rows drop
+        idx = jnp.where((j < emit_len[:, None]) & ~carry.done[:, None],
+                        carry.n_out[:, None] + j,
+                        jnp.int32(out0.shape[1] + 1))        # OOB → drop
+        out = carry.out.at[jnp.arange(B)[:, None], idx].set(
+            emit, mode="drop")
+        n_out = carry.n_out + emit_len
+        last = jnp.where(carry.done, carry.last,
+                         jnp.take_along_axis(
+                             emit, jnp.maximum(emit_len - 1, 0)[:, None],
+                             axis=1)[:, 0])
+        stats = SpecStats(
+            blocks=carry.stats.blocks + 1,
+            drafted=carry.stats.drafted
+            + jnp.sum(jnp.where(carry.done, 0, k)),
+            accepted=carry.stats.accepted
+            + jnp.sum(jnp.where(carry.done, 0, n_acc)))
+        return Carry(t_cache, d_cache, last, n_out, out, new_done, stats)
+
+    def cond(carry: Carry):
+        return jnp.any((carry.n_out < max_new_tokens) & ~carry.done)
+
+    init = Carry(t_cache, d_cache, first, jnp.ones((B,), jnp.int32),
+                 out0, done0,
+                 SpecStats(jnp.int32(0), jnp.int32(0), jnp.int32(0)))
+    final = lax.while_loop(cond, block, init)
+    return final.out[:, :max_new_tokens], final.stats
