@@ -174,6 +174,20 @@ def prefill(params: dict, tokens: jax.Array, config: TransformerConfig,
     return logits.astype(jnp.float32), new_cache
 
 
+def uses_flash_decode(config: TransformerConfig) -> bool:
+    """Whether decode_step dispatches to the Pallas flash-decode kernel —
+    streaming the cache HBM→VMEM instead of materializing (B, G, rep, 1, S)
+    logits, the long-KV bandwidth path. "auto" engages on TPU once the
+    cache is long enough for the einsum's extra HBM round-trip to matter.
+    The ONE predicate: serving's spec_exact_only gate keys off it too (the
+    verify window is always the einsum path, so kernel-mix bit divergence
+    is possible exactly when this returns True)."""
+    c = config
+    return c.decode_attention == "flash" or (
+        c.decode_attention == "auto" and jax.default_backend() == "tpu"
+        and c.max_seq_len >= 2048)
+
+
 # -------------------------------------------------------------- decode step
 def decode_step(params: dict, cache: dict, token: jax.Array,
                 pos: jax.Array, config: TransformerConfig):
@@ -212,13 +226,7 @@ def decode_step(params: dict, cache: dict, token: jax.Array,
 
     rep = c.n_heads // c.n_kv_heads
     stacked = dict(cache)                            # (L, B, S, G, D) (+scales)
-    # flash-decode: stream the cache through the Pallas kernel instead of
-    # materializing (B, G, rep, 1, S) logits — the long-KV bandwidth path.
-    # "auto" engages on TPU once the cache is long enough for the einsum's
-    # extra HBM round-trip to matter.
-    use_flash = c.decode_attention == "flash" or (
-        c.decode_attention == "auto" and jax.default_backend() == "tpu"
-        and c.max_seq_len >= 2048)
+    use_flash = uses_flash_decode(c)
     pos_vec = pos32 if per_row else jnp.broadcast_to(pos32, (B,))
 
     for i in range(c.n_layers):

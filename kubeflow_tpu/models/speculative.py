@@ -20,11 +20,21 @@ TPU-first shape of the loop:
   the stale rows in place (the same static-shape discipline as the decode
   cache itself).
 
-Greedy only (temperature 0): acceptance is exact token match, which makes
-speculative output IDENTICAL to ``generate``'s greedy output — pinned by
-tests/test_speculative.py. Sampled speculative decoding (Leviathan-style
-accept/reject on probability ratios) is a planned extension; the verify
-window already returns full distributions.
+Two acceptance rules, selected per row by its traced temperature:
+- **temperature 0 (greedy)**: accept while the draft token equals the
+  target's argmax — output IDENTICAL to ``generate``'s greedy stream
+  (pinned by tests/test_speculative.py);
+- **temperature > 0 (sampled)**: the Leviathan accept/reject rule —
+  accept draft token x with probability min(1, p(x)/q(x)) where p/q are
+  the temperature-scaled target/draft distributions; on rejection sample
+  the replacement from norm(max(p − q, 0)); after a fully-accepted block
+  sample the bonus from p. Each emitted token is then distributed exactly
+  as target sampling (the residual construction cancels the draft's
+  bias), verified distributionally in the tests.
+
+``top_k``/``top_p`` warps are not supported here (both distributions
+would need the warp applied before the ratio test); ``generate`` remains
+the path for nucleus/top-k sampling.
 
 The reference (a notebook provisioning controller) has no decode path;
 this belongs to the TPU workload layer (SURVEY §2d serving).
@@ -44,10 +54,24 @@ from .transformer import TransformerConfig
 
 
 class SpecStats(NamedTuple):
-    """Observability for the acceptance dynamics (per batch, summed)."""
-    blocks: jax.Array          # verify iterations run
-    drafted: jax.Array         # draft tokens proposed
-    accepted: jax.Array        # draft tokens accepted
+    """Observability for the acceptance dynamics. ``drafted``/``accepted``
+    are PER-ROW (batch,) vectors — callers that pad the batch (the serving
+    engine's power-of-two dummy rows) sum only the rows that are real.
+    Rows stop counting once they are done or have filled max_new_tokens
+    (they keep riding the while-loop for the stragglers, but their traffic
+    is bookkeeping, not requested work)."""
+    blocks: jax.Array          # scalar: verify iterations run
+    drafted: jax.Array         # (B,) draft tokens proposed per row
+    accepted: jax.Array        # (B,) draft tokens accepted per row
+
+
+def _scaled_probs(logits: jax.Array, temperature: jax.Array) -> jax.Array:
+    """softmax(logits / temp) with temp broadcast over trailing axes; the
+    temp<=0 guard keeps the division finite (greedy rows never read it)."""
+    t = jnp.maximum(temperature, 1e-6)
+    while t.ndim < logits.ndim:
+        t = t[..., None]
+    return jax.nn.softmax(logits / t, axis=-1)
 
 
 @partial(jax.jit,
@@ -57,15 +81,20 @@ def speculative_generate(params: dict, draft_params: dict,
                          prompt: jax.Array, config: TransformerConfig,
                          draft_config: TransformerConfig,
                          max_new_tokens: int, k: int = 4,
+                         temperature: float = 0.0,
+                         key: jax.Array | None = None,
                          eos_id: int | None = None,
                          pad_id: int = 0) -> tuple[jax.Array, SpecStats]:
-    """Greedy speculative decode: (batch, max_new_tokens) ids + SpecStats.
+    """Speculative decode: (batch, max_new_tokens) ids + SpecStats.
 
-    Contract matches ``generate(..., temperature=0)`` exactly, including
-    the EOS semantics (positions after a row's first EOS hold ``pad_id``).
-    Requires ``prompt_len + max_new_tokens + k <= max_seq_len`` on BOTH
-    configs (the verify window may overhang the last emitted position by
-    up to ``k`` rejected rows before they are overwritten).
+    ``temperature`` is traced — a scalar or per-row (batch,) vector, 0 for
+    greedy rows (exact ``generate`` greedy parity) and >0 for sampled rows
+    (exact target-sampling distribution via accept/reject); mixed batches
+    share one executable. EOS semantics match ``generate`` (positions
+    after a row's first EOS hold ``pad_id``). Requires
+    ``prompt_len + max_new_tokens + k <= max_seq_len`` on BOTH configs
+    (the verify window may overhang the last emitted position by up to
+    ``k`` rejected rows before they are overwritten).
     """
     tc, dc = config, draft_config
     B, P = prompt.shape
@@ -75,13 +104,22 @@ def speculative_generate(params: dict, draft_params: dict,
             f"exceeds max_seq_len {min(tc.max_seq_len, dc.max_seq_len)}")
     if k < 1:
         raise ValueError("k must be >= 1")
+    if key is None:
+        key = jax.random.key(0)
+    temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
+    sampled = temp > 0.0                                     # (B,)
 
     t_logits, t_cache = prefill(params, prompt, tc)
     _, d_cache = prefill(draft_params, prompt, dc)
 
     # the first generated token comes straight from the target's prefill
-    # logits — no draft needed, and it seeds the block loop's `last`
-    first = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+    # logits — greedy rows argmax, sampled rows draw from p
+    key, sub = jax.random.split(key)
+    first_greedy = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+    first_sampled = jax.random.categorical(
+        sub, jnp.log(_scaled_probs(t_logits, temp) + 1e-30),
+        axis=-1).astype(jnp.int32)
+    first = jnp.where(sampled, first_sampled, first_greedy)
     done0 = jnp.zeros((B,), bool)
     if eos_id is not None:
         done0 = first == eos_id
@@ -97,46 +135,94 @@ def speculative_generate(params: dict, draft_params: dict,
         n_out: jax.Array       # (B,) tokens emitted so far
         out: jax.Array         # (B, max_new + k + 1)
         done: jax.Array        # (B,) row hit EOS
+        key: jax.Array
         stats: SpecStats
-
-    def draft_block(d_cache, last, q_pos):
-        """k+1 sequential greedy draft steps consuming
-        [last, d_0 .. d_{k-1}] at positions q_pos .. q_pos+k → (B, k)
-        proposals + advanced cache. The extra step exists for the cache,
-        not the proposal: when all k drafts are accepted the next block
-        starts at q_pos+k+1, so the draft cache must already hold
-        d_{k-1}'s K/V at q_pos+k — without consuming it, that row would
-        be a permanent hole the draft then attends through."""
-        def body(carry, j):
-            cache, tok = carry
-            logits, cache = decode_step(draft_params, cache, tok,
-                                        q_pos + j, dc)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (cache, nxt), nxt
-        (d_cache, _), drafts = lax.scan(
-            body, (d_cache, last), jnp.arange(k + 1, dtype=jnp.int32))
-        return d_cache, jnp.moveaxis(drafts[:k], 0, 1)      # (B, k)
 
     def block(carry: Carry) -> Carry:
         q_pos = P + carry.n_out - 1          # (B,) position of `last`
-        d_cache, drafts = draft_block(carry.d_cache, carry.last, q_pos)
+        key_blk, key_u, key_rej, key_bonus, key_next = jax.random.split(
+            carry.key, 5)
+
+        # k+1 sequential draft steps consuming [last, d_0 .. d_{k-1}] at
+        # positions q_pos .. q_pos+k → (B, k) proposals, their (B, k, V)
+        # draft distributions, advanced cache. The extra step exists for
+        # the cache, not the proposal: when all k drafts are accepted the
+        # next block starts at q_pos+k+1, so the draft cache must already
+        # hold d_{k-1}'s K/V at q_pos+k — without consuming it, that row
+        # would be a permanent hole the draft then attends through. Draft
+        # proposals are greedy for greedy rows and drawn from q for
+        # sampled rows (the acceptance rule needs proposals actually
+        # distributed as q).
+        def body(bcarry, j):
+            cache, tok, bkey = bcarry
+            logits, cache = decode_step(draft_params, cache, tok,
+                                        q_pos + j, dc)
+            bkey, sub = jax.random.split(bkey)
+            probs = _scaled_probs(logits, temp)
+            nxt_greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            nxt_sampled = jax.random.categorical(
+                sub, jnp.log(probs + 1e-30), axis=-1).astype(jnp.int32)
+            nxt = jnp.where(sampled, nxt_sampled, nxt_greedy)
+            return (cache, nxt, bkey), (nxt, probs)
+
+        (d_cache, _, _), (drafts_t, q_probs_t) = lax.scan(
+            body, (carry.d_cache, carry.last, key_blk),
+            jnp.arange(k + 1, dtype=jnp.int32))
+        drafts = jnp.moveaxis(drafts_t[:k], 0, 1)            # (B, k)
+        q_probs = jnp.moveaxis(q_probs_t[:k], 0, 1)          # (B, k, V)
+
         window = jnp.concatenate([carry.last[:, None], drafts], axis=1)
         t_logits, t_cache = decode_window(params, carry.t_cache, window,
                                           q_pos, tc)
-        greedy = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # (B, k+1)
-        # accept drafts while they match the target's greedy pick given
-        # the (known-correct) prefix; the first mismatch position gets the
-        # target's own token as the bonus emission
-        match = drafts == greedy[:, :k]                      # (B, k)
+        p_probs = _scaled_probs(t_logits, temp)              # (B, k+1, V)
+        greedy = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+
+        # --- acceptance, per rule ---
+        p_at_d = jnp.take_along_axis(p_probs[:, :k], drafts[..., None],
+                                     axis=-1)[..., 0]        # (B, k)
+        q_at_d = jnp.take_along_axis(q_probs, drafts[..., None],
+                                     axis=-1)[..., 0]
+        u = jax.random.uniform(key_u, (B, k))
+        match_sampled = u * q_at_d < p_at_d      # u < p/q without the div
+        match_greedy = drafts == greedy[:, :k]
+        match = jnp.where(sampled[:, None], match_sampled, match_greedy)
         n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
                         axis=1)                              # (B,) in [0, k]
-        # emitted block: drafts[0..n_acc-1] then greedy[n_acc]
+
+        # --- the block's closing token ---
+        # greedy rows: the target's own pick at the first mismatch (or the
+        # bonus after k accepts) — greedy[n_acc] covers both.
+        # sampled rows, rejection at r=n_acc<k: draw from the residual
+        # norm(max(p_r − q_r, 0)); all-accepted: draw the bonus from p_k.
+        p_r = jnp.take_along_axis(
+            p_probs, jnp.minimum(n_acc, k - 1)[:, None, None], axis=1)[:, 0]
+        q_r = jnp.take_along_axis(
+            q_probs, jnp.minimum(n_acc, k - 1)[:, None, None], axis=1)[:, 0]
+        resid = jnp.maximum(p_r - q_r, 0.0)
+        resid_mass = jnp.sum(resid, axis=-1, keepdims=True)
+        # p == q makes the residual empty; rejection then cannot happen
+        # (accept prob was 1), but guard the log anyway
+        resid = jnp.where(resid_mass > 1e-12, resid / resid_mass, p_r)
+        rej_tok = jax.random.categorical(
+            key_rej, jnp.log(resid + 1e-30), axis=-1).astype(jnp.int32)
+        p_bonus = p_probs[:, k]
+        bonus_tok = jax.random.categorical(
+            key_bonus, jnp.log(p_bonus + 1e-30), axis=-1).astype(jnp.int32)
+        tail_sampled = jnp.where(n_acc == k, bonus_tok, rej_tok)
+        tail_greedy = jnp.take_along_axis(greedy, n_acc[:, None],
+                                          axis=1)[:, 0]
+        tail = jnp.where(sampled, tail_sampled, tail_greedy)
+
+        # --- emit the block ---
         j = jnp.arange(k + 1, dtype=jnp.int32)[None, :]      # (1, k+1)
         emit = jnp.where(j < n_acc[:, None],
                          jnp.pad(drafts, ((0, 0), (0, 1))),
-                         jnp.take_along_axis(greedy, jnp.minimum(
-                             j, n_acc[:, None]), axis=1))
-        emit_len = jnp.where(carry.done, 0, n_acc + 1)
+                         tail[:, None])
+        # a row participates while un-done AND still short of max_new —
+        # full rows ride along for the stragglers without advancing
+        # cursors or stats
+        alive = ~carry.done & (carry.n_out < max_new_tokens)
+        emit_len = jnp.where(alive, n_acc + 1, 0)
         if eos_id is not None:
             # truncate the block at its first EOS: everything after it in
             # THIS block is suppressed, and the row goes done
@@ -147,30 +233,31 @@ def speculative_generate(params: dict, draft_params: dict,
             new_done = carry.done | jnp.any(is_eos, axis=1)
         else:
             new_done = carry.done
-        # scatter the block at each row's cursor; finished rows drop
-        idx = jnp.where((j < emit_len[:, None]) & ~carry.done[:, None],
+        # scatter the block at each row's cursor; non-alive rows drop
+        idx = jnp.where(j < emit_len[:, None],
                         carry.n_out[:, None] + j,
                         jnp.int32(out0.shape[1] + 1))        # OOB → drop
         out = carry.out.at[jnp.arange(B)[:, None], idx].set(
             emit, mode="drop")
         n_out = carry.n_out + emit_len
-        last = jnp.where(carry.done, carry.last,
+        last = jnp.where(alive,
                          jnp.take_along_axis(
                              emit, jnp.maximum(emit_len - 1, 0)[:, None],
-                             axis=1)[:, 0])
+                             axis=1)[:, 0],
+                         carry.last)
         stats = SpecStats(
             blocks=carry.stats.blocks + 1,
-            drafted=carry.stats.drafted
-            + jnp.sum(jnp.where(carry.done, 0, k)),
-            accepted=carry.stats.accepted
-            + jnp.sum(jnp.where(carry.done, 0, n_acc)))
-        return Carry(t_cache, d_cache, last, n_out, out, new_done, stats)
+            drafted=carry.stats.drafted + jnp.where(alive, k, 0),
+            accepted=carry.stats.accepted + jnp.where(alive, n_acc, 0))
+        return Carry(t_cache, d_cache, last, n_out, out, new_done,
+                     key_next, stats)
 
     def cond(carry: Carry):
         return jnp.any((carry.n_out < max_new_tokens) & ~carry.done)
 
     init = Carry(t_cache, d_cache, first, jnp.ones((B,), jnp.int32),
-                 out0, done0,
-                 SpecStats(jnp.int32(0), jnp.int32(0), jnp.int32(0)))
+                 out0, done0, key,
+                 SpecStats(jnp.int32(0), jnp.zeros((B,), jnp.int32),
+                           jnp.zeros((B,), jnp.int32)))
     final = lax.while_loop(cond, block, init)
     return final.out[:, :max_new_tokens], final.stats

@@ -248,7 +248,8 @@ class ServingServer:
         gen = self.generator
         out = {"status": "ok", "engine": type(gen).__name__}
         for attr in ("requests_total", "batches_total", "admitted_total",
-                     "admitted_while_running", "steps_total"):
+                     "admitted_while_running", "steps_total",
+                     "spec_batches", "spec_accepted", "spec_drafted"):
             if hasattr(gen, attr):
                 out[attr] = getattr(gen, attr)
         return out
@@ -266,7 +267,7 @@ class ServingServer:
 
 
 # -------------------------------------------------------------- entrypoint
-def build_generator(params, config, args):
+def build_generator(params, config, args, draft=None):
     from .serving import BatchedGenerator, ContinuousBatchedGenerator
     if args.engine == "bucketed":
         if args.kv_quant or args.eos_id >= 0:
@@ -274,8 +275,17 @@ def build_generator(params, config, args):
             # behavior this engine does not implement
             raise SystemExit("--kv-quant/--eos-id require "
                              "--engine continuous")
+        kw = {}
+        if draft is not None:
+            kw = dict(draft_params=draft[0], draft_config=draft[1],
+                      spec_k=args.spec_k)
         return BatchedGenerator(params, config, max_batch=args.slots,
-                                quantize=args.quantize)
+                                quantize=args.quantize, **kw)
+    if draft is not None:
+        raise SystemExit("--draft-config requires --engine bucketed "
+                         "(the continuous engine schedules single-token "
+                         "ticks; block-speculation integration is not "
+                         "implemented)")
     return ContinuousBatchedGenerator(
         params, config, n_slots=args.slots, quantize=args.quantize,
         kv_quant=args.kv_quant,
@@ -304,6 +314,17 @@ def main(argv=None) -> int:
     ap.add_argument("--kv-quant", action="store_true",
                     help="int8 KV cache (continuous engine)")
     ap.add_argument("--eos-id", type=int, default=-1)
+    ap.add_argument("--draft-config", default=None,
+                    help="JSON TransformerConfig for a speculative draft "
+                         "model (bucketed engine): un-warped batches run "
+                         "draft-propose/verify-once with identical "
+                         "outputs")
+    ap.add_argument("--draft-checkpoint", default=None,
+                    help="TrainCheckpointer dir for the draft params; "
+                         "absent with --draft-config -> random draft "
+                         "(dev only)")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="draft tokens proposed per speculative block")
     ap.add_argument("--platform", default=None,
                     help="force the jax platform (e.g. 'cpu' for dev "
                          "boxes): applied via jax.config BEFORE backend "
@@ -330,8 +351,29 @@ def main(argv=None) -> int:
         log.warning("no --checkpoint: serving randomly initialized params")
         params = init_params(jax.random.key(0), config)
 
-    server = ServingServer(build_generator(params, config, args), config,
-                           host=args.host, port=args.port).start()
+    draft = None
+    if args.draft_checkpoint and not args.draft_config:
+        raise SystemExit("--draft-checkpoint requires --draft-config")
+    if args.draft_config:
+        with open(args.draft_config) as fh:
+            draft_config = TransformerConfig(**json.load(fh))
+        if args.draft_checkpoint:
+            from .checkpoint import TrainCheckpointer, abstract_state
+            abstract = abstract_state(jax.eval_shape(
+                lambda: init_params(jax.random.key(0), draft_config)))
+            with TrainCheckpointer(args.draft_checkpoint) as ckpt:
+                restored = ckpt.restore_params(abstract)
+            if restored is None:
+                raise SystemExit(
+                    f"no checkpoint found in {args.draft_checkpoint}")
+            _, draft_params = restored
+        else:
+            log.warning("no --draft-checkpoint: random draft (dev only)")
+            draft_params = init_params(jax.random.key(1), draft_config)
+        draft = (draft_params, draft_config)
+
+    server = ServingServer(build_generator(params, config, args, draft),
+                           config, host=args.host, port=args.port).start()
     log.info("ready on %s", server.url)
     try:
         threading.Event().wait()

@@ -61,11 +61,18 @@ class BatchedGenerator:
 
     ``submit`` returns a Future resolving to the (max_new_tokens,) int32
     generated ids; ``generate_sync`` blocks for the result.
+
+    With ``draft_params``/``draft_config`` set, un-warped batches (no
+    top-k/top-p) run speculative decoding — same outputs, target weights
+    read once per accepted block; ``spec_batches``/``spec_accepted``/
+    ``spec_drafted`` expose the acceptance dynamics.
     """
 
     def __init__(self, params, config, *, max_batch: int = 8,
                  max_wait_s: float = 0.01, seed: int = 0,
-                 quantize: bool = False):
+                 quantize: bool = False, draft_params=None,
+                 draft_config=None, spec_k: int = 4,
+                 spec_exact_only: bool = True):
         if quantize:
             # int8 weight-only serving: decode is HBM-bound, so halving
             # weight bytes is 1.25-1.4x tokens/s on v5e and a 4x smaller
@@ -75,6 +82,31 @@ class BatchedGenerator:
             params = quantize_params(params)
         self.params = params
         self.config = config
+        # speculative serving: batches whose requests use no top-k/top-p
+        # warp run draft-propose/verify-once (models/speculative.py) —
+        # same outputs (exact greedy parity / exact sampling distribution),
+        # target weights read once per accepted block. Warped or
+        # near-max_seq_len batches fall back to plain generate.
+        if (draft_params is None) != (draft_config is None):
+            raise ValueError("draft_params and draft_config must be "
+                             "provided together")
+        if draft_params is not None and spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+        self.draft = (draft_params, draft_config) \
+            if draft_params is not None else None
+        self.spec_k = spec_k
+        # generate() auto-dispatches to the Pallas flash-decode kernel on
+        # TPU at max_seq_len >= 2048, while the speculative verify window
+        # is the einsum path — two kernels whose last-bit rounding can
+        # flip a near-tie greedy argmax. spec_exact_only (default) falls
+        # back to plain generate in that regime so the byte-identical
+        # contract holds everywhere it is promised; opting out trades
+        # last-bit greedy divergence for speculation on long caches
+        # (sampled requests' distributional guarantee is unaffected).
+        self.spec_exact_only = spec_exact_only
+        self.spec_batches = 0
+        self.spec_accepted = 0
+        self.spec_drafted = 0
         self.max_batch = max_batch
         self.max_wait_s = max_wait_s
         self._queue: queue.Queue = queue.Queue()
@@ -219,10 +251,32 @@ class BatchedGenerator:
         prompts = jnp.asarray(np.stack(rows))
         temps = jnp.asarray(temps_list, jnp.float32)
         self._key, sub = jax.random.split(self._key)
-        out = generate(self.params, prompts, self.config,
-                       batch[0].max_new_tokens, temperature=temps, key=sub,
-                       top_k=jnp.asarray(top_ks, jnp.int32),
-                       top_p=jnp.asarray(top_ps, jnp.float32))
+        max_new = batch[0].max_new_tokens
+        from ..models.decode import uses_flash_decode
+        use_spec = (
+            self.draft is not None
+            and all(k <= 0 for k in top_ks)        # spec has no k/p warps
+            and all(p >= 1.0 for p in top_ps)
+            and not (self.spec_exact_only and uses_flash_decode(self.config))
+            and prompts.shape[1] + max_new + self.spec_k
+            <= min(self.config.max_seq_len, self.draft[1].max_seq_len))
+        if use_spec:
+            from ..models.speculative import speculative_generate
+            out, stats = speculative_generate(
+                self.params, self.draft[0], prompts, self.config,
+                self.draft[1], max_new, k=self.spec_k, temperature=temps,
+                key=sub)
+            self.spec_batches += 1
+            # per-row stats: count only the real rows, not the
+            # power-of-two padding dummies
+            n_real = len(batch)
+            self.spec_accepted += int(stats.accepted[:n_real].sum())
+            self.spec_drafted += int(stats.drafted[:n_real].sum())
+        else:
+            out = generate(self.params, prompts, self.config, max_new,
+                           temperature=temps, key=sub,
+                           top_k=jnp.asarray(top_ks, jnp.int32),
+                           top_p=jnp.asarray(top_ps, jnp.float32))
         out = np.asarray(out)
         for i, req in enumerate(batch):
             req.future.set_result(out[i])

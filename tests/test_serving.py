@@ -161,3 +161,51 @@ def test_continuous_on_token_raising_callback_loses_stream_not_engine():
         assert len(ids) == 8
         # engine still serves subsequent requests
         assert len(gen.generate_sync([5, 6], 4, timeout=120)) == 4
+
+
+# ----------------------------------------------------- speculative serving
+def test_spec_serving_greedy_matches_plain_engine():
+    """With a draft model configured, greedy batches run speculatively and
+    must produce byte-identical results to the plain engine (the spec
+    contract), with the acceptance counters moving."""
+    params, cfg = model()
+    ps = prompts(4)
+    with BatchedGenerator(params, cfg, max_batch=4, max_wait_s=0.2) as gen:
+        want = [np.asarray(f.result(timeout=120)) for f in
+                [gen.submit(p, 8) for p in ps]]
+    with BatchedGenerator(params, cfg, max_batch=4, max_wait_s=0.2,
+                          draft_params=params, draft_config=cfg,
+                          spec_k=3) as gen:
+        got = [np.asarray(f.result(timeout=120)) for f in
+               [gen.submit(p, 8) for p in ps]]
+        assert gen.spec_batches >= 1
+        # self-draft: greedy acceptance is total
+        assert gen.spec_accepted == gen.spec_drafted > 0
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_spec_serving_falls_back_for_warped_sampling():
+    """top-k/top-p requests can't ride the speculative path (the warp
+    would have to apply to both distributions); the engine silently uses
+    plain generate for those batches."""
+    params, cfg = model()
+    with BatchedGenerator(params, cfg, max_batch=2, max_wait_s=0.2,
+                          draft_params=params, draft_config=cfg) as gen:
+        f = gen.submit(prompts(1)[0], 8, temperature=0.9, top_k=5)
+        out = f.result(timeout=120)
+        assert out.shape == (8,)
+        assert gen.spec_batches == 0
+
+
+def test_spec_serving_falls_back_near_max_seq_len():
+    """prompt + max_new inside max_seq_len but + spec_k overflowing must
+    fall back to plain generate, not raise."""
+    params, cfg = model()   # max_seq_len=32
+    with BatchedGenerator(params, cfg, max_batch=2, max_wait_s=0.2,
+                          draft_params=params, draft_config=cfg,
+                          spec_k=4) as gen:
+        f = gen.submit(prompts(1, length=20)[0], 12)  # 20+12 = 32 exactly
+        out = f.result(timeout=120)
+        assert out.shape == (12,)
+        assert gen.spec_batches == 0

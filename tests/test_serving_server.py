@@ -327,3 +327,44 @@ def test_stream_flag_must_be_boolean(server):
                         "stream": "false"})
     assert ei.value.code == 400
     assert "boolean" in json.loads(ei.value.read())["error"]
+
+
+def test_speculative_bucketed_server_end_to_end():
+    """--draft-config shape: a bucketed engine with a draft model behind
+    the HTTP server — responses identical to the plain engine, /healthz
+    exposes the acceptance counters."""
+    params, cfg = model()
+    plain = BatchedGenerator(params, cfg, max_batch=2, max_wait_s=0.05)
+    with ServingServer(plain, cfg, port=0) as srv:
+        _, want = _post(srv.url, {"prompt": list(range(6)),
+                                  "max_new_tokens": 8})
+    spec = BatchedGenerator(params, cfg, max_batch=2, max_wait_s=0.05,
+                            draft_params=params, draft_config=cfg,
+                            spec_k=2)
+    with ServingServer(spec, cfg, port=0) as srv:
+        _, got = _post(srv.url, {"prompt": list(range(6)),
+                                 "max_new_tokens": 8})
+        _, health = _get(srv.url, "/healthz")
+    assert got["ids"] == want["ids"]
+    assert health["spec_batches"] == 1
+    assert health["spec_accepted"] == health["spec_drafted"] > 0
+
+
+def test_draft_requires_continuous_rejection_and_pairing():
+    from kubeflow_tpu.runtime.server import build_generator
+    params, cfg = model()
+
+    class Args:
+        engine = "continuous"
+        slots = 2
+        quantize = False
+        kv_quant = False
+        eos_id = -1
+        spec_k = 2
+    with pytest.raises(SystemExit, match="bucketed"):
+        build_generator(params, cfg, Args(), draft=(params, cfg))
+    with pytest.raises(ValueError, match="together"):
+        BatchedGenerator(params, cfg, draft_params=params)
+    with pytest.raises(ValueError, match="spec_k"):
+        BatchedGenerator(params, cfg, draft_params=params,
+                         draft_config=cfg, spec_k=0)
