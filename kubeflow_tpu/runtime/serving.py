@@ -288,6 +288,20 @@ class _Slot:
     """Host-side bookkeeping for one engine row."""
     req: GenerateRequest | None = None
     target: int = 0          # tokens to emit for the current request
+    prefilling: bool = False  # admission in progress; row not active yet
+
+
+@dataclass
+class _Admission:
+    """Chunked-prefill progress for one slot: the prompt consumed
+    ``chunk`` tokens per engine iteration into a private single-row cache,
+    spliced into the engine state when complete."""
+    req: GenerateRequest
+    padded: np.ndarray       # (1, n_chunks * chunk) pad-extended prompt
+    real_len: int
+    row_cache: dict
+    consumed: int = 0
+    last_logits: object = None   # (1, V) at the last REAL position so far
 
 
 class ContinuousBatchedGenerator:
@@ -302,9 +316,13 @@ class ContinuousBatchedGenerator:
       compiled decode step; per-row positions drive the cache writes and
       causal masks (models/decode.decode_step with vector ``pos``), so
       rows at different depths coexist in a step;
-    - admission = a single-prompt prefill written into the slot's cache
-      rows via dynamic_update_slice, plus slot-state updates — one
-      compile per distinct prompt length (templated notebook prompts);
+    - admission is CHUNKED: the prompt streams through a private
+      single-row cache ``prefill_chunk`` tokens per engine iteration
+      (models/decode.decode_window), interleaved with decode ticks, then
+      splices into the engine state in one aliased update. In-flight
+      decodes stall at most one chunk's forward per tick instead of the
+      whole prompt's, and XLA compiles one executable per chunk size +
+      one splice — not one per distinct prompt length;
     - generated ids accumulate in a device-side (slots, cap) buffer;
       the host reads a row back only at completion. The per-step host
       sync is ONE packed (3, slots) int32 readback (n_out / done /
@@ -324,11 +342,15 @@ class ContinuousBatchedGenerator:
     def __init__(self, params, config, *, n_slots: int = 8,
                  max_new_cap: int | None = None, seed: int = 0,
                  quantize: bool = False, kv_quant: bool = False,
-                 eos_id: int | None = None, pad_id: int = 0):
+                 eos_id: int | None = None, pad_id: int = 0,
+                 prefill_chunk: int = 256):
         from ..models.decode import init_kv_cache
         if quantize:
             from ..models.quant import quantize_params
             params = quantize_params(params)
+        if prefill_chunk < 1:
+            raise ValueError(f"prefill_chunk must be >= 1, "
+                             f"got {prefill_chunk}")
         self.params = params
         self.config = config
         self.n_slots = n_slots
@@ -336,8 +358,10 @@ class ContinuousBatchedGenerator:
         self.eos_id = eos_id
         self.pad_id = pad_id
         self.kv_quant = kv_quant
+        self.prefill_chunk = prefill_chunk
         self._queue: queue.Queue = queue.Queue()
         self._slots = [_Slot() for _ in range(n_slots)]
+        self._admitting: dict[int, _Admission] = {}
         self._key = jax.random.key(seed)
         self._closed = False
         self._lifecycle = threading.Lock()
@@ -346,6 +370,7 @@ class ContinuousBatchedGenerator:
         self.admitted_total = 0
         self.admitted_while_running = 0
         self.steps_total = 0
+        self.prefill_chunks_total = 0
         self._state = {
             "cache": init_kv_cache(config, n_slots, kv_quant=kv_quant),
             "logits": jnp.zeros((n_slots, config.vocab_size), jnp.float32),
@@ -372,6 +397,8 @@ class ContinuousBatchedGenerator:
         req = GenerateRequest(np.asarray(prompt, np.int32), max_new_tokens,
                               temperature, top_k, top_p,
                               on_token=on_token)
+        if len(req.prompt) == 0:
+            raise ValueError("prompt must be non-empty")
         if len(req.prompt) + max_new_tokens > self.config.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
         with self._lifecycle:
@@ -402,14 +429,35 @@ class ContinuousBatchedGenerator:
 
     # ------------------------------------------------------- jitted kernels
     @staticmethod
-    @partial(jax.jit, static_argnames=("config", "kv_quant"))
-    def _admit_jit(params, state, prompt, slot, temp, top_k, top_p,
-                   config, kv_quant):
-        """Prefill one prompt and splice it into ``slot``'s row of the
-        engine state. One compile per distinct prompt length."""
-        from ..models.decode import prefill
-        logits_row, row_cache = prefill(params, prompt[None], config,
-                                        kv_quant=kv_quant)
+    @partial(jax.jit, static_argnames=("config",))
+    def _chunk_jit(params, row_cache, chunk, start, last_idx, config):
+        """Consume one prompt chunk into a private (L, 1, S, ...) row
+        cache (models/decode.decode_window with B=1). ``last_idx`` is the
+        in-chunk index of the last REAL token (traced: C-1 for full
+        chunks, the prompt tail's offset in the final one — padding
+        beyond it writes masked-off garbage the decode frontier later
+        overwrites) — its logits carry forward so the final chunk hands
+        the splice the prompt's next-token distribution without a
+        separate pass. One compile per chunk length, shared by every
+        prompt."""
+        from ..models.decode import decode_window
+        logits, row_cache = decode_window(params, row_cache, chunk,
+                                          start, config)
+        picked = jnp.take_along_axis(
+            logits, last_idx[None, None, None], axis=1)[:, 0]  # (1, V)
+        return row_cache, picked
+
+    @staticmethod
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def _splice_jit(state, row_cache, last_logits, slot, real_len,
+                    temp, top_k, top_p):
+        """Install a completed admission: splice the row cache into
+        ``slot``'s row of the engine cache and arm the row. One compile
+        total — chunking already erased the prompt-length shape. The old
+        engine state and the consumed row cache are donated (the caller
+        overwrites/discards both), so XLA aliases the update in place
+        instead of copying the whole (L, n_slots, S, ...) cache per
+        admission."""
         slot32 = jnp.asarray(slot, jnp.int32)
         cache = dict(state["cache"])
         for name, buf in row_cache.items():
@@ -420,8 +468,9 @@ class ContinuousBatchedGenerator:
         return {
             **state,
             "cache": cache,
-            "logits": state["logits"].at[slot32].set(logits_row[0]),
-            "pos": state["pos"].at[slot32].set(prompt.shape[0]),
+            "logits": state["logits"].at[slot32].set(last_logits[0]),
+            "pos": state["pos"].at[slot32].set(
+                jnp.asarray(real_len, jnp.int32)),
             "active": state["active"].at[slot32].set(True),
             "done": state["done"].at[slot32].set(False),
             "n_out": state["n_out"].at[slot32].set(0),
@@ -472,17 +521,60 @@ class ContinuousBatchedGenerator:
         return [i for i, s in enumerate(self._slots) if s.req is None]
 
     def _any_active(self) -> bool:
-        return any(s.req is not None for s in self._slots)
+        return any(s.req is not None and not s.prefilling
+                   for s in self._slots)
 
-    def _admit(self, req: GenerateRequest, slot: int) -> None:
-        self._state = self._admit_jit(
-            self.params, self._state, jnp.asarray(req.prompt),
-            slot, jnp.float32(req.temperature), jnp.int32(req.top_k),
-            jnp.float32(req.top_p), self.config, self.kv_quant)
-        self._slots[slot] = _Slot(req=req, target=req.max_new_tokens)
-        self.admitted_total += 1
-        if sum(s.req is not None for s in self._slots) > 1:
-            self.admitted_while_running += 1
+    def _begin_admission(self, req: GenerateRequest, slot: int) -> None:
+        """Stage a chunked admission: private row cache + pad-extended
+        prompt; _advance_admissions consumes it chunk-at-a-time."""
+        from ..models.decode import init_kv_cache
+        C = self.prefill_chunk
+        real_len = len(req.prompt)
+        n_chunks = max(1, -(-real_len // C))
+        padded = np.full((1, n_chunks * C), self.pad_id, np.int32)
+        padded[0, :real_len] = req.prompt
+        self._admitting[slot] = _Admission(
+            req=req, padded=padded, real_len=real_len,
+            row_cache=init_kv_cache(self.config, 1, kv_quant=self.kv_quant))
+        self._slots[slot] = _Slot(req=req, target=req.max_new_tokens,
+                                  prefilling=True)
+
+    def _advance_admissions(self) -> None:
+        """One prompt chunk per admitting slot, then (when a prompt
+        completes) the splice that arms its row. Interleaved with decode
+        ticks by the loop, so an in-flight decode stalls at most one
+        chunk's forward per tick instead of a whole long prompt's."""
+        C = self.prefill_chunk
+        for slot, adm in list(self._admitting.items()):
+            req = adm.req
+            try:
+                chunk = jnp.asarray(adm.padded[:, adm.consumed:
+                                               adm.consumed + C])
+                last_idx = jnp.asarray(
+                    min(adm.real_len - 1 - adm.consumed, C - 1), jnp.int32)
+                adm.row_cache, adm.last_logits = self._chunk_jit(
+                    self.params, adm.row_cache, chunk,
+                    jnp.int32(adm.consumed), last_idx, self.config)
+                adm.consumed += C
+                self.prefill_chunks_total += 1
+                if adm.consumed < adm.padded.shape[1]:
+                    continue
+                self._state = self._splice_jit(
+                    self._state, adm.row_cache, adm.last_logits,
+                    slot, adm.real_len, jnp.float32(req.temperature),
+                    jnp.int32(req.top_k), jnp.float32(req.top_p))
+                del self._admitting[slot]
+                self._slots[slot].prefilling = False
+                self.admitted_total += 1
+                if sum(s.req is not None and not s.prefilling
+                       for s in self._slots) > 1:
+                    self.admitted_while_running += 1
+            except BaseException as exc:  # noqa: BLE001 — fail THIS
+                # request; other admissions and the running batch continue
+                del self._admitting[slot]
+                self._slots[slot] = _Slot()
+                if not req.future.done():
+                    req.future.set_exception(exc)
 
     def _emit_tokens(self, ids: np.ndarray) -> None:
         """Deliver this step's sampled ids (already on host via the packed
@@ -491,7 +583,8 @@ class ContinuousBatchedGenerator:
         active (collection frees done rows at the same tick they finish),
         so each such row sampled a real token this step."""
         for i, slot in enumerate(self._slots):
-            if slot.req is not None and slot.req.on_token is not None:
+            if slot.req is not None and not slot.prefilling \
+                    and slot.req.on_token is not None:
                 try:
                     slot.req.on_token(int(ids[i]))
                 except Exception:  # noqa: BLE001
@@ -501,7 +594,7 @@ class ContinuousBatchedGenerator:
                           done: np.ndarray) -> None:
         deactivate = []
         for i, slot in enumerate(self._slots):
-            if slot.req is None:
+            if slot.req is None or slot.prefilling:
                 continue
             if n_out[i] >= slot.target or done[i]:
                 ids = np.asarray(self._state["out"][i, :slot.target])
@@ -519,9 +612,11 @@ class ContinuousBatchedGenerator:
     def _loop(self) -> None:
         draining = False
         while True:
-            # admit as many arrivals as there are free slots; block for
-            # work only when fully idle
-            block = not draining and not self._any_active()
+            # stage as many arrivals as there are free slots; block for
+            # work only when fully idle (nothing decoding, nothing
+            # admitting)
+            block = (not draining and not self._any_active()
+                     and not self._admitting)
             while not draining:
                 free = self._free_slots()
                 if not free:
@@ -532,17 +627,21 @@ class ContinuousBatchedGenerator:
                     break
                 block = False
                 if req is None:
-                    # close(): finish what's running (like BatchedGenerator
-                    # draining its current batch), admit nothing new
+                    # close(): finish what's running and what's already
+                    # admitting (those requests were accepted), admit
+                    # nothing new
                     draining = True
                     break
                 try:
-                    self._admit(req, free[0])
+                    self._begin_admission(req, free[0])
                 except BaseException as exc:  # noqa: BLE001
                     if not req.future.done():
                         req.future.set_exception(exc)
+            # one prompt chunk per admitting slot per iteration,
+            # interleaved with the decode tick below
+            self._advance_admissions()
             if not self._any_active():
-                if draining:
+                if draining and not self._admitting:
                     self._shutdown()
                     return
                 continue
@@ -563,6 +662,7 @@ class ContinuousBatchedGenerator:
                     if slot.req is not None and not slot.req.future.done():
                         slot.req.future.set_exception(exc)
                     self._slots[i] = _Slot()
+                self._admitting.clear()   # their futures just failed above
                 self._state = {**self._state,
                                "active": jnp.zeros((self.n_slots,), bool)}
 
