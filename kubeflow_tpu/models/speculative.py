@@ -34,7 +34,10 @@ Two acceptance rules, selected per row by its traced temperature:
 
 ``top_k``/``top_p`` warps are not supported here (both distributions
 would need the warp applied before the ratio test); ``generate`` remains
-the path for nucleus/top-k sampling.
+the path for nucleus/top-k sampling. MoE targets compose (the verify
+window routes (B, W) token blocks) with the usual serving caveat: expert
+capacity must be non-binding for window-vs-step routing to agree, the
+same condition models/decode.py already states for decode parity.
 
 The reference (a notebook provisioning controller) has no decode path;
 this belongs to the TPU workload layer (SURVEY §2d serving).

@@ -249,7 +249,7 @@ class ServingServer:
         out = {"status": "ok", "engine": type(gen).__name__}
         for attr in ("requests_total", "batches_total", "admitted_total",
                      "admitted_while_running", "steps_total",
-                     "prefill_chunks_total",
+                     "prefill_chunks_total", "prefix_cache_hits_total",
                      "spec_batches", "spec_accepted", "spec_drafted"):
             if hasattr(gen, attr):
                 out[attr] = getattr(gen, attr)

@@ -323,6 +323,14 @@ class ContinuousBatchedGenerator:
       decodes stall at most one chunk's forward per tick instead of the
       whole prompt's, and XLA compiles one executable per chunk size +
       one splice — not one per distinct prompt length;
+    - full prompt chunks are PREFIX-CACHED (templated notebook prompts
+      share long system/context prefixes): each fully-real chunk's K/V
+      rows are stored under the hash of the ENTIRE prefix through that
+      chunk, and a new admission skips every leading chunk whose prefix
+      hash hits — LRU-bounded by ``prefix_cache_chunks`` entries, exact
+      by construction (a hash covers all tokens that influenced the
+      rows). The final (possibly partial) chunk always computes fresh so
+      the splice has real last-token logits;
     - generated ids accumulate in a device-side (slots, cap) buffer;
       the host reads a row back only at completion. The per-step host
       sync is ONE packed (3, slots) int32 readback (n_out / done /
@@ -343,14 +351,16 @@ class ContinuousBatchedGenerator:
                  max_new_cap: int | None = None, seed: int = 0,
                  quantize: bool = False, kv_quant: bool = False,
                  eos_id: int | None = None, pad_id: int = 0,
-                 prefill_chunk: int = 256):
-        from ..models.decode import init_kv_cache
+                 prefill_chunk: int = 256, prefix_cache_chunks: int = 64):
         if quantize:
             from ..models.quant import quantize_params
             params = quantize_params(params)
         if prefill_chunk < 1:
             raise ValueError(f"prefill_chunk must be >= 1, "
                              f"got {prefill_chunk}")
+        if prefix_cache_chunks < 0:
+            raise ValueError(f"prefix_cache_chunks must be >= 0, "
+                             f"got {prefix_cache_chunks}")
         self.params = params
         self.config = config
         self.n_slots = n_slots
@@ -359,6 +369,11 @@ class ContinuousBatchedGenerator:
         self.pad_id = pad_id
         self.kv_quant = kv_quant
         self.prefill_chunk = prefill_chunk
+        # prefix cache: full-prefix hash → that chunk's (L, 1, C, ...) K/V
+        # rows on device; OrderedDict insertion order is the LRU order
+        self.prefix_cache_chunks = prefix_cache_chunks
+        self._prefix_cache: collections.OrderedDict = \
+            collections.OrderedDict()
         self._queue: queue.Queue = queue.Queue()
         self._slots = [_Slot() for _ in range(n_slots)]
         self._admitting: dict[int, _Admission] = {}
@@ -371,8 +386,22 @@ class ContinuousBatchedGenerator:
         self.admitted_while_running = 0
         self.steps_total = 0
         self.prefill_chunks_total = 0
-        self._state = {
-            "cache": init_kv_cache(config, n_slots, kv_quant=kv_quant),
+        self.prefix_cache_hits_total = 0   # chunks SKIPPED via the cache
+        self._state = self._fresh_state()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="kubeflow-tpu-cbatch")
+        self._thread.start()
+
+    def _fresh_state(self) -> dict:
+        """A zeroed engine state — built at construction and again after a
+        donated splice fails at execution (donation invalidated the old
+        buffers, so the only honest recovery is failing the batch and
+        re-arming from scratch)."""
+        from ..models.decode import init_kv_cache
+        n_slots, config = self.n_slots, self.config
+        return {
+            "cache": init_kv_cache(config, n_slots,
+                                   kv_quant=self.kv_quant),
             "logits": jnp.zeros((n_slots, config.vocab_size), jnp.float32),
             "pos": jnp.zeros((n_slots,), jnp.int32),
             "active": jnp.zeros((n_slots,), bool),
@@ -383,9 +412,6 @@ class ContinuousBatchedGenerator:
             "top_k": jnp.zeros((n_slots,), jnp.int32),
             "top_p": jnp.ones((n_slots,), jnp.float32),
         }
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="kubeflow-tpu-cbatch")
-        self._thread.start()
 
     # ----------------------------------------------------------------- API
     def submit(self, prompt, max_new_tokens: int, temperature: float = 0.0,
@@ -429,7 +455,7 @@ class ContinuousBatchedGenerator:
 
     # ------------------------------------------------------- jitted kernels
     @staticmethod
-    @partial(jax.jit, static_argnames=("config",))
+    @partial(jax.jit, static_argnames=("config",), donate_argnums=(1,))
     def _chunk_jit(params, row_cache, chunk, start, last_idx, config):
         """Consume one prompt chunk into a private (L, 1, S, ...) row
         cache (models/decode.decode_window with B=1). ``last_idx`` is the
@@ -446,6 +472,33 @@ class ContinuousBatchedGenerator:
         picked = jnp.take_along_axis(
             logits, last_idx[None, None, None], axis=1)[:, 0]  # (1, V)
         return row_cache, picked
+
+    @staticmethod
+    @partial(jax.jit, static_argnames=("chunk",))
+    def _extract_chunk_jit(row_cache, start, chunk):
+        """Copy rows [start, start+chunk) out of a (L, 1, S, ...) row
+        cache — the device-resident value stored in the prefix cache."""
+        out = {}
+        for name, buf in row_cache.items():
+            starts = (jnp.int32(0), jnp.int32(0),
+                      jnp.asarray(start, jnp.int32)) + \
+                (jnp.int32(0),) * (buf.ndim - 3)
+            sizes = (buf.shape[0], 1, chunk) + buf.shape[3:]
+            out[name] = lax.dynamic_slice(buf, starts, sizes)
+        return out
+
+    @staticmethod
+    @partial(jax.jit, donate_argnums=(0,))
+    def _insert_chunk_jit(row_cache, delta, start):
+        """Write a cached chunk's rows into a fresh row cache at
+        ``start`` (donated: the admission's cache updates in place)."""
+        out = {}
+        for name, buf in row_cache.items():
+            starts = (jnp.int32(0), jnp.int32(0),
+                      jnp.asarray(start, jnp.int32)) + \
+                (jnp.int32(0),) * (buf.ndim - 3)
+            out[name] = lax.dynamic_update_slice(buf, delta[name], starts)
+        return out
 
     @staticmethod
     @partial(jax.jit, donate_argnums=(0, 1))
@@ -524,18 +577,44 @@ class ContinuousBatchedGenerator:
         return any(s.req is not None and not s.prefilling
                    for s in self._slots)
 
+    def _prefix_key(self, prompt: np.ndarray, upto: int) -> tuple:
+        import hashlib
+        return (upto, hashlib.sha1(prompt[:upto].tobytes()).digest())
+
+    def _cacheable_chunks(self, real_len: int) -> int:
+        """How many leading chunks of a prompt are prefix-cacheable:
+        fully-real AND not the final chunk (the final chunk always
+        computes fresh so the splice has genuine last-token logits)."""
+        C = self.prefill_chunk
+        n_chunks = max(1, -(-real_len // C))
+        return min(real_len // C, n_chunks - 1)
+
     def _begin_admission(self, req: GenerateRequest, slot: int) -> None:
         """Stage a chunked admission: private row cache + pad-extended
-        prompt; _advance_admissions consumes it chunk-at-a-time."""
+        prompt; leading chunks whose full-prefix hash is cached splice in
+        directly; _advance_admissions consumes the rest chunk-at-a-time."""
         from ..models.decode import init_kv_cache
         C = self.prefill_chunk
         real_len = len(req.prompt)
         n_chunks = max(1, -(-real_len // C))
         padded = np.full((1, n_chunks * C), self.pad_id, np.int32)
         padded[0, :real_len] = req.prompt
-        self._admitting[slot] = _Admission(
+        adm = _Admission(
             req=req, padded=padded, real_len=real_len,
             row_cache=init_kv_cache(self.config, 1, kv_quant=self.kv_quant))
+        # longest run of consecutive leading chunks already in the cache
+        if self.prefix_cache_chunks:
+            for c in range(self._cacheable_chunks(real_len)):
+                key = self._prefix_key(req.prompt, (c + 1) * C)
+                delta = self._prefix_cache.get(key)
+                if delta is None:
+                    break
+                self._prefix_cache.move_to_end(key)      # LRU refresh
+                adm.row_cache = self._insert_chunk_jit(
+                    adm.row_cache, delta, jnp.int32(c * C))
+                adm.consumed += C
+                self.prefix_cache_hits_total += 1
+        self._admitting[slot] = adm
         self._slots[slot] = _Slot(req=req, target=req.max_new_tokens,
                                   prefilling=True)
 
@@ -552,29 +631,53 @@ class ContinuousBatchedGenerator:
                                                adm.consumed + C])
                 last_idx = jnp.asarray(
                     min(adm.real_len - 1 - adm.consumed, C - 1), jnp.int32)
+                start = adm.consumed
                 adm.row_cache, adm.last_logits = self._chunk_jit(
                     self.params, adm.row_cache, chunk,
-                    jnp.int32(adm.consumed), last_idx, self.config)
+                    jnp.int32(start), last_idx, self.config)
                 adm.consumed += C
                 self.prefill_chunks_total += 1
+                if self.prefix_cache_chunks and \
+                        start // C < self._cacheable_chunks(adm.real_len):
+                    key = self._prefix_key(req.prompt, start + C)
+                    self._prefix_cache[key] = self._extract_chunk_jit(
+                        adm.row_cache, jnp.int32(start), chunk=C)
+                    self._prefix_cache.move_to_end(key)
+                    while len(self._prefix_cache) > self.prefix_cache_chunks:
+                        self._prefix_cache.popitem(last=False)
                 if adm.consumed < adm.padded.shape[1]:
                     continue
-                self._state = self._splice_jit(
-                    self._state, adm.row_cache, adm.last_logits,
-                    slot, adm.real_len, jnp.float32(req.temperature),
-                    jnp.int32(req.top_k), jnp.float32(req.top_p))
-                del self._admitting[slot]
-                self._slots[slot].prefilling = False
-                self.admitted_total += 1
-                if sum(s.req is not None and not s.prefilling
-                       for s in self._slots) > 1:
-                    self.admitted_while_running += 1
             except BaseException as exc:  # noqa: BLE001 — fail THIS
                 # request; other admissions and the running batch continue
+                # (the chunk donated only the admission's private cache)
                 del self._admitting[slot]
                 self._slots[slot] = _Slot()
                 if not req.future.done():
                     req.future.set_exception(exc)
+                continue
+            try:
+                self._state = self._splice_jit(
+                    self._state, adm.row_cache, adm.last_logits,
+                    slot, adm.real_len, jnp.float32(req.temperature),
+                    jnp.int32(req.top_k), jnp.float32(req.top_p))
+            except BaseException as exc:  # noqa: BLE001 — the splice
+                # DONATED the engine state: an execution-time failure
+                # invalidated those buffers, so partial containment is
+                # impossible. Fail every in-flight request honestly and
+                # re-arm from a fresh state (the engine keeps serving).
+                for i, s in enumerate(self._slots):
+                    if s.req is not None and not s.req.future.done():
+                        s.req.future.set_exception(exc)
+                    self._slots[i] = _Slot()
+                self._admitting.clear()
+                self._state = self._fresh_state()
+                return
+            del self._admitting[slot]
+            self._slots[slot].prefilling = False
+            self.admitted_total += 1
+            if sum(s.req is not None and not s.prefilling
+                   for s in self._slots) > 1:
+                self.admitted_while_running += 1
 
     def _emit_tokens(self, ids: np.ndarray) -> None:
         """Deliver this step's sampled ids (already on host via the packed

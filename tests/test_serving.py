@@ -259,8 +259,11 @@ def test_admission_interleaves_with_decode():
         fb.result(timeout=120)
         fa.result(timeout=120)
     # A received tokens while B's chunks were being consumed: some of A's
-    # stream arrived at intermediate chunk counts (0 < chunks < 4)
-    mid = [c for _, c in seen if 0 < c < 4]
+    # stream arrived at intermediate chunk counts. A's own admission was
+    # chunk 1, so B's four chunks take the counter 2→5 — only counts
+    # STRICTLY inside that range prove interleaving (c=1 would hold even
+    # if admission stalled the loop entirely).
+    mid = [c for _, c in seen if 1 < c < 5]
     assert mid, f"admission did not interleave: {seen}"
 
 
@@ -269,3 +272,62 @@ def test_empty_prompt_rejected():
     with ContinuousBatchedGenerator(params, cfg, n_slots=2) as gen:
         with pytest.raises(ValueError, match="non-empty"):
             gen.submit(np.zeros((0,), np.int32), 4)
+
+
+# -------------------------------------------------------- prefix caching
+def test_prefix_cache_skips_shared_chunks_exactly():
+    """Two prompts sharing a 2-chunk prefix: the second admission skips
+    the shared chunks via the cache and still equals generate exactly."""
+    params, cfg = model()
+    shared = np.arange(16, dtype=np.int32) % 96          # 2 chunks at C=8
+    a = np.concatenate([shared, np.array([1, 2, 3], np.int32)])
+    b = np.concatenate([shared, np.array([7, 8, 9, 10], np.int32)])
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                    prefill_chunk=8) as gen:
+        got_a = gen.generate_sync(a, 6)
+        chunks_after_a = gen.prefill_chunks_total        # 3 fresh
+        got_b = gen.generate_sync(b, 6)
+        assert gen.prefix_cache_hits_total == 2          # both shared
+        assert gen.prefill_chunks_total == chunks_after_a + 1  # tail only
+    np.testing.assert_array_equal(
+        got_a, np.asarray(generate(params, a[None], cfg, 6))[0])
+    np.testing.assert_array_equal(
+        got_b, np.asarray(generate(params, b[None], cfg, 6))[0])
+
+
+def test_prefix_cache_no_false_hit_on_divergent_prefix():
+    """A prompt whose SECOND chunk differs must only reuse the first —
+    the key hashes the whole prefix, not the chunk alone."""
+    params, cfg = model()
+    a = np.arange(20, dtype=np.int32) % 96
+    b = a.copy()
+    b[10] = (b[10] + 1) % 96                             # inside chunk 2
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                    prefill_chunk=8) as gen:
+        gen.generate_sync(a, 4)
+        gen.generate_sync(b, 4)
+        assert gen.prefix_cache_hits_total == 1          # chunk 1 only
+    # and the divergent prompt still decodes exactly
+        got_b = gen.generate_sync(b, 4)
+    np.testing.assert_array_equal(
+        got_b, np.asarray(generate(params, b[None], cfg, 4))[0])
+
+
+def test_prefix_cache_lru_bound_and_disable():
+    params, cfg = model()
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                    prefill_chunk=4,
+                                    prefix_cache_chunks=2) as gen:
+        for seed in range(4):   # 4 distinct 3-chunk prompts: 8 cacheable
+            p = np.random.default_rng(seed).integers(
+                0, 96, 12).astype(np.int32)
+            gen.generate_sync(p, 2)
+        assert len(gen._prefix_cache) == 2               # LRU bound held
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                    prefill_chunk=4,
+                                    prefix_cache_chunks=0) as gen:
+        p = np.arange(12, dtype=np.int32)
+        gen.generate_sync(p, 2)
+        gen.generate_sync(p, 2)
+        assert gen.prefix_cache_hits_total == 0
+        assert len(gen._prefix_cache) == 0

@@ -235,3 +235,21 @@ def test_shape_validation(target, draft):
     with pytest.raises(ValueError, match="k must"):
         speculative_generate(params, dparams, _prompt(), cfg, dcfg,
                              8, k=0)
+
+
+def test_moe_target_speculative_parity():
+    """Speculation composes with the MoE family: a sparse target verified
+    through decode_window (router sees (B, W) token blocks) still matches
+    generate's greedy stream exactly, with a dense draft proposing."""
+    from kubeflow_tpu.models.moe import MoEConfig, init_moe_params
+    mcfg = MoEConfig(vocab_size=128, d_model=32, n_layers=2, n_heads=4,
+                     n_kv_heads=2, d_ff=48, dtype="float32", max_seq_len=128,
+                     n_experts=2, experts_per_token=2, capacity_factor=8.0)
+    mparams = init_moe_params(jax.random.key(0), mcfg)
+    dcfg = _cfg(n_layers=1, d_model=32)
+    dparams = init_params(jax.random.key(7), dcfg)
+    prompt = _prompt(2, 8)
+    want = generate(mparams, prompt, mcfg, 16)
+    got, _ = speculative_generate(mparams, dparams, prompt, mcfg, dcfg,
+                                  16, k=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
