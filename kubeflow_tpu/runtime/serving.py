@@ -639,12 +639,19 @@ class ContinuousBatchedGenerator:
                 self.prefill_chunks_total += 1
                 if self.prefix_cache_chunks and \
                         start // C < self._cacheable_chunks(adm.real_len):
-                    key = self._prefix_key(req.prompt, start + C)
-                    self._prefix_cache[key] = self._extract_chunk_jit(
-                        adm.row_cache, jnp.int32(start), chunk=C)
-                    self._prefix_cache.move_to_end(key)
-                    while len(self._prefix_cache) > self.prefix_cache_chunks:
-                        self._prefix_cache.popitem(last=False)
+                    try:
+                        key = self._prefix_key(req.prompt, start + C)
+                        self._prefix_cache[key] = self._extract_chunk_jit(
+                            adm.row_cache, jnp.int32(start), chunk=C)
+                        self._prefix_cache.move_to_end(key)
+                        while len(self._prefix_cache) > \
+                                self.prefix_cache_chunks:
+                            self._prefix_cache.popitem(last=False)
+                    except Exception:  # noqa: BLE001 — caching is an
+                        # optimization: an extract failure (e.g. HBM
+                        # pressure allocating the entry) must not fail a
+                        # request whose prefill already succeeded
+                        pass
                 if adm.consumed < adm.padded.shape[1]:
                     continue
             except BaseException as exc:  # noqa: BLE001 — fail THIS
@@ -661,17 +668,27 @@ class ContinuousBatchedGenerator:
                     slot, adm.real_len, jnp.float32(req.temperature),
                     jnp.int32(req.top_k), jnp.float32(req.top_p))
             except BaseException as exc:  # noqa: BLE001 — the splice
-                # DONATED the engine state: an execution-time failure
-                # invalidated those buffers, so partial containment is
-                # impossible. Fail every in-flight request honestly and
-                # re-arm from a fresh state (the engine keeps serving).
-                for i, s in enumerate(self._slots):
-                    if s.req is not None and not s.req.future.done():
-                        s.req.future.set_exception(exc)
-                    self._slots[i] = _Slot()
-                self._admitting.clear()
-                self._state = self._fresh_state()
-                return
+                # DONATES the engine state. A trace/compile-time failure
+                # happens before donation (buffers intact → contain to
+                # this request); an execution-time failure invalidated
+                # them, so the only honest recovery is failing every
+                # in-flight request and re-arming from a fresh state.
+                state_intact = not any(
+                    getattr(leaf, "is_deleted", lambda: False)()
+                    for leaf in jax.tree.leaves(self._state))
+                del self._admitting[slot]
+                self._slots[slot] = _Slot()
+                if not req.future.done():
+                    req.future.set_exception(exc)
+                if not state_intact:
+                    for i, s in enumerate(self._slots):
+                        if s.req is not None and not s.req.future.done():
+                            s.req.future.set_exception(exc)
+                        self._slots[i] = _Slot()
+                    self._admitting.clear()
+                    self._state = self._fresh_state()
+                    return
+                continue
             del self._admitting[slot]
             self._slots[slot].prefilling = False
             self.admitted_total += 1

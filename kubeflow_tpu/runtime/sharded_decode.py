@@ -1,0 +1,63 @@
+"""Tensor-parallel serving: run decode on a sharded mesh.
+
+A model whose weights exceed one chip's HBM serves by sharding over the
+``tp`` axis of a mesh: attention heads and MLP hidden split across chips
+(parallel/sharding.py DEFAULT_RULES — ``heads``/``kv_heads``/``mlp``/
+``vocab`` → tp), the KV cache inherits the head sharding from the
+sharded projections, and XLA inserts the one all-reduce per layer that
+tensor parallelism costs (after ``wo`` and ``w_down``). Nothing in
+models/decode.py changes: GSPMD propagates the input shardings through
+the same jitted ``generate``/``decode_step``/``decode_window`` —
+placement is data, not code.
+
+Decode-time note on fsdp: DEFAULT_RULES shard ``embed`` over fsdp,
+which is right for training (per-step all-gather amortized over a big
+batch) but adds a latency-path gather per token when serving. A serving
+mesh should set ``fsdp=1`` (all axes exist, unused ones at size 1 —
+parallel/mesh.py MeshConfig.auto(n, tp=n)) so weights shard over tp
+only; ``decode_rules()`` exists for meshes that must keep a real fsdp
+axis, mapping ``embed`` to None instead.
+
+The reference (a notebook provisioning controller) has no serving path;
+this is the TPU workload layer's scale-out serving story (SURVEY §2d:
+ICI-collective work happens inside the provisioned containers).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+from ..models.transformer import TransformerConfig, param_logical_specs
+from ..parallel.sharding import PartitionRules, param_shardings
+
+DEFAULT_RULES = PartitionRules().rules
+
+
+def decode_rules() -> PartitionRules:
+    """DEFAULT_RULES with ``embed`` replicated: on a mesh that keeps a
+    real fsdp axis, fsdp-sharded weights would cost an all-gather on the
+    per-token latency path — serving wants them resident."""
+    rules = tuple((k, None) if k == "embed" else (k, v)
+                  for k, v in DEFAULT_RULES)
+    return PartitionRules(rules=rules)
+
+
+def shard_decode_params(params: dict, mesh: Mesh,
+                        config: TransformerConfig,
+                        rules: PartitionRules | None = None) -> dict:
+    """Place a params pytree onto ``mesh`` with the serving layout.
+
+    Works for the dense and MoE families (specs chosen by config type).
+    The returned tree feeds the ordinary ``generate``/``decode_step``/
+    ``speculative_generate``/serving engines unchanged — every jitted
+    decode function picks the mesh up from its inputs.
+    """
+    from ..models.moe import MoEConfig, moe_param_logical_specs
+    if isinstance(config, MoEConfig):
+        specs = moe_param_logical_specs(config)
+    else:
+        specs = param_logical_specs(config)
+    shardings = param_shardings(mesh, specs,
+                                rules or decode_rules())
+    return jax.device_put(params, shardings)
