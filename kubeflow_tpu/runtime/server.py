@@ -51,16 +51,60 @@ class ServingServer:
     """HTTP front for a generation engine. ``generator`` is either
     engine class (both expose submit/generate_sync/close)."""
 
+    ENGINE_COUNTERS = (
+        "requests_total", "batches_total", "admitted_total",
+        "admitted_while_running", "steps_total", "prefill_chunks_total",
+        "prefix_cache_hits_total", "spec_batches", "spec_accepted",
+        "spec_drafted")
+
     def __init__(self, generator, config, *, host: str = "127.0.0.1",
                  port: int = 8890, request_timeout_s: float = 300.0):
+        from ..utils.metrics import MetricsRegistry
         self.generator = generator
         self.config = config
         self.request_timeout_s = request_timeout_s
+        # Prometheus exposition (GET /metrics): engine counters mirrored at
+        # scrape time, plus the HTTP layer's own request/latency series —
+        # the serving analog of the controller's metrics endpoint
+        self.metrics = MetricsRegistry(include_notebook_metrics=False)
+        self._m_http = self.metrics.counter(
+            "serving_http_requests_total",
+            "HTTP requests by route and status code")
+        self._m_lat_sum = self.metrics.counter(
+            "serving_generate_seconds_sum",
+            "Cumulative wall seconds spent in /v1/generate requests")
+        self._m_lat_count = self.metrics.counter(
+            "serving_generate_seconds_count",
+            "Completed /v1/generate requests")
+        engine_metrics = {
+            name: self.metrics.gauge(
+                f"serving_engine_{name}",
+                f"Engine counter {name} (mirrored at scrape)")
+            for name in self.ENGINE_COUNTERS if hasattr(generator, name)}
+
+        def mirror_engine() -> None:
+            for name, metric in engine_metrics.items():
+                metric.set(float(getattr(self.generator, name)))
+        self.metrics.on_scrape(mirror_engine)
         server = self
+
+        KNOWN_ROUTES = frozenset(
+            {"/healthz", "/v1/models", "/metrics", "/v1/generate"})
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
                 log.debug("http: " + fmt, *args)
+
+            def _count(self, code: int) -> None:
+                # unknown paths collapse to one label bucket: the route
+                # label must not be attacker-controlled cardinality (a
+                # crawler probing thousands of paths would otherwise leak
+                # one permanent series per path)
+                route = self.path.split("?")[0]
+                if route not in KNOWN_ROUTES:
+                    route = "other"
+                server._m_http.inc({"route": route, "method": self.command,
+                                    "code": str(code)})
 
             def _json(self, code: int, payload: dict) -> None:
                 body = json.dumps(payload).encode()
@@ -69,12 +113,22 @@ class ServingServer:
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+                self._count(code)
 
             def do_GET(self):
                 if self.path == "/healthz":
                     self._json(200, server.health())
                 elif self.path == "/v1/models":
                     self._json(200, server.model_info())
+                elif self.path == "/metrics":
+                    body = server.metrics.expose().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    self._count(200)
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
 
@@ -97,8 +151,12 @@ class ServingServer:
                         raise ValueError("'stream' must be a boolean")
                     if stream:
                         server.stream_generate(req, self)
+                        self._count(200)
                         return
+                    t0 = time.monotonic()
                     out = server.generate(req)
+                    server._m_lat_sum.inc(by=time.monotonic() - t0)
+                    server._m_lat_count.inc()
                     self._json(200, out)
                 except (ValueError, KeyError, TypeError) as e:
                     self._json(400, {"error": str(e)})
@@ -247,10 +305,7 @@ class ServingServer:
     def health(self) -> dict:
         gen = self.generator
         out = {"status": "ok", "engine": type(gen).__name__}
-        for attr in ("requests_total", "batches_total", "admitted_total",
-                     "admitted_while_running", "steps_total",
-                     "prefill_chunks_total", "prefix_cache_hits_total",
-                     "spec_batches", "spec_accepted", "spec_drafted"):
+        for attr in self.ENGINE_COUNTERS:
             if hasattr(gen, attr):
                 out[attr] = getattr(gen, attr)
         return out

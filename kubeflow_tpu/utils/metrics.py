@@ -65,9 +65,13 @@ class MetricsRegistry:
     mirrors the reference's collector that computes ``notebook_running`` at
     scrape time by listing StatefulSets (metrics.go:60-99)."""
 
-    def __init__(self) -> None:
+    def __init__(self, include_notebook_metrics: bool = True) -> None:
         self._metrics: dict[str, _Metric] = {}
         self._scrape_callbacks: list[Callable[[], None]] = []
+        if not include_notebook_metrics:
+            # a non-controller process (e.g. the serving server) wants the
+            # registry machinery without the reference's notebook series
+            return
         self.notebook_create_total = self.counter(
             "notebook_create_total", "Total times of creating notebooks")
         self.notebook_create_failed_total = self.counter(

@@ -368,3 +368,24 @@ def test_draft_requires_continuous_rejection_and_pairing():
     with pytest.raises(ValueError, match="spec_k"):
         BatchedGenerator(params, cfg, draft_params=params,
                          draft_config=cfg, spec_k=0)
+
+
+def test_metrics_endpoint_prometheus_format():
+    """GET /metrics: Prometheus text exposition with the engine counters
+    mirrored at scrape time and the HTTP layer's own series — the serving
+    analog of the controller metrics endpoint."""
+    params, cfg = model()
+    gen = ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                     prefill_chunk=8)
+    with ServingServer(gen, cfg, port=0) as srv:
+        _post(srv.url, {"prompt": list(range(10)), "max_new_tokens": 4})
+        with urllib.request.urlopen(srv.url + "/metrics",
+                                    timeout=30) as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+    assert "# TYPE serving_engine_steps_total gauge" in text
+    assert "serving_engine_prefill_chunks_total 2" in text
+    assert "serving_generate_seconds_count 1" in text
+    assert 'serving_http_requests_total{code="200",method="POST",route="/v1/generate"} 1' in text
+    # notebook controller series must NOT leak into the serving process
+    assert "notebook_create_total" not in text
