@@ -4,12 +4,18 @@ The last mile of the serving story: a provisioned notebook that serves
 its model needs a wire protocol, not just a Python API. This is a
 stdlib-only JSON-over-HTTP server in the shape such endpoints take:
 
-    POST /v1/generate   {"prompt": [ids...], "max_new_tokens": N,
+    POST /v1/generate   {"prompt": [ids...] | "text": "...",
+                         "max_new_tokens": N,
                          "temperature": t, "top_k": k, "top_p": p}
-                      → {"ids": [ids...]}
+                      → {"ids": [ids...], "text": "..." (text mode)}
                         with "stream": true → text/event-stream, one
-                        data: {"token": id} event per token as generated,
-                        then data: {"done": true, "ids": [...]}
+                        data: {"token": id, "text": delta?} event per
+                        token as generated, then
+                        data: {"done": true, "ids": [...], "text"?}
+                        ("text" requires --tokenizer; stream deltas use
+                        incremental detokenization)
+    GET  /metrics       Prometheus text exposition (engine counters +
+                        HTTP request/latency series)
     GET  /healthz       liveness + engine stats (what the culler's
                         activity probe and the auth sidecar front)
     GET  /v1/models     the serving configuration (model shape, engine,
@@ -47,6 +53,35 @@ log = logging.getLogger("kubeflow_tpu.serving_server")
 MAX_BODY_BYTES = 8 << 20  # an 8 MB prompt is a client error, not an OOM
 
 
+class IncrementalDetokenizer:
+    """Streaming detokenization in the standard (HF TextStreamer / vLLM)
+    form: decode a trailing id window, withhold output while it ends in
+    U+FFFD (a multi-byte character still split across tokens), advance
+    the window offsets once the text stabilizes. O(total ids) — the
+    window stays small because the prefix offset advances — and correct
+    for byte-level BPE, where decode() can REWRITE the tail rather than
+    extend it. Genuinely invalid byte sequences (a model emitting bytes,
+    not text) surface as U+FFFD once a following token forces the window
+    to stabilize — held forever would stall the stream."""
+
+    def __init__(self, tokenizer):
+        self.tokenizer = tokenizer
+        self._ids: list[int] = []
+        self._prefix = 0
+        self._read = 0
+
+    def feed(self, tok: int) -> str:
+        """One generated id in → the text delta now safe to emit."""
+        self._ids.append(tok)
+        window = self.tokenizer.decode(self._ids[self._prefix:])
+        if window.endswith("�"):
+            return ""                     # held back until complete
+        prev = self.tokenizer.decode(self._ids[self._prefix:self._read])
+        self._prefix = self._read
+        self._read = len(self._ids)
+        return window[len(prev):]
+
+
 class ServingServer:
     """HTTP front for a generation engine. ``generator`` is either
     engine class (both expose submit/generate_sync/close)."""
@@ -58,11 +93,17 @@ class ServingServer:
         "spec_drafted")
 
     def __init__(self, generator, config, *, host: str = "127.0.0.1",
-                 port: int = 8890, request_timeout_s: float = 300.0):
+                 port: int = 8890, request_timeout_s: float = 300.0,
+                 tokenizer=None):
         from ..utils.metrics import MetricsRegistry
         self.generator = generator
         self.config = config
         self.request_timeout_s = request_timeout_s
+        # duck-typed: anything with encode(text, add_special_tokens=False)
+        # -> ids and decode(ids) -> text (a transformers tokenizer works).
+        # With one configured, requests may pass "text" instead of
+        # "prompt" ids and responses/stream events carry decoded text.
+        self.tokenizer = tokenizer
         # Prometheus exposition (GET /metrics): engine counters mirrored at
         # scrape time, plus the HTTP layer's own request/latency series —
         # the serving analog of the controller's metrics endpoint
@@ -150,7 +191,10 @@ class ServingServer:
                         # truthiness here silently switches content types
                         raise ValueError("'stream' must be a boolean")
                     if stream:
+                        t0 = time.monotonic()
                         server.stream_generate(req, self)
+                        server._m_lat_sum.inc(by=time.monotonic() - t0)
+                        server._m_lat_count.inc()
                         self._count(200)
                         return
                     t0 = time.monotonic()
@@ -209,26 +253,64 @@ class ServingServer:
         self.stop()
 
     # ------------------------------------------------------------- handlers
-    @staticmethod
-    def _validate(req: dict):
+    def _validate(self, req: dict):
         prompt = req.get("prompt")
-        if not isinstance(prompt, list) or not prompt or \
+        text = req.get("text")
+        if (prompt is None) == (text is None):
+            raise ValueError("provide exactly one of 'prompt' (token ids)"
+                             " or 'text'")
+        if text is not None:
+            if self.tokenizer is None:
+                raise ValueError("'text' requires the server to be "
+                                 "started with a tokenizer "
+                                 "(--tokenizer DIR)")
+            if not isinstance(text, str) or not text:
+                raise ValueError("'text' must be a non-empty string")
+            prompt = list(self.tokenizer.encode(
+                text, add_special_tokens=False))
+            if not prompt:
+                raise ValueError("'text' tokenized to an empty prompt")
+            if max(prompt) >= self.config.vocab_size:
+                raise ValueError(
+                    f"tokenizer produced id {max(prompt)} outside the "
+                    f"model vocab ({self.config.vocab_size}) — wrong "
+                    f"tokenizer for this model")
+        elif not isinstance(prompt, list) or not prompt or \
                 not all(isinstance(t, int) for t in prompt):
             raise ValueError("'prompt' must be a non-empty list of "
                              "token ids")
+        if not all(0 <= t < self.config.vocab_size for t in prompt):
+            # an out-of-range id would hit XLA's clamping gather and
+            # return a silently-wrong embedding row, not an error
+            raise ValueError(f"prompt ids must be in [0, "
+                             f"{self.config.vocab_size})")
         max_new = req.get("max_new_tokens", 64)
         if not isinstance(max_new, int) or max_new < 1:
             raise ValueError("'max_new_tokens' must be a positive integer")
         return (np.asarray(prompt, np.int32), max_new,
                 float(req.get("temperature", 0.0)),
-                int(req.get("top_k", 0)), float(req.get("top_p", 1.0)))
+                int(req.get("top_k", 0)), float(req.get("top_p", 1.0)),
+                text is not None)
+
+    def _live_ids(self, ids) -> list[int]:
+        """The generated ids up to (and excluding) the engine's EOS —
+        the pad filler after it AND the EOS token's own surface form do
+        not belong in client-facing text."""
+        ids = [int(t) for t in ids]
+        eos = getattr(self.generator, "eos_id", None)
+        if eos is not None and eos in ids:
+            ids = ids[:ids.index(eos)]
+        return ids
 
     def generate(self, req: dict) -> dict:
-        prompt, max_new, temp, top_k, top_p = self._validate(req)
+        prompt, max_new, temp, top_k, top_p, was_text = self._validate(req)
         ids = self.generator.generate_sync(
             prompt, max_new, temp, top_k=top_k, top_p=top_p,
             timeout=self.request_timeout_s)
-        return {"ids": [int(t) for t in ids]}
+        out = {"ids": [int(t) for t in ids]}
+        if was_text:
+            out["text"] = self.tokenizer.decode(self._live_ids(ids))
+        return out
 
     def stream_generate(self, req: dict, handler) -> None:
         """``"stream": true``: per-token SSE emission. The engine already
@@ -246,7 +328,7 @@ class ServingServer:
         EOS) and ``n_tokens`` counts the token events that preceded it.
         The response is delimited by connection close (no
         Content-Length)."""
-        prompt, max_new, temp, top_k, top_p = self._validate(req)
+        prompt, max_new, temp, top_k, top_p, was_text = self._validate(req)
         if not getattr(self.generator, "supports_streaming", False):
             raise ValueError(
                 f"engine {type(self.generator).__name__} does not "
@@ -254,6 +336,24 @@ class ServingServer:
         q: queue.Queue = queue.Queue()
         future = self.generator.submit(prompt, max_new, temp, top_k=top_k,
                                        top_p=top_p, on_token=q.put)
+
+        # text mode: each token event carries the incremental decoded
+        # suffix (IncrementalDetokenizer — held back while a multi-byte
+        # character is still split across tokens)
+        detok = IncrementalDetokenizer(self.tokenizer) if was_text else None
+        eos = getattr(self.generator, "eos_id", None)
+
+        def token_payload(tok: int) -> dict:
+            payload = {"token": tok}
+            if detok is None:
+                return payload
+            if eos is not None and tok == eos:
+                # the done event's text excludes the EOS surface form;
+                # its own stream event must agree
+                payload["text"] = ""
+                return payload
+            payload["text"] = detok.feed(tok)
+            return payload
 
         handler.send_response(200)
         handler.send_header("Content-Type", "text/event-stream")
@@ -276,7 +376,7 @@ class ServingServer:
             try:
                 tok = q.get(timeout=min(0.25, max(0.0, t_end -
                                                   time.monotonic())))
-                if not event({"token": tok}):
+                if not event(token_payload(tok)):
                     return
                 n_tokens += 1
                 continue
@@ -289,7 +389,7 @@ class ServingServer:
                         tok = q.get_nowait()
                     except queue.Empty:
                         break
-                    if not event({"token": tok}):
+                    if not event(token_payload(tok)):
                         return
                     n_tokens += 1
                 break
@@ -298,7 +398,10 @@ class ServingServer:
                 return
         try:
             ids = [int(t) for t in future.result(timeout=0)]
-            event({"done": True, "n_tokens": n_tokens, "ids": ids})
+            done = {"done": True, "n_tokens": n_tokens, "ids": ids}
+            if was_text:
+                done["text"] = self.tokenizer.decode(self._live_ids(ids))
+            event(done)
         except Exception as e:  # noqa: BLE001 — surface as a final event
             event({"error": f"{type(e).__name__}: {e}"})
 
@@ -314,6 +417,7 @@ class ServingServer:
         c = self.config
         return {
             "engine": type(self.generator).__name__,
+            "tokenizer": self.tokenizer is not None,
             "model": {
                 "d_model": c.d_model, "n_layers": c.n_layers,
                 "n_heads": c.n_heads, "n_kv_heads": c.n_kv_heads,
@@ -381,6 +485,10 @@ def main(argv=None) -> int:
                          "(dev only)")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens proposed per speculative block")
+    ap.add_argument("--tokenizer", default=None,
+                    help="local tokenizer directory (transformers "
+                         "AutoTokenizer, local_files_only): enables "
+                         "'text' requests and decoded responses")
     ap.add_argument("--platform", default=None,
                     help="force the jax platform (e.g. 'cpu' for dev "
                          "boxes): applied via jax.config BEFORE backend "
@@ -428,8 +536,15 @@ def main(argv=None) -> int:
             draft_params = init_params(jax.random.key(1), draft_config)
         draft = (draft_params, draft_config)
 
+    tokenizer = None
+    if args.tokenizer:
+        from transformers import AutoTokenizer
+        tokenizer = AutoTokenizer.from_pretrained(args.tokenizer,
+                                                  local_files_only=True)
+
     server = ServingServer(build_generator(params, config, args, draft),
-                           config, host=args.host, port=args.port).start()
+                           config, host=args.host, port=args.port,
+                           tokenizer=tokenizer).start()
     log.info("ready on %s", server.url)
     try:
         threading.Event().wait()

@@ -389,3 +389,162 @@ def test_metrics_endpoint_prometheus_format():
     assert 'serving_http_requests_total{code="200",method="POST",route="/v1/generate"} 1' in text
     # notebook controller series must NOT leak into the serving process
     assert "notebook_create_total" not in text
+
+
+# ----------------------------------------------------------- text mode
+def _word_tokenizer(tmp_path, vocab_size=96):
+    """A real (transformers-loadable) word-level tokenizer whose ids fit
+    the test model's vocab — built locally, no downloads."""
+    from tokenizers import Tokenizer
+    from tokenizers.models import WordLevel
+    from tokenizers.pre_tokenizers import Whitespace
+    from transformers import PreTrainedTokenizerFast
+    vocab = {f"w{i}": i for i in range(vocab_size - 1)}
+    vocab["[UNK]"] = vocab_size - 1
+    tok = Tokenizer(WordLevel(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = Whitespace()
+    fast = PreTrainedTokenizerFast(tokenizer_object=tok,
+                                   unk_token="[UNK]")
+    d = tmp_path / "tok"
+    fast.save_pretrained(str(d))
+    from transformers import AutoTokenizer
+    return AutoTokenizer.from_pretrained(str(d), local_files_only=True)
+
+
+def test_text_mode_round_trip(tmp_path):
+    """POST {'text': ...} encodes through the tokenizer, generates, and
+    returns decoded text alongside the ids; ids-mode clients see no
+    change; text without a tokenizer is a 400."""
+    params, cfg = model()
+    tok = _word_tokenizer(tmp_path)
+    gen = ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                     prefill_chunk=8)
+    with ServingServer(gen, cfg, port=0, tokenizer=tok) as srv:
+        code, out = _post(srv.url, {"text": "w1 w2 w3",
+                                    "max_new_tokens": 5})
+        assert code == 200
+        assert len(out["ids"]) == 5
+        want_text = tok.decode(out["ids"])
+        assert out["text"] == want_text
+        _, info = _get(srv.url, "/v1/models")
+        assert info["tokenizer"] is True
+        # ids mode still works and returns no text field
+        _, out2 = _post(srv.url, {"prompt": [1, 2, 3],
+                                  "max_new_tokens": 4})
+        assert "text" not in out2
+    gen2 = ContinuousBatchedGenerator(params, cfg, n_slots=2)
+    with ServingServer(gen2, cfg, port=0) as srv:
+        try:
+            _post(srv.url, {"text": "w1", "max_new_tokens": 2})
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "tokenizer" in json.loads(e.read())["error"]
+
+
+def test_text_stream_deltas_concatenate_to_final_text(tmp_path):
+    """Streaming text mode: the per-token text deltas concatenated equal
+    the final done event's text exactly (incremental detokenization)."""
+    params, cfg = model()
+    tok = _word_tokenizer(tmp_path)
+    gen = ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                     prefill_chunk=8)
+    with ServingServer(gen, cfg, port=0, tokenizer=tok) as srv:
+        req = urllib.request.Request(
+            srv.url + "/v1/generate",
+            data=json.dumps({"text": "w5 w6", "max_new_tokens": 6,
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        events = []
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers["Content-Type"] == "text/event-stream"
+            for raw in resp:
+                raw = raw.strip()
+                if raw.startswith(b"data: "):
+                    events.append(json.loads(raw[6:]))
+    done = events[-1]
+    assert done.get("done") is True
+    deltas = "".join(e["text"] for e in events[:-1])
+    assert deltas == done["text"]
+    assert len(events) - 1 == done["n_tokens"] == 6
+
+
+def test_text_mode_rejects_mismatched_tokenizer(tmp_path):
+    """A tokenizer minting ids beyond the model vocab is an operator
+    error surfaced as a 400, not a device-side gather OOB."""
+    params, cfg = model()   # vocab 96
+    tok = _word_tokenizer(tmp_path, vocab_size=200)
+    gen = ContinuousBatchedGenerator(params, cfg, n_slots=2)
+    with ServingServer(gen, cfg, port=0, tokenizer=tok) as srv:
+        try:
+            _post(srv.url, {"text": "w150", "max_new_tokens": 2})
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "vocab" in json.loads(e.read())["error"]
+
+
+def _bytelevel_tokenizer(tmp_path):
+    """Byte-level BPE (the GPT-2/Llama family shape): every byte is one
+    token, so multi-byte UTF-8 characters split across tokens."""
+    from tokenizers import Tokenizer, decoders, pre_tokenizers
+    from tokenizers.models import BPE
+    from tokenizers.pre_tokenizers import ByteLevel
+    from transformers import PreTrainedTokenizerFast
+    alphabet = ByteLevel.alphabet()
+    vocab = {ch: i for i, ch in enumerate(sorted(alphabet))}
+    tok = Tokenizer(BPE(vocab, []))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    fast = PreTrainedTokenizerFast(tokenizer_object=tok)
+    d = tmp_path / "btok"
+    fast.save_pretrained(str(d))
+    from transformers import AutoTokenizer
+    return AutoTokenizer.from_pretrained(str(d), local_files_only=True)
+
+
+def test_incremental_detokenizer_holds_split_multibyte(tmp_path):
+    """The U+FFFD holdback: feeding the two bytes of 'e-acute' one at a
+    time yields no text for the first byte and the complete character for
+    the second — a streamer diffing on string length would emit a
+    replacement char and then an empty delta."""
+    from kubeflow_tpu.runtime.server import IncrementalDetokenizer
+    btok = _bytelevel_tokenizer(tmp_path)
+    ids = btok.encode("h\u00e9!", add_special_tokens=False)
+    assert len(ids) == 4   # h + 2 bytes of e-acute + !
+    detok = IncrementalDetokenizer(btok)
+    deltas = [detok.feed(t) for t in ids]
+    assert deltas[0] == "h"
+    assert deltas[1] == ""           # held: mid-character
+    assert deltas[2] == "\u00e9"     # completes the character
+    assert deltas[3] == "!"
+    assert "".join(deltas) == "h\u00e9!"
+
+
+def test_incremental_detokenizer_flushes_invalid_bytes(tmp_path):
+    """A genuinely invalid byte (a model emitting bytes, not text) must
+    not stall the stream forever: the next stabilizing token flushes it
+    as U+FFFD — the documented behavior, matching decode() of the whole
+    sequence."""
+    from kubeflow_tpu.runtime.server import IncrementalDetokenizer
+    btok = _bytelevel_tokenizer(tmp_path)
+    stray = btok.encode("\u00e9", add_special_tokens=False)[1]  # lone
+    ascii_a = btok.encode("a", add_special_tokens=False)[0]      # cont.
+    detok = IncrementalDetokenizer(btok)
+    first = detok.feed(stray)
+    assert first == ""               # alone it is an incomplete tail
+    second = detok.feed(ascii_a)
+    assert second == "\ufffda"       # flushed as replacement + real char
+    assert "".join([first, second]) == btok.decode([stray, ascii_a])
+
+
+def test_incremental_detokenizer_matches_full_decode(tmp_path):
+    """Property over a mixed valid sequence: concatenated deltas equal
+    the whole-sequence decode exactly."""
+    from kubeflow_tpu.runtime.server import IncrementalDetokenizer
+    btok = _bytelevel_tokenizer(tmp_path)
+    text = "caf\u00e9 \u2192 \u00fcber"
+    ids = btok.encode(text, add_special_tokens=False)
+    detok = IncrementalDetokenizer(btok)
+    out = "".join(detok.feed(t) for t in ids)
+    assert out == btok.decode(ids) == text
