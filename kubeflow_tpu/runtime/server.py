@@ -64,6 +64,13 @@ class IncrementalDetokenizer:
     not text) surface as U+FFFD once a following token forces the window
     to stabilize — held forever would stall the stream."""
 
+    # ids held back while the window tail is U+FFFD: a real split UTF-8
+    # character completes within 3 follow-up bytes, so a window still
+    # unstable after this many ids is invalid bytes, not a character —
+    # force stabilization (bounds the re-decoded window, keeping feed()
+    # O(total ids) even for a model emitting pure garbage)
+    MAX_HOLD = 8
+
     def __init__(self, tokenizer):
         self.tokenizer = tokenizer
         self._ids: list[int] = []
@@ -74,11 +81,23 @@ class IncrementalDetokenizer:
         """One generated id in → the text delta now safe to emit."""
         self._ids.append(tok)
         window = self.tokenizer.decode(self._ids[self._prefix:])
-        if window.endswith("�"):
+        if window.endswith("�") and \
+                len(self._ids) - self._read < self.MAX_HOLD:
             return ""                     # held back until complete
         prev = self.tokenizer.decode(self._ids[self._prefix:self._read])
         self._prefix = self._read
         self._read = len(self._ids)
+        return window[len(prev):]
+
+    def flush(self) -> str:
+        """Text still held back when the stream ends (generation stopped
+        mid-character): emit it so concatenated deltas equal the full
+        decode, replacement chars and all."""
+        if self._read == len(self._ids):
+            return ""
+        window = self.tokenizer.decode(self._ids[self._prefix:])
+        prev = self.tokenizer.decode(self._ids[self._prefix:self._read])
+        self._prefix = self._read = len(self._ids)
         return window[len(prev):]
 
 
@@ -89,8 +108,8 @@ class ServingServer:
     ENGINE_COUNTERS = (
         "requests_total", "batches_total", "admitted_total",
         "admitted_while_running", "steps_total", "prefill_chunks_total",
-        "prefix_cache_hits_total", "spec_batches", "spec_accepted",
-        "spec_drafted")
+        "prefix_cache_hits_total", "cancelled_total", "spec_batches",
+        "spec_accepted", "spec_drafted")
 
     def __init__(self, generator, config, *, host: str = "127.0.0.1",
                  port: int = 8890, request_timeout_s: float = 300.0,
@@ -304,9 +323,17 @@ class ServingServer:
 
     def generate(self, req: dict) -> dict:
         prompt, max_new, temp, top_k, top_p, was_text = self._validate(req)
-        ids = self.generator.generate_sync(
-            prompt, max_new, temp, top_k=top_k, top_p=top_p,
-            timeout=self.request_timeout_s)
+        future = self.generator.submit(prompt, max_new, temp, top_k=top_k,
+                                       top_p=top_p)
+        try:
+            ids = future.result(timeout=self.request_timeout_s)
+        except TimeoutError:
+            # the 504 goes to the client; the engine must not keep the
+            # slot decoding for a response nobody will read
+            cancel = getattr(self.generator, "cancel", None)
+            if cancel is not None:
+                cancel(future)
+            raise
         out = {"ids": [int(t) for t in ids]}
         if was_text:
             out["text"] = self.tokenizer.decode(self._live_ids(ids))
@@ -367,8 +394,14 @@ class ServingServer:
                     b"data: " + json.dumps(payload).encode() + b"\n\n")
                 handler.wfile.flush()
                 return True
-            except OSError:   # client went away; the engine finishes the
-                return False  # request (no cancellation at token level)
+            except OSError:
+                # client went away: cancel cooperatively so the engine
+                # frees the slot at the next token boundary instead of
+                # finishing a generation nobody will read
+                cancel = getattr(self.generator, "cancel", None)
+                if cancel is not None:
+                    cancel(future)
+                return False
 
         t_end = time.monotonic() + self.request_timeout_s
         n_tokens = 0
@@ -394,10 +427,19 @@ class ServingServer:
                     n_tokens += 1
                 break
             if time.monotonic() >= t_end:
+                # free the slot: nobody will read the rest of this
+                # generation (same cooperative cancel as a disconnect)
+                cancel = getattr(self.generator, "cancel", None)
+                if cancel is not None:
+                    cancel(future)
                 event({"error": "generation timed out"})
                 return
         try:
             ids = [int(t) for t in future.result(timeout=0)]
+            if detok is not None:
+                held = detok.flush()
+                if held and not event({"text": held}):
+                    return   # token-less flush event: mid-character tail
             done = {"done": True, "n_tokens": n_tokens, "ids": ids}
             if was_text:
                 done["text"] = self.tokenizer.decode(self._live_ids(ids))

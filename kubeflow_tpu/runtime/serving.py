@@ -26,7 +26,7 @@ import collections
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import CancelledError, Future
 from dataclasses import dataclass, field
 from functools import partial
 
@@ -50,6 +50,10 @@ class GenerateRequest:
     # thread, BEFORE the future resolves — must be cheap and non-blocking
     # (hand the id to a queue; never do IO here)
     on_token: object | None = None
+    # cooperative cancellation (client disconnect): the engine frees the
+    # slot at the next token boundary and fails the future with
+    # CancelledError — set via the engine's cancel(), not directly
+    cancelled: threading.Event = field(default_factory=threading.Event)
 
     @property
     def shape_key(self) -> tuple:
@@ -387,6 +391,7 @@ class ContinuousBatchedGenerator:
         self.steps_total = 0
         self.prefill_chunks_total = 0
         self.prefix_cache_hits_total = 0   # chunks SKIPPED via the cache
+        self.cancelled_total = 0
         self._state = self._fresh_state()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="kubeflow-tpu-cbatch")
@@ -427,11 +432,25 @@ class ContinuousBatchedGenerator:
             raise ValueError("prompt must be non-empty")
         if len(req.prompt) + max_new_tokens > self.config.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        req.future._kubeflow_tpu_request = req   # cancel() handle
         with self._lifecycle:
             if self._closed:
                 raise RuntimeError("generator is closed")
             self._queue.put(req)
         return req.future
+
+    def cancel(self, future: Future) -> bool:
+        """Request cooperative cancellation of a submitted generation (a
+        disconnected streaming client, an abandoned request): the engine
+        frees the slot at the next token boundary — queued or admitting
+        requests never run — and the future fails with CancelledError.
+        Returns False for futures this engine did not issue or that have
+        already resolved."""
+        req = getattr(future, "_kubeflow_tpu_request", None)
+        if req is None or future.done():
+            return False
+        req.cancelled.set()
+        return True
 
     def generate_sync(self, prompt, max_new_tokens: int,
                       temperature: float = 0.0, *, top_k: int = 0,
@@ -626,6 +645,13 @@ class ContinuousBatchedGenerator:
         C = self.prefill_chunk
         for slot, adm in list(self._admitting.items()):
             req = adm.req
+            if req.cancelled.is_set():
+                del self._admitting[slot]
+                self._slots[slot] = _Slot()
+                if not req.future.done():
+                    req.future.set_exception(CancelledError())
+                self.cancelled_total += 1
+                continue
             try:
                 chunk = jnp.asarray(adm.padded[:, adm.consumed:
                                                adm.consumed + C])
@@ -716,6 +742,13 @@ class ContinuousBatchedGenerator:
         for i, slot in enumerate(self._slots):
             if slot.req is None or slot.prefilling:
                 continue
+            if slot.req.cancelled.is_set():
+                if not slot.req.future.done():
+                    slot.req.future.set_exception(CancelledError())
+                self._slots[i] = _Slot()
+                deactivate.append(i)
+                self.cancelled_total += 1
+                continue
             if n_out[i] >= slot.target or done[i]:
                 ids = np.asarray(self._state["out"][i, :slot.target])
                 if n_out[i] < slot.target:  # EOS'd early: pad the tail
@@ -752,6 +785,11 @@ class ContinuousBatchedGenerator:
                     # nothing new
                     draining = True
                     break
+                if req.cancelled.is_set():  # cancelled while queued
+                    if not req.future.done():
+                        req.future.set_exception(CancelledError())
+                    self.cancelled_total += 1
+                    continue
                 try:
                     self._begin_admission(req, free[0])
                 except BaseException as exc:  # noqa: BLE001

@@ -331,3 +331,78 @@ def test_prefix_cache_lru_bound_and_disable():
         gen.generate_sync(p, 2)
         assert gen.prefix_cache_hits_total == 0
         assert len(gen._prefix_cache) == 0
+
+
+# --------------------------------------------------------- cancellation
+def test_cancel_mid_generation_frees_the_slot():
+    """Cancelling a long in-flight generation fails its future with
+    CancelledError at the next token boundary and frees the slot for the
+    next request; the other in-flight request is untouched."""
+    from concurrent.futures import CancelledError
+    params, cfg = model()
+    with ContinuousBatchedGenerator(params, cfg, n_slots=1,
+                                    prefill_chunk=8) as gen:
+        seen = []
+        f_long = gen.submit(np.arange(4, dtype=np.int32), 24,
+                            on_token=seen.append)
+        while len(seen) < 2:
+            time.sleep(0.01)
+        assert gen.cancel(f_long) is True
+        with pytest.raises(CancelledError):
+            f_long.result(timeout=60)
+        assert gen.cancelled_total == 1
+        # the single slot is free again: a new request completes
+        out = gen.generate_sync(np.arange(4, dtype=np.int32), 4)
+        assert out.shape == (4,)
+        # cancelled/finished futures refuse further cancellation
+        assert gen.cancel(f_long) is False
+
+
+def test_cancel_queued_and_admitting_requests():
+    """Cancellation lands wherever the request is: still queued behind a
+    full engine, or mid-chunked-admission."""
+    from concurrent.futures import CancelledError
+    params, cfg = model()
+    with ContinuousBatchedGenerator(params, cfg, n_slots=1,
+                                    prefill_chunk=4) as gen:
+        f_busy = gen.submit(np.arange(4, dtype=np.int32), 20)
+        f_queued = gen.submit(np.arange(4, dtype=np.int32), 4)
+        assert gen.cancel(f_queued) is True
+        with pytest.raises(CancelledError):
+            f_queued.result(timeout=60)
+        f_busy.result(timeout=120)
+    assert gen.cancelled_total == 1
+
+
+def test_cancel_foreign_future_rejected():
+    from concurrent.futures import Future
+    params, cfg = model()
+    with ContinuousBatchedGenerator(params, cfg, n_slots=1) as gen:
+        assert gen.cancel(Future()) is False
+
+
+def test_cancel_mid_admission_frees_the_slot():
+    """Cancelling DURING a multi-chunk admission drops the in-flight
+    _Admission, resets the slot, and stops consuming chunks — the branch
+    at the top of _advance_admissions."""
+    from concurrent.futures import CancelledError
+    params, cfg = model()
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                    prefill_chunk=4) as gen:
+        seen = []
+        f_a = gen.submit(np.arange(4, dtype=np.int32), 24,
+                         on_token=seen.append)
+        while len(seen) < 1:
+            time.sleep(0.01)
+        # B's 6-chunk admission interleaves with A's decode ticks
+        f_b = gen.submit(np.arange(24, dtype=np.int32), 2)
+        while gen.prefill_chunks_total < 3:   # B demonstrably mid-admission
+            time.sleep(0.005)
+        assert gen.cancel(f_b) is True
+        with pytest.raises(CancelledError):
+            f_b.result(timeout=60)
+        f_a.result(timeout=120)
+        # the admission slot is reusable
+        assert gen.generate_sync(np.arange(4, dtype=np.int32),
+                                 3).shape == (3,)
+        assert gen.cancelled_total == 1
