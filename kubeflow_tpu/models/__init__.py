@@ -3,9 +3,13 @@ from .moe import MoEConfig, init_moe_params, moe_forward, moe_param_logical_spec
 from .decode import (init_kv_cache, prefill, decode_step, decode_window,
                      generate)
 from .speculative import SpecStats, speculative_generate
+from .lora import (LoRAConfig, init_lora_params, lora_logical_specs,
+                   make_sharded_lora_step, merge_lora)
 
 __all__ = ["TransformerConfig", "init_params", "forward", "param_logical_specs",
            "MoEConfig", "init_moe_params", "moe_forward",
            "moe_param_logical_specs",
            "init_kv_cache", "prefill", "decode_step", "decode_window",
-           "generate", "SpecStats", "speculative_generate"]
+           "generate", "SpecStats", "speculative_generate",
+           "LoRAConfig", "init_lora_params", "lora_logical_specs",
+           "make_sharded_lora_step", "merge_lora"]

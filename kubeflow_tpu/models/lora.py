@@ -1,0 +1,224 @@
+"""LoRA finetuning: low-rank adapters over the frozen base model.
+
+What a provisioned notebook actually does with a pretrained checkpoint:
+finetune it cheaply. LoRA (Hu et al. 2021) freezes the base weights and
+trains a rank-r delta W + (alpha/r)·A@B per target matrix — optimizer
+state shrinks from 2 f32 copies of every weight to 2 copies of the
+adapters (hundreds× smaller at r=8 on the flagship), and checkpoints of
+a finetune are megabytes, not gigabytes.
+
+TPU-first shape:
+- the adapters MERGE into the base weights inside the jitted step
+  (``merge_lora``): one fused einsum per target produces the effective
+  weight, so the forward/backward is EXACTLY the base model's compute
+  graph — flash kernels, remat policies, fused CE, pipeline/ring paths
+  all apply unchanged, and XLA sees static shapes it already knows how
+  to schedule. ``lax.stop_gradient`` on the base keeps autodiff from
+  materializing base-weight gradients (the merge's extra weight copy is
+  transient and fused);
+- adapter shapes carry the stacked ``layers`` axis like every block
+  weight, so they ride the same scans and the same logical-axis
+  sharding machinery: A's input axis and B's output axes take the BASE
+  weight's rules (tp/fsdp), the rank axis stays unsharded
+  (``lora_logical_specs``);
+- serving needs no LoRA code: ``merge_lora`` once on the host and the
+  merged tree feeds generate/speculation/the engines as a plain model.
+
+B initializes to zero (the standard: the delta starts as the identity),
+so a freshly-initialized adapter reproduces the base model bit-for-bit —
+pinned by tests/test_lora.py.
+
+The reference provisions Jupyter images and has no model code
+(SURVEY §2d); this belongs to the workload layer those images run.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import TransformerConfig
+
+# per target: (input logical axes, output logical axes) of the base weight
+# (param_logical_specs minus the leading "layers")
+_TARGET_AXES = {
+    "wq": (("embed",), ("heads", "head_dim")),
+    "wk": (("embed",), ("kv_heads", "head_dim")),
+    "wv": (("embed",), ("kv_heads", "head_dim")),
+    "wo": (("heads", "head_dim"), ("embed",)),
+    "w_gate": (("embed",), ("mlp",)),
+    "w_up": (("embed",), ("mlp",)),
+    "w_down": (("mlp",), ("embed",)),
+}
+
+
+@dataclass(frozen=True)
+class LoRAConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: tuple = ("wq", "wk", "wv", "wo")
+
+    def __post_init__(self):
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
+        unknown = set(self.targets) - set(_TARGET_AXES)
+        if unknown:
+            raise ValueError(f"unknown LoRA targets {sorted(unknown)}; "
+                             f"valid: {sorted(_TARGET_AXES)}")
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def _axis_dim(config: TransformerConfig, axis: str) -> int:
+    """Logical axis name → its dimension on this config (the one source
+    of truth tying _TARGET_AXES to concrete adapter shapes)."""
+    return {"embed": config.d_model, "heads": config.n_heads,
+            "kv_heads": config.n_kv_heads, "head_dim": config.d_head,
+            "mlp": config.d_ff}[axis]
+
+
+def _target_dims(config: TransformerConfig, name: str):
+    """(in_shape, out_shape) of one layer's base weight, sans layers —
+    derived from _TARGET_AXES so targets have a single definition."""
+    in_axes, out_axes = _TARGET_AXES[name]
+    return (tuple(_axis_dim(config, a) for a in in_axes),
+            tuple(_axis_dim(config, a) for a in out_axes))
+
+
+def init_lora_params(key: jax.Array, config: TransformerConfig,
+                     lora: LoRAConfig) -> dict:
+    """{"blocks": {target: {"A": (L, *in, r), "B": (L, r, *out)}}}.
+
+    A ~ N(0, 1/in_features) (the base init's fan-in convention), B = 0:
+    the initial delta is exactly zero."""
+    c = config
+    pdt = jnp.dtype(c.param_dtype)
+    keys = jax.random.split(key, len(lora.targets))
+    blocks = {}
+    for k, name in zip(keys, sorted(lora.targets)):
+        in_shape, out_shape = _target_dims(c, name)
+        fan_in = 1
+        for d in in_shape:
+            fan_in *= d
+        blocks[name] = {
+            "A": jax.random.normal(
+                k, (c.n_layers, *in_shape, lora.rank), pdt) /
+            jnp.sqrt(jnp.float32(fan_in)).astype(pdt),
+            "B": jnp.zeros((c.n_layers, lora.rank, *out_shape), pdt),
+        }
+    return {"blocks": blocks}
+
+
+def lora_logical_specs(config: TransformerConfig, lora: LoRAConfig) -> dict:
+    """Logical-axis names per adapter leaf: the base weight's rules on
+    the input/output axes, the rank axis unsharded — feed to
+    parallel.param_shardings like any other spec tree."""
+    blocks = {}
+    for name in sorted(lora.targets):
+        in_axes, out_axes = _TARGET_AXES[name]
+        blocks[name] = {
+            "A": ("layers", *in_axes, None),
+            "B": ("layers", None, *out_axes),
+        }
+    return {"blocks": blocks}
+
+
+def merge_lora(params: dict, lora_params: dict, config: TransformerConfig,
+               lora: LoRAConfig) -> dict:
+    """Base params + (alpha/r)·A@B per target — the effective weights.
+
+    Inside a jitted step this is one fused einsum per target; on the
+    host it bakes a servable plain-model tree."""
+    del config
+    blocks = dict(params["blocks"])
+    for name, ab in lora_params["blocks"].items():
+        delta = _rank_contract(ab["A"], ab["B"])
+        blocks[name] = blocks[name] + lora.scale * \
+            delta.astype(blocks[name].dtype)
+    return {**params, "blocks": blocks}
+
+
+def _rank_contract(A: jax.Array, B: jax.Array) -> jax.Array:
+    """(L, *in, r) × (L, r, *out) → (L, *in, *out) via one reshape-matmul
+    (einsum subscripts cannot express two variadic groups)."""
+    L = A.shape[0]
+    r = A.shape[-1]
+    in_shape = A.shape[1:-1]
+    out_shape = B.shape[2:]
+    a2 = A.reshape(L, -1, r)
+    b2 = B.reshape(L, r, -1)
+    return jnp.einsum("lir,lro->lio", a2, b2).reshape(
+        L, *in_shape, *out_shape)
+
+
+def make_sharded_lora_step(mesh, config: TransformerConfig,
+                           lora: LoRAConfig, tc=None, rules=None):
+    """(init_fn, step_fn) for adapter-only training over ``mesh``.
+
+    init_fn(key, base_params) → (lora_params, opt_state): adapters and
+    optimizer state shard per lora_logical_specs and are donated through
+    the step; the base params ride as a non-donated input (frozen —
+    ``stop_gradient`` keeps autodiff off them entirely).
+    step_fn(base, lora_params, opt_state, tokens, targets) →
+    (lora_params, opt_state, loss).
+    """
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.sharding import (PartitionRules, batch_sharding,
+                                     param_shardings)
+    from .train import (TrainConfig, apply_update, ce_chunk_for,
+                        fused_loss_fn, loss_fn, make_optimizer,
+                        opt_state_shardings)
+    from .transformer import param_logical_specs
+
+    tc = tc or TrainConfig()
+    rules = rules or PartitionRules()
+    optimizer = make_optimizer(tc)
+    base_sh = param_shardings(mesh, param_logical_specs(config), rules)
+    lora_sh = param_shardings(mesh, lora_logical_specs(config, lora),
+                              rules)
+    replicated = NamedSharding(mesh, P())
+    opt_sh = opt_state_shardings(
+        optimizer,
+        lambda k: init_lora_params(k, config, lora),
+        lora_sh, replicated)
+    batch_sh = batch_sharding(mesh)
+
+    @partial(jax.jit, out_shardings=(lora_sh, opt_sh))
+    def init_fn(key):
+        lp = init_lora_params(key, config, lora)
+        return lp, optimizer.init(lp)
+
+    def _loss(lora_params, base, tokens, targets, chunk):
+        merged = merge_lora(jax.lax.stop_gradient(base), lora_params,
+                            config, lora)
+        if chunk:
+            return fused_loss_fn(merged, tokens, targets, config,
+                                 mesh=mesh, chunk_tokens=chunk)
+        return loss_fn(merged, tokens, targets, config, mesh)
+
+    @partial(jax.jit,
+             in_shardings=(base_sh, lora_sh, opt_sh, batch_sh, batch_sh),
+             out_shardings=(lora_sh, opt_sh, None),
+             donate_argnums=(1, 2))
+    def step_fn(base, lora_params, opt_state, tokens, targets):
+        chunk = ce_chunk_for(tc, tokens, config.vocab_size)
+        loss, grads = jax.value_and_grad(_loss)(lora_params, base,
+                                                tokens, targets, chunk)
+        lora_params, opt_state = apply_update(optimizer, lora_params,
+                                              opt_state, grads)
+        return lora_params, opt_state, loss
+
+    return init_fn, step_fn
+
+
+def lora_num_params(config: TransformerConfig, lora: LoRAConfig) -> int:
+    lp = jax.eval_shape(lambda: init_lora_params(jax.random.key(0),
+                                                 config, lora))
+    return sum(int(jnp.prod(jnp.asarray(leaf.shape)))
+               for leaf in jax.tree.leaves(lp))

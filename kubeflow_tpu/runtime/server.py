@@ -81,12 +81,21 @@ class IncrementalDetokenizer:
         """One generated id in → the text delta now safe to emit."""
         self._ids.append(tok)
         window = self.tokenizer.decode(self._ids[self._prefix:])
-        if window.endswith("�") and \
-                len(self._ids) - self._read < self.MAX_HOLD:
-            return ""                     # held back until complete
+        forced = False
+        if window.endswith("�"):
+            if len(self._ids) - self._read < self.MAX_HOLD:
+                return ""                 # held back until complete
+            forced = True                 # invalid bytes: stabilize
         prev = self.tokenizer.decode(self._ids[self._prefix:self._read])
-        self._prefix = self._read
-        self._read = len(self._ids)
+        if forced:
+            # the emitted tail is replacement chars for invalid bytes,
+            # not a character prefix — the NEXT window must not re-decode
+            # across it (a later completing byte would re-interpret the
+            # boundary and the length-diff would drop text)
+            self._prefix = self._read = len(self._ids)
+        else:
+            self._prefix = self._read
+            self._read = len(self._ids)
         return window[len(prev):]
 
     def flush(self) -> str:
@@ -272,6 +281,13 @@ class ServingServer:
         self.stop()
 
     # ------------------------------------------------------------- handlers
+    def _cancel(self, future) -> None:
+        """Cooperative cancel for abandoned requests (disconnect/timeout)
+        — a no-op on engines without cancellation support."""
+        cancel = getattr(self.generator, "cancel", None)
+        if cancel is not None:
+            cancel(future)
+
     def _validate(self, req: dict):
         prompt = req.get("prompt")
         text = req.get("text")
@@ -330,9 +346,7 @@ class ServingServer:
         except TimeoutError:
             # the 504 goes to the client; the engine must not keep the
             # slot decoding for a response nobody will read
-            cancel = getattr(self.generator, "cancel", None)
-            if cancel is not None:
-                cancel(future)
+            self._cancel(future)
             raise
         out = {"ids": [int(t) for t in ids]}
         if was_text:
@@ -398,9 +412,7 @@ class ServingServer:
                 # client went away: cancel cooperatively so the engine
                 # frees the slot at the next token boundary instead of
                 # finishing a generation nobody will read
-                cancel = getattr(self.generator, "cancel", None)
-                if cancel is not None:
-                    cancel(future)
+                self._cancel(future)
                 return False
 
         t_end = time.monotonic() + self.request_timeout_s
@@ -429,9 +441,7 @@ class ServingServer:
             if time.monotonic() >= t_end:
                 # free the slot: nobody will read the rest of this
                 # generation (same cooperative cancel as a disconnect)
-                cancel = getattr(self.generator, "cancel", None)
-                if cancel is not None:
-                    cancel(future)
+                self._cancel(future)
                 event({"error": "generation timed out"})
                 return
         try:

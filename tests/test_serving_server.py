@@ -548,3 +548,22 @@ def test_incremental_detokenizer_matches_full_decode(tmp_path):
     detok = IncrementalDetokenizer(btok)
     out = "".join(detok.feed(t) for t in ids)
     assert out == btok.decode(ids) == text
+
+
+def test_incremental_detokenizer_forced_stabilization_boundary(tmp_path):
+    """The review-found boundary bug: after MAX_HOLD forces emission of
+    replacement chars, a later token completing a REAL character must
+    still stream it — the forced emit must advance the window past the
+    invalid tail instead of re-decoding across it."""
+    from kubeflow_tpu.runtime.server import IncrementalDetokenizer
+    btok = _bytelevel_tokenizer(tmp_path)
+    cont = btok.encode("é", add_special_tokens=False)[1]  # lone cont.
+    e_acute = btok.encode("é", add_special_tokens=False)
+    detok = IncrementalDetokenizer(btok)
+    out = []
+    for t in [cont] * IncrementalDetokenizer.MAX_HOLD + e_acute:
+        out.append(detok.feed(t))
+    out.append(detok.flush())
+    text = "".join(out)
+    assert text.endswith("é"), f"completing char lost: {text!r}"
+    assert text.count("�") == IncrementalDetokenizer.MAX_HOLD
