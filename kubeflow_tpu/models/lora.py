@@ -128,13 +128,12 @@ def lora_logical_specs(config: TransformerConfig, lora: LoRAConfig) -> dict:
     return {"blocks": blocks}
 
 
-def merge_lora(params: dict, lora_params: dict, config: TransformerConfig,
+def merge_lora(params: dict, lora_params: dict,
                lora: LoRAConfig) -> dict:
     """Base params + (alpha/r)·A@B per target — the effective weights.
 
     Inside a jitted step this is one fused einsum per target; on the
     host it bakes a servable plain-model tree."""
-    del config
     blocks = dict(params["blocks"])
     for name, ab in lora_params["blocks"].items():
         delta = _rank_contract(ab["A"], ab["B"])
@@ -160,10 +159,13 @@ def make_sharded_lora_step(mesh, config: TransformerConfig,
                            lora: LoRAConfig, tc=None, rules=None):
     """(init_fn, step_fn) for adapter-only training over ``mesh``.
 
-    init_fn(key, base_params) → (lora_params, opt_state): adapters and
-    optimizer state shard per lora_logical_specs and are donated through
-    the step; the base params ride as a non-donated input (frozen —
-    ``stop_gradient`` keeps autodiff off them entirely).
+    init_fn(key) → (lora_params, opt_state): adapters and optimizer
+    state shard per lora_logical_specs and are donated through the
+    step; the base params ride as a non-donated step input (frozen —
+    ``stop_gradient`` keeps autodiff off them entirely). Adapters stay
+    f32-grade by construction (they are megabytes), so the dense step's
+    ``bf16_params`` master-copy machinery does not apply here — the
+    flag is rejected rather than silently ignored.
     step_fn(base, lora_params, opt_state, tokens, targets) →
     (lora_params, opt_state, loss).
     """
@@ -177,6 +179,11 @@ def make_sharded_lora_step(mesh, config: TransformerConfig,
     from .transformer import param_logical_specs
 
     tc = tc or TrainConfig()
+    if tc.bf16_params:
+        raise ValueError(
+            "bf16_params is a dense-step lever (f32 master copies of the "
+            "full weights); LoRA adapters are small enough to keep in "
+            "full precision — drop the flag for the lora step")
     rules = rules or PartitionRules()
     optimizer = make_optimizer(tc)
     base_sh = param_shardings(mesh, param_logical_specs(config), rules)
@@ -196,7 +203,7 @@ def make_sharded_lora_step(mesh, config: TransformerConfig,
 
     def _loss(lora_params, base, tokens, targets, chunk):
         merged = merge_lora(jax.lax.stop_gradient(base), lora_params,
-                            config, lora)
+                            lora)
         if chunk:
             return fused_loss_fn(merged, tokens, targets, config,
                                  mesh=mesh, chunk_tokens=chunk)
@@ -218,7 +225,10 @@ def make_sharded_lora_step(mesh, config: TransformerConfig,
 
 
 def lora_num_params(config: TransformerConfig, lora: LoRAConfig) -> int:
-    lp = jax.eval_shape(lambda: init_lora_params(jax.random.key(0),
-                                                 config, lora))
-    return sum(int(jnp.prod(jnp.asarray(leaf.shape)))
-               for leaf in jax.tree.leaves(lp))
+    import math
+    total = 0
+    for name in lora.targets:
+        in_shape, out_shape = _target_dims(config, name)
+        total += config.n_layers * lora.rank * (
+            math.prod(in_shape) + math.prod(out_shape))
+    return total
