@@ -372,3 +372,51 @@ def make_sharded_train_step(mesh: Mesh, config: TransformerConfig,
 def pipelined_forward_adapter(params, tokens, config, mesh=None, *,
                               n_microbatches):
     return pipelined_forward(params, tokens, config, mesh, n_microbatches)
+
+
+def build_eval_loss(mesh: Mesh, config: TransformerConfig,
+                    tc: TrainConfig | None = None,
+                    n_microbatches: int | None = None):
+    """The loss dispatch the train factories use, packaged for evaluation:
+    pp-aware forward selection, the fused-CE gate (disabled for the dense
+    pipelined path, whose per-stage LM head exposes no hidden states),
+    and — eval-specific — the MoE router aux EXCLUDED (a training
+    regularizer; with it, exp(loss) would not be a perplexity). Returns
+    ``eval_loss(params, tokens, targets) -> mean CE over valid tokens``.
+    Trainer.evaluate jits it; kept here so the engagement policy cannot
+    drift from the training step's."""
+    import dataclasses
+
+    from .moe import (MoEConfig, moe_forward_hidden, moe_loss_fn,
+                      pipelined_moe_forward_hidden)
+
+    tc = tc or TrainConfig()
+    pp = mesh.shape.get("pp", 1)
+    n_micro = n_microbatches or 2 * pp
+
+    if isinstance(config, MoEConfig):
+        eval_config = dataclasses.replace(config, router_aux_coef=0.0)
+        if pp > 1:
+            def hidden_impl(p, t, c, mesh=mesh):
+                return pipelined_moe_forward_hidden(p, t, c, mesh, n_micro)
+        else:
+            hidden_impl = moe_forward_hidden
+
+        def eval_loss(params, tokens, targets):
+            chunk = ce_chunk_for(tc, tokens, eval_config.vocab_size)
+            return moe_loss_fn(params, tokens, targets, eval_config,
+                               mesh, ce_chunk_tokens=chunk,
+                               hidden_impl=hidden_impl)
+        return eval_loss
+
+    fwd = partial(pipelined_forward_adapter, n_microbatches=n_micro) \
+        if pp > 1 else forward
+
+    def eval_loss(params, tokens, targets):
+        chunk = ce_chunk_for(tc, tokens, config.vocab_size) \
+            if pp == 1 else 0
+        if chunk:
+            return fused_loss_fn(params, tokens, targets, config, mesh,
+                                 chunk_tokens=chunk)
+        return loss_fn(params, tokens, targets, config, mesh, fwd)
+    return eval_loss

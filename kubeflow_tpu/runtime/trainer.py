@@ -48,6 +48,7 @@ class TrainerStats:
     tokens_per_sec: float = 0.0
     model_tflops_per_sec: float = 0.0
     losses: list = field(default_factory=list)  # (step, loss) at log points
+    evals: list = field(default_factory=list)   # (step, eval loss)
 
 
 class Trainer:
@@ -166,6 +167,66 @@ class Trainer:
         if loss is not None and self.stats.last_loss is None:
             self.stats.last_loss = float(loss)
         return self.stats
+
+    # --------------------------------------------------------------- eval
+    def _eval_step(self):
+        """Lazily-built jitted eval step: (params, tokens, targets) →
+        (loss·n_valid, n_valid) device scalars. The loss dispatch is
+        train_lib.build_eval_loss — the SAME pp-aware forward selection
+        and fused-CE gating as the training step (kept in one place so
+        they cannot drift), with the MoE router aux excluded so
+        exp(loss) is a real perplexity for both families."""
+        if getattr(self, "_eval_fn", None) is not None:
+            return self._eval_fn
+        import jax.numpy as jnp
+
+        eval_loss = train_lib.build_eval_loss(self.mesh, self.config,
+                                              self.tc)
+
+        @jax.jit
+        def eval_fn(params, tokens, targets):
+            loss = eval_loss(params, tokens, targets)
+            n = jnp.sum(targets >= 0)
+            return loss * n, n
+        self._eval_fn = eval_fn
+        return eval_fn
+
+    def evaluate(self, source, *, max_batches: int | None = None,
+                 prefetch_buffer: int = 2) -> dict:
+        """Held-out evaluation: token-weighted mean cross entropy and
+        perplexity over ``source`` (an iterable of (tokens, targets) host
+        batches; ``max_batches`` bounds a generator). No parameter or
+        optimizer state changes — safe mid-training; the result is also
+        appended to ``stats.evals`` as (step, loss)."""
+        eval_fn = self._eval_step()
+        bounded = iter(source) if max_batches is None else \
+            itertools.islice(iter(source), max_batches)
+        # device-side accumulation: the loop dispatches ahead without a
+        # per-batch host sync (the same async-queue discipline as fit());
+        # the one readback happens after the last batch
+        totals = []
+        counts = []
+        n_batches = 0
+        with prefetch_to_device(bounded, self.mesh,
+                                buffer_size=prefetch_buffer) as batches:
+            for tokens, targets in batches:
+                weighted, n_valid = eval_fn(self.params, tokens, targets)
+                totals.append(weighted)
+                counts.append(n_valid)
+                n_batches += 1
+        n_tokens = int(sum(int(c) for c in counts))
+        if n_tokens == 0:
+            raise ValueError("evaluate() saw no valid tokens")
+        mean_loss = float(sum(float(t) for t in totals)) / n_tokens
+        result = {"loss": mean_loss,
+                  "perplexity": float(jax.numpy.exp(mean_loss)),
+                  "batches": n_batches, "tokens": n_tokens,
+                  "step": self.stats.step}
+        self.stats.evals.append((self.stats.step, mean_loss))
+        log.info("eval @ step %d: loss %.4f ppl %.2f (%d tokens)",
+                 self.stats.step, mean_loss, result["perplexity"],
+                 n_tokens)
+        return result
 
     def _profile_tick(self) -> None:
         """Open/close the jax.profiler trace when the step counter crosses
