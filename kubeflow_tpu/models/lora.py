@@ -184,6 +184,12 @@ def make_sharded_lora_step(mesh, config: TransformerConfig,
             "bf16_params is a dense-step lever (f32 master copies of the "
             "full weights); LoRA adapters are small enough to keep in "
             "full precision — drop the flag for the lora step")
+    if mesh.shape.get("pp", 1) > 1:
+        raise ValueError(
+            "LoRA uses the scanned (non-pipelined) forward; a pp>1 mesh "
+            "would silently waste its pipeline axis and disagree with "
+            "evaluation's pipelined path — finetune on a tp/fsdp/dp mesh "
+            "(adapters are small; pipeline parallelism buys nothing here)")
     rules = rules or PartitionRules()
     optimizer = make_optimizer(tc)
     base_sh = param_shardings(mesh, param_logical_specs(config), rules)

@@ -362,13 +362,8 @@ def make_sharded_moe_train_step(mesh: Mesh, config: MoEConfig,
                         pipeline_rules)
 
     pp = mesh.shape.get("pp", 1)
-    hidden_impl = None
     if pp > 1:
         rules = rules or pipeline_rules()
-        n_micro = n_microbatches or 2 * pp
-
-        def hidden_impl(p, t, c, mesh=mesh):
-            return pipelined_moe_forward_hidden(p, t, c, mesh, n_micro)
     tc = tc or TrainConfig()
     rules = rules or PartitionRules()
     optimizer = make_optimizer(tc)
@@ -384,11 +379,11 @@ def make_sharded_moe_train_step(mesh: Mesh, config: MoEConfig,
         params = init_moe_params(key, config)
         return params, optimizer.init(params)
 
-    def step_loss(p, t, tg):
-        from .train import ce_chunk_for  # one shared engagement policy
-        chunk = ce_chunk_for(tc, t, config.vocab_size)
-        return moe_loss_fn(p, t, tg, config, mesh, ce_chunk_tokens=chunk,
-                           hidden_impl=hidden_impl)
+    # ONE loss dispatch shared with evaluation (train.build_loss): the
+    # pipelined hidden for pp meshes, the shared fused-CE engagement
+    # policy, aux included (this is the training objective)
+    from .train import build_loss
+    step_loss = build_loss(mesh, config, tc, n_microbatches)
 
     @partial(jax.jit,
              in_shardings=(p_shardings, opt_shardings, batch_sh, batch_sh),

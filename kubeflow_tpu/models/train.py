@@ -315,10 +315,8 @@ def make_sharded_train_step(mesh: Mesh, config: TransformerConfig,
     if pp > 1:
         rules = rules or pipeline_rules()
         n_microbatches = n_microbatches or 2 * pp
-        fwd = partial(pipelined_forward_adapter, n_microbatches=n_microbatches)
     else:
         rules = rules or PartitionRules()
-        fwd = forward
     optimizer = make_optimizer(tc)
     p_shardings = param_shardings(mesh, param_logical_specs(config), rules)
     batch_sh = batch_sharding(mesh, accum=accum_steps > 1)
@@ -341,14 +339,10 @@ def make_sharded_train_step(mesh: Mesh, config: TransformerConfig,
                                           master=master)
         return params, optimizer.init(params)
 
-    # the fused chunked CE consumes hidden states, which the pipelined
-    # forward does not expose (its LM head runs per-stage) — fused path is
-    # for the non-pp layouts, engaged by the trace-time logits size
-    def step_loss(p, t, tg):
-        chunk = ce_chunk_for(tc, t, config.vocab_size) if pp == 1 else 0
-        if chunk:
-            return fused_loss_fn(p, t, tg, config, mesh, chunk_tokens=chunk)
-        return loss_fn(p, t, tg, config, mesh, fwd)
+    # ONE loss dispatch shared with evaluation (build_loss): pp-aware
+    # forward selection + the fused-CE gate, which is disabled under pp
+    # (the pipelined forward's per-stage LM head exposes no hidden states)
+    step_loss = build_loss(mesh, config, tc, n_microbatches)
 
     @partial(jax.jit,
              in_shardings=(p_shardings, opt_shardings, batch_sh, batch_sh),
@@ -374,17 +368,19 @@ def pipelined_forward_adapter(params, tokens, config, mesh=None, *,
     return pipelined_forward(params, tokens, config, mesh, n_microbatches)
 
 
-def build_eval_loss(mesh: Mesh, config: TransformerConfig,
-                    tc: TrainConfig | None = None,
-                    n_microbatches: int | None = None):
-    """The loss dispatch the train factories use, packaged for evaluation:
-    pp-aware forward selection, the fused-CE gate (disabled for the dense
-    pipelined path, whose per-stage LM head exposes no hidden states),
-    and — eval-specific — the MoE router aux EXCLUDED (a training
-    regularizer; with it, exp(loss) would not be a perplexity). Returns
-    ``eval_loss(params, tokens, targets) -> mean CE over valid tokens``.
-    Trainer.evaluate jits it; kept here so the engagement policy cannot
-    drift from the training step's."""
+def build_loss(mesh: Mesh, config: TransformerConfig,
+               tc: TrainConfig | None = None,
+               n_microbatches: int | None = None,
+               include_aux: bool = True):
+    """THE loss dispatch — one place for pp-aware forward selection and
+    the fused-CE gate (disabled for the dense pipelined path, whose
+    per-stage LM head exposes no hidden states). Both train factories
+    and Trainer.evaluate build their loss from here, so the engagement
+    policy cannot drift between training and evaluation.
+
+    ``include_aux=False`` (evaluation) excludes the MoE router aux — a
+    training regularizer; with it, exp(loss) would not be a perplexity.
+    Returns ``loss(params, tokens, targets) -> scalar``."""
     import dataclasses
 
     from .moe import (MoEConfig, moe_forward_hidden, moe_loss_fn,
@@ -395,28 +391,37 @@ def build_eval_loss(mesh: Mesh, config: TransformerConfig,
     n_micro = n_microbatches or 2 * pp
 
     if isinstance(config, MoEConfig):
-        eval_config = dataclasses.replace(config, router_aux_coef=0.0)
+        loss_config = config if include_aux else \
+            dataclasses.replace(config, router_aux_coef=0.0)
         if pp > 1:
             def hidden_impl(p, t, c, mesh=mesh):
                 return pipelined_moe_forward_hidden(p, t, c, mesh, n_micro)
         else:
-            hidden_impl = moe_forward_hidden
+            hidden_impl = None   # moe_loss_fn's default scanned forward
 
-        def eval_loss(params, tokens, targets):
-            chunk = ce_chunk_for(tc, tokens, eval_config.vocab_size)
-            return moe_loss_fn(params, tokens, targets, eval_config,
+        def _loss(params, tokens, targets):
+            chunk = ce_chunk_for(tc, tokens, loss_config.vocab_size)
+            return moe_loss_fn(params, tokens, targets, loss_config,
                                mesh, ce_chunk_tokens=chunk,
                                hidden_impl=hidden_impl)
-        return eval_loss
+        return _loss
 
     fwd = partial(pipelined_forward_adapter, n_microbatches=n_micro) \
         if pp > 1 else forward
 
-    def eval_loss(params, tokens, targets):
+    def _loss(params, tokens, targets):
         chunk = ce_chunk_for(tc, tokens, config.vocab_size) \
             if pp == 1 else 0
         if chunk:
             return fused_loss_fn(params, tokens, targets, config, mesh,
                                  chunk_tokens=chunk)
         return loss_fn(params, tokens, targets, config, mesh, fwd)
-    return eval_loss
+    return _loss
+
+
+def build_eval_loss(mesh: Mesh, config: TransformerConfig,
+                    tc: TrainConfig | None = None,
+                    n_microbatches: int | None = None):
+    """build_loss with the MoE aux excluded — what evaluate() jits."""
+    return build_loss(mesh, config, tc, n_microbatches,
+                      include_aux=False)

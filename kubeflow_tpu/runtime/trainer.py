@@ -63,12 +63,35 @@ class Trainer:
                  train_config: train_lib.TrainConfig | None = None,
                  checkpoint_dir=None, *, checkpoint_interval: int = 100,
                  max_checkpoints: int = 3, seed: int = 0,
-                 profile_dir=None, profile_steps: tuple = (10, 15)):
+                 profile_dir=None, profile_steps: tuple = (10, 15),
+                 lora=None, base_params=None):
         self.mesh = mesh
         self.config = config
         self.tc = train_config or train_lib.TrainConfig()
         self.is_moe = isinstance(config, MoEConfig)
-        if self.is_moe:
+        # LoRA finetune mode: self.params are the ADAPTERS (tiny), the
+        # frozen base rides every step as a non-donated input; the
+        # checkpoint/resume/eval machinery below sees adapters where it
+        # would see params — which is the point (a finetune checkpoint is
+        # megabytes; eval runs the merged model)
+        self.lora = lora
+        self._base = None
+        if lora is not None:
+            from ..models.lora import make_sharded_lora_step
+            if self.is_moe:
+                raise ValueError("LoRA targets the dense family; MoE "
+                                 "adapter routing is not implemented")
+            if base_params is None:
+                raise ValueError("lora mode requires base_params (the "
+                                 "pretrained weights being finetuned)")
+            self._base = jax.device_put(
+                base_params,
+                param_shardings(mesh, param_logical_specs(config)))
+            self.init_fn, self._lora_step = make_sharded_lora_step(
+                mesh, config, lora, tc=self.tc)
+            self.step_fn = lambda p, o, t, tg: self._lora_step(
+                self._base, p, o, t, tg)
+        elif self.is_moe:
             self.init_fn, self.step_fn = moe_model.make_sharded_moe_train_step(
                 mesh, config, tc=self.tc)
         else:
@@ -94,6 +117,18 @@ class Trainer:
     def _restore_targets(self):
         """Abstract (params, opt_state) with THIS mesh's shardings, so a
         checkpoint from a different topology reshards on load."""
+        if self.lora is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..models.lora import init_lora_params, lora_logical_specs
+            lp_sh = param_shardings(
+                self.mesh, lora_logical_specs(self.config, self.lora))
+            opt_sh = train_lib.opt_state_shardings(
+                train_lib.make_optimizer(self.tc),
+                lambda k: init_lora_params(k, self.config, self.lora),
+                lp_sh, NamedSharding(self.mesh, P()))
+            return (abstract_state(self.params, lp_sh),
+                    abstract_state(self.opt_state, opt_sh))
         if self.is_moe:
             specs = moe_model.moe_param_logical_specs(self.config)
             init = lambda k: moe_model.init_moe_params(k, self.config)  # noqa: E731
@@ -105,6 +140,12 @@ class Trainer:
         opt_sh = train_lib.opt_state_shardings(
             train_lib.make_optimizer(self.tc), init, p_sh,
             NamedSharding(self.mesh, P()))
+        if not self.is_moe and self.tc.bf16_params:
+            # the dense step wraps the optax state in MasterOptState with
+            # the f32 masters sharded like the params; the restore target
+            # must mirror that structure or abstract_state's tree.map
+            # fails on the mismatch
+            opt_sh = train_lib.MasterOptState(inner=opt_sh, master=p_sh)
         return (abstract_state(self.params, p_sh),
                 abstract_state(self.opt_state, opt_sh))
 
@@ -182,9 +223,15 @@ class Trainer:
 
         eval_loss = train_lib.build_eval_loss(self.mesh, self.config,
                                               self.tc)
+        lora, base = self.lora, self._base
+        if lora is not None:
+            from ..models.lora import merge_lora
 
         @jax.jit
         def eval_fn(params, tokens, targets):
+            if lora is not None:
+                # params are the adapters: evaluate the merged model
+                params = merge_lora(base, params, lora)
             loss = eval_loss(params, tokens, targets)
             n = jnp.sum(targets >= 0)
             return loss * n, n
@@ -242,6 +289,15 @@ class Trainer:
             jax.profiler.stop_trace()
             self._profiling = False
             log.info("profile trace written to %s", self.profile_dir)
+
+    def merged_params(self):
+        """LoRA mode: the base + trained-adapter merged tree — a plain
+        servable model for generate/speculation/the engines."""
+        if self.lora is None:
+            raise ValueError("merged_params() is for lora mode; in full "
+                             "training self.params already IS the model")
+        from ..models.lora import merge_lora
+        return merge_lora(self._base, self.params, self.lora)
 
     def save(self, *, force: bool = True) -> None:
         """Durably persist the current step (idempotent: a step the interval

@@ -235,3 +235,86 @@ def test_evaluate_on_pipeline_mesh():
         r_pp = tr_pp.evaluate(heldout)
         r_flat = tr_flat.evaluate(heldout)
     assert np.isclose(r_pp["loss"], r_flat["loss"], rtol=1e-4)
+
+
+def test_trainer_lora_mode_end_to_end(tmp_path):
+    """The finetune story composed: Trainer(lora=...) trains adapters
+    over a frozen base with prefetch + checkpoints, evaluates the MERGED
+    model, resumes from an adapter checkpoint, and hands a servable
+    merged tree to the serving stack."""
+    from kubeflow_tpu.models.lora import LoRAConfig
+    from kubeflow_tpu.models.transformer import init_params
+    cfg = TransformerConfig(vocab_size=128, d_model=32, n_layers=1,
+                            n_heads=4, n_kv_heads=2, d_ff=64,
+                            max_seq_len=32, dtype="float32")
+    base = init_params(jax.random.key(0), cfg)
+    lcfg = LoRAConfig(rank=4)
+    mesh = build_mesh(MeshConfig.auto(8, tp=2, fsdp=2))
+    heldout = list(synthetic_lm_batches(8, 16, 128, seed=4, n_batches=2))
+    with Trainer(mesh, cfg, lora=lcfg, base_params=base,
+                 checkpoint_dir=tmp_path / "ft",
+                 checkpoint_interval=10) as tr:
+        r0 = tr.evaluate(heldout)
+        tr.fit(synthetic_lm_batches(8, 16, 128, seed=4), steps=30,
+               log_every=30)
+        r1 = tr.evaluate(heldout)
+        assert r1["loss"] < r0["loss"]     # merged-model eval improves
+        tr.save()
+        # adapter checkpoints are tiny: total saved leaves ≈ adapter size
+        saved = sum(leaf.size for leaf in jax.tree.leaves(tr.params))
+        base_size = sum(leaf.size for leaf in jax.tree.leaves(base))
+        assert saved < base_size / 10
+        # merged tree decodes as a plain model
+        from kubeflow_tpu.models.decode import generate
+        merged = jax.device_get(tr.merged_params())
+        assert generate(merged, heldout[0][0][:1, :8], cfg, 4).shape == \
+            (1, 4)
+    # resume: a fresh lora trainer picks the adapters back up
+    with Trainer(mesh, cfg, lora=lcfg, base_params=base,
+                 checkpoint_dir=tmp_path / "ft") as tr2:
+        assert tr2.stats.step == 30
+        r2 = tr2.evaluate(heldout)
+        assert np.isclose(r2["loss"], r1["loss"], rtol=1e-5)
+
+
+def test_trainer_lora_mode_validation():
+    from kubeflow_tpu.models.lora import LoRAConfig
+    cfg = TransformerConfig(vocab_size=128, d_model=32, n_layers=1,
+                            n_heads=4, n_kv_heads=2, d_ff=64,
+                            max_seq_len=32, dtype="float32")
+    mesh = build_mesh(MeshConfig.auto(8))
+    with pytest.raises(ValueError, match="base_params"):
+        Trainer(mesh, cfg, lora=LoRAConfig(rank=2))
+    with Trainer(mesh, cfg) as tr:
+        with pytest.raises(ValueError, match="lora mode"):
+            tr.merged_params()
+
+
+def test_bf16_trainer_resumes_with_master_state(tmp_path):
+    """bf16_params + checkpoint_dir: construction must build
+    MasterOptState-shaped restore targets (review-found crash: the plain
+    optax tree shape mismatched the wrapped state even on an empty dir)
+    and resume on the training trajectory."""
+    cfg = TransformerConfig(vocab_size=128, d_model=32, n_layers=1,
+                            n_heads=4, n_kv_heads=2, d_ff=64,
+                            max_seq_len=32, dtype="float32")
+    mesh = build_mesh(MeshConfig.auto(8, tp=2, fsdp=2))
+    tc = TrainConfig(bf16_params=True)
+    with Trainer(mesh, cfg, tc, tmp_path / "bf", checkpoint_interval=5) \
+            as tr:
+        tr.fit(synthetic_lm_batches(8, 16, 128, seed=6), steps=10,
+               log_every=10)
+        tr.save()
+        step_before = tr.stats.step
+    with Trainer(mesh, cfg, tc, tmp_path / "bf") as tr2:
+        assert tr2.stats.step == step_before
+
+
+def test_lora_rejects_pipeline_mesh():
+    from kubeflow_tpu.models.lora import LoRAConfig, make_sharded_lora_step
+    cfg = TransformerConfig(vocab_size=128, d_model=32, n_layers=2,
+                            n_heads=4, n_kv_heads=2, d_ff=64,
+                            max_seq_len=32, dtype="float32")
+    mesh = build_mesh(MeshConfig.auto(8, pp=2, tp=2))
+    with pytest.raises(ValueError, match="pp"):
+        make_sharded_lora_step(mesh, cfg, LoRAConfig(rank=2))
