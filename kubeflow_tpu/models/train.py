@@ -383,8 +383,7 @@ def build_loss(mesh: Mesh, config: TransformerConfig,
     Returns ``loss(params, tokens, targets) -> scalar``."""
     import dataclasses
 
-    from .moe import (MoEConfig, moe_forward_hidden, moe_loss_fn,
-                      pipelined_moe_forward_hidden)
+    from .moe import MoEConfig, moe_loss_fn, pipelined_moe_forward_hidden
 
     tc = tc or TrainConfig()
     pp = mesh.shape.get("pp", 1)

@@ -541,6 +541,14 @@ def main(argv=None) -> int:
                     help="local tokenizer directory (transformers "
                          "AutoTokenizer, local_files_only): enables "
                          "'text' requests and decoded responses")
+    ap.add_argument("--lora-config", default=None,
+                    help="JSON of LoRAConfig fields (rank/alpha/targets):"
+                         " merge a finetuned adapter into the base "
+                         "weights at startup")
+    ap.add_argument("--lora-checkpoint", default=None,
+                    help="TrainCheckpointer dir holding the adapters "
+                         "(Trainer lora-mode checkpoints); required with "
+                         "--lora-config")
     ap.add_argument("--platform", default=None,
                     help="force the jax platform (e.g. 'cpu' for dev "
                          "boxes): applied via jax.config BEFORE backend "
@@ -553,9 +561,18 @@ def main(argv=None) -> int:
     import jax
     if args.platform:
         jax.config.update("jax_platforms", args.platform)
+    def _serving_abstract(tree):
+        # serving restores onto THIS process's device regardless of the
+        # training mesh: without explicit target shardings orbax falls
+        # back to the sharding file (the SAVED topology) and a checkpoint
+        # from a multi-chip trainer fails or misplaces on a dev box
+        from .checkpoint import abstract_state
+        dev = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        return abstract_state(tree, jax.tree.map(lambda _: dev, tree))
+
     if args.checkpoint:
-        from .checkpoint import TrainCheckpointer, abstract_state
-        abstract = abstract_state(
+        from .checkpoint import TrainCheckpointer
+        abstract = _serving_abstract(
             jax.eval_shape(lambda: init_params(jax.random.key(0), config)))
         with TrainCheckpointer(args.checkpoint) as ckpt:
             restored = ckpt.restore_params(abstract)
@@ -566,6 +583,29 @@ def main(argv=None) -> int:
     else:
         log.warning("no --checkpoint: serving randomly initialized params")
         params = init_params(jax.random.key(0), config)
+
+    if (args.lora_config is None) != (args.lora_checkpoint is None):
+        raise SystemExit("--lora-config and --lora-checkpoint must be "
+                         "provided together")
+    if args.lora_config:
+        # serve a finetune: restore the adapters and bake them into the
+        # base weights — downstream is a plain model (models/lora.py)
+        from ..models.lora import (LoRAConfig, init_lora_params,
+                                   merge_lora)
+        from .checkpoint import TrainCheckpointer
+        with open(args.lora_config) as fh:
+            lora_cfg = LoRAConfig(**json.load(fh))
+        abstract = _serving_abstract(jax.eval_shape(
+            lambda: init_lora_params(jax.random.key(0), config, lora_cfg)))
+        with TrainCheckpointer(args.lora_checkpoint) as ckpt:
+            restored = ckpt.restore_params(abstract)
+        if restored is None:
+            raise SystemExit(
+                f"no adapter checkpoint found in {args.lora_checkpoint}")
+        lstep, lora_params = restored
+        params = merge_lora(params, lora_params, lora_cfg)
+        log.info("merged LoRA adapters from step %d (rank %d, %s)",
+                 lstep, lora_cfg.rank, ",".join(lora_cfg.targets))
 
     draft = None
     if args.draft_checkpoint and not args.draft_config:

@@ -223,18 +223,24 @@ class Trainer:
 
         eval_loss = train_lib.build_eval_loss(self.mesh, self.config,
                                               self.tc)
-        lora, base = self.lora, self._base
+        lora = self.lora
         if lora is not None:
             from ..models.lora import merge_lora
 
         @jax.jit
-        def eval_fn(params, tokens, targets):
+        def eval_jit(base, params, tokens, targets):
             if lora is not None:
-                # params are the adapters: evaluate the merged model
+                # params are the adapters: evaluate the merged model.
+                # base rides as a traced ARGUMENT — a closure capture
+                # would bake a full extra copy of the weights into the
+                # executable's constants
                 params = merge_lora(base, params, lora)
             loss = eval_loss(params, tokens, targets)
             n = jnp.sum(targets >= 0)
             return loss * n, n
+
+        def eval_fn(params, tokens, targets):
+            return eval_jit(self._base, params, tokens, targets)
         self._eval_fn = eval_fn
         return eval_fn
 

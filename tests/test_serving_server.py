@@ -567,3 +567,43 @@ def test_incremental_detokenizer_forced_stabilization_boundary(tmp_path):
     text = "".join(out)
     assert text.endswith("é"), f"completing char lost: {text!r}"
     assert text.count("�") == IncrementalDetokenizer.MAX_HOLD
+
+
+def test_lora_merge_at_startup(tmp_path):
+    """--lora-config/--lora-checkpoint: adapters restore and merge into
+    the base at startup; the served logits are the merged model's, not
+    the base's. Drives main()'s restore+merge block via its pieces (the
+    blocking main() itself is process-lifetime)."""
+    import optax
+    from kubeflow_tpu.models.lora import (LoRAConfig, init_lora_params,
+                                          merge_lora)
+    from kubeflow_tpu.runtime.checkpoint import (TrainCheckpointer,
+                                                 abstract_state)
+    params, cfg = model()
+    lcfg = LoRAConfig(rank=2, targets=("wq",))
+    lp = init_lora_params(jax.random.key(3), cfg, lcfg)
+    # make the adapter non-trivial (B is zero-init)
+    lp["blocks"]["wq"]["B"] = jax.tree.map(
+        lambda b: b + 0.1, lp["blocks"]["wq"]["B"])
+    with TrainCheckpointer(tmp_path / "ad") as ck:
+        ck.save(7, lp, optax.adam(1e-3).init(lp), force=True)
+        ck.wait()
+    # the restore path main() runs
+    abstract = abstract_state(jax.eval_shape(
+        lambda: init_lora_params(jax.random.key(0), cfg, lcfg)))
+    with TrainCheckpointer(tmp_path / "ad") as ck:
+        step, lp_r = ck.restore_params(abstract)
+    assert step == 7
+    merged = merge_lora(params, lp_r, lcfg)
+    # the restored adapter is the one we wrote, and the merge is live:
+    # the served stream equals generate() on the merged tree exactly
+    for a, b in zip(jax.tree.leaves(lp_r), jax.tree.leaves(lp)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    from kubeflow_tpu.models.decode import generate
+    gen = BatchedGenerator(merged, cfg, max_batch=2, max_wait_s=0.05)
+    with ServingServer(gen, cfg, port=0) as srv:
+        _, out = _post(srv.url, {"prompt": list(range(6)),
+                                 "max_new_tokens": 6})
+    np.testing.assert_array_equal(
+        out["ids"],
+        np.asarray(generate(merged, np.arange(6)[None], cfg, 6))[0])
