@@ -118,17 +118,51 @@ def http_prober(config: ControllerConfig) -> Callable[[dict], JupyterActivity]:
     return probe
 
 
+def serving_requests_prober(config: ControllerConfig) \
+        -> Callable[[dict, str], int | None]:
+    """Production serving-activity probe: GET the in-pod serving server's
+    ``/healthz`` (runtime/server.py) through the notebook Service on the
+    annotated port and return its cumulative ``requests_total``. None =
+    unreachable (no server yet, or mid-restart) — never an error."""
+    def probe(notebook: dict, port: str) -> int | None:
+        ns, name = k8s.namespace(notebook), k8s.name(notebook)
+        if config.dev_mode:
+            url = (f"{config.dev_proxy_url}/api/v1/namespaces/{ns}/"
+                   f"services/{name}:{port}/proxy/healthz")
+        else:
+            url = (f"http://{name}.{ns}.svc.{config.cluster_domain}:"
+                   f"{port}/healthz")
+        try:
+            with urllib.request.urlopen(
+                    url, timeout=config.jupyter_probe_timeout_s) as resp:
+                body = json.loads(resp.read())
+            if not isinstance(body, dict):
+                raise ValueError(f"unexpected healthz shape: "
+                                 f"{type(body).__name__}")
+            total = body.get("requests_total")
+            return int(total) if total is not None else None
+        except (urllib.error.URLError, OSError, ValueError,
+                TypeError) as exc:
+            log.debug("serving probe %s/%s failed: %s", ns, name, exc)
+            return None
+    return probe
+
+
 class CullingReconciler:
     name = "culling-controller"
 
     def __init__(self, client, config: ControllerConfig | None = None,
                  metrics: MetricsRegistry | None = None,
                  prober: Callable[[dict], JupyterActivity] | None = None,
-                 clock: Callable[[], float] = time.time):
+                 clock: Callable[[], float] = time.time,
+                 serving_prober: Callable[[dict, str], int | None]
+                 | None = None):
         self.client = client
         self.config = config or ControllerConfig()
         self.metrics = metrics or MetricsRegistry()
         self.prober = prober or http_prober(self.config)
+        self.serving_prober = serving_prober or \
+            serving_requests_prober(self.config)
         self.clock = clock
 
     def setup(self, mgr: Manager) -> None:
@@ -180,6 +214,34 @@ class CullingReconciler:
                 if latest is not None and latest > parse_time(last_activity):
                     updates[names.LAST_ACTIVITY_ANNOTATION] = format_time(latest)
 
+        # serving-aware idleness: a notebook with the serving-port
+        # annotation hosts a model endpoint (runtime/server.py); request
+        # traffic since the previous probe IS activity — an endpoint
+        # taking inference load must not be culled for having no Jupyter
+        # kernels. The observed cumulative count rides an annotation so
+        # the comparison survives controller restarts/failovers.
+        serving_port = k8s.get_annotation(notebook,
+                                          names.SERVING_PORT_ANNOTATION)
+        if serving_port:
+            total = self.serving_prober(notebook, serving_port)
+            if total is not None:
+                seen = k8s.get_annotation(
+                    notebook, names.SERVING_REQUESTS_OBSERVED_ANNOTATION)
+                try:
+                    seen_n = int(seen) if seen is not None else None
+                except ValueError:
+                    seen_n = None
+                if seen_n is None or total != seen_n:
+                    if seen_n is not None and total > seen_n:
+                        # traffic since the last probe (the first
+                        # observation only arms; a DECREASE is a server
+                        # restart — re-arm at the new baseline without
+                        # crediting activity)
+                        updates[names.LAST_ACTIVITY_ANNOTATION] = \
+                            format_time(now)
+                    updates[names.SERVING_REQUESTS_OBSERVED_ANNOTATION] = \
+                        str(total)
+
         effective_last = parse_time(
             updates.get(names.LAST_ACTIVITY_ANNOTATION, last_activity))
         idle_s = now - effective_last
@@ -206,14 +268,15 @@ class CullingReconciler:
         return None
 
     def _strip_activity_annotations(self, notebook: dict) -> None:
-        if (k8s.get_annotation(notebook, names.LAST_ACTIVITY_ANNOTATION) is None
-                and k8s.get_annotation(
-                    notebook,
-                    names.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION) is None):
+        if all(k8s.get_annotation(notebook, a) is None for a in (
+                names.LAST_ACTIVITY_ANNOTATION,
+                names.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION,
+                names.SERVING_REQUESTS_OBSERVED_ANNOTATION)):
             return
         self._retry_patch_annotations(notebook, {
             names.LAST_ACTIVITY_ANNOTATION: None,
             names.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION: None,
+            names.SERVING_REQUESTS_OBSERVED_ANNOTATION: None,
         })
 
     def _retry_patch_annotations(self, notebook: dict,

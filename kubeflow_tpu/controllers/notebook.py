@@ -360,6 +360,25 @@ class NotebookReconciler:
                 }],
             },
         }
+        # serving-aware culling: the annotated model-serving endpoint
+        # (runtime/server.py) must be reachable THROUGH the Service or the
+        # culler's activity probe (controllers/culling.py
+        # serving_requests_prober) would get connection-refused and cull
+        # an actively-serving slice
+        serving_port = k8s.get_annotation(notebook,
+                                          names.SERVING_PORT_ANNOTATION)
+        if serving_port:
+            try:
+                port_n = int(serving_port)
+            except ValueError:
+                port_n = None
+            if port_n is not None and 0 < port_n < 65536:
+                svc["spec"]["ports"].append({
+                    "name": "http-serving",
+                    "port": port_n,
+                    "targetPort": port_n,
+                    "protocol": "TCP",
+                })
         k8s.set_controller_reference(notebook, svc)
         return svc
 

@@ -614,8 +614,8 @@ def main(argv=None) -> int:
         with open(args.draft_config) as fh:
             draft_config = TransformerConfig(**json.load(fh))
         if args.draft_checkpoint:
-            from .checkpoint import TrainCheckpointer, abstract_state
-            abstract = abstract_state(jax.eval_shape(
+            from .checkpoint import TrainCheckpointer
+            abstract = _serving_abstract(jax.eval_shape(
                 lambda: init_params(jax.random.key(0), draft_config)))
             with TrainCheckpointer(args.draft_checkpoint) as ckpt:
                 restored = ckpt.restore_params(abstract)

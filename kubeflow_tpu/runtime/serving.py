@@ -386,6 +386,10 @@ class ContinuousBatchedGenerator:
         self._lifecycle = threading.Lock()
         # metrics: the serving-test observable — how many requests were
         # admitted while other rows were mid-generation
+        # requests_total counts SUBMISSIONS (like BatchedGenerator's) —
+        # it is also the serving-activity signal the culler's prober
+        # reads from /healthz (controllers/culling.py)
+        self.requests_total = 0
         self.admitted_total = 0
         self.admitted_while_running = 0
         self.steps_total = 0
@@ -436,6 +440,7 @@ class ContinuousBatchedGenerator:
         with self._lifecycle:
             if self._closed:
                 raise RuntimeError("generator is closed")
+            self.requests_total += 1
             self._queue.put(req)
         return req.future
 

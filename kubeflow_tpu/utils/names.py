@@ -49,6 +49,12 @@ TPU_TOPOLOGY_ANNOTATION = "tpu.kubeflow.org/topology"
 TPU_SLICE_LABEL = "tpu.kubeflow.org/slice"
 # records what the image was before the TPU image swap replaced it
 TPU_ORIGINAL_IMAGE_ANNOTATION = "tpu.kubeflow.org/original-image"
+# serving-aware culling: the port of an in-pod model-serving endpoint
+# (runtime/server.py) whose request traffic counts as notebook activity,
+# and the request count observed at the previous culling probe
+SERVING_PORT_ANNOTATION = "tpu.kubeflow.org/serving-port"
+SERVING_REQUESTS_OBSERVED_ANNOTATION = \
+    "tpu.kubeflow.org/serving-requests-observed"
 
 # Kubernetes DNS-1123 subdomain limit for the pod hostname contributed by the
 # StatefulSet name; the reference caps STS names at 52 chars so the "-<ordinal>"

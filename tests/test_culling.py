@@ -166,3 +166,102 @@ def test_enable_culling_gate(store):
     cfg = ControllerConfig(enable_culling=True)
     mgr = setup_controllers(store, cfg, prober=lambda nb: JupyterActivity())
     assert "culling-controller" in mgr._reconcilers
+
+
+# ------------------------------------------------------ serving-aware culling
+class FakeServing:
+    """Switchable serving-endpoint counter (None = unreachable)."""
+
+    def __init__(self):
+        self.total = None
+        self.probes = 0
+
+    def __call__(self, notebook, port):
+        self.probes += 1
+        self.port = port
+        return self.total
+
+
+@pytest.fixture
+def serving_world(store):
+    clock = FakeClock()
+    jupyter = FakeJupyter()
+    jupyter.activity = JupyterActivity(kernels=[])   # no Jupyter activity
+    serving = FakeServing()
+    cfg = ControllerConfig(enable_culling=True, cull_idle_time_min=60,
+                           idleness_check_period_min=1)
+    metrics = MetricsRegistry()
+    mgr = Manager(store)
+    NotebookReconciler(store, cfg, metrics).setup(mgr)
+    CullingReconciler(store, cfg, metrics, prober=jupyter, clock=clock,
+                      serving_prober=serving).setup(mgr)
+    StatefulSetSimulator(store, boot_delay_s=0.0).setup(mgr)
+    return store, mgr, clock, serving
+
+
+def test_serving_traffic_prevents_cull(serving_world):
+    """A notebook hosting a model endpoint with request traffic is ACTIVE
+    even with zero Jupyter kernels — the culler reads the serving
+    /healthz counter through the annotated port."""
+    store, mgr, clock, serving = serving_world
+    store.create(api.new_notebook("nb", "ns", annotations={
+        names.SERVING_PORT_ANNOTATION: "8890"}))
+    drain(mgr, include_delayed_under=0.1)
+    serving.total = 10
+    tick(store, mgr, clock, 2)           # arms the observed counter
+    for _ in range(4):
+        serving.total += 25              # traffic every window
+        tick(store, mgr, clock, 45)      # 180 idle-min without the signal
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is None
+    assert serving.port == "8890"
+    assert k8s.get_annotation(
+        nb, names.SERVING_REQUESTS_OBSERVED_ANNOTATION) == str(serving.total)
+
+
+def test_idle_serving_endpoint_still_culls(serving_world):
+    """No traffic (constant counter) is idleness: the endpoint's mere
+    existence must not pin the slice forever."""
+    store, mgr, clock, serving = serving_world
+    store.create(api.new_notebook("nb", "ns", annotations={
+        names.SERVING_PORT_ANNOTATION: "8890"}))
+    drain(mgr, include_delayed_under=0.1)
+    serving.total = 500
+    tick(store, mgr, clock, 2)
+    tick(store, mgr, clock, 45)
+    tick(store, mgr, clock, 45)          # 90+ min, counter never moved
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is not None
+
+
+def test_serving_counter_reset_rearms_without_activity_credit(serving_world):
+    """A server restart (counter decrease) re-baselines the observation
+    but is NOT activity — crediting it would let crash-looping servers
+    pin the slice."""
+    store, mgr, clock, serving = serving_world
+    store.create(api.new_notebook("nb", "ns", annotations={
+        names.SERVING_PORT_ANNOTATION: "8890"}))
+    drain(mgr, include_delayed_under=0.1)
+    serving.total = 400
+    tick(store, mgr, clock, 2)           # arm at 400
+    serving.total = 3                    # restart: counter reset
+    tick(store, mgr, clock, 45)
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(
+        nb, names.SERVING_REQUESTS_OBSERVED_ANNOTATION) == "3"
+    tick(store, mgr, clock, 45)          # still no NEW traffic → cull
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is not None
+
+
+def test_unreachable_serving_endpoint_is_not_activity(serving_world):
+    store, mgr, clock, serving = serving_world
+    store.create(api.new_notebook("nb", "ns", annotations={
+        names.SERVING_PORT_ANNOTATION: "8890"}))
+    drain(mgr, include_delayed_under=0.1)
+    serving.total = None                 # probe always fails
+    tick(store, mgr, clock, 2)
+    tick(store, mgr, clock, 61)
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is not None
+    assert serving.probes > 0

@@ -387,3 +387,21 @@ def test_notebook_label_edit_keeps_pods_visible_to_simulator(
     cond = api.get_condition(store.get(api.KIND, "ns", "mynb"),
                              api.CONDITION_SLICE_READY)
     assert cond["status"] == "True"
+
+
+def test_service_exposes_annotated_serving_port(store, manager,
+                                                notebook_reconciler):
+    """tpu.kubeflow.org/serving-port: the Service must route the model
+    endpoint or the culler's serving-activity probe gets connection
+    refused and culls an actively-serving slice; junk values are ignored
+    rather than producing an invalid Service."""
+    apply_notebook(store, manager, api.new_notebook("srv", "ns", annotations={
+        names.SERVING_PORT_ANNOTATION: "8890"}))
+    apply_notebook(store, manager, api.new_notebook("bad", "ns", annotations={
+        names.SERVING_PORT_ANNOTATION: "not-a-port"}))
+    apply_notebook(store, manager, api.new_notebook("plain", "ns"))
+    ports = store.get("Service", "ns", "srv")["spec"]["ports"]
+    assert {"name": "http-serving", "port": 8890, "targetPort": 8890,
+            "protocol": "TCP"} in ports
+    assert len(store.get("Service", "ns", "bad")["spec"]["ports"]) == 1
+    assert len(store.get("Service", "ns", "plain")["spec"]["ports"]) == 1
