@@ -91,6 +91,51 @@ def token_file_batches(path, batch_size: int, seq_len: int, *,
         epoch += 1
 
 
+def tokenize_corpus(text_path, tokenizer, out_path, *,
+                    doc_sep: int | None = None,
+                    encoding: str = "utf-8") -> int:
+    """One-time corpus preparation: tokenize a text file into the raw
+    int32 token-file format ``token_file_batches`` memmaps — the bridge
+    from "I have a .txt" to the packed training pipeline.
+
+    Documents are blank-line-separated paragraphs; with ``doc_sep`` set,
+    that id is written between documents so the loader can mask
+    cross-document targets (its ``doc_sep`` argument). Tokenization is
+    streamed paragraph-at-a-time — the corpus never loads into RAM —
+    and the token count is returned (and is the out file's length / 4).
+
+    ``tokenizer`` is duck-typed like the serving server's: anything with
+    ``encode(text, add_special_tokens=False) -> ids``."""
+    import itertools
+
+    n = 0
+    with open(text_path, encoding=encoding) as fh, \
+            open(out_path, "wb") as out:
+        for is_blank, lines in itertools.groupby(
+                fh, key=lambda ln: not ln.strip()):
+            if is_blank:
+                continue
+            text = " ".join(ln.strip() for ln in lines)
+            ids = tokenizer.encode(text, add_special_tokens=False)
+            if not ids:
+                continue
+            arr = np.asarray(ids, dtype="<i4")
+            if doc_sep is not None:
+                if doc_sep in arr:
+                    # a tokenizer that can emit the separator id would
+                    # make the loader silently mask REAL mid-document
+                    # targets — surface the collision at write time
+                    raise ValueError(
+                        f"tokenizer emitted doc_sep id {doc_sep} inside "
+                        f"a document; pick an id outside its vocab")
+                if n:
+                    out.write(np.asarray([doc_sep], dtype="<i4").tobytes())
+                    n += 1
+            out.write(arr.tobytes())
+            n += len(arr)
+    return n
+
+
 class _Stop:
     pass
 

@@ -253,3 +253,18 @@ def test_moe_target_speculative_parity():
     got, _ = speculative_generate(mparams, dparams, prompt, mcfg, dcfg,
                                   16, k=3)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_int8_quantized_target_speculative_parity(target, draft):
+    """The production serving shape: int8 weight-only target verified
+    through decode_window (wcast dequantizes in the operand load) must
+    match generate() on the same quantized tree exactly."""
+    from kubeflow_tpu.models.quant import quantize_params
+    params, cfg = target
+    dparams, dcfg = draft
+    qparams = quantize_params(params)
+    prompt = _prompt()
+    want = generate(qparams, prompt, cfg, 16)
+    got, _ = speculative_generate(qparams, dparams, prompt, cfg, dcfg,
+                                  16, k=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

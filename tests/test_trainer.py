@@ -318,3 +318,36 @@ def test_lora_rejects_pipeline_mesh():
     mesh = build_mesh(MeshConfig.auto(8, pp=2, tp=2))
     with pytest.raises(ValueError, match="pp"):
         make_sharded_lora_step(mesh, cfg, LoRAConfig(rank=2))
+
+
+def test_tokenize_corpus_to_training_pipeline(tmp_path):
+    """The .txt -> token-file -> packed-batches bridge: paragraph
+    documents tokenize streamed, doc_sep separators land between them,
+    and the produced file feeds token_file_batches with cross-document
+    targets masked."""
+    from kubeflow_tpu.runtime.data import token_file_batches, tokenize_corpus
+
+    class WordTok:
+        def encode(self, text, add_special_tokens=False):
+            return [hash(w) % 90 + 2 for w in text.split()]
+    text = tmp_path / "corpus.txt"
+    text.write_text(
+        "alpha beta gamma delta\nepsilon zeta\n"
+        "\n\n"
+        "eta theta iota kappa\n"
+        "\n"
+        "lam mu nu xi omicron pi rho sigma\n")
+    out = tmp_path / "corpus.tokens"
+    n = tokenize_corpus(text, WordTok(), out, doc_sep=1)
+    # 6 + 4 + 8 words + 2 separators
+    assert n == 20
+    assert out.stat().st_size == n * 4
+    raw = np.fromfile(out, dtype="<i4")
+    assert list(raw).count(1) == 2          # separators between docs only
+    assert raw[0] != 1 and raw[-1] != 1
+    batches = list(token_file_batches(out, batch_size=2, seq_len=8,
+                                      seed=None, doc_sep=1))
+    assert batches
+    tokens, targets = batches[0]
+    assert tokens.shape == (2, 8)
+    assert (targets == -1).sum() > 0        # boundary masking engaged
