@@ -77,6 +77,95 @@ def _scaled_probs(logits: jax.Array, temperature: jax.Array) -> jax.Array:
     return jax.nn.softmax(logits / t, axis=-1)
 
 
+def propose_and_verify(params: dict, draft_params: dict, t_cache: dict,
+                       d_cache: dict, last: jax.Array, q_pos: jax.Array,
+                       temp: jax.Array, key: jax.Array,
+                       config: TransformerConfig,
+                       draft_config: TransformerConfig, k: int):
+    """One speculative block with no emit bookkeeping: draft k proposals
+    sequentially, verify with ONE target decode_window, accept per row
+    (greedy exact-match for temp==0 rows, Leviathan accept/reject for
+    sampled rows), and select the block's closing token (target pick /
+    residual resample / bonus). Shared by ``speculative_generate``'s
+    while-loop and the continuous serving engine's spec tick
+    (runtime/serving.py) — the math lives once.
+
+    last: (B,) newest emitted, not yet consumed, at positions q_pos.
+    Returns (t_cache, d_cache, drafts (B, k), n_acc (B,), tail (B,)):
+    the emitted block for a row is drafts[:n_acc] then tail. The k+1th
+    draft step exists for the cache (see the body comment)."""
+    tc, dc = config, draft_config
+    B = last.shape[0]
+    sampled = temp > 0.0
+    key_blk, key_u, key_rej, key_bonus = jax.random.split(key, 4)
+
+    # k+1 sequential draft steps consuming [last, d_0 .. d_{k-1}] at
+    # positions q_pos .. q_pos+k → (B, k) proposals, their (B, k, V)
+    # draft distributions, advanced cache. The extra step exists for the
+    # cache, not the proposal: when all k drafts are accepted the next
+    # block starts at q_pos+k+1, so the draft cache must already hold
+    # d_{k-1}'s K/V at q_pos+k — without consuming it, that row would be
+    # a permanent hole the draft then attends through. Draft proposals
+    # are greedy for greedy rows and drawn from q for sampled rows (the
+    # acceptance rule needs proposals actually distributed as q).
+    def body(bcarry, j):
+        cache, tok, bkey = bcarry
+        logits, cache = decode_step(draft_params, cache, tok,
+                                    q_pos + j, dc)
+        bkey, sub = jax.random.split(bkey)
+        probs = _scaled_probs(logits, temp)
+        nxt_greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt_sampled = jax.random.categorical(
+            sub, jnp.log(probs + 1e-30), axis=-1).astype(jnp.int32)
+        nxt = jnp.where(sampled, nxt_sampled, nxt_greedy)
+        return (cache, nxt, bkey), (nxt, probs)
+
+    (d_cache, _, _), (drafts_t, q_probs_t) = lax.scan(
+        body, (d_cache, last, key_blk), jnp.arange(k + 1, dtype=jnp.int32))
+    drafts = jnp.moveaxis(drafts_t[:k], 0, 1)                # (B, k)
+    q_probs = jnp.moveaxis(q_probs_t[:k], 0, 1)              # (B, k, V)
+
+    window = jnp.concatenate([last[:, None], drafts], axis=1)
+    t_logits, t_cache = decode_window(params, t_cache, window, q_pos, tc)
+    p_probs = _scaled_probs(t_logits, temp)                  # (B, k+1, V)
+    greedy = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+
+    # acceptance, per rule
+    p_at_d = jnp.take_along_axis(p_probs[:, :k], drafts[..., None],
+                                 axis=-1)[..., 0]            # (B, k)
+    q_at_d = jnp.take_along_axis(q_probs, drafts[..., None],
+                                 axis=-1)[..., 0]
+    u = jax.random.uniform(key_u, (B, k))
+    match_sampled = u * q_at_d < p_at_d      # u < p/q without the div
+    match_greedy = drafts == greedy[:, :k]
+    match = jnp.where(sampled[:, None], match_sampled, match_greedy)
+    n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
+                    axis=1)                                  # (B,) in [0, k]
+
+    # the block's closing token: greedy rows take the target's own pick
+    # at the first mismatch (or the bonus after k accepts — greedy[n_acc]
+    # covers both); sampled rows resample rejections from the residual
+    # norm(max(p_r − q_r, 0)) and draw the bonus from p_k.
+    p_r = jnp.take_along_axis(
+        p_probs, jnp.minimum(n_acc, k - 1)[:, None, None], axis=1)[:, 0]
+    q_r = jnp.take_along_axis(
+        q_probs, jnp.minimum(n_acc, k - 1)[:, None, None], axis=1)[:, 0]
+    resid = jnp.maximum(p_r - q_r, 0.0)
+    resid_mass = jnp.sum(resid, axis=-1, keepdims=True)
+    # p == q makes the residual empty; rejection then cannot happen
+    # (accept prob was 1), but guard the log anyway
+    resid = jnp.where(resid_mass > 1e-12, resid / resid_mass, p_r)
+    rej_tok = jax.random.categorical(
+        key_rej, jnp.log(resid + 1e-30), axis=-1).astype(jnp.int32)
+    bonus_tok = jax.random.categorical(
+        key_bonus, jnp.log(p_probs[:, k] + 1e-30),
+        axis=-1).astype(jnp.int32)
+    tail_sampled = jnp.where(n_acc == k, bonus_tok, rej_tok)
+    tail_greedy = jnp.take_along_axis(greedy, n_acc[:, None], axis=1)[:, 0]
+    tail = jnp.where(sampled, tail_sampled, tail_greedy)
+    return t_cache, d_cache, drafts, n_acc, tail
+
+
 @partial(jax.jit,
          static_argnames=("config", "draft_config", "max_new_tokens",
                           "k", "eos_id", "pad_id"))
@@ -143,78 +232,10 @@ def speculative_generate(params: dict, draft_params: dict,
 
     def block(carry: Carry) -> Carry:
         q_pos = P + carry.n_out - 1          # (B,) position of `last`
-        key_blk, key_u, key_rej, key_bonus, key_next = jax.random.split(
-            carry.key, 5)
-
-        # k+1 sequential draft steps consuming [last, d_0 .. d_{k-1}] at
-        # positions q_pos .. q_pos+k → (B, k) proposals, their (B, k, V)
-        # draft distributions, advanced cache. The extra step exists for
-        # the cache, not the proposal: when all k drafts are accepted the
-        # next block starts at q_pos+k+1, so the draft cache must already
-        # hold d_{k-1}'s K/V at q_pos+k — without consuming it, that row
-        # would be a permanent hole the draft then attends through. Draft
-        # proposals are greedy for greedy rows and drawn from q for
-        # sampled rows (the acceptance rule needs proposals actually
-        # distributed as q).
-        def body(bcarry, j):
-            cache, tok, bkey = bcarry
-            logits, cache = decode_step(draft_params, cache, tok,
-                                        q_pos + j, dc)
-            bkey, sub = jax.random.split(bkey)
-            probs = _scaled_probs(logits, temp)
-            nxt_greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            nxt_sampled = jax.random.categorical(
-                sub, jnp.log(probs + 1e-30), axis=-1).astype(jnp.int32)
-            nxt = jnp.where(sampled, nxt_sampled, nxt_greedy)
-            return (cache, nxt, bkey), (nxt, probs)
-
-        (d_cache, _, _), (drafts_t, q_probs_t) = lax.scan(
-            body, (carry.d_cache, carry.last, key_blk),
-            jnp.arange(k + 1, dtype=jnp.int32))
-        drafts = jnp.moveaxis(drafts_t[:k], 0, 1)            # (B, k)
-        q_probs = jnp.moveaxis(q_probs_t[:k], 0, 1)          # (B, k, V)
-
-        window = jnp.concatenate([carry.last[:, None], drafts], axis=1)
-        t_logits, t_cache = decode_window(params, carry.t_cache, window,
-                                          q_pos, tc)
-        p_probs = _scaled_probs(t_logits, temp)              # (B, k+1, V)
-        greedy = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
-
-        # --- acceptance, per rule ---
-        p_at_d = jnp.take_along_axis(p_probs[:, :k], drafts[..., None],
-                                     axis=-1)[..., 0]        # (B, k)
-        q_at_d = jnp.take_along_axis(q_probs, drafts[..., None],
-                                     axis=-1)[..., 0]
-        u = jax.random.uniform(key_u, (B, k))
-        match_sampled = u * q_at_d < p_at_d      # u < p/q without the div
-        match_greedy = drafts == greedy[:, :k]
-        match = jnp.where(sampled[:, None], match_sampled, match_greedy)
-        n_acc = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1),
-                        axis=1)                              # (B,) in [0, k]
-
-        # --- the block's closing token ---
-        # greedy rows: the target's own pick at the first mismatch (or the
-        # bonus after k accepts) — greedy[n_acc] covers both.
-        # sampled rows, rejection at r=n_acc<k: draw from the residual
-        # norm(max(p_r − q_r, 0)); all-accepted: draw the bonus from p_k.
-        p_r = jnp.take_along_axis(
-            p_probs, jnp.minimum(n_acc, k - 1)[:, None, None], axis=1)[:, 0]
-        q_r = jnp.take_along_axis(
-            q_probs, jnp.minimum(n_acc, k - 1)[:, None, None], axis=1)[:, 0]
-        resid = jnp.maximum(p_r - q_r, 0.0)
-        resid_mass = jnp.sum(resid, axis=-1, keepdims=True)
-        # p == q makes the residual empty; rejection then cannot happen
-        # (accept prob was 1), but guard the log anyway
-        resid = jnp.where(resid_mass > 1e-12, resid / resid_mass, p_r)
-        rej_tok = jax.random.categorical(
-            key_rej, jnp.log(resid + 1e-30), axis=-1).astype(jnp.int32)
-        p_bonus = p_probs[:, k]
-        bonus_tok = jax.random.categorical(
-            key_bonus, jnp.log(p_bonus + 1e-30), axis=-1).astype(jnp.int32)
-        tail_sampled = jnp.where(n_acc == k, bonus_tok, rej_tok)
-        tail_greedy = jnp.take_along_axis(greedy, n_acc[:, None],
-                                          axis=1)[:, 0]
-        tail = jnp.where(sampled, tail_sampled, tail_greedy)
+        key_blk, key_next = jax.random.split(carry.key)
+        t_cache, d_cache, drafts, n_acc, tail = propose_and_verify(
+            params, draft_params, carry.t_cache, carry.d_cache,
+            carry.last, q_pos, temp, key_blk, tc, dc, k)
 
         # --- emit the block ---
         j = jnp.arange(k + 1, dtype=jnp.int32)[None, :]      # (1, k+1)

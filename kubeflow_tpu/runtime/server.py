@@ -118,7 +118,7 @@ class ServingServer:
         "requests_total", "batches_total", "admitted_total",
         "admitted_while_running", "steps_total", "prefill_chunks_total",
         "prefix_cache_hits_total", "cancelled_total", "spec_batches",
-        "spec_accepted", "spec_drafted")
+        "spec_ticks", "spec_accepted", "spec_drafted")
 
     def __init__(self, generator, config, *, host: str = "127.0.0.1",
                  port: int = 8890, request_timeout_s: float = 300.0,
@@ -490,18 +490,21 @@ def build_generator(params, config, args, draft=None):
         kw = {}
         if draft is not None:
             kw = dict(draft_params=draft[0], draft_config=draft[1],
-                      spec_k=args.spec_k)
+                      spec_k=args.spec_k,
+                      spec_exact_only=not getattr(args, "spec_inexact",
+                                                  False))
         return BatchedGenerator(params, config, max_batch=args.slots,
                                 quantize=args.quantize, **kw)
+    kw = {}
     if draft is not None:
-        raise SystemExit("--draft-config requires --engine bucketed "
-                         "(the continuous engine schedules single-token "
-                         "ticks; block-speculation integration is not "
-                         "implemented)")
+        kw = dict(draft_params=draft[0], draft_config=draft[1],
+                  spec_k=args.spec_k,
+                  spec_exact_only=not getattr(args, "spec_inexact",
+                                              False))
     return ContinuousBatchedGenerator(
         params, config, n_slots=args.slots, quantize=args.quantize,
         kv_quant=args.kv_quant,
-        eos_id=args.eos_id if args.eos_id >= 0 else None)
+        eos_id=args.eos_id if args.eos_id >= 0 else None, **kw)
 
 
 def main(argv=None) -> int:
@@ -537,6 +540,12 @@ def main(argv=None) -> int:
                          "(dev only)")
     ap.add_argument("--spec-k", type=int, default=4,
                     help="draft tokens proposed per speculative block")
+    ap.add_argument("--spec-inexact", action="store_true",
+                    help="allow speculation where plain decode would use "
+                         "the flash kernel: the einsum verify window can "
+                         "differ in last-bit rounding, so a greedy "
+                         "near-tie may flip (sampled requests' "
+                         "distribution is unaffected)")
     ap.add_argument("--tokenizer", default=None,
                     help="local tokenizer directory (transformers "
                          "AutoTokenizer, local_files_only): enables "

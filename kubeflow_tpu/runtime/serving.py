@@ -299,13 +299,18 @@ class _Slot:
 class _Admission:
     """Chunked-prefill progress for one slot: the prompt consumed
     ``chunk`` tokens per engine iteration into a private single-row cache,
-    spliced into the engine state when complete."""
+    spliced into the engine state when complete. In speculative mode the
+    DRAFT model prefills the same prompt into its own row cache with an
+    independent cursor (prefix-cache hits can advance the two at
+    different rates)."""
     req: GenerateRequest
     padded: np.ndarray       # (1, n_chunks * chunk) pad-extended prompt
     real_len: int
     row_cache: dict
     consumed: int = 0
     last_logits: object = None   # (1, V) at the last REAL position so far
+    d_row_cache: dict | None = None
+    d_consumed: int = 0
 
 
 class ContinuousBatchedGenerator:
@@ -355,7 +360,9 @@ class ContinuousBatchedGenerator:
                  max_new_cap: int | None = None, seed: int = 0,
                  quantize: bool = False, kv_quant: bool = False,
                  eos_id: int | None = None, pad_id: int = 0,
-                 prefill_chunk: int = 256, prefix_cache_chunks: int = 64):
+                 prefill_chunk: int = 256, prefix_cache_chunks: int = 64,
+                 draft_params=None, draft_config=None, spec_k: int = 4,
+                 spec_exact_only: bool = True):
         if quantize:
             from ..models.quant import quantize_params
             params = quantize_params(params)
@@ -365,6 +372,30 @@ class ContinuousBatchedGenerator:
         if prefix_cache_chunks < 0:
             raise ValueError(f"prefix_cache_chunks must be >= 0, "
                              f"got {prefix_cache_chunks}")
+        # continuous speculation: every tick runs a k-token draft block +
+        # ONE verify window for all rows (models/speculative.py
+        # propose_and_verify), rows advancing 1..k+1 tokens at their own
+        # acceptance rate while admission/collection stay per-token-
+        # boundary. Same outputs as the plain engine (greedy exact,
+        # sampled exactly target-distributed); top-k/top-p warps are
+        # rejected at submit in this mode.
+        if (draft_params is None) != (draft_config is None):
+            raise ValueError("draft_params and draft_config must be "
+                             "provided together")
+        if draft_params is not None:
+            from ..models.decode import uses_flash_decode
+            if spec_k < 1:
+                raise ValueError(f"spec_k must be >= 1, got {spec_k}")
+            if spec_exact_only and uses_flash_decode(config):
+                raise ValueError(
+                    "speculative verification runs the einsum window "
+                    "while this config's plain decode would use the "
+                    "flash kernel; last-bit kernel divergence can flip "
+                    "a greedy near-tie — pass spec_exact_only=False to "
+                    "accept that, or use the non-speculative engine")
+        self.draft = (draft_params, draft_config) \
+            if draft_params is not None else None
+        self.spec_k = spec_k
         self.params = params
         self.config = config
         self.n_slots = n_slots
@@ -396,7 +427,14 @@ class ContinuousBatchedGenerator:
         self.prefill_chunks_total = 0
         self.prefix_cache_hits_total = 0   # chunks SKIPPED via the cache
         self.cancelled_total = 0
+        self.spec_ticks = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         self._state = self._fresh_state()
+        self._dstate = None
+        if self.draft is not None:
+            from ..models.decode import init_kv_cache
+            self._dstate = {"cache": init_kv_cache(self.draft[1], n_slots)}
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="kubeflow-tpu-cbatch")
         self._thread.start()
@@ -420,6 +458,11 @@ class ContinuousBatchedGenerator:
             "temp": jnp.zeros((n_slots,), jnp.float32),
             "top_k": jnp.zeros((n_slots,), jnp.int32),
             "top_p": jnp.ones((n_slots,), jnp.float32),
+            # speculative mode only: the newest emitted-not-yet-consumed
+            # token per row, its position, and the row's token target
+            "last": jnp.zeros((n_slots,), jnp.int32),
+            "lpos": jnp.zeros((n_slots,), jnp.int32),
+            "target": jnp.zeros((n_slots,), jnp.int32),
         }
 
     # ----------------------------------------------------------------- API
@@ -436,6 +479,20 @@ class ContinuousBatchedGenerator:
             raise ValueError("prompt must be non-empty")
         if len(req.prompt) + max_new_tokens > self.config.max_seq_len:
             raise ValueError("prompt + max_new_tokens exceeds max_seq_len")
+        if self.draft is not None:
+            if top_k > 0 or top_p < 1.0:
+                raise ValueError("the speculative engine has no "
+                                 "top-k/top-p warps (both distributions "
+                                 "would need the warp before the ratio "
+                                 "test); use the plain engine")
+            # the verify window may overhang the frontier by up to k
+            # rejected rows before they are overwritten
+            limit = min(self.config.max_seq_len,
+                        self.draft[1].max_seq_len)
+            if len(req.prompt) + max_new_tokens + self.spec_k > limit:
+                raise ValueError(
+                    f"prompt + max_new_tokens + spec_k exceeds "
+                    f"max_seq_len {limit}")
         req.future._kubeflow_tpu_request = req   # cancel() handle
         with self._lifecycle:
             if self._closed:
@@ -558,6 +615,116 @@ class ContinuousBatchedGenerator:
         }
 
     @staticmethod
+    @partial(jax.jit, static_argnames=("eos_id", "pad_id"),
+             donate_argnums=(0, 1, 2, 3))
+    def _spec_splice_jit(state, dstate, row_cache, d_row_cache,
+                        last_logits, slot, real_len, target, temp, key,
+                        eos_id, pad_id):
+        """Speculative-mode install: splice BOTH models' row caches and
+        arm the row with its first token sampled from the prompt's
+        next-token logits (the spec loop consumes `last` rather than
+        carrying logits — models/speculative.py's `first` seeding)."""
+        slot32 = jnp.asarray(slot, jnp.int32)
+
+        def splice(buf_state, rows):
+            cache = dict(buf_state["cache"])
+            for name, buf in rows.items():
+                cache[name] = lax.dynamic_update_slice(
+                    buf_state["cache"][name], buf,
+                    (jnp.int32(0), slot32) + (jnp.int32(0),) *
+                    (buf.ndim - 2))
+            return {**buf_state, "cache": cache}
+
+        from ..models.speculative import _scaled_probs
+        dstate = splice(dstate, d_row_cache)
+        temp32 = jnp.float32(temp)
+        greedy = jnp.argmax(last_logits[0]).astype(jnp.int32)
+        probs = _scaled_probs(last_logits[0], temp32)
+        drawn = jax.random.categorical(
+            key, jnp.log(probs + 1e-30)).astype(jnp.int32)
+        first = jnp.where(temp32 > 0.0, drawn, greedy)
+        done0 = jnp.asarray(False) if eos_id is None else first == eos_id
+        state = splice(state, row_cache)
+        return {
+            **state,
+            "pos": state["pos"].at[slot32].set(
+                jnp.asarray(real_len, jnp.int32)),
+            "active": state["active"].at[slot32].set(True),
+            "done": state["done"].at[slot32].set(done0),
+            "n_out": state["n_out"].at[slot32].set(1),
+            "out": state["out"].at[slot32].set(0).at[slot32, 0].set(first),
+            "temp": state["temp"].at[slot32].set(temp32),
+            "target": state["target"].at[slot32].set(
+                jnp.asarray(target, jnp.int32)),
+            "last": state["last"].at[slot32].set(first),
+            "lpos": state["lpos"].at[slot32].set(
+                jnp.asarray(real_len, jnp.int32)),
+        }, dstate, first
+
+    @staticmethod
+    @partial(jax.jit, static_argnames=("config", "draft_config", "k",
+                                       "eos_id", "pad_id"),
+             donate_argnums=(2, 3))
+    def _spec_tick_jit(params, draft_params, state, dstate, key, config,
+                       draft_config, k, eos_id, pad_id):
+        """One speculative engine tick: ONE draft block + ONE verify
+        window for every row (models/speculative.propose_and_verify),
+        each alive row emitting 1..k+1 tokens at its own acceptance rate.
+        The packed host buffer is (slots, k+5) int32 —
+        [n_out, done, emit_len, n_acc, emit_0..emit_k] per row — one
+        readback per tick like the plain engine's."""
+        from ..models.speculative import propose_and_verify
+        n_slots = state["last"].shape[0]
+        alive = state["active"] & ~state["done"] & \
+            (state["n_out"] < state["target"])
+        t_cache, d_cache, drafts, n_acc, tail = propose_and_verify(
+            params, draft_params, state["cache"], dstate["cache"],
+            state["last"], state["lpos"], state["temp"], key,
+            config, draft_config, k)
+
+        j = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+        emit = jnp.where(j < n_acc[:, None],
+                         jnp.pad(drafts, ((0, 0), (0, 1))), tail[:, None])
+        # clamp to the row's remaining budget: a block may complete the
+        # request mid-window; tokens past the target are never emitted
+        emit_len = jnp.where(
+            alive, jnp.minimum(n_acc + 1,
+                               state["target"] - state["n_out"]), 0)
+        if eos_id is not None:
+            is_eos = (emit == eos_id) & (j < emit_len[:, None])
+            any_eos = jnp.any(is_eos, axis=1)
+            # the block ENDS at its first EOS: nothing after it is
+            # written or streamed (the SSE contract says token events
+            # stop at EOS; the collect path pads the result tail)
+            first_eos = jnp.argmax(is_eos, axis=1).astype(jnp.int32)
+            emit_len = jnp.where(any_eos,
+                                 jnp.minimum(emit_len, first_eos + 1),
+                                 emit_len)
+            done = state["done"] | any_eos
+        else:
+            done = state["done"]
+        idx = jnp.where(j < emit_len[:, None],
+                        state["n_out"][:, None] + j,
+                        jnp.int32(state["out"].shape[1] + 1))
+        out = state["out"].at[jnp.arange(n_slots)[:, None], idx].set(
+            emit, mode="drop")
+        n_out = state["n_out"] + emit_len
+        moved = emit_len > 0
+        last = jnp.where(moved,
+                         jnp.take_along_axis(
+                             emit, jnp.maximum(emit_len - 1, 0)[:, None],
+                             axis=1)[:, 0],
+                         state["last"])
+        lpos = state["lpos"] + emit_len
+        flags = jnp.concatenate([
+            n_out[:, None], done.astype(jnp.int32)[:, None],
+            emit_len[:, None],
+            jnp.where(alive, n_acc, 0)[:, None], emit], axis=1)
+        new_state = {**state, "cache": t_cache, "done": done, "out": out,
+                     "n_out": n_out, "last": last, "lpos": lpos}
+        return new_state, {**dstate, "cache": d_cache}, flags
+
+    @staticmethod
     @partial(jax.jit, static_argnames=("config", "eos_id", "pad_id"))
     def _step_jit(params, state, key, config, eos_id, pad_id):
         """One engine tick: sample a token for every active row from the
@@ -601,9 +768,12 @@ class ContinuousBatchedGenerator:
         return any(s.req is not None and not s.prefilling
                    for s in self._slots)
 
-    def _prefix_key(self, prompt: np.ndarray, upto: int) -> tuple:
+    def _prefix_key(self, prompt: np.ndarray, upto: int,
+                    model: str = "t") -> tuple:
+        # keyed per model: the speculative draft's chunk rows live in the
+        # same LRU under a "d" tag (its K/V differ from the target's)
         import hashlib
-        return (upto, hashlib.sha1(prompt[:upto].tobytes()).digest())
+        return (model, upto, hashlib.sha1(prompt[:upto].tobytes()).digest())
 
     def _cacheable_chunks(self, real_len: int) -> int:
         """How many leading chunks of a prompt are prefix-cacheable:
@@ -626,18 +796,27 @@ class ContinuousBatchedGenerator:
         adm = _Admission(
             req=req, padded=padded, real_len=real_len,
             row_cache=init_kv_cache(self.config, 1, kv_quant=self.kv_quant))
-        # longest run of consecutive leading chunks already in the cache
-        if self.prefix_cache_chunks:
-            for c in range(self._cacheable_chunks(real_len)):
-                key = self._prefix_key(req.prompt, (c + 1) * C)
-                delta = self._prefix_cache.get(key)
-                if delta is None:
-                    break
-                self._prefix_cache.move_to_end(key)      # LRU refresh
-                adm.row_cache = self._insert_chunk_jit(
-                    adm.row_cache, delta, jnp.int32(c * C))
-                adm.consumed += C
-                self.prefix_cache_hits_total += 1
+        if self.draft is not None:
+            adm.d_row_cache = init_kv_cache(self.draft[1], 1)
+
+        def take_hits(row_cache, model: str) -> tuple:
+            consumed = 0
+            if self.prefix_cache_chunks:
+                for c in range(self._cacheable_chunks(real_len)):
+                    key = self._prefix_key(req.prompt, (c + 1) * C, model)
+                    delta = self._prefix_cache.get(key)
+                    if delta is None:
+                        break
+                    self._prefix_cache.move_to_end(key)  # LRU refresh
+                    row_cache = self._insert_chunk_jit(
+                        row_cache, delta, jnp.int32(c * C))
+                    consumed += C
+                    self.prefix_cache_hits_total += 1
+            return row_cache, consumed
+        adm.row_cache, adm.consumed = take_hits(adm.row_cache, "t")
+        if adm.d_row_cache is not None:
+            adm.d_row_cache, adm.d_consumed = take_hits(adm.d_row_cache,
+                                                        "d")
         self._admitting[slot] = adm
         self._slots[slot] = _Slot(req=req, target=req.max_new_tokens,
                                   prefilling=True)
@@ -657,23 +836,24 @@ class ContinuousBatchedGenerator:
                     req.future.set_exception(CancelledError())
                 self.cancelled_total += 1
                 continue
-            try:
-                chunk = jnp.asarray(adm.padded[:, adm.consumed:
-                                               adm.consumed + C])
+            width = adm.padded.shape[1]
+
+            def consume(model_params, row_cache, config, start, model):
+                """One chunk through one model, prefix-cached."""
+                chunk = jnp.asarray(adm.padded[:, start:start + C])
                 last_idx = jnp.asarray(
-                    min(adm.real_len - 1 - adm.consumed, C - 1), jnp.int32)
-                start = adm.consumed
-                adm.row_cache, adm.last_logits = self._chunk_jit(
-                    self.params, adm.row_cache, chunk,
-                    jnp.int32(start), last_idx, self.config)
-                adm.consumed += C
+                    min(adm.real_len - 1 - start, C - 1), jnp.int32)
+                row_cache, logits = self._chunk_jit(
+                    model_params, row_cache, chunk, jnp.int32(start),
+                    last_idx, config)
                 self.prefill_chunks_total += 1
                 if self.prefix_cache_chunks and \
                         start // C < self._cacheable_chunks(adm.real_len):
                     try:
-                        key = self._prefix_key(req.prompt, start + C)
+                        key = self._prefix_key(req.prompt, start + C,
+                                               model)
                         self._prefix_cache[key] = self._extract_chunk_jit(
-                            adm.row_cache, jnp.int32(start), chunk=C)
+                            row_cache, jnp.int32(start), chunk=C)
                         self._prefix_cache.move_to_end(key)
                         while len(self._prefix_cache) > \
                                 self.prefix_cache_chunks:
@@ -683,7 +863,22 @@ class ContinuousBatchedGenerator:
                         # pressure allocating the entry) must not fail a
                         # request whose prefill already succeeded
                         pass
-                if adm.consumed < adm.padded.shape[1]:
+                return row_cache, logits
+
+            try:
+                if adm.consumed < width:
+                    adm.row_cache, adm.last_logits = consume(
+                        self.params, adm.row_cache, self.config,
+                        adm.consumed, "t")
+                    adm.consumed += C
+                if adm.d_row_cache is not None and adm.d_consumed < width:
+                    adm.d_row_cache, _ = consume(
+                        self.draft[0], adm.d_row_cache, self.draft[1],
+                        adm.d_consumed, "d")
+                    adm.d_consumed += C
+                if adm.consumed < width or (
+                        adm.d_row_cache is not None
+                        and adm.d_consumed < width):
                     continue
             except BaseException as exc:  # noqa: BLE001 — fail THIS
                 # request; other admissions and the running batch continue
@@ -694,10 +889,26 @@ class ContinuousBatchedGenerator:
                     req.future.set_exception(exc)
                 continue
             try:
-                self._state = self._splice_jit(
-                    self._state, adm.row_cache, adm.last_logits,
-                    slot, adm.real_len, jnp.float32(req.temperature),
-                    jnp.int32(req.top_k), jnp.float32(req.top_p))
+                if self.draft is None:
+                    self._state = self._splice_jit(
+                        self._state, adm.row_cache, adm.last_logits,
+                        slot, adm.real_len, jnp.float32(req.temperature),
+                        jnp.int32(req.top_k), jnp.float32(req.top_p))
+                else:
+                    self._key, sub = jax.random.split(self._key)
+                    self._state, self._dstate, first = \
+                        self._spec_splice_jit(
+                            self._state, self._dstate, adm.row_cache,
+                            adm.d_row_cache, adm.last_logits, slot,
+                            adm.real_len, req.max_new_tokens,
+                            jnp.float32(req.temperature), sub,
+                            self.eos_id, self.pad_id)
+                    # the first token is an EMITTED token: stream it
+                    if req.on_token is not None:
+                        try:
+                            req.on_token(int(first))
+                        except Exception:  # noqa: BLE001
+                            req.on_token = None
             except BaseException as exc:  # noqa: BLE001 — the splice
                 # DONATES the engine state. A trace/compile-time failure
                 # happens before donation (buffers intact → contain to
@@ -706,18 +917,14 @@ class ContinuousBatchedGenerator:
                 # in-flight request and re-arming from a fresh state.
                 state_intact = not any(
                     getattr(leaf, "is_deleted", lambda: False)()
-                    for leaf in jax.tree.leaves(self._state))
+                    for leaf in jax.tree.leaves((self._state,
+                                                 self._dstate)))
                 del self._admitting[slot]
                 self._slots[slot] = _Slot()
                 if not req.future.done():
                     req.future.set_exception(exc)
                 if not state_intact:
-                    for i, s in enumerate(self._slots):
-                        if s.req is not None and not s.req.future.done():
-                            s.req.future.set_exception(exc)
-                        self._slots[i] = _Slot()
-                    self._admitting.clear()
-                    self._state = self._fresh_state()
+                    self._fail_all_and_rearm(exc)
                     return
                 continue
             del self._admitting[slot]
@@ -726,6 +933,21 @@ class ContinuousBatchedGenerator:
             if sum(s.req is not None and not s.prefilling
                    for s in self._slots) > 1:
                 self.admitted_while_running += 1
+
+    def _fail_all_and_rearm(self, exc: BaseException) -> None:
+        """Donation invalidated the engine buffers: fail every in-flight
+        request honestly and rebuild both models' states from zero (the
+        engine keeps serving)."""
+        for i, s in enumerate(self._slots):
+            if s.req is not None and not s.req.future.done():
+                s.req.future.set_exception(exc)
+            self._slots[i] = _Slot()
+        self._admitting.clear()
+        self._state = self._fresh_state()
+        if self.draft is not None:
+            from ..models.decode import init_kv_cache
+            self._dstate = {"cache": init_kv_cache(self.draft[1],
+                                                   self.n_slots)}
 
     def _emit_tokens(self, ids: np.ndarray) -> None:
         """Deliver this step's sampled ids (already on host via the packed
@@ -740,6 +962,22 @@ class ContinuousBatchedGenerator:
                     slot.req.on_token(int(ids[i]))
                 except Exception:  # noqa: BLE001
                     slot.req.on_token = None
+
+    def _emit_spec_tokens(self, host: np.ndarray) -> None:
+        """Spec-tick streaming: each row emitted 0..k+1 tokens this tick
+        — deliver the burst in order (the flags layout carries the emit
+        block inline, so no extra readback)."""
+        k1 = self.spec_k + 1
+        for i, slot in enumerate(self._slots):
+            if slot.req is None or slot.prefilling \
+                    or slot.req.on_token is None:
+                continue
+            for t in host[i, 4:4 + min(int(host[i, 2]), k1)]:
+                try:
+                    slot.req.on_token(int(t))
+                except Exception:  # noqa: BLE001
+                    slot.req.on_token = None
+                    break
 
     def _collect_finished(self, n_out: np.ndarray,
                           done: np.ndarray) -> None:
@@ -810,24 +1048,51 @@ class ContinuousBatchedGenerator:
                 continue
             try:
                 self._key, sub = jax.random.split(self._key)
-                self._state, flags = self._step_jit(
-                    self.params, self._state, sub, self.config, self.eos_id,
-                    self.pad_id)
-                self.steps_total += 1
-                # ONE host sync per tick: the packed (3, slots) buffer
-                host = np.asarray(flags)
-                # stream BEFORE collection so every token is delivered
-                # before the request's future resolves
-                self._emit_tokens(host[2])
-                self._collect_finished(host[0], host[1] != 0)
-            except BaseException as exc:  # noqa: BLE001 — fail the batch
-                for i, slot in enumerate(self._slots):
-                    if slot.req is not None and not slot.req.future.done():
-                        slot.req.future.set_exception(exc)
-                    self._slots[i] = _Slot()
-                self._admitting.clear()   # their futures just failed above
-                self._state = {**self._state,
-                               "active": jnp.zeros((self.n_slots,), bool)}
+                if self.draft is None:
+                    self._state, flags = self._step_jit(
+                        self.params, self._state, sub, self.config,
+                        self.eos_id, self.pad_id)
+                    self.steps_total += 1
+                    # ONE host sync per tick: the packed (3, slots) buffer
+                    host = np.asarray(flags)
+                    # stream BEFORE collection so every token is delivered
+                    # before the request's future resolves
+                    self._emit_tokens(host[2])
+                    self._collect_finished(host[0], host[1] != 0)
+                else:
+                    self._state, self._dstate, flags = self._spec_tick_jit(
+                        self.params, self.draft[0], self._state,
+                        self._dstate, sub, self.config, self.draft[1],
+                        self.spec_k, self.eos_id, self.pad_id)
+                    self.steps_total += 1
+                    self.spec_ticks += 1
+                    # ONE host sync: (slots, k+5) —
+                    # [n_out, done, emit_len, n_acc, emit_0..emit_k]
+                    host = np.asarray(flags)
+                    moved = host[:, 2] > 0
+                    self.spec_drafted += int(moved.sum()) * self.spec_k
+                    self.spec_accepted += int(host[moved, 3].sum())
+                    self._emit_spec_tokens(host)
+                    self._collect_finished(host[:, 0], host[:, 1] != 0)
+            except BaseException as exc:  # noqa: BLE001 — fail the batch.
+                # The spec tick donates the states: rebuild when the
+                # buffers were actually invalidated.
+                intact = not any(
+                    getattr(leaf, "is_deleted", lambda: False)()
+                    for leaf in jax.tree.leaves((self._state,
+                                                 self._dstate)))
+                if not intact:
+                    self._fail_all_and_rearm(exc)
+                else:
+                    for i, slot in enumerate(self._slots):
+                        if slot.req is not None and \
+                                not slot.req.future.done():
+                            slot.req.future.set_exception(exc)
+                        self._slots[i] = _Slot()
+                    self._admitting.clear()
+                    self._state = {**self._state,
+                                   "active": jnp.zeros((self.n_slots,),
+                                                       bool)}
 
     def _shutdown(self) -> None:
         stragglers = [s.req for s in self._slots if s.req is not None]

@@ -406,3 +406,81 @@ def test_cancel_mid_admission_frees_the_slot():
         assert gen.generate_sync(np.arange(4, dtype=np.int32),
                                  3).shape == (3,)
         assert gen.cancelled_total == 1
+
+
+# ------------------------------------------- continuous speculation
+def test_spec_continuous_greedy_matches_plain_and_generate():
+    """Continuous speculation: greedy outputs are byte-identical to the
+    plain continuous engine and to generate(); a self-draft accepts
+    everything, so ticks emit full blocks (far fewer ticks than
+    tokens)."""
+    params, cfg = model()
+    ps = prompts(3)
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                    prefill_chunk=8) as plain:
+        want = [np.asarray(plain.generate_sync(p, 8)) for p in ps]
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                    prefill_chunk=8, draft_params=params,
+                                    draft_config=cfg, spec_k=3) as gen:
+        got = [np.asarray(gen.generate_sync(p, 8)) for p in ps]
+        assert gen.spec_accepted == gen.spec_drafted > 0
+        # full acceptance advances k+1 per tick per row
+        assert gen.spec_ticks < 3 * 8
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_spec_continuous_perturbed_draft_exact_and_concurrent():
+    """A good-but-imperfect draft: partial acceptance, still exact greedy
+    parity, and rows admitted mid-flight ride the same spec ticks."""
+    import jax as _jax
+    params, cfg = model()
+    noisy = _jax.tree.map(
+        lambda p: p + 0.02 * _jax.random.normal(
+            _jax.random.key(hash(p.shape) % 997), p.shape, p.dtype),
+        params)
+    ps = prompts(4)
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                    prefill_chunk=8) as plain:
+        want = [np.asarray(plain.generate_sync(p, 10)) for p in ps]
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                    prefill_chunk=8, draft_params=noisy,
+                                    draft_config=cfg, spec_k=3) as gen:
+        futs = [gen.submit(p, 10) for p in ps]   # 4 reqs, 2 slots
+        got = [np.asarray(f.result(timeout=300)) for f in futs]
+        assert gen.admitted_while_running >= 1
+        assert 0 < gen.spec_accepted < gen.spec_drafted
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_spec_continuous_streaming_bursts_in_order():
+    params, cfg = model()
+    seen = []
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                    prefill_chunk=8, draft_params=params,
+                                    draft_config=cfg, spec_k=3) as gen:
+        ids = gen.submit(prompts(1)[0], 9,
+                         on_token=seen.append).result(timeout=300)
+    assert seen == [int(t) for t in ids]
+
+
+def test_spec_continuous_eos_and_submit_validation():
+    params, cfg = model()
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                    prefill_chunk=8) as plain:
+        ref = np.asarray(plain.generate_sync(prompts(1)[0], 10))
+    eos = int(ref[3])
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                    prefill_chunk=8, draft_params=params,
+                                    draft_config=cfg, spec_k=3,
+                                    eos_id=eos) as gen:
+        out = np.asarray(gen.generate_sync(prompts(1)[0], 10))
+        with pytest.raises(ValueError, match="top-k"):
+            gen.submit(prompts(1)[0], 4, top_k=5)
+        with pytest.raises(ValueError, match="spec_k"):
+            gen.submit(prompts(1)[0], 24)   # 6 + 24 + 3 > 32, 6+24 fits
+    # after the first eos, pads — same contract as generate
+    first = list(out).index(eos)
+    assert set(out[first + 1:]) <= {0}
+    np.testing.assert_array_equal(out[:first + 1], ref[:first + 1])

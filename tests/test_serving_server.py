@@ -350,7 +350,7 @@ def test_speculative_bucketed_server_end_to_end():
     assert health["spec_accepted"] == health["spec_drafted"] > 0
 
 
-def test_draft_requires_continuous_rejection_and_pairing():
+def test_draft_pairing_validation_and_continuous_support():
     from kubeflow_tpu.runtime.server import build_generator
     params, cfg = model()
 
@@ -361,13 +361,20 @@ def test_draft_requires_continuous_rejection_and_pairing():
         kv_quant = False
         eos_id = -1
         spec_k = 2
-    with pytest.raises(SystemExit, match="bucketed"):
-        build_generator(params, cfg, Args(), draft=(params, cfg))
+    # the continuous engine runs speculation natively (per-tick blocks)
+    gen = build_generator(params, cfg, Args(), draft=(params, cfg))
+    try:
+        assert isinstance(gen, ContinuousBatchedGenerator)
+        assert gen.draft is not None and gen.spec_k == 2
+    finally:
+        gen.close()
     with pytest.raises(ValueError, match="together"):
         BatchedGenerator(params, cfg, draft_params=params)
     with pytest.raises(ValueError, match="spec_k"):
         BatchedGenerator(params, cfg, draft_params=params,
                          draft_config=cfg, spec_k=0)
+    with pytest.raises(ValueError, match="together"):
+        ContinuousBatchedGenerator(params, cfg, draft_params=params)
 
 
 def test_metrics_endpoint_prometheus_format():
