@@ -168,7 +168,7 @@ def propose_and_verify(params: dict, draft_params: dict, t_cache: dict,
 
 @partial(jax.jit,
          static_argnames=("config", "draft_config", "max_new_tokens",
-                          "k", "eos_id", "pad_id"))
+                          "k", "eos_id", "pad_id", "kv_quant"))
 def speculative_generate(params: dict, draft_params: dict,
                          prompt: jax.Array, config: TransformerConfig,
                          draft_config: TransformerConfig,
@@ -176,7 +176,9 @@ def speculative_generate(params: dict, draft_params: dict,
                          temperature: float = 0.0,
                          key: jax.Array | None = None,
                          eos_id: int | None = None,
-                         pad_id: int = 0) -> tuple[jax.Array, SpecStats]:
+                         pad_id: int = 0,
+                         kv_quant: bool = False) \
+        -> tuple[jax.Array, SpecStats]:
     """Speculative decode: (batch, max_new_tokens) ids + SpecStats.
 
     ``temperature`` is traced — a scalar or per-row (batch,) vector, 0 for
@@ -201,7 +203,11 @@ def speculative_generate(params: dict, draft_params: dict,
     temp = jnp.broadcast_to(jnp.asarray(temperature, jnp.float32), (B,))
     sampled = temp > 0.0                                     # (B,)
 
-    t_logits, t_cache = prefill(params, prompt, tc)
+    # kv_quant: int8 TARGET cache with per-position scales — the verify
+    # window quantizes its writes exactly like decode_step does, so the
+    # stored cache equals generate(kv_quant=True)'s and greedy parity
+    # holds bit-for-bit; the draft stays full-precision (it is small)
+    t_logits, t_cache = prefill(params, prompt, tc, kv_quant=kv_quant)
     _, d_cache = prefill(draft_params, prompt, dc)
 
     # the first generated token comes straight from the target's prefill

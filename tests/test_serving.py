@@ -484,3 +484,19 @@ def test_spec_continuous_eos_and_submit_validation():
     first = list(out).index(eos)
     assert set(out[first + 1:]) <= {0}
     np.testing.assert_array_equal(out[:first + 1], ref[:first + 1])
+
+
+def test_spec_continuous_with_int8_kv_cache():
+    """Continuous speculation over an int8 KV target cache: the engine's
+    quantized cache flows through the verify window unchanged, output
+    still equal to generate(kv_quant=True)."""
+    params, cfg = model()
+    p = prompts(1)[0]
+    want = np.asarray(generate(params, np.asarray(p)[None], cfg, 8,
+                               kv_quant=True))[0]
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                    prefill_chunk=8, kv_quant=True,
+                                    draft_params=params, draft_config=cfg,
+                                    spec_k=3) as gen:
+        got = np.asarray(gen.generate_sync(p, 8))
+    np.testing.assert_array_equal(got, want)

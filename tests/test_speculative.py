@@ -268,3 +268,16 @@ def test_int8_quantized_target_speculative_parity(target, draft):
     got, _ = speculative_generate(qparams, dparams, prompt, cfg, dcfg,
                                   16, k=3)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_int8_kv_cache_speculative_parity(target, draft):
+    """The long-KV bandwidth lever composes: an int8 KV target cache
+    (window writes quantize exactly like decode_step's) keeps greedy
+    speculative output identical to generate(kv_quant=True)."""
+    params, cfg = target
+    dparams, dcfg = draft
+    prompt = _prompt()
+    want = generate(params, prompt, cfg, 16, kv_quant=True)
+    got, _ = speculative_generate(params, dparams, prompt, cfg, dcfg,
+                                  16, k=3, kv_quant=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
