@@ -184,7 +184,11 @@ def speculative_generate(params: dict, draft_params: dict,
     ``temperature`` is traced — a scalar or per-row (batch,) vector, 0 for
     greedy rows (exact ``generate`` greedy parity) and >0 for sampled rows
     (exact target-sampling distribution via accept/reject); mixed batches
-    share one executable. EOS semantics match ``generate`` (positions
+    share one executable. ``kv_quant``: int8 TARGET cache with
+    per-position scales — bit-identical to ``generate(kv_quant=True)``
+    (the verify window quantizes its writes exactly like decode_step);
+    the draft cache stays full precision (it is small; its bandwidth is
+    not the bottleneck). EOS semantics match ``generate`` (positions
     after a row's first EOS hold ``pad_id``). Requires
     ``prompt_len + max_new_tokens + k <= max_seq_len`` on BOTH configs
     (the verify window may overhang the last emitted position by up to

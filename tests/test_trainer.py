@@ -351,3 +351,20 @@ def test_tokenize_corpus_to_training_pipeline(tmp_path):
     tokens, targets = batches[0]
     assert tokens.shape == (2, 8)
     assert (targets == -1).sum() > 0        # boundary masking engaged
+
+
+def test_evaluate_on_sequence_parallel_mesh():
+    """evaluate() on an sp>1 mesh (ring attention over the sequence axis)
+    matches the flat mesh — the shared loss dispatch serves every layout
+    the train step does."""
+    cfg = TransformerConfig(vocab_size=128, d_model=32, n_layers=1,
+                            n_heads=4, n_kv_heads=2, d_ff=64,
+                            max_seq_len=32, dtype="float32")
+    heldout = list(synthetic_lm_batches(8, 16, 128, seed=8, n_batches=2))
+    mesh_sp = build_mesh(MeshConfig.auto(8, sp=2, tp=2))
+    mesh_flat = build_mesh(MeshConfig.auto(8, tp=2, fsdp=2))
+    with Trainer(mesh_sp, cfg, seed=13) as tr_sp, \
+            Trainer(mesh_flat, cfg, seed=13) as tr_flat:
+        r_sp = tr_sp.evaluate(heldout)
+        r_flat = tr_flat.evaluate(heldout)
+    assert np.isclose(r_sp["loss"], r_flat["loss"], rtol=1e-4)
