@@ -500,3 +500,28 @@ def test_spec_continuous_with_int8_kv_cache():
                                     spec_k=3) as gen:
         got = np.asarray(gen.generate_sync(p, 8))
     np.testing.assert_array_equal(got, want)
+
+
+def test_spec_continuous_moe_target():
+    """Continuous speculation with a sparse MoE target and a dense draft:
+    the engine's verify window routes (slots, k+1) blocks; outputs equal
+    the plain engine's greedy stream (capacity non-binding)."""
+    from kubeflow_tpu.models.moe import MoEConfig, init_moe_params
+    mcfg = MoEConfig(vocab_size=96, d_model=32, n_layers=1, n_heads=4,
+                     n_kv_heads=4, d_ff=48, dtype="float32",
+                     max_seq_len=32, n_experts=2, experts_per_token=2,
+                     capacity_factor=8.0)
+    mparams = init_moe_params(jax.random.key(0), mcfg)
+    dcfg = TransformerConfig(vocab_size=96, d_model=32, n_layers=1,
+                             n_heads=4, n_kv_heads=4, d_ff=48,
+                             dtype="float32", max_seq_len=32)
+    dparams = init_params(jax.random.key(5), dcfg)
+    p = prompts(1)[0]
+    with ContinuousBatchedGenerator(mparams, mcfg, n_slots=2,
+                                    prefill_chunk=8) as plain:
+        want = np.asarray(plain.generate_sync(p, 8))
+    with ContinuousBatchedGenerator(mparams, mcfg, n_slots=2,
+                                    prefill_chunk=8, draft_params=dparams,
+                                    draft_config=dcfg, spec_k=3) as gen:
+        got = np.asarray(gen.generate_sync(p, 8))
+    np.testing.assert_array_equal(got, want)
