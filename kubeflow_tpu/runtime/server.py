@@ -7,7 +7,8 @@ stdlib-only JSON-over-HTTP server in the shape such endpoints take:
     POST /v1/generate   {"prompt": [ids...] | "text": "...",
                          "max_new_tokens": N,
                          "temperature": t, "top_k": k, "top_p": p}
-                      → {"ids": [ids...], "text": "..." (text mode)}
+                      → {"ids": [ids...], "usage": {prompt_tokens,
+                         completion_tokens}, "text": "..." (text mode)}
                         with "stream": true → text/event-stream, one
                         data: {"token": id, "text": delta?} event per
                         token as generated, then
@@ -337,6 +338,17 @@ class ServingServer:
             ids = ids[:ids.index(eos)]
         return ids
 
+    def _usage(self, prompt, ids) -> dict:
+        """Accounting for the response: completion_tokens counts every
+        GENERATED token including a terminating EOS (matching the stream's
+        n_tokens), not the pad filler after it."""
+        ids = [int(t) for t in ids]
+        eos = getattr(self.generator, "eos_id", None)
+        n = ids.index(eos) + 1 if eos is not None and eos in ids \
+            else len(ids)
+        return {"prompt_tokens": int(prompt.shape[0]),
+                "completion_tokens": n}
+
     def generate(self, req: dict) -> dict:
         prompt, max_new, temp, top_k, top_p, was_text = self._validate(req)
         future = self.generator.submit(prompt, max_new, temp, top_k=top_k,
@@ -348,7 +360,8 @@ class ServingServer:
             # slot decoding for a response nobody will read
             self._cancel(future)
             raise
-        out = {"ids": [int(t) for t in ids]}
+        out = {"ids": [int(t) for t in ids],
+               "usage": self._usage(prompt, ids)}
         if was_text:
             out["text"] = self.tokenizer.decode(self._live_ids(ids))
         return out
@@ -363,7 +376,8 @@ class ServingServer:
         Wire format: ``Content-Type: text/event-stream``, one
         ``data: {"token": id}`` event per token actually SAMPLED — when
         the engine stops at an EOS id, the token events end there — then a
-        final ``data: {"done": true, "n_tokens": n, "ids": [...]}`` event
+        final ``data: {"done": true, "n_tokens": n, "ids": [...],
+        "usage": {...}}`` event
         whose ``ids`` is the engine's result exactly as the non-streaming
         response would return it (padded to max_new_tokens after an early
         EOS) and ``n_tokens`` counts the token events that preceded it.
@@ -450,7 +464,8 @@ class ServingServer:
                 held = detok.flush()
                 if held and not event({"text": held}):
                     return   # token-less flush event: mid-character tail
-            done = {"done": True, "n_tokens": n_tokens, "ids": ids}
+            done = {"done": True, "n_tokens": n_tokens, "ids": ids,
+                    "usage": self._usage(prompt, ids)}
             if was_text:
                 done["text"] = self.tokenizer.decode(self._live_ids(ids))
             event(done)

@@ -614,3 +614,28 @@ def test_lora_merge_at_startup(tmp_path):
     np.testing.assert_array_equal(
         out["ids"],
         np.asarray(generate(merged, np.arange(6)[None], cfg, 6))[0])
+
+
+def test_usage_accounting_in_responses():
+    """Responses carry usage {prompt_tokens, completion_tokens} — the
+    standard serving-API accounting field; completion counts live tokens
+    (EOS pads excluded via the same rule as text decoding)."""
+    params, cfg = model()
+    gen = ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                     prefill_chunk=8)
+    with ServingServer(gen, cfg, port=0) as srv:
+        _, out = _post(srv.url, {"prompt": list(range(7)),
+                                 "max_new_tokens": 5})
+    assert out["usage"] == {"prompt_tokens": 7, "completion_tokens": 5}
+    # with EOS: completion counts the terminating EOS, not the pad tail.
+    # Pick an emitted id whose FIRST occurrence is past position 0 so the
+    # stream demonstrably truncates mid-way.
+    ids = out["ids"]
+    eos = next(t for i, t in enumerate(ids) if t not in ids[:i] and i > 0)
+    cut = ids.index(eos)
+    gen2 = ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                      prefill_chunk=8, eos_id=eos)
+    with ServingServer(gen2, cfg, port=0) as srv:
+        _, out2 = _post(srv.url, {"prompt": list(range(7)),
+                                  "max_new_tokens": 5})
+    assert out2["usage"]["completion_tokens"] == cut + 1  # incl. the EOS
