@@ -15,6 +15,11 @@ stdlib-only JSON-over-HTTP server in the shape such endpoints take:
                         data: {"done": true, "ids": [...], "text"?}
                         ("text" requires --tokenizer; stream deltas use
                         incremental detokenization)
+    POST /v1/completions  OpenAI-compatible completions (requires
+                        --tokenizer): {"prompt": str|[ids], "max_tokens",
+                        "temperature", "top_p", "stream"} → the standard
+                        text_completion object / SSE chunk stream ending
+                        in data: [DONE]
     GET  /metrics       Prometheus text exposition (engine counters +
                         HTTP request/latency series)
     GET  /healthz       liveness + engine stats (what the culler's
@@ -159,7 +164,8 @@ class ServingServer:
         server = self
 
         KNOWN_ROUTES = frozenset(
-            {"/healthz", "/v1/models", "/metrics", "/v1/generate"})
+            {"/healthz", "/v1/models", "/metrics", "/v1/generate",
+             "/v1/completions"})
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):
@@ -203,7 +209,7 @@ class ServingServer:
                     self._json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
-                if self.path != "/v1/generate":
+                if self.path not in ("/v1/generate", "/v1/completions"):
                     self._json(404, {"error": f"no route {self.path}"})
                     return
                 try:
@@ -214,6 +220,9 @@ class ServingServer:
                         self._json(413, {"error": "invalid request size"})
                         return
                     req = json.loads(self.rfile.read(length))
+                    openai = self.path == "/v1/completions"
+                    if openai:
+                        req = server.translate_completions(req)
                     stream = req.get("stream", False)
                     if not isinstance(stream, bool):
                         # '"stream": "false"' is a client bug; guessing a
@@ -221,7 +230,7 @@ class ServingServer:
                         raise ValueError("'stream' must be a boolean")
                     if stream:
                         t0 = time.monotonic()
-                        server.stream_generate(req, self)
+                        server.stream_generate(req, self, openai=openai)
                         server._m_lat_sum.inc(by=time.monotonic() - t0)
                         server._m_lat_count.inc()
                         self._count(200)
@@ -230,6 +239,8 @@ class ServingServer:
                     out = server.generate(req)
                     server._m_lat_sum.inc(by=time.monotonic() - t0)
                     server._m_lat_count.inc()
+                    if openai:
+                        out = server.to_completions_response(out)
                     self._json(200, out)
                 except (ValueError, KeyError, TypeError) as e:
                     self._json(400, {"error": str(e)})
@@ -338,6 +349,69 @@ class ServingServer:
             ids = ids[:ids.index(eos)]
         return ids
 
+    MODEL_NAME = "kubeflow-tpu"
+
+    def translate_completions(self, req: dict) -> dict:
+        """OpenAI `/v1/completions` body → the internal request shape.
+        The de-facto standard surface: a client switching from any
+        OpenAI-compatible server points its base_url here. Requires a
+        tokenizer (the response format is text). Unsupported knobs fail
+        loudly rather than silently changing semantics."""
+        if self.tokenizer is None:
+            raise ValueError("/v1/completions requires the server to "
+                             "run with --tokenizer (responses are text)")
+        if req.get("n", 1) != 1 or req.get("best_of", 1) != 1:
+            raise ValueError("'n'/'best_of' > 1 not supported")
+        for knob in ("logprobs", "echo", "stop", "suffix", "logit_bias",
+                     "frequency_penalty", "presence_penalty", "seed"):
+            # anything that would CHANGE sampling semantics if ignored
+            # fails loudly (0/None/empty are the no-op values)
+            if req.get(knob):
+                raise ValueError(f"'{knob}' is not supported")
+        prompt = req.get("prompt")
+        out = {"max_new_tokens": req.get("max_tokens", 16),
+               # OpenAI defaults temperature to 1.0 (ours is greedy 0.0)
+               "temperature": float(req.get("temperature", 1.0)),
+               "top_p": float(req.get("top_p", 1.0)),
+               "stream": req.get("stream", False)}
+        if isinstance(prompt, str) and prompt:
+            out["text"] = prompt
+        elif isinstance(prompt, list):
+            out["prompt"] = prompt
+        else:
+            raise ValueError("'prompt' must be a non-empty string or a "
+                             "token id list")
+        return out
+
+    def _completions_envelope(self) -> dict:
+        import uuid
+        return {"id": "cmpl-" + uuid.uuid4().hex[:24],
+                "object": "text_completion",
+                "created": int(time.time()), "model": self.MODEL_NAME}
+
+    def _finish_and_usage(self, usage: dict, ids: list) -> tuple:
+        """(finish_reason, OpenAI usage) — ONE definition for the
+        streaming and non-streaming completions responses. "stop" means
+        the engine's EOS appeared among the generated ids (including on
+        the very last slot, where a budget-based check would mislabel it
+        "length")."""
+        eos = getattr(self.generator, "eos_id", None)
+        finish = "stop" if eos is not None and eos in ids else "length"
+        return finish, {**usage,
+                        "total_tokens": usage["prompt_tokens"]
+                        + usage["completion_tokens"]}
+
+    def to_completions_response(self, out: dict) -> dict:
+        """Internal generate() result → OpenAI text_completion shape."""
+        finish, usage = self._finish_and_usage(out["usage"], out["ids"])
+        text = out.get("text")
+        if text is None:
+            text = self.tokenizer.decode(self._live_ids(out["ids"]))
+        return {**self._completions_envelope(),
+                "choices": [{"text": text, "index": 0, "logprobs": None,
+                             "finish_reason": finish}],
+                "usage": usage}
+
     def _usage(self, prompt, ids) -> dict:
         """Accounting for the response: completion_tokens counts every
         GENERATED token including a terminating EOS (matching the stream's
@@ -366,7 +440,8 @@ class ServingServer:
             out["text"] = self.tokenizer.decode(self._live_ids(ids))
         return out
 
-    def stream_generate(self, req: dict, handler) -> None:
+    def stream_generate(self, req: dict, handler,
+                        openai: bool = False) -> None:
         """``"stream": true``: per-token SSE emission. The engine already
         works at token boundaries (ContinuousBatchedGenerator admits and
         samples per step); this hands each sampled id straight to the wire
@@ -394,20 +469,20 @@ class ServingServer:
 
         # text mode: each token event carries the incremental decoded
         # suffix (IncrementalDetokenizer — held back while a multi-byte
-        # character is still split across tokens)
-        detok = IncrementalDetokenizer(self.tokenizer) if was_text else None
+        # character is still split across tokens). The OpenAI route
+        # always streams text (translate_completions guarantees the
+        # tokenizer), even for token-array prompts.
+        detok = IncrementalDetokenizer(self.tokenizer) \
+            if (was_text or openai) else None
         eos = getattr(self.generator, "eos_id", None)
 
         def token_payload(tok: int) -> dict:
             payload = {"token": tok}
-            if detok is None:
-                return payload
-            if eos is not None and tok == eos:
-                # the done event's text excludes the EOS surface form;
-                # its own stream event must agree
-                payload["text"] = ""
-                return payload
-            payload["text"] = detok.feed(tok)
+            if detok is not None:
+                # the EOS token itself contributes no text (the done
+                # event's text excludes its surface form)
+                payload["text"] = "" if (eos is not None and tok == eos) \
+                    else detok.feed(tok)
             return payload
 
         handler.send_response(200)
@@ -429,13 +504,51 @@ class ServingServer:
                 self._cancel(future)
                 return False
 
+        envelope = self._completions_envelope() if openai else None
+
+        def send(payload: dict) -> bool:
+            """Wire emission: internal event shape, or the OpenAI chunk
+            framing (text deltas; finish_reason on the final chunk; the
+            literal [DONE] sentinel) on /v1/completions."""
+            if not openai:
+                return event(payload)
+            if "error" in payload:
+                # OpenAI-SDK-parseable error frame, then the sentinel so
+                # stream consumers terminate cleanly
+                ok = event({"error": {"message": str(payload["error"]),
+                                      "type": "server_error"}})
+                if ok:
+                    try:
+                        handler.wfile.write(b"data: [DONE]\n\n")
+                        handler.wfile.flush()
+                    except OSError:
+                        return False
+                return ok
+            if payload.get("done"):
+                finish, usage = self._finish_and_usage(payload["usage"],
+                                                       payload["ids"])
+                ok = event({**envelope, "choices": [
+                    {"text": "", "index": 0, "logprobs": None,
+                     "finish_reason": finish}],
+                    "usage": usage})
+                if ok:
+                    try:
+                        handler.wfile.write(b"data: [DONE]\n\n")
+                        handler.wfile.flush()
+                    except OSError:
+                        return False
+                return ok
+            return event({**envelope, "choices": [
+                {"text": payload.get("text", ""), "index": 0,
+                 "logprobs": None, "finish_reason": None}]})
+
         t_end = time.monotonic() + self.request_timeout_s
         n_tokens = 0
         while True:
             try:
                 tok = q.get(timeout=min(0.25, max(0.0, t_end -
                                                   time.monotonic())))
-                if not event(token_payload(tok)):
+                if not send(token_payload(tok)):
                     return
                 n_tokens += 1
                 continue
@@ -448,7 +561,7 @@ class ServingServer:
                         tok = q.get_nowait()
                     except queue.Empty:
                         break
-                    if not event(token_payload(tok)):
+                    if not send(token_payload(tok)):
                         return
                     n_tokens += 1
                 break
@@ -456,21 +569,21 @@ class ServingServer:
                 # free the slot: nobody will read the rest of this
                 # generation (same cooperative cancel as a disconnect)
                 self._cancel(future)
-                event({"error": "generation timed out"})
+                send({"error": "generation timed out"})
                 return
         try:
             ids = [int(t) for t in future.result(timeout=0)]
             if detok is not None:
                 held = detok.flush()
-                if held and not event({"text": held}):
+                if held and not send({"text": held}):
                     return   # token-less flush event: mid-character tail
             done = {"done": True, "n_tokens": n_tokens, "ids": ids,
                     "usage": self._usage(prompt, ids)}
             if was_text:
                 done["text"] = self.tokenizer.decode(self._live_ids(ids))
-            event(done)
+            send(done)
         except Exception as e:  # noqa: BLE001 — surface as a final event
-            event({"error": f"{type(e).__name__}: {e}"})
+            send({"error": f"{type(e).__name__}: {e}"})
 
     def health(self) -> dict:
         gen = self.generator

@@ -639,3 +639,111 @@ def test_usage_accounting_in_responses():
         _, out2 = _post(srv.url, {"prompt": list(range(7)),
                                   "max_new_tokens": 5})
     assert out2["usage"]["completion_tokens"] == cut + 1  # incl. the EOS
+
+
+def test_openai_completions_route(tmp_path):
+    """/v1/completions: the OpenAI-compatible surface — string or
+    token-array prompts, text_completion response shape with
+    finish_reason/usage, SSE chunk stream ending in [DONE], and loud
+    rejection of unsupported knobs / missing tokenizer."""
+    params, cfg = model()
+    tok = _word_tokenizer(tmp_path)
+    gen = ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                     prefill_chunk=8)
+    with ServingServer(gen, cfg, port=0, tokenizer=tok) as srv:
+        def post(path, payload):
+            req = urllib.request.Request(
+                srv.url + path, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return resp.status, json.loads(resp.read())
+        _, out = post("/v1/completions",
+                      {"prompt": "w1 w2 w3", "max_tokens": 5,
+                       "temperature": 0})
+        assert out["object"] == "text_completion"
+        assert out["id"].startswith("cmpl-")
+        assert out["choices"][0]["finish_reason"] == "length"
+        assert out["usage"] == {"prompt_tokens": 3,
+                                "completion_tokens": 5,
+                                "total_tokens": 8}
+        # parity with the native route's decode
+        _, native = post("/v1/generate", {"text": "w1 w2 w3",
+                                          "max_new_tokens": 5})
+        assert out["choices"][0]["text"] == native["text"]
+        # token-array prompt works too (decoded response)
+        ids = tok.encode("w1 w2 w3", add_special_tokens=False)
+        _, out2 = post("/v1/completions",
+                       {"prompt": list(ids), "max_tokens": 5,
+                        "temperature": 0})
+        assert out2["choices"][0]["text"] == out["choices"][0]["text"]
+        # streaming: chunk deltas concatenate to the full text, then [DONE]
+        req = urllib.request.Request(
+            srv.url + "/v1/completions",
+            data=json.dumps({"prompt": "w4 w5", "max_tokens": 5,
+                             "temperature": 0, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        frames = []
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers["Content-Type"] == "text/event-stream"
+            for raw in resp:
+                raw = raw.strip()
+                if raw.startswith(b"data: "):
+                    frames.append(raw[6:])
+        assert frames[-1] == b"[DONE]"
+        chunks = [json.loads(f) for f in frames[:-1]]
+        assert all(c["object"] == "text_completion" for c in chunks)
+        assert chunks[-1]["choices"][0]["finish_reason"] == "length"
+        assert "usage" in chunks[-1]
+        text = "".join(c["choices"][0]["text"] for c in chunks)
+        _, want = post("/v1/generate", {"text": "w4 w5",
+                                        "max_new_tokens": 5})
+        assert text == want["text"]
+        # unsupported knobs fail loudly
+        try:
+            post("/v1/completions", {"prompt": "w1", "n": 2})
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    # no tokenizer → clear 400
+    gen2 = ContinuousBatchedGenerator(params, cfg, n_slots=2)
+    with ServingServer(gen2, cfg, port=0) as srv:
+        req = urllib.request.Request(
+            srv.url + "/v1/completions",
+            data=json.dumps({"prompt": "w1"}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "tokenizer" in json.loads(e.read())["error"]
+
+
+def test_openai_finish_reason_stop_on_last_slot_eos(tmp_path):
+    """EOS landing exactly on the final generated slot must report
+    finish_reason='stop' (a budget-based check would say 'length' and
+    continue-generation clients would loop)."""
+    params, cfg = model()
+    tok = _word_tokenizer(tmp_path)
+    # learn where an EOS would land, then budget EXACTLY to that slot
+    probe = ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                       prefill_chunk=8)
+    with ServingServer(probe, cfg, port=0, tokenizer=tok) as srv:
+        _, base = _post(srv.url, {"text": "w1 w2 w3",
+                                  "max_new_tokens": 6})
+    ids = base["ids"]
+    eos = next(t for i, t in enumerate(ids) if t not in ids[:i] and i > 0)
+    budget = ids.index(eos) + 1
+    gen = ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                     prefill_chunk=8, eos_id=eos)
+    with ServingServer(gen, cfg, port=0, tokenizer=tok) as srv:
+        req = urllib.request.Request(
+            srv.url + "/v1/completions",
+            data=json.dumps({"prompt": "w1 w2 w3", "max_tokens": budget,
+                             "temperature": 0}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+    assert out["usage"]["completion_tokens"] == budget
+    assert out["choices"][0]["finish_reason"] == "stop"
