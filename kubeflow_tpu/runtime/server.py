@@ -138,6 +138,7 @@ class ServingServer:
         # With one configured, requests may pass "text" instead of
         # "prompt" ids and responses/stream events carry decoded text.
         self.tokenizer = tokenizer
+        self._started_at = int(time.time())
         # Prometheus exposition (GET /metrics): engine counters mirrored at
         # scrape time, plus the HTTP layer's own request/latency series —
         # the serving analog of the controller's metrics endpoint
@@ -596,6 +597,12 @@ class ServingServer:
     def model_info(self) -> dict:
         c = self.config
         return {
+            # OpenAI list-shape alongside the native fields, so SDK
+            # clients pointed at this base_url can enumerate models
+            "object": "list",
+            "data": [{"id": self.MODEL_NAME, "object": "model",
+                      "created": self._started_at,
+                      "owned_by": self.MODEL_NAME}],
             "engine": type(self.generator).__name__,
             "tokenizer": self.tokenizer is not None,
             "model": {

@@ -70,6 +70,12 @@ def test_health_and_model_info(server):
     status, info = _get(srv.url, "/v1/models")
     assert info["model"]["vocab_size"] == cfg.vocab_size
     assert info["model"]["max_seq_len"] == cfg.max_seq_len
+    # OpenAI SDK model enumeration works against the same route
+    assert info["object"] == "list"
+    entry = info["data"][0]
+    assert entry["id"] == "kubeflow-tpu" and entry["object"] == "model"
+    # the OpenAI SDK's Model type REQUIRES these two fields
+    assert isinstance(entry["created"], int) and entry["owned_by"]
 
 
 def test_request_validation_is_400_not_500(server):
