@@ -5,7 +5,7 @@ PYTHON ?= python
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: help test test-fast test-chaos test-transport gate lint manifests \
-        manifests-check check-license bench numerics ctx-sweep mfu-ab \
+        manifests-check check-license bench numerics ctx-sweep mfu-ab capture \
         dryrun loadtest run run-split
 
 help: ## Display this help.
@@ -49,6 +49,9 @@ ctx-sweep: ## remat × CE-chunk × context grid on chip (requires a live TPU).
 
 mfu-ab: ## Per-lever train-step MFU A/B on chip (requires a live TPU).
 	$(PYTHON) ci/tpu_mfu_ab.py
+
+capture: ## Full serial on-chip capture: bench + mfu-ab + ctx-sweep + numerics.
+	bash ci/capture_all.sh
 
 dryrun: ## Multi-chip sharding dryrun on 8 + 16 virtual CPU devices.
 	$(PYTHON) __graft_entry__.py 8

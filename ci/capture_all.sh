@@ -1,0 +1,47 @@
+#!/bin/bash
+# One-command hardware capture: run every on-chip harness SERIALLY (the
+# axon tunnel wedges under concurrent clients — round-4 lesson) and stash
+# logs under _tpu_capture/. Safe to re-run; each stage is independent and
+# a failed stage does not stop the next. Run whenever the tunnel is live:
+#
+#   make capture          # everything, ~30-60 min with cold compiles
+#
+# Stages:
+#   1. bench.py              — all archive metrics + refreshes
+#                              BENCH_TPU_LAST_GOOD.json per metric
+#   2. ci/tpu_mfu_ab.py      — train-step MFU lever grid (VERDICT r3 #3)
+#   3. ci/tpu_ctx_sweep.py   — remat x CE-chunk x context (VERDICT r3 #5)
+#   4. ci/tpu_numerics.py    — kernel numerics incl. flash-decode cases
+set -u
+cd "$(dirname "$0")/.."
+OUT=_tpu_capture
+mkdir -p "$OUT"
+TS=$(date -u +%Y%m%dT%H%M%SZ)
+
+probe() {
+  timeout 90 python -c "import jax; d=jax.devices(); print(jax.default_backend())" 2>/dev/null | tail -1
+}
+
+B=$(probe)
+case "$B" in
+  tpu|axon) echo "capture: tunnel live ($B), starting at $TS" ;;
+  *) echo "capture: tunnel not reachable (probe said '$B'); aborting"; exit 1 ;;
+esac
+
+run() {  # name, command...
+  local name=$1; shift
+  echo "capture: === $name ==="
+  ( "$@" > "$OUT/${name}_$TS.json" ) 2> "$OUT/${name}_$TS.log"
+  local rc=$?
+  echo "capture: $name rc=$rc -> $OUT/${name}_$TS.json"
+}
+
+run bench     python bench.py
+run mfu_ab    python ci/tpu_mfu_ab.py
+run ctx_sweep python ci/tpu_ctx_sweep.py
+run numerics  python ci/tpu_numerics.py
+
+echo "capture: done. Post-process:"
+echo "  - BENCH_TPU_LAST_GOOD.json refreshed automatically by bench.py"
+echo "  - copy numerics json over TPU_NUMERICS.json if numerics_ok"
+echo "  - fold mfu_ab/ctx_sweep numbers into PERF.md"
