@@ -51,7 +51,7 @@ mfu-ab: ## Per-lever train-step MFU A/B on chip (requires a live TPU).
 	$(PYTHON) ci/tpu_mfu_ab.py
 
 capture: ## Full serial on-chip capture: bench + mfu-ab + ctx-sweep + numerics.
-	bash ci/capture_all.sh
+	PYTHON=$(PYTHON) bash ci/capture_all.sh
 
 dryrun: ## Multi-chip sharding dryrun on 8 + 16 virtual CPU devices.
 	$(PYTHON) __graft_entry__.py 8

@@ -14,34 +14,39 @@
 #   4. ci/tpu_numerics.py    — kernel numerics incl. flash-decode cases
 set -u
 cd "$(dirname "$0")/.."
+PYTHON=${PYTHON:-python}
 OUT=_tpu_capture
 mkdir -p "$OUT"
 TS=$(date -u +%Y%m%dT%H%M%SZ)
 
-probe() {
-  timeout 90 python -c "import jax; d=jax.devices(); print(jax.default_backend())" 2>/dev/null | tail -1
-}
+# Gate on bench.py's windowed probe (retry+backoff over 10 min): a
+# one-shot jax.devices() probe re-creates exactly the transient-wedge
+# fragility probe_backend() was built to survive (bench.py:104-113).
+if ! "$PYTHON" -c "import sys; sys.path.insert(0, '.'); \
+from bench import probe_backend; \
+sys.exit(0 if not probe_backend()['fallback'] else 1)"; then
+  echo "capture: tunnel not reachable within the probe window; aborting"
+  exit 1
+fi
+echo "capture: tunnel live, starting at $TS"
 
-B=$(probe)
-case "$B" in
-  tpu|axon) echo "capture: tunnel live ($B), starting at $TS" ;;
-  *) echo "capture: tunnel not reachable (probe said '$B'); aborting"; exit 1 ;;
-esac
-
+FAILS=0
 run() {  # name, command...
   local name=$1; shift
   echo "capture: === $name ==="
   ( "$@" > "$OUT/${name}_$TS.json" ) 2> "$OUT/${name}_$TS.log"
   local rc=$?
+  [ "$rc" -ne 0 ] && FAILS=$((FAILS + 1))
   echo "capture: $name rc=$rc -> $OUT/${name}_$TS.json"
 }
 
-run bench     python bench.py
-run mfu_ab    python ci/tpu_mfu_ab.py
-run ctx_sweep python ci/tpu_ctx_sweep.py
-run numerics  python ci/tpu_numerics.py
+run bench     "$PYTHON" bench.py
+run mfu_ab    "$PYTHON" ci/tpu_mfu_ab.py
+run ctx_sweep "$PYTHON" ci/tpu_ctx_sweep.py
+run numerics  "$PYTHON" ci/tpu_numerics.py
 
-echo "capture: done. Post-process:"
+echo "capture: done ($FAILS stage failures). Post-process:"
 echo "  - BENCH_TPU_LAST_GOOD.json refreshed automatically by bench.py"
 echo "  - copy numerics json over TPU_NUMERICS.json if numerics_ok"
 echo "  - fold mfu_ab/ctx_sweep numbers into PERF.md"
+exit "$FAILS"
