@@ -1,9 +1,20 @@
 """Batched generation service: the serving half of the notebook workload.
 
 A provisioned notebook that serves its model needs request batching to keep
-the chip busy — single-prompt generate calls leave the MXU mostly idle. The
-``BatchedGenerator`` runs a background scheduler thread that coalesces
-concurrent requests into batches and answers each caller through a Future.
+the chip busy — single-prompt generate calls leave the MXU mostly idle.
+Two engines, one submit/Future API:
+
+- ``BatchedGenerator`` — shape-bucketed: a background scheduler coalesces
+  concurrent same-shape requests and runs each batch to completion
+  (templated/phased load);
+- ``ContinuousBatchedGenerator`` — requests join and leave a RUNNING
+  batch at token boundaries, with chunked prefill admission, exact
+  prefix caching, cooperative cancellation, and (with a draft model)
+  per-tick speculative blocks.
+
+Both optionally speculate (models/speculative.py): same outputs — exact
+greedy parity, exact sampled distributions — with the target's weights
+read once per accepted block instead of once per token.
 
 TPU-first batching policy:
 - requests batch only when their (prompt_len, max_new_tokens) shapes match —
