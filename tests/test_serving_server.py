@@ -753,3 +753,31 @@ def test_openai_finish_reason_stop_on_last_slot_eos(tmp_path):
             out = json.loads(resp.read())
     assert out["usage"]["completion_tokens"] == budget
     assert out["choices"][0]["finish_reason"] == "stop"
+
+
+def test_spec_inexact_flag_controls_flash_regime_gate():
+    """decode_attention='flash' puts plain decode on the Pallas kernel
+    while the verify window is einsum — the engine refuses speculation
+    by default (kernel-mix greedy parity risk) and --spec-inexact is the
+    explicit opt-in build_generator must actually wire through."""
+    import dataclasses
+    from kubeflow_tpu.runtime.server import build_generator
+    params, cfg = model()
+    fcfg = dataclasses.replace(cfg, decode_attention="flash")
+
+    class Args:
+        engine = "continuous"
+        slots = 2
+        quantize = False
+        kv_quant = False
+        eos_id = -1
+        spec_k = 2
+        spec_inexact = False
+    with pytest.raises(ValueError, match="spec_exact_only"):
+        build_generator(params, fcfg, Args(), draft=(params, fcfg))
+    Args.spec_inexact = True
+    gen = build_generator(params, fcfg, Args(), draft=(params, fcfg))
+    try:
+        assert gen.draft is not None
+    finally:
+        gen.close()
