@@ -128,7 +128,7 @@ class ServingServer:
 
     def __init__(self, generator, config, *, host: str = "127.0.0.1",
                  port: int = 8890, request_timeout_s: float = 300.0,
-                 tokenizer=None):
+                 tokenizer=None, model_name: str | None = None):
         from ..utils.metrics import MetricsRegistry
         self.generator = generator
         self.config = config
@@ -138,6 +138,7 @@ class ServingServer:
         # With one configured, requests may pass "text" instead of
         # "prompt" ids and responses/stream events carry decoded text.
         self.tokenizer = tokenizer
+        self.model_name = model_name or self.MODEL_NAME
         self._started_at = int(time.time())
         # Prometheus exposition (GET /metrics): engine counters mirrored at
         # scrape time, plus the HTTP layer's own request/latency series —
@@ -361,6 +362,14 @@ class ServingServer:
         if self.tokenizer is None:
             raise ValueError("/v1/completions requires the server to "
                              "run with --tokenizer (responses are text)")
+        # SDKs always send 'model': a mismatch means the client thinks
+        # it is talking to a different deployment — refuse rather than
+        # silently serve the wrong weights
+        want_model = req.get("model")
+        if want_model is not None and want_model != self.model_name:
+            raise ValueError(f"model {want_model!r} is not served here "
+                             f"(this endpoint serves "
+                             f"{self.model_name!r})")
         if req.get("n", 1) != 1 or req.get("best_of", 1) != 1:
             raise ValueError("'n'/'best_of' > 1 not supported")
         for knob in ("logprobs", "echo", "stop", "suffix", "logit_bias",
@@ -388,7 +397,7 @@ class ServingServer:
         import uuid
         return {"id": "cmpl-" + uuid.uuid4().hex[:24],
                 "object": "text_completion",
-                "created": int(time.time()), "model": self.MODEL_NAME}
+                "created": int(time.time()), "model": self.model_name}
 
     def _finish_and_usage(self, usage: dict, ids: list) -> tuple:
         """(finish_reason, OpenAI usage) — ONE definition for the
@@ -600,9 +609,9 @@ class ServingServer:
             # OpenAI list-shape alongside the native fields, so SDK
             # clients pointed at this base_url can enumerate models
             "object": "list",
-            "data": [{"id": self.MODEL_NAME, "object": "model",
+            "data": [{"id": self.model_name, "object": "model",
                       "created": self._started_at,
-                      "owned_by": self.MODEL_NAME}],
+                      "owned_by": self.model_name}],
             "engine": type(self.generator).__name__,
             "tokenizer": self.tokenizer is not None,
             "model": {
@@ -681,6 +690,9 @@ def main(argv=None) -> int:
                          "differ in last-bit rounding, so a greedy "
                          "near-tie may flip (sampled requests' "
                          "distribution is unaffected)")
+    ap.add_argument("--model-name", default=None,
+                    help="model id reported on /v1/models and in "
+                         "completions responses (default: kubeflow-tpu)")
     ap.add_argument("--tokenizer", default=None,
                     help="local tokenizer directory (transformers "
                          "AutoTokenizer, local_files_only): enables "
@@ -780,7 +792,8 @@ def main(argv=None) -> int:
 
     server = ServingServer(build_generator(params, config, args, draft),
                            config, host=args.host, port=args.port,
-                           tokenizer=tokenizer).start()
+                           tokenizer=tokenizer,
+                           model_name=args.model_name).start()
     log.info("ready on %s", server.url)
     try:
         threading.Event().wait()

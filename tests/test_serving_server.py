@@ -781,3 +781,46 @@ def test_spec_inexact_flag_controls_flash_regime_gate():
         assert gen.draft is not None
     finally:
         gen.close()
+
+
+def test_model_name_flag_reaches_openai_surfaces(tmp_path):
+    """--model-name: the reported id flows to /v1/models and the
+    completions envelope."""
+    params, cfg = model()
+    tok = _word_tokenizer(tmp_path)
+    gen = ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                     prefill_chunk=8)
+    with ServingServer(gen, cfg, port=0, tokenizer=tok,
+                       model_name="my-finetune-v2") as srv:
+        _, info = _get(srv.url, "/v1/models")
+        assert info["data"][0]["id"] == "my-finetune-v2"
+        req = urllib.request.Request(
+            srv.url + "/v1/completions",
+            data=json.dumps({"prompt": "w1 w2", "max_tokens": 2,
+                             "temperature": 0}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            out = json.loads(resp.read())
+        assert out["model"] == "my-finetune-v2"
+        # a client asking for a DIFFERENT model gets a loud 400, not the
+        # wrong weights
+        req = urllib.request.Request(
+            srv.url + "/v1/completions",
+            data=json.dumps({"model": "someone-elses-model",
+                             "prompt": "w1", "max_tokens": 2}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            assert "not served here" in json.loads(e.read())["error"]
+        # the matching name (what SDKs send) passes
+        req = urllib.request.Request(
+            srv.url + "/v1/completions",
+            data=json.dumps({"model": "my-finetune-v2", "prompt": "w1",
+                             "max_tokens": 2,
+                             "temperature": 0}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.status == 200
