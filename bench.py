@@ -35,6 +35,14 @@ last-good on-chip compute lines from ``BENCH_TPU_LAST_GOOD.json`` are
 re-emitted tagged ``archived: true`` + ``fallback: true`` with per-line
 capture timestamps, so the artifact still carries hardware numbers with
 explicit provenance; a live TPU run refreshes that archive per metric.
+
+Short-tunnel-window modes (VERDICT r4 ask #1 — live windows can be
+minutes long, so never-measured metrics must run first): ``--missing-first``
+orders the compute benches by archive absence (never-captured, then
+stalest ``captured_at``), ``--missing-only`` runs just the never-captured
+ones, ``--only M[,M...]`` an explicit subset. The archive refreshes
+incrementally after every live bench, so a mid-run tunnel wedge keeps
+whatever it already captured.
 """
 
 from __future__ import annotations
@@ -243,6 +251,14 @@ _EMITTED: list[dict] = []
 def _emit(info: dict, **fields) -> None:
     fields.setdefault("backend", info["backend"])
     fields.setdefault("fallback", info["fallback"])
+    # stamp live archive-metric lines at MEASUREMENT time: the archive's
+    # stalest-first ordering (plan_benches) depends on per-line capture
+    # times, so a later refresh pass must not re-date them to end-of-run
+    if fields["backend"] != "cpu" and not fields["fallback"] \
+            and fields.get("metric") in ARCHIVE_METRICS \
+            and fields.get("value") is not None:
+        fields.setdefault("captured_at",
+                          time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()))
     _EMITTED.append(fields)
     print(json.dumps(fields), flush=True)
 
@@ -256,9 +272,11 @@ def _refresh_archive(info: dict) -> None:
     re-measure — each carried-forward line keeps its own older
     ``captured_at``."""
     now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
-    # per-line capture metadata: carried-forward lines from a previous run
-    # keep their own timestamp AND device_kind (the chips may differ)
-    good = {line["metric"]: {**line, "captured_at": now,
+    # per-line capture metadata: a line keeps the timestamp _emit stamped
+    # at measurement; carried-forward lines from a previous run keep their
+    # own timestamp AND device_kind (the chips may differ)
+    good = {line["metric"]: {**line,
+                             "captured_at": line.get("captured_at") or now,
                              "device_kind": info.get("device_kind")}
             for line in _EMITTED
             if line.get("backend") != "cpu" and not line.get("fallback")
@@ -922,46 +940,164 @@ def measure_once_http() -> float:
                 sys.stderr.write(f"bench: cleanup {cleanup} failed: {e}\n")
 
 
-def main() -> None:
+# Every compute bench with the archive metric(s) it emits, in the default
+# (legacy) run order. bench_decode emits three lines from one shared setup.
+COMPUTE_BENCHES: tuple = (
+    (bench_attention, ("flash_vs_xla_attention_speedup",)),
+    (bench_train_step, ("train_step_tokens_per_sec",)),
+    (bench_long_context_train, ("train_8k_ctx_tokens_per_sec",)),
+    (bench_16k_context_train, ("train_16k_ctx_tokens_per_sec",)),
+    (bench_32k_context_train, ("train_32k_ctx_tokens_per_sec",)),
+    (bench_decode, ("decode_tokens_per_sec",
+                    "decode_long_ctx_tokens_per_sec",
+                    "decode_int8_tokens_per_sec")),
+    (bench_spec_window, ("spec_verify_window_speedup",)),
+    (bench_serving, ("serving_tokens_per_sec",)),
+)
+
+CONTROL_PLANE_METRICS = ("notebook_cr_to_slice_ready_http_p50_s",
+                         "notebook_cr_to_slice_ready_p50_s")
+
+
+def _archived_capture_times(path: pathlib.Path = None) -> dict:
+    """metric -> captured_at for every line in the last-good archive; a
+    metric absent from the returned dict has NEVER produced an on-chip
+    number (the round-4 lesson: those must run first in a short window)."""
+    try:
+        payload = json.loads((path or ARCHIVE_PATH).read_text())
+        default = payload.get("captured_at") or ""
+        return {line["metric"]: line.get("captured_at") or default
+                for line in payload.get("lines", ()) if line.get("metric")}
+    except (OSError, ValueError, AttributeError, TypeError):
+        # unreadable OR structurally-corrupt archive reads as absent — a
+        # bad file must not kill the capture run it exists to prioritize
+        # (same stance as _refresh_archive)
+        return {}
+
+
+def plan_benches(captured: dict, only: set | None = None,
+                 missing_first: bool = False,
+                 missing_only: bool = False) -> tuple[list, bool]:
+    """Select + order the compute benches for this run.
+
+    Returns ``(benches, run_control_plane)`` where ``benches`` is a list of
+    ``(fn, metrics)`` entries from COMPUTE_BENCHES. Round-4 lesson encoded
+    here: the tunnel's live windows can be minutes long, and the legacy
+    fixed order put every never-captured metric BEHIND re-measures of
+    already-archived ones (VERDICT r4 weak #1) — ``missing_first`` sorts by
+    archive absence (never-captured first, then stalest ``captured_at``),
+    ``missing_only`` additionally drops every bench whose metrics are all
+    already archived, and ``only`` restricts to an explicit metric set."""
+    benches = list(COMPUTE_BENCHES)
+    run_control_plane = only is None and not missing_only
+    if only is not None:
+        benches = [(fn, ms) for fn, ms in benches if only & set(ms)]
+        # --missing-only's "skips the control-plane benches" contract wins
+        # over an --only naming one (they never have archive entries)
+        run_control_plane = bool(only & set(CONTROL_PLANE_METRICS)) \
+            and not missing_only
+    if missing_only:
+        benches = [(fn, ms) for fn, ms in benches
+                   if any(m not in captured for m in ms)]
+    if missing_first or missing_only:
+        # key per bench = its most-capture-worthy metric: (False, "") for a
+        # never-captured metric sorts before every (True, timestamp)
+        benches.sort(key=lambda entry: min(
+            (m in captured, captured.get(m, "")) for m in entry[1]))
+    return benches, run_control_plane
+
+
+def main(argv: list | None = None) -> None:
+    import argparse
+    parser = argparse.ArgumentParser(
+        description="Control-plane + single-chip compute benchmarks. "
+                    "Default (no flags) runs everything in the legacy "
+                    "order — what the round driver invokes.")
+    parser.add_argument(
+        "--missing-first", action="store_true",
+        help="order compute benches by archive absence: never-captured "
+             "metrics first, then stalest captured_at (short-tunnel-window "
+             "mode; VERDICT r4 ask #1)")
+    parser.add_argument(
+        "--missing-only", action="store_true",
+        help="run ONLY benches with a never-captured archive metric, "
+             "missing-first ordered; skips the control-plane benches")
+    parser.add_argument(
+        "--only", default=None, metavar="METRIC[,METRIC...]",
+        help="run only the benches emitting these metrics "
+             "(compute or control-plane)")
+    args = parser.parse_args(argv)
+
+    all_metrics = {m for _, ms in COMPUTE_BENCHES for m in ms} | \
+        set(CONTROL_PLANE_METRICS)
+    only = None
+    if args.only is not None:
+        only = {m.strip() for m in args.only.split(",") if m.strip()}
+        unknown = only - all_metrics
+        if not only:
+            parser.error("--only needs at least one metric; known: "
+                         f"{sorted(all_metrics)}")
+        if unknown:
+            parser.error(f"unknown metric(s) {sorted(unknown)}; "
+                         f"known: {sorted(all_metrics)}")
+
+    captured = _archived_capture_times()
+    benches, run_control_plane = plan_benches(
+        captured, only=only, missing_first=args.missing_first,
+        missing_only=args.missing_only)
+    selective = bool(args.only or args.missing_only)
+    if args.missing_first or args.missing_only:
+        sys.stderr.write(
+            "bench: order = " + " -> ".join(
+                "+".join(m for m in ms) for _, ms in benches) +
+            (" (then control-plane)" if run_control_plane else "") + "\n")
+
     info = probe_backend()
-    for bench, metric in ((bench_attention, "flash_vs_xla_attention_speedup"),
-                          (bench_train_step, "train_step_tokens_per_sec"),
-                          (bench_long_context_train,
-                           "train_8k_ctx_tokens_per_sec"),
-                          (bench_16k_context_train,
-                           "train_16k_ctx_tokens_per_sec"),
-                          (bench_32k_context_train,
-                           "train_32k_ctx_tokens_per_sec"),
-                          (bench_decode, "decode_tokens_per_sec"),
-                          (bench_spec_window, "spec_verify_window_speedup"),
-                          (bench_serving, "serving_tokens_per_sec")):
+    for bench, metrics in benches:
         try:
             bench(info)
         except Exception as e:  # a compute bench must never eat the headline
-            _emit(info, metric=metric, value=None, unit="error",
-                  vs_baseline=None, error=f"{type(e).__name__}: {e}")
-    try:
-        http_p50 = statistics.median(
-            [measure_once_http() for _ in range(RUNS)])
-        _emit(info, metric="notebook_cr_to_slice_ready_http_p50_s",
-              value=round(http_p50, 4), unit="s",
-              vs_baseline=round(BASELINE_SECONDS / http_p50, 2))
-    except Exception as e:
-        _emit(info, metric="notebook_cr_to_slice_ready_http_p50_s",
-              value=None, unit="error", vs_baseline=None,
-              error=f"{type(e).__name__}: {e}")
-    latencies = [measure_once() for _ in range(RUNS)]
-    p50 = statistics.median(latencies)
-    _emit(info, metric="notebook_cr_to_slice_ready_p50_s",
-          value=round(p50, 4), unit="s",
-          vs_baseline=round(BASELINE_SECONDS / p50, 2))
+            # one error line PER metric the bench would have emitted (minus
+            # any it managed before failing): a consumer reconciling the
+            # stream against ARCHIVE_METRICS must see failed, not absent
+            done = {line.get("metric") for line in _EMITTED}
+            for metric in metrics:
+                if metric not in done:
+                    _emit(info, metric=metric, value=None, unit="error",
+                          vs_baseline=None, error=f"{type(e).__name__}: {e}")
+        # refresh the archive INCREMENTALLY after every live bench: a
+        # tunnel wedge mid-run must not lose the captures already made
+        # (round-4's 16-minute window would have kept its first numbers)
+        if info["backend"] != "cpu" and not info["fallback"]:
+            _refresh_archive(info)
+    def _cp_selected(metric: str) -> bool:
+        return run_control_plane and (only is None or metric in only)
+
+    if _cp_selected("notebook_cr_to_slice_ready_http_p50_s"):
+        try:
+            http_p50 = statistics.median(
+                [measure_once_http() for _ in range(RUNS)])
+            _emit(info, metric="notebook_cr_to_slice_ready_http_p50_s",
+                  value=round(http_p50, 4), unit="s",
+                  vs_baseline=round(BASELINE_SECONDS / http_p50, 2))
+        except Exception as e:
+            _emit(info, metric="notebook_cr_to_slice_ready_http_p50_s",
+                  value=None, unit="error", vs_baseline=None,
+                  error=f"{type(e).__name__}: {e}")
+    if _cp_selected("notebook_cr_to_slice_ready_p50_s"):
+        latencies = [measure_once() for _ in range(RUNS)]
+        p50 = statistics.median(latencies)
+        _emit(info, metric="notebook_cr_to_slice_ready_p50_s",
+              value=round(p50, 4), unit="s",
+              vs_baseline=round(BASELINE_SECONDS / p50, 2))
     # keyed on the RESOLVED backend, not just probe exhaustion: a probe
     # that "succeeds" but cleanly initializes CPU-only (libtpu misconfig)
-    # must also surface the archived hardware numbers
-    if info["backend"] == "cpu":
+    # must also surface the archived hardware numbers. Selective runs
+    # (--only / --missing-only) skip the replay: their consumers want the
+    # requested measurements, not the whole archive re-emitted around them.
+    # (Live runs already refreshed the archive incrementally per bench.)
+    if info["backend"] == "cpu" and not selective:
         _emit_archived_tpu_lines()
-    else:
-        _refresh_archive(info)
 
 
 if __name__ == "__main__":

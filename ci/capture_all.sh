@@ -6,12 +6,21 @@
 #
 #   make capture          # everything, ~30-60 min with cold compiles
 #
-# Stages:
-#   1. bench.py              — all archive metrics + refreshes
-#                              BENCH_TPU_LAST_GOOD.json per metric
-#   2. ci/tpu_mfu_ab.py      — train-step MFU lever grid (VERDICT r3 #3)
+# Stage order is NEVER-MEASURED FIRST (VERDICT r4 weak #1: a 16-minute
+# live window was spent re-measuring two known-good lines because the old
+# fixed order put every never-captured metric last):
+#   1. bench.py --missing-only — ONLY the archive metrics that have never
+#                               produced an on-chip number, stalest-first;
+#                               refreshes BENCH_TPU_LAST_GOOD.json per
+#                               metric INCREMENTALLY (a mid-run wedge
+#                               keeps what it captured)
+#   2. ci/tpu_numerics.py    — kernel numerics incl. the never-run
+#                               flash-decode cases
 #   3. ci/tpu_ctx_sweep.py   — remat x CE-chunk x context (VERDICT r3 #5)
-#   4. ci/tpu_numerics.py    — kernel numerics incl. flash-decode cases
+#   4. ci/tpu_mfu_ab.py      — train-step MFU lever grid (VERDICT r3 #3)
+#   5. bench.py --missing-first — full refresh of everything else
+#                               (+ control-plane lines), still ordered
+#                               stalest-first
 set -u
 cd "$(dirname "$0")/.."
 PYTHON=${PYTHON:-python}
@@ -40,10 +49,11 @@ run() {  # name, command...
   echo "capture: $name rc=$rc -> $OUT/${name}_$TS.json"
 }
 
-run bench     "$PYTHON" bench.py
-run mfu_ab    "$PYTHON" ci/tpu_mfu_ab.py
-run ctx_sweep "$PYTHON" ci/tpu_ctx_sweep.py
-run numerics  "$PYTHON" ci/tpu_numerics.py
+run bench_missing "$PYTHON" bench.py --missing-only
+run numerics      "$PYTHON" ci/tpu_numerics.py
+run ctx_sweep     "$PYTHON" ci/tpu_ctx_sweep.py
+run mfu_ab        "$PYTHON" ci/tpu_mfu_ab.py
+run bench         "$PYTHON" bench.py --missing-first
 
 echo "capture: done ($FAILS stage failures). Post-process:"
 echo "  - BENCH_TPU_LAST_GOOD.json refreshed automatically by bench.py"

@@ -246,3 +246,156 @@ def test_shipped_archive_is_valid_and_tpu_only(bench):
         assert not line.get("fallback")
         assert line.get("value") is not None
         assert line["metric"] in bench.ARCHIVE_METRICS
+
+
+# ---------------------------------------------------------- bench planning
+# VERDICT r4 ask #1: a short live window must run never-captured metrics
+# first. plan_benches() is the pure ordering core; these pin its contract.
+
+def _write_archive(bench, metrics):
+    """Seed the (redirected) archive with the given metric -> captured_at."""
+    bench.ARCHIVE_PATH.write_text(json.dumps({
+        "captured_at": "2026-07-30T00:00:00Z",
+        "lines": [{"metric": m, "backend": "tpu", "value": 1.0,
+                   "captured_at": ts} for m, ts in metrics.items()]}))
+
+
+def test_default_plan_is_legacy_order_with_control_plane(bench):
+    benches, cp = bench.plan_benches({})
+    assert benches == list(bench.COMPUTE_BENCHES)
+    assert cp is True
+
+
+def test_missing_first_puts_never_captured_before_archived(bench):
+    captured = {"flash_vs_xla_attention_speedup": "2026-07-31T03:25:00Z",
+                "train_step_tokens_per_sec": "2026-07-31T03:25:00Z",
+                "train_8k_ctx_tokens_per_sec": "2026-07-30T12:40:00Z",
+                "decode_tokens_per_sec": "2026-07-30T12:40:00Z",
+                "decode_int8_tokens_per_sec": "2026-07-30T12:40:00Z"}
+    benches, cp = bench.plan_benches(captured, missing_first=True)
+    ordered = ["+".join(ms) for _, ms in benches]
+    n_missing = 5  # 16k, 32k, spec window, serving, decode_long_ctx
+    missing_block = ordered[:n_missing]
+    for name in ("train_16k_ctx_tokens_per_sec",
+                 "train_32k_ctx_tokens_per_sec",
+                 "spec_verify_window_speedup", "serving_tokens_per_sec"):
+        assert any(name in entry for entry in missing_block), ordered
+    # decode_long_ctx has never been captured -> the decode bench (which
+    # emits it) belongs to the missing block even though its siblings are
+    # archived
+    assert any("decode_long_ctx" in entry for entry in missing_block)
+    # within the archived tail, stalest captured_at first
+    tail = ordered[n_missing:]
+    assert tail.index("train_8k_ctx_tokens_per_sec") < \
+        tail.index("train_step_tokens_per_sec")
+    assert cp is True
+    assert len(benches) == len(bench.COMPUTE_BENCHES)
+
+
+def test_missing_only_drops_fully_archived_benches_and_control_plane(bench):
+    captured = {m: "2026-07-30T00:00:00Z"
+                for _, ms in bench.COMPUTE_BENCHES for m in ms
+                if m not in ("serving_tokens_per_sec",
+                             "spec_verify_window_speedup")}
+    benches, cp = bench.plan_benches(captured, missing_only=True)
+    names = ["+".join(ms) for _, ms in benches]
+    assert names == ["spec_verify_window_speedup", "serving_tokens_per_sec"]
+    assert cp is False
+
+
+def test_only_restricts_to_named_metrics(bench):
+    benches, cp = bench.plan_benches(
+        {}, only={"decode_tokens_per_sec", "train_step_tokens_per_sec"})
+    fns = [fn.__name__ for fn, _ in benches]
+    assert fns == ["bench_train_step", "bench_decode"]
+    assert cp is False
+    _, cp2 = bench.plan_benches(
+        {}, only={"notebook_cr_to_slice_ready_p50_s"})
+    assert cp2 is True
+
+
+def test_archived_capture_times_reads_per_line_timestamps(bench):
+    _write_archive(bench, {"decode_tokens_per_sec": "2026-07-29T00:00:00Z",
+                           "train_step_tokens_per_sec": None})
+    times = bench._archived_capture_times(bench.ARCHIVE_PATH)
+    assert times["decode_tokens_per_sec"] == "2026-07-29T00:00:00Z"
+    # a line with no own timestamp inherits the payload-level one
+    assert times["train_step_tokens_per_sec"] == "2026-07-30T00:00:00Z"
+    assert bench._archived_capture_times(bench.ARCHIVE_PATH.parent /
+                                         "nope.json") == {}
+
+
+def test_unknown_only_metric_errors(bench):
+    with pytest.raises(SystemExit):
+        bench.main(["--only", "not_a_metric"])
+
+
+def test_compute_bench_table_covers_archive_metrics(bench):
+    """Every archived metric must be reachable through the planner, or a
+    --missing-only run could silently never capture it."""
+    table = {m for _, ms in bench.COMPUTE_BENCHES for m in ms}
+    assert table == set(bench.ARCHIVE_METRICS)
+
+
+def test_empty_only_value_errors(bench):
+    for bad in (",", " ", ", ,"):
+        with pytest.raises(SystemExit):
+            bench.main(["--only", bad])
+
+
+def test_missing_only_wins_over_only_control_plane(bench):
+    _, cp = bench.plan_benches(
+        {}, only={"notebook_cr_to_slice_ready_p50_s"}, missing_only=True)
+    assert cp is False
+
+
+def test_failed_multi_metric_bench_emits_error_per_unemitted_metric(
+        bench, monkeypatch, capsys):
+    """bench_decode emits three metrics; if it dies after the first, the
+    other two must surface as error lines, not vanish (a consumer
+    reconciling against ARCHIVE_METRICS reads absent as never-ran)."""
+    def exploding_decode(info):
+        bench._emit(info, metric="decode_tokens_per_sec", value=1.0,
+                    unit="tokens/s")
+        raise RuntimeError("tunnel wedged")
+    monkeypatch.setattr(bench, "probe_backend", lambda: {
+        "backend": "tpu", "n_devices": 1, "device_kind": "TPU v5e",
+        "fallback": False, "probe_error": None})
+    entry = next(e for e in bench.COMPUTE_BENCHES
+                 if e[0].__name__ == "bench_decode")
+    monkeypatch.setattr(bench, "COMPUTE_BENCHES",
+                        ((exploding_decode, entry[1]),))
+    bench.main(["--only", "decode_tokens_per_sec"])
+    out = [json.loads(line) for line in
+           capsys.readouterr().out.strip().splitlines()]
+    by_metric = {line["metric"]: line for line in out}
+    assert by_metric["decode_tokens_per_sec"]["value"] == 1.0
+    for m in ("decode_long_ctx_tokens_per_sec",
+              "decode_int8_tokens_per_sec"):
+        assert "tunnel wedged" in by_metric[m]["error"]
+    # the successful live line landed in the archive with its own stamp
+    payload = json.loads(bench.ARCHIVE_PATH.read_text())
+    [line] = payload["lines"]
+    assert line["metric"] == "decode_tokens_per_sec"
+    assert line["captured_at"]
+
+
+def test_incremental_refresh_preserves_measurement_timestamps(bench):
+    """A later refresh pass must not re-date a line to end-of-run time —
+    stalest-first ordering depends on true per-line capture times."""
+    info = {"backend": "tpu", "fallback": False, "device_kind": "TPU v5e"}
+    bench._emit(info, metric="decode_tokens_per_sec", value=2.0,
+                unit="tokens/s", captured_at="2026-07-30T01:00:00Z")
+    bench._refresh_archive(info)
+    bench._refresh_archive(info)  # second (end-of-run) pass
+    payload = json.loads(bench.ARCHIVE_PATH.read_text())
+    [line] = payload["lines"]
+    assert line["captured_at"] == "2026-07-30T01:00:00Z"
+
+
+def test_archived_capture_times_tolerates_corrupt_archive(bench):
+    """Valid-JSON-wrong-shape archives read as absent — a corrupt file must
+    not abort the capture run it exists to prioritize."""
+    for corrupt in ("[]", '{"lines": ["x"]}', '{"lines": 3}', "null"):
+        bench.ARCHIVE_PATH.write_text(corrupt)
+        assert bench._archived_capture_times(bench.ARCHIVE_PATH) == {}
