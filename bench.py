@@ -786,11 +786,18 @@ def bench_serving(info: dict) -> None:
             deadline = time.monotonic() + 5.0
             while len(lat) < n_req and time.monotonic() < deadline:
                 time.sleep(0.01)
-            lat.sort()
+            # snapshot into a NEW name: stragglers keep appending to the
+            # original list (the done-callbacks close over `lat`), the
+            # percentiles index a frozen sorted copy; past the drain
+            # deadline the race degrades the latency fields to null,
+            # never the whole load point
+            snap = sorted(lat)
             return {"tokens_per_sec": round(n_req * N / makespan, 1),
                     "makespan_s": round(makespan, 2),
-                    "latency_p50_s": round(lat[len(lat) // 2], 3),
-                    "latency_p95_s": round(lat[int(len(lat) * 0.95)], 3)}
+                    "latency_p50_s": round(snap[len(snap) // 2], 3)
+                    if snap else None,
+                    "latency_p95_s": round(snap[int(len(snap) * 0.95)], 3)
+                    if snap else None}
         finally:
             eng.close()
 

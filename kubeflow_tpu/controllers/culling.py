@@ -126,6 +126,14 @@ def serving_requests_prober(config: ControllerConfig) \
     unreachable (no server yet, or mid-restart) — never an error."""
     def probe(notebook: dict, port: str) -> int | None:
         ns, name = k8s.namespace(notebook), k8s.name(notebook)
+        # the annotation is attacker-ish input (any notebook author sets
+        # it): k8s.parse_port is the same bound notebook.py applies before
+        # exposing the Service port — a bad value must not reach the URL
+        port_num = k8s.parse_port(port)
+        if port_num is None:
+            log.debug("serving probe %s/%s: invalid port %r", ns, name, port)
+            return None
+        port = str(port_num)
         if config.dev_mode:
             url = (f"{config.dev_proxy_url}/api/v1/namespaces/{ns}/"
                    f"services/{name}:{port}/proxy/healthz")

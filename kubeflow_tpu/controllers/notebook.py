@@ -368,11 +368,8 @@ class NotebookReconciler:
         serving_port = k8s.get_annotation(notebook,
                                           names.SERVING_PORT_ANNOTATION)
         if serving_port:
-            try:
-                port_n = int(serving_port)
-            except ValueError:
-                port_n = None
-            if port_n is not None and 0 < port_n < 65536:
+            port_n = k8s.parse_port(serving_port)
+            if port_n is not None:
                 svc["spec"]["ports"].append({
                     "name": "http-serving",
                     "port": port_n,

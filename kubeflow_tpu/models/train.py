@@ -50,7 +50,7 @@ class TrainConfig:
     # application, both moment updates, bias correction, weight decay, and
     # the parameter delta per leaf, instead of optax.chain's staged trees
     # (clip's scaled-grad tree, adamw's mu_hat/nu_hat/update trees). Same
-    # math to float tolerance (pinned by tests/test_train.py); exists as a
+    # math to float tolerance (pinned by tests/test_fused_adamw.py); as a
     # measured MFU lever — whether XLA already fuses optax's stages is a
     # hardware question, answered by ci/tpu_mfu_ab.py.
     fused_adamw: bool = False
@@ -98,7 +98,7 @@ def fused_clip_adamw(schedule, *, b1: float, b2: float,
     and both new moments in a single jax.tree.map whose per-leaf body is
     one elementwise chain — trivially one fusion per parameter. Matches
     optax.chain(clip_by_global_norm, adamw) to float tolerance
-    (tests/test_train.py pins parity)."""
+    (tests/test_fused_adamw.py pins parity)."""
 
     def init(params):
         zeros = jax.tree.map(jnp.zeros_like, params)

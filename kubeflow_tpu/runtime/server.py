@@ -222,6 +222,11 @@ class ServingServer:
                         self._json(413, {"error": "invalid request size"})
                         return
                     req = json.loads(self.rfile.read(length))
+                    if not isinstance(req, dict):
+                        # valid JSON of the wrong shape ([1,2], "x") is a
+                        # client error, not an AttributeError 500
+                        raise ValueError(
+                            "request body must be a JSON object")
                     openai = self.path == "/v1/completions"
                     if openai:
                         req = server.translate_completions(req)
@@ -466,6 +471,11 @@ class ServingServer:
         whose ``ids`` is the engine's result exactly as the non-streaming
         response would return it (padded to max_new_tokens after an early
         EOS) and ``n_tokens`` counts the token events that preceded it.
+        In text mode, a multi-byte character still split across tokens at
+        the end of generation is flushed as ONE extra token-less
+        ``data: {"text": ...}`` event between the last token event and the
+        done event — clients keying on ``"token"`` must treat a frame
+        without it as text-only continuation, not a protocol error.
         The response is delimited by connection close (no
         Content-Length)."""
         prompt, max_new, temp, top_k, top_p, was_text = self._validate(req)

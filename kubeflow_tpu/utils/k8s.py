@@ -91,6 +91,20 @@ def remove_annotation(obj: Obj, key: str) -> None:
         anns.pop(key, None)
 
 
+def parse_port(value) -> int | None:
+    """Annotation values are author-controlled input: parse a TCP port,
+    returning None for anything non-numeric or out of range. The ONE
+    validation shared by every consumer of a port-bearing annotation
+    (Service exposure in controllers/notebook.py, the serving-activity
+    probe URL in controllers/culling.py) — a single bound, so the exposure
+    check and the prober check can never desynchronize."""
+    try:
+        port = int(value)
+    except (TypeError, ValueError):
+        return None
+    return port if 0 < port < 65536 else None
+
+
 def finalizers(obj: Obj) -> list:
     return meta(obj).setdefault("finalizers", [])
 

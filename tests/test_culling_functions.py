@@ -174,3 +174,40 @@ class TestStopAnnotationAndIdleness:
         nb = store.get(api.KIND, "ns", "nb")
         assert nb["metadata"]["annotations"][names.STOP_ANNOTATION] == \
             stop_value
+
+
+# --------------------------------------------------- serving prober hygiene
+class TestServingProberPortValidation:
+    """The serving-port annotation is author-controlled input; the prober
+    must range-check it before it reaches a probe URL (the reconciler
+    applies the same 0<port<65536 bound before exposing the Service port)
+    — a crafted value must not redirect the probe path, notably through
+    the API-server proxy URL in dev_mode (ADVICE r4)."""
+
+    def _probe(self, **cfg):
+        from kubeflow_tpu.controllers.culling import serving_requests_prober
+        from kubeflow_tpu.utils.config import ControllerConfig
+        return serving_requests_prober(ControllerConfig(**cfg))
+
+    NB = {"metadata": {"name": "nb", "namespace": "ns"}}
+
+    @pytest.mark.parametrize("port", [
+        "", "http", "-1", "0", "65536", "999999",
+        "80/../../api/v1/secrets", "80?x=1", "80#frag", "8080:9090",
+        None,
+    ])
+    def test_invalid_port_returns_none_without_probing(self, port):
+        probe = self._probe()
+        # no HTTP server exists in this test: an invalid value must be
+        # rejected BEFORE any connection attempt (None, instantly)
+        t0 = time.monotonic()
+        assert probe(self.NB, port) is None
+        assert time.monotonic() - t0 < 0.5
+
+    def test_valid_port_reaches_the_connection_attempt(self):
+        # a well-formed port passes validation and fails only at connect
+        # time (dev-mode proxy on a closed local port: instant refusal)
+        probe = self._probe(dev_mode=True,
+                            dev_proxy_url="http://127.0.0.1:9",
+                            jupyter_probe_timeout_s=0.2)
+        assert probe(self.NB, "8080") is None

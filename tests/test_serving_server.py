@@ -90,6 +90,23 @@ def test_request_validation_is_400_not_500(server):
         assert "error" in json.loads(err.value.read())
 
 
+def test_non_object_json_body_is_400_not_500(server):
+    """Syntactically valid JSON of the wrong shape ([1,2], "x", 3, null)
+    is a client error — it must not reach req.get/translate_completions
+    and surface as an AttributeError 500 (ADVICE r4)."""
+    srv, _, _ = server
+    for path in ("/v1/generate", "/v1/completions"):
+        for body in (b"[1, 2]", b'"x"', b"3", b"null", b"true"):
+            req = urllib.request.Request(
+                srv.url + path, data=body,
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(req, timeout=30)
+            assert err.value.code == 400
+            assert "JSON object" in json.loads(err.value.read())["error"]
+
+
 def test_unknown_route_is_404(server):
     srv, _, _ = server
     with pytest.raises(urllib.error.HTTPError) as err:
