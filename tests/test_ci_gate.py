@@ -14,12 +14,13 @@ GATE = REPO / "ci" / "gate.py"
 
 def _run_gate(tmp_path, test_body: str):
     suite = tmp_path / "minisuite"
-    suite.mkdir()
+    suite.mkdir(exist_ok=True)
     (suite / "test_mini.py").write_text(test_body)
     status = tmp_path / "status.json"
     proc = subprocess.run(
         [sys.executable, str(GATE), "--tests", str(suite),
-         "--status-file", str(status), "-p", "no:cacheprovider"],
+         "--status-file", str(status), "--md-file",
+         str(tmp_path / "GATE.md"), "-p", "no:cacheprovider"],
         capture_output=True, text=True, timeout=120)
     return proc, json.loads(status.read_text())
 
@@ -47,6 +48,41 @@ def test_gate_passes_and_stamps_on_green(tmp_path):
         tmp_path, "def test_green():\n    assert True\n")
     assert proc.returncode == 0
     assert status["ok"] is True and status["passed"] == 1
-    # the stamp records which tree the gate ran on
+    # the stamp records which tree the gate ran on, and when
     assert status["commit"]
     assert "dirty" in status
+    assert status["completed_at"].endswith("Z")
+
+
+def test_gate_writes_committed_markdown_stamp(tmp_path):
+    """VERDICT r4 weak #7: CI_STATUS.json is gitignored, so the green-suite
+    claim never rode the snapshot. GATE.md is the committed half — same
+    facts, human-readable, verdict + commit + dirty + counts + time."""
+    for body, verdict in (
+            ("def test_green():\n    assert True\n", "GREEN"),
+            ("def test_red():\n    assert False\n", "RED")):
+        _, status = _run_gate(tmp_path, body)
+        md = (tmp_path / "GATE.md").read_text()
+        assert f"**{verdict}**" in md
+        assert status["commit"] in md
+        assert f"dirty: {str(status['dirty']).lower()}" in md
+        assert f"completed_at: {status['completed_at']}" in md
+
+
+def test_subset_run_does_not_write_default_gate_md(tmp_path):
+    """A partial-suite run must not clobber the committed full-suite
+    GATE.md claim: without --md-file, no markdown is written."""
+    suite = tmp_path / "minisuite"
+    suite.mkdir(exist_ok=True)
+    (suite / "test_mini.py").write_text("def test_g():\n    assert True\n")
+    before = (REPO / "GATE.md").read_text() \
+        if (REPO / "GATE.md").exists() else None
+    proc = subprocess.run(
+        [sys.executable, str(GATE), "--tests", str(suite),
+         "--status-file", str(tmp_path / "s.json"),
+         "-p", "no:cacheprovider"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    after = (REPO / "GATE.md").read_text() \
+        if (REPO / "GATE.md").exists() else None
+    assert after == before
