@@ -18,7 +18,7 @@ artifact instead of a convention:
   persist where the verdict is formed). Protocol: run the gate on a clean
   tree, then commit GATE.md by itself; a reader verifies the green-suite
   claim by checking GATE.md's recorded commit equals the PARENT of the
-  commit that introduced it and ``dirty`` is false — no 25-minute re-run;
+  commit that last modified it and ``dirty`` is false — no 25-min re-run;
 - the verdict is pytest's exit code, nothing else: 0 is green, everything
   else — failures (1), internal errors (3), usage errors (4), and EMPTY
   COLLECTION (5) — is red. Counts come from the junit XML report and are
@@ -93,8 +93,8 @@ def _write_md(md_path: Path, status: dict) -> None:
         "Written by `ci/gate.py` after a full-suite run; commit this file "
         "by itself immediately after the run. To verify the claim without "
         "re-running the suite: the `commit` below must be the PARENT of "
-        "the commit that introduced this file, and `dirty` must be "
-        "false.\n\n"
+        "the commit that last modified this file (`git log -1 -- "
+        "GATE.md`), and `dirty` must be false.\n\n"
         f"- verdict: **{verdict}** (pytest rc={status['returncode']})\n"
         f"- commit: `{status['commit'] or 'unknown'}`\n"
         f"- dirty: {str(status['dirty']).lower()}\n"
@@ -114,7 +114,8 @@ def run_gate(tests: str = "tests/", status_path: Path | None = None,
     # must not silently clobber it with a green verdict backed by a
     # handful of tests — subset runs only write markdown when the caller
     # names a destination explicitly
-    if md_path is None and tests == "tests/":
+    if md_path is None and \
+            Path(REPO / tests).resolve() == (REPO / "tests").resolve():
         md_path = REPO / "GATE.md"
     with tempfile.NamedTemporaryFile(suffix=".xml") as junit:
         cmd = [sys.executable, "-m", "pytest", tests, "-q",
