@@ -1,0 +1,293 @@
+"""/v1/chat/completions + chat templates (VERDICT r4 ask #4).
+
+The surface modern OpenAI SDK clients call by default: messages render
+through a configurable template (runtime/chat_template.py) to one model
+prompt; responses are chat.completion objects, streams are
+chat.completion.chunk deltas ending in [DONE]. Template goldens pin the
+rendering; the HTTP tests run over the real wire against the continuous
+engine, asserting parity with the native /v1/generate route on the
+rendered prompt.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.runtime.chat_template import (BUILTIN, ChatTemplate,
+                                                TokenizerChatTemplate,
+                                                load_template,
+                                                validate_messages)
+from kubeflow_tpu.runtime.server import ServingServer
+from kubeflow_tpu.runtime.serving import ContinuousBatchedGenerator
+from tests.test_serving_server import _word_tokenizer, model
+
+CONV = [{"role": "system", "content": "be terse"},
+        {"role": "user", "content": "hi"},
+        {"role": "assistant", "content": "hello"},
+        {"role": "user", "content": "bye"}]
+
+
+# ------------------------------------------------------- template goldens
+def test_role_tags_template_golden():
+    got = BUILTIN["role-tags"].render(CONV)
+    assert got == ("<|system|>\nbe terse\n"
+                   "<|user|>\nhi\n"
+                   "<|assistant|>\nhello\n"
+                   "<|user|>\nbye\n"
+                   "<|assistant|>\n")
+
+
+def test_chatml_template_golden():
+    got = BUILTIN["chatml"].render(CONV)
+    assert got == ("<|im_start|>system\nbe terse<|im_end|>\n"
+                   "<|im_start|>user\nhi<|im_end|>\n"
+                   "<|im_start|>assistant\nhello<|im_end|>\n"
+                   "<|im_start|>user\nbye<|im_end|>\n"
+                   "<|im_start|>assistant\n")
+
+
+def test_render_without_generation_prompt():
+    got = BUILTIN["role-tags"].render(CONV[:2], add_generation_prompt=False)
+    assert got.endswith("<|user|>\nhi\n")
+    assert not got.endswith("<|assistant|>\n")
+
+
+@pytest.mark.parametrize("bad", [
+    None, [], "hi", [{"role": "user"}],                 # missing content
+    [{"role": "user", "content": ""}],                  # empty content
+    [{"role": "user", "content": ["part"]}],            # multimodal parts
+    [{"role": "tool", "content": "result"}],            # model-specific
+    [{"role": "shout", "content": "x"}], ["x"],
+])
+def test_message_validation_is_loud(bad):
+    with pytest.raises(ValueError):
+        validate_messages(bad)
+
+
+def test_load_template_builtins_and_default():
+    assert load_template(None) is BUILTIN["role-tags"]
+    assert load_template("chatml") is BUILTIN["chatml"]
+
+
+def test_load_template_custom_json_file(tmp_path):
+    spec = tmp_path / "tmpl.json"
+    spec.write_text(json.dumps({
+        "name": "mini", "turn": "[{role}] {content}\n",
+        "generation_prompt": "[assistant] "}))
+    tmpl = load_template(str(spec))
+    assert isinstance(tmpl, ChatTemplate) and tmpl.name == "mini"
+    assert tmpl.render([{"role": "user", "content": "q"}]) == \
+        "[user] q\n[assistant] "
+
+
+@pytest.mark.parametrize("raw,hint", [
+    ("not json", "not valid JSON"),
+    ('["a"]', "must be an object"),
+    ('{"turn": "x"}', "must be an object with string"),
+    ('{"turn": "{nope}", "generation_prompt": ""}', "bad 'turn'"),
+])
+def test_load_template_bad_file_is_loud(tmp_path, raw, hint):
+    spec = tmp_path / "tmpl.json"
+    spec.write_text(raw)
+    with pytest.raises(ValueError, match=hint.replace("[", "\\[")):
+        load_template(str(spec))
+
+
+def test_load_template_missing_path_is_loud():
+    with pytest.raises(ValueError, match="neither a builtin"):
+        load_template("/nope/definitely-missing.json")
+
+
+def test_tokenizer_template_delegates_and_requires_support():
+    class HFish:
+        def apply_chat_template(self, messages, tokenize,
+                                add_generation_prompt):
+            assert tokenize is False
+            return f"custom:{len(messages)}:{add_generation_prompt}"
+    out = load_template("tokenizer", HFish()).render(CONV)
+    assert out == "custom:4:True"
+    with pytest.raises(ValueError, match="apply_chat_template"):
+        TokenizerChatTemplate(object())
+    with pytest.raises(ValueError, match="apply_chat_template"):
+        load_template("tokenizer", None)
+
+
+# ------------------------------------------------------- HTTP round trips
+def _post(url, path, payload):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def _post_expect_400(url, path, payload):
+    try:
+        _post(url, path, payload)
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+        return json.loads(e.read())["error"]
+    raise AssertionError("expected 400")
+
+
+@pytest.fixture()
+def chat_server(tmp_path):
+    params, cfg = model()
+    tok = _word_tokenizer(tmp_path)
+    gen = ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                     prefill_chunk=8)
+    srv = ServingServer(gen, cfg, port=0, tokenizer=tok,
+                        model_name="chat-model")
+    srv.start()
+    try:
+        yield srv, tok
+    finally:
+        srv.stop()
+
+
+MESSAGES = [{"role": "system", "content": "w1"},
+            {"role": "user", "content": "w2 w3"}]
+
+
+def test_chat_completion_shape_and_template_parity(chat_server):
+    """Non-stream chat: chat.completion object, assistant message, usage;
+    the content must equal what /v1/generate produces for the template-
+    rendered prompt — the template really is the only translation."""
+    srv, tok = chat_server
+    _, out = _post(srv.url, "/v1/chat/completions",
+                   {"model": "chat-model", "messages": MESSAGES,
+                    "max_tokens": 5, "temperature": 0})
+    assert out["object"] == "chat.completion"
+    assert out["id"].startswith("chatcmpl-")
+    [choice] = out["choices"]
+    assert choice["message"]["role"] == "assistant"
+    assert choice["finish_reason"] in ("length", "stop")
+    rendered = BUILTIN["role-tags"].render(MESSAGES)
+    n_prompt = len(tok.encode(rendered, add_special_tokens=False))
+    assert out["usage"]["prompt_tokens"] == n_prompt
+    assert out["usage"]["total_tokens"] == \
+        n_prompt + out["usage"]["completion_tokens"]
+    _, native = _post(srv.url, "/v1/generate",
+                      {"text": rendered, "max_new_tokens": 5})
+    assert choice["message"]["content"] == native["text"]
+
+
+def test_chat_streaming_chunks(chat_server):
+    """Streaming: chat.completion.chunk frames — role on the first
+    delta, content deltas concatenating to the non-stream content, an
+    empty final delta carrying finish_reason + usage, then [DONE]."""
+    srv, _ = chat_server
+    _, want = _post(srv.url, "/v1/chat/completions",
+                    {"messages": MESSAGES, "max_tokens": 5,
+                     "temperature": 0})
+    req = urllib.request.Request(
+        srv.url + "/v1/chat/completions",
+        data=json.dumps({"messages": MESSAGES, "max_tokens": 5,
+                         "temperature": 0, "stream": True}).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    frames = []
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        for raw in resp:
+            raw = raw.strip()
+            if raw.startswith(b"data: "):
+                frames.append(raw[6:])
+    assert frames[-1] == b"[DONE]"
+    chunks = [json.loads(f) for f in frames[:-1]]
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    assert chunks[0]["id"].startswith("chatcmpl-")
+    assert chunks[0]["choices"][0]["delta"]["role"] == "assistant"
+    assert all("role" not in c["choices"][0]["delta"]
+               for c in chunks[1:-1])
+    final = chunks[-1]["choices"][0]
+    assert final["delta"] == {}
+    assert final["finish_reason"] in ("length", "stop")
+    assert "usage" in chunks[-1]
+    text = "".join(c["choices"][0]["delta"].get("content", "")
+                   for c in chunks)
+    assert text == want["choices"][0]["message"]["content"]
+
+
+def test_chat_validation_is_loud(chat_server):
+    srv, _ = chat_server
+    err = _post_expect_400(srv.url, "/v1/chat/completions",
+                           {"model": "other", "messages": MESSAGES})
+    assert "not served here" in err
+    err = _post_expect_400(srv.url, "/v1/chat/completions",
+                           {"messages": MESSAGES,
+                            "tools": [{"type": "function"}]})
+    assert "tools" in err
+    err = _post_expect_400(srv.url, "/v1/chat/completions",
+                           {"messages": [{"role": "tool",
+                                          "content": "x"}]})
+    assert "role" in err
+    err = _post_expect_400(srv.url, "/v1/chat/completions", {})
+    assert "messages" in err
+
+
+def test_chat_max_completion_tokens_alias(chat_server):
+    srv, _ = chat_server
+    _, out = _post(srv.url, "/v1/chat/completions",
+                   {"messages": MESSAGES, "max_completion_tokens": 3,
+                    "temperature": 0})
+    assert out["usage"]["completion_tokens"] <= 3
+
+
+def test_chat_without_tokenizer_is_400():
+    params, cfg = model()
+    gen = ContinuousBatchedGenerator(params, cfg, n_slots=2)
+    with ServingServer(gen, cfg, port=0) as srv:
+        err = _post_expect_400(srv.url, "/v1/chat/completions",
+                               {"messages": MESSAGES})
+        assert "tokenizer" in err
+
+
+def test_chat_respects_configured_template(tmp_path):
+    """A server started with the chatml template renders chatml — pinned
+    by parity with /v1/generate on the chatml-rendered prompt."""
+    params, cfg = model()
+    tok = _word_tokenizer(tmp_path)
+    gen = ContinuousBatchedGenerator(params, cfg, n_slots=2)
+    with ServingServer(gen, cfg, port=0, tokenizer=tok,
+                       chat_template=BUILTIN["chatml"]) as srv:
+        _, out = _post(srv.url, "/v1/chat/completions",
+                       {"messages": MESSAGES, "max_tokens": 4,
+                        "temperature": 0})
+        rendered = BUILTIN["chatml"].render(MESSAGES)
+        _, native = _post(srv.url, "/v1/generate",
+                          {"text": rendered, "max_new_tokens": 4})
+        assert out["choices"][0]["message"]["content"] == native["text"]
+
+
+def test_tokenizer_template_conversation_rejection_is_valueerror():
+    """A jinja-style raise inside apply_chat_template (Llama/Mistral
+    templates reject non-alternating roles) is a CLIENT error → the HTTP
+    layer's ValueError→400 mapping must see ValueError, not the raw
+    TemplateError (which would 500)."""
+    class Strict:
+        def apply_chat_template(self, messages, tokenize,
+                                add_generation_prompt):
+            raise RuntimeError("roles must alternate")
+    tmpl = load_template("tokenizer", Strict())
+    with pytest.raises(ValueError, match="rejected the conversation"):
+        tmpl.render(CONV)
+
+
+def test_load_template_attribute_placeholder_is_loud(tmp_path):
+    spec = tmp_path / "tmpl.json"
+    spec.write_text(json.dumps({"turn": "{role.nope} {content}",
+                                "generation_prompt": ""}))
+    with pytest.raises(ValueError, match="bad 'turn'"):
+        load_template(str(spec))
+
+
+def test_completions_rejects_chat_only_max_completion_tokens(chat_server):
+    srv, _ = chat_server
+    err = _post_expect_400(srv.url, "/v1/completions",
+                           {"prompt": "w1", "max_tokens": 5,
+                            "max_completion_tokens": 1})
+    assert "max_completion_tokens" in err
