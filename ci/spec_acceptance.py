@@ -1,0 +1,253 @@
+"""Speculative-decoding acceptance dynamics: measured, not claimed.
+
+VERDICT r4 weak #4: the speculation implementation has exact parity pins
+but zero throughput evidence. This harness produces the
+acceptance→speedup curve on any backend (CPU by default — the dynamics
+are backend-independent facts about the algorithm; wall-clock speedups
+carry explicit backend provenance and are NOT TPU claims):
+
+- drafts at several agreement levels against one target: the target's
+  own weights (acceptance ≈ 1, the self-speculation ceiling), gaussian-
+  perturbed copies at increasing sigma (mid/low agreement), and an
+  independently-initialized model (chance-level agreement);
+- per level: measured acceptance rate (SpecStats accepted/drafted),
+  tokens emitted PER TARGET FORWARD (``N / blocks`` — the quantity
+  speculation exists to raise above decode's 1.0), and end-to-end
+  tokens/s of ``speculative_generate`` vs plain ``generate``;
+- the same sweep through BOTH serving engines (bucketed
+  ``BatchedGenerator`` draft mode and the continuous engine's per-tick
+  draft blocks), engine-vs-engine-without-draft.
+
+Output: one JSON document on stdout (plus a human table on stderr).
+Fold the numbers into PERF.md's speculation section.
+
+Run (CPU, ~2-4 min):          python ci/spec_acceptance.py
+Run on chip when live:        python ci/spec_acceptance.py --platform tpu
+Smoke (CI):                   python ci/spec_acceptance.py --smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+# CRITICAL ordering: this image pre-exports JAX_PLATFORMS=axon and
+# re-asserts it at interpreter startup; a "CPU" harness that skips the
+# explicit pin silently becomes a second tunnel client and wedges the
+# tunnel for every other process (round-4 lesson). Platform is resolved
+# BEFORE any jax import.
+
+
+def _pin_platform(platform: str) -> None:
+    os.environ["JAX_PLATFORMS"] = platform
+    import jax
+    jax.config.update("jax_platforms", platform)
+
+
+def _timed(fn, warm_args, reps: int) -> float:
+    """Seconds per call, first (compile) call excluded."""
+    import jax
+    jax.block_until_ready(fn(*warm_args))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(reps):
+        out = fn(*warm_args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps
+
+
+def run(platform: str, smoke: bool) -> dict:
+    _pin_platform(platform)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeflow_tpu.models.decode import generate
+    from kubeflow_tpu.models.speculative import speculative_generate
+    from kubeflow_tpu.models.transformer import (TransformerConfig,
+                                                 init_params)
+
+    if smoke:
+        config = TransformerConfig(vocab_size=256, d_model=64, n_layers=2,
+                                   n_heads=4, n_kv_heads=2, d_ff=128,
+                                   max_seq_len=128, dtype="float32")
+        B, P, N, K, reps = 2, 8, 16, 4, 1
+    else:
+        config = TransformerConfig(vocab_size=2048, d_model=256,
+                                   n_layers=4, n_heads=4, n_kv_heads=2,
+                                   d_ff=512, max_seq_len=512,
+                                   dtype="float32")
+        B, P, N, K, reps = 4, 32, 96, 4, 3
+
+    target = init_params(jax.random.key(0), config)
+
+    def perturbed(sigma: float) -> dict:
+        """Target + gaussian noise scaled per-leaf to sigma * leaf std:
+        the knob that dials draft/target agreement continuously."""
+        leaves, treedef = jax.tree.flatten(target)
+        keys = jax.random.split(jax.random.key(7), len(leaves))
+        noisy = [leaf + sigma * jnp.std(leaf)
+                 * jax.random.normal(k, leaf.shape, leaf.dtype)
+                 for k, leaf in zip(keys, leaves)]
+        return jax.tree.unflatten(treedef, noisy)
+
+    # the acceptance sweep uses SAME-SIZE drafts (perturbation dials
+    # agreement continuously; cost ratio pinned at 1.0 — the worst case:
+    # any real deployment's draft is cheaper). "small-random" is the
+    # realistic COST shape (a fraction of the target's FLOPs) at the
+    # acceptance FLOOR (random weights agree by chance): together the two
+    # axes bound the deployable operating curve.
+    import dataclasses
+    small_cfg = dataclasses.replace(
+        config, d_model=config.d_model // 2, d_ff=config.d_ff // 2,
+        n_layers=max(1, config.n_layers // 2))
+    drafts = [("identical", target, config),
+              ("perturbed-0.05", perturbed(0.05), config),
+              ("perturbed-0.2", perturbed(0.2), config),
+              ("independent", init_params(jax.random.key(99), config),
+               config),
+              ("small-random", init_params(jax.random.key(98), small_cfg),
+               small_cfg)]
+
+    prompts = jax.random.randint(jax.random.key(1), (B, P), 0,
+                                 config.vocab_size)
+
+    by_name = {n: (d, c) for n, d, c in drafts}
+    gen = jax.jit(lambda p, t: generate(p, t, config, N))
+    t_plain = _timed(gen, (target, prompts), reps)
+    plain_tok_s = B * N / t_plain
+    # greedy-parity reference, shared by every draft level below
+    want = np.asarray(gen(target, prompts))
+    # measured draft-cost ratio for the small draft: plain generate on
+    # the draft model vs the target (per-forward cost proxy)
+    gen_small = jax.jit(lambda p, t: generate(p, t, small_cfg, N))
+    t_small = _timed(gen_small, (by_name["small-random"][0], prompts),
+                     reps)
+    draft_cost_ratio = round(t_small / t_plain, 3)
+    sys.stderr.write(
+        f"plain generate: {plain_tok_s:,.0f} tok/s "
+        f"(B={B} N={N}, {platform}); small-draft cost ratio "
+        f"{draft_cost_ratio}\n"
+        f"{'draft':<16} {'accept':>7} {'tok/fwd':>8} {'tok/s':>10} "
+        f"{'vs plain':>8}\n")
+
+    levels = []
+    for name, draft, dcfg in drafts:
+        spec = jax.jit(lambda tp, dp, pr, dcfg=dcfg:
+                       speculative_generate(
+                           tp, dp, pr, config, dcfg, N, k=K))
+        ids, stats = spec(target, draft, prompts)
+        # correctness first: greedy speculation must equal plain greedy
+        assert (np.asarray(ids) == want).all(), \
+            f"{name}: speculative output diverged from generate"
+        t_spec = _timed(spec, (target, draft, prompts), reps)
+        drafted = float(np.asarray(stats.drafted).sum())
+        accepted = float(np.asarray(stats.accepted).sum())
+        blocks = float(np.asarray(stats.blocks))
+        level = {
+            "draft": name,
+            "acceptance_rate": round(accepted / max(drafted, 1), 4),
+            # what speculation buys: emitted tokens per target forward
+            # per sequence (plain decode is exactly 1.0)
+            "tokens_per_target_forward": round(N / max(blocks, 1), 3),
+            "target_forwards": int(blocks),
+            "tokens_per_sec": round(B * N / t_spec, 1),
+            "speedup_vs_plain": round(t_plain / t_spec, 3),
+        }
+        levels.append(level)
+        sys.stderr.write(
+            f"{name:<16} {level['acceptance_rate']:>7.2%} "
+            f"{level['tokens_per_target_forward']:>8.2f} "
+            f"{level['tokens_per_sec']:>10,.0f} "
+            f"{level['speedup_vs_plain']:>7.2f}x\n")
+
+    # ---- the same dynamics through both serving engines (end to end:
+    # submit -> future, includes engine scheduling + host loop)
+    from kubeflow_tpu.runtime.serving import (BatchedGenerator,
+                                              ContinuousBatchedGenerator)
+    M = 2 if smoke else 8
+    rng = np.random.default_rng(3)
+    reqs = [rng.integers(0, config.vocab_size, P).astype(np.int32)
+            for _ in range(M)]
+
+    def engine_toks(make_engine) -> float:
+        eng = make_engine()
+        try:
+            # warm at the EXACT timed shape: the engines compile per
+            # batch bucket / slot occupancy, and a compile landing inside
+            # the timed window swamps the measurement
+            for timed in (False, True):
+                t0 = time.perf_counter()
+                futs = [eng.submit(r, N) for r in reqs]
+                for f in futs:
+                    f.result(timeout=600)
+                if timed:
+                    return M * N / (time.perf_counter() - t0)
+        finally:
+            eng.close()
+
+    engines = {}
+    for label, cls, kw in (
+            ("bucketed", BatchedGenerator, {"max_batch": M}),
+            ("continuous", ContinuousBatchedGenerator, {"n_slots": M})):
+        base = engine_toks(lambda: cls(target, config, **kw))
+        with_draft = {}
+        for name in ("identical", "perturbed-0.2", "small-random"):
+            dp, dc = by_name[name]
+            toks = engine_toks(lambda: cls(
+                target, config, draft_params=dp, draft_config=dc,
+                spec_k=K, **kw))
+            with_draft[name] = {"tokens_per_sec": round(toks, 1),
+                                "speedup_vs_no_draft": round(toks / base,
+                                                             3)}
+            sys.stderr.write(
+                f"engine {label:<11} draft={name:<14} "
+                f"{toks:>10,.0f} tok/s ({toks / base:.2f}x vs no-draft)\n")
+        engines[label] = {"no_draft_tokens_per_sec": round(base, 1),
+                          "with_draft": with_draft}
+
+    return {"harness": "spec_acceptance",
+            "backend": platform,
+            "note": "acceptance dynamics are backend-independent; "
+                    "wall-clock lines are " + platform + " measurements",
+            "config": {"B": B, "P": P, "N": N, "k": K,
+                       "d_model": config.d_model,
+                       "n_layers": config.n_layers,
+                       "vocab": config.vocab_size},
+            "plain_generate_tokens_per_sec": round(plain_tok_s, 1),
+            "small_draft_cost_ratio": draft_cost_ratio,
+            "levels": levels,
+            "engines": engines,
+            "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                         time.gmtime())}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--platform", default="cpu",
+                    help="jax platform (default cpu — pinned explicitly; "
+                         "pass tpu/axon ONLY when the tunnel is live and "
+                         "no other TPU process is running)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes for CI (seconds, numbers "
+                         "meaningless)")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON document to this path")
+    args = ap.parse_args(argv)
+    doc = run(args.platform, args.smoke)
+    payload = json.dumps(doc, indent=1)
+    print(payload)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(payload + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

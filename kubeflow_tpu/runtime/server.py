@@ -452,11 +452,22 @@ class ServingServer:
              "tool_choice", "functions", "function_call",
              "response_format"))
         out = self._openai_sampling(req)
+        out["text"] = self.chat_template.render(req.get("messages"),
+                                                add_generation_prompt=True)
         if "max_completion_tokens" in req:
             # the chat surface's newer name wins over legacy max_tokens
             out["max_new_tokens"] = req["max_completion_tokens"]
-        out["text"] = self.chat_template.render(req.get("messages"),
-                                                add_generation_prompt=True)
+        elif "max_tokens" not in req:
+            # chat clients routinely omit the budget (OpenAI's chat
+            # surface generates to the limit by default) — the legacy
+            # completions default of 16 would silently truncate, and a
+            # fixed large default would 400 on short-context models; do
+            # what OpenAI does: generate to the context limit (capped at
+            # 256 so an omitted budget can't monopolize engine slots)
+            n_prompt = len(self.tokenizer.encode(
+                out["text"], add_special_tokens=False))
+            out["max_new_tokens"] = max(
+                1, min(256, self.config.max_seq_len - n_prompt))
         return out
 
     def _envelope(self, prefix: str, obj: str) -> dict:

@@ -291,3 +291,17 @@ def test_completions_rejects_chat_only_max_completion_tokens(chat_server):
                            {"prompt": "w1", "max_tokens": 5,
                             "max_completion_tokens": 1})
     assert "max_completion_tokens" in err
+
+
+def test_chat_omitted_budget_generates_to_context_limit(chat_server):
+    """A chat client omitting max_tokens must NOT get the legacy
+    16-token truncation or a 400 on short-context models: the default is
+    the remaining context (capped at 256), like OpenAI's surface."""
+    srv, tok = chat_server
+    _, out = _post(srv.url, "/v1/chat/completions",
+                   {"messages": MESSAGES, "temperature": 0})
+    rendered = BUILTIN["role-tags"].render(MESSAGES)
+    n_prompt = len(tok.encode(rendered, add_special_tokens=False))
+    # test model max_seq_len=48: the budget fills the context exactly
+    assert out["usage"]["completion_tokens"] == 48 - n_prompt
+    assert out["choices"][0]["finish_reason"] == "length"

@@ -1,0 +1,48 @@
+"""The speculative acceptance-dynamics harness (ci/spec_acceptance.py)
+is itself under test: a smoke run must produce the JSON contract PERF.md
+cites, with the acceptance curve behaving the way the algorithm
+guarantees (identical draft accepts everything; agreement decays with
+perturbation; tokens-per-target-forward >= 1 always)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+HARNESS = REPO / "ci" / "spec_acceptance.py"
+
+
+@pytest.mark.slow
+def test_smoke_run_contract(tmp_path):
+    out = tmp_path / "spec.json"
+    proc = subprocess.run(
+        [sys.executable, str(HARNESS), "--smoke", "--out", str(out)],
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(out.read_text())
+    assert doc["backend"] == "cpu"
+    levels = {lv["draft"]: lv for lv in doc["levels"]}
+    assert set(levels) == {"identical", "perturbed-0.05", "perturbed-0.2",
+                           "independent", "small-random"}
+    # the algorithm's guarantees, measured: self-speculation accepts all
+    assert levels["identical"]["acceptance_rate"] == 1.0
+    # agreement decays monotonically with perturbation
+    assert levels["identical"]["acceptance_rate"] > \
+        levels["perturbed-0.05"]["acceptance_rate"] > \
+        levels["perturbed-0.2"]["acceptance_rate"] >= \
+        levels["independent"]["acceptance_rate"]
+    # a rejected block still emits the verify window's bonus token
+    for lv in doc["levels"]:
+        assert lv["tokens_per_target_forward"] >= 1.0
+        assert lv["tokens_per_sec"] > 0
+    # the small draft really is cheaper per forward
+    assert 0 < doc["small_draft_cost_ratio"] < 1.0
+    # both engines measured, with and without a draft
+    for eng in ("bucketed", "continuous"):
+        entry = doc["engines"][eng]
+        assert entry["no_draft_tokens_per_sec"] > 0
+        assert set(entry["with_draft"]) == {"identical", "perturbed-0.2",
+                                            "small-random"}
