@@ -748,11 +748,12 @@ class ServingServer:
 def build_generator(params, config, args, draft=None):
     from .serving import BatchedGenerator, ContinuousBatchedGenerator
     if args.engine == "bucketed":
-        if args.kv_quant or args.eos_id >= 0:
+        if args.kv_quant or args.eos_id >= 0 or \
+                getattr(args, "steps_per_sync", 1) > 1:
             # refuse rather than silently ignore: the operator asked for
             # behavior this engine does not implement
-            raise SystemExit("--kv-quant/--eos-id require "
-                             "--engine continuous")
+            raise SystemExit("--kv-quant/--eos-id/--steps-per-sync "
+                             "require --engine continuous")
         kw = {}
         if draft is not None:
             kw = dict(draft_params=draft[0], draft_config=draft[1],
@@ -770,6 +771,7 @@ def build_generator(params, config, args, draft=None):
     return ContinuousBatchedGenerator(
         params, config, n_slots=args.slots, quantize=args.quantize,
         kv_quant=args.kv_quant,
+        steps_per_sync=getattr(args, "steps_per_sync", 1),
         eos_id=args.eos_id if args.eos_id >= 0 else None, **kw)
 
 
@@ -794,6 +796,11 @@ def main(argv=None) -> int:
                     help="int8 weight-only serving")
     ap.add_argument("--kv-quant", action="store_true",
                     help="int8 KV cache (continuous engine)")
+    ap.add_argument("--steps-per-sync", type=int, default=1,
+                    help="decode steps per host round-trip (continuous "
+                         "engine): >1 amortizes scheduler latency at the "
+                         "cost of token-burst streaming; admissions "
+                         "always drop back to single-step")
     ap.add_argument("--eos-id", type=int, default=-1)
     ap.add_argument("--draft-config", default=None,
                     help="JSON TransformerConfig for a speculative draft "

@@ -352,10 +352,17 @@ class ContinuousBatchedGenerator:
       rows). The final (possibly partial) chunk always computes fresh so
       the splice has real last-token logits;
     - generated ids accumulate in a device-side (slots, cap) buffer;
-      the host reads a row back only at completion. The per-step host
-      sync is ONE packed (3, slots) int32 readback (n_out / done /
-      sampled ids fused in _step_jit) — a single tunnel round-trip per
-      token, sized so the decode matmuls dominate;
+      the host reads a row back only at completion. The per-sync host
+      traffic is ONE packed (n_steps, 4, slots) int32 readback (n_out /
+      done / sampled ids / emit mask fused in _steps_jit) — a single
+      round-trip per ``steps_per_sync`` tokens. With the default
+      ``steps_per_sync=1`` every token boundary reaches the host (lowest
+      streaming/admission latency); raising it runs that many decode
+      steps per dispatch via ``lax.scan``, the first-order throughput
+      lever when scheduler↔device latency is ~ms (the axon tunnel) —
+      the loop drops back to single-step whenever a request is admitting
+      or queued, bounding the admission cost of batching to at most one
+      in-flight scan (a submit landing mid-dispatch waits ≤ S steps);
     - free slots run the step as masked dummy rows (static shapes; the
       idle-row compute is the price of never recompiling).
 
@@ -372,6 +379,7 @@ class ContinuousBatchedGenerator:
                  quantize: bool = False, kv_quant: bool = False,
                  eos_id: int | None = None, pad_id: int = 0,
                  prefill_chunk: int = 256, prefix_cache_chunks: int = 64,
+                 steps_per_sync: int = 1,
                  draft_params=None, draft_config=None, spec_k: int = 4,
                  spec_exact_only: bool = True):
         if quantize:
@@ -383,6 +391,16 @@ class ContinuousBatchedGenerator:
         if prefix_cache_chunks < 0:
             raise ValueError(f"prefix_cache_chunks must be >= 0, "
                              f"got {prefix_cache_chunks}")
+        if steps_per_sync < 1:
+            raise ValueError(f"steps_per_sync must be >= 1, "
+                             f"got {steps_per_sync}")
+        if steps_per_sync > 1 and draft_params is not None:
+            # the speculative tick is already a multi-token block per
+            # host sync; stacking the two schedulers would multiply
+            # admission latency for no modeled gain
+            raise ValueError("steps_per_sync > 1 is not supported "
+                             "together with a draft model")
+        self.steps_per_sync = steps_per_sync
         # continuous speculation: every tick runs a k-token draft block +
         # ONE verify window for all rows (models/speculative.py
         # propose_and_verify), rows advancing 1..k+1 tokens at their own
@@ -595,7 +613,7 @@ class ContinuousBatchedGenerator:
     @staticmethod
     @partial(jax.jit, donate_argnums=(0, 1))
     def _splice_jit(state, row_cache, last_logits, slot, real_len,
-                    temp, top_k, top_p):
+                    temp, top_k, top_p, target):
         """Install a completed admission: splice the row cache into
         ``slot``'s row of the engine cache and arm the row. One compile
         total — chunking already erased the prompt-length shape. The old
@@ -623,6 +641,11 @@ class ContinuousBatchedGenerator:
             "temp": state["temp"].at[slot32].set(temp),
             "top_k": state["top_k"].at[slot32].set(top_k),
             "top_p": state["top_p"].at[slot32].set(top_p),
+            # per-row token budget: the multi-step tick freezes a row on
+            # device the step it fills its budget (host collection still
+            # happens at the sync boundary)
+            "target": state["target"].at[slot32].set(
+                jnp.asarray(target, jnp.int32)),
         }
 
     @staticmethod
@@ -736,40 +759,58 @@ class ContinuousBatchedGenerator:
         return new_state, {**dstate, "cache": d_cache}, flags
 
     @staticmethod
-    @partial(jax.jit, static_argnames=("config", "eos_id", "pad_id"))
-    def _step_jit(params, state, key, config, eos_id, pad_id):
-        """One engine tick: sample a token for every active row from the
-        carried logits, record it, and run one decode step at per-row
-        positions. Inactive rows ride along masked."""
+    @partial(jax.jit,
+             static_argnames=("config", "eos_id", "pad_id", "n_steps"))
+    def _steps_jit(params, state, key, config, eos_id, pad_id,
+                   n_steps=1):
+        """``n_steps`` engine ticks in ONE dispatch + ONE readback.
+
+        Per step, a row EMITS iff it is armed, not EOS-done, and under
+        its token budget — a row finishing mid-scan freezes on device
+        (pad token, carried logits, frozen pos) until the host collects
+        it at the sync boundary. With ``n_steps=1`` this is exactly the
+        classic tick (collection frees finished rows at the same sync,
+        so every occupied row emits). With ``n_steps>1`` the host pays
+        one round-trip per n_steps tokens — the first-order lever when
+        the scheduler↔device latency is ~ms (the axon tunnel) or the
+        host loop is slow relative to a decode step.
+
+        The packed flags buffer is (n_steps, 4, slots) int32 —
+        [n_out, done, token, emitted] per step — one readback total;
+        ``emitted`` tells the streaming path which tokens are real
+        without any per-row host state."""
         from ..models.decode import decode_step, sample_token
-        active = state["active"]
-        token = sample_token(state["logits"], key, state["temp"],
-                             state["top_k"], state["top_p"])
-        if eos_id is not None:
-            token = jnp.where(state["done"], jnp.int32(pad_id), token)
-        token = jnp.where(active, token, jnp.int32(pad_id))
-        rows = jnp.arange(token.shape[0])
-        out = state["out"].at[rows, state["n_out"]].set(
-            jnp.where(active, token, state["out"][rows, state["n_out"]]))
-        n_out = state["n_out"] + active.astype(jnp.int32)
-        done = state["done"]
-        if eos_id is not None:
-            done = done | (active & (token == eos_id))
-        logits, cache = decode_step(params, state["cache"], token,
-                                    state["pos"], config)
-        # inactive rows keep their carried logits; their cache writes land
-        # at their stale pos but are never read (mask is per-row)
-        logits = jnp.where(active[:, None], logits, state["logits"])
-        pos = state["pos"] + active.astype(jnp.int32)
-        # everything the host needs per tick rides ONE packed (3, slots)
-        # buffer — n_out, done, and the sampled tokens — so the scheduler
-        # pays a single device→host round-trip per token instead of three
-        # (over the axon tunnel each readback is ~ms; at decode step times
-        # of a few ms, separate readbacks would dominate the step)
-        flags = jnp.stack([n_out, done.astype(jnp.int32), token])
-        return {**state, "cache": cache, "logits": logits, "pos": pos,
-                "active": active, "done": done, "out": out,
-                "n_out": n_out}, flags
+
+        def body(state, key):
+            emit = state["active"] & ~state["done"] & \
+                (state["n_out"] < state["target"])
+            token = sample_token(state["logits"], key, state["temp"],
+                                 state["top_k"], state["top_p"])
+            token = jnp.where(emit, token, jnp.int32(pad_id))
+            rows = jnp.arange(token.shape[0])
+            out = state["out"].at[rows, state["n_out"]].set(
+                jnp.where(emit, token,
+                          state["out"][rows, state["n_out"]]))
+            n_out = state["n_out"] + emit.astype(jnp.int32)
+            done = state["done"]
+            if eos_id is not None:
+                done = done | (emit & (token == eos_id))
+            logits, cache = decode_step(params, state["cache"], token,
+                                        state["pos"], config)
+            # frozen/inactive rows keep their carried logits; their cache
+            # writes land at their frozen pos but are never read (the row
+            # is re-spliced before its slot serves again)
+            logits = jnp.where(emit[:, None], logits, state["logits"])
+            pos = state["pos"] + emit.astype(jnp.int32)
+            flags = jnp.stack([n_out, done.astype(jnp.int32), token,
+                               emit.astype(jnp.int32)])
+            return ({**state, "cache": cache, "logits": logits,
+                     "pos": pos, "done": done, "out": out,
+                     "n_out": n_out}, flags)
+
+        state, flags = lax.scan(body, state,
+                                jax.random.split(key, n_steps))
+        return state, flags
 
     # -------------------------------------------------------------- engine
     def _free_slots(self) -> list[int]:
@@ -904,7 +945,8 @@ class ContinuousBatchedGenerator:
                     self._state = self._splice_jit(
                         self._state, adm.row_cache, adm.last_logits,
                         slot, adm.real_len, jnp.float32(req.temperature),
-                        jnp.int32(req.top_k), jnp.float32(req.top_p))
+                        jnp.int32(req.top_k), jnp.float32(req.top_p),
+                        jnp.int32(req.max_new_tokens))
                 else:
                     self._key, sub = jax.random.split(self._key)
                     self._state, self._dstate, first = \
@@ -960,14 +1002,17 @@ class ContinuousBatchedGenerator:
             self._dstate = {"cache": init_kv_cache(self.draft[1],
                                                    self.n_slots)}
 
-    def _emit_tokens(self, ids: np.ndarray) -> None:
-        """Deliver this step's sampled ids (already on host via the packed
-        flags readback) to streaming requests. A raising callback loses its
-        own stream, never the engine loop. Every slot holding a request is
-        active (collection frees done rows at the same tick they finish),
-        so each such row sampled a real token this step."""
+    def _emit_tokens(self, ids: np.ndarray,
+                     emitted: np.ndarray) -> None:
+        """Deliver one step's sampled ids (already on host via the packed
+        flags readback) to streaming requests. A raising callback loses
+        its own stream, never the engine loop. ``emitted`` is the
+        device's per-row emit mask for this step — under multi-step
+        scheduling a row frozen mid-scan (EOS/budget) samples only pad
+        filler afterwards, which must not reach the stream."""
         for i, slot in enumerate(self._slots):
-            if slot.req is not None and not slot.prefilling \
+            if emitted[i] and slot.req is not None \
+                    and not slot.prefilling \
                     and slot.req.on_token is not None:
                 try:
                     slot.req.on_token(int(ids[i]))
@@ -1060,16 +1105,26 @@ class ContinuousBatchedGenerator:
             try:
                 self._key, sub = jax.random.split(self._key)
                 if self.draft is None:
-                    self._state, flags = self._step_jit(
+                    # multi-step scheduling: amortize the host round-trip
+                    # over n_steps tokens — but drop to single-step while
+                    # anything is admitting or queued, so batching never
+                    # costs admission latency
+                    steps = self.steps_per_sync
+                    if steps > 1 and (self._admitting
+                                      or not self._queue.empty()):
+                        steps = 1
+                    self._state, flags = self._steps_jit(
                         self.params, self._state, sub, self.config,
-                        self.eos_id, self.pad_id)
-                    self.steps_total += 1
-                    # ONE host sync per tick: the packed (3, slots) buffer
+                        self.eos_id, self.pad_id, n_steps=steps)
+                    self.steps_total += steps
+                    # ONE host sync for all `steps` ticks: the packed
+                    # (steps, 4, slots) buffer
                     host = np.asarray(flags)
                     # stream BEFORE collection so every token is delivered
                     # before the request's future resolves
-                    self._emit_tokens(host[2])
-                    self._collect_finished(host[0], host[1] != 0)
+                    for s in range(host.shape[0]):
+                        self._emit_tokens(host[s, 2], host[s, 3] != 0)
+                    self._collect_finished(host[-1, 0], host[-1, 1] != 0)
                 else:
                     self._state, self._dstate, flags = self._spec_tick_jit(
                         self.params, self.draft[0], self._state,

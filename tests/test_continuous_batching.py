@@ -190,3 +190,88 @@ def test_many_concurrent_submitters():
     assert len(outs) == 12
     for i, p in enumerate(ps):
         np.testing.assert_array_equal(outs[i], _direct(params, cfg, p, 6))
+
+
+# -------------------------------------------------- multi-step scheduling
+def test_steps_per_sync_matches_direct_generate():
+    """steps_per_sync>1 runs S decode steps per host round-trip via
+    lax.scan — greedy outputs must be token-identical to steps_per_sync=1
+    and to direct generate (same executables, same carried logits)."""
+    params, cfg = model()
+    ps = prompts(3, seed=21)
+    want = [_direct(params, cfg, p, 9) for p in ps]
+    with ContinuousBatchedGenerator(params, cfg, n_slots=4, max_new_cap=16,
+                                    steps_per_sync=4) as gen:
+        futs = [gen.submit(p, 9) for p in ps]
+        got = [f.result(timeout=60) for f in futs]
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(g, w)
+
+
+def test_steps_per_sync_mixed_budgets_freeze_mid_scan():
+    """A row filling its budget mid-scan freezes on device: its result
+    is exactly its budget's tokens while longer rows keep decoding."""
+    params, cfg = model()
+    ps = prompts(2, seed=22)
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2, max_new_cap=16,
+                                    steps_per_sync=8) as gen:
+        f_short = gen.submit(ps[0], 2)
+        f_long = gen.submit(ps[1], 13)
+        short, long = f_short.result(60), f_long.result(60)
+    np.testing.assert_array_equal(short, _direct(params, cfg, ps[0], 2))
+    np.testing.assert_array_equal(long, _direct(params, cfg, ps[1], 13))
+
+
+def test_steps_per_sync_eos_mid_scan_pads_and_stops_stream():
+    """EOS landing mid-scan: the frozen row's pad filler must reach
+    neither the result tail nor the token stream."""
+    params, cfg = model()
+    p = prompts(1, seed=11)[0]
+    ref = _direct(params, cfg, p, 8)
+    eos = int(ref[2])
+    want = _direct(params, cfg, p, 8, eos_id=eos, pad_id=0)
+    streamed = []
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2, max_new_cap=16,
+                                    steps_per_sync=8, eos_id=eos,
+                                    pad_id=0) as gen:
+        got = gen.submit(p, 8, on_token=streamed.append).result(60)
+    np.testing.assert_array_equal(got, want)
+    # token events stop AT the EOS (SSE contract): 3 real tokens
+    assert streamed == [int(t) for t in want[:3]]
+
+
+def test_steps_per_sync_streaming_order_and_count():
+    params, cfg = model()
+    p = prompts(1, seed=23)[0]
+    streamed = []
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2, max_new_cap=16,
+                                    steps_per_sync=4) as gen:
+        got = gen.submit(p, 10, on_token=streamed.append).result(60)
+    assert streamed == [int(t) for t in got]
+
+
+def test_steps_per_sync_late_admission_still_joins():
+    """The loop drops to single-step while requests are queued/admitting,
+    so a late arrival joins a running multi-step batch promptly and both
+    results stay exact."""
+    params, cfg = model()
+    ps = prompts(2, seed=24)
+    with ContinuousBatchedGenerator(params, cfg, n_slots=2, max_new_cap=32,
+                                    steps_per_sync=8,
+                                    prefill_chunk=4) as gen:
+        f1 = gen.submit(ps[0], 24)
+        time.sleep(0.05)  # f1 is mid-generation
+        f2 = gen.submit(ps[1], 6)
+        r1, r2 = f1.result(60), f2.result(60)
+        assert gen.admitted_while_running >= 1
+    np.testing.assert_array_equal(r1, _direct(params, cfg, ps[0], 24))
+    np.testing.assert_array_equal(r2, _direct(params, cfg, ps[1], 6))
+
+
+def test_steps_per_sync_validation():
+    params, cfg = model()
+    with pytest.raises(ValueError, match="steps_per_sync"):
+        ContinuousBatchedGenerator(params, cfg, steps_per_sync=0)
+    with pytest.raises(ValueError, match="draft"):
+        ContinuousBatchedGenerator(params, cfg, steps_per_sync=2,
+                                   draft_params=params, draft_config=cfg)
