@@ -38,7 +38,8 @@ def test_smoke_run_contract(tmp_path):
     for lv in doc["levels"]:
         assert lv["tokens_per_target_forward"] >= 1.0
         assert lv["tokens_per_sec"] > 0
-    # the small draft really is cheaper per forward
+    # the small draft really is cheaper per forward (~0.4 measured; the
+    # harness times min-of-reps, which holds under a contended CI box)
     assert 0 < doc["small_draft_cost_ratio"] < 1.0
     # both engines measured, with and without a draft
     for eng in ("bucketed", "continuous"):

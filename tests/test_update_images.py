@@ -1,0 +1,114 @@
+"""The scheduled image re-pinner (ci/update_images.py) — analog of the
+reference's images-updater bot. Pin-state audit, release-record restamp,
+and non-image parameter preservation are pinned here; the engine-backed
+--resolve path needs a registry and is exercised only by the workflow."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+SCRIPT = REPO / "ci" / "update_images.py"
+
+PINNED = ("kubeflow-tpu-notebook-controller="
+          "reg.example/nc@sha256:" + "a" * 64 + "\n"
+          "tpu-notebook-image=reg.example/nb@sha256:" + "b" * 64 + "\n"
+          "auth-proxy-image=reg.example/proxy:v1.2.3\n"
+          "notebook-gateway-name=data-science-gateway\n")
+
+
+def _run(tmp_path, params_text, *args):
+    params = tmp_path / "params.env"
+    params.write_text(params_text)
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT), "--params", str(params),
+         "--no-manifests", *args],
+        capture_output=True, text=True, timeout=60)
+    return proc, json.loads(proc.stdout), params
+
+
+def test_check_green_on_fully_floating_dev_tree(tmp_path):
+    """The committed dev params.env floats on :latest everywhere — the
+    EXPECTED pre-release state, so the weekly audit stays green (the
+    reference's bot PRs refreshed pins, it doesn't fail the world)."""
+    proc, doc, _ = _run(
+        tmp_path,
+        (REPO / "config/manager/params.env").read_text(), "--check")
+    assert proc.returncode == 0 and doc["ok"] is True
+    assert set(doc["unpinned"]) == {"kubeflow-tpu-notebook-controller",
+                                    "tpu-notebook-image",
+                                    "auth-proxy-image"}
+
+
+def test_check_red_on_mixed_pinning_and_strict_mode(tmp_path):
+    mixed = ("kubeflow-tpu-notebook-controller="
+             "reg.example/nc@sha256:" + "a" * 64 + "\n"
+             "tpu-notebook-image=reg.example/nb:latest\n"
+             "auth-proxy-image=reg.example/proxy:latest\n")
+    proc, doc, _ = _run(tmp_path, mixed, "--check")
+    # one digest + floating siblings = the drift the bot exists to catch
+    assert proc.returncode == 1 and doc["ok"] is False
+    # strict mode: a fully-floating tree is red too (release branches)
+    proc2, doc2, _ = _run(
+        tmp_path,
+        (REPO / "config/manager/params.env").read_text(),
+        "--check", "--require-pinned")
+    assert proc2.returncode == 1 and doc2["ok"] is False
+    # a key vanishing is always red
+    proc3, doc3, _ = _run(
+        tmp_path, "notebook-gateway-name=g\n", "--check")
+    assert proc3.returncode == 1 and "MISSING" in str(doc3["entries"])
+
+
+def test_check_passes_on_pinned_entries(tmp_path):
+    proc, doc, _ = _run(tmp_path, PINNED, "--check")
+    assert proc.returncode == 0 and doc["ok"] is True
+    states = {e["key"]: e["state"] for e in doc["entries"]}
+    assert states["kubeflow-tpu-notebook-controller"] == "digest"
+    assert states["auth-proxy-image"] == "tag"   # versioned tag passes
+
+
+def test_resolve_from_release_restamps_and_preserves_params(tmp_path):
+    release = tmp_path / "RELEASE.json"
+    new_ref = "reg.example/nc@sha256:" + "c" * 64
+    release.write_text(json.dumps({"images": {
+        "kubeflow-tpu-notebook-controller": {"ref": new_ref}}}))
+    proc, doc, params = _run(
+        tmp_path,
+        (REPO / "config/manager/params.env").read_text(),
+        "--resolve", "--from-release", str(release))
+    assert doc["updated"] == ["kubeflow-tpu-notebook-controller"]
+    text = params.read_text()
+    assert new_ref in text
+    # non-image parameters survive the restamp untouched
+    assert "notebook-gateway-name=data-science-gateway" in text
+    # entries the release record does not cover stay reported unpinned
+    assert "tpu-notebook-image" in doc["unpinned"]
+    assert proc.returncode == 1  # still-unpinned entries keep it red
+
+
+def test_resolve_without_engine_or_release_is_loud(tmp_path):
+    import shutil
+    if shutil.which("docker") or shutil.which("podman"):
+        import pytest
+        pytest.skip("container engine present: the loud-failure branch "
+                    "is unreachable")
+    params = tmp_path / "params.env"
+    params.write_text("tpu-notebook-image=reg.example/nb:latest\n")
+    proc = subprocess.run(
+        [sys.executable, str(SCRIPT), "--params", str(params),
+         "--no-manifests", "--resolve"],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode != 0
+    assert "container engine" in proc.stderr
+
+
+def test_require_pinned_rejects_versioned_tags(tmp_path):
+    """Strict mode means DIGESTS: a versioned tag is still a mutable
+    reference and must fail a release-branch gate."""
+    proc, doc, _ = _run(tmp_path, PINNED, "--check", "--require-pinned")
+    assert proc.returncode == 1 and doc["ok"] is False
+    # ...while the default audit accepts it (consistent, all referenced)
+    proc2, doc2, _ = _run(tmp_path, PINNED, "--check")
+    assert proc2.returncode == 0
