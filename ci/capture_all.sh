@@ -54,6 +54,10 @@ run numerics      "$PYTHON" ci/tpu_numerics.py
 run ctx_sweep     "$PYTHON" ci/tpu_ctx_sweep.py
 run mfu_ab        "$PYTHON" ci/tpu_mfu_ab.py
 run bench         "$PYTHON" bench.py --missing-first
+# on-chip acceptance dynamics (CPU curve exists; this adds the hardware
+# wall-clock columns) — LAST: everything above it has no CPU fallback
+run spec_accept   "$PYTHON" ci/spec_acceptance.py --platform axon \
+                  --out SPEC_ACCEPTANCE_TPU.json
 
 echo "capture: done ($FAILS stage failures). Post-process:"
 echo "  - BENCH_TPU_LAST_GOOD.json refreshed automatically by bench.py"

@@ -55,13 +55,20 @@ def _timed(fn, warm_args, reps: int) -> float:
     first (compile) call excluded. Min-of-reps is the contention-robust
     estimator — a background process stealing cores inflates some reps,
     never deflates one (observed: the CI smoke's draft-cost ratio flaked
-    under a concurrent full-suite run with mean-based timing)."""
+    under a concurrent full-suite run with mean-based timing). Timing
+    anchors on a device→host READBACK of the first output leaf, not
+    block_until_ready — on the axon TPU backend block_until_ready
+    returns before execution completes (bench.py methodology)."""
     import jax
-    jax.block_until_ready(fn(*warm_args))
+    import numpy as np
+
+    def sync(out):
+        np.asarray(jax.tree.leaves(out)[0])
+    sync(fn(*warm_args))
     best = float("inf")
     for _ in range(reps):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*warm_args))
+        sync(fn(*warm_args))
         best = min(best, time.perf_counter() - t0)
     return best
 

@@ -36,10 +36,26 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(REPO))
 
-# every params.env entry that names a container image (the rest are
-# gateway/namespace parameters the updater must never touch)
-IMAGE_KEYS = ("kubeflow-tpu-notebook-controller", "tpu-notebook-image",
-              "auth-proxy-image")
+def _release_module():
+    """ci/release.py loaded by path, once (ci/ is scripts, not a
+    package) — the release pipeline is the single source of truth for
+    which params.env keys are first-party images and how engines are
+    discovered."""
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "ci_release", REPO / "ci" / "release.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_RELEASE = _release_module()
+
+# every params.env entry that names a container image: the first-party
+# images the release pipeline builds (release.IMAGES) plus the
+# third-party sidecar. The rest of params.env (gateway/namespace
+# parameters) the updater must never touch.
+IMAGE_KEYS = (*_RELEASE.IMAGES, "auth-proxy-image")
 
 
 def _pin_state(ref: str) -> str:
@@ -50,14 +66,7 @@ def _pin_state(ref: str) -> str:
 
 
 def _engine() -> str | None:
-    # one engine-discovery definition, shared with the release pipeline
-    # (ci/ is scripts, not a package — load by path)
-    import importlib.util
-    spec = importlib.util.spec_from_file_location(
-        "ci_release", REPO / "ci" / "release.py")
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod.find_engine()
+    return _RELEASE.find_engine()
 
 
 def _resolve_digest(engine: str, ref: str) -> str | None:
@@ -91,6 +100,7 @@ def run(check: bool, from_release: str | None,
     release = None
     if from_release:
         release = json.loads(Path(from_release).read_text())
+    engine = _engine() if not check and release is None else None
     for key in IMAGE_KEYS:
         ref = params.get(key)
         if ref is None:
@@ -103,7 +113,6 @@ def run(check: bool, from_release: str | None,
                 rel = release.get("images", {}).get(key)
                 new = rel.get("ref") if rel else None
             else:
-                engine = _engine()
                 if engine is None:
                     raise SystemExit(
                         "--resolve needs a container engine or "
