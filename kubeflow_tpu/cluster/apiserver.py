@@ -30,7 +30,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from ..utils import k8s
+from ..utils import k8s, names
 from . import restmapper
 from .errors import ApiError, NotFoundError
 from .store import WatchEvent
@@ -64,15 +64,19 @@ def _status_body(code: int, reason: str, message: str) -> bytes:
 
 
 class _Route:
-    """A parsed request path: which mapping, namespace, name, subresource."""
+    """A parsed request path: which mapping, namespace, name, subresource.
+    ``tail`` holds the path segments AFTER the subresource — the proxy
+    subresource forwards them to the backend."""
 
     def __init__(self, mapping: restmapper.RestMapping,
                  namespace: str | None, name: str | None,
-                 subresource: str | None) -> None:
+                 subresource: str | None,
+                 tail: tuple[str, ...] = ()) -> None:
         self.mapping = mapping
         self.namespace = namespace
         self.name = name
         self.subresource = subresource
+        self.tail = tail
 
 
 def _parse_path(path: str) -> _Route | None:
@@ -104,7 +108,8 @@ def _parse_path(path: str) -> _Route | None:
         return None
     name = rest[0] if rest else None
     subresource = rest[1] if len(rest) > 1 else None
-    return _Route(mapping, namespace, name, subresource)
+    return _Route(mapping, namespace, name, subresource,
+                  tuple(rest[2:]))
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -194,7 +199,25 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_status(404, "NotFound",
                                     f"unrecognized path {parsed.path}")
             return
+        if route.subresource == "proxy" and method != "GET":
+            # the probes this facade serves are GETs; refusing the rest
+            # loudly beats misrouting them into the REST verbs. Drain
+            # the unread body first: on a keep-alive connection stale
+            # body bytes would be parsed as the NEXT request line.
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                length = 0
+            if length > 0:
+                self.rfile.read(length)
+            self._send_error_status(405, "MethodNotAllowed",
+                                    "the service proxy forwards GET only")
+            return
         query = {key: vals[-1] for key, vals in parse_qs(parsed.query).items()}
+        # the proxy subresource forwards the RAW query string verbatim
+        # (parse_qs collapses duplicate keys — fine for list options,
+        # wrong for a passthrough)
+        self._raw_query = parsed.query
         try:
             getattr(self, f"_handle_{method}")(route, query)
         except ApiError as err:
@@ -214,9 +237,110 @@ class _Handler(BaseHTTPRequestHandler):
     do_PATCH = lambda self: self._dispatch("PATCH")        # noqa: E731
     do_DELETE = lambda self: self._dispatch("DELETE")      # noqa: E731
 
+    def _handle_service_proxy(self, route: _Route) -> None:
+        """GET ``/api/v1/namespaces/{ns}/services/{name}:{port}/proxy/…``
+        — the apiserver's service-proxy subresource, the path the idle
+        culler's probes take in dev mode (reference:
+        culling_controller.go:249-254 builds exactly this URL; the
+        serving-activity prober does too, controllers/culling.py).
+
+        Backend resolution: in this in-process cluster pods hold no real
+        sockets, so the Service carries ``tpu.kubeflow.org/proxy-backend``
+        annotations naming the actual listeners' base URLs (set by the
+        dev composition root or a test) — the facade's analog of ready
+        Endpoints. PER-PORT resolution mirrors real endpoints: the
+        suffixed form ``…/proxy-backend-<port-or-name>`` wins over the
+        bare key, so one multi-port notebook Service can route its
+        Jupyter and model-serving ports to distinct listeners (the
+        culler runs BOTH probes against the same Service). No resolvable
+        annotation → 503, exactly what a real apiserver answers for a
+        Service with no ready endpoints. The requested port must exist
+        on the Service spec (by number or name), like the real
+        subresource; the query string forwards; 3xx responses relay
+        as-is (Location included) instead of being followed."""
+        import urllib.error
+        import urllib.request
+        if route.mapping.kind != "Service":
+            self._send_error_status(
+                404, "NotFound",
+                f"proxy subresource not supported on "
+                f"{route.mapping.kind}")
+            return
+        name, _, port = (route.name or "").partition(":")
+        svc = self.store.get("Service", route.namespace or "", name)
+        ports = k8s.get_in(svc, "spec", "ports", default=[]) or []
+        entry = next((p for p in ports if str(p.get("port")) == port
+                      or p.get("name") == port), None) if port else None
+        if port and entry is None:
+            self._send_error_status(
+                503, "ServiceUnavailable",
+                f"no port {port!r} on service {name}")
+            return
+        # per-port annotation first (by the requested spelling, the
+        # port's name, and its number), then the bare fallback
+        candidates = [port]
+        if entry is not None:
+            candidates += [entry.get("name"), str(entry.get("port"))]
+        keys = [f"{names.PROXY_BACKEND_ANNOTATION}-{c}"
+                for c in dict.fromkeys(c for c in candidates if c)]
+        keys.append(names.PROXY_BACKEND_ANNOTATION)
+        backend = next((v for v in (k8s.get_annotation(svc, k)
+                                    for k in keys) if v), None)
+        if not backend:
+            self._send_error_status(
+                503, "ServiceUnavailable",
+                f"service {name} has no resolvable endpoints (the "
+                f"in-process facade resolves through the "
+                f"{names.PROXY_BACKEND_ANNOTATION}[-<port>] annotations)")
+            return
+        if not backend.startswith(("http://", "https://")):
+            # annotations are author-ish input (same stance as
+            # k8s.parse_port): a file:// or ftp:// backend must not
+            # reach urllib's non-HTTP handlers
+            self._send_error_status(
+                503, "ServiceUnavailable",
+                f"service {name} proxy backend must be http(s), "
+                f"got {backend.split(':', 1)[0]!r}")
+            return
+        url = backend.rstrip("/") + "/" + "/".join(route.tail)
+        if self._raw_query:
+            url += "?" + self._raw_query
+
+        def relay(status: int, headers, body: bytes) -> None:
+            self.send_response(status)
+            self.send_header("Content-Type",
+                             headers.get("Content-Type",
+                                         "application/octet-stream"))
+            if headers.get("Location"):  # relayed 3xx keeps its target
+                self.send_header("Location", headers["Location"])
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        class _NoRedirect(urllib.request.HTTPRedirectHandler):
+            # the real subresource RELAYS 3xx; following it here could
+            # also walk off the annotated backend entirely
+            def redirect_request(self, *args, **kwargs):
+                return None
+
+        opener = urllib.request.build_opener(_NoRedirect)
+        try:
+            with opener.open(url, timeout=10.0) as resp:
+                relay(resp.status, resp.headers, resp.read())
+        except urllib.error.HTTPError as err:
+            # the backend's OWN status (errors AND unfollowed redirects)
+            relay(err.code, err.headers, err.read())
+        except (urllib.error.URLError, OSError) as err:
+            self._send_error_status(
+                502, "BadGateway",
+                f"proxy to {name} failed: {err}")
+
     # ---------------------------------------------------------------- verbs
     def _handle_GET(self, route: _Route, query: dict) -> None:
         kind = route.mapping.kind
+        if route.subresource == "proxy":
+            self._handle_service_proxy(route)
+            return
         if route.name:
             obj = self.store.get(kind, route.namespace or "", route.name)
             self._send_json(200, obj)

@@ -55,6 +55,15 @@ TPU_ORIGINAL_IMAGE_ANNOTATION = "tpu.kubeflow.org/original-image"
 SERVING_PORT_ANNOTATION = "tpu.kubeflow.org/serving-port"
 SERVING_REQUESTS_OBSERVED_ANNOTATION = \
     "tpu.kubeflow.org/serving-requests-observed"
+# where the apiserver facade's service-proxy subresource forwards: in the
+# in-process cluster pods hold no real sockets, so the composition root
+# (or a test) annotates the Service with the actual listener's base URL
+# — the facade's analog of a Service's ready endpoints. A multi-port
+# Service (the notebook Service carries Jupyter AND model serving) maps
+# each port to its own listener with the suffixed form
+# ``tpu.kubeflow.org/proxy-backend-<port-or-port-name>``; the bare key
+# is the single-listener fallback.
+PROXY_BACKEND_ANNOTATION = "tpu.kubeflow.org/proxy-backend"
 
 # Kubernetes DNS-1123 subdomain limit for the pod hostname contributed by the
 # StatefulSet name; the reference caps STS names at 52 chars so the "-<ordinal>"

@@ -1,0 +1,245 @@
+"""The apiserver facade's service-proxy subresource
+(`/api/v1/namespaces/{ns}/services/{name}:{port}/proxy/...`) — the path
+the idle culler's probes take in dev mode (reference:
+culling_controller.go:249-254). The headline test wires the WHOLE chain
+over real HTTP: culler's serving-activity prober → apiserver proxy →
+a live ServingServer's /healthz."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+from kubeflow_tpu.cluster.apiserver import ApiServerProxy
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.utils import names
+
+
+def _service(name="web", ns="ns", port=8890, backend=None):
+    svc = {"apiVersion": "v1", "kind": "Service",
+           "metadata": {"name": name, "namespace": ns, "annotations": {}},
+           "spec": {"ports": [{"name": "http-serving", "port": port,
+                               "targetPort": port, "protocol": "TCP"}]}}
+    if backend:
+        svc["metadata"]["annotations"][
+            names.PROXY_BACKEND_ANNOTATION] = backend
+    return svc
+
+
+@pytest.fixture()
+def proxy():
+    store = ClusterStore()
+    server = ApiServerProxy(store)
+    server.start()
+    try:
+        yield store, server
+    finally:
+        server.stop()
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.status, resp.read()
+
+
+def test_proxy_forwards_to_annotated_backend(proxy):
+    import http.server
+    import threading
+
+    class Backend(http.server.BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = json.dumps({"path": self.path}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), Backend)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    store, server = proxy
+    store.create(_service(
+        backend=f"http://127.0.0.1:{httpd.server_address[1]}"))
+    try:
+        status, body = _get(
+            f"{server.url}/api/v1/namespaces/ns/services/web:8890/"
+            f"proxy/some/sub/path")
+        assert status == 200
+        assert json.loads(body) == {"path": "/some/sub/path"}
+        # the port is also resolvable by NAME, like the real subresource
+        status2, _ = _get(
+            f"{server.url}/api/v1/namespaces/ns/services/"
+            f"web:http-serving/proxy/x")
+        assert status2 == 200
+    finally:
+        httpd.shutdown()
+
+
+def test_proxy_failure_modes(proxy):
+    store, server = proxy
+    store.create(_service())  # no backend annotation
+    base = f"{server.url}/api/v1/namespaces/ns/services"
+    for url, code in (
+            (f"{base}/web:8890/proxy/healthz", 503),    # no endpoints
+            (f"{base}/web:9999/proxy/healthz", 503),    # unknown port
+            (f"{base}/nope:8890/proxy/healthz", 404),   # no such service
+    ):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(url)
+        assert err.value.code == code, url
+    # backend annotated but nothing listening → 502, not a hang/500
+    store.update(_service(backend="http://127.0.0.1:9"))
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(f"{base}/web:8890/proxy/healthz")
+    assert err.value.code == 502
+    # non-GET verbs are refused loudly
+    req = urllib.request.Request(f"{base}/web:8890/proxy/healthz",
+                                 data=b"{}", method="POST")
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(req, timeout=30)
+    assert err.value.code == 405
+
+
+def test_culler_serving_prober_through_proxy_end_to_end(proxy):
+    """The dev-mode serving-activity chain over REAL wire: prober →
+    apiserver service proxy → live ServingServer /healthz. The probe
+    must return the engine's cumulative requests_total."""
+    from kubeflow_tpu.controllers.culling import serving_requests_prober
+    from kubeflow_tpu.models.transformer import (TransformerConfig,
+                                                 init_params)
+    from kubeflow_tpu.runtime.server import ServingServer
+    from kubeflow_tpu.runtime.serving import ContinuousBatchedGenerator
+    from kubeflow_tpu.utils.config import ControllerConfig
+
+    cfg = TransformerConfig(vocab_size=96, d_model=32, n_layers=1,
+                            n_heads=4, n_kv_heads=2, d_ff=48,
+                            dtype="float32", max_seq_len=48)
+    params = init_params(jax.random.key(0), cfg)
+    gen = ContinuousBatchedGenerator(params, cfg, n_slots=2,
+                                     max_new_cap=8)
+    store, server = proxy
+    with ServingServer(gen, cfg, port=0) as srv:
+        store.create(_service(name="nb", backend=srv.url))
+        probe = serving_requests_prober(ControllerConfig(
+            dev_mode=True, dev_proxy_url=server.url))
+        nb = {"metadata": {"name": "nb", "namespace": "ns"}}
+        assert probe(nb, "8890") == 0
+        # traffic moves the counter the prober reads
+        req = urllib.request.Request(
+            srv.url + "/v1/generate",
+            data=json.dumps({"prompt": [1, 2], "max_new_tokens": 2}
+                            ).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=60):
+            pass
+        assert probe(nb, "8890") == 1
+
+
+def test_proxy_per_port_routing_query_and_redirects(proxy):
+    """A multi-port Service routes each port to its own listener via the
+    suffixed proxy-backend annotations (the notebook Service carries
+    Jupyter AND serving ports; the culler probes both); the query string
+    forwards verbatim; 3xx responses relay with their Location instead
+    of being followed off the backend."""
+    import http.server
+    import threading
+
+    def backend(tag):
+        class B(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path.startswith("/redirect"):
+                    self.send_response(302)
+                    self.send_header("Location", "/login")
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                body = json.dumps({"tag": tag,
+                                   "path": self.path}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+        httpd = http.server.HTTPServer(("127.0.0.1", 0), B)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        return httpd
+
+    jupyter, serving = backend("jupyter"), backend("serving")
+    store, server = proxy
+    svc = {"apiVersion": "v1", "kind": "Service",
+           "metadata": {"name": "nb2", "namespace": "ns", "annotations": {
+               names.PROXY_BACKEND_ANNOTATION:
+                   f"http://127.0.0.1:{jupyter.server_address[1]}",
+               f"{names.PROXY_BACKEND_ANNOTATION}-http-serving":
+                   f"http://127.0.0.1:{serving.server_address[1]}"}},
+           "spec": {"ports": [
+               {"name": "http-notebook", "port": 80},
+               {"name": "http-serving", "port": 8890}]}}
+    store.create(svc)
+    base = f"{server.url}/api/v1/namespaces/ns/services"
+    try:
+        # serving port (by number) → the suffixed (name-keyed) backend
+        _, body = _get(f"{base}/nb2:8890/proxy/healthz")
+        assert json.loads(body)["tag"] == "serving"
+        # jupyter port → the bare fallback backend; query forwarded
+        _, body2 = _get(f"{base}/nb2:80/proxy/api/sessions?token=t0k")
+        assert json.loads(body2) == {"tag": "jupyter",
+                                     "path": "/api/sessions?token=t0k"}
+        # a redirect relays as 302 + Location, not followed
+        req = urllib.request.Request(f"{base}/nb2:80/proxy/redirect")
+        opener = urllib.request.build_opener(
+            type("NR", (urllib.request.HTTPRedirectHandler,),
+                 {"redirect_request": lambda *a, **k: None}))
+        with pytest.raises(urllib.error.HTTPError) as err:
+            opener.open(req, timeout=30)
+        assert err.value.code == 302
+        assert err.value.headers["Location"] == "/login"
+    finally:
+        jupyter.shutdown()
+        serving.shutdown()
+
+
+def test_proxy_rejects_non_http_backend_scheme(proxy):
+    """Annotations are author-controlled: a file:// backend must not
+    reach urllib's non-HTTP handlers (same stance as k8s.parse_port)."""
+    store, server = proxy
+    store.create(_service(backend="file:///etc/passwd"))
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(f"{server.url}/api/v1/namespaces/ns/services/web:8890/"
+             f"proxy/healthz")
+    assert err.value.code == 503
+    assert b"http(s)" in err.value.read()
+
+
+def test_405_drains_body_keeping_the_connection_usable(proxy):
+    """HTTP/1.1 keep-alive: a refused POST's body must be drained before
+    responding, or the stale bytes would be parsed as the next request
+    line on the same connection."""
+    import http.client
+    store, server = proxy
+    store.create(_service())
+    conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=30)
+    try:
+        conn.request("POST",
+                     "/api/v1/namespaces/ns/services/web:8890/proxy/x",
+                     body=b'{"k": 1}',
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 405
+        resp.read()
+        # the SAME connection must serve a clean follow-up request
+        conn.request("GET", "/healthz")
+        resp2 = conn.getresponse()
+        assert resp2.status == 200 and resp2.read() == b"ok"
+    finally:
+        conn.close()
