@@ -1,0 +1,34 @@
+"""The prefix-cache A/B harness (ci/prefix_cache_ab.py) is itself under
+test: a smoke run must produce the JSON contract PERF.md cites, with
+the cold-batch and warm-round chunk savings behaving as the mechanism
+guarantees (the harness asserts token-identity across arms itself)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.mark.slow
+def test_prefix_cache_ab_smoke_contract(tmp_path):
+    out = tmp_path / "ab.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "ci" / "prefix_cache_ab.py"),
+         "--smoke", "--out", str(out)],
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(out.read_text())
+    assert doc["backend"] == "cpu"
+    assert doc["cache_off"]["prefix_cache_hits_total"] == 0
+    assert doc["cache_on"]["prefix_cache_hits_total"] > 0
+    for kind in ("cold_round_prefill_chunks", "warm_round_prefill_chunks"):
+        assert doc["cache_on"][kind] < doc["cache_off"][kind]
+    assert doc["cold_batch_chunks_saved_pct"] > 0
+    # warm steady state can only save MORE than the cold batch (every
+    # preamble chunk is already resident)
+    assert doc["warm_round_chunks_saved_pct"] >= \
+        doc["cold_batch_chunks_saved_pct"]
