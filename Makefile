@@ -6,7 +6,7 @@ TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: help test test-fast test-chaos test-transport gate lint manifests \
         manifests-check check-license bench numerics ctx-sweep mfu-ab capture \
-        spec-acceptance prefix-cache-ab dryrun loadtest run run-split
+        spec-acceptance prefix-cache-ab chunked-prefill-ab dryrun loadtest run run-split
 
 help: ## Display this help.
 	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_-]+:.*?##/ {printf "  %-16s %s\n", $$1, $$2}' $(MAKEFILE_LIST)
@@ -55,6 +55,9 @@ spec-acceptance: ## Speculative-decoding acceptance→speedup curve (CPU).
 
 prefix-cache-ab: ## Prefix-cache on/off A/B on a templated workload (CPU).
 	$(PYTHON) ci/prefix_cache_ab.py --out PREFIX_CACHE_AB.json
+
+chunked-prefill-ab: ## Chunked-vs-monolithic admission-stall A/B (CPU).
+	$(PYTHON) ci/chunked_prefill_ab.py --out CHUNKED_PREFILL_AB.json
 
 capture: ## Full serial on-chip capture: bench + mfu-ab + ctx-sweep + numerics.
 	PYTHON=$(PYTHON) bash ci/capture_all.sh

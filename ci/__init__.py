@@ -1,0 +1,2 @@
+# ci/ is mostly standalone scripts, but shared tunnel-safety helpers
+# (platform_pin) import as a package when the repo root is on sys.path.

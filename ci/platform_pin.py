@@ -1,0 +1,22 @@
+"""THE platform pin for CI harnesses — tunnel-safety-critical.
+
+This image pre-exports ``JAX_PLATFORMS=axon`` and RE-ASSERTS it at
+interpreter startup, so ``os.environ.setdefault`` is a no-op and even
+``env JAX_PLATFORMS=cpu`` gets overridden. A harness meant to run on
+CPU MUST call :func:`pin_platform` before its first jax backend use; a
+"CPU" script that skips it silently connects to the TPU tunnel — and a
+second concurrent tunnel client wedges the tunnel for every process
+(observed round 4: hours of lost capture window). One definition so a
+fix here reaches every harness."""
+
+from __future__ import annotations
+
+import os
+
+
+def pin_platform(platform: str) -> None:
+    """Pin jax to ``platform`` via BOTH the env var and jax.config —
+    must run before any backend-initializing jax call."""
+    os.environ["JAX_PLATFORMS"] = platform
+    import jax
+    jax.config.update("jax_platforms", platform)

@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import pathlib
 import sys
 import time
@@ -32,17 +31,11 @@ import time
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
-def _pin_platform(platform: str) -> None:
-    # explicit pin BEFORE any jax import: this image re-asserts
-    # JAX_PLATFORMS=axon at startup; a "CPU" script that skips this
-    # becomes a second tunnel client and wedges the tunnel
-    os.environ["JAX_PLATFORMS"] = platform
-    import jax
-    jax.config.update("jax_platforms", platform)
+from ci.platform_pin import pin_platform  # noqa: E402
 
 
 def run(platform: str, smoke: bool) -> dict:
-    _pin_platform(platform)
+    pin_platform(platform)
     import numpy as np
 
     import jax

@@ -30,24 +30,13 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import pathlib
 import sys
 import time
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
-# CRITICAL ordering: this image pre-exports JAX_PLATFORMS=axon and
-# re-asserts it at interpreter startup; a "CPU" harness that skips the
-# explicit pin silently becomes a second tunnel client and wedges the
-# tunnel for every other process (round-4 lesson). Platform is resolved
-# BEFORE any jax import.
-
-
-def _pin_platform(platform: str) -> None:
-    os.environ["JAX_PLATFORMS"] = platform
-    import jax
-    jax.config.update("jax_platforms", platform)
+from ci.platform_pin import pin_platform  # noqa: E402
 
 
 def _timed(fn, warm_args, reps: int) -> float:
@@ -74,7 +63,7 @@ def _timed(fn, warm_args, reps: int) -> float:
 
 
 def run(platform: str, smoke: bool) -> dict:
-    _pin_platform(platform)
+    pin_platform(platform)
     import jax
     import jax.numpy as jnp
     import numpy as np

@@ -1,0 +1,32 @@
+"""The chunked-prefill admission-stall harness (ci/chunked_prefill_ab.py)
+is itself under test: the smoke run must produce the JSON contract and
+show the mechanism's direction — a monolithic prefill stalls a running
+stream longer than chunked admission. The RATIO bound is deliberately
+loose (wall-clock on a shared CI box); PERF.md cites the uncontended
+full run."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.mark.slow
+def test_chunked_prefill_ab_smoke_contract(tmp_path):
+    out = tmp_path / "ab.json"
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "ci" / "chunked_prefill_ab.py"),
+         "--smoke", "--out", str(out)],
+        capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(out.read_text())
+    assert doc["backend"] == "cpu"
+    assert doc["chunked"]["max_admission_stall_ms"] > 0
+    assert doc["monolithic"]["max_admission_stall_ms"] > 0
+    # direction only: monolithic must stall at least as hard as chunked
+    # (measured ~5x uncontended; scheduling noise can compress it)
+    assert doc["stall_ratio"] >= 1.0
