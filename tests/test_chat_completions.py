@@ -305,3 +305,27 @@ def test_chat_omitted_budget_generates_to_context_limit(chat_server):
     # test model max_seq_len=48: the budget fills the context exactly
     assert out["usage"]["completion_tokens"] == 48 - n_prompt
     assert out["choices"][0]["finish_reason"] == "length"
+
+
+def test_tokenizer_template_with_real_transformers_jinja(tmp_path):
+    """'tokenizer' mode against ACTUAL transformers machinery: a jinja
+    chat_template set on a real PreTrainedTokenizerFast renders through
+    apply_chat_template, and a template that raises on bad conversations
+    surfaces as ValueError (the 400 path), not a jinja traceback."""
+    tok = _word_tokenizer(tmp_path)
+    tok.chat_template = (
+        "{% for m in messages %}<{{ m.role }}>{{ m.content }}</{{ m.role }}>"
+        "{% endfor %}{% if add_generation_prompt %}<assistant>{% endif %}")
+    tmpl = load_template("tokenizer", tok)
+    got = tmpl.render([{"role": "system", "content": "a"},
+                       {"role": "user", "content": "b"}])
+    assert got == "<system>a</system><user>b</user><assistant>"
+    # a strict template (Llama-style raise_exception) → ValueError
+    tok.chat_template = (
+        "{% if messages[0].role != 'user' %}"
+        "{{ raise_exception('first message must be from user') }}"
+        "{% endif %}{{ messages[0].content }}")
+    strict = load_template("tokenizer", tok)
+    with pytest.raises(ValueError, match="rejected the conversation"):
+        strict.render([{"role": "system", "content": "x"}])
+    assert strict.render([{"role": "user", "content": "ok"}]) == "ok"
