@@ -1,0 +1,72 @@
+"""Tier-1-safe dispatch-regression smoke: a small wire fan-out under a hard
+wall-clock budget.
+
+The full loadtest (loadtest/RESULTS.md: 500-1000 notebooks) is a manual /
+workflow-gated run; dispatch regressions (a worker-pool deadlock, an
+accidental O(N^2) in the queue, per-key serialization gone serial-global)
+used to surface only there. This smoke runs the REAL wire stack —
+controllers over a local HTTP apiserver, StatefulSet simulator, webhooks,
+metrics — at 50 notebooks with 4 workers, and fails when the run exceeds
+its budget or any loadtest bound (convergence, requests/notebook) trips.
+
+Budget rationale: the run takes ~2 s on a quiet dev box; the default 60 s
+budget is ~30x headroom, loose enough to survive a loaded CI box yet tight
+enough that the historical O(N^2) simulator regression (215 s at 500 ≈
+tens of seconds at 50) or a stalled worker pool (timeout → FAIL from the
+loadtest itself) still trips it.
+
+Usage:
+    python ci/loadtest_smoke.py            # 50 notebooks, 4 workers, 60 s
+    python ci/loadtest_smoke.py --count 50 --workers 1 --budget-s 60
+
+`tests/test_loadtest_smoke.py` runs this in-process as part of tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+DEFAULT_COUNT = 50
+DEFAULT_WORKERS = 4
+DEFAULT_BUDGET_S = 60.0
+MAX_REQUESTS_PER_NB = 60.0
+
+
+def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
+              budget_s: float = DEFAULT_BUDGET_S) -> int:
+    """Run the wire fan-out; return nonzero on any failed bound."""
+    from loadtest.start_notebooks import run_wire
+
+    t0 = time.monotonic()
+    rc = run_wire(count, "loadtest-smoke", "v5e-4",
+                  timeout=budget_s,  # convergence may not outlive the budget
+                  max_requests_per_nb=MAX_REQUESTS_PER_NB,
+                  workers=workers)
+    wall = time.monotonic() - t0
+    if rc != 0:
+        print(f"SMOKE FAIL: loadtest bounds violated (rc={rc})")
+        return rc
+    if wall > budget_s:
+        print(f"SMOKE FAIL: {wall:.1f}s exceeds the {budget_s:.0f}s budget")
+        return 1
+    print(f"smoke OK: {count} notebooks x {workers} workers in {wall:.1f}s "
+          f"(budget {budget_s:.0f}s)")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--count", type=int, default=DEFAULT_COUNT)
+    ap.add_argument("--workers", type=int, default=DEFAULT_WORKERS)
+    ap.add_argument("--budget-s", type=float, default=DEFAULT_BUDGET_S)
+    args = ap.parse_args()
+    return run_smoke(args.count, args.workers, args.budget_s)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -183,6 +183,16 @@ class _Handler(BaseHTTPRequestHandler):
             log.warning("audit write failed: %s", exc)
 
     def _dispatch(self, method: str) -> None:
+        latency = getattr(self.server, "latency_s", 0.0)
+        if latency:
+            # emulated network+processing round trip (ApiServerProxy
+            # latency_s): a real apiserver is a remote process; sleeping
+            # here (GIL released) is what lets concurrent clients overlap
+            # their in-flight requests like they would over a real wire.
+            # Watch streams are exempt below (the stream is long-lived;
+            # per-frame latency is not request latency).
+            if "watch" not in parse_qs(urlparse(self.path).query):
+                time.sleep(latency)
         if not self._authorized():
             self._send_error_status(401, "Unauthorized", "invalid bearer token")
             return
@@ -451,13 +461,18 @@ class ApiServerProxy:
     def __init__(self, store, port: int = 0, host: str = "127.0.0.1",
                  token: str | None = None, certfile: str | None = None,
                  keyfile: str | None = None,
-                 audit_log: str | None = None) -> None:
+                 audit_log: str | None = None,
+                 latency_s: float = 0.0) -> None:
         self.store = store
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.store = store  # type: ignore[attr-defined]
         self._httpd.token = token  # type: ignore[attr-defined]
         self._httpd.shutting_down = False  # type: ignore[attr-defined]
+        # emulated request round-trip latency (loadtest knob: a localhost
+        # facade has ~0 RTT while a production apiserver has 1-10 ms; the
+        # dispatch worker-pool measurements need the real shape)
+        self._httpd.latency_s = latency_s  # type: ignore[attr-defined]
         # optional mutating-request audit trail (suite_test.go:127-157
         # analog); opened append so restarts extend the trail
         self._audit_file = open(audit_log, "a") if audit_log else None

@@ -83,9 +83,33 @@ def strip_configmap_data(obj: dict) -> dict:
 DEFAULT_TRANSFORMS = (strip_secret_data, strip_configmap_data)
 
 
+def live_reader(client):
+    """The live (uncached) client behind a reconciler's wrapper chain —
+    EchoTrackingClient delegates ``store`` to the CachingClient, whose
+    ``store`` is the real apiserver client; a bare store has no ``store``
+    attribute and IS the live client. Conflict-retry paths re-read through
+    this: after a 409 the foreign write's watch event may not have reached
+    the cache yet, and a cached re-read would resend the same stale
+    resourceVersion (RetryOnConflict re-reads from the apiserver for the
+    same reason)."""
+    return getattr(client, "store", None) or client
+
+
 class CachingClient:
     """Same client surface as ClusterStore for reads/writes/watches, with the
-    manager-cache semantics described above."""
+    manager-cache semantics described above.
+
+    ``disable_for`` kinds are payload kinds: their ``get``/``list`` payload
+    reads go to the live store. They are still INGESTED (transforms strip
+    the payload first, so a cached Secret/ConfigMap is metadata-sized —
+    exactly the reference's stripped manager cache) so that a warm cache
+    can answer EXISTENCE authoritatively: a miss on a warm payload kind is
+    NotFound without a wire GET. Controllers probing optional ConfigMaps
+    (CA bundles, runtime-images) every reconcile otherwise turn a big
+    fan-out into a GET-404 storm. ``Event`` is the exception (never cached,
+    never warm): the stream is high-churn and Events are read rarely."""
+
+    NEVER_CACHE = frozenset(("Event",))
 
     def __init__(self, store,
                  transforms: Iterable[Callable[[dict], dict]] =
@@ -147,10 +171,11 @@ class CachingClient:
     def feed(self, event: WatchEvent) -> None:
         """Ingest one watch event from a stream the OWNER holds (tee from a
         manager watch). Only meaningful with auto_informer=False.
-        disable_for kinds are dropped at the door: their reads always go
-        live, so caching them (hot Event streams especially) would grow
-        memory for objects never served."""
-        if event.obj.get("kind") in self.disable_for:
+        Payload (disable_for) kinds are ingested STRIPPED — the transforms
+        drop data/binaryData/stringData — so the cache can answer existence
+        without ever holding payloads; Event is dropped at the door (high
+        churn, never served from cache)."""
+        if event.obj.get("kind") in self.NEVER_CACHE:
             return
         self._on_event(event)
 
@@ -168,9 +193,12 @@ class CachingClient:
         the promise of a resync would turn existing objects into
         authoritative NotFounds for the gap (and for the whole outage if
         the stream never connected). The overlap with a delivered resync
-        is idempotent ingestion."""
-        if kind in self.disable_for:
-            return  # payload kinds are live-read by design; never warm
+        is idempotent ingestion.
+
+        Payload (disable_for) kinds backfill too — stripped — so their
+        existence checks turn authoritative; Event never does."""
+        if kind in self.NEVER_CACHE:
+            return  # never cached, never warm
         with self._lock:
             if kind in self._warm:
                 return
@@ -236,6 +264,16 @@ class CachingClient:
     # -------------------------------------------------------------- reads
     def get(self, kind: str, namespace: str, name: str) -> dict:
         if kind in self.disable_for:
+            # payload kind: a HIT still reads live (the caller wants the
+            # data the cache deliberately strips), but a MISS on a warm,
+            # watch-fed kind is an authoritative NotFound — no wire GET
+            # for every optional ConfigMap probed per reconcile
+            with self._lock:
+                warm = kind in self._warm
+                present = (kind, namespace, name) in self._cache
+            if warm and not present:
+                from .errors import NotFoundError
+                raise NotFoundError(f"{kind} {namespace}/{name}")
             return self.store.get(kind, namespace, name)  # live read
         with self._lock:
             unfed = kind not in self._watched
@@ -287,17 +325,44 @@ class CachingClient:
         return [k8s.deepcopy(o) for o in matched]
 
     # ---------------------------------------- writes + watches: passthrough
+    def _ingest_write(self, obj, recreate: bool = False):
+        """Feed a write's RESPONSE (fresh rv) straight into the cache for
+        kinds this cache tracks — read-your-writes for the author. Over a
+        real wire the watch event confirming our own write arrives
+        milliseconds later; without this, a warm payload kind would report
+        a just-created object as authoritative NotFound for that window,
+        and any re-read would serve the pre-write copy. The rv guard in
+        _ingest keeps the overlap with the eventual watch event idempotent.
+
+        ``recreate`` (create responses only) clears a DELETE tombstone — a
+        create after delete is a genuine recreate. Update/patch responses
+        must NOT: an update racing a delete would pop the tombstone and
+        resurrect the deleted object in the cache forever (no later watch
+        event would ever evict it)."""
+        if isinstance(obj, dict):
+            kind = obj.get("kind")
+            if kind and kind not in self.NEVER_CACHE:
+                with self._lock:
+                    tracked = kind in self._watched or kind in self._warm
+                if tracked:
+                    # deepcopy: the same response dict goes back to the
+                    # caller, who may mutate it (copy-fields helpers do) —
+                    # the cache must hold its own copy
+                    self._ingest(k8s.deepcopy(obj), from_watch=recreate)
+        return obj
+
     def create(self, obj: dict) -> dict:
-        return self.store.create(obj)
+        return self._ingest_write(self.store.create(obj), recreate=True)
 
     def update(self, obj: dict) -> dict:
-        return self.store.update(obj)
+        return self._ingest_write(self.store.update(obj))
 
     def update_status(self, obj: dict) -> dict:
-        return self.store.update_status(obj)
+        return self._ingest_write(self.store.update_status(obj))
 
     def patch(self, kind: str, namespace: str, name: str, patch: dict) -> dict:
-        return self.store.patch(kind, namespace, name, patch)
+        return self._ingest_write(self.store.patch(kind, namespace, name,
+                                                   patch))
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         return self.store.delete(kind, namespace, name)

@@ -1,0 +1,117 @@
+"""Self-write echo suppression for reconciler watch streams.
+
+Every write a reconciler makes comes back to it as a watch event (the
+apiserver fans mutations out to all watchers, including the author). For a
+level-triggered controller that event carries zero information — the
+reconcile that made the write already acted on the freshest state — but it
+costs a full re-reconcile. With a single dispatch thread the echoes mostly
+vanish into queue coalescing (a deep backlog merges them into the next run
+anyway); with MaxConcurrentReconciles > 1 the queue stays shallow and every
+echo becomes its own reconcile. Measured on the 500-notebook wire fan-out:
+~2x the reconciles and requests per notebook at workers=4 vs workers=1,
+almost entirely self-echo re-runs.
+
+``EchoTrackingClient`` wraps a reconciler's client, records the
+resourceVersion of every object its writes produce, and exposes an
+``is_echo(event)`` predicate for the manager watches: an event whose
+object carries exactly a recorded (kind, ns, name) → rv is the author's
+own write coming back and is dropped. The same-rv match makes this safe:
+
+- a foreign write (other controller, user, another replica) bumps rv past
+  the recorded value → never suppressed;
+- our write racing a foreign one: whichever landed later has a different
+  rv → the foreign state is always delivered;
+- DELETED events are never suppressed (deletes need no rv reasoning);
+- a missed recording (in-process stores deliver watch callbacks inline,
+  BEFORE the write call returns) fails open: the echo is delivered and
+  merely costs the old re-reconcile.
+
+This is the same idea as controller-runtime's predicate layer
+(GenerationChangedPredicate and friends drop self-inflicted status-echo
+reconciles); rv-matching generalizes it to annotation/label writes, which
+this control plane uses as its cooperation protocol.
+
+One contract change for authors: a reconciler must NOT rely on its own
+write's echo to re-trigger itself (e.g. "update then return; the watch
+re-enqueues"). Pattern replacement: return ``Result(requeue_after=0)`` for
+an explicit immediate requeue (extension.py's finalizer-add does this).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+from ..utils import k8s
+
+
+class EchoTrackingClient:
+    """Transparent client wrapper: writes record their resulting
+    resourceVersion; everything else passes through. Thread-safe — with a
+    worker pool, several reconciles of different keys write concurrently."""
+
+    #: rvs remembered per object — one reconcile can write the same object
+    #: more than once (create + immediate fixup), and each echo arrives
+    #: separately
+    RVS_PER_KEY = 4
+    #: objects tracked before the oldest recording is evicted
+    CAPACITY = 8192
+    #: kinds no ``not_echo`` predicate ever consults — recording them
+    #: (high-churn Event writes from the recorder especially) would only
+    #: evict live Notebook/STS/Service records from the bounded table
+    NEVER_TRACK = frozenset(("Event",))
+
+    def __init__(self, client):
+        self._client = client
+        self._lock = threading.Lock()
+        # (kind, namespace, name) → list of recent rv strings (newest last)
+        self._written: OrderedDict[tuple[str, str, str], list[str]] = \
+            OrderedDict()
+
+    # ------------------------------------------------------------ recording
+    def _record(self, obj):
+        if isinstance(obj, dict) and obj.get("kind") not in self.NEVER_TRACK:
+            rv = k8s.get_in(obj, "metadata", "resourceVersion")
+            if rv is not None:
+                key = (k8s.kind(obj), k8s.namespace(obj), k8s.name(obj))
+                with self._lock:
+                    rvs = self._written.setdefault(key, [])
+                    rvs.append(str(rv))
+                    del rvs[:-self.RVS_PER_KEY]
+                    self._written.move_to_end(key)
+                    while len(self._written) > self.CAPACITY:
+                        self._written.popitem(last=False)
+        return obj
+
+    def is_echo(self, event) -> bool:
+        """True iff ``event`` is the delivery of one of OUR writes."""
+        if event.type == "DELETED":
+            return False
+        obj = event.obj
+        rv = k8s.get_in(obj, "metadata", "resourceVersion")
+        if rv is None:
+            return False
+        key = (k8s.kind(obj), k8s.namespace(obj), k8s.name(obj))
+        with self._lock:
+            return str(rv) in self._written.get(key, ())
+
+    def not_echo(self, event) -> bool:
+        """Watch-predicate form: pass everything that is not our echo."""
+        return not self.is_echo(event)
+
+    # --------------------------------------------------------------- writes
+    def create(self, obj):
+        return self._record(self._client.create(obj))
+
+    def update(self, obj):
+        return self._record(self._client.update(obj))
+
+    def update_status(self, obj):
+        return self._record(self._client.update_status(obj))
+
+    def patch(self, kind, namespace, name, patch):
+        return self._record(self._client.patch(kind, namespace, name, patch))
+
+    # ------------------------------------------------- reads / passthrough
+    def __getattr__(self, name):
+        return getattr(self._client, name)

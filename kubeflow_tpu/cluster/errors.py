@@ -43,6 +43,33 @@ class ForbiddenError(ApiError):
     reason = "Forbidden"
 
 
+def update_with_conflict_retry(client, read, mutate, attempts: int = 3):
+    """retry.RetryOnConflict analog for the read→mutate→update shape, the
+    conflict-retry loop concurrent reconcile workers need in several
+    places (finalizer strips, copy-fields drift repair).
+
+    ``read()`` returns the current object or None (nothing to do — give
+    up quietly); pass a LIVE read (cache.live_reader) when retrying a
+    conflict, because the foreign write that caused the 409 may not have
+    reached the watch-fed cache yet and a cached re-read would resend the
+    same stale resourceVersion. ``mutate(obj)`` edits in place and
+    returns whether an update is needed. ConflictError retries up to
+    ``attempts`` times; a final conflict or a vanished object returns
+    None (callers relying on error-backoff should re-raise instead —
+    this helper is for benign races the next watch event re-converges)."""
+    for _attempt in range(attempts):
+        obj = read()
+        if obj is None or not mutate(obj):
+            return None
+        try:
+            return client.update(obj)
+        except ConflictError:
+            continue
+        except NotFoundError:
+            return None
+    return None
+
+
 def is_not_found(err: Exception) -> bool:
     return isinstance(err, NotFoundError)
 

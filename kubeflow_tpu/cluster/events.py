@@ -86,29 +86,46 @@ class EventRecorder:
         suffix = _aggregation_suffix(ref["uid"], type_, reason, message)
         event_name = f"{ref['name']}.{suffix}"
         self._maybe_prune(namespace)
-        existing = self.client.get_or_none(EVENT_KIND, namespace, event_name)
-        if existing is not None:
-            existing = k8s.deepcopy(existing)
-            existing["count"] = int(existing.get("count", 1)) + 1
-            existing["lastTimestamp"] = now
-            return self.client.update(existing)
-        event = {
-            "apiVersion": "v1",
-            "kind": EVENT_KIND,
-            "metadata": {
-                "name": event_name,
-                "namespace": namespace,
-            },
-            "involvedObject": ref,
-            "type": type_,
-            "reason": reason,
-            "message": message,
-            "count": 1,
-            "firstTimestamp": now,
-            "lastTimestamp": now,
-            "source": {"component": self.component},
-        }
-        return self.client.create(event)
+        # get-then-write races under concurrent reconcile workers (two keys
+        # re-emitting the same aggregated event): a lost create falls back
+        # to the update branch and a conflicting update re-reads — bounded
+        # retries, never an exception for an aggregation race
+        from .errors import AlreadyExistsError, ConflictError, NotFoundError
+        existing = None
+        for _attempt in range(3):
+            existing = self.client.get_or_none(EVENT_KIND, namespace,
+                                               event_name)
+            if existing is not None:
+                existing = k8s.deepcopy(existing)
+                existing["count"] = int(existing.get("count", 1)) + 1
+                existing["lastTimestamp"] = now
+                try:
+                    return self.client.update(existing)
+                except (ConflictError, NotFoundError):
+                    continue  # concurrent bump or prune; re-read
+            event = {
+                "apiVersion": "v1",
+                "kind": EVENT_KIND,
+                "metadata": {
+                    "name": event_name,
+                    "namespace": namespace,
+                },
+                "involvedObject": ref,
+                "type": type_,
+                "reason": reason,
+                "message": message,
+                "count": 1,
+                "firstTimestamp": now,
+                "lastTimestamp": now,
+                "source": {"component": self.component},
+            }
+            try:
+                return self.client.create(event)
+            except AlreadyExistsError:
+                continue  # lost the create race; aggregate onto the winner
+        # kept racing; events are best-effort telemetry — surface the last
+        # observed aggregate rather than raising into the reconcile loop
+        return existing or {}
 
     def _maybe_prune(self, namespace: str) -> None:
         """Delete events whose lastTimestamp is past the TTL. Amortized: at

@@ -10,7 +10,8 @@ __all__ = ["Manager", "Request", "NotebookReconciler", "CullingReconciler",
 def setup_controllers(client, config=None, metrics=None, prober=None, *,
                       core=True, extension=True, webhooks=True,
                       leader_elect=False, health_port=None,
-                      lease_name=None, cached_reads=True):
+                      lease_name=None, cached_reads=True,
+                      max_concurrent_reconciles=None):
     """Wire a manager the way the two reference manager binaries do
     (notebook-controller/main.go:58-148 + odh main.go:141-374): admission
     webhooks on the apiserver, core reconciler always, culler only when
@@ -25,7 +26,12 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
     watch-fed cache — one informer layer, no per-reconcile GET storms —
     while Secret/ConfigMap payload reads and Events stay live. Writes
     always pass through; conflict-retried updates absorb the staleness,
-    exactly as in the reference."""
+    exactly as in the reference.
+
+    ``max_concurrent_reconciles`` sizes the manager's dispatch worker pool
+    (controller-runtime's MaxConcurrentReconciles; default from
+    config.max_concurrent_reconciles / MAX_CONCURRENT_RECONCILES, 4).
+    1 restores the classic single dispatch thread."""
     from ..api.types import install_notebook_crd
     from ..cluster.cache import CachingClient
     from ..utils.config import ControllerConfig
@@ -50,14 +56,19 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
         # on cached state would be a correctness hazard
         NotebookMutatingWebhook(client, config).install(client)
         NotebookValidatingWebhook(config).install(client)
+    if max_concurrent_reconciles is None:
+        max_concurrent_reconciles = getattr(config,
+                                            "max_concurrent_reconciles", 4)
     if cached_reads:
         read_client = CachingClient(
             client, auto_informer=False,
             disable_for=("Secret", "ConfigMap", "Event"))
-        mgr = Manager(read_client, read_cache=read_client)
+        mgr = Manager(read_client, read_cache=read_client,
+                      max_concurrent_reconciles=max_concurrent_reconciles)
     else:
         read_client = client
-        mgr = Manager(read_client)
+        mgr = Manager(read_client,
+                      max_concurrent_reconciles=max_concurrent_reconciles)
     client = read_client  # reconcilers below read cached, write through
     mgr.attach_metrics(metrics)
     # ``core``/``extension`` mirror the reference's TWO manager binaries:
@@ -87,11 +98,9 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
     if health_port is not None:
         mgr.health_server = HealthServer(metrics_registry=metrics,
                                          port=health_port)
-        # liveness = the reconcile loop thread is actually alive; readiness
+        # liveness = the reconcile worker pool is actually alive; readiness
         # deliberately does NOT gate on leadership — standby replicas must
         # stay Ready (controller-runtime semantics: readyz is a ping, else
         # rolling updates of a 2-replica deployment deadlock on the lease)
-        mgr.health_server.add_healthz_check(
-            "manager", lambda: mgr._thread is not None
-            and mgr._thread.is_alive())
+        mgr.health_server.add_healthz_check("manager", mgr.is_alive)
     return mgr

@@ -13,6 +13,7 @@ import base64
 import binascii
 import logging
 
+from ..cluster import errors
 from ..utils import k8s
 
 log = logging.getLogger("kubeflow_tpu.cacert")
@@ -88,20 +89,29 @@ def reconcile_ca_bundle(client, controller_namespace: str,
                                   WORKBENCH_BUNDLE)
     if bundle is None:
         if existing is not None:
-            client.delete("ConfigMap", user_namespace, WORKBENCH_BUNDLE)
+            try:
+                client.delete("ConfigMap", user_namespace, WORKBENCH_BUNDLE)
+            except errors.NotFoundError:
+                pass  # another worker's reconcile got there first
         return
     desired_data = {"ca-bundle.crt": bundle}
     if existing is None:
-        client.create({
-            "apiVersion": "v1",
-            "kind": "ConfigMap",
-            "metadata": {
-                "name": WORKBENCH_BUNDLE,
-                "namespace": user_namespace,
-                "labels": {"opendatahub.io/managed-by": "workbenches"},
-            },
-            "data": desired_data,
-        })
+        try:
+            client.create({
+                "apiVersion": "v1",
+                "kind": "ConfigMap",
+                "metadata": {
+                    "name": WORKBENCH_BUNDLE,
+                    "namespace": user_namespace,
+                    "labels": {"opendatahub.io/managed-by": "workbenches"},
+                },
+                "data": desired_data,
+            })
+        except errors.AlreadyExistsError:
+            pass  # two notebooks of one namespace reconciling in parallel
     elif existing.get("data") != desired_data:
         existing["data"] = desired_data
-        client.update(existing)
+        try:
+            client.update(existing)
+        except errors.ConflictError:
+            pass  # a parallel worker refreshed the same bundle; converged

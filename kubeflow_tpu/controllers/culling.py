@@ -165,6 +165,11 @@ class CullingReconciler:
                  clock: Callable[[], float] = time.time,
                  serving_prober: Callable[[dict, str], int | None]
                  | None = None):
+        # record write rvs → drop self-echo watch events (cluster/echo.py):
+        # the culler's own annotation patches must not re-trigger it (its
+        # cadence is the periodic requeue, not its writes)
+        from ..cluster.echo import EchoTrackingClient
+        client = EchoTrackingClient(client)
         self.client = client
         self.config = config or ControllerConfig()
         self.metrics = metrics or MetricsRegistry()
@@ -175,7 +180,7 @@ class CullingReconciler:
 
     def setup(self, mgr: Manager) -> None:
         mgr.register(self)
-        mgr.watch(api.KIND, self.name)
+        mgr.watch(api.KIND, self.name, predicate=self.client.not_echo)
 
     # ------------------------------------------------------------ reconcile
     def reconcile(self, req: Request) -> Result | None:

@@ -14,8 +14,57 @@ reproduced:
 Two drive modes:
 - ``run_until_idle()`` — deterministic draining for tests/benchmarks (the
   envtest suites effectively do this by polling with Eventually);
-- ``start()/stop()`` — background thread with timed requeues, the production
-  shape.
+- ``start()/stop()`` — a pool of ``max_concurrent_reconciles`` worker
+  threads with timed requeues, the production shape (controller-runtime's
+  MaxConcurrentReconciles; with 1 the pool degenerates to the classic
+  single dispatch thread).
+
+Dispatch state machine (client-go workqueue parity)
+---------------------------------------------------
+
+Each key is in at most one of three states; the combination gives the
+correctness contract concurrent dispatch must keep:
+
+- **queued** — an immediate item waits in the heap; further immediate adds
+  for the key coalesce (dropped).
+- **processing** — a worker is reconciling the key. A key being processed
+  is NEVER handed to a second worker.
+- **dirty** — an event arrived for a key that was processing; when the
+  worker finishes, the key is re-enqueued exactly once (client-go's dirty
+  set). A timed requeue that fires while its key is processing converts to
+  dirty the same way.
+
+Timed requeues (AddAfter) dedup per key on the EARLIEST pending deadline;
+superseded heap entries become ghosts discarded lazily at pop.
+
+Ordering: per key, a reconcile observes every add that happened before it
+started (level-triggered — state is re-read from the store, so coalescing
+loses no information). ACROSS keys there is no ordering guarantee once
+``max_concurrent_reconciles > 1``: two different keys reconcile in
+arbitrary order and in parallel.
+
+Workqueue metrics (attach_metrics; label ``name`` = controller name)
+--------------------------------------------------------------------
+
+- ``workqueue_adds_total`` — counter: every enqueue call (immediate or
+  timed), including adds coalesced into an existing queued/dirty state —
+  client-go counts Add() calls, not insertions.
+- ``workqueue_depth`` — gauge: live queued work = immediate queued keys +
+  earliest pending timed requeue per key. Excludes superseded timed
+  ghosts and items currently PROCESSING (those are visible in
+  ``workqueue_unfinished_work_seconds`` instead).
+- ``workqueue_queue_duration_seconds`` — histogram: time from an item
+  becoming ready (enqueue for immediate items, deadline for timed ones)
+  to a worker picking it up.
+- ``workqueue_work_duration_seconds`` — histogram: reconcile duration,
+  including the error path.
+- ``workqueue_retries_total`` — counter: error-backoff requeues
+  (AddRateLimited analog); reconcilers may also count their own
+  conflict-retry fast paths here (notebook.py's 409 helper does).
+- ``workqueue_unfinished_work_seconds`` — gauge: sum of in-flight
+  (processing) item ages at scrape time; 0 when nothing is processing.
+- ``workqueue_longest_running_processor_seconds`` — gauge: age of the
+  oldest in-flight item at scrape time.
 """
 
 from __future__ import annotations
@@ -63,60 +112,122 @@ class Manager:
     ERROR_BACKOFF_BASE = 0.005   # fast in-process analog of the 5ms rate-limiter base
     ERROR_BACKOFF_MAX = 2.0
 
-    def __init__(self, client, read_cache=None) -> None:
+    def __init__(self, client, read_cache=None,
+                 max_concurrent_reconciles: int = 4) -> None:
         self.client = client
         # shared informer layer (reference: the manager cache) — when set,
         # every watch this manager registers tees its events into the
         # cache and backfills the kind, so reconciler reads through the
         # cache are watch-fed without duplicate streams or GET storms
         self.read_cache = read_cache
+        # pool size AND the default per-controller in-flight cap
+        # (controller-runtime's MaxConcurrentReconciles; register() can
+        # lower it per controller). With 1 the manager is the classic
+        # single dispatch thread.
+        self.max_concurrent_reconciles = max(1, int(max_concurrent_reconciles))
         self._reconcilers: dict[str, Reconciler] = {}
+        # per-controller in-flight cap overrides (register kwarg)
+        self._max_concurrent: dict[str, int] = {}
         self._queue: list[_QueueItem] = []
         self._queued: set[tuple[str, Request]] = set()
         # earliest pending timed requeue per key — AddAfter dedup semantics
         # (controller-runtime's delaying queue coalesces by key; without this,
         # every watch event would spawn an extra periodic reconcile chain)
         self._timed_pending: dict[tuple[str, Request], float] = {}
+        # keys being reconciled right now → monotonic start time (feeds the
+        # unfinished-work/longest-running gauges); a processing key is never
+        # dispatched to a second worker
+        self._processing: dict[tuple[str, Request], float] = {}
+        # keys that received an immediate add (or a firing timed requeue)
+        # while processing — re-enqueued exactly once when the worker is done
+        self._dirty: set[tuple[str, Request]] = set()
+        # in-flight count per controller (enforces the per-controller cap)
+        self._active: dict[str, int] = {}
+        # ready items parked while their controller is at its cap — held
+        # off the heap (markers intact) so idle workers don't re-scan a
+        # saturated backlog on every wake; spliced back when a slot frees
+        self._capped: dict[str, list[_QueueItem]] = {}
         self._failures: dict[tuple[str, Request], int] = {}
         self._cv = threading.Condition()
         self._seq = 0
         self._running = False
-        self._thread: threading.Thread | None = None
+        self._threads: list[threading.Thread] = []
         self.healthz: dict[str, bool] = {}
-        # optional active/passive HA — when set, the loop parks (queue keeps
+        # optional active/passive HA — when set, workers park (queue keeps
         # accumulating watch events) until this replica holds the lease, the
         # same semantics as controller-runtime's --leader-elect
-        # (reference main.go:87-94)
+        # (reference main.go:87-94). In-flight work always quiesces before
+        # the manager yields: stop() joins the pool BEFORE releasing the
+        # lease, and a worker that observes a lost lease after popping
+        # returns the item to the queue untouched.
         self.leader_elector = None
         # optional healthz/readyz+metrics endpoints (reference main.go:125-133)
         self.health_server = None
         # optional HTTPS admission server (set by main.build_manager)
         self.webhook_server = None
         # controller-runtime parity metrics (attach_metrics):
-        # controller_runtime_reconcile_total{controller,result} and the
-        # workqueue depth gauge, computed at scrape
+        # controller_runtime_reconcile_total{controller,result} + the
+        # workqueue family documented in the module docstring
         self._reconcile_metric = None
+        self._wq_adds = None
+        self._wq_retries = None
+        self._wq_queue_duration = None
+        self._wq_work_duration = None
 
     def attach_metrics(self, registry) -> None:
         self._reconcile_metric = registry.counter(
             "controller_runtime_reconcile_total",
             "Total reconciliations per controller, by result.")
+        self._wq_adds = registry.counter(
+            "workqueue_adds_total",
+            "Total adds handled by the workqueue (every enqueue call, "
+            "coalesced or not).")
+        self._wq_retries = registry.counter(
+            "workqueue_retries_total",
+            "Total retries handled by the workqueue (error-backoff "
+            "requeues + reconciler conflict fast-retries).")
+        self._wq_queue_duration = registry.histogram(
+            "workqueue_queue_duration_seconds",
+            "How long an item stays ready in the workqueue before a "
+            "worker picks it up.")
+        self._wq_work_duration = registry.histogram(
+            "workqueue_work_duration_seconds",
+            "How long processing an item takes.")
         depth = registry.gauge(
             "workqueue_depth", "Current depth of the reconcile workqueue.")
+        unfinished = registry.gauge(
+            "workqueue_unfinished_work_seconds",
+            "Sum of in-flight (processing) item ages.")
+        longest = registry.gauge(
+            "workqueue_longest_running_processor_seconds",
+            "Age of the oldest in-flight item.")
 
         def scrape() -> None:
-            # count live work only: _queued (immediate) + _timed_pending
-            # (earliest timed requeue per key) — the raw heap also holds
-            # superseded ghost entries that _pop_ready discards lazily, and
-            # counting those over-reports depth
+            # depth counts live QUEUED work only: _queued (immediate) +
+            # _timed_pending (earliest timed requeue per key) — the raw heap
+            # also holds superseded ghost entries that the pop loop discards
+            # lazily, and counting those over-reports depth. In-flight
+            # (processing) items are NOT part of depth; they surface in the
+            # unfinished-work/longest-running gauges below.
             with self._cv:
+                now = time.monotonic()
                 per_controller: dict[str, int] = {}
                 for controller, _req in list(self._queued) + \
                         list(self._timed_pending):
                     per_controller[controller] = \
                         per_controller.get(controller, 0) + 1
+                unfinished_per: dict[str, float] = {}
+                longest_per: dict[str, float] = {}
+                for (controller, _req), started in self._processing.items():
+                    age = max(now - started, 0.0)
+                    unfinished_per[controller] = \
+                        unfinished_per.get(controller, 0.0) + age
+                    longest_per[controller] = \
+                        max(longest_per.get(controller, 0.0), age)
             for name in self._reconcilers:
                 depth.set(per_controller.get(name, 0), {"name": name})
+                unfinished.set(unfinished_per.get(name, 0.0), {"name": name})
+                longest.set(longest_per.get(name, 0.0), {"name": name})
         registry.on_scrape(scrape)
 
     def _count_reconcile(self, controller: str, result: str) -> None:
@@ -125,9 +236,17 @@ class Manager:
                                         "result": result})
 
     # ---------------------------------------------------------------- wiring
-    def register(self, reconciler: Reconciler) -> None:
+    def register(self, reconciler: Reconciler,
+                 max_concurrent_reconciles: int | None = None) -> None:
+        """Register a reconciler; ``max_concurrent_reconciles`` caps THIS
+        controller's in-flight reconciles (≤ the pool size is typical; 1
+        serializes the controller entirely). Default: the manager-wide
+        value."""
         self._reconcilers[reconciler.name] = reconciler
         self.healthz[reconciler.name] = True
+        if max_concurrent_reconciles is not None:
+            self._max_concurrent[reconciler.name] = \
+                max(1, int(max_concurrent_reconciles))
 
     def watch(self, kind: str, controller: str,
               mapper: Callable[[dict], list[Request]] | None = None,
@@ -172,8 +291,14 @@ class Manager:
 
     def enqueue(self, controller: str, req: Request, after: float = 0.0) -> None:
         with self._cv:
+            if self._wq_adds is not None:
+                self._wq_adds.inc({"name": controller})
             key = (controller, req)
             if after == 0.0:
+                if key in self._processing:
+                    # in-flight: mark dirty; _finish re-enqueues exactly once
+                    self._dirty.add(key)
+                    return
                 if key in self._queued:
                     return
                 self._queued.add(key)
@@ -195,70 +320,241 @@ class Manager:
             self._cv.notify_all()
 
     # --------------------------------------------------------------- driving
-    def _pop_ready(self, block: bool) -> _QueueItem | None:
+    def _cap(self, controller: str) -> int:
+        return self._max_concurrent.get(controller,
+                                        self.max_concurrent_reconciles)
+
+    def _consume_locked(self, item: _QueueItem,
+                        key: tuple[str, Request]) -> None:
+        """Remove a popped item's live-state marker (caller holds _cv)."""
+        if item.timed:
+            del self._timed_pending[key]
+        else:
+            self._queued.discard(key)
+
+    def _requeue_immediate_locked(self, controller: str, req: Request,
+                                  ready_at: float) -> None:
+        """Queue an immediate item unless one is already queued (caller
+        holds _cv). Shared by the dirty re-enqueue and the lost-lease
+        release paths — enqueue() is not used because these are internal
+        state transitions, not new adds (workqueue_adds_total must not
+        count them)."""
+        key = (controller, req)
+        if key not in self._queued:
+            self._queued.add(key)
+            self._seq += 1
+            heapq.heappush(self._queue,
+                           _QueueItem(ready_at, self._seq, controller, req))
+
+    def _unblock_locked(self, controller: str) -> None:
+        """A slot freed for ``controller``: return ONE of its cap-blocked
+        items to the heap (caller holds _cv). Items were stashed aside
+        instead of re-pushed so idle workers don't re-scan a saturated
+        controller's whole ready backlog on every wake — and each freed
+        slot serves exactly one item, so splicing one keeps that bound
+        (re-heaping the whole stash would re-park all but one of it per
+        completion: quadratic again). Ghosts (superseded timed entries)
+        are discarded here so a freed slot is never spent on one."""
+        blocked = self._capped.get(controller)
+        while blocked:
+            item = blocked.pop(0)
+            key = (item.controller, item.req)
+            if (item.timed and
+                    self._timed_pending.get(key) != item.ready_at) or \
+                    (not item.timed and key not in self._queued):
+                continue  # superseded while parked; discard the ghost
+            heapq.heappush(self._queue, item)
+            break
+        if not blocked:
+            self._capped.pop(controller, None)
+
+    def _dispatch_one(self, block: bool) -> _QueueItem | None:
+        """Pop the next DISPATCHABLE ready item and mark it processing.
+
+        Skips (a) superseded timed ghosts, (b) items whose key is already
+        processing — those convert to dirty, the queue entry is consumed —
+        and (c) items whose controller is at its in-flight cap — those
+        stay queued (stashed in _capped, returned to the heap when a slot
+        frees) while this call waits for a worker to finish."""
         with self._cv:
             while True:
                 now = time.monotonic()
-                if self._queue and self._queue[0].ready_at <= now:
+                found: _QueueItem | None = None
+                while self._queue and self._queue[0].ready_at <= now:
                     item = heapq.heappop(self._queue)
                     key = (item.controller, item.req)
                     if item.timed:
                         if self._timed_pending.get(key) != item.ready_at:
                             continue  # superseded by an earlier requeue; drop
-                        del self._timed_pending[key]
-                    else:
-                        self._queued.discard(key)
-                    return item
-                if not block:
+                    elif key not in self._queued:
+                        continue  # stale entry (defensive; should not happen)
+                    if key in self._processing:
+                        # firing while in-flight → dirty (state machine):
+                        # consume the queue entry, re-enqueue at _finish
+                        self._consume_locked(item, key)
+                        self._dirty.add(key)
+                        continue
+                    if self._active.get(item.controller, 0) >= \
+                            self._cap(item.controller):
+                        # cap-blocked: still queued (markers intact), but
+                        # parked OFF the heap so the next wake doesn't
+                        # re-scan the whole saturated backlog
+                        self._capped.setdefault(item.controller,
+                                                []).append(item)
+                        continue
+                    self._consume_locked(item, key)
+                    found = item
+                    break
+                if found is not None:
+                    started = time.monotonic()
+                    self._processing[(found.controller, found.req)] = started
+                    self._active[found.controller] = \
+                        self._active.get(found.controller, 0) + 1
+                    if self._wq_queue_duration is not None:
+                        self._wq_queue_duration.observe(
+                            max(started - found.ready_at, 0.0),
+                            {"name": found.controller})
+                    return found
+                if not block or not self._running:
                     return None
-                timeout = (self._queue[0].ready_at - now) if self._queue else None
-                if not self._running:
-                    return None
-                self._cv.wait(timeout=timeout if timeout is None or timeout > 0 else 0)
+                # wake on: an enqueue, a worker finishing (unparks a cap-
+                # blocked item or re-enqueues a dirty key), or the next
+                # FUTURE deadline. The pop loop above consumed every entry
+                # with ready_at <= now (cap-blocked ones moved to _capped),
+                # so the heap head IS the earliest future deadline — no
+                # zero timeout, no busy-spin.
+                next_future = self._queue[0].ready_at if self._queue else None
+                self._cv.wait(timeout=None if next_future is None
+                              else max(next_future - now, 0))
+
+    def _finish(self, item: _QueueItem) -> None:
+        """Worker is done with ``item``: clear processing state, return any
+        cap-blocked siblings to the heap, and re-enqueue the key iff it
+        went dirty while in flight."""
+        key = (item.controller, item.req)
+        with self._cv:
+            self._processing.pop(key, None)
+            self._active[item.controller] = \
+                max(0, self._active.get(item.controller, 1) - 1)
+            self._unblock_locked(item.controller)
+            if key in self._dirty:
+                self._dirty.discard(key)
+                self._requeue_immediate_locked(item.controller, item.req,
+                                               time.monotonic())
+            self._cv.notify_all()
+
+    def _release_undispatched(self, item: _QueueItem) -> None:
+        """Return a popped-but-unprocessed item to the queue UNTOUCHED
+        (lease moved between pop and process): clear processing state
+        without counting a reconcile, restore the item in its original
+        lane — a timed requeue keeps its deadline and AddAfter dedup
+        bookkeeping, an immediate item stays immediate — and surface any
+        dirty mark picked up while briefly marked processing as the
+        immediate re-run it represents."""
+        key = (item.controller, item.req)
+        with self._cv:
+            self._processing.pop(key, None)
+            self._active[item.controller] = \
+                max(0, self._active.get(item.controller, 1) - 1)
+            self._unblock_locked(item.controller)
+            if item.timed:
+                pending = self._timed_pending.get(key)
+                if pending is None or pending > item.ready_at:
+                    self._timed_pending[key] = item.ready_at
+                    self._seq += 1
+                    heapq.heappush(self._queue,
+                                   _QueueItem(item.ready_at, self._seq,
+                                              item.controller, item.req,
+                                              timed=True))
+            else:
+                self._requeue_immediate_locked(item.controller, item.req,
+                                               item.ready_at)
+            if key in self._dirty:
+                self._dirty.discard(key)
+                self._requeue_immediate_locked(item.controller, item.req,
+                                               time.monotonic())
+            self._cv.notify_all()
 
     def _process(self, item: _QueueItem) -> None:
         rec = self._reconcilers.get(item.controller)
         if rec is None:
             return
         key = (item.controller, item.req)
+        started = time.monotonic()
         try:
             result = rec.reconcile(item.req)
         except Exception as exc:  # noqa: BLE001 — error→requeue, never crash the loop
-            failures = self._failures.get(key, 0) + 1
-            self._failures[key] = failures
+            with self._cv:
+                failures = self._failures.get(key, 0) + 1
+                self._failures[key] = failures
             backoff = min(self.ERROR_BACKOFF_BASE * (2 ** failures),
                           self.ERROR_BACKOFF_MAX)
             log.warning("reconcile %s %s failed (%s); requeue in %.3fs",
                         item.controller, item.req, exc, backoff)
             self._count_reconcile(item.controller, "error")
+            if self._wq_retries is not None:
+                self._wq_retries.inc({"name": item.controller})
+            if self._wq_work_duration is not None:
+                self._wq_work_duration.observe(time.monotonic() - started,
+                                               {"name": item.controller})
             self.enqueue(item.controller, item.req, after=backoff)
             return
-        self._failures.pop(key, None)
+        with self._cv:
+            self._failures.pop(key, None)
         if result is not None and result.requeue_after is not None:
             self._count_reconcile(item.controller, "requeue_after")
-            self.enqueue(item.controller, item.req, after=result.requeue_after)
+            self.enqueue(item.controller, item.req,
+                         after=result.requeue_after)
         else:
             self._count_reconcile(item.controller, "success")
+        if self._wq_work_duration is not None:
+            self._wq_work_duration.observe(time.monotonic() - started,
+                                           {"name": item.controller})
 
     def run_until_idle(self, timeout: float = 30.0,
                        include_delayed_under: float = 0.0) -> int:
-        """Drain the queue synchronously; returns number of reconciles run.
-        Timed requeues further than ``include_delayed_under`` seconds out are
-        left pending (so periodic culler requeues don't spin forever)."""
+        """Drain the queue on the calling thread; returns the number of
+        reconciles THIS call ran. Timed requeues further than
+        ``include_delayed_under`` seconds out are left pending (so periodic
+        culler requeues don't spin forever).
+
+        Idle means: no live queued item within the window AND nothing
+        processing — with background workers running, this call drains
+        alongside them (respecting the per-key/per-controller invariants)
+        and does not return while their items are still in flight. Waits
+        ride the condition variable with a computed timeout; there is no
+        polling sleep."""
         deadline = time.monotonic() + timeout
         count = 0
         while time.monotonic() < deadline:
-            item = self._pop_ready(block=False)
-            if item is None:
-                with self._cv:
-                    upcoming = [q for q in self._queue
-                                if q.ready_at - time.monotonic() <= include_delayed_under]
-                if not upcoming:
-                    return count
-                time.sleep(0.001)
+            item = self._dispatch_one(block=False)
+            if item is not None:
+                try:
+                    self._process(item)
+                finally:
+                    self._finish(item)
+                count += 1
                 continue
-            self._process(item)
-            count += 1
+            with self._cv:
+                now = time.monotonic()
+                live = [q.ready_at for q in self._queue
+                        if (q.ready_at - now <= include_delayed_under)
+                        and (self._timed_pending.get(
+                                (q.controller, q.req)) == q.ready_at
+                             if q.timed
+                             else (q.controller, q.req) in self._queued)]
+                if not live and not self._processing:
+                    return count
+                ready_now = any(t <= now for t in live)
+                if ready_now and not self._processing:
+                    continue  # dispatchable again (e.g. a dirty re-add raced)
+                wait = deadline - now
+                next_future = min((t for t in live if t > now), default=None)
+                if next_future is not None and not self._processing:
+                    wait = min(wait, next_future - now)
+                if wait > 0:
+                    # woken by: enqueue, a worker finishing, or the timeout
+                    self._cv.wait(wait)
         return count
 
     def start(self) -> None:
@@ -270,41 +566,80 @@ class Manager:
             self.leader_elector.start()
         if self.health_server is not None:
             self.health_server.start()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="kubeflow-tpu-manager")
-        self._thread.start()
+        # pool size: the manager-wide MaxConcurrentReconciles, raised if a
+        # controller registered a higher per-controller cap (the cap could
+        # never be reached with fewer threads)
+        n = max(self.max_concurrent_reconciles,
+                *(self._max_concurrent.values() or (1,)))
+        self._threads = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"kubeflow-tpu-manager-{i}")
+            for i in range(n)]
+        for t in self._threads:
+            t.start()
 
-    def _loop(self) -> None:
+    def is_alive(self) -> bool:
+        """The FULL pool is running (healthz hook): a partially dead pool
+        silently sheds throughput and can strand in-flight keys, so it
+        must fail liveness like the old single dispatch thread did."""
+        return bool(self._threads) and all(t.is_alive()
+                                           for t in self._threads)
+
+    def _worker(self) -> None:
         while True:
             with self._cv:
                 if not self._running:
                     return
-            if self.leader_elector is not None and \
-                    not self.leader_elector.is_leader():
-                # parked standby; watches still enqueue. Leadership can't
-                # change faster than the renew loop, so pace on it instead
-                # of busy-polling.
-                time.sleep(min(self.leader_elector.renew_period / 4, 0.5))
-                continue
-            item = self._pop_ready(block=True)
-            if item is None:
-                continue
-            # re-check after the (possibly long) blocking pop: the lease may
-            # have moved while we slept — processing anyway would be
-            # split-brain with the new leader
-            if self.leader_elector is not None and \
-                    not self.leader_elector.is_leader():
-                self.enqueue(item.controller, item.req)
-                continue
-            self._process(item)
+            item: _QueueItem | None = None
+            try:
+                if self.leader_elector is not None and \
+                        not self.leader_elector.is_leader():
+                    # parked standby; watches still enqueue. Leadership
+                    # can't change faster than the renew loop, so pace on
+                    # it instead of busy-polling.
+                    time.sleep(min(self.leader_elector.renew_period / 4,
+                                   0.5))
+                    continue
+                item = self._dispatch_one(block=True)
+                if item is None:
+                    continue
+                # re-check after the (possibly long) blocking pop: the
+                # lease may have moved while we slept — processing anyway
+                # would be split-brain with the new leader
+                if self.leader_elector is not None and \
+                        not self.leader_elector.is_leader():
+                    self._release_undispatched(item)
+                    continue
+                try:
+                    self._process(item)
+                finally:
+                    self._finish(item)
+            except Exception:  # noqa: BLE001 — a worker must never die:
+                # _process already converts reconcile errors to backoff, so
+                # anything landing here is dispatch plumbing (a raising
+                # elector, metric callback, …). Log, release a held item so
+                # its key can't wedge in _processing, and keep serving.
+                log.exception("manager worker iteration failed; continuing")
+                if item is not None:
+                    with self._cv:
+                        held = (item.controller, item.req) in self._processing
+                    if held:
+                        try:
+                            self._finish(item)
+                        except Exception:  # noqa: BLE001
+                            log.exception("releasing item after worker "
+                                          "failure also failed")
 
     def stop(self) -> None:
         with self._cv:
             self._running = False
             self._cv.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        # quiesce the pool BEFORE yielding leadership: in-flight reconciles
+        # finish (or the join times out) while we still hold the lease, so
+        # a standby never runs concurrently with our workers
+        for t in self._threads:
+            t.join(timeout=5)
+        self._threads = []
         if self.leader_elector is not None:
             self.leader_elector.stop()
         if self.health_server is not None:

@@ -73,7 +73,11 @@ def reconcile_network_policies(client, notebook: dict,
     desired = [new_notebook_network_policy(notebook, controller_namespace)]
     if auth:
         desired.append(new_auth_proxy_network_policy(notebook))
-    else:
+    elif client.get_or_none("NetworkPolicy", ns,
+                            auth_policy_name(k8s.name(notebook))) is not None:
+        # existence-check first: NetworkPolicy is watch-cached, so the
+        # check is free — a blind delete is a wire DELETE-404 on every
+        # no-auth reconcile
         try:
             client.delete("NetworkPolicy", ns,
                           auth_policy_name(k8s.name(notebook)))

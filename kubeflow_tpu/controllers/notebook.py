@@ -50,10 +50,21 @@ class NotebookReconciler:
 
     def __init__(self, client, config: ControllerConfig | None = None,
                  metrics: MetricsRegistry | None = None):
+        # every write records its rv so our watches drop the echo of our
+        # own writes (cluster/echo.py — essential once the manager runs
+        # concurrent workers: echoes no longer vanish into queue backlog)
+        from ..cluster.echo import EchoTrackingClient
+        client = EchoTrackingClient(client)
         self.client = client
         self.config = config or ControllerConfig()
         self.metrics = metrics or MetricsRegistry()
         self.metrics.on_scrape(self._scrape_running)
+        # conflict fast-retries land in the standard workqueue retry counter
+        # (get-or-create: shares the series the manager registers)
+        self._wq_retries = self.metrics.counter(
+            "workqueue_retries_total",
+            "Total retries handled by the workqueue (error-backoff "
+            "requeues + reconciler conflict fast-retries).")
         self.recorder = events.EventRecorder(client, component=self.name)
         # watch-fed read cache for the Event predicate (built in setup();
         # reconcilers constructed without setup() fall back to live reads)
@@ -85,10 +96,15 @@ class NotebookReconciler:
                                   auto_informer=False)
             tee = cache.feed
         self._read_cache = cache
-        mgr.watch(api.KIND, self.name, tee=tee)
+        # predicate: drop the echoes of our own status/STS/Service writes —
+        # they carry no new state and each would cost a full reconcile once
+        # workers > 1 keep the queue too shallow to coalesce them
+        ne = self.client.not_echo
+        mgr.watch(api.KIND, self.name, tee=tee, predicate=ne)
         mgr.watch("StatefulSet", self.name, mapper=owner_mapper(api.KIND),
-                  tee=tee)
-        mgr.watch("Service", self.name, mapper=owner_mapper(api.KIND))
+                  tee=tee, predicate=ne)
+        mgr.watch("Service", self.name, mapper=owner_mapper(api.KIND),
+                  predicate=ne)
         mgr.watch("Pod", self.name, mapper=label_mapper(names.NOTEBOOK_NAME_LABEL),
                   tee=tee)
         # backfill AFTER the watches above are live (watch-then-list: no
@@ -107,7 +123,8 @@ class NotebookReconciler:
         mgr.watch(events.EVENT_KIND, self.name,
                   predicate=self._pred_nb_events)
         if self.config.use_istio:
-            mgr.watch("VirtualService", self.name, mapper=owner_mapper(api.KIND))
+            mgr.watch("VirtualService", self.name,
+                      mapper=owner_mapper(api.KIND), predicate=ne)
 
     def _pred_nb_events(self, watch_event) -> bool:
         if watch_event.type == "DELETED":
@@ -407,6 +424,31 @@ class NotebookReconciler:
         return svc
 
     # --------------------------------------------------- create-or-update
+    def _update_with_conflict_retry(self, desired: dict, found: dict,
+                                    copy_fields) -> None:
+        """409 fast path: with concurrent workers, an update can race the
+        culler's annotation patches (or the other reconciler) and conflict.
+        Burning a full error-backoff requeue for that is wasteful — instead
+        re-read LIVE, re-diff against the SAME desired state, and retry
+        ONCE (controller-runtime reconcilers use RetryOnConflict the same
+        way). A still-conflicting retry is dropped: the foreign write that
+        keeps winning also re-enqueues this key through the watch, so the
+        next reconcile re-converges level-triggered. Retries are counted
+        in workqueue_retries_total."""
+        try:
+            self.client.update(found)
+            return
+        except errors.ConflictError:
+            pass
+        self._wq_retries.inc({"name": self.name})
+        from ..cluster.cache import live_reader
+        live = live_reader(self.client)
+        errors.update_with_conflict_retry(
+            self.client,
+            lambda: live.get_or_none(k8s.kind(found), k8s.namespace(found),
+                                     k8s.name(found)),
+            lambda fresh: copy_fields(desired, fresh), attempts=1)
+
     def _find_owned_sts(self, notebook: dict) -> dict | None:
         """Find the STS for a notebook, robust to GenerateName (lookup by
         notebook-name label + owner uid rather than name)."""
@@ -437,15 +479,18 @@ class NotebookReconciler:
                 fixed = self.generate_statefulset(
                     notebook, slice_spec, actual_sts_name=k8s.name(created))
                 if copy_statefulset_fields(fixed, created):
-                    self.client.update(created)
+                    self._update_with_conflict_retry(
+                        fixed, created, copy_statefulset_fields)
             return
         if copy_statefulset_fields(desired, found):
-            self.client.update(found)
+            self._update_with_conflict_retry(desired, found,
+                                             copy_statefulset_fields)
 
     def _create_or_update(self, desired: dict, copy_fields) -> None:
         """Create-or-idempotent-update for a named desired object: swallow
         the create race (another worker got there first; the watch re-enqueues)
-        and only update when copy_fields reports drift."""
+        and retry a conflicting update once before falling back to error
+        backoff."""
         found = self.client.get_or_none(k8s.kind(desired),
                                         k8s.namespace(desired),
                                         k8s.name(desired))
@@ -456,7 +501,7 @@ class NotebookReconciler:
                 pass
             return
         if copy_fields(desired, found):
-            self.client.update(found)
+            self._update_with_conflict_retry(desired, found, copy_fields)
 
     def _reconcile_service(self, notebook: dict,
                            slice_spec: SliceSpec | None) -> None:
@@ -587,19 +632,28 @@ def virtual_service_name(notebook_name: str, namespace: str) -> str:
 
 
 # -------------------------------------------------------------- copy-fields
+def _copy_meta_maps(desired: dict, found: dict) -> bool:
+    """Copy labels/annotations when they MATERIALLY differ. An absent map
+    and an empty map are the same state — comparing them unequal made
+    every notebook burn one spurious Service PUT per fan-out (the desired
+    Service carries no annotations key; the stored object returns None)."""
+    changed = False
+    for field in ("labels", "annotations"):
+        want = desired["metadata"].get(field) or {}
+        have = found["metadata"].get(field) or {}
+        if have != want:
+            found["metadata"][field] = k8s.deepcopy(want)
+            changed = True
+    return changed
+
+
 def copy_statefulset_fields(desired: dict, found: dict) -> bool:
     """Idempotent-update semantics of reconcilehelper.CopyStatefulSetFields
     (components/common/reconcilehelper/util.go:107-143): copy labels,
     annotations, replicas and pod template; leave everything else (incl.
     selector, serviceName on an existing object) untouched. Returns whether
     an update is required."""
-    changed = False
-    for field in ("labels", "annotations"):
-        want = desired["metadata"].get(field, {})
-        have = found["metadata"].get(field)
-        if have != want:
-            found["metadata"][field] = k8s.deepcopy(want)
-            changed = True
+    changed = _copy_meta_maps(desired, found)
     if found["spec"].get("replicas") != desired["spec"].get("replicas"):
         found["spec"]["replicas"] = desired["spec"]["replicas"]
         changed = True
@@ -612,13 +666,7 @@ def copy_statefulset_fields(desired: dict, found: dict) -> bool:
 def copy_virtual_service_fields(desired: dict, found: dict) -> bool:
     """reconcilehelper.CopyVirtualService (util.go:197-219): labels,
     annotations, and the whole (unstructured) spec."""
-    changed = False
-    for field in ("labels", "annotations"):
-        want = desired["metadata"].get(field, {})
-        have = found["metadata"].get(field)
-        if have != want:
-            found["metadata"][field] = k8s.deepcopy(want)
-            changed = True
+    changed = _copy_meta_maps(desired, found)
     if found.get("spec") != desired.get("spec"):
         found["spec"] = k8s.deepcopy(desired["spec"])
         changed = True
@@ -628,13 +676,7 @@ def copy_virtual_service_fields(desired: dict, found: dict) -> bool:
 def copy_service_fields(desired: dict, found: dict) -> bool:
     """reconcilehelper.CopyServiceFields (util.go:170-195): labels,
     annotations, selector and ports only — NEVER clusterIP (util.go:182)."""
-    changed = False
-    for field in ("labels", "annotations"):
-        want = desired["metadata"].get(field, {})
-        have = found["metadata"].get(field)
-        if have != want:
-            found["metadata"][field] = k8s.deepcopy(want)
-            changed = True
+    changed = _copy_meta_maps(desired, found)
     if found["spec"].get("selector") != desired["spec"].get("selector"):
         found["spec"]["selector"] = k8s.deepcopy(desired["spec"]["selector"])
         changed = True

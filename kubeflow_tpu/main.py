@@ -44,6 +44,7 @@ def build_manager(store=None, config: ControllerConfig | None = None, *,
                   cert_dir: str | None = None,
                   simulate_kubelet: bool = False,
                   components: str = "all",
+                  max_concurrent_reconciles: int | None = None,
                   on_tls_change=None):
     """Compose the full production stack; returns (manager, shutdown_event).
 
@@ -79,7 +80,8 @@ def build_manager(store=None, config: ControllerConfig | None = None, *,
     # watch streams and snapshot LISTs
     mgr = setup_controllers(store, config, leader_elect=leader_elect,
                             health_port=health_port, core=core,
-                            extension=extension, webhooks=extension)
+                            extension=extension, webhooks=extension,
+                            max_concurrent_reconciles=max_concurrent_reconciles)
     client = mgr.client  # the cached view (Secret/CM/Event reads stay live)
 
     profile = tls_profile.fetch_apiserver_tls_profile(store)
@@ -129,6 +131,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                          "(absent → plain HTTP, dev only)")
     ap.add_argument("--simulate-kubelet", action="store_true",
                     help="run the StatefulSet/pod simulator (standalone)")
+    ap.add_argument("--max-concurrent-reconciles", type=int, default=None,
+                    metavar="N",
+                    help="dispatch worker-pool size (controller-runtime "
+                         "MaxConcurrentReconciles; default from "
+                         "MAX_CONCURRENT_RECONCILES env, 4; 1 = the "
+                         "classic single dispatch thread)")
     ap.add_argument("--components", choices=("all", "core", "extension"),
                     default="all",
                     help="which manager to run: 'core' = the "
@@ -212,6 +220,7 @@ def main(argv=None) -> int:
         webhook_port=args.webhook_port or None,
         cert_dir=args.cert_dir,
         components=args.components,
+        max_concurrent_reconciles=args.max_concurrent_reconciles,
         simulate_kubelet=args.simulate_kubelet and client is None)
 
     apiserver = None

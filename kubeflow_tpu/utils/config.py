@@ -59,6 +59,9 @@ class ControllerConfig:
     # analog; env-overridable so multi-process failover tests can shrink it)
     leader_lease_duration_s: float = 15.0
     leader_renew_period_s: float = 2.0
+    # dispatch worker-pool size (controller-runtime MaxConcurrentReconciles;
+    # 1 = the classic single dispatch thread)
+    max_concurrent_reconciles: int = 4
     # TPU-native
     tpu_default_image: str = "us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest"
     image_swap_map: dict = field(default_factory=dict)  # cuda image → jax/libtpu image
@@ -87,6 +90,8 @@ class ControllerConfig:
             inject_cluster_proxy_env=_env_bool("INJECT_CLUSTER_PROXY_ENV", False),
             leader_lease_duration_s=float(env.get("LEADER_LEASE_DURATION", "15")),
             leader_renew_period_s=float(env.get("LEADER_RENEW_PERIOD", "2")),
+            max_concurrent_reconciles=int(
+                env.get("MAX_CONCURRENT_RECONCILES", "4")),
             tpu_default_image=env.get(
                 "TPU_NOTEBOOK_IMAGE",
                 "us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest"),

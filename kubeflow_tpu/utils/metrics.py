@@ -60,6 +60,71 @@ class _Metric:
         return "\n".join(lines)
 
 
+# workqueue latencies span sub-ms (in-process store) to tens of seconds
+# (big wire fan-outs); client-go's exponential buckets cover the same range
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0,
+                   30.0, 60.0)
+
+
+class _Histogram:
+    """Prometheus histogram (cumulative ``_bucket{le=...}`` + ``_sum`` +
+    ``_count`` exposition). Fixed buckets, chosen at registration."""
+
+    def __init__(self, name: str, help_: str,
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.type = "histogram"
+        self.buckets = tuple(sorted(buckets))
+        # labels key → [per-bucket counts..., +Inf count, sum]
+        self._series: dict[tuple, list[float]] = {}
+        self._lock = threading.Lock()
+
+    def _labels_key(self, labels: dict[str, str] | None) -> tuple:
+        return tuple(sorted((labels or {}).items()))
+
+    def observe(self, value: float,
+                labels: dict[str, str] | None = None) -> None:
+        key = self._labels_key(labels)
+        with self._lock:
+            series = self._series.get(key)
+            if series is None:
+                series = self._series[key] = [0.0] * (len(self.buckets) + 2)
+            for i, le in enumerate(self.buckets):
+                if value <= le:
+                    series[i] += 1
+            series[-2] += 1          # +Inf / _count
+            series[-1] += value      # _sum
+
+    def count(self, labels: dict[str, str] | None = None) -> float:
+        with self._lock:
+            series = self._series.get(self._labels_key(labels))
+        return series[-2] if series else 0.0
+
+    def sum(self, labels: dict[str, str] | None = None) -> float:
+        with self._lock:
+            series = self._series.get(self._labels_key(labels))
+        return series[-1] if series else 0.0
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.type}"]
+        with self._lock:
+            items = sorted((k, list(v)) for k, v in self._series.items())
+        for key, series in items:
+            base = ",".join(f'{k}="{v}"' for k, v in key)
+            for i, le in enumerate(self.buckets):
+                label_s = (base + "," if base else "") + f'le="{le:g}"'
+                lines.append(f"{self.name}_bucket{{{label_s}}} "
+                             f"{series[i]:g}")
+            label_s = (base + "," if base else "") + 'le="+Inf"'
+            lines.append(f"{self.name}_bucket{{{label_s}}} {series[-2]:g}")
+            suffix = f"{{{base}}}" if base else ""
+            lines.append(f"{self.name}_sum{suffix} {series[-1]:g}")
+            lines.append(f"{self.name}_count{suffix} {series[-2]:g}")
+        return "\n".join(lines)
+
+
 class MetricsRegistry:
     """Registry + the reference's notebook metric set. ``scrape_callbacks``
     mirrors the reference's collector that computes ``notebook_running`` at
@@ -102,6 +167,15 @@ class MetricsRegistry:
         self._metrics[name] = m
         return m
 
+    def histogram(self, name: str, help_: str,
+                  buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> _Histogram:
+        existing = self._metrics.get(name)
+        if existing is not None:
+            return existing
+        m = _Histogram(name, help_, buckets)
+        self._metrics[name] = m
+        return m
+
     def on_scrape(self, fn: Callable[[], None]) -> None:
         self._scrape_callbacks.append(fn)
 
@@ -110,6 +184,9 @@ class MetricsRegistry:
         self.last_culling_timestamp.set(time.time())
 
     def expose(self) -> str:
-        for fn in self._scrape_callbacks:
+        # snapshot both collections: a concurrent worker registering a
+        # metric mid-scrape must not blow up the exposition iteration
+        for fn in list(self._scrape_callbacks):
             fn()
-        return "\n".join(m.expose() for m in self._metrics.values()) + "\n"
+        return "\n".join(m.expose()
+                         for m in list(self._metrics.values())) + "\n"

@@ -67,7 +67,8 @@ spec:
 
 
 def run_inprocess(count: int, namespace: str, accelerator: str,
-                  timeout: float, server: str | None = None) -> int:
+                  timeout: float, server: str | None = None,
+                  workers: int = 4) -> int:
     """Default: drive the in-process control plane. With ``server``: the
     same fan-out over REAL HTTP against a running apiserver (start one with
     ``python -m kubeflow_tpu.main --serve-apiserver PORT --simulate-kubelet``)
@@ -85,7 +86,7 @@ def run_inprocess(count: int, namespace: str, accelerator: str,
         from kubeflow_tpu.controllers import setup_controllers
 
         store = ClusterStore()
-        mgr = setup_controllers(store)
+        mgr = setup_controllers(store, max_concurrent_reconciles=workers)
         StatefulSetSimulator(store, boot_delay_s=0.0).setup(mgr)
         mgr.start()
     created: dict[str, float] = {}
@@ -115,7 +116,7 @@ def run_inprocess(count: int, namespace: str, accelerator: str,
         print(f"FAIL: only {len(ready)}/{count} notebooks became SliceReady "
               f"within {timeout}s")
         return 1
-    print(f"notebooks: {count}  wall: {total:.2f}s  "
+    print(f"notebooks: {count}  workers: {workers}  wall: {total:.2f}s  "
           f"throughput: {count/total:.1f} nb/s")
     _print_latencies(sorted(ready.values()))
     return 0
@@ -131,7 +132,8 @@ def _print_latencies(lat: list[float]) -> None:
 
 
 def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
-             max_requests_per_nb: float | None = None) -> int:
+             max_requests_per_nb: float | None = None,
+             workers: int = 4, apiserver_latency_ms: float = 0.0) -> int:
     """Controller wire-cost measurement: the full controller stack runs
     over a real HTTP apiserver while the load generator drives the store
     directly, so ``rest_client_requests_total`` counts ONLY controller
@@ -156,13 +158,15 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
         StatefulSetSimulator(store, boot_delay_s=0.0).setup(sim_mgr)
         sim_mgr.start()
         cleanups.append(sim_mgr.stop)
-        proxy = ApiServerProxy(store)
+        proxy = ApiServerProxy(store,
+                               latency_s=apiserver_latency_ms / 1000.0)
         proxy.start()
         cleanups.append(proxy.stop)
         client = HttpApiClient(proxy.url)
         cleanups.append(client.close)
         metrics = MetricsRegistry()
-        mgr = setup_controllers(client, metrics=metrics)
+        mgr = setup_controllers(client, metrics=metrics,
+                                max_concurrent_reconciles=workers)
         mgr.start()
         cleanups.append(mgr.stop)
         requests = metrics.counter("rest_client_requests_total", "")
@@ -210,7 +214,7 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
             print(f"FAIL: only {ready}/{count} notebooks became SliceReady "
                   f"within {timeout}s")
             return 1
-        print(f"notebooks: {count}  wall: {wall:.2f}s  "
+        print(f"notebooks: {count}  workers: {workers}  wall: {wall:.2f}s  "
               f"controller apiserver requests/notebook: {per_nb:.1f}")
         _print_latencies(sorted(ready_at[n] - created_at[n]
                                 for n in ready_at))
@@ -244,6 +248,14 @@ def main() -> int:
     ap.add_argument("--max-requests-per-nb", type=float, default=None,
                     help="with --wire: fail if controller apiserver "
                          "requests per notebook exceed this bound")
+    ap.add_argument("--workers", type=int, default=4,
+                    help="manager MaxConcurrentReconciles (dispatch "
+                         "worker-pool size; 1 = single-thread baseline)")
+    ap.add_argument("--apiserver-latency-ms", type=float, default=0.0,
+                    help="with --wire: inject this request round-trip "
+                         "latency at the apiserver (a localhost facade "
+                         "has ~0 RTT; production apiservers have 1-10 ms "
+                         "— the regime concurrent dispatch exists for)")
     args = ap.parse_args()
     if args.emit_yaml:
         try:
@@ -256,9 +268,12 @@ def main() -> int:
     if args.wire:
         return run_wire(args.count, args.namespace, args.accelerator,
                         args.timeout,
-                        max_requests_per_nb=args.max_requests_per_nb)
+                        max_requests_per_nb=args.max_requests_per_nb,
+                        workers=args.workers,
+                        apiserver_latency_ms=args.apiserver_latency_ms)
     return run_inprocess(args.count, args.namespace, args.accelerator,
-                         args.timeout, server=args.server)
+                         args.timeout, server=args.server,
+                         workers=args.workers)
 
 
 if __name__ == "__main__":

@@ -140,3 +140,56 @@ class TestCachingClientGuarantee:
         assert "managedFields" not in obj["metadata"]
         assert LAST_APPLIED_ANNOTATION not in obj["metadata"]["annotations"]
         assert obj["metadata"]["annotations"]["keep"] == "me"
+
+
+class TestWriteThroughIngest:
+    """Writes feed their responses into the cache (read-your-writes for
+    the author) without breaking the DELETE tombstone guard."""
+
+    def test_create_response_visible_before_watch_event(self):
+        """A warm payload kind must not report the author's own fresh
+        create as an authoritative NotFound (the wire-client window where
+        the confirming watch event is still in flight)."""
+        store = ClusterStore()
+        client = CachingClient(store, auto_informer=False,
+                               disable_for=("ConfigMap",))
+        client.backfill("ConfigMap")  # warm, empty
+        client.create({"kind": "ConfigMap", "apiVersion": "v1",
+                       "metadata": {"name": "cm", "namespace": "ns"},
+                       "data": {"k": "v"}})
+        got = client.get("ConfigMap", "ns", "cm")
+        assert got["data"] == {"k": "v"}  # payload read still live
+
+    def test_late_update_response_does_not_resurrect_deleted_object(self):
+        """update/patch responses must NOT clear a DELETE tombstone: a
+        worker's successful update racing another worker's delete would
+        otherwise re-cache the pre-delete object forever (no later watch
+        event ever evicts it)."""
+        from kubeflow_tpu.cluster.store import WatchEvent
+        store = ClusterStore()
+        client = CachingClient(store, auto_informer=False, disable_for=())
+        client.backfill("ConfigMap")
+        created = client.create({"kind": "ConfigMap", "apiVersion": "v1",
+                                 "metadata": {"name": "cm",
+                                              "namespace": "ns"}})
+        # worker B's update succeeds server-side...
+        updated = store.update(created)
+        # ...then worker A's delete lands and its DELETED event is fed
+        client.feed(WatchEvent("DELETED", updated))
+        # ...and only now B's (late) response would be ingested
+        client._ingest_write(updated)
+        assert client.get_or_none("ConfigMap", "ns", "cm") is None
+
+    def test_create_after_delete_is_a_genuine_recreate(self):
+        from kubeflow_tpu.cluster.store import WatchEvent
+        store = ClusterStore()
+        client = CachingClient(store, auto_informer=False, disable_for=())
+        client.backfill("ConfigMap")
+        created = client.create({"kind": "ConfigMap", "apiVersion": "v1",
+                                 "metadata": {"name": "cm",
+                                              "namespace": "ns"}})
+        client.feed(WatchEvent("DELETED", created))
+        store.delete("ConfigMap", "ns", "cm")
+        client.create({"kind": "ConfigMap", "apiVersion": "v1",
+                       "metadata": {"name": "cm", "namespace": "ns"}})
+        assert client.get_or_none("ConfigMap", "ns", "cm") is not None

@@ -1,0 +1,12 @@
+"""Tier-1 wiring for the dispatch-regression wire smoke (ci/loadtest_smoke).
+
+Runs the real wire stack — controllers over a local HTTP apiserver with a
+4-worker dispatch pool — at a 50-notebook fan-out with a hard wall-clock
+budget, so a dispatch regression (pool deadlock, queue O(N^2), lost
+reconciles) fails the unit gate instead of waiting for a manual loadtest."""
+
+from ci.loadtest_smoke import run_smoke
+
+
+def test_wire_smoke_50_notebooks_4_workers():
+    assert run_smoke(count=50, workers=4, budget_s=60.0) == 0

@@ -105,3 +105,62 @@ def test_trusted_ca_mounted_on_update_when_source_appears_later(world):
     mounts = api.notebook_container(nb).get("volumeMounts", [])
     assert any(m.get("mountPath", "").startswith("/etc/pki/tls")
                for m in mounts)
+
+
+class ConflictOnce:
+    """Client wrapper: the first ``update`` of the targeted kind raises 409
+    (as if a concurrent worker/culler wrote between our read and write)."""
+
+    def __init__(self, store, kind):
+        self._store = store
+        self._kind = kind
+        self.conflicts_left = 1
+        self.update_calls = 0
+
+    def update(self, obj):
+        from kubeflow_tpu.cluster import errors
+        self.update_calls += 1
+        if obj.get("kind") == self._kind and self.conflicts_left > 0:
+            self.conflicts_left -= 1
+            raise errors.ConflictError(
+                f"simulated 409 on {self._kind}")
+        return self._store.update(obj)
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+
+def test_statefulset_update_conflict_retries_once_without_backoff():
+    """The 409 fast path (notebook.py _update_with_conflict_retry):
+    a conflicting STS update re-reads + re-diffs + retries in the SAME
+    reconcile — no error-backoff requeue — and the retry is counted in
+    workqueue_retries_total."""
+    from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+    store = ClusterStore()
+    client = ConflictOnce(store, "StatefulSet")
+    metrics = MetricsRegistry()
+    mgr = setup_controllers(client, ControllerConfig(), metrics=metrics,
+                            extension=False, webhooks=False,
+                            cached_reads=False)
+    store.create(api.new_notebook("nb", "user-ns", image="jupyter:2024a"))
+    drain(mgr)
+    # drift the STS so reconcile needs an update, then reconcile with the
+    # first update conflicting
+    nb = store.get(api.KIND, "user-ns", "nb")
+    api.notebook_container(nb)["image"] = "jupyter:2024b"
+    store.update(nb)
+    errors_before = metrics.counter(
+        "controller_runtime_reconcile_total", "").get(
+        {"controller": "notebook-controller", "result": "error"})
+    drain(mgr)
+    sts = store.get("StatefulSet", "user-ns", "nb")
+    container = k8s.get_in(sts, "spec", "template", "spec", "containers")[0]
+    assert container["image"] == "jupyter:2024b"  # retry applied the update
+    assert client.conflicts_left == 0              # the 409 actually fired
+    retries = metrics.counter("workqueue_retries_total", "")
+    assert retries.get({"name": "notebook-controller"}) == 1
+    errors_after = metrics.counter(
+        "controller_runtime_reconcile_total", "").get(
+        {"controller": "notebook-controller", "result": "error"})
+    assert errors_after == errors_before  # no error-backoff requeue burned
