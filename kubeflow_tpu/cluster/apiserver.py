@@ -24,14 +24,16 @@ from __future__ import annotations
 import json
 import logging
 import queue
+import socket
 import ssl
+import struct
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..utils import k8s, names
-from . import restmapper
+from . import faults, restmapper
 from .errors import ApiError, NotFoundError
 from .store import WatchEvent
 
@@ -77,6 +79,16 @@ class _Route:
         self.name = name
         self.subresource = subresource
         self.tail = tail
+
+
+def _wire_verb(method: str, route: _Route, is_watch: bool) -> str:
+    """Map a request to the client-go verb vocabulary a FaultPlan rules on."""
+    if method == "GET":
+        if is_watch:
+            return "watch"
+        return "get" if route.name else "list"
+    return {"POST": "create", "PUT": "update", "PATCH": "patch",
+            "DELETE": "delete"}.get(method, method.lower())
 
 
 def _parse_path(path: str) -> _Route | None:
@@ -137,14 +149,26 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
+        # audit BEFORE the body reaches the socket: once the client sees
+        # the response it may issue its next request, and that request's
+        # audit line must not be able to overtake this one (the
+        # idempotency checker replays the trail in order)
+        self._audit_now()
         self.wfile.write(data)
 
-    def _send_error_status(self, code: int, reason: str, message: str) -> None:
+    def _send_error_status(self, code: int, reason: str, message: str,
+                           retry_after_s: float | None = None) -> None:
         data = _status_body(code, reason, message)
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        if retry_after_s is not None:
+            # real apiserver priority-and-fairness sends integer seconds;
+            # sub-second plans still need pacing, so send the raw float
+            # (HttpApiClient parses either)
+            self.send_header("Retry-After", f"{retry_after_s:g}")
         self.end_headers()
+        self._audit_now()  # same ordering argument as _send_json
         self.wfile.write(data)
 
     def _send_api_error(self, err: ApiError) -> None:
@@ -158,19 +182,34 @@ class _Handler(BaseHTTPRequestHandler):
         self._last_status = code
         super().send_response(code, message)
 
+    def _audit_now(self) -> None:
+        """Write this request's audit line exactly once (first caller
+        wins: the response senders call it pre-body, the dispatch finally
+        is the catch-all)."""
+        method = getattr(self, "_audit_method", None)
+        if method is None or getattr(self, "_audited", True):
+            return
+        self._audited = True
+        self._audit(method, self._audit_path)
+
     def _audit(self, method: str, path: str) -> None:
-        """One NDJSON line per mutating request (verb, path, peer, the
-        RESPONSE status so denied/failed mutations are distinguishable,
-        RFC3339 timestamp) — the analog of the reference test suite's
-        optional apiserver audit log (odh suite_test.go:127-157). Reads
-        are skipped (GET/watch volume would drown the trail) and an audit
-        write failure must never break serving."""
+        """One NDJSON line per mutating request (verb, path, the resource
+        NAME — for POST the server-assigned one, so retried creates are
+        attributable to one object — peer, the RESPONSE status so
+        denied/failed mutations are distinguishable, RFC3339 timestamp) —
+        the analog of the reference test suite's optional apiserver audit
+        log (odh suite_test.go:127-157). The chaos soak's idempotency
+        check greps this trail: two 201s for one (path, name) would mean
+        a retried create double-applied. Reads are skipped (GET/watch
+        volume would drown the trail) and an audit write failure must
+        never break serving."""
         audit = getattr(self.server, "audit_log", None)
         if audit is None or method == "GET":
             return
         line = json.dumps({
             "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "verb": method, "path": path,
+            "name": getattr(self, "_audit_name", None),
             "status": getattr(self, "_last_status", None),
             "peer": self.address_string(),
         }) + "\n"
@@ -183,6 +222,16 @@ class _Handler(BaseHTTPRequestHandler):
             log.warning("audit write failed: %s", exc)
 
     def _dispatch(self, method: str) -> None:
+        # audit bookkeeping for THIS request (handler instances are
+        # per-connection, reused across keep-alive requests — reset all
+        # of it): the line is written by whichever response sender runs
+        # first (_audit_now before the body bytes, so a client's next
+        # request can't overtake its own trail), the finally is the
+        # catch-all for paths that never send a full response
+        self._audit_method = method
+        self._audit_path = urlparse(self.path).path
+        self._audit_name = None
+        self._audited = False
         latency = getattr(self.server, "latency_s", 0.0)
         if latency:
             # emulated network+processing round trip (ApiServerProxy
@@ -198,6 +247,25 @@ class _Handler(BaseHTTPRequestHandler):
             return
         parsed = urlparse(self.path)
         if parsed.path in ("/healthz", "/readyz", "/livez"):
+            # health endpoints are NOT exempt from wire faults (matched as
+            # GET with no kind): a partitioned or dead apiserver cannot
+            # answer its own readyz either, so FaultPlan.outage() must
+            # fail the breaker's ping probe too, or the breaker would
+            # flap closed on a clean 200 one probe interval after opening
+            plan = getattr(self.server, "fault_plan", None)
+            rule = plan.decide("get", None) if plan is not None else None
+            if rule is not None:
+                if rule.fault == faults.FAULT_LATENCY:
+                    time.sleep(rule.latency_s)
+                elif rule.fault == faults.FAULT_RESET:
+                    self._inject_reset()
+                    return
+                elif rule.fault == faults.FAULT_HTTP:
+                    self._send_error_status(
+                        rule.status, rule.reason,
+                        f"injected {rule.status} fault",
+                        retry_after_s=rule.retry_after_s)
+                    return
             self.send_response(200)
             self.send_header("Content-Type", "text/plain")
             self.send_header("Content-Length", "2")
@@ -209,6 +277,43 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_status(404, "NotFound",
                                     f"unrecognized path {parsed.path}")
             return
+        # ------------------------------------------------ fault injection
+        # (FaultPlan, cluster/faults.py): decided per request AFTER auth
+        # and routing — the plan speaks the verb/kind vocabulary — but
+        # BEFORE the handler for unambiguous faults (429/5xx: the real
+        # apiserver rejects those before processing). Connection resets
+        # instead run the handler and truncate the response: the mutation
+        # HAS applied, the client cannot know — the ambiguity retried
+        # creates must disambiguate. Health endpoints stay exempt above.
+        self._audit_name = route.name  # POST overwrites with the created name
+        self._watch_kill_after = None
+        reset_rule = None
+        plan = getattr(self.server, "fault_plan", None)
+        if plan is not None:
+            is_watch = method == "GET" and \
+                parse_qs(parsed.query).get("watch", ["false"])[-1] in \
+                ("true", "1")
+            verb = _wire_verb(method, route, is_watch)
+            rule = plan.decide(verb, route.mapping.kind)
+            if rule is not None:
+                if rule.fault == faults.FAULT_LATENCY:
+                    time.sleep(rule.latency_s)
+                elif rule.fault == faults.FAULT_WATCH_KILL:
+                    self._watch_kill_after = rule.after_s
+                elif rule.fault == faults.FAULT_HTTP:
+                    self._send_error_status(
+                        rule.status, rule.reason,
+                        f"injected {rule.status} fault",
+                        retry_after_s=rule.retry_after_s)
+                    return
+                elif rule.fault == faults.FAULT_RESET:
+                    if verb == "watch":
+                        # a buffered watch stream would never terminate;
+                        # reset the connect instead (same client outcome:
+                        # reconnect + RV-diff resync)
+                        self._inject_reset()
+                        return
+                    reset_rule = rule
         if route.subresource == "proxy" and method != "GET":
             # the probes this facade serves are GETs; refusing the rest
             # loudly beats misrouting them into the REST verbs. Drain
@@ -229,7 +334,10 @@ class _Handler(BaseHTTPRequestHandler):
         # wrong for a passthrough)
         self._raw_query = parsed.query
         try:
-            getattr(self, f"_handle_{method}")(route, query)
+            if reset_rule is not None:
+                self._serve_then_reset(method, route, query)
+            else:
+                getattr(self, f"_handle_{method}")(route, query)
         except ApiError as err:
             self._send_api_error(err)
         except BrokenPipeError:
@@ -238,14 +346,62 @@ class _Handler(BaseHTTPRequestHandler):
             log.exception("handler error on %s %s", method, self.path)
             self._send_error_status(500, "InternalError", str(exc))
         finally:
-            # AFTER the response: the audit line carries the actual status
-            self._audit(method, parsed.path)
+            # catch-all for paths that never reached a response sender
+            # (broken pipe mid-handler, injected reset); _audited dedups
+            self._audit_now()
 
     do_GET = lambda self: self._dispatch("GET")            # noqa: E731
     do_POST = lambda self: self._dispatch("POST")          # noqa: E731
     do_PUT = lambda self: self._dispatch("PUT")            # noqa: E731
     do_PATCH = lambda self: self._dispatch("PATCH")        # noqa: E731
     do_DELETE = lambda self: self._dispatch("DELETE")      # noqa: E731
+
+    def _inject_reset(self, promised: int = 128) -> None:
+        """Promise a body, deliver nothing, then RST the socket (SO_LINGER
+        0 makes close() send RST, not FIN) — the LB-killed-connection
+        failure mode: the client's read fails with ECONNRESET /
+        IncompleteRead instead of a clean status."""
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(promised))
+            self.end_headers()
+            self.wfile.flush()
+            self.connection.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                       struct.pack("ii", 1, 0))
+        except OSError:
+            pass  # peer already gone; nothing left to reset
+        self.close_connection = True
+
+    def _serve_then_reset(self, method: str, route: _Route,
+                          query: dict) -> None:
+        """FAULT_RESET for REST verbs: run the REAL handler with the
+        response buffered, then deliver only part of it and RST the
+        socket. The side effect (create/update/delete) has been applied
+        server-side; the client sees a connection reset and cannot know —
+        the ambiguous failure mode a retried create disambiguates via 409
+        AlreadyExists + a live read."""
+        import io
+        real = self.wfile
+        buf = io.BytesIO()
+        self.wfile = buf
+        try:
+            getattr(self, f"_handle_{method}")(route, query)
+        finally:
+            self.wfile = real
+        data = buf.getvalue()
+        try:
+            # deliver roughly half — enough that the status line usually
+            # parses and the BODY truncates (IncompleteRead), sometimes
+            # cutting mid-headers (BadStatusLine): both shapes occur on a
+            # real wire and the client must survive both
+            real.write(data[:max(len(data) // 2, 1)])
+            real.flush()
+            self.connection.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                                       struct.pack("ii", 1, 0))
+        except OSError:
+            pass
+        self.close_connection = True
 
     def _handle_service_proxy(self, route: _Route) -> None:
         """GET ``/api/v1/namespaces/{ns}/services/{name}:{port}/proxy/…``
@@ -373,7 +529,12 @@ class _Handler(BaseHTTPRequestHandler):
         obj.setdefault("apiVersion", route.mapping.api_version)
         if route.namespace and route.mapping.namespaced:
             k8s.meta(obj).setdefault("namespace", route.namespace)
-        self._send_json(201, self.store.create(obj))
+        created = self.store.create(obj)
+        # the collection path carries no name; audit the server-assigned
+        # one (generateName included) so the idempotency check can group
+        # creates per object
+        self._audit_name = k8s.name(created)
+        self._send_json(201, created)
 
     def _handle_PUT(self, route: _Route, query: dict) -> None:
         if not route.name:
@@ -438,13 +599,26 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Connection", "close")
         self.end_headers()
         self.close_connection = True
+        # injected watch kill (FaultPlan): close the stream after its
+        # armed lifetime — the client sees EOF mid-watch and must
+        # reconnect + resync by resourceVersion diff
+        kill_at = None
+        if getattr(self, "_watch_kill_after", None) is not None:
+            kill_at = time.monotonic() + self._watch_kill_after
         try:
             while not self.server.shutting_down:  # type: ignore[attr-defined]
+                timeout = WATCH_BOOKMARK_INTERVAL_S
+                if kill_at is not None:
+                    remaining = kill_at - time.monotonic()
+                    if remaining <= 0:
+                        return  # injected stream kill (finally unwatches)
+                    timeout = min(timeout, remaining)
                 try:
-                    event: WatchEvent = events.get(
-                        timeout=WATCH_BOOKMARK_INTERVAL_S)
+                    event: WatchEvent = events.get(timeout=timeout)
                     frame = {"type": event.type, "object": event.obj}
                 except queue.Empty:
+                    if kill_at is not None and time.monotonic() >= kill_at:
+                        return
                     frame = {"type": "BOOKMARK", "object": {}}
                 self.wfile.write(json.dumps(frame).encode() + b"\n")
                 self.wfile.flush()
@@ -462,13 +636,18 @@ class ApiServerProxy:
                  token: str | None = None, certfile: str | None = None,
                  keyfile: str | None = None,
                  audit_log: str | None = None,
-                 latency_s: float = 0.0) -> None:
+                 latency_s: float = 0.0,
+                 fault_plan: "faults.FaultPlan | None" = None) -> None:
         self.store = store
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.store = store  # type: ignore[attr-defined]
         self._httpd.token = token  # type: ignore[attr-defined]
         self._httpd.shutting_down = False  # type: ignore[attr-defined]
+        # programmable wire-fault seam (cluster/faults.py): per-verb/kind
+        # 429/5xx/reset/watch-kill/latency — the chaos runner and soaks
+        # flip this live via set_fault_plan()
+        self._httpd.fault_plan = fault_plan  # type: ignore[attr-defined]
         # emulated request round-trip latency (loadtest knob: a localhost
         # facade has ~0 RTT while a production apiserver has 1-10 ms; the
         # dispatch worker-pool measurements need the real shape)
@@ -486,6 +665,15 @@ class ApiServerProxy:
                                                  server_side=True)
             self.scheme = "https"
         self._thread: threading.Thread | None = None
+
+    @property
+    def fault_plan(self):
+        return self._httpd.fault_plan  # type: ignore[attr-defined]
+
+    def set_fault_plan(self, plan) -> None:
+        """Swap the active FaultPlan (None = heal). Takes effect on the
+        next request; in-flight watch streams keep any armed kill."""
+        self._httpd.fault_plan = plan  # type: ignore[attr-defined]
 
     @property
     def port(self) -> int:

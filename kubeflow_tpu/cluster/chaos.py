@@ -3,11 +3,25 @@
 The reference's chaos tests wrap the envtest client with per-operation error
 rates (sdk.NewChaosClient, odh chaostests/chaos_test.go:42-54) and assert both
 error propagation and reconvergence after Deactivate(). This wrapper provides
-the same seam over ClusterStore for our chaos tests."""
+the same seam over ClusterStore for our chaos tests.
+
+Two injection surfaces share one ``FaultConfig``:
+
+- **in-process** (this module): ``ChaosClient`` raises ``InjectedFault``
+  per verb, and — new — injects on the WATCH path too: events are dropped
+  with probability ``watch`` and/or delivered late by ``watch_delay_s``
+  (the informer-lag / dropped-edge failure mode the reference's chaos SDK
+  cannot produce, because its client wrapper passes watches through);
+- **wire** (``FaultConfig.wire_plan()`` → ``cluster/faults.FaultPlan``):
+  the same per-verb rates compiled into a plan for ``ApiServerProxy``, so
+  a chaos run can hit a manager over the REAL transport with
+  429/503/reset/watch-kill instead of in-process exceptions.
+"""
 
 from __future__ import annotations
 
 import random
+import threading
 from dataclasses import dataclass, field
 
 from .errors import ApiError
@@ -21,13 +35,17 @@ class InjectedFault(ApiError):
 
 @dataclass
 class FaultConfig:
-    """Per-verb error probabilities in [0, 1]."""
+    """Per-verb error probabilities in [0, 1]. ``watch`` is the
+    probability an individual watch EVENT is dropped before delivery;
+    ``watch_delay_s`` delays every delivered event (0 = synchronous)."""
     get: float = 0.0
     list: float = 0.0
     create: float = 0.0
     update: float = 0.0
     patch: float = 0.0
     delete: float = 0.0
+    watch: float = 0.0
+    watch_delay_s: float = 0.0
     active: bool = True
     seed: int | None = None
     _rng: random.Random = field(init=False, repr=False, default=None)  # type: ignore
@@ -45,6 +63,40 @@ class FaultConfig:
         rate = getattr(self, verb, 0.0)
         return self.active and rate > 0 and self._rng.random() < rate
 
+    def wire_plan(self, *, reset_share: float = 0.34,
+                  retry_after_s: float = 0.05,
+                  watch_kill_after_s: float = 1.0):
+        """Compile these rates into a ``FaultPlan`` for ``ApiServerProxy``
+        — the same chaos config driving the real transport. Each verb's
+        rate splits between a 429-with-Retry-After/503 mix and (for
+        mutations) connection resets; the ``watch`` rate becomes
+        watch-stream kills. The plan gets its own RNG seeded from
+        ``seed`` so in-process and wire runs don't consume one stream."""
+        from .faults import (FAULT_HTTP, FAULT_RESET, FAULT_WATCH_KILL,
+                             MUTATING_VERBS, FaultPlan, FaultRule)
+        rules = []
+        for verb in ("get", "list", "create", "update", "patch", "delete"):
+            rate = getattr(self, verb)
+            if rate <= 0:
+                continue
+            resettable = verb in MUTATING_VERBS
+            reset_rate = rate * reset_share if resettable else 0.0
+            http_rate = rate - reset_rate
+            rules.append(FaultRule(FAULT_HTTP, http_rate / 2, status=429,
+                                   retry_after_s=retry_after_s,
+                                   verbs=frozenset({verb})))
+            rules.append(FaultRule(FAULT_HTTP, http_rate / 2, status=503,
+                                   verbs=frozenset({verb})))
+            if reset_rate > 0:
+                rules.append(FaultRule(FAULT_RESET, reset_rate,
+                                       verbs=frozenset({verb})))
+        if self.watch > 0:
+            rules.append(FaultRule(FAULT_WATCH_KILL, self.watch,
+                                   after_s=watch_kill_after_s))
+        plan = FaultPlan(rules=rules, seed=self.seed)
+        plan.active = self.active
+        return plan
+
 
 class ChaosClient:
     """Duck-types ClusterStore's verb surface; controllers take either."""
@@ -52,6 +104,9 @@ class ChaosClient:
     def __init__(self, store: ClusterStore, config: FaultConfig):
         self._store = store
         self.config = config
+        # original callback → injection wrapper, so unwatch() can
+        # deregister by the identity the consumer holds
+        self._watch_wrappers: dict = {}
 
     def _maybe_fail(self, verb: str) -> None:
         if self.config.should_fail(verb):
@@ -89,8 +144,33 @@ class ChaosClient:
         self._maybe_fail("delete")
         return self._store.delete(kind, namespace, name)
 
-    def watch(self, *args, **kwargs):
-        return self._store.watch(*args, **kwargs)
+    def watch(self, kind, callback, *args, **kwargs):
+        """Watch with event-level fault injection: each event is dropped
+        with probability ``config.watch`` (a lossy informer edge — the
+        consumer must reconverge off a later event or resync, exactly the
+        level-triggered contract) and/or delivered ``watch_delay_s`` late
+        on a timer thread (informer lag: the consumer observes genuinely
+        stale world state). Injection is decided per event at delivery
+        time, so deactivate() heals live watches immediately."""
+        config = self.config
+
+        def injected(event):
+            if config.should_fail("watch"):
+                return  # dropped edge
+            if config.active and config.watch_delay_s > 0:
+                timer = threading.Timer(config.watch_delay_s, callback,
+                                        args=(event,))
+                timer.daemon = True
+                timer.start()
+            else:
+                callback(event)
+
+        self._watch_wrappers[callback] = injected
+        return self._store.watch(kind, injected, *args, **kwargs)
+
+    def unwatch(self, callback):
+        wrapped = self._watch_wrappers.pop(callback, callback)
+        return self._store.unwatch(wrapped)
 
     def register_admission(self, *args, **kwargs):
         return self._store.register_admission(*args, **kwargs)
@@ -109,3 +189,11 @@ class ChaosClient:
         close = getattr(self._store, "close", None)
         if close is not None:
             close()
+
+    def __getattr__(self, name):
+        # transport extras (ping, set_health_tracker, …) pass through to
+        # the wrapped client so the manager's breaker wiring composes
+        # over chaos: hasattr() answers exactly what the inner client
+        # supports. Note __getattr__ only fires for names NOT defined
+        # above — the fault-injecting verbs always win.
+        return getattr(self._store, name)

@@ -10,6 +10,14 @@ from __future__ import annotations
 class ApiError(Exception):
     code = 500
     reason = "InternalError"
+    #: server-suggested retry delay in seconds (a 429/503 ``Retry-After``
+    #: header); None when the server sent none
+    retry_after: float | None = None
+    #: set by the transport when this error surfaced on a RETRY after an
+    #: ambiguous failure (connection reset mid-request): the earlier
+    #: attempt may have been applied, so e.g. AlreadyExists on a retried
+    #: create is probably our own first write landing
+    ambiguous_retry: bool = False
 
     def __init__(self, message: str = ""):
         super().__init__(message or self.reason)
@@ -41,6 +49,22 @@ class InvalidError(ApiError):
 class ForbiddenError(ApiError):
     code = 403
     reason = "Forbidden"
+
+
+class TooManyRequestsError(ApiError):
+    """Apiserver priority-and-fairness rejection (429). Always safe to
+    retry — the server refused the request *before* processing it — and
+    carries the server's ``Retry-After`` pacing when sent."""
+    code = 429
+    reason = "TooManyRequests"
+
+
+class ServiceUnavailableError(ApiError):
+    """503 from the apiserver or an LB in front of it (overload, rolling
+    restart). Retried for idempotent verbs only: unlike a 429 it gives no
+    guarantee about whether processing started."""
+    code = 503
+    reason = "ServiceUnavailable"
 
 
 def update_with_conflict_retry(client, read, mutate, attempts: int = 3):

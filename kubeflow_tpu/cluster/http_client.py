@@ -32,21 +32,26 @@ AdmissionServer (config/webhook), exactly as in the reference.
 from __future__ import annotations
 
 import base64
+import http.client
 import json
 import logging
 import os
+import random
 import socket
 import ssl
 import tempfile
 import threading
+import time
 import urllib.error
 import urllib.request
+from dataclasses import dataclass
 from urllib.parse import quote, urlencode
 
 from ..utils import k8s
 from . import restmapper
 from .errors import (AlreadyExistsError, ApiError, ConflictError,
-                     ForbiddenError, InvalidError, NotFoundError)
+                     ForbiddenError, InvalidError, NotFoundError,
+                     ServiceUnavailableError, TooManyRequestsError)
 from .store import WatchEvent
 
 log = logging.getLogger("kubeflow_tpu.http_client")
@@ -59,9 +64,42 @@ _ERROR_BY_REASON = {
     "Conflict": ConflictError,
     "Invalid": InvalidError,
     "Forbidden": ForbiddenError,
+    "TooManyRequests": TooManyRequestsError,
+    "ServiceUnavailable": ServiceUnavailableError,
 }
 _ERROR_BY_CODE = {404: NotFoundError, 409: ConflictError, 422: InvalidError,
-                  403: ForbiddenError}
+                  403: ForbiddenError, 429: TooManyRequestsError,
+                  503: ServiceUnavailableError}
+
+#: failures that mean "the bytes didn't arrive", not "the server said no":
+#: connection refused/reset (URLError/OSError) and a response that
+#: truncated mid-wire (IncompleteRead/BadStatusLine are HTTPExceptions,
+#: NOT OSErrors — a reset-mid-body previously escaped every handler here)
+TRANSPORT_ERRORS = (urllib.error.URLError, OSError, http.client.HTTPException)
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """client-go-style bounded retries with decorrelated-jitter backoff.
+
+    What retries (the policy table, also in ARCHITECTURE.md):
+
+    - ``429`` — every verb: the server rejected the request before
+      processing (priority-and-fairness), so retry is always safe;
+      ``Retry-After`` overrides the computed backoff when sent.
+    - ``503`` — idempotent verbs only (GET/LIST/DELETE).
+    - transport errors (refused/reset/truncated) — idempotent verbs, plus
+      *named* creates: a reset POST may or may not have applied, and the
+      retry disambiguates via 409 AlreadyExists + a live read. generateName
+      creates never retry on transport errors (a blind retry could
+      materialize two objects).
+    - PUT/PATCH — 429 only: resourceVersion preconditions + the
+      reconcilers' conflict-retry loops own that ambiguity.
+    """
+
+    max_attempts: int = 4
+    backoff_base_s: float = 0.05
+    backoff_cap_s: float = 2.0
 
 # Watch streams ask the server to close gracefully after this long
 # (?timeoutSeconds=, honored by real apiservers); the socket read timeout
@@ -72,6 +110,12 @@ _ERROR_BY_CODE = {404: NotFoundError, 409: ConflictError, 422: InvalidError,
 WATCH_SERVER_TIMEOUT_S = 290
 WATCH_READ_TIMEOUT_S = WATCH_SERVER_TIMEOUT_S + 10.0
 WATCH_RECONNECT_DELAY_S = 1.0
+# consecutive watch reconnect failures back off exponentially from
+# WATCH_RECONNECT_DELAY_S up to this cap (an unreachable apiserver must
+# not be hammered at 1 Hz per watched kind); a stream that lived this
+# long before dropping resets the backoff
+WATCH_BACKOFF_MAX_S = 30.0
+WATCH_BACKOFF_RESET_AFTER_S = 5.0
 
 
 def _serialize_selector(selector: dict) -> str:
@@ -81,7 +125,8 @@ def _serialize_selector(selector: dict) -> str:
                     for key, val in selector.items())
 
 
-def _error_from_response(code: int, body: bytes) -> ApiError:
+def _error_from_response(code: int, body: bytes,
+                         headers=None) -> ApiError:
     reason, message = "", ""
     try:
         status = json.loads(body)
@@ -92,7 +137,21 @@ def _error_from_response(code: int, body: bytes) -> ApiError:
     cls = _ERROR_BY_REASON.get(reason) or _ERROR_BY_CODE.get(code) or ApiError
     err = cls(message or f"HTTP {code}")
     err.code = code  # preserve the wire status (e.g. 401) on generic errors
+    if headers is not None:
+        err.retry_after = _parse_retry_after(headers.get("Retry-After"))
     return err
+
+
+def _parse_retry_after(raw: str | None) -> float | None:
+    """Delay-seconds form only (integer per RFC 7231; our facade also sends
+    sub-second floats). The HTTP-date form is ignored — client-go does the
+    same for apiserver flow-control."""
+    if not raw:
+        return None
+    try:
+        return max(float(raw), 0.0)
+    except ValueError:
+        return None
 
 
 def _data_or_file(data_b64: str | None, path: str | None) -> str | None:
@@ -114,11 +173,20 @@ class HttpApiClient:
     def __init__(self, base_url: str, token: str | None = None,
                  ca_cert: str | None = None, client_cert: str | None = None,
                  client_key: str | None = None, verify: bool = True,
-                 timeout: float = 30.0, metrics=None) -> None:
+                 timeout: float = 30.0, metrics=None,
+                 retry_policy: RetryPolicy | None = None) -> None:
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
+        self.retry_policy = retry_policy or RetryPolicy()
+        self._retry_rng = random.Random()  # decorrelated jitter source
         self._requests_metric = None
+        self._retries_metric = None
+        self._duration_metric = None
+        # optional apiserver health tracker (the manager's circuit
+        # breaker): told about every transport-level success/failure —
+        # an HTTP error response counts as SUCCESS (the server answered)
+        self._health_tracker = None
         if metrics is not None:
             self.attach_metrics(metrics)
         self._ssl: ssl.SSLContext | None = None
@@ -191,32 +259,159 @@ class HttpApiClient:
             resp = urllib.request.urlopen(
                 req, timeout=timeout or self.timeout, context=self._ssl)
             self._count_request(method, resp.status)
+            self._health_ok()
             return resp
         except urllib.error.HTTPError as err:
             self._count_request(method, err.code)
-            raise _error_from_response(err.code, err.read()) from None
-        except (urllib.error.URLError, OSError):
+            self._health_ok()  # an error RESPONSE still means "reachable"
+            raise _error_from_response(err.code, err.read(),
+                                       err.headers) from None
+        except (urllib.error.URLError, OSError) as err:
             self._count_request(method, "<error>")
+            self._health_fail()
+            err._kt_health_recorded = True  # _json must not double-count
             raise
 
     def _count_request(self, method: str, code) -> None:
         if self._requests_metric is not None:
             self._requests_metric.inc({"method": method, "code": str(code)})
 
+    def _count_retry(self, method: str, reason: str) -> None:
+        if self._retries_metric is not None:
+            self._retries_metric.inc({"verb": method, "reason": reason})
+
+    def _observe_duration(self, method: str, started: float) -> None:
+        if self._duration_metric is not None:
+            self._duration_metric.observe(time.monotonic() - started,
+                                          {"verb": method})
+
+    def _health_ok(self) -> None:
+        tracker = self._health_tracker
+        if tracker is not None:
+            tracker.record_success()
+
+    def _health_fail(self) -> None:
+        tracker = self._health_tracker
+        if tracker is not None:
+            tracker.record_failure()
+
+    def set_health_tracker(self, tracker) -> None:
+        """Attach an apiserver health tracker (record_success/
+        record_failure) — the manager's circuit breaker. Watch reconnects
+        report through the same seam, so a full outage trips the breaker
+        even while the worker pool is idle."""
+        self._health_tracker = tracker
+
+    def ping(self, timeout: float = 2.0) -> bool:
+        """Transport-liveness probe (GET /readyz): True when the apiserver
+        answered at all — ANY http status counts, only a connection-level
+        failure is down. The breaker's half-open probe; never retried."""
+        try:
+            with self._request("GET", "/readyz", timeout=timeout) as resp:
+                resp.read()  # a reset manifests at body-read, not connect
+            return True
+        except ApiError:
+            return True
+        except TRANSPORT_ERRORS:
+            return False
+
     def attach_metrics(self, registry) -> None:
-        """Bind a metrics registry — the rest_client_requests_total analog
-        (client-go exposes it through the controller-runtime registry; the
-        reference's managers ship it on the same endpoint as the notebook
-        series). setup_controllers calls this late, since the client is
-        constructed before the registry exists."""
+        """Bind a metrics registry — the rest_client_* family (client-go
+        exposes these through the controller-runtime registry; the
+        reference's managers ship them on the same endpoint as the
+        notebook series). setup_controllers calls this late, since the
+        client is constructed before the registry exists."""
         self._requests_metric = registry.counter(
             "rest_client_requests_total",
             "Number of apiserver HTTP requests, by verb and status code.")
+        self._retries_metric = registry.counter(
+            "rest_client_retries_total",
+            "Number of request retries, by verb and reason "
+            "(an HTTP status or 'transport').")
+        self._duration_metric = registry.histogram(
+            "rest_client_request_duration_seconds",
+            "Apiserver request latency per attempt, by verb.")
+
+    def _api_retry_wait(self, err: ApiError, method: str,
+                        fallback_delay: float) -> float | None:
+        """Seconds to wait before retrying an HTTP error, or None when the
+        error is not retryable for this verb (see RetryPolicy)."""
+        if err.code == 429:
+            return err.retry_after if err.retry_after is not None \
+                else fallback_delay
+        if err.code == 503 and method in ("GET", "DELETE"):
+            return err.retry_after if err.retry_after is not None \
+                else fallback_delay
+        return None
 
     def _json(self, method: str, path: str, body: dict | None = None,
-              content_type: str = "application/json") -> dict:
-        with self._request(method, path, body, content_type) as resp:
-            return json.loads(resp.read())
+              content_type: str = "application/json",
+              retry_transport: bool | None = None) -> dict:
+        """One logical request with the RetryPolicy applied. Transport
+        retries default to the idempotent verbs; create() opts named POSTs
+        in explicitly. Errors surfacing on a retry after an ambiguous
+        (transport) failure carry ``ambiguous_retry`` so callers can
+        disambiguate (AlreadyExists on create, NotFound on delete)."""
+        policy = self.retry_policy
+        if retry_transport is None:
+            retry_transport = method in ("GET", "DELETE")
+        ambiguous = False
+        delay = policy.backoff_base_s
+        attempt = 0
+        while True:
+            attempt += 1
+            started = time.monotonic()
+            try:
+                with self._request(method, path, body, content_type) as resp:
+                    data = resp.read()
+                self._observe_duration(method, started)
+                return json.loads(data)
+            except ApiError as err:
+                self._observe_duration(method, started)
+                err.ambiguous_retry = ambiguous
+                wait = None
+                if attempt < policy.max_attempts:
+                    wait = self._api_retry_wait(err, method, delay)
+                if wait is None:
+                    raise
+                if err.code == 503 and method != "GET":
+                    # a 503 gives no guarantee processing never started
+                    # (an LB can emit it after the apiserver applied the
+                    # write) — a DELETE retried through one must treat a
+                    # subsequent 404 as its own earlier success
+                    ambiguous = True
+                reason = str(err.code)
+                pending = err
+            except (*TRANSPORT_ERRORS, json.JSONDecodeError) as err:
+                # JSONDecodeError covers a reset that truncated mid-HEADERS:
+                # the client parses what arrived, finds no Content-Length,
+                # reads to EOF and hands back an empty/partial body — same
+                # wire failure as IncompleteRead, different surface
+                self._observe_duration(method, started)
+                if not getattr(err, "_kt_health_recorded", False):
+                    # a body that truncated AFTER a successful connect
+                    # (IncompleteRead/JSONDecodeError) was not seen by
+                    # _request
+                    self._health_fail()
+                if method != "GET":
+                    # the request may have been applied server-side
+                    ambiguous = True
+                if not retry_transport or attempt >= policy.max_attempts:
+                    raise
+                wait = delay
+                reason = "transport"
+                pending = err
+            # decorrelated jitter (the AWS builders'-library shape): each
+            # delay is uniform(base, prev*3) capped — spreads a thundering
+            # herd of retriers without a coordinated clock
+            delay = min(policy.backoff_cap_s,
+                        self._retry_rng.uniform(policy.backoff_base_s,
+                                                delay * 3))
+            self._count_retry(method, reason)
+            # the cap applies to COMPUTED backoff only — a server-sent
+            # Retry-After is pacing we must honor (bounded for sanity)
+            if self._stopped.wait(min(wait, 30.0)):
+                raise pending  # close() aborts in-flight retry waits
 
     @staticmethod
     def _path(kind: str, namespace: str | None = None,
@@ -250,7 +445,30 @@ class HttpApiClient:
     def create(self, obj: dict) -> dict:
         kind = k8s.kind(obj)
         obj.setdefault("apiVersion", restmapper.mapping_for(kind).api_version)
-        return self._json("POST", self._path(kind, k8s.namespace(obj)), obj)
+        name = k8s.name(obj)
+        try:
+            # transport retry only for NAMED creates — a generateName
+            # retry could materialize two objects with no way to tell
+            return self._json("POST", self._path(kind, k8s.namespace(obj)),
+                              obj, retry_transport=bool(name))
+        except AlreadyExistsError as err:
+            if not err.ambiguous_retry or not name:
+                raise
+            # an earlier attempt died mid-response (connection reset): the
+            # write probably landed and this 409 is our own object. Check
+            # against the live resourceVersion: if the object exists,
+            # return it as the created state. A racing foreign create is
+            # indistinguishable — level-based reconcilers converge on the
+            # next loop either way (they re-read and adopt/patch).
+            existing = self.get_or_none(kind, k8s.namespace(obj), name)
+            if existing is not None:
+                log.debug("create %s %s/%s: 409 after ambiguous retry; "
+                          "adopting live object rv=%s", kind,
+                          k8s.namespace(obj), name,
+                          k8s.get_in(existing, "metadata",
+                                     "resourceVersion", default="?"))
+                return existing
+            raise
 
     def update(self, obj: dict) -> dict:
         kind = k8s.kind(obj)
@@ -269,7 +487,12 @@ class HttpApiClient:
                           content_type="application/merge-patch+json")
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
-        self._json("DELETE", self._path(kind, namespace, name))
+        try:
+            self._json("DELETE", self._path(kind, namespace, name))
+        except NotFoundError as err:
+            if err.ambiguous_retry:
+                return  # an earlier ambiguous attempt already deleted it
+            raise
 
     def register_admission(self, kind: str, fn) -> None:
         raise RuntimeError(
@@ -318,10 +541,14 @@ class HttpApiClient:
         # synthesized as DELETED carrying this full final object, so
         # owner-mapped and label-filtered watches still route it
         seen: dict[tuple[str, str], dict] = {}
+        failures = 0
         while not self._stopped.is_set():
+            stream_started = time.monotonic()
+            failed = True
             try:
                 self._watch_stream(kind, callback, namespace, label_selector,
                                    connected, seen)
+                failed = False  # server closed the stream cleanly
             except json.JSONDecodeError as err:
                 if self._stopped.is_set():
                     return  # close() aborted the read mid-body: not an error
@@ -332,15 +559,34 @@ class HttpApiClient:
                 # stay visible, not loop silently
                 log.warning("watch %s resync body unparseable (%s); "
                             "reconnecting", kind, err)
-            except (urllib.error.URLError, OSError, ApiError) as err:
+            except (*TRANSPORT_ERRORS, ApiError) as err:
                 if self._stopped.is_set():
                     return
-                # a timed-out idle stream is the designed reconnect cadence,
-                # not an error worth resyncing eagerly over — but we cannot
-                # distinguish it from a drop, and the resync is cheap when
-                # nothing changed (RV diff delivers zero events)
-                log.debug("watch %s dropped (%s); reconnecting", kind, err)
-            self._stopped.wait(WATCH_RECONNECT_DELAY_S)
+                # ApiError covers the resync LIST failing with a Status
+                # (429/503 burst, a 401 during token rotation) AFTER the
+                # retry budget — the daemon watch thread must reconnect
+                # with backoff, never die (a dead thread is a permanently
+                # stale informer with no error surface). HTTPException
+                # covers a body reset mid-resync (IncompleteRead), which
+                # is NOT an OSError and previously escaped this loop.
+                log.debug("watch %s dropped (%s: %s); reconnecting", kind,
+                          type(err).__name__, err)
+            # a stream that served for a while then dropped is the normal
+            # reconnect cadence; only back-to-back connect/resync failures
+            # escalate the delay (unreachable or persistently erroring
+            # apiserver — don't hammer it at 1 Hz per watched kind)
+            if failed and \
+                    time.monotonic() - stream_started < \
+                    WATCH_BACKOFF_RESET_AFTER_S:
+                failures += 1
+            else:
+                failures = 0
+            delay = WATCH_RECONNECT_DELAY_S
+            if failures > 1:
+                delay = min(WATCH_RECONNECT_DELAY_S * 2 ** min(failures, 8),
+                            WATCH_BACKOFF_MAX_S)
+                delay *= self._retry_rng.uniform(0.5, 1.0)
+            self._stopped.wait(delay)
 
     def _deliver(self, callback, event: WatchEvent, seen: dict) -> None:
         """Invoke the callback, then record delivery. A raising callback is

@@ -42,8 +42,9 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
 
     config = config or ControllerConfig.from_env()
     metrics = metrics or MetricsRegistry()
+    transport_client = client  # pre-cache-wrap: where the breaker attaches
     if hasattr(client, "attach_metrics"):
-        client.attach_metrics(metrics)  # rest_client_requests_total
+        client.attach_metrics(metrics)  # rest_client_* family
     # remote clients (HttpApiClient) can't register in-process admission —
     # there, schema validation and the webhooks run server-side (CRD schema +
     # AdmissionServer behind webhook configurations, as in the reference)
@@ -71,6 +72,21 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
                       max_concurrent_reconciles=max_concurrent_reconciles)
     client = read_client  # reconcilers below read cached, write through
     mgr.attach_metrics(metrics)
+    # apiserver circuit breaker — transport clients only (HttpApiClient,
+    # or a ChaosClient over one; the in-process store cannot fail at the
+    # transport level, so hasattr() correctly skips it). The client
+    # reports every transport outcome; N consecutive failures park the
+    # worker pool, flip readyz + apiserver_available, and recovery (probe
+    # or an organic success, e.g. a watch reconnecting) resumes through
+    # mgr.resync_all().
+    if hasattr(transport_client, "set_health_tracker"):
+        from .resilience import CircuitBreaker
+        breaker = CircuitBreaker(
+            probe=getattr(transport_client, "ping", None),
+            on_resume=mgr.resync_all)
+        breaker.attach_metrics(metrics)
+        transport_client.set_health_tracker(breaker)
+        mgr.breaker = breaker
     # ``core``/``extension`` mirror the reference's TWO manager binaries:
     # notebook-controller (core reconciler + culler) and the odh extension
     # manager (extension reconciler + webhooks) — run split via
@@ -103,4 +119,12 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
         # stay Ready (controller-runtime semantics: readyz is a ping, else
         # rolling updates of a 2-replica deployment deadlock on the lease)
         mgr.health_server.add_healthz_check("manager", mgr.is_alive)
+        if mgr.breaker is not None:
+            # readiness (NOT liveness) tracks the apiserver breaker: a
+            # parked pool must fail readyz — route traffic away, page on
+            # sustained not-ready — while restarting the pod would not
+            # help, so healthz stays green (same seam main.build_manager
+            # uses for the webhook listener readyz check)
+            mgr.health_server.add_readyz_check(
+                "apiserver", lambda: mgr.breaker.available)
     return mgr

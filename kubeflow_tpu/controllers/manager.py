@@ -59,8 +59,10 @@ Workqueue metrics (attach_metrics; label ``name`` = controller name)
 - ``workqueue_work_duration_seconds`` — histogram: reconcile duration,
   including the error path.
 - ``workqueue_retries_total`` — counter: error-backoff requeues
-  (AddRateLimited analog); reconcilers may also count their own
-  conflict-retry fast paths here (notebook.py's 409 helper does).
+  (AddRateLimited analog) plus breaker-resume resync re-enqueues
+  (``resync_all`` — a resync is a retry of the world); reconcilers may
+  also count their own conflict-retry fast paths here (notebook.py's
+  409 helper does).
 - ``workqueue_unfinished_work_seconds`` — gauge: sum of in-flight
   (processing) item ages at scrape time; 0 when nothing is processing.
 - ``workqueue_longest_running_processor_seconds`` — gauge: age of the
@@ -111,9 +113,14 @@ class _QueueItem:
 class Manager:
     ERROR_BACKOFF_BASE = 0.005   # fast in-process analog of the 5ms rate-limiter base
     ERROR_BACKOFF_MAX = 2.0
+    # how long a parked worker sleeps between breaker checks while the
+    # apiserver circuit is open (each check also offers to run the
+    # half-open probe)
+    BREAKER_PARK_POLL_S = 0.05
 
     def __init__(self, client, read_cache=None,
-                 max_concurrent_reconciles: int = 4) -> None:
+                 max_concurrent_reconciles: int = 4,
+                 rate_limiter=None) -> None:
         self.client = client
         # shared informer layer (reference: the manager cache) — when set,
         # every watch this manager registers tees its events into the
@@ -165,6 +172,25 @@ class Manager:
         self.health_server = None
         # optional HTTPS admission server (set by main.build_manager)
         self.webhook_server = None
+        # overall error-requeue rate limiter (client-go's
+        # DefaultControllerRateLimiter composes a 10 qps/100 burst bucket
+        # with the per-item exponential limiter via MaxOfRateLimiter):
+        # each error backoff is max(per-key exponential, bucket delay),
+        # so a mass failure can't collapse into a synchronized retry herd.
+        # Pass rate_limiter=False to disable (deterministic tests).
+        if rate_limiter is None:
+            from .resilience import TokenBucket
+            rate_limiter = TokenBucket(qps=10.0, burst=100)
+        self.rate_limiter = rate_limiter or None
+        # optional apiserver circuit breaker (controllers.resilience,
+        # wired by setup_controllers over transport clients): while open,
+        # workers park instead of burning reconciles against a dead
+        # apiserver; on close the manager runs a full resync
+        self.breaker = None
+        # (kind, controller, mapper, predicate) per watch — what
+        # resync_all() replays
+        self._watch_specs: list[tuple[str, str, Callable | None,
+                                      Callable | None]] = []
         # controller-runtime parity metrics (attach_metrics):
         # controller_runtime_reconcile_total{controller,result} + the
         # workqueue family documented in the module docstring
@@ -279,6 +305,7 @@ class Manager:
                     else [Request(k8s.namespace(event.obj), k8s.name(event.obj))])
             for req in reqs:
                 self.enqueue(controller, req)
+        self._watch_specs.append((kind, controller, mapper, predicate))
         self.client.watch(kind, cb)
         if cache is not None:
             try:
@@ -318,6 +345,47 @@ class Manager:
                                _QueueItem(ready_at, self._seq, controller,
                                           req, timed=True))
             self._cv.notify_all()
+
+    def resync_all(self) -> int:
+        """Full resync: list every watched kind and re-enqueue through the
+        registered mappers — the recovery path the circuit breaker runs on
+        close (controller-runtime's informers re-list on reconnect; our
+        watch threads RV-diff too, so this is belt and braces for work
+        whose events raced the outage). Each re-enqueue is counted in
+        ``workqueue_retries_total`` — a resync IS a retry of the world.
+        Returns the number of requests enqueued."""
+        count = 0
+        for kind, controller, mapper, predicate in list(self._watch_specs):
+            try:
+                objs = self.client.list(kind)
+            except Exception as exc:  # noqa: BLE001 — a kind failing to
+                # list must not abort the rest of the resync
+                log.warning("resync list %s failed: %s", kind, exc)
+                continue
+            for obj in objs:
+                if predicate is not None:
+                    # replay through the watch's own filter (as a
+                    # synthetic MODIFIED, the informer-resync shape) —
+                    # without this, the Event watch's default object-key
+                    # mapping would re-emit every HISTORICAL Event onto
+                    # its notebook at each breaker close
+                    try:
+                        if not predicate(WatchEvent("MODIFIED", obj)):
+                            continue
+                    except Exception:  # noqa: BLE001 — a raising
+                        # predicate must not abort the resync; skip, as
+                        # the live watch path drops raising predicates too
+                        log.exception("resync predicate failed for %s",
+                                      kind)
+                        continue
+                reqs = (mapper(obj) if mapper is not None
+                        else [Request(k8s.namespace(obj), k8s.name(obj))])
+                for req in reqs:
+                    if self._wq_retries is not None:
+                        self._wq_retries.inc({"name": controller})
+                    self.enqueue(controller, req)
+                    count += 1
+        return count
 
     # --------------------------------------------------------------- driving
     def _cap(self, controller: str) -> int:
@@ -489,6 +557,10 @@ class Manager:
                 self._failures[key] = failures
             backoff = min(self.ERROR_BACKOFF_BASE * (2 ** failures),
                           self.ERROR_BACKOFF_MAX)
+            if self.rate_limiter is not None:
+                # MaxOfRateLimiter: the overall bucket only stretches the
+                # delay once the aggregate error rate exhausts its burst
+                backoff = max(backoff, self.rate_limiter.next_delay())
             log.warning("reconcile %s %s failed (%s); requeue in %.3fs",
                         item.controller, item.req, exc, backoff)
             self._count_reconcile(item.controller, "error")
@@ -599,6 +671,16 @@ class Manager:
                     # it instead of busy-polling.
                     time.sleep(min(self.leader_elector.renew_period / 4,
                                    0.5))
+                    continue
+                if self.breaker is not None and \
+                        not self.breaker.allow_dispatch():
+                    # apiserver circuit open: reconciling would only burn
+                    # the error-backoff ladder against a dead transport.
+                    # Park (watches/timed requeues keep accumulating) and
+                    # offer to run the half-open probe; the breaker's
+                    # close path resyncs and this loop resumes.
+                    self.breaker.maybe_probe()
+                    time.sleep(self.BREAKER_PARK_POLL_S)
                     continue
                 item = self._dispatch_one(block=True)
                 if item is None:

@@ -177,6 +177,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                     help="bearer token required by --serve-apiserver "
                          "(env APISERVER_TOKEN also honored); TLS via "
                          "--cert-dir")
+    ap.add_argument("--fault-plan", default=None, metavar="PATH",
+                    help="with --serve-apiserver: arm the facade with a "
+                         "wire-level FaultPlan (YAML: seed + rules of "
+                         "429/503/reset/watch_kill/latency per verb/kind, "
+                         "cluster/faults.py) — a standalone chaos "
+                         "apiserver for exercising any manager's retry/"
+                         "breaker behavior over real HTTP")
     ap.add_argument("--otlp-endpoint", default=None, metavar="URL",
                     help="export admission/controller spans as "
                          "OTLP/HTTP JSON to this collector base URL "
@@ -238,12 +245,20 @@ def main(argv=None) -> int:
                       args.apiserver_bind)
             return 2
         from .cluster.apiserver import ApiServerProxy
+        fault_plan = None
+        if args.fault_plan:
+            from .cluster.faults import FaultPlan
+            fault_plan = FaultPlan.from_file(args.fault_plan)
+            log.warning("apiserver facade armed with fault plan %s "
+                        "(%d rules) — injected 429/5xx/resets ahead",
+                        args.fault_plan, len(fault_plan.rules))
         apiserver = ApiServerProxy(
             mgr.client.store, port=args.serve_apiserver,
             host=args.apiserver_bind, token=token,
             certfile=f"{args.cert_dir}/tls.crt" if args.cert_dir else None,
             keyfile=f"{args.cert_dir}/tls.key" if args.cert_dir else None,
-            audit_log=args.audit_log)
+            audit_log=args.audit_log,
+            fault_plan=fault_plan)
         apiserver.start()
         log.info("apiserver facade listening on %s (auth=%s)",
                  apiserver.url, "token" if token else "none/loopback")

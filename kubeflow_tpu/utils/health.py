@@ -82,7 +82,10 @@ class HealthServer:
         if path.startswith("/readyz"):
             ok, body = self._run_checks({**self._checks,
                                          **self._ready_checks})
-            return (200 if ok else 500), body, "text/plain"
+            # not-ready is 503 ServiceUnavailable (route traffic away),
+            # not 500 (something crashed) — what a parked-on-open-breaker
+            # manager answers during an apiserver outage
+            return (200 if ok else 503), body, "text/plain"
         if path.startswith("/metrics"):
             if self.metrics_registry is None:
                 return 404, "no metrics registry\n", "text/plain"

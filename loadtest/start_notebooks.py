@@ -133,22 +133,48 @@ def _print_latencies(lat: list[float]) -> None:
 
 def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
              max_requests_per_nb: float | None = None,
-             workers: int = 4, apiserver_latency_ms: float = 0.0) -> int:
+             workers: int = 4, apiserver_latency_ms: float = 0.0,
+             fault_rate: float = 0.0, fault_plan: str | None = None,
+             fault_seed: int | None = 7) -> int:
     """Controller wire-cost measurement: the full controller stack runs
     over a real HTTP apiserver while the load generator drives the store
     directly, so ``rest_client_requests_total`` counts ONLY controller
     traffic. Reports apiserver requests per notebook — the number the
     reference's informer-cache architecture keeps small, and the regression
     guard for full-LIST/GET-storm patterns on the hot paths (metrics
-    scrape, Event predicate)."""
+    scrape, Event predicate).
+
+    ``fault_rate`` arms the apiserver with the standard mixed wire-fault
+    plan (429-with-Retry-After / 503 / connection reset per verb +
+    watch-stream kills, cluster/faults.FaultPlan.uniform) at that
+    per-request rate; ``fault_plan`` loads a custom plan YAML instead.
+    With faults on, the run keeps an audit tap and fails on any duplicate
+    side-effect write (a retried create applying twice) in addition to
+    the convergence bound — the chaos soak contract."""
+    import tempfile
+
     from kubeflow_tpu.api import types as api
     from kubeflow_tpu.cluster.apiserver import ApiServerProxy
+    from kubeflow_tpu.cluster.experiments import audit_duplicate_creates
+    from kubeflow_tpu.cluster.faults import FaultPlan
     from kubeflow_tpu.cluster.http_client import HttpApiClient
     from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
     from kubeflow_tpu.cluster.store import ClusterStore
     from kubeflow_tpu.controllers import Manager, setup_controllers
     from kubeflow_tpu.utils import names
     from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+    plan = None
+    if fault_plan:
+        plan = FaultPlan.from_file(fault_plan)
+    elif fault_rate > 0:
+        plan = FaultPlan.uniform(fault_rate, seed=fault_seed)
+    audit_path = None
+    if plan is not None:
+        audit_file = tempfile.NamedTemporaryFile(suffix=".ndjson",
+                                                 delete=False)
+        audit_file.close()
+        audit_path = audit_file.name
 
     store = ClusterStore()
     api.install_notebook_crd(store)
@@ -159,7 +185,8 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
         sim_mgr.start()
         cleanups.append(sim_mgr.stop)
         proxy = ApiServerProxy(store,
-                               latency_s=apiserver_latency_ms / 1000.0)
+                               latency_s=apiserver_latency_ms / 1000.0,
+                               fault_plan=plan, audit_log=audit_path)
         proxy.start()
         cleanups.append(proxy.stop)
         client = HttpApiClient(proxy.url)
@@ -211,17 +238,33 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
         metrics.expose()
         per_nb = (requests.total() - baseline) / max(count, 1)
         if ready < count:
+            stuck = [n for n in created_at if n not in ready_at]
             print(f"FAIL: only {ready}/{count} notebooks became SliceReady "
-                  f"within {timeout}s")
+                  f"within {timeout}s (stuck: {stuck[:5]}"
+                  f"{'...' if len(stuck) > 5 else ''})")
             return 1
+        faults_note = ""
+        if plan is not None:
+            injected = plan.injected()
+            faults_note = (f"  injected faults: {plan.injected_total()} "
+                           f"({dict(sorted(injected.items()))})")
         print(f"notebooks: {count}  workers: {workers}  wall: {wall:.2f}s  "
-              f"controller apiserver requests/notebook: {per_nb:.1f}")
+              f"controller apiserver requests/notebook: {per_nb:.1f}"
+              f"{faults_note}")
         _print_latencies(sorted(ready_at[n] - created_at[n]
                                 for n in ready_at))
         if max_requests_per_nb is not None and per_nb > max_requests_per_nb:
             print(f"FAIL: {per_nb:.1f} requests/notebook exceeds bound "
                   f"{max_requests_per_nb}")
             return 1
+        if audit_path is not None:
+            duplicates = audit_duplicate_creates(audit_path)
+            if duplicates:
+                print("FAIL: duplicate side-effect writes under faults:")
+                for dup in duplicates:
+                    print(f"  {dup}")
+                return 1
+            print("audit: no duplicate side-effect writes")
         return 0
     finally:
         for cleanup in reversed(cleanups):
@@ -229,6 +272,11 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
                 cleanup()
             except Exception as e:  # noqa: BLE001
                 sys.stderr.write(f"loadtest: cleanup failed: {e}\n")
+        if audit_path is not None:
+            try:
+                Path(audit_path).unlink()
+            except OSError:
+                pass
 
 
 def main() -> int:
@@ -256,6 +304,16 @@ def main() -> int:
                          "latency at the apiserver (a localhost facade "
                          "has ~0 RTT; production apiservers have 1-10 ms "
                          "— the regime concurrent dispatch exists for)")
+    ap.add_argument("--fault-rate", type=float, default=0.0,
+                    help="with --wire: per-request probability of an "
+                         "injected wire fault (429/503/reset/watch-kill "
+                         "mix, cluster/faults.FaultPlan.uniform); the run "
+                         "also fails on any duplicate side-effect write")
+    ap.add_argument("--fault-plan", default=None, metavar="PATH",
+                    help="with --wire: load a custom FaultPlan YAML "
+                         "instead of the uniform mix")
+    ap.add_argument("--fault-seed", type=int, default=7,
+                    help="seed for the injected-fault RNG (replayable runs)")
     args = ap.parse_args()
     if args.emit_yaml:
         try:
@@ -270,7 +328,10 @@ def main() -> int:
                         args.timeout,
                         max_requests_per_nb=args.max_requests_per_nb,
                         workers=args.workers,
-                        apiserver_latency_ms=args.apiserver_latency_ms)
+                        apiserver_latency_ms=args.apiserver_latency_ms,
+                        fault_rate=args.fault_rate,
+                        fault_plan=args.fault_plan,
+                        fault_seed=args.fault_seed)
     return run_inprocess(args.count, args.namespace, args.accelerator,
                          args.timeout, server=args.server,
                          workers=args.workers)

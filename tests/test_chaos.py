@@ -1,5 +1,10 @@
 """Fault-injection tests — the operator-chaos SDK tier (SURVEY §4.3):
-error propagation while faults are active, reconvergence after Deactivate()."""
+error propagation while faults are active, reconvergence after Deactivate();
+watch-path injection (drop/delay); and the apiserver circuit breaker under
+a full wire outage (park → readyz 503 + apiserver_available 0 → resume
+through a resync)."""
+
+import time
 
 import pytest
 
@@ -9,6 +14,16 @@ from kubeflow_tpu.cluster.store import ClusterStore
 from kubeflow_tpu.controllers import Manager, NotebookReconciler
 from kubeflow_tpu.controllers.manager import Request
 from kubeflow_tpu.utils import names
+
+
+def wait_for(fn, timeout=30.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = fn()
+        if result:
+            return result
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {msg}")
 
 
 def converge(mgr, timeout=5.0):
@@ -76,3 +91,155 @@ def test_delete_faults_then_cleanup():
     store.delete(api.KIND, "ns", "nb")
     converge(mgr)
     assert store.get_or_none("StatefulSet", "ns", "nb") is None
+
+
+# --------------------------------------------------- watch-path injection
+
+
+def _cm(name, ns="ns"):
+    return {"kind": "ConfigMap", "apiVersion": "v1",
+            "metadata": {"name": name, "namespace": ns}}
+
+
+def test_chaos_watch_drops_events_then_heals():
+    """Regression: ChaosClient.watch used to pass through UNINJECTED —
+    the one client surface chaos could not touch. With watch=1.0 every
+    event is dropped; after deactivate() the next event flows, and a
+    level-triggered consumer reconverges off it."""
+    store = ClusterStore()
+    config = FaultConfig(watch=1.0, seed=5)
+    chaos = ChaosClient(store, config)
+    events = []
+    chaos.watch("ConfigMap", events.append, namespace="ns")
+    store.create(_cm("dropped"))
+    assert events == []  # the creation edge was injected away
+    config.deactivate()
+    store.create(_cm("delivered"))
+    assert [e.obj["metadata"]["name"] for e in events] == ["delivered"]
+
+
+def test_chaos_watch_delayed_delivery():
+    """watch_delay_s models informer lag: the consumer sees the event,
+    but measurably late."""
+    store = ClusterStore()
+    chaos = ChaosClient(store, FaultConfig(watch_delay_s=0.2))
+    stamped = []
+    chaos.watch("ConfigMap", lambda e: stamped.append(time.monotonic()),
+                namespace="ns")
+    t0 = time.monotonic()
+    store.create(_cm("late"))
+    assert stamped == []  # not synchronous anymore
+    wait_for(lambda: stamped, timeout=5.0, msg="delayed watch delivery")
+    assert stamped[0] - t0 >= 0.2
+
+
+def test_chaos_unwatch_deregisters_wrapped_callback():
+    """unwatch() must translate the consumer's callback to the injection
+    wrapper actually registered on the store."""
+    store = ClusterStore()
+    chaos = ChaosClient(store, FaultConfig())
+    events = []
+    chaos.watch("ConfigMap", events.append, namespace="ns")
+    store.create(_cm("one"))
+    chaos.unwatch(events.append)
+    store.create(_cm("two"))
+    assert [e.obj["metadata"]["name"] for e in events] == ["one"]
+
+
+def test_fault_config_compiles_to_wire_plan():
+    """FaultConfig drives the REAL transport: wire_plan() yields the
+    per-verb 429/503/reset mix + watch kills for ApiServerProxy."""
+    plan = FaultConfig(get=0.3, create=0.3, watch=0.2, seed=9).wire_plan()
+    faults_by_verb = {}
+    for rule in plan.rules:
+        for verb in (rule.verbs or ["watch"]):
+            faults_by_verb.setdefault(verb, []).append(rule.fault)
+    assert set(faults_by_verb["get"]) == {"http"}         # idempotent: no reset
+    assert set(faults_by_verb["create"]) == {"http", "reset"}
+    assert faults_by_verb["watch"] == ["watch_kill"]
+    assert abs(sum(r.rate for r in plan.rules
+                   if r.verbs == frozenset({"get"})) - 0.3) < 1e-9
+
+
+# ------------------------------------------------- circuit breaker (wire)
+
+
+def test_breaker_full_outage_parks_then_recovery_resyncs(config, monkeypatch):
+    """The acceptance scenario: a full apiserver outage trips the breaker
+    (workers park, readyz → 503, apiserver_available → 0); the apiserver
+    returning closes it again, and the resume resync reconciles work that
+    arrived during the outage."""
+    import urllib.error
+    import urllib.request
+
+    import kubeflow_tpu.cluster.http_client as hc
+    from kubeflow_tpu.cluster.apiserver import ApiServerProxy
+    from kubeflow_tpu.cluster.http_client import HttpApiClient, RetryPolicy
+    from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
+    from kubeflow_tpu.controllers import setup_controllers
+    from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+    monkeypatch.setattr(hc, "WATCH_RECONNECT_DELAY_S", 0.05)
+    store = ClusterStore()
+    api.install_notebook_crd(store)
+    sim_mgr = Manager(store)
+    StatefulSetSimulator(store, boot_delay_s=0.0).setup(sim_mgr)
+    sim_mgr.start()
+    proxy = ApiServerProxy(store)
+    proxy.start()
+    port = proxy.port
+    client = HttpApiClient(proxy.url, retry_policy=RetryPolicy(
+        max_attempts=2, backoff_base_s=0.01, backoff_cap_s=0.05))
+    metrics = MetricsRegistry()
+    mgr = setup_controllers(client, config, metrics=metrics, health_port=0)
+    assert mgr.breaker is not None, "breaker must wire over HttpApiClient"
+    mgr.start()
+    health_port = mgr.health_server.port
+
+    def readyz_status():
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{health_port}/readyz",
+                    timeout=5.0) as resp:
+                return resp.status
+        except urllib.error.HTTPError as err:
+            return err.code
+
+    available = metrics.gauge("apiserver_available", "")
+    retries = metrics.counter("workqueue_retries_total", "")
+    try:
+        store.create(api.new_notebook("nb-before", "ns"))
+        wait_for(lambda: store.get_or_none("Pod", "ns", "nb-before-0"),
+                 timeout=60, msg="baseline reconcile over the wire")
+        assert readyz_status() == 200
+        assert available.get() == 1.0
+
+        proxy.stop()  # ------------------------------------ full outage
+        wait_for(lambda: mgr.breaker.state == "open", timeout=30,
+                 msg="breaker to open on consecutive transport failures")
+        assert readyz_status() == 503       # parked pool is NOT ready...
+        assert available.get() == 0.0       # ...and says so on /metrics
+        assert not mgr.breaker.allow_dispatch()
+        store.create(api.new_notebook("nb-during", "ns"))  # outage work
+        retries_before_resume = retries.total()
+
+        proxy = ApiServerProxy(store, port=port)  # ------------ recovery
+        proxy.start()
+        wait_for(lambda: store.get_or_none("Pod", "ns", "nb-during-0"),
+                 timeout=60,
+                 msg="outage-time notebook reconciled after resume")
+        wait_for(lambda: mgr.breaker.state == "closed", timeout=30,
+                 msg="breaker to close")
+        assert readyz_status() == 200
+        assert available.get() == 1.0
+        # the resume ran a full resync, counted as workqueue retries
+        assert retries.total() > retries_before_resume
+        transitions = metrics.counter(
+            "apiserver_breaker_transitions_total", "")
+        assert transitions.get({"to": "open"}) >= 1
+        assert transitions.get({"to": "closed"}) >= 1
+    finally:
+        mgr.stop()
+        client.close()
+        proxy.stop()
+        sim_mgr.stop()

@@ -1,0 +1,17 @@
+"""Tier-1 wiring for the chaos smoke (ci/chaos_smoke).
+
+Runs the real wire stack — schema-validated chaos experiments executed by
+the runner (injection + steadyState checks + recovery bounds) plus a
+20-notebook fan-out at a 5% injected wire-fault rate (429/503/reset/
+watch-kill) with the audit-tap idempotency check — under a hard wall
+budget, so a robustness regression (retry storm, dead watch thread,
+breaker that never closes, duplicate create under resets) fails the unit
+gate instead of waiting for a manual chaos run. The heavier 50 @ 10%
+variant is the ci/chaos_smoke.py CLI default (chaos_validation workflow).
+"""
+
+from ci.chaos_smoke import run_smoke
+
+
+def test_chaos_smoke_experiments_and_fault_soak():
+    assert run_smoke(count=20, fault_rate=0.05, budget_s=150.0) == 0

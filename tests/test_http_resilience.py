@@ -1,0 +1,247 @@
+"""Client resilience over the real wire: bounded retries, Retry-After,
+ambiguous-mutation disambiguation, and watch-thread survival under fault
+bursts — the transport behaviors the chaos soak leans on, pinned one by
+one against a fault-injecting ApiServerProxy (cluster/faults.FaultPlan).
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.cluster import http_client as hc
+from kubeflow_tpu.cluster.apiserver import ApiServerProxy
+from kubeflow_tpu.cluster.errors import (ApiError, ServiceUnavailableError,
+                                         TooManyRequestsError)
+from kubeflow_tpu.cluster.faults import (FAULT_HTTP, FAULT_LATENCY,
+                                         FAULT_RESET, FAULT_WATCH_KILL,
+                                         FaultPlan, FaultRule)
+from kubeflow_tpu.cluster.http_client import HttpApiClient, RetryPolicy
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+FAST = RetryPolicy(max_attempts=4, backoff_base_s=0.01, backoff_cap_s=0.1)
+
+
+@pytest.fixture()
+def server(store):
+    proxy = ApiServerProxy(store)
+    proxy.start()
+    yield proxy
+    proxy.stop()
+
+
+@pytest.fixture()
+def client(server):
+    cl = HttpApiClient(server.url, retry_policy=FAST)
+    yield cl
+    cl.close()
+
+
+def cm(name, ns="default", data=None):
+    return {"kind": "ConfigMap", "apiVersion": "v1",
+            "metadata": {"name": name, "namespace": ns},
+            "data": data or {"k": "v"}}
+
+
+def plan_429(rate=1.0, retry_after=0.01, n_then_clean=None):
+    return FaultPlan([FaultRule(FAULT_HTTP, rate, status=429,
+                                retry_after_s=retry_after)], seed=5)
+
+
+# ---------------------------------------------------------------- retries
+
+
+def test_get_retries_through_429_and_counts_metric(server, client, store):
+    store.create(cm("x"))
+    metrics = MetricsRegistry()
+    client.attach_metrics(metrics)
+    # deterministic burst: exactly the first 3 requests 429, then clean —
+    # one logical GET retries 3 times and succeeds on the 4th attempt
+    server.set_fault_plan(FaultPlan(
+        [FaultRule(FAULT_HTTP, 1.0, status=429, retry_after_s=0.001,
+                   times=3)]))
+    assert client.get("ConfigMap", "default", "x")["data"]["k"] == "v"
+    retries = metrics.counter("rest_client_retries_total", "")
+    assert retries.get({"verb": "GET", "reason": "429"}) == 3
+    durations = metrics.histogram("rest_client_request_duration_seconds", "")
+    assert "rest_client_request_duration_seconds" in durations.expose()
+
+
+def test_429_retry_after_is_honored(server, client, store):
+    """The server's pacing wins over the computed backoff: a 429 burst with
+    Retry-After=0.2 must make the retried call take at least that long."""
+    store.create(cm("paced"))
+    server.set_fault_plan(FaultPlan(
+        [FaultRule(FAULT_HTTP, 1.0, status=429, retry_after_s=0.2,
+                   times=1)]))
+    t0 = time.monotonic()
+    client.get("ConfigMap", "default", "paced")
+    # the single 429 carried Retry-After=0.2, far above the computed
+    # backoff (base 0.01): the wait must come from the header
+    assert time.monotonic() - t0 >= 0.2
+
+
+def test_429_exhaustion_raises_too_many_requests(server, client, store):
+    store.create(cm("x"))
+    server.set_fault_plan(FaultPlan(
+        [FaultRule(FAULT_HTTP, 1.0, status=429, retry_after_s=0.001)]))
+    with pytest.raises(TooManyRequestsError) as exc_info:
+        client.get("ConfigMap", "default", "x")
+    assert exc_info.value.retry_after == pytest.approx(0.001)
+
+
+def test_503_retried_for_get_but_not_update(server, client, store):
+    store.create(cm("x"))
+    server.set_fault_plan(FaultPlan(
+        [FaultRule(FAULT_HTTP, 1.0, status=503)]))
+    with pytest.raises(ServiceUnavailableError):
+        client.get("ConfigMap", "default", "x")  # retried, then raises
+    t0 = time.monotonic()
+    with pytest.raises(ServiceUnavailableError):
+        client.update(cm("x", data={"k": "v2"}))
+    # PUT fails FAST (no transport/5xx retry loop for non-idempotent verbs)
+    assert time.monotonic() - t0 < 0.5 * FAST.max_attempts
+
+
+def test_get_survives_connection_reset_mid_body(server, client, store):
+    store.create(cm("x"))
+    # the first two attempts of the GET truncate mid-body, the third is
+    # clean — IncompleteRead/ECONNRESET must be retried, not surfaced
+    server.set_fault_plan(FaultPlan([FaultRule(FAULT_RESET, 1.0, times=2)]))
+    assert client.get("ConfigMap", "default", "x")["data"]["k"] == "v"
+
+
+def test_latency_spike_fault_delays_but_succeeds(server, client, store):
+    store.create(cm("x"))
+    server.set_fault_plan(FaultPlan(
+        [FaultRule(FAULT_LATENCY, 1.0, latency_s=0.15)]))
+    t0 = time.monotonic()
+    assert client.get("ConfigMap", "default", "x")
+    assert time.monotonic() - t0 >= 0.15
+
+
+# ------------------------------------------- ambiguous-mutation semantics
+
+
+def test_create_reset_applies_then_retry_adopts_via_409(server, client,
+                                                        store):
+    """The acceptance-critical ambiguity: every create response is reset
+    AFTER the store applied it. The retry's 409 AlreadyExists must
+    resolve to the live object, not an error — and the store must hold
+    exactly one object."""
+    server.set_fault_plan(FaultPlan(
+        [FaultRule(FAULT_RESET, 1.0, verbs=frozenset({"create"}))]))
+    created = client.create(cm("amb", data={"a": "1"}))
+    assert created["metadata"]["name"] == "amb"
+    assert created["data"] == {"a": "1"}
+    assert store.get("ConfigMap", "default", "amb")
+
+
+def test_genuine_already_exists_still_raises(server, client, store):
+    from kubeflow_tpu.cluster.errors import AlreadyExistsError
+    store.create(cm("dup"))
+    with pytest.raises(AlreadyExistsError):
+        client.create(cm("dup"))
+
+
+def test_delete_reset_applies_then_retry_tolerates_404(server, client,
+                                                       store):
+    store.create(cm("bye"))
+    # first DELETE applies server-side and the response resets; the retry
+    # sees a clean 404, which the ambiguity marker converts to success
+    server.set_fault_plan(FaultPlan(
+        [FaultRule(FAULT_RESET, 1.0, verbs=frozenset({"delete"}),
+                   times=1)]))
+    client.delete("ConfigMap", "default", "bye")  # must not raise
+    assert store.get_or_none("ConfigMap", "default", "bye") is None
+
+
+def test_genuine_delete_of_missing_object_still_raises(server, client):
+    from kubeflow_tpu.cluster.errors import NotFoundError
+    with pytest.raises(NotFoundError):
+        client.delete("ConfigMap", "default", "never-existed")
+
+
+# ----------------------------------------------------- watch-thread faults
+
+
+def watch_collector(client, store, monkeypatch, kind="ConfigMap"):
+    monkeypatch.setattr(hc, "WATCH_RECONNECT_DELAY_S", 0.05)
+    events, got = [], threading.Event()
+
+    def cb(event):
+        events.append(event)
+        got.set()
+    client.watch(kind, cb, namespace="default")
+    return events, got
+
+
+def wait_for_name(events, name, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if any(e.obj["metadata"]["name"] == name for e in events):
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_watch_survives_503_burst_on_resync_list(server, client, store,
+                                                 monkeypatch):
+    """Satellite regression: an ApiError from the resync LIST (503 burst
+    past the retry budget) must reconnect the daemon watch thread with
+    backoff, never kill it. Burst = kill every stream instantly AND 503
+    every resync list; heal and assert events still flow."""
+    events, _ = watch_collector(client, store, monkeypatch)
+    server.set_fault_plan(FaultPlan([
+        FaultRule(FAULT_WATCH_KILL, 1.0, after_s=0.0),
+        FaultRule(FAULT_HTTP, 1.0, status=503,
+                  verbs=frozenset({"list", "watch"})),
+    ]))
+    time.sleep(1.0)  # several reconnect attempts fail entirely
+    server.set_fault_plan(None)
+    store.create(cm("after-burst"))
+    assert wait_for_name(events, "after-burst"), \
+        "watch thread died during the 503 burst"
+
+
+def test_watch_survives_reset_during_resync_list(server, client, store,
+                                                 monkeypatch):
+    """The reset variant: a truncated LIST body raises IncompleteRead —
+    an HTTPException, NOT an OSError — which used to escape the watch
+    loop and silently kill the thread."""
+    events, _ = watch_collector(client, store, monkeypatch)
+    server.set_fault_plan(FaultPlan([
+        FaultRule(FAULT_WATCH_KILL, 1.0, after_s=0.0),
+        FaultRule(FAULT_RESET, 1.0, verbs=frozenset({"list", "get"})),
+    ]))
+    time.sleep(1.0)
+    server.set_fault_plan(None)
+    store.create(cm("after-resets"))
+    assert wait_for_name(events, "after-resets"), \
+        "watch thread died on IncompleteRead during resync"
+
+
+def test_watch_kill_reconnect_resyncs_missed_changes(server, client, store,
+                                                     monkeypatch):
+    """Changes landing while the stream is down arrive via the RV-diff
+    resync after the killed stream reconnects."""
+    events, got = watch_collector(client, store, monkeypatch)
+    store.create(cm("pre"))
+    assert wait_for_name(events, "pre")
+    server.set_fault_plan(FaultPlan(
+        [FaultRule(FAULT_WATCH_KILL, 0.5, after_s=0.1)], seed=21))
+    for i in range(5):
+        store.create(cm(f"during-{i}"))
+        time.sleep(0.05)
+    server.set_fault_plan(None)
+    for i in range(5):
+        assert wait_for_name(events, f"during-{i}"), \
+            f"during-{i} lost across killed watch streams"
+
+
+def test_ping_truth_table(server, store):
+    cl = HttpApiClient(server.url, retry_policy=FAST)
+    assert cl.ping() is True
+    server.stop()
+    assert cl.ping() is False
+    cl.close()

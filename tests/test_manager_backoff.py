@@ -1,0 +1,266 @@
+"""Manager error-backoff behavior under sustained failure, the overall
+token-bucket rate limiter, resync accounting, and the circuit-breaker
+state machine (controllers/resilience.py) — the robustness contract the
+chaos suite leans on, pinned at the unit level.
+"""
+
+import time
+
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.controllers.manager import Manager, Request, _QueueItem
+from kubeflow_tpu.controllers.resilience import CircuitBreaker, TokenBucket
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+
+class _AlwaysFails:
+    name = "boom"
+
+    def reconcile(self, req):
+        raise RuntimeError("injected reconcile failure")
+
+
+class _FailsNTimes:
+    name = "flaky"
+
+    def __init__(self, n):
+        self.remaining = n
+
+    def reconcile(self, req):
+        if self.remaining > 0:
+            self.remaining -= 1
+            raise RuntimeError("transient")
+        return None
+
+
+def _capture_backoffs(mgr):
+    captured = []
+    original = mgr.enqueue
+
+    def recording(controller, req, after=0.0):
+        captured.append(after)
+        original(controller, req, after=after)
+    mgr.enqueue = recording
+    return captured
+
+
+def _item(controller, name="n"):
+    return _QueueItem(0.0, 0, controller, Request("ns", name))
+
+
+# ---------------------------------------------------------- error backoff
+
+
+def test_per_key_backoff_grows_and_caps_at_error_backoff_max():
+    mgr = Manager(ClusterStore(), rate_limiter=False)
+    mgr.register(_AlwaysFails())
+    backoffs = _capture_backoffs(mgr)
+    item = _item("boom")
+    for _ in range(15):
+        mgr._process(item)
+    assert backoffs == sorted(backoffs), "backoff must be monotonic"
+    assert backoffs[0] == Manager.ERROR_BACKOFF_BASE * 2
+    assert max(backoffs) == Manager.ERROR_BACKOFF_MAX
+    # the ladder stays pinned at the cap under sustained failure — it
+    # must never wrap, reset, or overflow past ERROR_BACKOFF_MAX
+    assert backoffs[-5:] == [Manager.ERROR_BACKOFF_MAX] * 5
+
+
+def test_backoff_is_per_key_not_shared():
+    mgr = Manager(ClusterStore(), rate_limiter=False)
+    mgr.register(_AlwaysFails())
+    backoffs = _capture_backoffs(mgr)
+    for _ in range(6):
+        mgr._process(_item("boom", "a"))
+    first_b = len(backoffs)
+    mgr._process(_item("boom", "b"))
+    # key b starts at the bottom of the ladder despite a's failures
+    assert backoffs[first_b] == Manager.ERROR_BACKOFF_BASE * 2
+
+
+def test_failures_cleared_on_success():
+    mgr = Manager(ClusterStore(), rate_limiter=False)
+    flaky = _FailsNTimes(2)
+    mgr.register(flaky)
+    item = _item("flaky")
+    key = (item.controller, item.req)
+    mgr._process(item)
+    mgr._process(item)
+    assert mgr._failures[key] == 2
+    mgr._process(item)  # third run succeeds
+    assert key not in mgr._failures, \
+        "_failures must clear on success so the next error restarts low"
+
+
+def test_retries_metric_counts_backoffs_and_breaker_resume_resyncs():
+    """workqueue_retries_total = error-backoff requeues + breaker-resume
+    resync re-enqueues (a resync IS a retry of the world)."""
+    store = ClusterStore()
+    mgr = Manager(store, rate_limiter=False)
+    metrics = MetricsRegistry()
+    mgr.attach_metrics(metrics)
+    mgr.register(_AlwaysFails())
+    mgr.watch("ConfigMap", "boom")
+    retries = metrics.counter("workqueue_retries_total", "")
+    mgr._process(_item("boom"))
+    mgr._process(_item("boom"))
+    assert retries.get({"name": "boom"}) == 2
+    for i in range(3):
+        store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                      "metadata": {"name": f"cm-{i}", "namespace": "ns"}})
+    enqueued = mgr.resync_all()
+    assert enqueued == 3
+    assert retries.get({"name": "boom"}) == 5
+
+
+def test_resync_all_maps_through_registered_mapper():
+    store = ClusterStore()
+    mgr = Manager(store, rate_limiter=False)
+    mgr.register(_AlwaysFails())
+    seen = []
+    mgr.watch("ConfigMap", "boom",
+              mapper=lambda obj: [Request("mapped",
+                                          obj["metadata"]["name"])])
+    mgr.enqueue = lambda c, r, after=0.0: seen.append((c, r))
+    store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                  "metadata": {"name": "x", "namespace": "ns"}})
+    mgr.resync_all()
+    assert ("boom", Request("mapped", "x")) in seen
+
+
+# ------------------------------------------------------------ rate limiter
+
+
+def test_token_bucket_burst_then_paces():
+    fake = [0.0]
+    bucket = TokenBucket(qps=10.0, burst=3, clock=lambda: fake[0])
+    assert [bucket.next_delay() for _ in range(3)] == [0.0, 0.0, 0.0]
+    d4 = bucket.next_delay()
+    d5 = bucket.next_delay()
+    assert abs(d4 - 0.1) < 1e-9   # first over-burst waits one token period
+    assert abs(d5 - 0.2) < 1e-9   # debt accumulates
+    fake[0] += 1.0                # a second replenishes 10 tokens
+    assert bucket.next_delay() == 0.0
+
+
+def test_manager_composes_bucket_with_exponential_backoff():
+    """MaxOfRateLimiter semantics: once the bucket's burst is spent, the
+    error requeue delay is the BUCKET's pace, not the (smaller) early
+    exponential steps."""
+    mgr = Manager(ClusterStore(), rate_limiter=TokenBucket(qps=2.0, burst=1))
+    mgr.register(_AlwaysFails())
+    backoffs = _capture_backoffs(mgr)
+    mgr._process(_item("boom", "a"))   # burst token: exponential wins
+    mgr._process(_item("boom", "b"))   # bucket empty: 0.5s pace wins
+    assert backoffs[0] == Manager.ERROR_BACKOFF_BASE * 2
+    assert backoffs[1] >= 0.4
+
+
+def test_default_rate_limiter_is_installed():
+    mgr = Manager(ClusterStore())
+    assert isinstance(mgr.rate_limiter, TokenBucket)
+    assert Manager(ClusterStore(), rate_limiter=False).rate_limiter is None
+
+
+# --------------------------------------------------------- circuit breaker
+
+
+def test_breaker_state_machine_with_fake_clock():
+    now = [0.0]
+    probe_ok = [False]
+    resumed = []
+    breaker = CircuitBreaker(probe=lambda: probe_ok[0],
+                             failure_threshold=3, probe_interval_s=1.0,
+                             on_resume=lambda: resumed.append(now[0]),
+                             clock=lambda: now[0])
+    assert breaker.state == "closed" and breaker.allow_dispatch()
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state == "closed"     # below threshold
+    breaker.record_failure()
+    assert breaker.state == "open" and not breaker.allow_dispatch()
+
+    assert breaker.maybe_probe() is False  # not due yet
+    now[0] = 1.1
+    assert breaker.maybe_probe() is True   # probe ran...
+    assert breaker.state == "open"         # ...and failed: still open
+    assert breaker.maybe_probe() is False  # interval doubled to 2s
+    now[0] = 2.0
+    assert breaker.maybe_probe() is False
+    now[0] = 3.2
+    probe_ok[0] = True
+    assert breaker.maybe_probe() is True
+    assert breaker.state == "closed" and breaker.allow_dispatch()
+    assert resumed == [3.2], "on_resume fires exactly once per close"
+
+
+def test_breaker_organic_success_closes_and_resumes():
+    """A watch thread reconnecting (any request success) recovers the
+    breaker without waiting for a probe."""
+    resumed = []
+    breaker = CircuitBreaker(failure_threshold=2,
+                             on_resume=lambda: resumed.append(True))
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state == "open"
+    breaker.record_success()
+    assert breaker.state == "closed"
+    assert resumed == [True]
+
+
+def test_breaker_consecutive_means_consecutive():
+    breaker = CircuitBreaker(failure_threshold=3)
+    for _ in range(10):
+        breaker.record_failure()
+        breaker.record_failure()
+        breaker.record_success()     # interleaved success resets the run
+    assert breaker.state == "closed"
+
+
+def test_breaker_metrics_transitions():
+    metrics = MetricsRegistry()
+    breaker = CircuitBreaker(failure_threshold=1)
+    breaker.attach_metrics(metrics)
+    available = metrics.gauge("apiserver_available", "")
+    assert available.get() == 1.0
+    breaker.record_failure()
+    assert available.get() == 0.0
+    assert metrics.gauge("apiserver_breaker_state", "").get() == 2.0
+    breaker.record_success()
+    assert available.get() == 1.0
+    transitions = metrics.counter("apiserver_breaker_transitions_total", "")
+    assert transitions.get({"to": "open"}) == 1
+    assert transitions.get({"to": "closed"}) == 1
+
+
+def test_breaker_parks_worker_pool_until_probe_succeeds():
+    """Integration: a Manager whose breaker is open dispatches nothing;
+    the half-open probe succeeding un-parks it and the queue drains."""
+    store = ClusterStore()
+    ran = []
+
+    class Records:
+        name = "rec"
+
+        def reconcile(self, req):
+            ran.append(req)
+            return None
+
+    server_up = [False]
+    breaker = CircuitBreaker(probe=lambda: server_up[0],
+                             failure_threshold=1, probe_interval_s=0.05)
+    mgr = Manager(store, max_concurrent_reconciles=2, rate_limiter=False)
+    mgr.breaker = breaker
+    mgr.register(Records())
+    breaker.record_failure()  # outage observed before any dispatch
+    mgr.start()
+    try:
+        mgr.enqueue("rec", Request("ns", "parked"))
+        time.sleep(0.4)
+        assert ran == [], "open breaker must park the worker pool"
+        server_up[0] = True   # apiserver back: next probe closes it
+        deadline = time.monotonic() + 10.0
+        while not ran and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert ran == [Request("ns", "parked")]
+    finally:
+        mgr.stop()
