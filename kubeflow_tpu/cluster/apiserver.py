@@ -515,11 +515,30 @@ class _Handler(BaseHTTPRequestHandler):
         if query.get("watch") in ("true", "1"):
             self._stream_watch(route, selector)
             return
-        items = self.store.list(kind, route.namespace, selector)
+        # chunked LIST (?limit=&continue=) + resourceVersion passthrough
+        # (rv=0 is the informer cache-ack form — see ClusterStore.list_page)
+        try:
+            limit = int(query["limit"]) if query.get("limit") else None
+        except ValueError:
+            self._send_error_status(400, "BadRequest",
+                                    f"invalid limit {query['limit']!r}")
+            return
+        pager = getattr(self.store, "list_page", None)
+        if pager is not None:
+            items, next_cont, list_rv = pager(
+                kind, route.namespace, selector, limit=limit,
+                continue_token=query.get("continue"),
+                resource_version=query.get("resourceVersion"))
+        else:  # wrapped store without pagination: one full page
+            items, next_cont, list_rv = \
+                self.store.list(kind, route.namespace, selector), None, "0"
+        list_meta: dict = {"resourceVersion": list_rv}
+        if next_cont:
+            list_meta["continue"] = next_cont
         self._send_json(200, {
             "kind": f"{kind}List",
             "apiVersion": route.mapping.api_version,
-            "metadata": {},
+            "metadata": list_meta,
             "items": items,
         })
 

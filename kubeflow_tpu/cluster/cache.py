@@ -1,4 +1,4 @@
-"""Read-cache client layer with data-stripping transforms.
+"""Read-cache client layer: data-stripping transforms + indexed stores.
 
 Reference: odh main.go builds its manager cache with transforms that strip
 ``data``/``binaryData``/``stringData`` from every cached Secret and ConfigMap
@@ -16,6 +16,23 @@ apiserver.
   direct to the store (fresh, untransformed);
 - writes always pass through.
 
+Reads are served from **per-kind stores carrying client-go-style indexers**
+(controller-runtime's informer cache registers namespace/label/field
+indexers behind every cached List; the reference's ``_find_owned_sts``-shape
+lookups never scan the world):
+
+- ``by-namespace`` — every namespaced list;
+- ``by-label`` — one index per hot label key (``DEFAULT_LABEL_INDEXES``:
+  the selectors the controllers actually use), equality AND existence form;
+- ``by-owner`` — ownerReferences UID, serving ``get_owned`` (the
+  Owns()-style lookup).
+
+Indexes are maintained incrementally on ingest/delete, so ``list`` and
+``get_owned`` are O(result), not O(cache). The lock guards ONLY the index
+lookup; label predicates and deepcopies run outside it (a big fleet's list
+must never stall ingestion). ``cache_index_lookups_total`` /
+``cache_full_scans_total`` prove the hot path stays scan-free.
+
 This is also where the framework's memory ceiling for big fleets is enforced:
 the cache never holds Secret/ConfigMap payloads, the same reason the
 reference added the transforms.
@@ -27,11 +44,21 @@ import threading
 import time
 from typing import Callable, Iterable
 
-from ..utils import k8s
+from ..utils import k8s, names
 from .store import WatchEvent
 
 DEFAULT_DISABLE_FOR = ("Secret", "ConfigMap")
 
+#: label keys indexed by default — the selectors the controllers actually
+#: use on hot paths: the notebook-name fleet label (``_find_owned_sts``,
+#: culling's pod scrape, the notebook_running metrics scrape), the STS pod
+#: selector, the runtime-image inventory, and the k8s part-of grouping
+DEFAULT_LABEL_INDEXES = (
+    names.NOTEBOOK_NAME_LABEL,
+    "statefulset",
+    "opendatahub.io/runtime-image",
+    "app.kubernetes.io/part-of",
+)
 
 LAST_APPLIED_ANNOTATION = "kubectl.kubernetes.io/last-applied-configuration"
 
@@ -95,6 +122,115 @@ def live_reader(client):
     return getattr(client, "store", None) or client
 
 
+def owned_objects(client, kind: str, owner: dict) -> list[dict]:
+    """``get_owned`` through ANY client: the indexed lookup when the client
+    carries the informer index (CachingClient behind the usual wrapper
+    chain), else a namespace LIST filtered by ownerReferences — the SAME
+    result set either way (ownership is the one filter; a label selector
+    here would silently drop an owned-but-mislabeled object on one path
+    and adopt it on the other)."""
+    fn = getattr(client, "get_owned", None)
+    if fn is not None:
+        return fn(kind, owner)
+    return [o for o in client.list(kind, k8s.namespace(owner))
+            if k8s.is_owned_by(o, k8s.uid(owner))]
+
+
+def _owner_uids(obj: dict) -> list[str]:
+    return [r.get("uid") for r in
+            (k8s.get_in(obj, "metadata", "ownerReferences",
+                        default=[]) or [])
+            if r.get("uid")]
+
+
+class _KindStore:
+    """One kind's objects plus its incrementally-maintained indexers (the
+    client-go Indexer shape: ``by-namespace``, ``by-label`` per registered
+    key, ``by-owner`` on ownerReferences UID). All mutation happens under
+    the CachingClient lock; object dicts are replaced, never mutated, so
+    references handed out under the lock are safe to read outside it."""
+
+    __slots__ = ("label_keys", "objects", "by_namespace", "by_owner",
+                 "by_label")
+
+    def __init__(self, label_keys: tuple[str, ...]):
+        self.label_keys = label_keys
+        self.objects: dict[tuple[str, str], dict] = {}  # (ns, name) → obj
+        self.by_namespace: dict[str, set] = {}
+        self.by_owner: dict[str, set] = {}
+        self.by_label: dict[str, dict[str, set]] = {k: {} for k in label_keys}
+
+    # --------------------------------------------------------- maintenance
+    def replace(self, key: tuple[str, str], obj: dict) -> None:
+        old = self.objects.get(key)
+        if old is not None:
+            self._unindex(key, old)
+        self.objects[key] = obj
+        self._index(key, obj)
+
+    def remove(self, key: tuple[str, str]) -> None:
+        old = self.objects.pop(key, None)
+        if old is not None:
+            self._unindex(key, old)
+
+    def _index(self, key: tuple[str, str], obj: dict) -> None:
+        self.by_namespace.setdefault(key[0], set()).add(key)
+        for uid in _owner_uids(obj):
+            self.by_owner.setdefault(uid, set()).add(key)
+        labels = k8s.get_in(obj, "metadata", "labels", default=None) or {}
+        for lk in self.label_keys:
+            if lk in labels:
+                self.by_label[lk].setdefault(labels[lk], set()).add(key)
+
+    def _unindex(self, key: tuple[str, str], obj: dict) -> None:
+        self._drop(self.by_namespace, key[0], key)
+        for uid in _owner_uids(obj):
+            self._drop(self.by_owner, uid, key)
+        labels = k8s.get_in(obj, "metadata", "labels", default=None) or {}
+        for lk in self.label_keys:
+            if lk in labels:
+                self._drop(self.by_label[lk], labels[lk], key)
+
+    @staticmethod
+    def _drop(index: dict, value, key) -> None:
+        bucket = index.get(value)
+        if bucket is not None:
+            bucket.discard(key)
+            if not bucket:  # empty buckets would leak one set per old value
+                del index[value]
+
+    # -------------------------------------------------------------- lookup
+    def select(self, namespace: str | None,
+               selector: dict | None) -> tuple[list[dict], str]:
+        """Candidate objects via the narrowest applicable index. Returns
+        (object refs, access path); the caller re-applies the FULL
+        namespace+selector predicate outside the lock, so over-selection
+        here is a perf concern only, never a correctness one."""
+        if selector:
+            for lk in self.label_keys:
+                if lk in selector:
+                    idx = self.by_label[lk]
+                    val = selector[lk]
+                    if val is None:  # existence term: every indexed value
+                        keys = [k for bucket in idx.values() for k in bucket]
+                    else:
+                        keys = list(idx.get(val, ()))
+                    return [self.objects[k] for k in keys], "by-label"
+        if namespace is not None:
+            return [self.objects[k]
+                    for k in self.by_namespace.get(namespace, ())], \
+                "by-namespace"
+        if not selector:
+            # unfiltered list-all IS the result set: O(result) by definition
+            return list(self.objects.values()), "all"
+        # selector carries no indexed key and no namespace bound: the one
+        # shape that still walks the whole kind (cache_full_scans_total)
+        return list(self.objects.values()), "scan"
+
+    def owned(self, owner_uid: str) -> list[dict]:
+        return [self.objects[k] for k in self.by_owner.get(owner_uid, ())]
+
+
 class CachingClient:
     """Same client surface as ClusterStore for reads/writes/watches, with the
     manager-cache semantics described above.
@@ -115,10 +251,12 @@ class CachingClient:
                  transforms: Iterable[Callable[[dict], dict]] =
                  DEFAULT_TRANSFORMS,
                  disable_for: Iterable[str] = DEFAULT_DISABLE_FOR,
-                 auto_informer: bool = True) -> None:
+                 auto_informer: bool = True,
+                 label_indexes: Iterable[str] = DEFAULT_LABEL_INDEXES) -> None:
         self.store = store
         self.transforms = tuple(transforms)
         self.disable_for = frozenset(disable_for)
+        self.label_indexes = tuple(label_indexes)
         # auto_informer=False: the cache opens NO watch streams of its own —
         # it is fed from watches its owner already holds (``feed``) plus an
         # explicit ``backfill`` per kind. This is how a reconciler shares
@@ -126,7 +264,7 @@ class CachingClient:
         # duplicating every stream + LIST (the reference likewise has ONE
         # informer layer serving both dispatch and cached reads).
         self.auto_informer = auto_informer
-        self._cache: dict[tuple[str, str, str], dict] = {}
+        self._kinds: dict[str, _KindStore] = {}
         # key → deletion time for keys DELETED by the watch stream; guards
         # the backfill (and the cache-miss fall-through) against resurrecting
         # an object whose DELETED event raced the list/get. The race window
@@ -141,6 +279,14 @@ class CachingClient:
         # to a live GET would re-create the per-frame GET storm for every
         # lookup of a deleted object (e.g. Events outliving their Pod)
         self._warm: set[str] = set()
+        # kind → count of currently-broken watch streams (mark_watch_gap/
+        # mark_watch_recovered, fed by the transport's stream health): while
+        # any stream for a kind is down, cached reads of it fall back LIVE —
+        # the informer can be arbitrarily stale until the reconnect resync
+        # lands, and an authoritative NotFound from a stale cache is wrong
+        self._gapped: dict[str, int] = {}
+        self._index_lookups = None  # cache_index_lookups_total
+        self._full_scans = None     # cache_full_scans_total
 
     # ------------------------------------------------------------- ingest
     def _transform(self, obj: dict) -> dict:
@@ -208,6 +354,54 @@ class CachingClient:
             self._watched.add(kind)
             self._warm.add(kind)
 
+    # -------------------------------------------------- watch-gap fallback
+    def mark_watch_gap(self, kind: str) -> None:
+        """A watch stream feeding ``kind`` dropped (transport stream-health
+        callback): until it recovers, cached reads of the kind serve LIVE —
+        the satellite contract for periodic scrapes (serve from the
+        informer index while the watch is healthy, fall back to a real
+        LIST only across a gap)."""
+        with self._lock:
+            self._gapped[kind] = self._gapped.get(kind, 0) + 1
+
+    def mark_watch_recovered(self, kind: str) -> None:
+        """The dropped stream reconnected AND its resync diff was delivered
+        (the cache is converged again): resume serving from the index."""
+        with self._lock:
+            n = self._gapped.get(kind, 0) - 1
+            if n > 0:
+                self._gapped[kind] = n
+            else:
+                self._gapped.pop(kind, None)
+
+    def _is_gapped(self, kind: str) -> bool:
+        with self._lock:
+            return kind in self._gapped
+
+    # -------------------------------------------------------------- metrics
+    def attach_metrics(self, registry) -> None:
+        """Register the index-vs-scan counter pair (and pass the registry
+        down to the backing store). ``cache_full_scans_total`` staying at 0
+        is the loadtest/smoke proof that no reconcile-hot read walks the
+        whole cache."""
+        self._index_lookups = registry.counter(
+            "cache_index_lookups_total",
+            "Cached reads served via an informer index, by kind and "
+            "index (by-label / by-namespace / by-owner / all).")
+        self._full_scans = registry.counter(
+            "cache_full_scans_total",
+            "Cached LISTs that had to walk a whole kind store because no "
+            "index covered the query. Must be 0 on the reconcile hot path.")
+        if hasattr(self.store, "attach_metrics"):
+            self.store.attach_metrics(registry)
+
+    def _count_access(self, kind: str, via: str) -> None:
+        if via == "scan":
+            if self._full_scans is not None:
+                self._full_scans.inc({"kind": kind})
+        elif self._index_lookups is not None:
+            self._index_lookups.inc({"kind": kind, "index": via})
+
     TOMBSTONE_TTL_S = 10.0
 
     def _prune_tombstones_locked(self) -> None:
@@ -220,7 +414,9 @@ class CachingClient:
         key = self._key(event.obj)
         if event.type == "DELETED":
             with self._lock:
-                self._cache.pop(key, None)
+                ks = self._kinds.get(key[0])
+                if ks is not None:
+                    ks.remove((key[1], key[2]))
                 self._prune_tombstones_locked()
                 self._tombstones[key] = time.monotonic()
         else:
@@ -243,7 +439,10 @@ class CachingClient:
             elif self._tombstones.get(key, 0) > \
                     time.monotonic() - self.TOMBSTONE_TTL_S:
                 return  # stale snapshot of a deleted object
-            cached = self._cache.get(key)
+            ks = self._kinds.get(key[0])
+            if ks is None:
+                ks = self._kinds[key[0]] = _KindStore(self.label_indexes)
+            cached = ks.objects.get((key[1], key[2]))
             if cached is not None:
                 cached_rv, new_rv = self._rv(cached), self._rv(obj)
                 # never replace a newer watched copy with older state — an
@@ -255,14 +454,30 @@ class CachingClient:
                 # per stream; re-transform/re-store under the lock is waste
                 if new_rv and cached_rv == new_rv:
                     return
-            self._cache[key] = self._transform(obj)
+            ks.replace((key[1], key[2]), self._transform(obj))
 
     @staticmethod
     def _key(obj: dict) -> tuple[str, str, str]:
         return (obj.get("kind", ""), k8s.namespace(obj), k8s.name(obj))
 
     # -------------------------------------------------------------- reads
+    def cached_object(self, kind: str, namespace: str,
+                      name: str) -> dict | None:
+        """Introspection: the cache's current copy (deepcopy) or None —
+        what a cache consumer WOULD see, without live fall-through. Tests
+        assert payload-stripping and tombstone behavior through this."""
+        with self._lock:
+            ks = self._kinds.get(kind)
+            obj = ks.objects.get((namespace, name)) if ks else None
+        return k8s.deepcopy(obj) if obj is not None else None
+
     def get(self, kind: str, namespace: str, name: str) -> dict:
+        if self._is_gapped(kind):
+            # watch gap: the cache may be missing foreign writes until the
+            # resync lands — neither a cached copy nor an authoritative
+            # NotFound is trustworthy, so read live (no ingest: event
+            # ordering during the gap is unknown; the resync repairs)
+            return self.store.get(kind, namespace, name)
         if kind in self.disable_for:
             # payload kind: a HIT still reads live (the caller wants the
             # data the cache deliberately strips), but a MISS on a warm,
@@ -270,7 +485,8 @@ class CachingClient:
             # for every optional ConfigMap probed per reconcile
             with self._lock:
                 warm = kind in self._warm
-                present = (kind, namespace, name) in self._cache
+                ks = self._kinds.get(kind)
+                present = ks is not None and (namespace, name) in ks.objects
             if warm and not present:
                 from .errors import NotFoundError
                 raise NotFoundError(f"{kind} {namespace}/{name}")
@@ -283,7 +499,8 @@ class CachingClient:
             return self.store.get(kind, namespace, name)
         self._ensure_informer(kind)
         with self._lock:
-            obj = self._cache.get((kind, namespace, name))
+            ks = self._kinds.get(kind)
+            obj = ks.objects.get((namespace, name)) if ks else None
             warm = kind in self._warm
         if obj is not None:
             return k8s.deepcopy(obj)
@@ -310,19 +527,50 @@ class CachingClient:
              label_selector: dict | None = None) -> list[dict]:
         with self._lock:
             unfed = kind not in self._watched
-        if kind in self.disable_for or (unfed and not self.auto_informer):
+        if kind in self.disable_for or (unfed and not self.auto_informer) \
+                or self._is_gapped(kind):
             # external-feed mode never auto-opens informers, so a LIST of a
-            # kind nobody backfilled must go live, not return an empty cache
+            # kind nobody backfilled must go live, not return an empty
+            # cache; a watch gap likewise bypasses the (possibly stale)
+            # index until the reconnect resync converges it
             return self.store.list(kind, namespace, label_selector)
         self._ensure_informer(kind)
-        # filter first, deepcopy only the matches, and do the copying
-        # outside the lock — list() on a big fleet must not stall ingestion
+        # index lookup under the lock is O(result); the label predicate and
+        # the deepcopying run OUTSIDE it — object dicts are replaced (never
+        # mutated) on ingest, so the refs stay safe to read, and list() on
+        # a big fleet never stalls ingestion on per-object predicate work
         with self._lock:
-            matched = [o for (k, ns, _), o in self._cache.items()
-                       if k == kind
-                       and (namespace is None or ns == namespace)
-                       and k8s.matches_labels(o, label_selector)]
+            ks = self._kinds.get(kind)
+            candidates, via = (ks.select(namespace, label_selector)
+                               if ks is not None else ([], "all"))
+        self._count_access(kind, via)
+        matched = [o for o in candidates
+                   if (namespace is None or k8s.namespace(o) == namespace)
+                   and k8s.matches_labels(o, label_selector)]
         return [k8s.deepcopy(o) for o in matched]
+
+    def get_owned(self, kind: str, owner: dict | str) -> list[dict]:
+        """Objects of ``kind`` whose ownerReferences carry the owner's UID —
+        the by-owner index lookup (client-go's cache.OwnerIndex shape), the
+        O(result) replacement for list-by-label + Python ownership filter.
+        ``owner`` is the owner object (preferred: its namespace scopes the
+        live fallback) or a bare UID string. Ownership is the ONLY filter,
+        on the index path and the live fallback alike — identical result
+        sets regardless of wiring."""
+        owner_uid = k8s.uid(owner) if isinstance(owner, dict) else owner
+        owner_ns = k8s.namespace(owner) if isinstance(owner, dict) else None
+        with self._lock:
+            unfed = kind not in self._watched
+        if kind in self.disable_for or (unfed and not self.auto_informer) \
+                or self._is_gapped(kind):
+            return [o for o in self.store.list(kind, owner_ns)
+                    if k8s.is_owned_by(o, owner_uid)]
+        self._ensure_informer(kind)
+        with self._lock:
+            ks = self._kinds.get(kind)
+            candidates = ks.owned(owner_uid) if ks is not None else []
+        self._count_access(kind, "by-owner")
+        return [k8s.deepcopy(o) for o in candidates]
 
     # ---------------------------------------- writes + watches: passthrough
     def _ingest_write(self, obj, recreate: bool = False):
@@ -376,7 +624,3 @@ class CachingClient:
     @property
     def supports_inprocess_admission(self) -> bool:
         return getattr(self.store, "supports_inprocess_admission", True)
-
-    def attach_metrics(self, registry) -> None:
-        if hasattr(self.store, "attach_metrics"):
-            self.store.attach_metrics(registry)

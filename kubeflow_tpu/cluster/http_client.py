@@ -78,6 +78,14 @@ _ERROR_BY_CODE = {404: NotFoundError, 409: ConflictError, 422: InvalidError,
 TRANSPORT_ERRORS = (urllib.error.URLError, OSError, http.client.HTTPException)
 
 
+class MalformedListError(http.client.HTTPException):
+    """A LIST response parsed as JSON but carries no ``items`` array — a
+    truncated/foreign body (LB error page, apiserver killed mid-write)
+    that must surface as a retryable transport failure. Reading it as an
+    empty list would be catastrophic during a watch resync: the RV-diff
+    would synthesize DELETED for every live object."""
+
+
 @dataclass(frozen=True)
 class RetryPolicy:
     """client-go-style bounded retries with decorrelated-jitter backoff.
@@ -116,6 +124,14 @@ WATCH_RECONNECT_DELAY_S = 1.0
 # long before dropping resets the backoff
 WATCH_BACKOFF_MAX_S = 30.0
 WATCH_BACKOFF_RESET_AFTER_S = 5.0
+
+
+def _require_items(parsed: dict) -> None:
+    """LIST-body validator for _json: no ``items`` array → transport
+    failure (see MalformedListError)."""
+    if not isinstance(parsed, dict) or \
+            not isinstance(parsed.get("items"), list):
+        raise MalformedListError("LIST body has no items array")
 
 
 def _serialize_selector(selector: dict) -> str:
@@ -174,11 +190,16 @@ class HttpApiClient:
                  ca_cert: str | None = None, client_cert: str | None = None,
                  client_key: str | None = None, verify: bool = True,
                  timeout: float = 30.0, metrics=None,
-                 retry_policy: RetryPolicy | None = None) -> None:
+                 retry_policy: RetryPolicy | None = None,
+                 list_page_size: int | None = None) -> None:
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
         self.retry_policy = retry_policy or RetryPolicy()
+        # LIST chunking (?limit=N&continue=…): bounds the memory and tail
+        # latency of a fleet-sized LIST — the backfills and post-outage
+        # resyncs page through instead of one giant body. None = unpaged.
+        self.list_page_size = list_page_size
         self._retry_rng = random.Random()  # decorrelated jitter source
         self._requests_metric = None
         self._retries_metric = None
@@ -199,6 +220,10 @@ class HttpApiClient:
                 ctx.load_cert_chain(client_cert, client_key)
             self._ssl = ctx
         self._stopped = threading.Event()
+        # optional watch stream-health listener pair (on_gap, on_recover):
+        # the read cache's degraded-mode hooks (CachingClient.mark_watch_gap)
+        # — while any stream for a kind is down, cached reads of it go live
+        self._watch_gap_listeners: tuple | None = None
         self._watch_threads: list[threading.Thread] = []
         # live watch responses, so close() can unblock readline() NOW
         # instead of waiting out the server's bookmark interval
@@ -295,6 +320,23 @@ class HttpApiClient:
         if tracker is not None:
             tracker.record_failure()
 
+    def set_watch_gap_listener(self, on_gap, on_recover) -> None:
+        """Attach per-kind stream-health callbacks: ``on_gap(kind)`` fires
+        when a watch stream for the kind drops (events may be missed until
+        reconnect), ``on_recover(kind)`` once the reconnected stream's
+        RV-diff resync has been delivered (the consumer's cache is
+        converged again). The read cache serves the gap window live."""
+        self._watch_gap_listeners = (on_gap, on_recover)
+
+    def _notify_watch_gap(self, kind: str, gapped: bool) -> None:
+        listeners = self._watch_gap_listeners
+        if listeners is None:
+            return
+        try:
+            (listeners[0] if gapped else listeners[1])(kind)
+        except Exception:  # noqa: BLE001 — consumer bug must not kill a watch
+            log.exception("watch gap listener failed for %s", kind)
+
     def set_health_tracker(self, tracker) -> None:
         """Attach an apiserver health tracker (record_success/
         record_failure) — the manager's circuit breaker. Watch reconnects
@@ -346,12 +388,16 @@ class HttpApiClient:
 
     def _json(self, method: str, path: str, body: dict | None = None,
               content_type: str = "application/json",
-              retry_transport: bool | None = None) -> dict:
+              retry_transport: bool | None = None,
+              validate=None) -> dict:
         """One logical request with the RetryPolicy applied. Transport
         retries default to the idempotent verbs; create() opts named POSTs
         in explicitly. Errors surfacing on a retry after an ambiguous
         (transport) failure carry ``ambiguous_retry`` so callers can
-        disambiguate (AlreadyExists on create, NotFound on delete)."""
+        disambiguate (AlreadyExists on create, NotFound on delete).
+        ``validate(parsed)`` may raise a TRANSPORT_ERRORS member to flag a
+        200 body that is semantically truncated (a LIST without ``items``)
+        — it rides the same retry/health path as a reset mid-body."""
         policy = self.retry_policy
         if retry_transport is None:
             retry_transport = method in ("GET", "DELETE")
@@ -365,7 +411,10 @@ class HttpApiClient:
                 with self._request(method, path, body, content_type) as resp:
                     data = resp.read()
                 self._observe_duration(method, started)
-                return json.loads(data)
+                parsed = json.loads(data)
+                if validate is not None:
+                    validate(parsed)
+                return parsed
             except ApiError as err:
                 self._observe_duration(method, started)
                 err.ambiguous_retry = ambiguous
@@ -436,11 +485,40 @@ class HttpApiClient:
 
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict[str, str] | None = None) -> list[dict]:
-        query = {}
+        return self._list(kind, namespace, label_selector)
+
+    def _list(self, kind: str, namespace: str | None,
+              label_selector: dict[str, str] | None,
+              resource_version: str | None = None) -> list[dict]:
+        """One logical LIST, paged through ``limit``/``continue`` when
+        ``list_page_size`` is set (bounds resync memory + tail latency on
+        big fleets). ``resource_version="0"`` is the informer cache-ack
+        form the resync path sends."""
+        base_query: dict[str, str] = {}
         if label_selector:
-            query["labelSelector"] = _serialize_selector(label_selector)
-        path = self._path(kind, namespace, query=query or None)
-        return self._json("GET", path).get("items", [])
+            base_query["labelSelector"] = _serialize_selector(label_selector)
+        if resource_version is not None:
+            base_query["resourceVersion"] = resource_version
+        items: list[dict] = []
+        cont: str | None = None
+        while True:
+            query = dict(base_query)
+            if self.list_page_size:
+                query["limit"] = str(self.list_page_size)
+            if cont:
+                query["continue"] = cont
+            path = self._path(kind, namespace, query=query or None)
+            # a 200 body without an ``items`` array is a WIRE failure
+            # (half-written/foreign body — an LB error page), never an
+            # empty fleet: _require_items raises MalformedListError
+            # (⊂ TRANSPORT_ERRORS) inside _json, so it gets the standard
+            # bounded-jitter retry AND counts toward the breaker's
+            # consecutive-failure threshold like any truncated response
+            body = self._json("GET", path, validate=_require_items)
+            items.extend(body["items"])
+            cont = (body.get("metadata") or {}).get("continue")
+            if not cont:
+                return items
 
     def create(self, obj: dict) -> dict:
         kind = k8s.kind(obj)
@@ -542,12 +620,22 @@ class HttpApiClient:
         # owner-mapped and label-filtered watches still route it
         seen: dict[tuple[str, str], dict] = {}
         failures = 0
+        in_gap = False
+
+        def on_resynced() -> None:
+            # stream live again AND the RV-diff delivered: consumers'
+            # caches are converged — end the degraded window
+            nonlocal in_gap
+            if in_gap:
+                in_gap = False
+                self._notify_watch_gap(kind, False)
+
         while not self._stopped.is_set():
             stream_started = time.monotonic()
             failed = True
             try:
                 self._watch_stream(kind, callback, namespace, label_selector,
-                                   connected, seen)
+                                   connected, seen, on_resynced)
                 failed = False  # server closed the stream cleanly
             except json.JSONDecodeError as err:
                 if self._stopped.is_set():
@@ -571,6 +659,12 @@ class HttpApiClient:
                 # is NOT an OSError and previously escaped this loop.
                 log.debug("watch %s dropped (%s: %s); reconnecting", kind,
                           type(err).__name__, err)
+            # a dropped stream (clean rotation or failure) leaves a gap —
+            # events until the next resync may be missed; flag it once per
+            # outage so index-served reads fall back live for the window
+            if not self._stopped.is_set() and not in_gap:
+                in_gap = True
+                self._notify_watch_gap(kind, True)
             # a stream that served for a while then dropped is the normal
             # reconnect cadence; only back-to-back connect/resync failures
             # escalate the delay (unreachable or persistently erroring
@@ -612,7 +706,12 @@ class HttpApiClient:
         outage would otherwise never surface and leave ghost objects in
         informer caches)."""
         current: dict[tuple[str, str], dict] = {}
-        for obj in self.list(kind, namespace, label_selector):
+        # rv=0: the informer list-then-watch form — any stored state is
+        # acceptable (the RV-diff below reconciles staleness); pages when
+        # list_page_size is set, so a post-outage resync of a big fleet
+        # never materializes one giant body
+        for obj in self._list(kind, namespace, label_selector,
+                              resource_version="0"):
             current[self._obj_key(obj)] = obj
         for key, obj in current.items():
             if key not in seen:
@@ -624,7 +723,8 @@ class HttpApiClient:
             self._deliver(callback, WatchEvent("DELETED", final_state), seen)
 
     def _watch_stream(self, kind: str, callback, namespace, label_selector,
-                      connected: threading.Event, seen: dict):
+                      connected: threading.Event, seen: dict,
+                      on_resynced=None):
         query = {"watch": "true",
                  "timeoutSeconds": str(WATCH_SERVER_TIMEOUT_S)}
         if label_selector:
@@ -643,6 +743,8 @@ class HttpApiClient:
                 # deliver twice (level-based consumers tolerate that); with
                 # unchanged RVs the diff delivers nothing.
                 self._resync(kind, callback, namespace, label_selector, seen)
+                if on_resynced is not None:
+                    on_resynced()
                 while not self._stopped.is_set():
                     try:
                         line = resp.readline()

@@ -23,7 +23,10 @@ the serialization point exactly as in Kubernetes.
 
 from __future__ import annotations
 
+import base64
+import bisect
 import itertools
+import json
 import threading
 import time
 from dataclasses import dataclass, field
@@ -65,6 +68,22 @@ class _Watch:
 _now_iso = k8s.now_iso
 
 
+def _encode_continue(namespace: str, name: str) -> str:
+    """Opaque continue token naming the last key a page served (the real
+    apiserver's token is likewise base64 JSON of a positional cursor)."""
+    raw = json.dumps([namespace, name]).encode()
+    return base64.urlsafe_b64encode(raw).decode().rstrip("=")
+
+
+def _decode_continue(token: str) -> tuple[str, str]:
+    pad = "=" * (-len(token) % 4)
+    try:
+        ns, nm = json.loads(base64.urlsafe_b64decode(token + pad))
+        return (str(ns), str(nm))
+    except (ValueError, TypeError):
+        raise InvalidError(f"malformed continue token {token!r}") from None
+
+
 class ClusterStore:
     """The in-process apiserver + etcd. All mutating verbs return a deep copy
     of the stored object (as the real apiserver returns the canonical form)."""
@@ -73,6 +92,14 @@ class ClusterStore:
         self._lock = threading.RLock()
         self._objects: dict[ObjectKey, dict] = {}
         self._rv_counter = itertools.count(1)
+        self._last_rv = 0  # latest issued rv — reported in LIST metadata
+        # one-entry sorted-key snapshot for paginated LISTs: a pager walks
+        # the same (kind, namespace) shape page after page, and re-sorting
+        # the whole kind under the lock per page would make one chunked
+        # LIST O(pages × N log N) of lock-held work. Keyed on _last_rv, so
+        # any write invalidates it (deletes don't bump rv — the pop loop
+        # below tolerates keys deleted since the snapshot).
+        self._page_snapshot: tuple | None = None  # (kind, ns, rv, pairs)
         self._uid_counter = itertools.count(1)
         self._watches: list[_Watch] = []
         # admission hooks: list of (kind, fn(operation, obj, old) -> obj|raise)
@@ -84,6 +111,13 @@ class ClusterStore:
         # Mutating/ValidatingWebhookConfiguration objects, indexed so writes
         # call out over real HTTPS AdmissionReview (cluster/remote_admission)
         self._webhook_configs: dict[str, dict[ObjectKey, dict]] = {}
+
+    def _next_rv(self) -> str:
+        """Issue the next resourceVersion (caller holds the lock) and
+        remember it — LIST metadata reports the latest issued rv, the
+        anchor for informer-style ``resourceVersion=0`` list-then-watch."""
+        self._last_rv = next(self._rv_counter)
+        return str(self._last_rv)
 
     # ------------------------------------------------------------------ keys
     def _key(self, kind: str, namespace: str, name: str) -> ObjectKey:
@@ -198,7 +232,7 @@ class ClusterStore:
             if key in self._objects:
                 raise AlreadyExistsError(f"{key.kind} {key.namespace}/{key.name}")
             md["uid"] = f"uid-{next(self._uid_counter)}"
-            md["resourceVersion"] = str(next(self._rv_counter))
+            md["resourceVersion"] = self._next_rv()
             md["generation"] = 1
             md.setdefault("creationTimestamp", _now_iso())
             self._objects[key] = obj
@@ -221,17 +255,81 @@ class ClusterStore:
 
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict[str, str] | None = None) -> list[dict]:
+        items, _, _ = self.list_page(kind, namespace, label_selector)
+        return items
+
+    def list_page(self, kind: str, namespace: str | None = None,
+                  label_selector: dict[str, str] | None = None,
+                  limit: int | None = None,
+                  continue_token: str | None = None,
+                  resource_version: str | None = None,
+                  ) -> tuple[list[dict], str | None, str]:
+        """LIST with apiserver chunking semantics (``limit``/``continue``,
+        apimachinery ListOptions). Returns ``(items, next_continue,
+        list_rv)``; ``next_continue`` is None on the final page.
+
+        Keys are served in deterministic ``(namespace, name)`` order and
+        the continue token names the last key a page walked, so on a
+        quiescent population the pages compose into exactly the
+        unpaginated set for every page size (the equivalence the tests
+        pin). Objects created/deleted between pages may be missed or seen
+        once, as with the real chunked LIST — level-triggered consumers
+        tolerate that, and the watch diff repairs it.
+
+        ``resource_version``: ``"0"`` is the informer cache-ack form —
+        "any stored state is acceptable, don't require quorum"; this store
+        IS the state of record, so it serves current state (the point of
+        accepting it is that clients can pipeline list-then-watch without
+        a special case). Exact/minimum-rv forms are likewise served from
+        current state — there are no historical snapshots here. ``list_rv``
+        is the latest issued resourceVersion, the anchor a watch would
+        start from."""
+        start_after = (_decode_continue(continue_token)
+                       if continue_token else None)
+        if limit is not None and limit <= 0:
+            limit = None  # limit=0 means "no limit", as on the wire
         with self._lock:
-            out = []
-            for key, obj in self._objects.items():
-                if key.kind != kind:
+            pairs = self._sorted_pairs_locked(kind, namespace,
+                                              snapshot=limit is not None)
+            start = (bisect.bisect_right(pairs, start_after)
+                     if start_after is not None else 0)
+            out: list[dict] = []
+            last_pair: tuple[str, str] | None = None
+            next_token: str | None = None
+            for pair in pairs[start:]:
+                # a key may have been deleted since the snapshot (deletes
+                # don't bump rv): skip — same "objects deleted between
+                # pages may be missed" contract as the real chunked LIST
+                obj = self._objects.get(ObjectKey(kind, pair[0], pair[1]))
+                if obj is None or not k8s.matches_labels(obj,
+                                                         label_selector):
                     continue
-                if namespace is not None and key.namespace != namespace:
-                    continue
-                if not k8s.matches_labels(obj, label_selector):
-                    continue
+                if limit is not None and len(out) >= limit:
+                    # page full with at least one candidate left: hand out
+                    # a cursor at the last key actually served
+                    next_token = _encode_continue(*last_pair)
+                    break
                 out.append(k8s.deepcopy(obj))
-            return out
+                last_pair = pair
+            return out, next_token, str(self._last_rv)
+
+    def _sorted_pairs_locked(self, kind: str, namespace: str | None,
+                             snapshot: bool) -> list[tuple[str, str]]:
+        """Sorted (namespace, name) pairs for a kind (caller holds the
+        lock). Paginated calls (``snapshot=True``) reuse the one-entry
+        snapshot while no write has bumped ``_last_rv``, so walking a big
+        fleet in pages sorts once, not once per page."""
+        token = (kind, namespace, self._last_rv)
+        if snapshot and self._page_snapshot is not None and \
+                self._page_snapshot[:3] == token:
+            return self._page_snapshot[3]
+        pairs = sorted(
+            (key.namespace, key.name) for key in self._objects
+            if key.kind == kind
+            and (namespace is None or key.namespace == namespace))
+        if snapshot:
+            self._page_snapshot = (*token, pairs)
+        return pairs
 
     def update(self, obj: dict) -> dict:
         obj = k8s.deepcopy(obj)
@@ -268,7 +366,7 @@ class ClusterStore:
             md["creationTimestamp"] = old["metadata"]["creationTimestamp"]
             if k8s.get_in(old, "metadata", "deletionTimestamp"):
                 md["deletionTimestamp"] = old["metadata"]["deletionTimestamp"]
-            md["resourceVersion"] = str(next(self._rv_counter))
+            md["resourceVersion"] = self._next_rv()
             if obj.get("spec") != old.get("spec"):
                 md["generation"] = old["metadata"].get("generation", 1) + 1
             else:
@@ -330,7 +428,7 @@ class ClusterStore:
                 raise ConflictError(f"{key.kind} {key.namespace}/{key.name}")
             stored = k8s.deepcopy(old)
             stored["status"] = k8s.deepcopy(obj.get("status", {}))
-            stored["metadata"]["resourceVersion"] = str(next(self._rv_counter))
+            stored["metadata"]["resourceVersion"] = self._next_rv()
             self._objects[key] = stored
             out = k8s.deepcopy(stored)
         self._notify(WatchEvent("MODIFIED", out))
@@ -357,7 +455,7 @@ class ClusterStore:
             if k8s.get_in(obj, "metadata", "finalizers"):
                 if not k8s.get_in(obj, "metadata", "deletionTimestamp"):
                     obj["metadata"]["deletionTimestamp"] = _now_iso()
-                    obj["metadata"]["resourceVersion"] = str(next(self._rv_counter))
+                    obj["metadata"]["resourceVersion"] = self._next_rv()
                     events.append(WatchEvent("MODIFIED", k8s.deepcopy(obj)))
             else:
                 events.extend(self._remove_and_gc(key))
@@ -392,7 +490,7 @@ class ClusterStore:
                 if k8s.get_in(dobj, "metadata", "finalizers"):
                     if not k8s.get_in(dobj, "metadata", "deletionTimestamp"):
                         dobj["metadata"]["deletionTimestamp"] = _now_iso()
-                        dobj["metadata"]["resourceVersion"] = str(next(self._rv_counter))
+                        dobj["metadata"]["resourceVersion"] = self._next_rv()
                         events.append(WatchEvent("MODIFIED", k8s.deepcopy(dobj)))
                 else:
                     events.extend(self._remove_and_gc(dk))

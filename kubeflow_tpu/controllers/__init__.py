@@ -64,6 +64,15 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
         read_client = CachingClient(
             client, auto_informer=False,
             disable_for=("Secret", "ConfigMap", "Event"))
+        # cache_index_lookups_total / cache_full_scans_total (the proof
+        # the reconcile hot path never walks the whole cache)
+        read_client.attach_metrics(metrics)
+        # transport stream health → cache degraded mode: while a watch
+        # stream for a kind is down, its index-served reads fall back to
+        # live LISTs until the reconnect resync converges the cache
+        if hasattr(transport_client, "set_watch_gap_listener"):
+            transport_client.set_watch_gap_listener(
+                read_client.mark_watch_gap, read_client.mark_watch_recovered)
         mgr = Manager(read_client, read_cache=read_client,
                       max_concurrent_reconciles=max_concurrent_reconciles)
     else:

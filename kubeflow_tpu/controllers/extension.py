@@ -21,7 +21,7 @@ import logging
 
 from ..api import types as api
 from ..cluster import errors, events
-from ..utils import k8s, names
+from ..utils import drift, k8s, names
 from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
 from . import auth, cacert, netpol, oauth, rbac, routes, runtime_images
@@ -33,6 +33,19 @@ FINALIZER_ROUTES = "kubeflow-tpu.org/route-cleanup"
 FINALIZER_REFGRANT = "kubeflow-tpu.org/referencegrant-cleanup"
 FINALIZER_CRB = "kubeflow-tpu.org/crb-cleanup"
 ALL_FINALIZERS = (FINALIZER_ROUTES, FINALIZER_REFGRANT, FINALIZER_CRB)
+
+
+def _copy_payload_fields(desired: dict, found: dict) -> bool:
+    """Copy*Fields contract for the auth resources: the controller owns
+    ``spec`` (Service) / ``data`` (the SAR ConfigMap); everything else —
+    clusterIP the server assigned, foreign labels — stays untouched."""
+    changed = False
+    for payload in ("spec", "data"):
+        if desired.get(payload) is not None and \
+                found.get(payload) != desired.get(payload):
+            found[payload] = k8s.deepcopy(desired[payload])
+            changed = True
+    return changed
 
 
 class ExtensionReconciler:
@@ -249,15 +262,14 @@ class ExtensionReconciler:
                 continue
             # repair drift on whichever payload the resource carries: spec
             # (Service) or data (the SAR ConfigMap — tampering with it would
-            # change what the auth proxy authorizes)
-            changed = False
-            for payload in ("spec", "data"):
-                if desired.get(payload) is not None and \
-                        existing.get(payload) != desired.get(payload):
-                    existing[payload] = k8s.deepcopy(desired[payload])
-                    changed = True
-            if changed:
-                self.client.update(existing)
+            # change what the auth proxy authorizes). Drift-aware minimal
+            # patch: no drift → no write; drift → only the changed paths,
+            # no resourceVersion to conflict on.
+            patch = drift.minimal_update_patch(desired, existing,
+                                               _copy_payload_fields)
+            if patch is not None:
+                self.client.patch(desired["kind"], ns, k8s.name(desired),
+                                  patch)
         crb = auth.new_auth_delegator_crb(notebook)
         if self.client.get_or_none("ClusterRoleBinding", "",
                                    k8s.name(crb)) is None:

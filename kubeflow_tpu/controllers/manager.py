@@ -80,6 +80,7 @@ from typing import Callable, Protocol
 
 from ..cluster.store import WatchEvent
 from ..utils import k8s
+from ..utils import metrics as metrics_mod
 
 log = logging.getLogger("kubeflow_tpu.manager")
 
@@ -199,6 +200,11 @@ class Manager:
         self._wq_retries = None
         self._wq_queue_duration = None
         self._wq_work_duration = None
+        # per-phase reconcile wall decomposition (label ``controller``):
+        # time spent in client reads vs writes, attributed by the
+        # EchoTrackingClient through the thread-local phase collector
+        self._read_seconds = None
+        self._write_seconds = None
 
     def attach_metrics(self, registry) -> None:
         self._reconcile_metric = registry.counter(
@@ -219,6 +225,16 @@ class Manager:
         self._wq_work_duration = registry.histogram(
             "workqueue_work_duration_seconds",
             "How long processing an item takes.")
+        self._read_seconds = registry.histogram(
+            "reconcile_read_seconds",
+            "Per-reconcile wall spent in client READS (get/list/"
+            "get_owned), by controller. Cached reads keep this in "
+            "microseconds; a regression to wire reads shows here first.")
+        self._write_seconds = registry.histogram(
+            "reconcile_write_seconds",
+            "Per-reconcile wall spent in client WRITES (create/update/"
+            "patch/delete), by controller. Drift-gated patches keep the "
+            "steady state at zero.")
         depth = registry.gauge(
             "workqueue_depth", "Current depth of the reconcile workqueue.")
         unfinished = registry.gauge(
@@ -543,12 +559,21 @@ class Manager:
                                                time.monotonic())
             self._cv.notify_all()
 
+    def _observe_phases(self, controller: str) -> None:
+        phases = metrics_mod.phase_collect_finish()
+        if self._read_seconds is not None:
+            self._read_seconds.observe(phases.get("read", 0.0),
+                                       {"controller": controller})
+            self._write_seconds.observe(phases.get("write", 0.0),
+                                        {"controller": controller})
+
     def _process(self, item: _QueueItem) -> None:
         rec = self._reconcilers.get(item.controller)
         if rec is None:
             return
         key = (item.controller, item.req)
         started = time.monotonic()
+        metrics_mod.phase_collect_start()
         try:
             result = rec.reconcile(item.req)
         except Exception as exc:  # noqa: BLE001 — error→requeue, never crash the loop
@@ -569,6 +594,7 @@ class Manager:
             if self._wq_work_duration is not None:
                 self._wq_work_duration.observe(time.monotonic() - started,
                                                {"name": item.controller})
+            self._observe_phases(item.controller)
             self.enqueue(item.controller, item.req, after=backoff)
             return
         with self._cv:
@@ -582,6 +608,7 @@ class Manager:
         if self._wq_work_duration is not None:
             self._wq_work_duration.observe(time.monotonic() - started,
                                            {"name": item.controller})
+        self._observe_phases(item.controller)
 
     def run_until_idle(self, timeout: float = 30.0,
                        include_delayed_under: float = 0.0) -> int:

@@ -27,8 +27,9 @@ import logging
 
 from ..api import types as api
 from ..cluster import errors, events
+from ..cluster.cache import owned_objects
 from ..tpu.topology import SliceSpec, parse_slice_request
-from ..utils import k8s, names
+from ..utils import drift, k8s, names
 from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
 from .manager import Manager, Request, Result, label_mapper, owner_mapper
@@ -59,12 +60,6 @@ class NotebookReconciler:
         self.config = config or ControllerConfig()
         self.metrics = metrics or MetricsRegistry()
         self.metrics.on_scrape(self._scrape_running)
-        # conflict fast-retries land in the standard workqueue retry counter
-        # (get-or-create: shares the series the manager registers)
-        self._wq_retries = self.metrics.counter(
-            "workqueue_retries_total",
-            "Total retries handled by the workqueue (error-backoff "
-            "requeues + reconciler conflict fast-retries).")
         self.recorder = events.EventRecorder(client, component=self.name)
         # watch-fed read cache for the Event predicate (built in setup();
         # reconcilers constructed without setup() fall back to live reads)
@@ -143,10 +138,13 @@ class NotebookReconciler:
     def _scrape_running(self) -> None:
         """notebook_running is computed at scrape time by listing STSs
         carrying the notebook-name label (reference pkg/metrics/
-        metrics.go:60-99 uses client.HasLabels) — the existence selector
-        runs server-side so a scrape is never an unbounded full-cluster
-        LIST over the wire."""
-        stss = self.client.list(
+        metrics.go:60-99 uses client.HasLabels). Served from the informer's
+        by-label index when the read cache is wired (setup): the periodic
+        scrape costs zero wire requests while the watch stream is healthy,
+        and the cache itself falls back to a live LIST across a watch gap
+        (CachingClient.mark_watch_gap)."""
+        reader = self._read_cache or self.client
+        stss = reader.list(
             "StatefulSet",
             label_selector={names.NOTEBOOK_NAME_LABEL: None})
         running = sum(1 for s in stss
@@ -424,38 +422,29 @@ class NotebookReconciler:
         return svc
 
     # --------------------------------------------------- create-or-update
-    def _update_with_conflict_retry(self, desired: dict, found: dict,
-                                    copy_fields) -> None:
-        """409 fast path: with concurrent workers, an update can race the
-        culler's annotation patches (or the other reconciler) and conflict.
-        Burning a full error-backoff requeue for that is wasteful — instead
-        re-read LIVE, re-diff against the SAME desired state, and retry
-        ONCE (controller-runtime reconcilers use RetryOnConflict the same
-        way). A still-conflicting retry is dropped: the foreign write that
-        keeps winning also re-enqueues this key through the watch, so the
-        next reconcile re-converges level-triggered. Retries are counted
-        in workqueue_retries_total."""
-        try:
-            self.client.update(found)
-            return
-        except errors.ConflictError:
-            pass
-        self._wq_retries.inc({"name": self.name})
-        from ..cluster.cache import live_reader
-        live = live_reader(self.client)
-        errors.update_with_conflict_retry(
-            self.client,
-            lambda: live.get_or_none(k8s.kind(found), k8s.namespace(found),
-                                     k8s.name(found)),
-            lambda fresh: copy_fields(desired, fresh), attempts=1)
+    def _apply_drift(self, desired: dict, found: dict, copy_fields) -> bool:
+        """Minimal-write path (utils/drift.py): run the Copy*Fields
+        contract against a scratch copy of the live object; NO drift means
+        NO request at all, and a real drift ships as a JSON merge patch of
+        only the drifted paths. Merge patches carry no resourceVersion
+        precondition, so a concurrent writer (the culler's annotation
+        patches, the other reconciler) can no longer 409 this write — the
+        old conflict-retry loop and its live re-GETs are gone from the
+        steady-state wire. Returns whether a write was issued."""
+        patch = drift.minimal_update_patch(desired, found, copy_fields)
+        if patch is None:
+            return False
+        self.client.patch(k8s.kind(found), k8s.namespace(found),
+                          k8s.name(found), patch)
+        return True
 
     def _find_owned_sts(self, notebook: dict) -> dict | None:
-        """Find the STS for a notebook, robust to GenerateName (lookup by
-        notebook-name label + owner uid rather than name)."""
-        for sts in self.client.list("StatefulSet", k8s.namespace(notebook),
-                                    {names.NOTEBOOK_NAME_LABEL: k8s.name(notebook)}):
-            if k8s.is_owned_by(sts, k8s.uid(notebook)):
-                return sts
+        """Find the STS for a notebook, robust to GenerateName: the
+        by-owner informer index when the client carries one (O(owned), no
+        scan), else a namespace LIST filtered by owner uid — ownership is
+        the one filter on both paths."""
+        for sts in owned_objects(self.client, "StatefulSet", notebook):
+            return sts
         return None
 
     def _reconcile_statefulset(self, notebook: dict,
@@ -478,19 +467,15 @@ class NotebookReconciler:
                 # the real pod names (before any pod has started)
                 fixed = self.generate_statefulset(
                     notebook, slice_spec, actual_sts_name=k8s.name(created))
-                if copy_statefulset_fields(fixed, created):
-                    self._update_with_conflict_retry(
-                        fixed, created, copy_statefulset_fields)
+                self._apply_drift(fixed, created, copy_statefulset_fields)
             return
-        if copy_statefulset_fields(desired, found):
-            self._update_with_conflict_retry(desired, found,
-                                             copy_statefulset_fields)
+        self._apply_drift(desired, found, copy_statefulset_fields)
 
     def _create_or_update(self, desired: dict, copy_fields) -> None:
         """Create-or-idempotent-update for a named desired object: swallow
-        the create race (another worker got there first; the watch re-enqueues)
-        and retry a conflicting update once before falling back to error
-        backoff."""
+        the create race (another worker got there first; the watch
+        re-enqueues); an existing object takes the drift-aware minimal-
+        patch path (zero requests in steady state)."""
         found = self.client.get_or_none(k8s.kind(desired),
                                         k8s.namespace(desired),
                                         k8s.name(desired))
@@ -500,8 +485,7 @@ class NotebookReconciler:
             except errors.AlreadyExistsError:
                 pass
             return
-        if copy_fields(desired, found):
-            self._update_with_conflict_retry(desired, found, copy_fields)
+        self._apply_drift(desired, found, copy_fields)
 
     def _reconcile_service(self, notebook: dict,
                            slice_spec: SliceSpec | None) -> None:

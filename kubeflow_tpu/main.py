@@ -114,7 +114,10 @@ def build_manager(store=None, config: ControllerConfig | None = None, *,
 
     if simulate_kubelet:
         from .cluster.kubelet import StatefulSetSimulator
-        StatefulSetSimulator(store).setup(mgr)
+        # reads through the manager's indexed informer cache when present:
+        # pod lookups hit the 'statefulset' by-label index instead of
+        # scanning the store's whole object map per reconcile
+        StatefulSetSimulator(mgr.read_cache or store).setup(mgr)
 
     return mgr, shutdown
 

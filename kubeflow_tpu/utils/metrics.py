@@ -106,6 +106,17 @@ class _Histogram:
             series = self._series.get(self._labels_key(labels))
         return series[-1] if series else 0.0
 
+    def total_sum(self) -> float:
+        """Sum of ``_sum`` over every label combination (e.g. all
+        controllers of reconcile_read_seconds)."""
+        with self._lock:
+            return sum(series[-1] for series in self._series.values())
+
+    def total_count(self) -> float:
+        """Sum of ``_count`` over every label combination."""
+        with self._lock:
+            return sum(series[-2] for series in self._series.values())
+
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.type}"]
@@ -123,6 +134,34 @@ class _Histogram:
             lines.append(f"{self.name}_sum{suffix} {series[-1]:g}")
             lines.append(f"{self.name}_count{suffix} {series[-2]:g}")
         return "\n".join(lines)
+
+
+# --------------------------------------------------------- phase collector
+# Per-reconcile read/write wall decomposition: the manager opens a
+# collection window on the worker thread (phase_collect_start), the
+# reconciler's client wrapper attributes each verb's duration to "read"
+# (get/list/get_owned) or "write" (create/update/patch/delete) via
+# phase_record, and the manager observes the totals into
+# reconcile_read_seconds / reconcile_write_seconds at the end. Thread-local,
+# so concurrent workers never mix phases; recording outside a window (watch
+# threads, scrape callbacks) is a no-op.
+_phase_tls = threading.local()
+
+
+def phase_collect_start() -> None:
+    _phase_tls.acc = {"read": 0.0, "write": 0.0}
+
+
+def phase_record(phase: str, seconds: float) -> None:
+    acc = getattr(_phase_tls, "acc", None)
+    if acc is not None:
+        acc[phase] = acc.get(phase, 0.0) + seconds
+
+
+def phase_collect_finish() -> dict[str, float]:
+    acc = getattr(_phase_tls, "acc", None) or {}
+    _phase_tls.acc = None
+    return acc
 
 
 class MetricsRegistry:

@@ -87,7 +87,9 @@ def run_inprocess(count: int, namespace: str, accelerator: str,
 
         store = ClusterStore()
         mgr = setup_controllers(store, max_concurrent_reconciles=workers)
-        StatefulSetSimulator(store, boot_delay_s=0.0).setup(mgr)
+        # indexed reads for the simulator too (shares the manager cache)
+        StatefulSetSimulator(mgr.read_cache or store,
+                             boot_delay_s=0.0).setup(mgr)
         mgr.start()
     created: dict[str, float] = {}
     ready: dict[str, float] = {}
@@ -135,7 +137,9 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
              max_requests_per_nb: float | None = None,
              workers: int = 4, apiserver_latency_ms: float = 0.0,
              fault_rate: float = 0.0, fault_plan: str | None = None,
-             fault_seed: int | None = 7) -> int:
+             fault_seed: int | None = 7,
+             list_page_size: int | None = None,
+             max_full_scans: int | None = None) -> int:
     """Controller wire-cost measurement: the full controller stack runs
     over a real HTTP apiserver while the load generator drives the store
     directly, so ``rest_client_requests_total`` counts ONLY controller
@@ -150,7 +154,12 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
     per-request rate; ``fault_plan`` loads a custom plan YAML instead.
     With faults on, the run keeps an audit tap and fails on any duplicate
     side-effect write (a retried create applying twice) in addition to
-    the convergence bound — the chaos soak contract."""
+    the convergence bound — the chaos soak contract.
+
+    ``list_page_size`` pages every controller LIST through
+    ``limit``/``continue`` chunks of that size (exercises pagination on
+    the wire); ``max_full_scans`` bounds ``cache_full_scans_total`` — 0
+    asserts the reconcile hot path never walks a whole cache kind."""
     import tempfile
 
     from kubeflow_tpu.api import types as api
@@ -180,8 +189,16 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
     api.install_notebook_crd(store)
     cleanups = []
     try:
-        sim_mgr = Manager(store)
-        StatefulSetSimulator(store, boot_delay_s=0.0).setup(sim_mgr)
+        # the simulator reads through its own indexed informer cache (the
+        # real STS controller's shape): pod lookups hit the 'statefulset'
+        # by-label index instead of scanning the store's whole object map
+        # per reconcile — at 2000 notebooks that scan is ~10k objects twice
+        # per reconcile and dominates the cluster-side wall
+        from kubeflow_tpu.cluster.cache import CachingClient
+        sim_cache = CachingClient(store, auto_informer=False,
+                                  disable_for=())
+        sim_mgr = Manager(sim_cache, read_cache=sim_cache)
+        StatefulSetSimulator(sim_cache, boot_delay_s=0.0).setup(sim_mgr)
         sim_mgr.start()
         cleanups.append(sim_mgr.stop)
         proxy = ApiServerProxy(store,
@@ -189,7 +206,7 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
                                fault_plan=plan, audit_log=audit_path)
         proxy.start()
         cleanups.append(proxy.stop)
-        client = HttpApiClient(proxy.url)
+        client = HttpApiClient(proxy.url, list_page_size=list_page_size)
         cleanups.append(client.close)
         metrics = MetricsRegistry()
         mgr = setup_controllers(client, metrics=metrics,
@@ -248,14 +265,28 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
             injected = plan.injected()
             faults_note = (f"  injected faults: {plan.injected_total()} "
                            f"({dict(sorted(injected.items()))})")
+        full_scans = metrics.counter("cache_full_scans_total", "").total()
+        index_lookups = metrics.counter("cache_index_lookups_total",
+                                        "").total()
+        read_s = metrics.histogram("reconcile_read_seconds", "")
+        write_s = metrics.histogram("reconcile_write_seconds", "")
         print(f"notebooks: {count}  workers: {workers}  wall: {wall:.2f}s  "
               f"controller apiserver requests/notebook: {per_nb:.1f}"
               f"{faults_note}")
+        print(f"cache: {index_lookups:.0f} index lookups, "
+              f"{full_scans:.0f} full scans  "
+              f"phase wall: read {read_s.total_sum():.2f}s / "
+              f"write {write_s.total_sum():.2f}s over "
+              f"{read_s.total_count():.0f} reconciles")
         _print_latencies(sorted(ready_at[n] - created_at[n]
                                 for n in ready_at))
         if max_requests_per_nb is not None and per_nb > max_requests_per_nb:
             print(f"FAIL: {per_nb:.1f} requests/notebook exceeds bound "
                   f"{max_requests_per_nb}")
+            return 1
+        if max_full_scans is not None and full_scans > max_full_scans:
+            print(f"FAIL: {full_scans:.0f} cache full scans exceed bound "
+                  f"{max_full_scans} (an unindexed hot-path LIST crept in)")
             return 1
         if audit_path is not None:
             duplicates = audit_duplicate_creates(audit_path)
@@ -314,6 +345,15 @@ def main() -> int:
                          "instead of the uniform mix")
     ap.add_argument("--fault-seed", type=int, default=7,
                     help="seed for the injected-fault RNG (replayable runs)")
+    ap.add_argument("--list-page-size", type=int, default=None,
+                    help="with --wire: page every controller LIST through "
+                         "limit/continue chunks of this size (exercises "
+                         "apiserver pagination on the wire; bounds resync "
+                         "memory on big fleets)")
+    ap.add_argument("--max-full-scans", type=int, default=None,
+                    help="with --wire: fail if cache_full_scans_total "
+                         "exceeds this (0 = assert the reconcile hot path "
+                         "never walks a whole cache kind)")
     args = ap.parse_args()
     if args.emit_yaml:
         try:
@@ -331,7 +371,9 @@ def main() -> int:
                         apiserver_latency_ms=args.apiserver_latency_ms,
                         fault_rate=args.fault_rate,
                         fault_plan=args.fault_plan,
-                        fault_seed=args.fault_seed)
+                        fault_seed=args.fault_seed,
+                        list_page_size=args.list_page_size,
+                        max_full_scans=args.max_full_scans)
     return run_inprocess(args.count, args.namespace, args.accelerator,
                          args.timeout, server=args.server,
                          workers=args.workers)

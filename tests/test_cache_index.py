@@ -1,0 +1,362 @@
+"""Indexed informer-cache spec (cluster/cache.py) + LIST pagination.
+
+Pins the tentpole contracts:
+
+- **equivalence** — indexed ``list``/``get_owned`` return exactly what the
+  old full-scan path returned, on a randomized object population and for
+  every query shape (namespace, indexed/unindexed label equality and
+  existence terms, owner lookups);
+- **consistency** — indexes stay coherent under interleaved ingest /
+  delete / tombstoned-snapshot traffic (the feed patterns a real watch
+  stream produces);
+- **accounting** — index-served reads count in ``cache_index_lookups_total``
+  and only the unindexable shape counts in ``cache_full_scans_total``;
+- **degraded mode** — a watch gap flips reads live until recovery;
+- **pagination** — ``limit``/``continue`` pages compose into exactly the
+  unpaginated item set for EVERY page size, in-process and over the wire,
+  and a LIST body without ``items`` raises a retryable transport error.
+"""
+
+import random
+
+import pytest
+
+from kubeflow_tpu.cluster.cache import CachingClient
+from kubeflow_tpu.cluster.store import ClusterStore, WatchEvent
+from kubeflow_tpu.utils import k8s
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+KINDS = ("StatefulSet", "Pod", "Service")
+NAMESPACES = ("ns-a", "ns-b", "ns-c")
+OWNERS = ("uid-owner-1", "uid-owner-2", "uid-owner-3")
+# first two are indexed by default; the third never is
+LABELS = ("notebook-name", "statefulset", "team")
+VALUES = ("v0", "v1", "v2")
+
+
+def _rand_obj(rng: random.Random, i: int, namespace=None) -> dict:
+    labels = {key: rng.choice(VALUES)
+              for key in LABELS if rng.random() < 0.5}
+    obj = {
+        "apiVersion": "v1", "kind": rng.choice(KINDS),
+        "metadata": {
+            "name": f"obj-{i}",
+            "namespace": namespace or rng.choice(NAMESPACES),
+            "labels": labels,
+        },
+        "spec": {"n": i},
+    }
+    if rng.random() < 0.6:
+        obj["metadata"]["ownerReferences"] = [{
+            "kind": "Notebook", "name": "own", "controller": True,
+            "uid": rng.choice(OWNERS)}]
+    return obj
+
+
+def _queries(rng: random.Random):
+    """Every selector shape the controllers use, plus adversarial mixes."""
+    shapes = [
+        (rng.choice(NAMESPACES), None),
+        (None, None),
+        (None, {"notebook-name": rng.choice(VALUES)}),     # indexed eq
+        (None, {"notebook-name": None}),                   # indexed existence
+        (rng.choice(NAMESPACES), {"statefulset": rng.choice(VALUES)}),
+        (None, {"team": rng.choice(VALUES)}),              # unindexed eq
+        (rng.choice(NAMESPACES), {"team": None}),          # unindexed exists
+        (None, {"notebook-name": rng.choice(VALUES),       # mixed
+                "team": rng.choice(VALUES)}),
+    ]
+    return shapes
+
+
+def _naive(store: ClusterStore, kind, namespace, selector):
+    """The old full-scan semantics, straight off the source of truth."""
+    return sorted(
+        k8s.name(o) for o in store.list(kind)
+        if (namespace is None or k8s.namespace(o) == namespace)
+        and k8s.matches_labels(o, selector))
+
+
+# ------------------------------------------------------------- equivalence
+def test_indexed_list_equals_scan_on_randomized_population():
+    for seed in (3, 5, 8):
+        rng = random.Random(seed)
+        store = ClusterStore()
+        client = CachingClient(store, disable_for=())
+        for i in range(120):
+            store.create(_rand_obj(rng, i))
+        for kind in KINDS:
+            for namespace, selector in _queries(rng):
+                got = sorted(k8s.name(o) for o in
+                             client.list(kind, namespace, selector))
+                assert got == _naive(store, kind, namespace, selector), \
+                    (kind, namespace, selector)
+
+
+def test_get_owned_equals_ownership_scan():
+    rng = random.Random(21)
+    store = ClusterStore()
+    client = CachingClient(store, disable_for=())
+    for i in range(80):
+        store.create(_rand_obj(rng, i))
+    for kind in KINDS:
+        for uid in OWNERS:
+            owner = {"kind": "Notebook",
+                     "metadata": {"name": "own", "uid": uid}}
+            got = sorted(k8s.name(o) for o in client.get_owned(kind, owner))
+            want = sorted(k8s.name(o) for o in store.list(kind)
+                          if k8s.is_owned_by(o, uid))
+            assert got == want
+
+
+# ------------------------------------------------------------- consistency
+def _integrity(client: CachingClient) -> None:
+    """Every index entry points at a live object AND every object appears
+    in exactly the indexes its fields imply."""
+    for kind, ks in client._kinds.items():
+        for ns, keys in ks.by_namespace.items():
+            assert keys, f"empty {kind} namespace bucket leaked"
+            for key in keys:
+                assert key in ks.objects and key[0] == ns
+        for uid, keys in ks.by_owner.items():
+            assert keys
+            for key in keys:
+                assert uid in [r.get("uid") for r in
+                               ks.objects[key]["metadata"].get(
+                                   "ownerReferences", [])]
+        for lk, buckets in ks.by_label.items():
+            for val, keys in buckets.items():
+                assert keys, f"empty {kind} label bucket {lk}={val} leaked"
+                for key in keys:
+                    assert ks.objects[key]["metadata"]["labels"][lk] == val
+        for key, obj in ks.objects.items():
+            assert key in ks.by_namespace[key[0]]
+            for lk in ks.label_keys:
+                val = (obj["metadata"].get("labels") or {}).get(lk)
+                if val is not None:
+                    assert key in ks.by_label[lk][val]
+
+
+def test_index_consistency_under_interleaved_ingest_delete_tombstone():
+    """Random interleavings of the watch-feed traffic shapes: ADDED /
+    MODIFIED (label and owner churn reindex), DELETED (tombstones), stale
+    snapshot re-ingest (must bounce off the tombstone and the rv guard),
+    and write-through ingest. After every burst the cache answers every
+    query exactly like a scan of the store, and the indexes are coherent."""
+    for seed in (2, 9):
+        rng = random.Random(seed)
+        store = ClusterStore()
+        client = CachingClient(store, auto_informer=False, disable_for=())
+        for kind in KINDS:
+            client.backfill(kind)
+        live: dict[str, dict] = {}
+        for step in range(300):
+            roll = rng.random()
+            if roll < 0.45 or not live:
+                obj = store.create(_rand_obj(rng, step))
+                live[k8s.name(obj)] = obj
+                client.feed(WatchEvent("ADDED", obj))
+            elif roll < 0.7:
+                name = rng.choice(list(live))
+                obj = k8s.deepcopy(live[name])
+                # churn the indexed fields: relabel + re-own
+                obj["metadata"]["labels"] = {
+                    key: rng.choice(VALUES)
+                    for key in LABELS if rng.random() < 0.5}
+                obj["metadata"]["ownerReferences"] = [{
+                    "kind": "Notebook", "name": "own", "controller": True,
+                    "uid": rng.choice(OWNERS)}] if rng.random() < 0.7 else []
+                obj = store.update(obj)
+                live[name] = obj
+                if rng.random() < 0.8:
+                    client.feed(WatchEvent("MODIFIED", obj))
+                else:
+                    client._ingest_write(obj)  # write-through path
+            elif roll < 0.85:
+                name = rng.choice(list(live))
+                obj = live.pop(name)
+                store.delete(obj["kind"], k8s.namespace(obj), name)
+                client.feed(WatchEvent("DELETED", obj))
+                if rng.random() < 0.5:
+                    # stale snapshot racing the delete: the tombstone must
+                    # keep it out of the cache AND out of every index
+                    client._ingest(k8s.deepcopy(obj))
+            else:
+                # stale re-feed of an older rv (a second stream's replay)
+                name = rng.choice(list(live))
+                stale = k8s.deepcopy(live[name])
+                stale["metadata"]["resourceVersion"] = "1"
+                stale["metadata"]["labels"] = {"team": "stale"}
+                client.feed(WatchEvent("MODIFIED", stale))
+            if step % 50 == 49:
+                _integrity(client)
+                for kind in KINDS:
+                    for namespace, selector in _queries(rng):
+                        got = sorted(k8s.name(o) for o in
+                                     client.list(kind, namespace, selector))
+                        assert got == _naive(store, kind, namespace,
+                                             selector)
+        _integrity(client)
+
+
+# --------------------------------------------------------------- accounting
+def test_scan_vs_index_accounting():
+    store = ClusterStore()
+    client = CachingClient(store, disable_for=())
+    metrics = MetricsRegistry()
+    client.attach_metrics(metrics)
+    store.create(_rand_obj(random.Random(1), 0, namespace="ns-a"))
+    scans = metrics.counter("cache_full_scans_total", "")
+    lookups = metrics.counter("cache_index_lookups_total", "")
+    client.list("Pod", "ns-a")                      # by-namespace
+    client.list("Pod", None, {"notebook-name": "v0"})   # by-label
+    client.list("Pod", None, {"notebook-name": None})   # by-label existence
+    client.list("Pod")                              # all (O(result))
+    client.get_owned("Pod", {"metadata": {"uid": "uid-owner-1"}})
+    assert scans.total() == 0
+    assert lookups.get({"kind": "Pod", "index": "by-namespace"}) == 1
+    assert lookups.get({"kind": "Pod", "index": "by-label"}) == 2
+    assert lookups.get({"kind": "Pod", "index": "all"}) == 1
+    assert lookups.get({"kind": "Pod", "index": "by-owner"}) == 1
+    # the ONE unindexable shape: no namespace, no indexed label key
+    client.list("Pod", None, {"team": "v0"})
+    assert scans.total() == 1
+
+
+# ------------------------------------------------------------ degraded mode
+def test_watch_gap_serves_live_until_recovered():
+    store = ClusterStore()
+    client = CachingClient(store, auto_informer=False, disable_for=())
+    created = store.create({"kind": "Pod", "apiVersion": "v1",
+                            "metadata": {"name": "p", "namespace": "ns"}})
+    client.backfill("Pod")
+    # the stream "drops": a foreign delete happens that the cache never
+    # hears about
+    store.delete("Pod", "ns", "p")
+    assert [k8s.name(o) for o in client.list("Pod", "ns")] == ["p"]  # stale
+    client.mark_watch_gap("Pod")
+    assert client.list("Pod", "ns") == []            # live during the gap
+    assert client.get_or_none("Pod", "ns", "p") is None
+    assert client.get_owned("Pod", {"metadata": {"uid": "x",
+                                                 "namespace": "ns"}}) == []
+    # reconnect resync delivers the missed DELETED, then recovery flips
+    # reads back to the index — now converged
+    client.feed(WatchEvent("DELETED", created))
+    client.mark_watch_recovered("Pod")
+    assert client.list("Pod", "ns") == []
+    # overlapping gaps: reads stay live until the LAST stream recovers
+    client.mark_watch_gap("Pod")
+    client.mark_watch_gap("Pod")
+    client.mark_watch_recovered("Pod")
+    assert client._is_gapped("Pod")
+    client.mark_watch_recovered("Pod")
+    assert not client._is_gapped("Pod")
+
+
+# --------------------------------------------------------------- pagination
+def test_store_pagination_equals_unpaginated_for_every_page_size():
+    rng = random.Random(31)
+    store = ClusterStore()
+    for i in range(17):
+        store.create(_rand_obj(rng, i))
+    for kind in KINDS:
+        for selector in (None, {"notebook-name": None}, {"team": "v1"}):
+            want = sorted(k8s.name(o) for o in store.list(kind, None,
+                                                          selector))
+            for page_size in range(1, 20):
+                items: list = []
+                cont = None
+                pages = 0
+                while True:
+                    page, cont, rv = store.list_page(
+                        kind, None, selector, limit=page_size,
+                        continue_token=cont)
+                    items.extend(page)
+                    pages += 1
+                    assert len(page) <= page_size
+                    assert rv == str(store._last_rv)
+                    if cont is None:
+                        break
+                assert sorted(k8s.name(o) for o in items) == want, \
+                    (kind, selector, page_size)
+                assert pages >= max(1, len(want) // page_size)
+
+
+def test_malformed_continue_token_rejected():
+    from kubeflow_tpu.cluster.errors import InvalidError
+    store = ClusterStore()
+    with pytest.raises(InvalidError):
+        store.list_page("Pod", continue_token="!!not-base64!!")
+
+
+def test_wire_pagination_same_item_set_and_rv0():
+    from kubeflow_tpu.cluster.apiserver import ApiServerProxy
+    from kubeflow_tpu.cluster.http_client import HttpApiClient
+    store = ClusterStore()
+    for i in range(10):
+        store.create({"kind": "ConfigMap", "apiVersion": "v1",
+                      "metadata": {"name": f"cm-{i}", "namespace": "ns",
+                                   "labels": {"app": "x"}
+                                   if i % 2 else {}}})
+    proxy = ApiServerProxy(store)
+    proxy.start()
+    try:
+        paged = HttpApiClient(proxy.url, list_page_size=3)
+        unpaged = HttpApiClient(proxy.url)
+        try:
+            assert sorted(k8s.name(o) for o in paged.list("ConfigMap")) == \
+                sorted(k8s.name(o) for o in unpaged.list("ConfigMap"))
+            assert sorted(
+                k8s.name(o) for o in
+                paged.list("ConfigMap", "ns", {"app": "x"})) == sorted(
+                k8s.name(o) for o in
+                unpaged.list("ConfigMap", "ns", {"app": "x"}))
+            # rv=0 cache-ack form (the resync list) pages identically
+            assert len(paged._list("ConfigMap", None, None,
+                                   resource_version="0")) == 10
+        finally:
+            paged.close()
+            unpaged.close()
+    finally:
+        proxy.stop()
+
+
+def test_list_body_without_items_is_a_transport_error():
+    """Satellite: a parseable LIST body with no ``items`` key must raise a
+    retryable TRANSPORT error, never read as an empty fleet — during a
+    resync an empty read would synthesize DELETED for every live object."""
+    from kubeflow_tpu.cluster.http_client import (TRANSPORT_ERRORS,
+                                                  HttpApiClient,
+                                                  MalformedListError,
+                                                  RetryPolicy)
+    client = HttpApiClient("http://127.0.0.1:1",
+                           retry_policy=RetryPolicy(max_attempts=2,
+                                                    backoff_base_s=0.001,
+                                                    backoff_cap_s=0.002))
+    calls = []
+
+    class _FakeResp:  # a clean 200 whose body is an LB error page
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+        @staticmethod
+        def read():
+            return b'{"kind": "Status", "code": 200}'
+
+    health = []
+    client._request = lambda *a, **kw: (calls.append(a), _FakeResp())[1]
+    client.set_health_tracker(type("T", (), {
+        "record_success": staticmethod(lambda: health.append(True)),
+        "record_failure": staticmethod(lambda: health.append(False))})())
+    with pytest.raises(MalformedListError):
+        client.list("ConfigMap", "ns")
+    assert len(calls) == 2  # rode _json's bounded transport retry
+    assert health == [False, False]  # counts toward the breaker
+    assert issubclass(MalformedListError, TRANSPORT_ERRORS)
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

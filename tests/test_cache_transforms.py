@@ -193,3 +193,68 @@ class TestWriteThroughIngest:
         client.create({"kind": "ConfigMap", "apiVersion": "v1",
                        "metadata": {"name": "cm", "namespace": "ns"}})
         assert client.get_or_none("ConfigMap", "ns", "cm") is not None
+
+
+class TestIndexedIngest:
+    """The per-kind indexers stay coherent through the same ingest/delete/
+    tombstone traffic the transforms tests exercise (the deep randomized
+    interleavings live in test_cache_index.py)."""
+
+    @staticmethod
+    def _pod(name, labels=None, owner_uid=None, rv="1"):
+        obj = {"kind": "Pod", "apiVersion": "v1",
+               "metadata": {"name": name, "namespace": "ns",
+                            "resourceVersion": rv,
+                            "labels": dict(labels or {})}}
+        if owner_uid:
+            obj["metadata"]["ownerReferences"] = [
+                {"kind": "Notebook", "name": "own", "controller": True,
+                 "uid": owner_uid}]
+        return obj
+
+    def test_relabel_moves_between_index_buckets(self):
+        from kubeflow_tpu.cluster.store import WatchEvent
+        store = ClusterStore()
+        client = CachingClient(store, auto_informer=False, disable_for=())
+        client.backfill("Pod")
+        client.feed(WatchEvent("ADDED", self._pod(
+            "p", labels={"notebook-name": "a"}, owner_uid="u1", rv="1")))
+        assert [o["metadata"]["name"] for o in
+                client.list("Pod", None, {"notebook-name": "a"})] == ["p"]
+        client.feed(WatchEvent("MODIFIED", self._pod(
+            "p", labels={"notebook-name": "b"}, owner_uid="u2", rv="2")))
+        assert client.list("Pod", None, {"notebook-name": "a"}) == []
+        assert [o["metadata"]["name"] for o in
+                client.list("Pod", None, {"notebook-name": "b"})] == ["p"]
+        assert client.get_owned("Pod", {"metadata": {"uid": "u1"}}) == []
+        assert [o["metadata"]["name"] for o in
+                client.get_owned("Pod", {"metadata": {"uid": "u2"}})] == \
+            ["p"]
+
+    def test_tombstoned_snapshot_never_reaches_an_index(self):
+        from kubeflow_tpu.cluster.store import WatchEvent
+        store = ClusterStore()
+        client = CachingClient(store, auto_informer=False, disable_for=())
+        client.backfill("Pod")
+        pod = self._pod("p", labels={"notebook-name": "a"}, owner_uid="u1")
+        client.feed(WatchEvent("ADDED", pod))
+        client.feed(WatchEvent("DELETED", pod))
+        client._ingest(pod)  # stale snapshot racing the delete
+        assert client.list("Pod", "ns") == []
+        assert client.list("Pod", None, {"notebook-name": "a"}) == []
+        assert client.get_owned("Pod", {"metadata": {"uid": "u1"}}) == []
+
+    def test_stale_rv_refeed_does_not_reindex(self):
+        from kubeflow_tpu.cluster.store import WatchEvent
+        store = ClusterStore()
+        client = CachingClient(store, auto_informer=False, disable_for=())
+        client.backfill("Pod")
+        client.feed(WatchEvent("ADDED", self._pod(
+            "p", labels={"notebook-name": "new"}, rv="5")))
+        # a second stream replays an OLDER frame: the rv guard must keep
+        # both the object and its index buckets on the newer state
+        client.feed(WatchEvent("MODIFIED", self._pod(
+            "p", labels={"notebook-name": "old"}, rv="3")))
+        assert [o["metadata"]["name"] for o in
+                client.list("Pod", None, {"notebook-name": "new"})] == ["p"]
+        assert client.list("Pod", None, {"notebook-name": "old"}) == []

@@ -1,0 +1,235 @@
+"""Drift detector spec (utils/drift.py) — property-style.
+
+The write-path contract the minimal-patch reconcile leans on:
+
+- ``diff_merge_patch(before, after)`` produces the MINIMAL RFC 7386 merge
+  patch: applying it to ``before`` reproduces ``after`` exactly, and every
+  path it carries actually differs (no unchanged subtree ships);
+- ``minimal_update_patch`` over the Copy*Fields helpers is a no-op on
+  server-defaulted objects with no semantic drift (uid/resourceVersion/
+  creationTimestamp/status, absent-vs-empty metadata maps), and otherwise
+  repairs exactly the drifted paths.
+"""
+
+import random
+
+import pytest
+
+from kubeflow_tpu.controllers.notebook import (copy_service_fields,
+                                               copy_statefulset_fields)
+from kubeflow_tpu.utils import drift, k8s
+
+# ---------------------------------------------------------------- generators
+
+_KEYS = ["alpha", "beta", "gamma", "delta", "eps", "zeta"]
+
+
+def _rand_scalar(rng: random.Random):
+    return rng.choice([
+        rng.randint(0, 99), f"s{rng.randint(0, 9)}", True, False,
+        [rng.randint(0, 9) for _ in range(rng.randint(0, 3))],
+    ])
+
+
+def _rand_tree(rng: random.Random, depth: int = 0) -> dict:
+    out = {}
+    for key in rng.sample(_KEYS, rng.randint(1, len(_KEYS))):
+        if depth < 3 and rng.random() < 0.4:
+            out[key] = _rand_tree(rng, depth + 1)
+        else:
+            out[key] = _rand_scalar(rng)
+    return out
+
+
+def _mutate(rng: random.Random, obj: dict, depth: int = 0) -> dict:
+    """A randomly edited deepcopy: add/delete/replace keys, recurse into
+    dicts — sometimes returning the object unchanged (the no-drift case)."""
+    out = k8s.deepcopy(obj)
+    for key in list(out):
+        roll = rng.random()
+        if roll < 0.15:
+            del out[key]
+        elif roll < 0.3:
+            out[key] = _rand_scalar(rng)
+        elif isinstance(out[key], dict) and depth < 3 and roll < 0.6:
+            out[key] = _mutate(rng, out[key], depth + 1)
+    if rng.random() < 0.3:
+        out[f"new{rng.randint(0, 4)}"] = _rand_scalar(rng)
+    return out
+
+
+def _assert_minimal(patch, before, after):
+    """Every path the patch carries must be a REAL difference."""
+    assert isinstance(patch, dict)
+    for key, val in patch.items():
+        if val is None:
+            assert key in before and key not in after
+        elif isinstance(val, dict) and isinstance(before.get(key), dict):
+            _assert_minimal(val, before[key], after[key])
+        else:
+            assert key not in before or before[key] != after.get(key)
+
+
+# ------------------------------------------------------------------- diffing
+class TestDiffMergePatch:
+    def test_equal_objects_produce_no_patch(self):
+        rng = random.Random(7)
+        for _ in range(50):
+            obj = _rand_tree(rng)
+            assert drift.diff_merge_patch(obj, k8s.deepcopy(obj)) is None
+
+    def test_apply_reproduces_after_exactly(self):
+        """THE patch property: json_merge_patch(before, patch) == after,
+        for randomized before/after pairs."""
+        rng = random.Random(11)
+        for _ in range(200):
+            before = _rand_tree(rng)
+            after = _mutate(rng, before)
+            patch = drift.diff_merge_patch(before, after)
+            if patch is None:
+                assert before == after
+            else:
+                assert k8s.json_merge_patch(before, patch) == after
+
+    def test_patch_is_minimal(self):
+        """No unchanged path ever ships."""
+        rng = random.Random(13)
+        for _ in range(200):
+            before = _rand_tree(rng)
+            after = _mutate(rng, before)
+            patch = drift.diff_merge_patch(before, after)
+            if patch is not None:
+                _assert_minimal(patch, before, after)
+
+    def test_deleted_key_patches_to_null(self):
+        patch = drift.diff_merge_patch({"a": 1, "b": 2}, {"a": 1})
+        assert patch == {"b": None}
+
+    def test_lists_replace_wholesale(self):
+        patch = drift.diff_merge_patch({"ports": [{"port": 80}, {"port": 1}]},
+                                       {"ports": [{"port": 80}]})
+        assert patch == {"ports": [{"port": 80}]}  # RFC 7386: no splicing
+
+    def test_inputs_never_aliased_into_patch(self):
+        after = {"spec": {"items": [1, 2]}}
+        patch = drift.diff_merge_patch({}, after)
+        patch["spec"]["items"].append(3)
+        assert after["spec"]["items"] == [1, 2]
+
+
+# -------------------------------------------------- Copy*Fields drift repair
+def _sts(image="img:a", replicas=2, labels=None, annotations=None,
+         server_side=False):
+    sts = {
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": {"name": "nb", "namespace": "ns",
+                     "labels": dict(labels or {"statefulset": "nb"})},
+        "spec": {
+            "replicas": replicas,
+            "selector": {"matchLabels": {"statefulset": "nb"}},
+            "serviceName": "nb",
+            "template": {
+                "metadata": {"labels": dict(labels or
+                                            {"statefulset": "nb"})},
+                "spec": {"containers": [{"name": "nb", "image": image}]},
+            },
+        },
+    }
+    if annotations is not None:
+        sts["metadata"]["annotations"] = dict(annotations)
+    if server_side:
+        # what the apiserver adds on persist — never part of desired state
+        sts["metadata"].update({
+            "uid": "uid-9", "resourceVersion": "42", "generation": 3,
+            "creationTimestamp": "2026-01-01T00:00:00Z",
+        })
+        sts["status"] = {"replicas": replicas, "readyReplicas": replicas}
+    return sts
+
+
+class TestMinimalUpdatePatch:
+    def test_server_defaulted_object_is_a_noop(self):
+        """The no-op detection the steady state depends on: a stored object
+        carrying server-populated fields (uid/rv/generation/timestamps/
+        status) and an ABSENT annotations map has no semantic drift from
+        the freshly-rendered desired object — no patch, no write."""
+        desired = _sts(annotations={})
+        found = _sts(server_side=True)  # no annotations key at all
+        assert drift.minimal_update_patch(
+            desired, found, copy_statefulset_fields) is None
+
+    def test_found_is_not_mutated(self):
+        desired = _sts(image="img:b")
+        found = _sts(server_side=True)
+        snapshot = k8s.deepcopy(found)
+        drift.minimal_update_patch(desired, found, copy_statefulset_fields)
+        assert found == snapshot
+
+    def test_patch_carries_only_drifted_paths_and_converges(self):
+        desired = _sts(image="img:b")
+        found = _sts(server_side=True)
+        patch = drift.minimal_update_patch(desired, found,
+                                           copy_statefulset_fields)
+        assert set(patch) == {"spec"}            # metadata untouched
+        assert set(patch["spec"]) == {"template"}  # replicas untouched
+        patched = k8s.json_merge_patch(found, patch)
+        # patch applied to found reproduces the desired state exactly on
+        # the owned fields — and a second pass detects zero drift
+        assert k8s.get_in(patched, "spec", "template", "spec",
+                          "containers")[0]["image"] == "img:b"
+        assert drift.minimal_update_patch(
+            desired, patched, copy_statefulset_fields) is None
+
+    def test_server_owned_fields_never_enter_the_patch(self):
+        rng = random.Random(17)
+        for _ in range(50):
+            desired = _sts(image=f"img:{rng.randint(0, 3)}",
+                           replicas=rng.randint(0, 4),
+                           labels={"statefulset": "nb",
+                                   f"l{rng.randint(0, 2)}": "v"})
+            found = _sts(server_side=True)
+            patch = drift.minimal_update_patch(desired, found,
+                                               copy_statefulset_fields)
+            if patch is None:
+                continue
+            flat = str(patch)
+            for field in ("resourceVersion", "uid", "creationTimestamp",
+                          "managedFields", "status"):
+                assert field not in flat
+            # applying converges: no residual drift
+            patched = k8s.json_merge_patch(found, patch)
+            assert drift.minimal_update_patch(
+                desired, patched, copy_statefulset_fields) is None
+
+    def test_service_clusterip_survives_drift_repair(self):
+        """copy_service_fields never touches clusterIP (util.go:182) — the
+        minimal patch must not either."""
+        desired = {
+            "apiVersion": "v1", "kind": "Service",
+            "metadata": {"name": "nb", "namespace": "ns"},
+            "spec": {"selector": {"statefulset": "nb"},
+                     "ports": [{"name": "http", "port": 80}]},
+        }
+        found = k8s.deepcopy(desired)
+        found["spec"]["clusterIP"] = "10.0.0.7"
+        found["spec"]["ports"] = [{"name": "http", "port": 8080}]
+        patch = drift.minimal_update_patch(desired, found,
+                                           copy_service_fields)
+        assert patch == {"spec": {"ports": [{"name": "http", "port": 80}]}}
+        assert k8s.json_merge_patch(found, patch)["spec"]["clusterIP"] == \
+            "10.0.0.7"
+
+
+class TestSemanticEqual:
+    def test_ignores_server_fields_and_empty_maps(self):
+        assert drift.semantic_equal(_sts(annotations={}),
+                                    _sts(server_side=True))
+
+    def test_detects_real_drift(self):
+        assert not drift.semantic_equal(_sts(image="img:a"),
+                                        _sts(image="img:b",
+                                             server_side=True))
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

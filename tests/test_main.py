@@ -65,7 +65,8 @@ def test_secret_payloads_not_cached_by_manager_client():
                   "metadata": {"name": "s", "namespace": "ns"},
                   "data": {"k": "djE="}})
     assert mgr.client.get("Secret", "ns", "s")["data"] == {"k": "djE="}
-    assert ("Secret", "ns", "s") not in mgr.client._cache
+    cached = mgr.client.cached_object("Secret", "ns", "s")
+    assert cached is None or "data" not in cached
 
 
 def test_json_log_format():

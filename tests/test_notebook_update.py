@@ -107,60 +107,93 @@ def test_trusted_ca_mounted_on_update_when_source_appears_later(world):
                for m in mounts)
 
 
-class ConflictOnce:
-    """Client wrapper: the first ``update`` of the targeted kind raises 409
-    (as if a concurrent worker/culler wrote between our read and write)."""
+class WriteRecorder:
+    """Client wrapper recording every PUT/PATCH per kind — the drift
+    write-path contract (no full PUTs, minimal merge patches only) is
+    asserted through it."""
 
-    def __init__(self, store, kind):
+    def __init__(self, store):
         self._store = store
-        self._kind = kind
-        self.conflicts_left = 1
-        self.update_calls = 0
+        self.updates: list[dict] = []
+        self.patches: list[tuple[str, str, str, dict]] = []
 
     def update(self, obj):
-        from kubeflow_tpu.cluster import errors
-        self.update_calls += 1
-        if obj.get("kind") == self._kind and self.conflicts_left > 0:
-            self.conflicts_left -= 1
-            raise errors.ConflictError(
-                f"simulated 409 on {self._kind}")
+        self.updates.append(k8s.deepcopy(obj))
         return self._store.update(obj)
+
+    def patch(self, kind, namespace, name, patch):
+        self.patches.append((kind, namespace, name, k8s.deepcopy(patch)))
+        return self._store.patch(kind, namespace, name, patch)
 
     def __getattr__(self, name):
         return getattr(self._store, name)
 
 
-def test_statefulset_update_conflict_retries_once_without_backoff():
-    """The 409 fast path (notebook.py _update_with_conflict_retry):
-    a conflicting STS update re-reads + re-diffs + retries in the SAME
-    reconcile — no error-backoff requeue — and the retry is counted in
-    workqueue_retries_total."""
+def test_statefulset_drift_repair_is_a_minimal_merge_patch():
+    """The drift write path (notebook.py _apply_drift + utils/drift.py):
+    repairing STS drift sends a JSON merge patch carrying ONLY the drifted
+    paths — never a full PUT, so there is no resourceVersion to 409 on, no
+    conflict-retry re-GET, and no error-backoff requeue even with a
+    concurrent writer racing the repair."""
     from kubeflow_tpu.utils.metrics import MetricsRegistry
 
     store = ClusterStore()
-    client = ConflictOnce(store, "StatefulSet")
+    client = WriteRecorder(store)
     metrics = MetricsRegistry()
     mgr = setup_controllers(client, ControllerConfig(), metrics=metrics,
                             extension=False, webhooks=False,
                             cached_reads=False)
     store.create(api.new_notebook("nb", "user-ns", image="jupyter:2024a"))
     drain(mgr)
-    # drift the STS so reconcile needs an update, then reconcile with the
-    # first update conflicting
     nb = store.get(api.KIND, "user-ns", "nb")
     api.notebook_container(nb)["image"] = "jupyter:2024b"
     store.update(nb)
     errors_before = metrics.counter(
         "controller_runtime_reconcile_total", "").get(
         {"controller": "notebook-controller", "result": "error"})
+    client.updates.clear()
+    client.patches.clear()
     drain(mgr)
     sts = store.get("StatefulSet", "user-ns", "nb")
     container = k8s.get_in(sts, "spec", "template", "spec", "containers")[0]
-    assert container["image"] == "jupyter:2024b"  # retry applied the update
-    assert client.conflicts_left == 0              # the 409 actually fired
-    retries = metrics.counter("workqueue_retries_total", "")
-    assert retries.get({"name": "notebook-controller"}) == 1
+    assert container["image"] == "jupyter:2024b"  # the patch applied
+    assert not [u for u in client.updates if u.get("kind") == "StatefulSet"]
+    sts_patches = [p for p in client.patches if p[0] == "StatefulSet"]
+    assert sts_patches  # drift repaired via PATCH…
+    for _, _, _, patch in sts_patches:
+        # …carrying only drifted paths: no metadata (labels/annotations
+        # unchanged), no replicas/selector/serviceName — just the template
+        assert "metadata" not in patch
+        assert set(patch) == {"spec"}
+        assert set(patch["spec"]) == {"template"}
+        # and no resourceVersion precondition anywhere in the patch
+        assert "resourceVersion" not in str(patch)
     errors_after = metrics.counter(
         "controller_runtime_reconcile_total", "").get(
         {"controller": "notebook-controller", "result": "error"})
     assert errors_after == errors_before  # no error-backoff requeue burned
+
+
+def test_no_drift_means_no_write():
+    """Steady state: re-reconciling an unchanged notebook issues ZERO
+    StatefulSet/Service writes (the drift detector gates the write
+    entirely — the read-only steady-state reconcile the reference gets
+    from its informer + CopyStatefulSetFields discipline)."""
+    store = ClusterStore()
+    client = WriteRecorder(store)
+    mgr = setup_controllers(client, ControllerConfig(),
+                            extension=False, webhooks=False,
+                            cached_reads=False)
+    store.create(api.new_notebook("nb", "user-ns", image="jupyter:2024a"))
+    drain(mgr)
+    client.updates.clear()
+    client.patches.clear()
+    # poke the notebook with a no-op annotation the STS does not propagate
+    # differently (kubectl-prefixed keys are excluded from propagation)
+    store.patch(api.KIND, "user-ns", "nb", {"metadata": {"annotations": {
+        "kubectl.kubernetes.io/last-applied-configuration": "{}"}}})
+    drain(mgr)
+    assert not [u for u in client.updates
+                if u.get("kind") in ("StatefulSet", "Service")]
+    assert not [p for p in client.patches
+                if p[0] in ("StatefulSet", "Service")]
