@@ -8,7 +8,7 @@ TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
         test-transport gate lint manifests \
         manifests-check check-license bench numerics ctx-sweep mfu-ab capture \
         spec-acceptance prefix-cache-ab chunked-prefill-ab dryrun loadtest \
-        loadtest-faults run run-split
+        loadtest-faults loadtest-preempt run run-split
 
 help: ## Display this help.
 	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_-]+:.*?##/ {printf "  %-16s %s\n", $$1, $$2}' $(MAKEFILE_LIST)
@@ -23,7 +23,7 @@ test-fast: ## Suite minus the subprocess/multi-process tests.
 	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q -k "not slow"
 
 test-chaos: ## Fault-injection tier only (reference: make test-chaos).
-	$(TEST_ENV) $(PYTHON) -m pytest tests/test_chaos.py tests/test_chaos_experiments.py tests/test_http_resilience.py tests/test_manager_backoff.py tests/test_chaos_smoke.py -q
+	$(TEST_ENV) $(PYTHON) -m pytest tests/test_chaos.py tests/test_chaos_experiments.py tests/test_http_resilience.py tests/test_manager_backoff.py tests/test_chaos_smoke.py tests/test_slice_repair.py -q
 
 chaos-experiments: ## Execute chaos/experiments/*.yaml via the runner (real-wire).
 	$(TEST_ENV) $(PYTHON) -m kubeflow_tpu.cluster.experiments chaos/experiments --run
@@ -33,6 +33,9 @@ chaos-smoke: ## Schema + all experiments + 50nb@10% wire-fault soak (180s budget
 
 loadtest-faults: ## 200-notebook wire fan-out at a 10% injected fault rate.
 	$(TEST_ENV) $(PYTHON) loadtest/start_notebooks.py --wire --count 200 --fault-rate 0.10
+
+loadtest-preempt: ## 50 v5e-16 slices, 20% of worker-0 nodes preempted mid-fan-out.
+	$(TEST_ENV) $(PYTHON) loadtest/start_notebooks.py --wire --count 50 --accelerator v5e-16 --preempt-rate 0.20
 
 test-transport: ## Real-HTTP transport + multi-process HA tier.
 	$(TEST_ENV) $(PYTHON) -m pytest tests/test_http_transport.py tests/test_http_stack.py tests/test_cli.py tests/test_multihost.py -q

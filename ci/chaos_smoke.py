@@ -6,10 +6,12 @@ against the REAL wire stack (controllers over a local HTTP apiserver):
 
 1. **schema** — every chaos/experiments/*.yaml validates (the reference
    CI's operator_chaos_validation, kept);
-2. **experiments** — the runner executes every experiment end to end:
-   N notebooks reach SliceReady, the injection fires, and every
-   steadyState check passes again within the scaled recovery bound
-   (kubeflow_tpu.cluster.experiments --run);
+2. **experiments** — the runner executes every experiment end to end
+   (incl. node-preemption: taint + kill the node under worker 0 of a
+   v5e-16 slice, slice-atomic repair, no quarantine from one
+   preemption): N notebooks reach SliceReady, the injection fires, and
+   every steadyState check passes again within the scaled recovery
+   bound (kubeflow_tpu.cluster.experiments --run);
 3. **soak** — the loadtest fan-out with a uniform wire FaultPlan
    (429-with-Retry-After / 503 / connection-reset / watch-kill mix):
    every notebook converges, zero stuck, and the audit tap shows no

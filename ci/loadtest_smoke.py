@@ -45,10 +45,18 @@ MAX_FULL_SCANS = 0
 # small page so the 50-notebook fan-out actually exercises limit/continue
 # chunking on the wire (backfills + resyncs page through the apiserver)
 LIST_PAGE_SIZE = 20
+# preemption phase: a smaller multi-host fan-out (each notebook is a 4-worker
+# v5e-16 slice) with a quarter of the fleet losing the node under worker 0
+# mid-run. Asserts zero stuck notebooks and zero partial-slice replica
+# states (0 or full only) under repair traffic. No requests/notebook bound:
+# repairs legitimately add writes.
+PREEMPT_COUNT = 16
+PREEMPT_RATE = 0.25
 
 
 def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
-              budget_s: float = DEFAULT_BUDGET_S) -> int:
+              budget_s: float = DEFAULT_BUDGET_S,
+              preempt: bool = True) -> int:
     """Run the wire fan-out; return nonzero on any failed bound."""
     from loadtest.start_notebooks import run_wire
 
@@ -59,14 +67,26 @@ def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
                   workers=workers,
                   list_page_size=LIST_PAGE_SIZE,
                   max_full_scans=MAX_FULL_SCANS)
-    wall = time.monotonic() - t0
     if rc != 0:
         print(f"SMOKE FAIL: loadtest bounds violated (rc={rc})")
         return rc
+    if preempt:
+        rc = run_wire(PREEMPT_COUNT, "preempt-smoke", "v5e-16",
+                      timeout=max(budget_s - (time.monotonic() - t0), 15.0),
+                      workers=workers,
+                      preempt_rate=PREEMPT_RATE)
+        if rc != 0:
+            print(f"SMOKE FAIL: preemption loadtest bounds violated "
+                  f"(rc={rc})")
+            return rc
+    wall = time.monotonic() - t0
     if wall > budget_s:
         print(f"SMOKE FAIL: {wall:.1f}s exceeds the {budget_s:.0f}s budget")
         return 1
-    print(f"smoke OK: {count} notebooks x {workers} workers in {wall:.1f}s "
+    print(f"smoke OK: {count} notebooks x {workers} workers "
+          f"+ {PREEMPT_COUNT} slices @ {PREEMPT_RATE:.0%} preemptions "
+          f"in {wall:.1f}s (budget {budget_s:.0f}s)" if preempt else
+          f"smoke OK: {count} notebooks x {workers} workers in {wall:.1f}s "
           f"(budget {budget_s:.0f}s)")
     return 0
 
@@ -76,8 +96,11 @@ def main() -> int:
     ap.add_argument("--count", type=int, default=DEFAULT_COUNT)
     ap.add_argument("--workers", type=int, default=DEFAULT_WORKERS)
     ap.add_argument("--budget-s", type=float, default=DEFAULT_BUDGET_S)
+    ap.add_argument("--no-preempt", action="store_true",
+                    help="skip the node-preemption repair phase")
     args = ap.parse_args()
-    return run_smoke(args.count, args.workers, args.budget_s)
+    return run_smoke(args.count, args.workers, args.budget_s,
+                     preempt=not args.no_preempt)
 
 
 if __name__ == "__main__":

@@ -40,6 +40,13 @@ CONDITION_READY = "Ready"
 # TPU-native aggregate condition (new): all workers of a slice ready AND the
 # JAX mesh formed — SURVEY §7 hard part "multi-host readiness semantics".
 CONDITION_SLICE_READY = "SliceReady"
+# Slice health & repair state machine (controllers/slicerepair.py), mirrored
+# into status alongside SliceReady. The condition type is "Slice" + the
+# state value carried in the tpu.kubeflow.org/slice-health annotation.
+CONDITION_SLICE_DEGRADED = "SliceDegraded"
+CONDITION_SLICE_REPAIRING = "SliceRepairing"
+CONDITION_SLICE_QUARANTINED = "SliceQuarantined"
+SLICE_HEALTH_STATES = ("Degraded", "Repairing", "Quarantined")
 
 
 def new_notebook(name: str, namespace: str, *,

@@ -60,6 +60,12 @@ DEFAULT_LABEL_INDEXES = (
     "app.kubernetes.io/part-of",
 )
 
+#: object-field paths indexed by default (controller-runtime's
+#: FieldIndexer analog): ``spec.nodeName`` serves the node-event fan-in
+#: (slice repair + kubelet sim map a Node to the pods bound to it) in
+#: O(pods on that node) instead of O(fleet pods) per node event
+DEFAULT_FIELD_INDEXES = ("spec.nodeName",)
+
 LAST_APPLIED_ANNOTATION = "kubectl.kubernetes.io/last-applied-configuration"
 
 
@@ -136,6 +142,19 @@ def owned_objects(client, kind: str, owner: dict) -> list[dict]:
             if k8s.is_owned_by(o, k8s.uid(owner))]
 
 
+def pods_on_node(client, node_name: str) -> list[dict]:
+    """Pods bound to ``node_name`` through ANY client — the by-field
+    ``spec.nodeName`` index when the client carries one (O(pods on this
+    node)), else a label-existence LIST filtered in Python. The one
+    node→pods fan-in both the slice-repair Node mapper and the kubelet
+    simulator use, so their fallbacks cannot drift apart."""
+    fn = getattr(client, "list_by_field", None)
+    if fn is not None:
+        return fn("Pod", "spec.nodeName", node_name)
+    return [p for p in client.list("Pod", None, {"statefulset": None})
+            if k8s.get_in(p, "spec", "nodeName") == node_name]
+
+
 def _owner_uids(obj: dict) -> list[str]:
     return [r.get("uid") for r in
             (k8s.get_in(obj, "metadata", "ownerReferences",
@@ -150,15 +169,19 @@ class _KindStore:
     the CachingClient lock; object dicts are replaced, never mutated, so
     references handed out under the lock are safe to read outside it."""
 
-    __slots__ = ("label_keys", "objects", "by_namespace", "by_owner",
-                 "by_label")
+    __slots__ = ("label_keys", "field_paths", "objects", "by_namespace",
+                 "by_owner", "by_label", "by_field")
 
-    def __init__(self, label_keys: tuple[str, ...]):
+    def __init__(self, label_keys: tuple[str, ...],
+                 field_paths: tuple[str, ...] = ()):
         self.label_keys = label_keys
+        # dot-paths into the object (e.g. "spec.nodeName"), pre-split once
+        self.field_paths = {p: tuple(p.split(".")) for p in field_paths}
         self.objects: dict[tuple[str, str], dict] = {}  # (ns, name) → obj
         self.by_namespace: dict[str, set] = {}
         self.by_owner: dict[str, set] = {}
         self.by_label: dict[str, dict[str, set]] = {k: {} for k in label_keys}
+        self.by_field: dict[str, dict[str, set]] = {p: {} for p in field_paths}
 
     # --------------------------------------------------------- maintenance
     def replace(self, key: tuple[str, str], obj: dict) -> None:
@@ -181,6 +204,10 @@ class _KindStore:
         for lk in self.label_keys:
             if lk in labels:
                 self.by_label[lk].setdefault(labels[lk], set()).add(key)
+        for path, parts in self.field_paths.items():
+            value = k8s.get_in(obj, *parts)
+            if isinstance(value, str) and value:
+                self.by_field[path].setdefault(value, set()).add(key)
 
     def _unindex(self, key: tuple[str, str], obj: dict) -> None:
         self._drop(self.by_namespace, key[0], key)
@@ -190,6 +217,10 @@ class _KindStore:
         for lk in self.label_keys:
             if lk in labels:
                 self._drop(self.by_label[lk], labels[lk], key)
+        for path, parts in self.field_paths.items():
+            value = k8s.get_in(obj, *parts)
+            if isinstance(value, str) and value:
+                self._drop(self.by_field[path], value, key)
 
     @staticmethod
     def _drop(index: dict, value, key) -> None:
@@ -230,6 +261,14 @@ class _KindStore:
     def owned(self, owner_uid: str) -> list[dict]:
         return [self.objects[k] for k in self.by_owner.get(owner_uid, ())]
 
+    def field(self, path: str, value: str) -> tuple[list[dict], bool]:
+        """Objects whose indexed field ``path`` equals ``value``; second
+        element False when the path carries no index (caller must scan)."""
+        idx = self.by_field.get(path)
+        if idx is None:
+            return [], False
+        return [self.objects[k] for k in idx.get(value, ())], True
+
 
 class CachingClient:
     """Same client surface as ClusterStore for reads/writes/watches, with the
@@ -252,11 +291,13 @@ class CachingClient:
                  DEFAULT_TRANSFORMS,
                  disable_for: Iterable[str] = DEFAULT_DISABLE_FOR,
                  auto_informer: bool = True,
-                 label_indexes: Iterable[str] = DEFAULT_LABEL_INDEXES) -> None:
+                 label_indexes: Iterable[str] = DEFAULT_LABEL_INDEXES,
+                 field_indexes: Iterable[str] = DEFAULT_FIELD_INDEXES) -> None:
         self.store = store
         self.transforms = tuple(transforms)
         self.disable_for = frozenset(disable_for)
         self.label_indexes = tuple(label_indexes)
+        self.field_indexes = tuple(field_indexes)
         # auto_informer=False: the cache opens NO watch streams of its own —
         # it is fed from watches its owner already holds (``feed``) plus an
         # explicit ``backfill`` per kind. This is how a reconciler shares
@@ -441,7 +482,8 @@ class CachingClient:
                 return  # stale snapshot of a deleted object
             ks = self._kinds.get(key[0])
             if ks is None:
-                ks = self._kinds[key[0]] = _KindStore(self.label_indexes)
+                ks = self._kinds[key[0]] = _KindStore(self.label_indexes,
+                                                      self.field_indexes)
             cached = ks.objects.get((key[1], key[2]))
             if cached is not None:
                 cached_rv, new_rv = self._rv(cached), self._rv(obj)
@@ -547,6 +589,38 @@ class CachingClient:
         matched = [o for o in candidates
                    if (namespace is None or k8s.namespace(o) == namespace)
                    and k8s.matches_labels(o, label_selector)]
+        return [k8s.deepcopy(o) for o in matched]
+
+    def list_by_field(self, kind: str, path: str, value: str,
+                      namespace: str | None = None) -> list[dict]:
+        """Objects of ``kind`` whose field ``path`` (dot-path, e.g.
+        "spec.nodeName") equals ``value`` — the FieldIndexer lookup,
+        O(result) when the path is indexed (``field_indexes``). Falls back
+        to a filtered live LIST for payload/unfed/gapped kinds and to a
+        counted full scan when the path carries no index, so the result
+        set is identical regardless of wiring."""
+        parts = tuple(path.split("."))
+        with self._lock:
+            unfed = kind not in self._watched
+        if kind in self.disable_for or (unfed and not self.auto_informer) \
+                or self._is_gapped(kind):
+            return [o for o in self.store.list(kind, namespace)
+                    if k8s.get_in(o, *parts) == value]
+        self._ensure_informer(kind)
+        with self._lock:
+            ks = self._kinds.get(kind)
+            if ks is None:
+                candidates, indexed = [], True
+            else:
+                candidates, indexed = ks.field(path, value)
+                if not indexed:
+                    candidates = list(ks.objects.values())
+        self._count_access(kind, "by-field" if indexed else "scan")
+        # the full predicate re-applies OUTSIDE the lock on both paths
+        # (same over-selection contract as select())
+        matched = [o for o in candidates
+                   if k8s.get_in(o, *parts) == value
+                   and (namespace is None or k8s.namespace(o) == namespace)]
         return [k8s.deepcopy(o) for o in matched]
 
     def get_owned(self, kind: str, owner: dict | str) -> list[dict]:

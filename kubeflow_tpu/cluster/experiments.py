@@ -44,9 +44,10 @@ import yaml
 
 EXPERIMENT_KIND = "ChaosExperiment"
 VALID_INJECTIONS = {"PodKill", "NetworkPartition", "WebhookDisrupt",
-                    "RBACRevoke", "DeploymentScaleZero", "SliceWorkerKill"}
+                    "RBACRevoke", "DeploymentScaleZero", "SliceWorkerKill",
+                    "NodePreemption"}
 VALID_CHECK_TYPES = {"conditionTrue", "resourceExists", "httpGet",
-                     "sliceAtomic"}
+                     "sliceAtomic", "notQuarantined"}
 
 
 def _require(cond: bool, errors: list[str], msg: str) -> None:
@@ -370,6 +371,22 @@ class _MiniCluster:
                                f"{replicas} (full={full})")
         return True, ""
 
+    def _check_notQuarantined(self, check: dict):  # noqa: N802
+        from ..utils import names as name_keys
+        from ..utils.k8s import get_annotation
+        for name in self.notebooks:
+            nb = self.store.get_or_none(self.api.KIND, self.namespace, name)
+            if nb is None:
+                continue
+            if get_annotation(nb, name_keys.QUARANTINE_ANNOTATION) \
+                    is not None:
+                return False, f"notebook {name} is quarantined"
+            cond = self.api.get_condition(
+                nb, self.api.CONDITION_SLICE_QUARANTINED)
+            if cond and cond.get("status") == "True":
+                return False, f"notebook {name} SliceQuarantined is True"
+        return True, ""
+
     def close(self) -> None:
         for attr, method in (("mgr", "stop"), ("client", "close"),
                              ("proxy", "stop"), ("sim_mgr", "stop")):
@@ -412,7 +429,8 @@ def run_experiment(doc: dict, *, notebooks: int = 2,
     checks = (spec.get("steadyState") or {}).get("checks") or []
     t0 = time.monotonic()
     failures: list[str] = []
-    accelerator = "v5e-16" if itype == "SliceWorkerKill" else "v5e-4"
+    accelerator = ("v5e-16" if itype in ("SliceWorkerKill", "NodePreemption")
+                   else "v5e-4")
     audit = tempfile.NamedTemporaryFile(suffix=".ndjson", delete=False)
     audit.close()
     duration = _scaled(params.get("duration", "30s"), time_scale,
@@ -492,6 +510,37 @@ def run_experiment(doc: dict, *, notebooks: int = 2,
                 failures.append("breaker tripped on Forbidden responses "
                                 "(403 is a live apiserver, not an outage)")
             cluster.proxy.set_fault_plan(None)
+        elif itype == "NodePreemption":
+            from .kubelet import kill_node, preempt_node
+            ordinal = int(params.get("ordinal", 0))
+            victim = f"{cluster.notebooks[0]}-{ordinal}"
+            pod = cluster.store.get_or_none("Pod", cluster.namespace, victim)
+            node_name = (pod.get("spec") or {}).get("nodeName") if pod \
+                else None
+            if not node_name:
+                failures.append(f"worker {victim} has no node binding — "
+                                f"kubelet node lifecycle not active")
+            else:
+                # GKE sequence: the impending-termination notice taint
+                # first, then the node actually dies partway through the
+                # injection window. Atomicity is sampled THROUGHOUT: the
+                # repair must only ever roll the one STS 0 <-> full.
+                preempt_node(cluster.store, node_name)
+                deadline = time.monotonic() + duration
+                kill_at = time.monotonic() + duration / 2
+                killed = False
+                while time.monotonic() < deadline:
+                    if not killed and time.monotonic() >= kill_at:
+                        kill_node(cluster.store, node_name)
+                        killed = True
+                    atomic = cluster.run_checks([{"type": "sliceAtomic"}])
+                    if atomic:
+                        failures += [f"during-preemption {f}"
+                                     for f in atomic]
+                        break
+                    time.sleep(0.05)
+                if not killed:
+                    kill_node(cluster.store, node_name)
         elif itype == "SliceWorkerKill":
             ordinal = int(params.get("ordinal", 1))
             victim = f"{cluster.notebooks[0]}-{ordinal}"

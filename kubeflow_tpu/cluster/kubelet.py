@@ -1,4 +1,4 @@
-"""StatefulSet-controller + kubelet simulator.
+"""StatefulSet-controller + kubelet + node-lifecycle simulator.
 
 envtest "has no scheduler/kubelet, so pods never run" and the reference asserts
 on rendered objects only (SURVEY §4.2). We go one step further: this simulator
@@ -13,6 +13,23 @@ in-process. It reproduces the StatefulSet semantics our TPU layer leans on:
 - scale-down reaps the highest ordinals first; replicas=0 reaps everything
   (the slice-atomic cull path);
 - pod template changes restart pods (rolling update, OnDelete-ish).
+
+Node lifecycle (the failure mode that dominates TPU fleets — GKE
+preemption/maintenance): every pod is bound to a ``Node`` object
+(``spec.nodeName``; one node per worker VM, the multi-host TPU shape).
+Injecting node failure (``kill_node``/``set_node_ready``/``taint_node``/
+``preempt_node``) drives the node-lifecycle-controller behavior the slice
+repair loop depends on:
+
+- a pod on a dead node (NotReady, NoExecute-tainted, or deleted) flips
+  Ready=False within one reconcile tick — status mirroring reacts
+  (``SliceReady`` drops) even without the repair controller;
+- after ``node_grace_s`` the pod is EVICTED (deleted); the recreate binds a
+  FRESH node (GKE replaces preempted capacity), preserving the pod name and
+  ordinal;
+- a preemption-notice taint (``cloud.google.com/impending-node-termination``,
+  NoSchedule) leaves running pods Ready but blocks new bindings — the
+  cordon shape; the repair controller treats the notice itself as Degraded.
 """
 
 from __future__ import annotations
@@ -20,27 +37,114 @@ from __future__ import annotations
 import time
 
 from ..controllers.manager import Request, Result, owner_mapper
-from ..utils import k8s
+from ..utils import k8s, names
 from . import errors
 from .store import ClusterStore
+
+
+def node_doomed(node: dict | None) -> bool:
+    """Pods on this node are lost: node gone, NotReady, or NoExecute-tainted
+    (the taint manager's eviction trigger). A NoSchedule-only taint — the
+    preemption NOTICE — does not doom running pods."""
+    if node is None or not k8s.condition_true(node, "Ready"):
+        return True
+    return any(t.get("effect") == "NoExecute"
+               for t in k8s.get_in(node, "spec", "taints", default=[]) or [])
+
+
+def node_schedulable(node: dict | None) -> bool:
+    """New pods may bind here: Ready, untainted, not cordoned."""
+    if node is None or not k8s.condition_true(node, "Ready"):
+        return False
+    if k8s.get_in(node, "spec", "unschedulable"):
+        return False
+    return not (k8s.get_in(node, "spec", "taints", default=[]) or [])
+
+
+# ------------------------------------------------------- injection helpers
+def set_node_ready(client, node_name: str, ready: bool,
+                   reason: str = "KubeletStopped") -> None:
+    node = client.get("Node", "", node_name)
+    node["status"] = node.get("status") or {}
+    node["status"]["conditions"] = [
+        {"type": "Ready", "status": "True" if ready else "False",
+         "reason": "KubeletReady" if ready else reason,
+         "lastTransitionTime": k8s.now_iso()}]
+    client.update_status(node)
+
+
+def taint_node(client, node_name: str,
+               key: str = names.PREEMPTION_TAINT_KEY,
+               effect: str = "NoSchedule") -> None:
+    node = client.get("Node", "", node_name)
+    taints = k8s.get_in(node, "spec", "taints", default=[]) or []
+    if not any(t.get("key") == key for t in taints):
+        taints.append({"key": key, "effect": effect,
+                       "timeAdded": k8s.now_iso()})
+        node.setdefault("spec", {})["taints"] = taints
+        client.update(node)
+
+
+def preempt_node(client, node_name: str) -> None:
+    """GCE/GKE preemption notice: the node keeps serving but termination is
+    imminent (ACPI G2 / maintenance event)."""
+    taint_node(client, node_name, names.PREEMPTION_TAINT_KEY, "NoSchedule")
+
+
+def kill_node(client, node_name: str) -> None:
+    """The termination itself: kubelet stops posting status (NotReady) and
+    the taint manager marks it unreachable/NoExecute."""
+    taint_node(client, node_name, "node.kubernetes.io/unreachable",
+               "NoExecute")
+    set_node_ready(client, node_name, False, reason="NodeStatusUnknown")
 
 
 class StatefulSetSimulator:
     name = "sim-statefulset-controller"
 
     def __init__(self, client: ClusterStore, boot_delay_s: float = 0.0,
-                 ready_hook=None):
+                 ready_hook=None, manage_nodes: bool = True,
+                 node_grace_s: float = 0.25):
         """``ready_hook(pod) -> bool`` lets tests/bench gate pod readiness on
-        e.g. a simulated TPU runtime verification."""
+        e.g. a simulated TPU runtime verification. ``manage_nodes`` binds
+        every pod to a simulated Node and runs the node-lifecycle behavior
+        described in the module docstring; ``node_grace_s`` is the
+        NotReady→eviction window (the pod-eviction-timeout analog,
+        wall-clock seconds)."""
         self.client = client
         self.boot_delay_s = boot_delay_s
         self.ready_hook = ready_hook
+        self.manage_nodes = manage_nodes
+        self.node_grace_s = node_grace_s
         self._boot_times: dict[tuple[str, str], float] = {}
+        # (ns, pod) → node generation; bumped when the bound node dies so
+        # the recreate lands on fresh capacity
+        self._node_gen: dict[tuple[str, str], int] = {}
+        # (ns, pod) → monotonic time its node was first seen doomed
+        self._node_down_since: dict[tuple[str, str], float] = {}
 
     def setup(self, mgr) -> None:
         mgr.register(self)
         mgr.watch("StatefulSet", self.name)
         mgr.watch("Pod", self.name, mapper=owner_mapper("StatefulSet"))
+        if self.manage_nodes:
+            mgr.watch("Node", self.name, mapper=self._node_to_sts)
+
+    def _node_to_sts(self, node: dict) -> list[Request]:
+        """Node event → the StatefulSets with pods bound to it
+        (cache.pods_on_node: by-field ``spec.nodeName`` index when the
+        client carries one, O(pods on THIS node))."""
+        from .cache import pods_on_node
+        out, seen = [], set()
+        for pod in pods_on_node(self.client, k8s.name(node)):
+            for ref in k8s.get_in(pod, "metadata", "ownerReferences",
+                                  default=[]) or []:
+                if ref.get("kind") == "StatefulSet":
+                    key = (k8s.namespace(pod), ref.get("name"))
+                    if key not in seen:
+                        seen.add(key)
+                        out.append(Request(*key))
+        return out
 
     def reconcile(self, req: Request) -> Result | None:
         sts = self.client.get_or_none("StatefulSet", req.namespace, req.name)
@@ -99,6 +203,12 @@ class StatefulSetSimulator:
                     pass
                 requeue = 0.001
                 continue
+            if self.manage_nodes:
+                node_requeue = self._apply_node_health(ns, pod)
+                if node_requeue is not None:
+                    requeue = min(requeue, node_requeue) \
+                        if requeue else node_requeue
+                    continue  # doomed node: never (re)mark this pod Ready
             if not _pod_is_ready(pod):
                 booted_at = self._boot_times.get((ns, pod_name), 0.0)
                 if time.monotonic() - booted_at >= self.boot_delay_s and (
@@ -120,6 +230,69 @@ class StatefulSetSimulator:
                 requeue = 0.001
         return Result(requeue_after=requeue) if requeue else None
 
+    # ------------------------------------------------------ node lifecycle
+    def _apply_node_health(self, ns: str, pod: dict) -> float | None:
+        """Node-lifecycle-controller behavior for one pod. Returns a
+        requeue delay while the pod is riding out its node's death (the
+        caller must then skip ready-marking), or None when the node is
+        fine."""
+        pod_name = k8s.name(pod)
+        node_name = k8s.get_in(pod, "spec", "nodeName")
+        if not node_name:
+            return None  # pre-node-era pod (external creation): no binding
+        key = (ns, pod_name)
+        node = self.client.get_or_none("Node", "", node_name)
+        if not node_doomed(node):
+            self._node_down_since.pop(key, None)
+            return None
+        first = self._node_down_since.setdefault(key, time.monotonic())
+        if _pod_is_ready(pod):
+            # within one reconcile tick of the node dying
+            self._mark_not_ready(pod, "NodeNotReady")
+        if time.monotonic() - first >= self.node_grace_s:
+            # eviction: the pod object goes away; the recreate pass binds
+            # the SAME pod name (ordinal/hostname preserved) to new capacity
+            try:
+                self.client.delete("Pod", ns, pod_name)
+            except errors.NotFoundError:
+                pass
+            self._node_down_since.pop(key, None)
+            return 0.001
+        return max(self.node_grace_s / 4, 0.001)
+
+    def _bind_node(self, ns: str, pod_name: str) -> str:
+        """Current-generation node for this worker, skipping dead/cordoned
+        ones (GKE replaces preempted capacity with fresh nodes; the pod
+        name — and with it TPU_WORKER_ID and the stable hostname — never
+        changes)."""
+        key = (ns, pod_name)
+        gen = self._node_gen.get(key, 0)
+        while True:
+            node_name = f"sim-node-{ns}-{pod_name}-{gen}"
+            node = self.client.get_or_none("Node", "", node_name)
+            if node is None:
+                try:
+                    self.client.create({
+                        "apiVersion": "v1",
+                        "kind": "Node",
+                        "metadata": {
+                            "name": node_name,
+                            "labels": {"kubeflow-tpu.org/sim-node": "true"},
+                        },
+                        "spec": {},
+                        "status": {"conditions": [
+                            {"type": "Ready", "status": "True",
+                             "reason": "KubeletReady"}]},
+                    })
+                except errors.AlreadyExistsError:
+                    continue  # raced another worker; re-read next loop
+                self._node_gen[key] = gen
+                return node_name
+            if node_schedulable(node):
+                self._node_gen[key] = gen
+                return node_name
+            gen += 1
+
     def _make_pod(self, sts: dict, pod_name: str, ordinal: int,
                   selector: dict, template: dict) -> dict:
         pod_labels = dict(selector)
@@ -140,6 +313,9 @@ class StatefulSetSimulator:
         pod["spec"]["hostname"] = pod_name
         pod["spec"]["subdomain"] = k8s.get_in(sts, "spec", "serviceName",
                                               default="")
+        if self.manage_nodes:
+            pod["spec"]["nodeName"] = self._bind_node(k8s.namespace(sts),
+                                                      pod_name)
         k8s.set_controller_reference(sts, pod)
         return pod
 
@@ -165,6 +341,23 @@ class StatefulSetSimulator:
         except (errors.ConflictError, errors.NotFoundError):
             pass
 
+    def _mark_not_ready(self, pod: dict, reason: str) -> None:
+        now = k8s.now_iso()
+        pod = k8s.deepcopy(pod)
+        conditions = [c for c in k8s.get_in(pod, "status", "conditions",
+                                            default=[]) or []
+                      if c.get("type") not in ("Ready", "ContainersReady")]
+        conditions += [
+            {"type": "ContainersReady", "status": "False", "reason": reason},
+            {"type": "Ready", "status": "False", "reason": reason,
+             "lastTransitionTime": now},
+        ]
+        pod.setdefault("status", {})["conditions"] = conditions
+        try:
+            self.client.update_status(pod)
+        except (errors.ConflictError, errors.NotFoundError):
+            pass
+
 
 def _ordinal_of(pod_name: str, sts_name: str) -> int | None:
     prefix = sts_name + "-"
@@ -175,6 +368,4 @@ def _ordinal_of(pod_name: str, sts_name: str) -> int | None:
 
 
 def _pod_is_ready(pod: dict) -> bool:
-    return any(c.get("type") == "Ready" and c.get("status") == "True"
-               for c in k8s.get_in(pod, "status", "conditions",
-                                   default=[]) or [])
+    return k8s.condition_true(pod, "Ready")

@@ -2,9 +2,11 @@ from .manager import Manager, Request
 from .notebook import NotebookReconciler
 from .culling import CullingReconciler
 from .extension import ExtensionReconciler
+from .slicerepair import SliceRepairReconciler
 
 __all__ = ["Manager", "Request", "NotebookReconciler", "CullingReconciler",
-           "ExtensionReconciler", "setup_controllers"]
+           "ExtensionReconciler", "SliceRepairReconciler",
+           "setup_controllers"]
 
 
 def setup_controllers(client, config=None, metrics=None, prober=None, *,
@@ -106,6 +108,12 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
         if config.enable_culling:
             kwargs = {"prober": prober} if prober is not None else {}
             CullingReconciler(client, config, metrics, **kwargs).setup(mgr)
+        if getattr(config, "enable_slice_repair", True):
+            # slice health & repair: watches Pods AND Nodes, drives the
+            # Healthy → Degraded → Repairing → (Quarantined) state machine
+            # with slice-atomic 0 → N rolls through the core reconciler's
+            # desired_replicas seam
+            SliceRepairReconciler(client, config, metrics).setup(mgr)
     if extension:
         ExtensionReconciler(client, config, metrics).setup(mgr)
     if leader_elect:

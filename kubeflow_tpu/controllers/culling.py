@@ -194,9 +194,23 @@ class CullingReconciler:
             self._strip_activity_annotations(notebook)
             return None
 
+        # slice under repair (controllers/slicerepair.py): Jupyter being
+        # unreachable is EXPECTED — workers are being rolled — so the idle
+        # clock must PAUSE, never strip or advance last-activity toward a
+        # cull mid-repair (culling a slice because its repair took an hour
+        # would turn every incident into a data-loss event)
+        repairing = k8s.get_annotation(
+            notebook, names.SLICE_HEALTH_ANNOTATION) is not None
+
         # worker-0 must exist (reference checks pod <name>-0, :120-139)
         pod0 = self._worker0_pod(notebook)
         if pod0 is None:
+            if repairing:
+                # mid-repair scale-down: freeze the idle clock instead of
+                # stripping (a strip would re-initialize last-activity and
+                # silently reset accumulated idleness)
+                self._pause_idle_clock(notebook)
+                return Result(requeue_after=period_s)
             self._strip_activity_annotations(notebook)
             return Result(requeue_after=period_s)
 
@@ -217,6 +231,13 @@ class CullingReconciler:
             return Result(requeue_after=period_s)  # reference :156-160
 
         activity = self.prober(notebook)
+        if not activity.reachable and repairing:
+            # unreachable probe while Degraded/Repairing/Quarantined: the
+            # repair explains the silence; pause the idle clock (a
+            # REACHABLE probe mid-repair still carries real data and takes
+            # the normal path below)
+            self._pause_idle_clock(notebook)
+            return Result(requeue_after=period_s)
         updates = {names.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION:
                    format_time(now)}
         if activity.reachable:
@@ -279,6 +300,30 @@ class CullingReconciler:
             if k8s.get_label(pod, "apps.kubernetes.io/pod-index", "0") == "0":
                 return pod
         return None
+
+    def _pause_idle_clock(self, notebook: dict) -> None:
+        """Freeze accumulated idleness across a repair window: shift
+        last-activity forward by exactly the time elapsed since the last
+        check, so idle_s neither grows nor resets while the slice is being
+        repaired. No-op before the clock is initialized, and throttled to
+        the check period — repair-state churn fans every Notebook event
+        into a culler reconcile, and pausing is always safe to defer
+        (the shift lands the same wherever inside the window it runs)."""
+        last_check = k8s.get_annotation(
+            notebook, names.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION)
+        last_activity = k8s.get_annotation(notebook,
+                                           names.LAST_ACTIVITY_ANNOTATION)
+        if last_check is None or last_activity is None:
+            return
+        now = self.clock()
+        elapsed = max(now - parse_time(last_check), 0.0)
+        if elapsed < self.config.idleness_check_period_min * 60:
+            return
+        self._retry_patch_annotations(notebook, {
+            names.LAST_ACTIVITY_ANNOTATION:
+                format_time(min(parse_time(last_activity) + elapsed, now)),
+            names.LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION: format_time(now),
+        })
 
     def _strip_activity_annotations(self, notebook: dict) -> None:
         if all(k8s.get_annotation(notebook, a) is None for a in (

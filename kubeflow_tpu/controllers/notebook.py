@@ -210,8 +210,14 @@ class NotebookReconciler:
     def desired_replicas(self, notebook: dict, slice_spec: SliceSpec | None) -> int:
         """Stop annotation → 0, else the slice worker count (reference
         :434-437 is the 0/1 version). NEVER a partial count — slice atomicity
-        invariant (SURVEY §7 stage 5)."""
+        invariant (SURVEY §7 stage 5). The repair controller's scale-down
+        hold (controllers/slicerepair.py) rides the same single-writer
+        seam: repairs roll the slice 0 → N through THIS function, so
+        replicas can only ever be 0 or full, never partial."""
         if k8s.get_annotation(notebook, names.STOP_ANNOTATION) is not None:
+            return 0
+        if k8s.get_annotation(notebook,
+                              names.REPAIR_SCALE_DOWN_ANNOTATION) is not None:
             return 0
         return slice_spec.num_workers if slice_spec else 1
 
@@ -233,6 +239,11 @@ class NotebookReconciler:
             if key in (names.TPU_ACCELERATOR_ANNOTATION,
                        names.TPU_TOPOLOGY_ANNOTATION):
                 continue  # slice identity lives in labels/env, not pod annotations
+            if key in names.SLICE_REPAIR_ANNOTATIONS:
+                # repair bookkeeping would churn the pod template (every
+                # health transition a spurious template drift → rolling
+                # restart) — it describes the slice, not the pods
+                continue
             out[key] = val
         return out
 
@@ -581,17 +592,49 @@ class NotebookReconciler:
                 if cs.get("name") == nb_name:
                     status["containerState"] = cs.get("state", {})
                     break
-        ready_pods = sum(
-            1 for p in pods
-            if any(c.get("type") == "Ready" and c.get("status") == "True"
-                   for c in k8s.get_in(p, "status", "conditions", default=[]) or []))
+        ready_uids = {k8s.name(p): k8s.uid(p) for p in pods
+                      if k8s.condition_true(p, "Ready")}
+        ready_pods = len(ready_uids)
         slice_ready = expected > 0 and ready_pods >= expected
+        # status.workerUIDs = the pod UIDs at MESH FORMATION, stamped in the
+        # same status write that publishes SliceReady=True (race-free: one
+        # writer, one write). A later PARTIAL difference between these and
+        # the live pods means a worker was silently replaced — the restarted
+        # worker's JAX client is orphaned even though every pod shows Ready,
+        # so the repair controller (slicerepair.py) must roll the slice. A
+        # COMPLETE replacement (restart annotation, cull/resume, the repair
+        # roll itself) is a consistent new mesh: refresh the baseline.
+        prev_uids = k8s.get_in(notebook, "status", "workerUIDs") or {}
+        if slice_ready:
+            stale = (not prev_uids or set(prev_uids) != set(ready_uids)
+                     or all(prev_uids[n] != ready_uids[n] for n in prev_uids))
+            status["workerUIDs"] = dict(ready_uids) if stale \
+                else dict(prev_uids)
+        elif prev_uids:
+            status["workerUIDs"] = dict(prev_uids)  # keep through degradation
         status["conditions"].insert(0, {
             "type": api.CONDITION_SLICE_READY,
             "status": "True" if slice_ready else "False",
             "reason": "AllWorkersReady" if slice_ready else "WaitingForWorkers",
             "message": f"{ready_pods}/{expected} workers ready",
         })
+        # slice health & repair state (controllers/slicerepair.py) rides the
+        # slice-health annotation; while it is set, mirror it as the
+        # Slice{Degraded,Repairing,Quarantined} condition triple (healthy
+        # slices and CPU notebooks keep the lean SliceReady-only set)
+        health = k8s.get_annotation(notebook, names.SLICE_HEALTH_ANNOTATION)
+        if health is not None:
+            reason = k8s.get_annotation(
+                notebook, names.SLICE_HEALTH_REASON_ANNOTATION) or health
+            for pos, state in enumerate(api.SLICE_HEALTH_STATES, start=1):
+                active = health == state
+                status["conditions"].insert(pos, {
+                    "type": f"Slice{state}",
+                    "status": "True" if active else "False",
+                    "reason": reason if active else "SliceHealthy",
+                    "message": (f"slice {state.lower()} ({reason})"
+                                if active else ""),
+                })
         if k8s.get_in(notebook, "status") != status:
             notebook = k8s.deepcopy(notebook)
             notebook["status"] = status

@@ -1,0 +1,559 @@
+"""Slice health & repair controller: node-preemption-aware, slice-atomic
+recovery with poison-pill quarantine.
+
+A JAX multi-host mesh cannot run degraded — one dead worker hangs every
+worker (SURVEY §7 stage 5) — so the failures that dominate TPU fleets (GKE
+node preemption/maintenance, a worker VM going NotReady, a crashlooping
+worker image) must be answered by repairing the *whole slice* or by
+deliberately stopping. This controller watches Pods AND Nodes for every TPU
+notebook's slice and drives a state machine:
+
+    Healthy ──(worker NotReady / node NotReady / preemption-notice taint /
+               crashloop)──▶ Degraded ──▶ Repairing ──▶ Healthy
+                                │
+                                └─(K FAILED repairs in a sliding window)──▶
+                                  Quarantined (poison pill: repairs stop
+                                  until an operator clears the annotation)
+
+Repair is **slice-atomic**: the one StatefulSet is rolled through
+replicas 0 → N — never individual worker deletions — so pods are only ever
+observed at 0 or the full worker count and ordinals/hostnames
+(``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES``) are preserved. The scale-down
+is expressed as the ``tpu.kubeflow.org/repair-scale-down`` annotation on
+the Notebook; the core reconciler's ``desired_replicas`` honors it, keeping
+a SINGLE writer of ``spec.replicas`` (the same pattern as the culler's stop
+annotation) so the partial-scale race between two writers cannot exist.
+
+State is carried on the Notebook (annotations — survives controller
+restarts and leader failover) and mirrored into status conditions
+(``SliceDegraded``/``SliceRepairing``/``SliceQuarantined`` alongside
+``SliceReady``) by the core reconciler. Every transition emits a Kubernetes
+Event, and four metric families export the fleet view:
+``slice_repairs_total``, ``slice_repair_duration_seconds``,
+``slice_quarantines_total``, ``slice_degraded``.
+
+Backoff between repair attempts of one slice is decorrelated jitter
+(``min(cap, uniform(base, prev*3))`` — the AWS shape the transport retries
+also use), so a zone-wide preemption wave does not re-roll every slice in
+lockstep.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+
+from ..api import types as api
+from ..cluster import events
+from ..tpu.topology import SliceSpec, TpuRequestError, parse_slice_request
+from ..utils import k8s, names
+from ..utils.config import ControllerConfig
+from ..utils.metrics import MetricsRegistry
+from .manager import Manager, Request, Result, label_mapper
+
+log = logging.getLogger("kubeflow_tpu.slicerepair")
+
+HEALTHY = None  # annotation absent
+DEGRADED = "Degraded"
+REPAIRING = "Repairing"
+QUARANTINED = "Quarantined"
+
+# containerStatuses restart count at which a worker counts as crashlooping
+# even before the kubelet labels it CrashLoopBackOff
+CRASHLOOP_RESTARTS = 3
+
+
+def node_problem(node: dict | None) -> tuple[str, str] | None:
+    """Why a node can't host slice workers: (reason, detail) or None.
+    Stricter than the kubelet's doom check (cluster/kubelet.node_doomed):
+    a NoSchedule preemption NOTICE leaves pods running — the kubelet does
+    not evict for it — but for a TPU slice the notice alone is Degraded,
+    because the repair must roll the slice off the node BEFORE the
+    termination lands mid-step."""
+    if node is None:
+        return ("NodeGone", "node object deleted")
+    for taint in k8s.get_in(node, "spec", "taints", default=[]) or []:
+        if taint.get("key") == names.PREEMPTION_TAINT_KEY:
+            return ("NodePreempted", "impending termination notice")
+        if taint.get("effect") == "NoExecute":
+            return ("NodeNotReady", f"NoExecute taint {taint.get('key')}")
+    for cond in k8s.get_in(node, "status", "conditions", default=[]) or []:
+        if cond.get("type") == "Ready" and cond.get("status") != "True":
+            return ("NodeNotReady",
+                    cond.get("reason") or "Ready condition not True")
+    return None
+
+
+def slice_health(notebook: dict) -> str | None:
+    """Current health state of a notebook's slice (annotation-carried):
+    "Degraded" / "Repairing" / "Quarantined", or None = healthy. The
+    culler consults this to pause the idle clock mid-repair."""
+    return k8s.get_annotation(notebook, names.SLICE_HEALTH_ANNOTATION)
+
+
+class SliceRepairReconciler:
+    name = "slice-repair-controller"
+
+    def __init__(self, client, config: ControllerConfig | None = None,
+                 metrics: MetricsRegistry | None = None,
+                 clock=time.time, rng: random.Random | None = None):
+        from ..cluster.echo import EchoTrackingClient
+        client = EchoTrackingClient(client)
+        self.client = client
+        self.config = config or ControllerConfig()
+        self.metrics = metrics or MetricsRegistry()
+        self.clock = clock
+        self._rng = rng or random.Random()
+        self.recorder = events.EventRecorder(client, component=self.name)
+        self._read_cache = None
+        # per-slice decorrelated-jitter backoff state (in-memory is fine:
+        # a restarted controller starting its first repair immediately is
+        # correct — the QUARANTINE window, which must survive restarts,
+        # rides the repair-failures annotation instead)
+        self._lock = threading.Lock()
+        self._backoff: dict[tuple[str, str], float] = {}
+        self._not_before: dict[tuple[str, str], float] = {}
+        # label combinations the slice_degraded gauge has ever exported —
+        # a state draining to zero must overwrite its stale sample
+        self._gauge_seen: set[tuple[str, str]] = set()
+        self.repairs_total = self.metrics.counter(
+            "slice_repairs_total",
+            "Slice-atomic repair attempts started, by namespace and "
+            "triggering reason.")
+        self.repair_duration = self.metrics.histogram(
+            "slice_repair_duration_seconds",
+            "Wall time from repair start to all workers Ready again, by "
+            "namespace.")
+        self.quarantines_total = self.metrics.counter(
+            "slice_quarantines_total",
+            "Slices quarantined after repeated failed repairs, by "
+            "namespace.")
+        self.degraded_gauge = self.metrics.gauge(
+            "slice_degraded",
+            "Slices currently not healthy, by namespace and state "
+            "(Degraded/Repairing/Quarantined).")
+        self.metrics.on_scrape(self._scrape_health)
+
+    # ------------------------------------------------------------- wiring
+    def setup(self, mgr: Manager) -> None:
+        """Own Notebook keys; map Pods via the notebook-name label and
+        Nodes via the pods bound to them (the Node kind was in the
+        restmapper/store all along but unwatched — this is the controller
+        that closes that loop)."""
+        mgr.register(self)
+        from ..cluster.cache import CachingClient
+        if mgr.read_cache is not None:
+            cache, tee = mgr.read_cache, None
+        else:
+            cache = CachingClient(self.client, disable_for=(),
+                                  auto_informer=False)
+            tee = cache.feed
+        self._read_cache = cache
+        ne = self.client.not_echo
+        mgr.watch(api.KIND, self.name, tee=tee, predicate=ne)
+        mgr.watch("Pod", self.name,
+                  mapper=label_mapper(names.NOTEBOOK_NAME_LABEL), tee=tee)
+        mgr.watch("Node", self.name, mapper=self._node_requests, tee=tee)
+        for kind in (api.KIND, "Pod", "Node"):
+            try:
+                cache.backfill(kind)
+            except Exception:  # noqa: BLE001 — degrade to live reads
+                log.warning("read-cache backfill for %s failed; reads "
+                            "stay live", kind, exc_info=True)
+
+    def _reader(self):
+        return self._read_cache or self.client
+
+    def _node_requests(self, node: dict) -> list[Request]:
+        """Node event → the notebooks with slice workers bound to it
+        (cache.pods_on_node: the by-field ``spec.nodeName`` index when the
+        reader carries one, O(pods on THIS node))."""
+        from ..cluster.cache import pods_on_node
+        out, seen = [], set()
+        for pod in pods_on_node(self._reader(), k8s.name(node)):
+            nb = k8s.get_label(pod, names.NOTEBOOK_NAME_LABEL)
+            key = (k8s.namespace(pod), nb)
+            if nb and key not in seen:
+                seen.add(key)
+                out.append(Request(*key))
+        return out
+
+    def _scrape_health(self) -> None:
+        """slice_degraded is computed at scrape time from the (cached)
+        Notebook population — the same shape as notebook_running."""
+        reader = self._reader()
+        counts: dict[tuple[str, str], int] = {}
+        for nb in reader.list(api.KIND):
+            state = slice_health(nb)
+            if state:
+                key = (k8s.namespace(nb), state)
+                counts[key] = counts.get(key, 0) + 1
+        for key in self._gauge_seen | set(counts):
+            self.degraded_gauge.set(counts.get(key, 0),
+                                    {"namespace": key[0], "state": key[1]})
+        self._gauge_seen |= set(counts)
+
+    # ---------------------------------------------------------- reconcile
+    def reconcile(self, req: Request) -> Result | None:
+        notebook = self.client.get_or_none(api.KIND, req.namespace, req.name)
+        key = (req.namespace, req.name)
+        if notebook is None or k8s.is_deleting(notebook):
+            with self._lock:
+                self._backoff.pop(key, None)
+                self._not_before.pop(key, None)
+            return None
+        try:
+            slice_spec = parse_slice_request(
+                k8s.get_in(notebook, "metadata", "annotations", default={}))
+        except TpuRequestError:
+            return None  # admission rejects these; nothing to repair
+        if slice_spec is None:
+            return None  # CPU notebook: no slice semantics
+
+        state = slice_health(notebook)
+        quarantined = k8s.get_annotation(notebook,
+                                         names.QUARANTINE_ANNOTATION)
+
+        # user stopped the notebook: the slice is deliberately at 0 — drop
+        # transient repair state (quarantine, if any, stays: it is cleared
+        # only by the operator)
+        if k8s.get_annotation(notebook, names.STOP_ANNOTATION) is not None:
+            self._patch(notebook, {
+                names.SLICE_HEALTH_ANNOTATION:
+                    QUARANTINED if quarantined else None,
+                names.SLICE_HEALTH_REASON_ANNOTATION:
+                    None if not quarantined else k8s.get_annotation(
+                        notebook, names.SLICE_HEALTH_REASON_ANNOTATION),
+                names.REPAIR_SCALE_DOWN_ANNOTATION: None,
+                names.REPAIR_STARTED_AT_ANNOTATION: None,
+            }, only_if_changed=True)
+            self._reset_backoff(key)
+            return None
+
+        # ---------------------------------------------------- poison pill
+        if quarantined is not None:
+            if state != QUARANTINED:
+                # normalize (e.g. annotation restored from backup, or the
+                # quarantine patch raced): quarantined means NOT repairing
+                self._patch(notebook, {
+                    names.SLICE_HEALTH_ANNOTATION: QUARANTINED,
+                    names.REPAIR_SCALE_DOWN_ANNOTATION: None,
+                    names.REPAIR_STARTED_AT_ANNOTATION: None,
+                })
+            return None  # no repairs, no polling — events re-trigger us
+        if state == QUARANTINED:
+            # operator cleared the annotation: resume and RESET the window
+            self._patch(notebook, {
+                names.SLICE_HEALTH_ANNOTATION: None,
+                names.SLICE_HEALTH_REASON_ANNOTATION: None,
+                names.REPAIR_FAILURES_ANNOTATION: None,
+            })
+            self._reset_backoff(key)
+            self.recorder.eventf(notebook, events.TYPE_NORMAL,
+                                 "SliceQuarantineCleared",
+                                 "quarantine annotation cleared; repairs "
+                                 "resume with a fresh failure window")
+            return Result(requeue_after=0)
+
+        # pods/nodes read through the informer cache (index-served, zero
+        # wire cost on the poll loop); the notebook itself stays on
+        # self.client — in the wired composition that IS the cache, and a
+        # standalone reconciler needs the freshest view of its own patches
+        pods = self._reader().list("Pod", req.namespace,
+                                   {names.NOTEBOOK_NAME_LABEL: req.name})
+        problems = self._detect(notebook, pods)
+        if not problems and state is None:
+            # silent worker replacement: every pod Ready, but some (not
+            # all) differ from the mesh-formation UIDs — the restarted
+            # worker's JAX client is orphaned; only a slice roll re-forms
+            # the mesh. This latch closes the race where a node death +
+            # kubelet self-heal completes faster than our event handling.
+            replaced = self._worker_replacement(notebook, slice_spec, pods)
+            if replaced:
+                problems = [replaced]
+
+        if state == REPAIRING:
+            return self._continue_repair(notebook, slice_spec, problems,
+                                         pods, key)
+
+        if problems:
+            reason, detail = problems[0]
+            if state != DEGRADED:
+                self._patch(notebook, {
+                    names.SLICE_HEALTH_ANNOTATION: DEGRADED,
+                    names.SLICE_HEALTH_REASON_ANNOTATION: reason,
+                })
+                self.recorder.eventf(
+                    notebook, events.TYPE_WARNING, "SliceDegraded",
+                    f"slice degraded ({reason}): {detail}")
+            return self._maybe_start_repair(notebook, reason, detail, key)
+
+        if state == DEGRADED:
+            ready = sum(1 for p in pods if _pod_ready(p))
+            if ready < slice_spec.num_workers:
+                # no explicit signal left, but the slice never got back to
+                # full readiness (e.g. a repair that replaced the pods with
+                # ones that wedge mid-boot): still degraded — a premature
+                # "recovered" here would reset the quarantine ladder and
+                # let a broken image restart-storm forever
+                reason = k8s.get_annotation(
+                    notebook, names.SLICE_HEALTH_REASON_ANNOTATION) or \
+                    "WorkersNotReady"
+                return self._maybe_start_repair(
+                    notebook, reason,
+                    f"{ready}/{slice_spec.num_workers} workers ready", key)
+            # transient — recovered without a repair (e.g. node flapped
+            # back inside the grace window)
+            self._patch(notebook, {
+                names.SLICE_HEALTH_ANNOTATION: None,
+                names.SLICE_HEALTH_REASON_ANNOTATION: None,
+            })
+            self._reset_backoff(key)
+            self.recorder.eventf(notebook, events.TYPE_NORMAL,
+                                 "SliceRecovered",
+                                 "slice healthy again without repair")
+        return None
+
+    # ---------------------------------------------------------- detection
+    def _detect(self, notebook: dict,
+                pods: list[dict]) -> list[tuple[str, str]]:
+        """Scan the slice's workers and their nodes. Returns
+        [(reason, detail), ...]; empty = no problem. Pods still booting
+        (no explicit Ready=False) are NOT problems — boot is the core
+        reconciler's business, and flagging it would roll freshly-created
+        slices forever."""
+        problems: list[tuple[str, str]] = []
+        nodes_seen: set[str] = set()
+        for pod in pods:
+            pod_name = k8s.name(pod)
+            node_name = k8s.get_in(pod, "spec", "nodeName")
+            if node_name and node_name not in nodes_seen:
+                nodes_seen.add(node_name)
+                node = self._reader().get_or_none("Node", "", node_name)
+                prob = node_problem(node)
+                if prob:
+                    problems.append(
+                        (prob[0], f"node {node_name}: {prob[1]}"))
+            for cond in k8s.get_in(pod, "status", "conditions",
+                                   default=[]) or []:
+                if cond.get("type") == "Ready" and \
+                        cond.get("status") == "False":
+                    problems.append(
+                        ("WorkerNotReady",
+                         f"worker {pod_name} Ready=False "
+                         f"({cond.get('reason', '')})"))
+            for cs in k8s.get_in(pod, "status", "containerStatuses",
+                                 default=[]) or []:
+                waiting = k8s.get_in(cs, "state", "waiting", "reason")
+                if waiting == "CrashLoopBackOff" or \
+                        int(cs.get("restartCount", 0)) >= CRASHLOOP_RESTARTS:
+                    problems.append(
+                        ("WorkerCrashLoop",
+                         f"worker {pod_name} container "
+                         f"{cs.get('name', '')} crashlooping"))
+        return problems
+
+    def _worker_replacement(self, notebook: dict, slice_spec: SliceSpec,
+                            pods: list[dict]) -> tuple[str, str] | None:
+        """Compare live pod UIDs against status.workerUIDs (stamped by the
+        core reconciler atomically with SliceReady=True). Partial overlap =
+        broken mesh; complete replacement = a consistent new mesh (restart
+        annotation, cull/resume, our own repair roll) that the core
+        refreshes the baseline for."""
+        baseline = k8s.get_in(notebook, "status", "workerUIDs") or {}
+        if not baseline or slice_spec.num_workers < 2:
+            return None  # single-host: a replaced pod IS a whole new mesh
+        ready = {k8s.name(p): k8s.uid(p) for p in pods if _pod_ready(p)}
+        if len(ready) < slice_spec.num_workers or \
+                set(ready) != set(baseline):
+            return None  # not fully re-formed: the readiness paths own this
+        changed = sorted(n for n in baseline if baseline[n] != ready[n])
+        if changed and len(changed) < len(baseline):
+            return ("WorkerReplaced",
+                    f"worker(s) {', '.join(changed)} restarted since mesh "
+                    f"formation; the mesh must re-form slice-atomically")
+        return None
+
+    # ------------------------------------------------------------- repair
+    def _maybe_start_repair(self, notebook: dict, reason: str, detail: str,
+                            key: tuple[str, str]) -> Result | None:
+        now = self.clock()
+        failures = self._failure_window(notebook, now)
+        if len(failures) >= self.config.slice_repair_max_failures:
+            return self._quarantine(notebook, reason, failures)
+        with self._lock:
+            not_before = self._not_before.get(key, 0.0)
+        if now < not_before:
+            return Result(requeue_after=max(not_before - now, 0.01))
+        # start: hold the slice at 0 via the scale-down annotation; the
+        # core reconciler scales the one StatefulSet (slice-atomic by
+        # construction), and Pod DELETED events drive the next phase
+        self._patch(notebook, {
+            names.SLICE_HEALTH_ANNOTATION: REPAIRING,
+            names.SLICE_HEALTH_REASON_ANNOTATION: reason,
+            names.REPAIR_SCALE_DOWN_ANNOTATION: "true",
+            names.REPAIR_STARTED_AT_ANNOTATION: "%.3f" % now,
+        })
+        self.repairs_total.inc({"namespace": key[0], "reason": reason})
+        self.recorder.eventf(
+            notebook, events.TYPE_NORMAL, "SliceRepairStarted",
+            f"slice-atomic repair: rolling StatefulSet 0 -> full "
+            f"({reason}: {detail})")
+        return Result(requeue_after=self.config.slice_repair_poll_s)
+
+    def _continue_repair(self, notebook: dict, slice_spec: SliceSpec,
+                         problems: list, pods: list[dict],
+                         key: tuple[str, str]) -> Result | None:
+        now = self.clock()
+        started_raw = k8s.get_annotation(notebook,
+                                         names.REPAIR_STARTED_AT_ANNOTATION)
+        try:
+            started = float(started_raw) if started_raw else None
+        except (TypeError, ValueError):
+            started = None
+        if started is None:
+            # lost/corrupted start stamp (operator annotation edit, backup
+            # restore): re-stamp NOW so the timeout clock is bounded from
+            # here — without this the repair could poll forever, untimed,
+            # unquarantinable
+            started = now
+            self._patch(notebook, {
+                names.REPAIR_STARTED_AT_ANNOTATION: "%.3f" % now})
+        poll = Result(requeue_after=self.config.slice_repair_poll_s)
+        ns = key[0]
+
+        if now - started > self.config.slice_repair_timeout_s:
+            return self._repair_failed(notebook, key, now)
+
+        if k8s.get_annotation(notebook,
+                              names.REPAIR_SCALE_DOWN_ANNOTATION) is not None:
+            if pods:
+                return poll  # waiting for the slice-atomic reap
+            # all workers gone together — release the hold; the core
+            # reconciler scales straight back to the FULL worker count
+            self._patch(notebook,
+                        {names.REPAIR_SCALE_DOWN_ANNOTATION: None})
+            return poll
+
+        ready = sum(1 for p in pods if _pod_ready(p))
+        if ready >= slice_spec.num_workers and not problems:
+            duration = max(now - started, 0.0)
+            self.repair_duration.observe(duration, {"namespace": ns})
+            self._patch(notebook, {
+                names.SLICE_HEALTH_ANNOTATION: None,
+                names.SLICE_HEALTH_REASON_ANNOTATION: None,
+                names.REPAIR_STARTED_AT_ANNOTATION: None,
+            })
+            self._reset_backoff(key)
+            self.recorder.eventf(
+                notebook, events.TYPE_NORMAL, "SliceRepaired",
+                f"all {slice_spec.num_workers} workers ready again "
+                f"after {duration:.1f}s")
+            return None
+        return poll
+
+    def _repair_failed(self, notebook: dict, key: tuple[str, str],
+                       now: float) -> Result | None:
+        """Repair timed out: record the failure in the sliding window and
+        either quarantine (window full) or fall back to Degraded for the
+        next backed-off attempt."""
+        reason = k8s.get_annotation(
+            notebook, names.SLICE_HEALTH_REASON_ANNOTATION) or "RepairTimeout"
+        failures = self._failure_window(notebook, now)
+        failures.append(now)
+        self.recorder.eventf(
+            notebook, events.TYPE_WARNING, "SliceRepairFailed",
+            f"repair did not converge within "
+            f"{self.config.slice_repair_timeout_s:.0f}s "
+            f"(failure {len(failures)}/"
+            f"{self.config.slice_repair_max_failures} in window)")
+        if len(failures) >= self.config.slice_repair_max_failures:
+            return self._quarantine(notebook, reason, failures)
+        self._patch(notebook, {
+            names.SLICE_HEALTH_ANNOTATION: DEGRADED,
+            names.SLICE_HEALTH_REASON_ANNOTATION: reason,
+            names.REPAIR_SCALE_DOWN_ANNOTATION: None,
+            names.REPAIR_STARTED_AT_ANNOTATION: None,
+            names.REPAIR_FAILURES_ANNOTATION: _join_stamps(failures),
+        })
+        # decorrelated-jitter gate before the NEXT attempt — armed on
+        # failure (a successful repair resets it), so a wedged slice
+        # backs off instead of restart-storming
+        with self._lock:
+            self._not_before[key] = now + self._next_backoff_locked(key)
+        return Result(requeue_after=self.config.slice_repair_poll_s)
+
+    def _quarantine(self, notebook: dict, reason: str,
+                    failures: list[float]) -> None:
+        """Poison pill: stop repairing. The slice stays scaled up (a
+        broken-but-present slice is debuggable; an endless restart storm
+        is not) and nothing short of an operator deleting the quarantine
+        annotation resumes repairs."""
+        ns = k8s.namespace(notebook)
+        self._patch(notebook, {
+            names.SLICE_HEALTH_ANNOTATION: QUARANTINED,
+            names.SLICE_HEALTH_REASON_ANNOTATION: reason,
+            names.REPAIR_SCALE_DOWN_ANNOTATION: None,
+            names.REPAIR_STARTED_AT_ANNOTATION: None,
+            names.REPAIR_FAILURES_ANNOTATION: _join_stamps(failures),
+            names.QUARANTINE_ANNOTATION:
+                f"{k8s.now_iso()} {reason}: {len(failures)} failed "
+                f"repairs in window",
+        })
+        self.quarantines_total.inc({"namespace": ns})
+        self.recorder.eventf(
+            notebook, events.TYPE_WARNING, "SliceQuarantined",
+            f"{len(failures)} failed repairs inside "
+            f"{self.config.slice_repair_window_s:.0f}s — repairs stopped; "
+            f"clear the {names.QUARANTINE_ANNOTATION} annotation to resume")
+        return None
+
+    # ------------------------------------------------------------ helpers
+    def _failure_window(self, notebook: dict, now: float) -> list[float]:
+        raw = k8s.get_annotation(notebook,
+                                 names.REPAIR_FAILURES_ANNOTATION) or ""
+        stamps = []
+        for part in raw.split(","):
+            try:
+                stamps.append(float(part))
+            except ValueError:
+                continue
+        cutoff = now - self.config.slice_repair_window_s
+        return [s for s in stamps if s >= cutoff]
+
+    def _next_backoff_locked(self, key: tuple[str, str]) -> float:
+        base = self.config.slice_repair_backoff_base_s
+        cap = self.config.slice_repair_backoff_max_s
+        prev = self._backoff.get(key, base)
+        delay = min(cap, self._rng.uniform(base, max(prev * 3, base)))
+        self._backoff[key] = delay
+        return delay
+
+    def _reset_backoff(self, key: tuple[str, str]) -> None:
+        with self._lock:
+            self._backoff.pop(key, None)
+            self._not_before.pop(key, None)
+
+    def _patch(self, notebook: dict, annotations: dict,
+               only_if_changed: bool = False) -> None:
+        if only_if_changed and all(
+                k8s.get_annotation(notebook, k) == v
+                for k, v in annotations.items()):
+            return
+        from ..cluster import errors
+        try:
+            self.client.patch(api.KIND, k8s.namespace(notebook),
+                              k8s.name(notebook),
+                              {"metadata": {"annotations": annotations}})
+        except errors.NotFoundError:
+            pass  # deleted mid-flight; the DELETE event cleans us up
+
+
+def _pod_ready(pod: dict) -> bool:
+    return k8s.condition_true(pod, "Ready")
+
+
+def _join_stamps(stamps: list[float]) -> str:
+    return ",".join("%.3f" % s for s in stamps)

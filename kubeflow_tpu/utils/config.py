@@ -62,6 +62,22 @@ class ControllerConfig:
     # dispatch worker-pool size (controller-runtime MaxConcurrentReconciles;
     # 1 = the classic single dispatch thread)
     max_concurrent_reconciles: int = 4
+    # slice health & repair controller (controllers/slicerepair.py):
+    # node-preemption-aware slice-atomic recovery with poison-pill quarantine
+    enable_slice_repair: bool = True
+    # decorrelated-jitter backoff between repair attempts of one slice
+    slice_repair_backoff_base_s: float = 0.5
+    slice_repair_backoff_max_s: float = 30.0
+    # a repair not completing (all workers Ready again) within this bound
+    # counts as a FAILED repair
+    slice_repair_timeout_s: float = 300.0
+    # poison pill: this many FAILED repairs inside the sliding window →
+    # Quarantined (stop repairing until an operator clears the annotation)
+    slice_repair_max_failures: int = 3
+    slice_repair_window_s: float = 900.0
+    # safety-net requeue while a repair phase waits on pod churn (the state
+    # machine is otherwise event-driven off the Pod/Node watches)
+    slice_repair_poll_s: float = 0.25
     # TPU-native
     tpu_default_image: str = "us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest"
     image_swap_map: dict = field(default_factory=dict)  # cuda image → jax/libtpu image
@@ -92,6 +108,19 @@ class ControllerConfig:
             leader_renew_period_s=float(env.get("LEADER_RENEW_PERIOD", "2")),
             max_concurrent_reconciles=int(
                 env.get("MAX_CONCURRENT_RECONCILES", "4")),
+            enable_slice_repair=_env_bool("ENABLE_SLICE_REPAIR", True),
+            slice_repair_backoff_base_s=float(
+                env.get("SLICE_REPAIR_BACKOFF_BASE", "0.5")),
+            slice_repair_backoff_max_s=float(
+                env.get("SLICE_REPAIR_BACKOFF_MAX", "30")),
+            slice_repair_timeout_s=float(
+                env.get("SLICE_REPAIR_TIMEOUT", "300")),
+            slice_repair_max_failures=int(
+                env.get("SLICE_REPAIR_MAX_FAILURES", "3")),
+            slice_repair_window_s=float(
+                env.get("SLICE_REPAIR_WINDOW", "900")),
+            slice_repair_poll_s=float(
+                env.get("SLICE_REPAIR_POLL", "0.25")),
             tpu_default_image=env.get(
                 "TPU_NOTEBOOK_IMAGE",
                 "us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest"),

@@ -160,6 +160,16 @@ def set_controller_reference(owner: Obj, controlled: Obj) -> None:
     refs.append(ref)
 
 
+def condition_true(obj: Obj, cond_type: str) -> bool:
+    """``status.conditions`` has ``cond_type`` with status "True" — THE
+    readiness predicate (Pod Ready, Node Ready, Notebook SliceReady…);
+    one definition so no two controllers can disagree about what ready
+    means."""
+    return any(c.get("type") == cond_type and c.get("status") == "True"
+               for c in get_in(obj, "status", "conditions",
+                               default=[]) or [])
+
+
 def is_owned_by(obj: Obj, owner_uid: str) -> bool:
     return any(r.get("uid") == owner_uid
                for r in get_in(obj, "metadata", "ownerReferences", default=[]) or [])

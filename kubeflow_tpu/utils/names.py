@@ -55,6 +55,38 @@ TPU_ORIGINAL_IMAGE_ANNOTATION = "tpu.kubeflow.org/original-image"
 SERVING_PORT_ANNOTATION = "tpu.kubeflow.org/serving-port"
 SERVING_REQUESTS_OBSERVED_ANNOTATION = \
     "tpu.kubeflow.org/serving-requests-observed"
+# --- slice health & repair (controllers/slicerepair.py) ---
+# current slice health state: "Degraded" | "Repairing" | "Quarantined";
+# absent = healthy. The repair controller owns these; the core reconciler
+# renders them into Slice* status conditions.
+SLICE_HEALTH_ANNOTATION = "tpu.kubeflow.org/slice-health"
+SLICE_HEALTH_REASON_ANNOTATION = "tpu.kubeflow.org/slice-health-reason"
+# present while a slice-atomic repair holds the StatefulSet at replicas=0;
+# the core reconciler's desired_replicas honors it (one writer of replicas,
+# so the slice is only ever observed at 0 or full — never partial)
+REPAIR_SCALE_DOWN_ANNOTATION = "tpu.kubeflow.org/repair-scale-down"
+# epoch timestamps of FAILED repairs (comma-joined) — the sliding window
+# the quarantine threshold counts; persisted so a controller restart
+# cannot forget a poison pill in progress
+REPAIR_FAILURES_ANNOTATION = "tpu.kubeflow.org/repair-failures"
+REPAIR_STARTED_AT_ANNOTATION = "tpu.kubeflow.org/repair-started-at"
+# poison-pill marker: set when K repairs failed inside the window; the
+# repair controller NEVER clears it — an operator must delete the
+# annotation to resume repairs (see ARCHITECTURE.md quarantine runbook)
+QUARANTINE_ANNOTATION = "tpu.kubeflow.org/quarantined"
+# repair bookkeeping never propagates to the StatefulSet/pod template
+# (it would churn the template and defeat drift gating)
+SLICE_REPAIR_ANNOTATIONS = frozenset({
+    SLICE_HEALTH_ANNOTATION, SLICE_HEALTH_REASON_ANNOTATION,
+    REPAIR_SCALE_DOWN_ANNOTATION, REPAIR_FAILURES_ANNOTATION,
+    REPAIR_STARTED_AT_ANNOTATION, QUARANTINE_ANNOTATION,
+})
+# GKE's impending-node-termination notice taint (maintenance/preemption):
+# the node keeps running for a grace period, then goes away — the repair
+# controller treats the notice itself as Degraded and rolls the slice off
+# the node before the termination hits mid-step
+PREEMPTION_TAINT_KEY = "cloud.google.com/impending-node-termination"
+
 # where the apiserver facade's service-proxy subresource forwards: in the
 # in-process cluster pods hold no real sockets, so the composition root
 # (or a test) annotates the Service with the actual listener's base URL

@@ -139,7 +139,8 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
              fault_rate: float = 0.0, fault_plan: str | None = None,
              fault_seed: int | None = 7,
              list_page_size: int | None = None,
-             max_full_scans: int | None = None) -> int:
+             max_full_scans: int | None = None,
+             preempt_rate: float = 0.0) -> int:
     """Controller wire-cost measurement: the full controller stack runs
     over a real HTTP apiserver while the load generator drives the store
     directly, so ``rest_client_requests_total`` counts ONLY controller
@@ -159,7 +160,16 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
     ``list_page_size`` pages every controller LIST through
     ``limit``/``continue`` chunks of that size (exercises pagination on
     the wire); ``max_full_scans`` bounds ``cache_full_scans_total`` — 0
-    asserts the reconcile hot path never walks a whole cache kind."""
+    asserts the reconcile hot path never walks a whole cache kind.
+
+    ``preempt_rate`` preempts the node under worker 0 of that fraction of
+    the fleet mid-fan-out (each target's node is killed the moment its
+    slice first reaches SliceReady — the worst time). The run then also
+    fails on: any StatefulSet ever OBSERVED at a partial replica count
+    (slice atomicity — replicas must only ever be 0 or the full worker
+    count), any preempted slice not repaired back to SliceReady with its
+    health state cleared, and any slice quarantined by a single
+    preemption."""
     import tempfile
 
     from kubeflow_tpu.api import types as api
@@ -221,9 +231,47 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
         # watch — a tight full-LIST poll at a 500-notebook fan-out costs
         # ~17 ms/scan of deep copies and perturbs the very system under
         # measurement (it pins a core against the controllers' GIL time)
+        import math
         import threading
+
+        from kubeflow_tpu.cluster.kubelet import kill_node
+        from kubeflow_tpu.tpu import topology
         ready_at: dict[str, float] = {}
         all_ready = threading.Event()
+        # slice-atomicity observer: EVERY StatefulSet write the apiserver
+        # fans out must show replicas at 0 or the full worker count —
+        # a partial value here is a broken repair/scale path, no matter
+        # how briefly it existed
+        full_workers = topology.parse_short_name(accelerator).num_workers
+        partial_observed: list[tuple[str, object]] = []
+
+        def on_sts_event(ev):
+            if ev.type == "DELETED":
+                return
+            replicas = (ev.obj.get("spec") or {}).get("replicas")
+            if replicas not in (0, full_workers):
+                partial_observed.append(
+                    (ev.obj["metadata"]["name"], replicas))
+        store.watch("StatefulSet", on_sts_event, namespace=namespace)
+
+        # node-preemption injection: the first ceil(count*rate) notebooks
+        # lose the node under worker 0 the moment their slice first turns
+        # Ready — mid-fan-out, while the controllers are busiest
+        preempt_targets = {f"loadtest-nb-{i}"
+                           for i in range(math.ceil(count * preempt_rate))} \
+            if preempt_rate > 0 else set()
+        preempted: set[str] = set()
+
+        def _preempt(name: str) -> None:
+            for pod in store.list("Pod", namespace,
+                                  {names.NOTEBOOK_NAME_LABEL: name}):
+                if pod.get("metadata", {}).get("labels", {}).get(
+                        "apps.kubernetes.io/pod-index") == "0":
+                    node = (pod.get("spec") or {}).get("nodeName")
+                    if node:
+                        kill_node(store, node)
+                        preempted.add(name)
+                    return
 
         def on_event(ev):
             nb = ev.obj
@@ -232,6 +280,8 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
                     (api.get_condition(nb, api.CONDITION_SLICE_READY)
                      or {}).get("status") == "True":
                 ready_at[name] = time.monotonic()
+                if name in preempt_targets and name not in preempted:
+                    _preempt(name)
                 if len(ready_at) >= count:
                     all_ready.set()
         store.watch(api.KIND, on_event, namespace=namespace)
@@ -248,7 +298,44 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
                 name, namespace,
                 annotations={names.TPU_ACCELERATOR_ANNOTATION: accelerator}))
         all_ready.wait(timeout)
+        # preempted slices must come back: repaired slice-atomically to
+        # SliceReady with the health state cleared and NO quarantine (a
+        # single preemption is normal fleet weather, not a poison pill)
+        stuck_repairs: list[str] = []
+        quarantined: list[str] = []
+        if preempted:
+            deadline = t0 + timeout
+
+            def _unrepaired() -> list[str]:
+                out = []
+                for name in sorted(preempted):
+                    nb = store.get_or_none(api.KIND, namespace, name)
+                    if nb is None:
+                        out.append(name)
+                        continue
+                    anns = nb.get("metadata", {}).get("annotations", {}) or {}
+                    cond = (api.get_condition(nb, api.CONDITION_SLICE_READY)
+                            or {})
+                    if cond.get("status") != "True" or \
+                            anns.get(names.SLICE_HEALTH_ANNOTATION):
+                        out.append(name)
+                return out
+
+            while time.monotonic() < deadline:
+                stuck_repairs = _unrepaired()
+                if not stuck_repairs:
+                    break
+                time.sleep(0.05)
+            else:
+                stuck_repairs = _unrepaired()
+            for name in sorted(preempted):
+                nb = store.get_or_none(api.KIND, namespace, name)
+                anns = (nb or {}).get("metadata", {}).get("annotations",
+                                                          {}) or {}
+                if anns.get(names.QUARANTINE_ANNOTATION):
+                    quarantined.append(name)
         store.unwatch(on_event)
+        store.unwatch(on_sts_event)
         ready = len(ready_at)
         wall = time.monotonic() - t0
         # one metrics scrape, so the notebook_running LIST cost is included
@@ -265,6 +352,10 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
             injected = plan.injected()
             faults_note = (f"  injected faults: {plan.injected_total()} "
                            f"({dict(sorted(injected.items()))})")
+        if preempted:
+            repairs = metrics.counter("slice_repairs_total", "").total()
+            faults_note += (f"  preempted nodes: {len(preempted)}  "
+                            f"slice repairs: {repairs:.0f}")
         full_scans = metrics.counter("cache_full_scans_total", "").total()
         index_lookups = metrics.counter("cache_index_lookups_total",
                                         "").total()
@@ -288,6 +379,36 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
             print(f"FAIL: {full_scans:.0f} cache full scans exceed bound "
                   f"{max_full_scans} (an unindexed hot-path LIST crept in)")
             return 1
+        if partial_observed:
+            sample = partial_observed[:5]
+            print(f"FAIL: {len(partial_observed)} partial-slice replica "
+                  f"states observed (must only ever be 0 or "
+                  f"{full_workers}): {sample}")
+            return 1
+        if stuck_repairs:
+            print(f"FAIL: {len(stuck_repairs)} preempted notebook(s) not "
+                  f"repaired back to SliceReady: {stuck_repairs[:5]}")
+            return 1
+        if quarantined:
+            print(f"FAIL: single preemption quarantined {quarantined[:5]} "
+                  f"(poison pill must need repeated FAILED repairs)")
+            return 1
+        if preempt_rate > 0 and not preempted:
+            # vacuous-pass guard: a broken pod→node binding (or a drifted
+            # worker-0 lookup) must fail the run, not silently skip every
+            # repair assertion below
+            print("FAIL: --preempt-rate set but no node was ever preempted "
+                  "(worker-0 pods had no node binding?)")
+            return 1
+        if preempted:
+            repairs = metrics.counter("slice_repairs_total", "").total()
+            if repairs < len(preempted):
+                # recovery without enough slice rolls means some slice
+                # self-healed pod-by-pod — Ready pods, broken JAX mesh
+                print(f"FAIL: {len(preempted)} preemptions but only "
+                      f"{repairs:.0f} slice-atomic repairs (a worker was "
+                      f"replaced without re-forming the mesh)")
+                return 1
         if audit_path is not None:
             duplicates = audit_duplicate_creates(audit_path)
             if duplicates:
@@ -354,6 +475,12 @@ def main() -> int:
                     help="with --wire: fail if cache_full_scans_total "
                          "exceeds this (0 = assert the reconcile hot path "
                          "never walks a whole cache kind)")
+    ap.add_argument("--preempt-rate", type=float, default=0.0,
+                    help="with --wire: preempt the node under worker 0 of "
+                         "this fraction of the fleet as each slice first "
+                         "turns Ready; the run fails on any partially "
+                         "scaled StatefulSet, unrepaired slice, or "
+                         "quarantine from a single preemption")
     args = ap.parse_args()
     if args.emit_yaml:
         try:
@@ -373,7 +500,8 @@ def main() -> int:
                         fault_plan=args.fault_plan,
                         fault_seed=args.fault_seed,
                         list_page_size=args.list_page_size,
-                        max_full_scans=args.max_full_scans)
+                        max_full_scans=args.max_full_scans,
+                        preempt_rate=args.preempt_rate)
     return run_inprocess(args.count, args.namespace, args.accelerator,
                          args.timeout, server=args.server,
                          workers=args.workers)

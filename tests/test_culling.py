@@ -168,6 +168,65 @@ def test_enable_culling_gate(store):
     assert "culling-controller" in mgr._reconcilers
 
 
+# ---------------------------------------------------- repair-aware idle clock
+
+def test_unreachable_probe_pauses_idle_clock_during_repair(culling_world):
+    """While the slice is Degraded/Repairing/Quarantined, an unreachable
+    Jupyter probe is EXPECTED (workers are being rolled): the idle clock
+    must pause — never advance toward a cull — and resume accruing only
+    once the repair state clears."""
+    store, mgr, clock, jupyter, metrics, cfg = culling_world
+    store.create(api.new_notebook("nb", "ns"))
+    drain(mgr, include_delayed_under=0.1)
+    jupyter.activity = JupyterActivity(kernels=[{
+        "execution_state": "idle", "last_activity": format_time(clock())}])
+    tick(store, mgr, clock, 2)
+
+    # repair starts; Jupyter goes dark for 2+ hours of wall time
+    store.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+        names.SLICE_HEALTH_ANNOTATION: "Repairing"}}})
+    jupyter.activity = JupyterActivity(kernels=None, terminals=None)
+    tick(store, mgr, clock, 61)
+    tick(store, mgr, clock, 61)  # far past the 60-min cull threshold
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is None
+    assert k8s.get_annotation(nb, names.LAST_ACTIVITY_ANNOTATION) is not None
+
+    # repair over, probe still unreachable → idleness resumes from the
+    # frozen point and the normal cull path applies again
+    store.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+        names.SLICE_HEALTH_ANNOTATION: None}}})
+    tick(store, mgr, clock, 61)
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is not None
+
+
+def test_missing_worker0_during_repair_does_not_strip_activity(culling_world):
+    """Mid-repair scale-down there are NO pods; the culler must pause
+    instead of stripping the activity annotations (a strip would reset
+    accumulated idleness via re-initialization)."""
+    store, mgr, clock, jupyter, metrics, cfg = culling_world
+    store.create(api.new_notebook("nb", "ns"))
+    drain(mgr, include_delayed_under=0.1)
+    tick(store, mgr, clock, 2)
+    nb = store.get(api.KIND, "ns", "nb")
+    before = k8s.get_annotation(nb, names.LAST_ACTIVITY_ANNOTATION)
+    assert before is not None
+
+    # the repair controller's scale-down hold: core reconciler scales the
+    # slice STS to 0, the sim reaps every pod
+    store.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+        names.SLICE_HEALTH_ANNOTATION: "Repairing",
+        names.REPAIR_SCALE_DOWN_ANNOTATION: "true"}}})
+    drain(mgr, include_delayed_under=0.1)
+    assert store.list("Pod", "ns", {names.NOTEBOOK_NAME_LABEL: "nb"}) == []
+
+    tick(store, mgr, clock, 61)
+    nb = store.get(api.KIND, "ns", "nb")
+    assert k8s.get_annotation(nb, names.LAST_ACTIVITY_ANNOTATION) is not None
+    assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is None
+
+
 # ------------------------------------------------------ serving-aware culling
 class FakeServing:
     """Switchable serving-endpoint counter (None = unreachable)."""

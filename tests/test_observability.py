@@ -184,6 +184,40 @@ def test_manager_parks_until_leader():
         mgr.stop()
 
 
+# ------------------------------------------------- slice repair metric families
+
+def test_slice_repair_metric_families_exported():
+    """The four slice-health families are registered by the repair
+    controller and expose with their label sets (namespace/reason for
+    repairs, namespace for duration+quarantines, namespace/state for the
+    degraded gauge — the gauge computed at scrape time from the Notebook
+    population, like notebook_running)."""
+    from kubeflow_tpu.controllers.slicerepair import SliceRepairReconciler
+
+    store = ClusterStore()
+    metrics = MetricsRegistry()
+    rec = SliceRepairReconciler(store, ControllerConfig(), metrics)
+    store.create(api.new_notebook("nb", "ns", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-4",
+        names.SLICE_HEALTH_ANNOTATION: "Degraded"}))
+    # the label shapes the reconciler writes (pinned against the real
+    # repair flow in tests/test_slice_repair.py)
+    rec.repairs_total.inc({"namespace": "ns", "reason": "NodeNotReady"})
+    rec.repair_duration.observe(1.5, {"namespace": "ns"})
+    rec.quarantines_total.inc({"namespace": "ns"})
+    text = metrics.expose()
+    assert 'slice_repairs_total{namespace="ns",reason="NodeNotReady"} 1' \
+        in text
+    assert 'slice_repair_duration_seconds_count{namespace="ns"} 1' in text
+    assert 'slice_quarantines_total{namespace="ns"} 1' in text
+    assert 'slice_degraded{namespace="ns",state="Degraded"} 1' in text
+    # recovery drains the gauge to zero WITHOUT dropping the label sample
+    store.patch(api.KIND, "ns", "nb", {"metadata": {"annotations": {
+        names.SLICE_HEALTH_ANNOTATION: None}}})
+    text = metrics.expose()
+    assert 'slice_degraded{namespace="ns",state="Degraded"} 0' in text
+
+
 # ------------------------------------------------------------ health server
 
 def _get(url):

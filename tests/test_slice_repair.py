@@ -1,0 +1,477 @@
+"""Slice health & repair controller: node-preemption-aware slice-atomic
+recovery + poison-pill quarantine (controllers/slicerepair.py) and the
+kubelet simulator's node lifecycle (cluster/kubelet.py)."""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster import kubelet
+from kubeflow_tpu.cluster.kubelet import (StatefulSetSimulator, kill_node,
+                                          preempt_node)
+from kubeflow_tpu.controllers import (Manager, NotebookReconciler,
+                                      SliceRepairReconciler)
+from kubeflow_tpu.controllers.slicerepair import (DEGRADED, QUARANTINED,
+                                                  REPAIRING, slice_health)
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+NS = "repair-ns"
+
+
+def fast_config(**overrides) -> ControllerConfig:
+    defaults = dict(slice_repair_backoff_base_s=0.01,
+                    slice_repair_backoff_max_s=0.05,
+                    slice_repair_poll_s=0.02,
+                    slice_repair_timeout_s=5.0,
+                    slice_repair_max_failures=3,
+                    slice_repair_window_s=60.0)
+    defaults.update(overrides)
+    return ControllerConfig(**defaults)
+
+
+class RepairWorld:
+    """Started manager + core/repair reconcilers + kubelet sim with node
+    lifecycle. Wall-clock driven (the node grace window and repair phases
+    are timed), with tight in-process timings."""
+
+    def __init__(self, store, config=None, ready_hook=None):
+        self.store = store
+        self.config = config or fast_config()
+        self.metrics = MetricsRegistry()
+        self.mgr = Manager(store)
+        NotebookReconciler(store, self.config, self.metrics).setup(self.mgr)
+        self.repairer = SliceRepairReconciler(store, self.config,
+                                             self.metrics)
+        self.repairer.setup(self.mgr)
+        self.sim = StatefulSetSimulator(store, boot_delay_s=0.0,
+                                        node_grace_s=0.05,
+                                        ready_hook=ready_hook)
+        self.sim.setup(self.mgr)
+        self.replicas_observed = set()
+        store.watch("StatefulSet", self._observe_sts)
+        self.mgr.start()
+
+    def _observe_sts(self, ev):
+        if ev.type != "DELETED":
+            self.replicas_observed.add(
+                k8s.get_in(ev.obj, "spec", "replicas"))
+
+    def create(self, name="nb", accelerator="v5e-16"):
+        self.store.create(api.new_notebook(name, NS, annotations={
+            names.TPU_ACCELERATOR_ANNOTATION: accelerator}))
+
+    def notebook(self, name="nb"):
+        return self.store.get(api.KIND, NS, name)
+
+    def slice_ready(self, name="nb"):
+        nb = self.store.get_or_none(api.KIND, NS, name)
+        cond = api.get_condition(nb, api.CONDITION_SLICE_READY) if nb else None
+        return bool(cond and cond.get("status") == "True")
+
+    def health(self, name="nb"):
+        return slice_health(self.notebook(name))
+
+    def pods(self, name="nb"):
+        return sorted(self.store.list(
+            "Pod", NS, {names.NOTEBOOK_NAME_LABEL: name}), key=k8s.name)
+
+    def wait(self, predicate, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.01)
+        return bool(predicate())
+
+    def wait_ready(self, name="nb", timeout=10.0):
+        assert self.wait(lambda: self.slice_ready(name), timeout), \
+            f"{name} never reached SliceReady"
+
+    def stop(self):
+        self.mgr.stop()
+
+
+@pytest.fixture
+def world(store):
+    w = RepairWorld(store)
+    yield w
+    w.stop()
+
+
+# --------------------------------------------------------- repair happy path
+
+def test_node_death_triggers_slice_atomic_repair(world):
+    """Node NotReady under one worker → the WHOLE slice is rolled 0 → N
+    (replicas never partial), ordinals/hostnames preserved, workers land
+    on fresh nodes, SliceReady recovers, health state clears."""
+    world.create()
+    world.wait_ready()
+    names_before = [k8s.name(p) for p in world.pods()]
+    hostnames_before = [p["spec"]["hostname"] for p in world.pods()]
+    victim_node = world.pods()[1]["spec"]["nodeName"]
+
+    kill_node(world.store, victim_node)
+    assert world.wait(lambda: world.metrics.counter(
+        "slice_repairs_total", "").total() >= 1), "repair never started"
+    assert world.wait(
+        lambda: world.slice_ready() and world.health() is None), \
+        "slice never repaired back to ready"
+
+    pods = world.pods()
+    assert [k8s.name(p) for p in pods] == names_before
+    assert [p["spec"]["hostname"] for p in pods] == hostnames_before
+    assert all(p["spec"]["nodeName"] != victim_node for p in pods)
+    # slice atomicity: every observed replica value is 0 or full — never
+    # a partial count (the acceptance invariant)
+    assert world.replicas_observed <= {0, 4}
+    # the health-clear patch precedes the SliceRepaired event write, so
+    # poll for the trail rather than snapshotting it
+    wanted = {"SliceDegraded", "SliceRepairStarted", "SliceRepaired"}
+    assert world.wait(lambda: wanted <= {
+        e["reason"] for e in world.store.list("Event", NS)}), \
+        f"event trail incomplete: " \
+        f"{ {e['reason'] for e in world.store.list('Event', NS)} }"
+    assert world.metrics.histogram(
+        "slice_repair_duration_seconds", "").total_count() >= 1
+
+
+def test_preemption_notice_taint_triggers_repair(world):
+    """The impending-termination NOTICE alone (pods still Ready) is
+    Degraded: the slice must roll off the node before termination lands."""
+    world.create()
+    world.wait_ready()
+    victim_node = world.pods()[0]["spec"]["nodeName"]
+    preempt_node(world.store, victim_node)
+    assert world.wait(lambda: world.metrics.counter(
+        "slice_repairs_total", "").get(
+            {"namespace": NS, "reason": "NodePreempted"}) >= 1)
+    assert world.wait(
+        lambda: world.slice_ready() and world.health() is None)
+    assert all(p["spec"]["nodeName"] != victim_node for p in world.pods())
+    assert world.replicas_observed <= {0, 4}
+    # one preemption is normal fleet weather: no quarantine
+    assert k8s.get_annotation(world.notebook(),
+                              names.QUARANTINE_ANNOTATION) is None
+
+
+def test_silently_replaced_worker_triggers_slice_roll(world):
+    """A worker replaced behind the controller's back (node-level self-heal
+    finishing before any event was observed): every pod shows Ready, but
+    the restarted worker's JAX client is orphaned. The UID baseline stamped
+    at mesh formation (status.workerUIDs) catches it and the slice is
+    rolled — all workers replaced together, not just the dead one."""
+    world.create()
+    world.wait_ready()
+    uid_before = {k8s.name(p): k8s.uid(p) for p in world.pods()}
+    world.store.delete("Pod", NS, "nb-2")  # sim recreates it, same node
+    assert world.wait(lambda: world.metrics.counter(
+        "slice_repairs_total", "").get(
+            {"namespace": NS, "reason": "WorkerReplaced"}) >= 1), \
+        "replacement never detected"
+    assert world.wait(lambda: world.slice_ready()
+                      and world.health() is None)
+    uid_after = {k8s.name(p): k8s.uid(p) for p in world.pods()}
+    assert set(uid_after) == set(uid_before)
+    assert all(uid_after[n] != uid_before[n] for n in uid_before)
+    assert world.replicas_observed <= {0, 4}
+
+
+def test_full_slice_replacement_is_a_consistent_new_mesh(world):
+    """The restart annotation bounces EVERY worker together — a complete
+    UID change is a consistent new mesh and must NOT trigger a repair
+    (otherwise every user restart would double-roll the slice)."""
+    world.create()
+    world.wait_ready()
+    world.store.patch(api.KIND, NS, "nb", {"metadata": {"annotations": {
+        names.RESTART_ANNOTATION: "true"}}})
+    # every worker comes back (new UIDs) with no repair triggered
+    assert world.wait(lambda: len(world.pods()) == 4
+                      and world.slice_ready())
+    time.sleep(0.3)  # give a spurious repair time to appear
+    assert world.metrics.counter("slice_repairs_total", "").total() == 0
+    assert world.health() is None
+
+
+def test_status_conditions_mirror_health_state(world):
+    """SliceDegraded/SliceRepairing/SliceQuarantined appear in status
+    alongside SliceReady once the repair machinery has touched the CR."""
+    world.create()
+    world.wait_ready()
+    # a watch sees EVERY status write — polling could miss the short
+    # Repairing window on a loaded box
+    seen = set()
+
+    def on_nb(ev):
+        if ev.type == "DELETED":
+            return
+        for cond_type in (api.CONDITION_SLICE_DEGRADED,
+                          api.CONDITION_SLICE_REPAIRING):
+            cond = api.get_condition(ev.obj, cond_type)
+            if cond and cond.get("status") == "True":
+                seen.add(cond_type)
+    world.store.watch(api.KIND, on_nb)
+    kill_node(world.store, world.pods()[0]["spec"]["nodeName"])
+    assert world.wait(
+        lambda: api.CONDITION_SLICE_REPAIRING in seen), \
+        f"SliceRepairing condition never True (saw {seen})"
+    assert world.wait(lambda: world.slice_ready() and world.health() is None)
+    world.store.unwatch(on_nb)
+
+
+# ----------------------------------------------------------------- quarantine
+
+@pytest.fixture
+def wedged_world(store):
+    """Pods never pass the readiness gate once ``allow["ok"]`` is False —
+    the crashlooping-image shape: every repair times out."""
+    allow = {"ok": True}
+    w = RepairWorld(store,
+                    config=fast_config(slice_repair_timeout_s=0.3,
+                                       slice_repair_max_failures=2),
+                    ready_hook=lambda pod: allow["ok"])
+    w.allow = allow
+    yield w
+    w.stop()
+
+
+def test_k_failed_repairs_quarantine_and_manual_clear(wedged_world):
+    w = wedged_world
+    w.create()
+    w.wait_ready()
+    w.allow["ok"] = False
+    # persistent signal: the notice taint stays until the repair rolls the
+    # pods off the node, so detection cannot race the kubelet's eviction
+    preempt_node(w.store, w.pods()[0]["spec"]["nodeName"])
+
+    # K=2 failed repairs inside the window → poison pill
+    assert w.wait(lambda: k8s.get_annotation(
+        w.notebook(), names.QUARANTINE_ANNOTATION) is not None,
+        timeout=20.0), "never quarantined"
+    assert w.health() == QUARANTINED
+    nb = w.notebook()
+    cond = api.get_condition(nb, api.CONDITION_SLICE_QUARANTINED)
+    assert cond and cond["status"] == "True"
+    assert w.metrics.counter("slice_quarantines_total", "").get(
+        {"namespace": NS}) == 1
+
+    # poison pill: NO further repair attempts while quarantined
+    repairs = w.metrics.counter("slice_repairs_total", "").total()
+    time.sleep(0.8)
+    assert w.metrics.counter("slice_repairs_total", "").total() == repairs
+    assert w.health() == QUARANTINED
+
+    # operator clears the annotation → repairs resume, window resets
+    w.allow["ok"] = True
+    w.store.patch(api.KIND, NS, "nb", {"metadata": {"annotations": {
+        names.QUARANTINE_ANNOTATION: None}}})
+    assert w.wait(lambda: w.slice_ready() and w.health() is None,
+                  timeout=20.0), "never recovered after quarantine clear"
+    nb = w.notebook()
+    assert k8s.get_annotation(nb, names.REPAIR_FAILURES_ANNOTATION) is None
+    reasons = {e["reason"] for e in w.store.list("Event", NS)}
+    assert {"SliceQuarantined", "SliceQuarantineCleared"} <= reasons
+    # the observed replica values stayed slice-atomic throughout the storm
+    assert w.replicas_observed <= {0, 4}
+
+
+def test_quarantine_survives_controller_restart(store):
+    """The poison pill rides annotations, not memory: a fresh manager must
+    not resume repairing a quarantined slice."""
+    w = RepairWorld(store, config=fast_config(slice_repair_timeout_s=0.2,
+                                              slice_repair_max_failures=1),
+                    ready_hook=lambda pod: False)
+    try:
+        store.create(api.new_notebook("nb", NS, annotations={
+            names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}))
+        # pods never become ready (wedged image); the persistent notice
+        # taint gives detection a deterministic signal
+        assert w.wait(lambda: len(w.pods()) == 4)
+        preempt_node(store, w.pods()[0]["spec"]["nodeName"])
+        assert w.wait(lambda: k8s.get_annotation(
+            w.notebook(), names.QUARANTINE_ANNOTATION) is not None,
+            timeout=20.0)
+    finally:
+        w.stop()
+    # new controller process, same cluster state
+    w2 = RepairWorld(store, config=fast_config(slice_repair_timeout_s=0.2,
+                                               slice_repair_max_failures=1))
+    try:
+        repairs = w2.metrics.counter("slice_repairs_total", "").total()
+        time.sleep(0.6)
+        assert w2.metrics.counter("slice_repairs_total", "").total() == \
+            repairs
+        assert w2.health() == QUARANTINED
+    finally:
+        w2.stop()
+
+
+# -------------------------------------------------------------------- backoff
+
+def test_repair_backoff_is_decorrelated_jitter_and_caps():
+    import random
+    rec = SliceRepairReconciler(
+        __import__("kubeflow_tpu.cluster.store",
+                   fromlist=["ClusterStore"]).ClusterStore(),
+        fast_config(slice_repair_backoff_base_s=0.5,
+                    slice_repair_backoff_max_s=4.0),
+        rng=random.Random(7))
+    key = (NS, "nb")
+    delays = [rec._next_backoff_locked(key) for _ in range(50)]
+    assert all(0.5 <= d <= 4.0 for d in delays), delays[:5]
+    # caps: the tail must sit AT the cap's reach, not grow unboundedly
+    assert max(delays) <= 4.0
+    # decorrelated: not a deterministic ladder
+    assert len({round(d, 6) for d in delays}) > 10
+    # reset starts the ladder over from base range
+    rec._reset_backoff(key)
+    assert rec._next_backoff_locked(key) <= 1.5
+
+
+# -------------------------------------------------------------- detection unit
+
+def test_detect_crashloop_and_node_states(store):
+    rec = SliceRepairReconciler(store, fast_config())
+    nb = api.new_notebook("nb", NS, annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"})
+
+    def pod(name, node=None, ready=None, waiting=None, restarts=0):
+        p = {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": name, "namespace": NS,
+                          "labels": {names.NOTEBOOK_NAME_LABEL: "nb"}},
+             "spec": {}, "status": {"conditions": [], "containerStatuses": []}}
+        if node:
+            p["spec"]["nodeName"] = node
+        if ready is not None:
+            p["status"]["conditions"] = [
+                {"type": "Ready", "status": "True" if ready else "False"}]
+        if waiting or restarts:
+            p["status"]["containerStatuses"] = [{
+                "name": "c", "restartCount": restarts,
+                "state": {"waiting": {"reason": waiting}} if waiting else {}}]
+        return p
+
+    # booting pod (no conditions): NOT a problem
+    assert rec._detect(nb, [pod("nb-0")]) == []
+    # explicit Ready=False: WorkerNotReady
+    assert rec._detect(nb, [pod("nb-0", ready=False)])[0][0] == \
+        "WorkerNotReady"
+    # crashloop via waiting reason and via restart count
+    assert rec._detect(nb, [pod("nb-0", waiting="CrashLoopBackOff")])[0][0] \
+        == "WorkerCrashLoop"
+    assert rec._detect(nb, [pod("nb-0", restarts=5)])[0][0] == \
+        "WorkerCrashLoop"
+    # node states need Node objects in the store
+    store.create({"apiVersion": "v1", "kind": "Node",
+                  "metadata": {"name": "n-ok"}, "spec": {},
+                  "status": {"conditions": [
+                      {"type": "Ready", "status": "True"}]}})
+    assert rec._detect(nb, [pod("nb-0", node="n-ok", ready=True)]) == []
+    kubelet.set_node_ready(store, "n-ok", False)
+    assert rec._detect(nb, [pod("nb-0", node="n-ok", ready=True)])[0][0] == \
+        "NodeNotReady"
+    store.create({"apiVersion": "v1", "kind": "Node",
+                  "metadata": {"name": "n-taint"}, "spec": {},
+                  "status": {"conditions": [
+                      {"type": "Ready", "status": "True"}]}})
+    kubelet.taint_node(store, "n-taint")
+    assert rec._detect(nb, [pod("nb-0", node="n-taint", ready=True)])[0][0] \
+        == "NodePreempted"
+    # node object gone entirely (the VM is deleted)
+    assert rec._detect(nb, [pod("nb-0", node="n-gone", ready=True)])[0][0] \
+        == "NodeGone"
+
+
+# ----------------------------------------------- kubelet node-lifecycle (sim)
+
+def test_sim_node_death_flips_pod_not_ready_then_evicts(store):
+    """Satellite: node NotReady propagates to pod Ready=False within one
+    reconcile tick and the pod is evicted after the grace window — so
+    SliceReady reacts to node death even WITHOUT the repair controller."""
+    from tests.conftest import drain
+    cfg = ControllerConfig(enable_slice_repair=False)
+    metrics = MetricsRegistry()
+    mgr = Manager(store)
+    NotebookReconciler(store, cfg, metrics).setup(mgr)
+    sim = StatefulSetSimulator(store, boot_delay_s=0.0, node_grace_s=0.15)
+    sim.setup(mgr)
+    store.create(api.new_notebook("nb", NS, annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}))
+    drain(mgr, include_delayed_under=0.1)
+    nb = store.get(api.KIND, NS, "nb")
+    assert api.get_condition(nb, api.CONDITION_SLICE_READY)["status"] == \
+        "True"
+    pods = store.list("Pod", NS, {names.NOTEBOOK_NAME_LABEL: "nb"})
+    victim = sorted(pods, key=k8s.name)[2]
+    kill_node(store, victim["spec"]["nodeName"])
+
+    # one drive of the IMMEDIATE queue only (no timed requeues — the
+    # eviction rides those): the pod flips Ready=False within one tick
+    drain(mgr)
+    pod = store.get("Pod", NS, k8s.name(victim))
+    ready = [c for c in pod["status"]["conditions"]
+             if c["type"] == "Ready"]
+    assert ready and ready[0]["status"] == "False"
+    assert ready[0]["reason"] == "NodeNotReady"
+    # ...and SliceReady mirrors the degradation
+    nb = store.get(api.KIND, NS, "nb")
+    assert api.get_condition(nb, api.CONDITION_SLICE_READY)["status"] == \
+        "False"
+
+    # after the grace window the pod is EVICTED and recreated on a fresh
+    # node, same name/ordinal
+    time.sleep(0.2)
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        drain(mgr, include_delayed_under=0.1)
+        pod = store.get_or_none("Pod", NS, k8s.name(victim))
+        if pod is not None and \
+                pod["spec"]["nodeName"] != victim["spec"]["nodeName"]:
+            break
+        time.sleep(0.02)
+    pod = store.get("Pod", NS, k8s.name(victim))
+    assert pod["spec"]["nodeName"] != victim["spec"]["nodeName"]
+    assert k8s.get_label(pod, "apps.kubernetes.io/pod-index") == \
+        k8s.get_label(victim, "apps.kubernetes.io/pod-index")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        drain(mgr, include_delayed_under=0.1)
+        nb = store.get(api.KIND, NS, "nb")
+        if api.get_condition(nb,
+                             api.CONDITION_SLICE_READY)["status"] == "True":
+            break
+        time.sleep(0.02)
+    assert api.get_condition(nb, api.CONDITION_SLICE_READY)["status"] == \
+        "True"
+
+
+def test_sim_preemption_notice_blocks_new_bindings_only(store):
+    """A NoSchedule notice taint cordons the node (new pods bind
+    elsewhere) but running pods stay Ready — the kubelet does not evict
+    for a notice."""
+    from tests.conftest import drain
+    cfg = ControllerConfig(enable_slice_repair=False)
+    mgr = Manager(store)
+    NotebookReconciler(store, cfg, MetricsRegistry()).setup(mgr)
+    sim = StatefulSetSimulator(store, boot_delay_s=0.0, node_grace_s=0.1)
+    sim.setup(mgr)
+    store.create(api.new_notebook("nb", NS, annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}))
+    drain(mgr, include_delayed_under=0.1)
+    pod = sorted(store.list("Pod", NS, {names.NOTEBOOK_NAME_LABEL: "nb"}),
+                 key=k8s.name)[0]
+    node = pod["spec"]["nodeName"]
+    preempt_node(store, node)
+    drain(mgr, include_delayed_under=0.05)
+    # still Ready, still on the tainted node
+    pod = store.get("Pod", NS, k8s.name(pod))
+    assert pod["spec"]["nodeName"] == node
+    assert any(c["type"] == "Ready" and c["status"] == "True"
+               for c in pod["status"]["conditions"])
+    # a recreate must avoid the tainted node
+    store.delete("Pod", NS, k8s.name(pod))
+    drain(mgr, include_delayed_under=0.1)
+    pod = store.get("Pod", NS, k8s.name(pod))
+    assert pod["spec"]["nodeName"] != node
