@@ -52,11 +52,24 @@ LIST_PAGE_SIZE = 20
 # repairs legitimately add writes.
 PREEMPT_COUNT = 16
 PREEMPT_RATE = 0.25
+# the request path must ride the keep-alive pool: ≥10 requests per opened
+# pooled TCP connection on the clean fan-out (the acceptance bound; a
+# healthy run measures 20-40x — connections scale with threads, not
+# requests)
+MIN_CONN_REUSE = 10.0
+# watch-kill phase: every watch stream is killed this long after connect
+# for the whole run, plus an idle-fleet settle window. Every reconnect
+# must RESUME from the server watch cache by resourceVersion: zero full
+# re-LIST resyncs (the O(delta) event-path contract), pinned via
+# watch_resumes_total{mode=relist} == 0.
+WATCH_KILL_COUNT = 25
+WATCH_KILL_AFTER_S = 0.4
+WATCH_KILL_SETTLE_S = 1.5
 
 
 def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
               budget_s: float = DEFAULT_BUDGET_S,
-              preempt: bool = True) -> int:
+              preempt: bool = True, watch_kill: bool = True) -> int:
     """Run the wire fan-out; return nonzero on any failed bound."""
     from loadtest.start_notebooks import run_wire
 
@@ -66,10 +79,22 @@ def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
                   max_requests_per_nb=MAX_REQUESTS_PER_NB,
                   workers=workers,
                   list_page_size=LIST_PAGE_SIZE,
-                  max_full_scans=MAX_FULL_SCANS)
+                  max_full_scans=MAX_FULL_SCANS,
+                  min_conn_reuse=MIN_CONN_REUSE)
     if rc != 0:
         print(f"SMOKE FAIL: loadtest bounds violated (rc={rc})")
         return rc
+    if watch_kill:
+        rc = run_wire(WATCH_KILL_COUNT, "watchkill-smoke", "v5e-4",
+                      timeout=max(budget_s - (time.monotonic() - t0), 15.0),
+                      workers=workers,
+                      watch_kill_after_s=WATCH_KILL_AFTER_S,
+                      max_relist_resyncs=0,
+                      settle_s=WATCH_KILL_SETTLE_S)
+        if rc != 0:
+            print(f"SMOKE FAIL: watch-kill loadtest bounds violated "
+                  f"(rc={rc})")
+            return rc
     if preempt:
         rc = run_wire(PREEMPT_COUNT, "preempt-smoke", "v5e-16",
                       timeout=max(budget_s - (time.monotonic() - t0), 15.0),
@@ -83,11 +108,14 @@ def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
     if wall > budget_s:
         print(f"SMOKE FAIL: {wall:.1f}s exceeds the {budget_s:.0f}s budget")
         return 1
-    print(f"smoke OK: {count} notebooks x {workers} workers "
-          f"+ {PREEMPT_COUNT} slices @ {PREEMPT_RATE:.0%} preemptions "
-          f"in {wall:.1f}s (budget {budget_s:.0f}s)" if preempt else
-          f"smoke OK: {count} notebooks x {workers} workers in {wall:.1f}s "
-          f"(budget {budget_s:.0f}s)")
+    phases = [f"smoke OK: {count} notebooks x {workers} workers"]
+    if watch_kill:
+        phases.append(f"{WATCH_KILL_COUNT} nb watch-kill chaos "
+                      f"(0 relists)")
+    if preempt:
+        phases.append(f"{PREEMPT_COUNT} slices @ {PREEMPT_RATE:.0%} "
+                      f"preemptions")
+    print(" + ".join(phases) + f" in {wall:.1f}s (budget {budget_s:.0f}s)")
     return 0
 
 
@@ -98,9 +126,12 @@ def main() -> int:
     ap.add_argument("--budget-s", type=float, default=DEFAULT_BUDGET_S)
     ap.add_argument("--no-preempt", action="store_true",
                     help="skip the node-preemption repair phase")
+    ap.add_argument("--no-watch-kill", action="store_true",
+                    help="skip the watch-kill RV-resume phase")
     args = ap.parse_args()
     return run_smoke(args.count, args.workers, args.budget_s,
-                     preempt=not args.no_preempt)
+                     preempt=not args.no_preempt,
+                     watch_kill=not args.no_watch_kill)
 
 
 if __name__ == "__main__":

@@ -21,6 +21,7 @@ objects and admission denials as 4xx Status responses.
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import queue
@@ -29,17 +30,140 @@ import ssl
 import struct
 import threading
 import time
+from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..utils import k8s, names
 from . import faults, restmapper
-from .errors import ApiError, NotFoundError
-from .store import WatchEvent
+from .errors import ApiError, ConflictError, GoneError, NotFoundError
+from .store import EventFrame, WatchEvent
 
 log = logging.getLogger("kubeflow_tpu.apiserver")
 
 WATCH_BOOKMARK_INTERVAL_S = 10.0
+
+#: retry budget for the status-subresource merge-PATCH re-merge loop —
+#: matches ClusterStore.PATCH_MAX_RETRIES; past it the racing writer wins
+#: and the client gets the 409 to reason about
+STATUS_PATCH_MAX_RETRIES = 20
+
+#: per-watcher queue depth beyond which MODIFIED frames coalesce per key
+#: (latest state wins). Healthy watchers drain far below this; a stalled
+#: one converges to at most one pending frame per live object — bounded
+#: by fleet size, not by event rate × stall time.
+WATCH_QUEUE_SOFT_LIMIT = 128
+#: hard depth cap: coalescing bounds MODIFIED churn, but ADDED/DELETED
+#: frames always append (edges must not be lost), so create/delete churn
+#: against a stalled watcher still grows the queue — past this the
+#: watcher is declared too slow and its STREAM is closed (the real
+#: apiserver does the same), which is cheap now: the client reconnects
+#: and resumes by resourceVersion from the watch-cache ring (sized the
+#: same), or relists after 410 if it stalled past the window.
+WATCH_QUEUE_HARD_LIMIT = 4096
+
+
+def _frame_line(etype: str, frame: EventFrame) -> bytes:
+    """One NDJSON watch frame from the shared encoding: the object bytes
+    are serialized once per EVENT (EventFrame caches them); only the tiny
+    type envelope is composed per watcher."""
+    return b'{"type":"' + etype.encode() + b'","object":' + \
+        frame.obj_bytes() + b"}\n"
+
+
+class _WatcherQueue:
+    """Bounded per-watcher frame queue with level-safe coalescing.
+
+    ``put`` is called from the store's dispatch (never blocks the writer);
+    ``get`` from the one streaming thread. Under backpressure (depth ≥
+    ``soft_limit``) an incoming MODIFIED frame coalesces into the pending
+    cell for the same object instead of appending — the delivery TYPE of
+    the pending cell is preserved (an undelivered ADDED stays ADDED,
+    carrying the newest state: level semantics, exactly what an informer
+    needs) and the cell MOVES to the queue tail, keeping delivered rvs
+    monotonic: an in-place replace would hand a higher-rv frame out ahead
+    of earlier-queued frames of other keys, and a client whose stream
+    died in between would resume PAST the undelivered ones — silently
+    lost events. ADDED and DELETED frames always append, so no edge is
+    lost and a DELETED is never overtaken by a stale MODIFIED (the key
+    map is cleared at the delete, isolating incarnations).
+
+    Coalescing bounds MODIFIED churn; ADDED/DELETED churn is bounded by
+    the HARD cap instead: past ``hard_limit`` the queue flips
+    ``overflowed`` and drops everything — the streaming thread closes the
+    stream, and the client's RV-resume (or 410→relist) re-delivers
+    level-safely. Memory is therefore bounded by
+    max(fleet size + soft_limit, hard_limit) frames per watcher."""
+
+    __slots__ = ("_cv", "_items", "_by_key", "_seq", "soft_limit",
+                 "hard_limit", "overflowed", "coalesced", "_on_coalesce")
+
+    def __init__(self, soft_limit: int = WATCH_QUEUE_SOFT_LIMIT,
+                 hard_limit: int = WATCH_QUEUE_HARD_LIMIT,
+                 on_coalesce=None) -> None:
+        self._cv = threading.Condition()
+        # FIFO by insertion seq; coalescing re-inserts at the tail in O(1).
+        # cells: [deliver_type, frame, key, seq]
+        self._items: OrderedDict = OrderedDict()
+        self._by_key: dict = {}  # (ns, name) → pending upsert cell
+        self._seq = itertools.count()
+        self.soft_limit = soft_limit
+        self.hard_limit = hard_limit
+        self.overflowed = False
+        self.coalesced = 0
+        self._on_coalesce = on_coalesce
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._items)
+
+    def put(self, frame: EventFrame) -> None:
+        key = (k8s.namespace(frame.obj), k8s.name(frame.obj))
+        with self._cv:
+            if self.overflowed:
+                return  # stream is doomed; stop accumulating now
+            if frame.type == "MODIFIED" and \
+                    len(self._items) >= self.soft_limit:
+                cell = self._by_key.get(key)
+                if cell is not None:
+                    # latest state wins; type preserved; move to tail
+                    del self._items[cell[3]]
+                    cell[1] = frame
+                    cell[3] = next(self._seq)
+                    self._items[cell[3]] = cell
+                    self.coalesced += 1
+                    if self._on_coalesce is not None:
+                        self._on_coalesce()
+                    return
+            if len(self._items) >= self.hard_limit:
+                # non-coalescible frame on a full queue: the watcher is
+                # too slow — drop everything and flag; delivering a
+                # partial stream would be worse than a clean kill, since
+                # the client's reconnect re-covers it exactly once
+                self.overflowed = True
+                self._items.clear()
+                self._by_key.clear()
+                self._cv.notify()
+                return
+            cell = [frame.type, frame, key, next(self._seq)]
+            self._items[cell[3]] = cell
+            if frame.type == "DELETED":
+                self._by_key.pop(key, None)
+            else:
+                self._by_key[key] = cell
+            self._cv.notify()
+
+    def get(self, timeout: float):
+        """Next ``(deliver_type, frame)`` or ``(None, None)`` on timeout."""
+        with self._cv:
+            if not self._items:
+                self._cv.wait(timeout)
+            if not self._items:
+                return None, None
+            _, cell = self._items.popitem(last=False)
+            if self._by_key.get(cell[2]) is cell:
+                del self._by_key[cell[2]]
+            return cell[0], cell[1]
 
 
 def _parse_label_selector(raw: str | None) -> dict[str, str | None] | None:
@@ -127,8 +251,41 @@ def _parse_path(path: str) -> _Route | None:
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "kubeflow-tpu-apiserver"
+    # keep-alive clients reuse one connection for many small requests:
+    # without TCP_NODELAY, Nagle holds each response body until the peer
+    # ACKs the headers (delayed ACK ≈ 40 ms) — per REQUEST, which dwarfs
+    # any real apiserver RTT. Per-request connections masked this via
+    # Connection: close flushing the socket.
+    disable_nagle_algorithm = True
 
     # ------------------------------------------------------------- plumbing
+    def setup(self):  # noqa: D102 — connection tracking for stop()
+        super().setup()
+        # register the accepted socket so stop() can shut down keep-alive
+        # connections: with client-side pooling a connection outlives its
+        # requests, and a "stopped" apiserver that keeps serving pooled
+        # peers would be unrealistic (a real restart drops every conn)
+        conns = getattr(self.server, "open_connections", None)
+        if conns is not None:
+            with self.server.conn_lock:  # type: ignore[attr-defined]
+                conns.add(self.connection)
+
+    def finish(self):  # noqa: D102
+        conns = getattr(self.server, "open_connections", None)
+        if conns is not None:
+            with self.server.conn_lock:  # type: ignore[attr-defined]
+                conns.discard(self.connection)
+        super().finish()
+
+    def handle_one_request(self):  # noqa: D102
+        try:
+            super().handle_one_request()
+        except (ConnectionResetError, BrokenPipeError):
+            # peer (or stop()) dropped the keep-alive connection between
+            # or during requests — normal teardown, not a handler error
+            # worth a socketserver stderr traceback
+            self.close_connection = True
+
     def log_message(self, fmt, *args):  # route through logging, not stderr
         log.debug("%s %s", self.address_string(), fmt % args)
 
@@ -513,7 +670,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         selector = _parse_label_selector(query.get("labelSelector"))
         if query.get("watch") in ("true", "1"):
-            self._stream_watch(route, selector)
+            self._stream_watch(route, selector, query)
             return
         # chunked LIST (?limit=&continue=) + resourceVersion passthrough
         # (rv=0 is the informer cache-ack form — see ClusterStore.list_page)
@@ -580,10 +737,12 @@ class _Handler(BaseHTTPRequestHandler):
         if route.subresource == "status":
             # status-subresource semantics: only .status from the patch is
             # applied (a real apiserver ignores spec fields sent here).
-            # Merge-patch never conflicts: re-merge on a racing writer, the
-            # same loop store.patch runs for the main resource.
-            from .errors import ConflictError
-            while True:
+            # Merge-patch re-merges on a racing writer — the same loop
+            # store.patch runs for the main resource — but BOUNDED: a
+            # pathological hot object (a writer livelocking every re-merge)
+            # must back off and surface 409, not spin a handler thread
+            # forever with the client timing out blind.
+            for attempt in range(STATUS_PATCH_MAX_RETRIES):
                 old = self.store.get(route.mapping.kind,
                                      route.namespace or "", route.name)
                 old["status"] = k8s.json_merge_patch(
@@ -592,7 +751,11 @@ class _Handler(BaseHTTPRequestHandler):
                     self._send_json(200, self.store.update_status(old))
                     return
                 except ConflictError:
-                    continue
+                    time.sleep(min(0.001 * (2 ** attempt), 0.1))
+            raise ConflictError(
+                f"{route.mapping.kind} {route.namespace}/{route.name}: "
+                f"status patch kept conflicting after "
+                f"{STATUS_PATCH_MAX_RETRIES} attempts")
         self._send_json(200, self.store.patch(
             route.mapping.kind, route.namespace or "", route.name, patch))
 
@@ -604,27 +767,106 @@ class _Handler(BaseHTTPRequestHandler):
                               "status": "Success"})
 
     # ---------------------------------------------------------------- watch
-    def _stream_watch(self, route: _Route, selector) -> None:
+    def _stream_watch(self, route: _Route, selector, query: dict) -> None:
         """Stream watch events as newline-delimited JSON, the real watch wire
         format. The connection closes when the client goes away (detected on
         the next write — idle bookmarks bound the detection latency) or the
-        server shuts down."""
-        events: queue.Queue = queue.Queue()
-        relay = events.put
-        self.store.watch(route.mapping.kind, relay,
-                         namespace=route.namespace, label_selector=selector)
-        self.send_response(200)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Connection", "close")
-        self.end_headers()
-        self.close_connection = True
-        # injected watch kill (FaultPlan): close the stream after its
-        # armed lifetime — the client sees EOF mid-watch and must
-        # reconnect + resync by resourceVersion diff
-        kill_at = None
-        if getattr(self, "_watch_kill_after", None) is not None:
-            kill_at = time.monotonic() + self._watch_kill_after
+        server shuts down.
+
+        ``?resourceVersion=N`` resumes: the retained event window after N
+        replays from the store's watch cache before live streaming — no
+        LIST, no gap — and a window already evicted answers ``410 Gone``
+        (reason Expired), the client's signal to fall back to the full
+        LIST+diff resync. Frames are encoded once per event (EventFrame)
+        and fanned out through a bounded, MODIFIED-coalescing per-watcher
+        queue, so a slow or stalled watcher costs bounded memory and never
+        slows the others. BOOKMARK frames carry the resourceVersion the
+        stream is complete through — the resume anchor on an idle watch."""
+        kind = route.mapping.kind
+        resume_raw = query.get("resourceVersion")
+        since_rv = None
+        if resume_raw:
+            # rv 0 included: a client whose stream anchored on an empty
+            # store (list rv 0 / connect bookmark 0) resumes from 0 —
+            # servable iff the kind's ring never evicted, else 410 →
+            # relist, exactly like any other evicted cursor
+            try:
+                since_rv = int(resume_raw)
+            except ValueError:
+                self._send_error_status(
+                    400, "BadRequest",
+                    f"invalid resourceVersion {resume_raw!r}")
+                return
+        register = getattr(self.store, "watch_frames", None)
+        legacy_q: queue.Queue | None = None
+        if register is not None:
+
+            def count_coalesce(_kind=kind):
+                metric = getattr(self.server, "watch_coalesced_metric", None)
+                if metric is not None:
+                    metric.inc({"kind": _kind})
+
+            frame_q = _WatcherQueue(on_coalesce=count_coalesce)
+            relay = frame_q.put
+            try:
+                replay, stream_rv = register(
+                    kind, relay, namespace=route.namespace,
+                    label_selector=selector, since_rv=since_rv)
+            except GoneError as err:
+                self._send_api_error(err)
+                return
+        elif since_rv is not None:
+            # wrapped store without the frame API: nothing retained to
+            # replay from — a resume here would silently skip events, so
+            # force the client's relist path instead
+            self._send_error_status(
+                410, "Expired",
+                "watch cache unavailable on this store; relist")
+            return
+        else:
+            legacy_q = queue.Queue()
+            relay = legacy_q.put
+            self.store.watch(kind, relay, namespace=route.namespace,
+                             label_selector=selector)
+            replay, stream_rv = [], 0
+        queues = getattr(self.server, "active_watch_queues", None)
+
+        def bookmark_bytes() -> bytes:
+            obj = {"metadata": {"resourceVersion": str(stream_rv)}}
+            return json.dumps({"type": "BOOKMARK", "object": obj},
+                              separators=(",", ":")).encode() + b"\n"
+
+        # the relay is registered: EVERYTHING from here on — the header
+        # write included (a client that connected and instantly went away
+        # raises BrokenPipeError there) — must reach the finally, or the
+        # store would relay every future event of this kind into a dead
+        # queue forever
         try:
+            if queues is not None and legacy_q is None:
+                with self.server.watch_queues_lock:  # type: ignore[attr-defined]
+                    queues.add(frame_q)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self.close_connection = True
+            # injected watch kill (FaultPlan): close the stream after its
+            # armed lifetime — the client sees EOF mid-watch and must
+            # reconnect (resuming from its last-delivered resourceVersion)
+            kill_at = None
+            if getattr(self, "_watch_kill_after", None) is not None:
+                kill_at = time.monotonic() + self._watch_kill_after
+            for frame in replay:
+                self.wfile.write(_frame_line(frame.type, frame))
+                stream_rv = max(stream_rv, frame.rv)
+            # connect-time BOOKMARK: hand the client its resume anchor
+            # immediately (the real apiserver's initial-events bookmark) —
+            # a stream killed while idle, before the periodic bookmark,
+            # would otherwise have no cursor and pay a full relist on
+            # reconnect. Sent even at rv 0: an empty store is a valid
+            # anchor, not a missing one.
+            self.wfile.write(bookmark_bytes())
+            self.wfile.flush()
             while not self.server.shutting_down:  # type: ignore[attr-defined]
                 timeout = WATCH_BOOKMARK_INTERVAL_S
                 if kill_at is not None:
@@ -632,19 +874,41 @@ class _Handler(BaseHTTPRequestHandler):
                     if remaining <= 0:
                         return  # injected stream kill (finally unwatches)
                     timeout = min(timeout, remaining)
-                try:
-                    event: WatchEvent = events.get(timeout=timeout)
-                    frame = {"type": event.type, "object": event.obj}
-                except queue.Empty:
+                payload = None
+                if legacy_q is not None:
+                    try:
+                        event: WatchEvent = legacy_q.get(timeout=timeout)
+                        payload = json.dumps(
+                            {"type": event.type,
+                             "object": event.obj}).encode() + b"\n"
+                    except queue.Empty:
+                        pass
+                else:
+                    etype, frame = frame_q.get(timeout)
+                    if frame_q.overflowed:
+                        # too-slow watcher (hard cap hit on edge churn):
+                        # close the stream — the client resumes by rv
+                        # from the watch-cache ring, or relists on 410
+                        return
+                    if frame is not None:
+                        payload = _frame_line(etype, frame)
+                        stream_rv = max(stream_rv, frame.rv)
+                if payload is None:
                     if kill_at is not None and time.monotonic() >= kill_at:
                         return
-                    frame = {"type": "BOOKMARK", "object": {}}
-                self.wfile.write(json.dumps(frame).encode() + b"\n")
+                    # idle BOOKMARK: the rv through which this stream is
+                    # complete — what a client records as its resume
+                    # anchor when no events are flowing
+                    payload = bookmark_bytes()
+                self.wfile.write(payload)
                 self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
             self.store.unwatch(relay)
+            if queues is not None and legacy_q is None:
+                with self.server.watch_queues_lock:  # type: ignore[attr-defined]
+                    queues.discard(frame_q)
 
 
 class ApiServerProxy:
@@ -671,6 +935,18 @@ class ApiServerProxy:
         # facade has ~0 RTT while a production apiserver has 1-10 ms; the
         # dispatch worker-pool measurements need the real shape)
         self._httpd.latency_s = latency_s  # type: ignore[attr-defined]
+        # serve-side watch fan-out introspection + metrics:
+        # watch_queue_coalesced_total lands here via attach_metrics();
+        # active_watch_queues lets tests assert a stalled watcher's queue
+        # stays bounded while coalescing
+        self._httpd.watch_coalesced_metric = None  # type: ignore[attr-defined]
+        self._httpd.active_watch_queues = set()  # type: ignore[attr-defined]
+        self._httpd.watch_queues_lock = threading.Lock()  # type: ignore[attr-defined]
+        # accepted sockets, so stop() tears down keep-alive connections
+        # (pooled clients would otherwise keep talking to a "stopped"
+        # apiserver through handler threads that survive shutdown())
+        self._httpd.open_connections = set()  # type: ignore[attr-defined]
+        self._httpd.conn_lock = threading.Lock()  # type: ignore[attr-defined]
         # optional mutating-request audit trail (suite_test.go:127-157
         # analog); opened append so restarts extend the trail
         self._audit_file = open(audit_log, "a") if audit_log else None
@@ -684,6 +960,25 @@ class ApiServerProxy:
                                                  server_side=True)
             self.scheme = "https"
         self._thread: threading.Thread | None = None
+
+    def attach_metrics(self, registry) -> None:
+        """Register the server-side watch fan-out counter and pass the
+        registry down to the backing store (watch-cache evictions) — the
+        loadtest attaches its controller registry here so the whole watch
+        path is measured in one exposition."""
+        self._httpd.watch_coalesced_metric = registry.counter(  # type: ignore[attr-defined]
+            "watch_queue_coalesced_total",
+            "MODIFIED watch frames coalesced per key in a backpressured "
+            "per-watcher queue (latest state wins), by kind.")
+        if hasattr(self.store, "attach_metrics"):
+            self.store.attach_metrics(registry)
+
+    @property
+    def active_watch_queues(self) -> list:
+        """Snapshot of the live per-watcher frame queues (introspection
+        for the bounded-backpressure tests)."""
+        with self._httpd.watch_queues_lock:  # type: ignore[attr-defined]
+            return list(self._httpd.active_watch_queues)  # type: ignore[attr-defined]
 
     @property
     def fault_plan(self):
@@ -712,6 +1007,16 @@ class ApiServerProxy:
         self._httpd.shutting_down = True  # type: ignore[attr-defined]
         self._httpd.shutdown()
         self._httpd.server_close()
+        # drop every live connection: handler threads unblock on EOF and
+        # exit; pooled clients see the close and reconnect (getting ECONNREFUSED
+        # until a restart) — real apiserver restart semantics
+        with self._httpd.conn_lock:  # type: ignore[attr-defined]
+            open_conns = list(self._httpd.open_connections)  # type: ignore[attr-defined]
+        for sock in open_conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

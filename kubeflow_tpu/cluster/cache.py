@@ -361,7 +361,15 @@ class CachingClient:
         Payload (disable_for) kinds are ingested STRIPPED — the transforms
         drop data/binaryData/stringData — so the cache can answer existence
         without ever holding payloads; Event is dropped at the door (high
-        churn, never served from cache)."""
+        churn, never served from cache).
+
+        The event object may be SHARED with every other watcher of the
+        store (serialize-once fan-out deepcopies once per event, not per
+        consumer): this cache honors that by never mutating what it
+        ingests — transforms copy-on-write, stores replace whole objects,
+        reads deepcopy on the way out. A DELETED synthesized after an
+        outage may carry only a skeleton (rv + routing metadata, the
+        transport's slim ``seen`` record); removal needs only its key."""
         if event.obj.get("kind") in self.NEVER_CACHE:
             return
         self._on_event(event)

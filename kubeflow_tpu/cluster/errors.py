@@ -46,6 +46,16 @@ class InvalidError(ApiError):
     reason = "Invalid"
 
 
+class GoneError(ApiError):
+    """410 Gone with reason ``Expired`` — the apiserver's answer when a
+    watch asks to resume from a resourceVersion the watch cache has
+    already evicted (apimachinery NewResourceExpired). The client's only
+    correct move is the full LIST+diff resync; resuming anywhere else
+    could silently skip evicted events."""
+    code = 410
+    reason = "Expired"
+
+
 class ForbiddenError(ApiError):
     code = 403
     reason = "Forbidden"

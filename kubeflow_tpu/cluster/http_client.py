@@ -16,13 +16,25 @@ Auth, mirroring client-go's loading order:
   exactly what the deploy manifests give the manager pod;
 - plain constructor for tests / token-only setups.
 
+Transport: requests ride per-thread persistent HTTP/1.1 connections
+(keep-alive pool) instead of a fresh TCP connect per request — the server
+half of every request's round-trips, and the per-connection handler-thread
+spawn on the facade, disappear from the hot path. A reused connection the
+server closed idle is retried ONCE on a fresh one, only when the failure
+happened at SEND time (the server never read the request, so the retry is
+safe for every verb); response-phase failures keep their PR-2 ambiguity
+semantics and are owned by the RetryPolicy layer.
+
 Watches are reconnecting daemon threads reading the newline-delimited JSON
-stream (``?watch=true``). After a drop the client re-lists and diffs against
-the per-key resourceVersions it has delivered: changed/new objects re-deliver
-as MODIFIED/ADDED and objects that vanished during the outage synthesize
-DELETED — so informer caches can neither go stale nor keep ghosts across
-apiserver restarts, and a quiet cluster costs one cheap list per reconnect,
-not a full re-delivery.
+stream (``?watch=true``). The loop tracks the resourceVersion of the last
+event it DELIVERED (bookmark frames anchor idle streams) and reconnects
+with ``?resourceVersion=N``: the apiserver replays the retained window
+after N — no LIST, no gap, O(delta) — and answers ``410 Gone`` when the
+window was evicted, which drops the cursor and falls back to the original
+LIST+diff resync: changed/new objects re-deliver as MODIFIED/ADDED and
+objects that vanished synthesize DELETED — so informer caches can neither
+go stale nor keep ghosts across apiserver restarts. ``watch_resumes_total``
+counts which path each reconnect took.
 
 In-process admission registration is NOT available here: against a real
 apiserver, admission runs via webhook configurations served by the manager's
@@ -43,14 +55,13 @@ import tempfile
 import threading
 import time
 import urllib.error
-import urllib.request
 from dataclasses import dataclass
-from urllib.parse import quote, urlencode
+from urllib.parse import quote, urlencode, urlsplit
 
 from ..utils import k8s
 from . import restmapper
 from .errors import (AlreadyExistsError, ApiError, ConflictError,
-                     ForbiddenError, InvalidError, NotFoundError,
+                     ForbiddenError, GoneError, InvalidError, NotFoundError,
                      ServiceUnavailableError, TooManyRequestsError)
 from .store import WatchEvent
 
@@ -66,10 +77,11 @@ _ERROR_BY_REASON = {
     "Forbidden": ForbiddenError,
     "TooManyRequests": TooManyRequestsError,
     "ServiceUnavailable": ServiceUnavailableError,
+    "Expired": GoneError,
 }
-_ERROR_BY_CODE = {404: NotFoundError, 409: ConflictError, 422: InvalidError,
-                  403: ForbiddenError, 429: TooManyRequestsError,
-                  503: ServiceUnavailableError}
+_ERROR_BY_CODE = {404: NotFoundError, 409: ConflictError, 410: GoneError,
+                  422: InvalidError, 403: ForbiddenError,
+                  429: TooManyRequestsError, 503: ServiceUnavailableError}
 
 #: failures that mean "the bytes didn't arrive", not "the server said no":
 #: connection refused/reset (URLError/OSError) and a response that
@@ -204,6 +216,18 @@ class HttpApiClient:
         self._requests_metric = None
         self._retries_metric = None
         self._duration_metric = None
+        self._connections_metric = None  # rest_client_connections_opened_total
+        self._resumes_metric = None      # watch_resumes_total
+        # keep-alive pool: one persistent connection per (thread, client) —
+        # http.client connections are not thread-safe, and a thread's
+        # requests are serial, so thread affinity IS the pool discipline
+        split = urlsplit(self.base_url)
+        self._addr = (split.scheme, split.hostname or "127.0.0.1",
+                      split.port or (443 if split.scheme == "https" else 80),
+                      split.path.rstrip("/"))
+        self._tl = threading.local()
+        self._conns: set = set()  # every pooled conn, so close() can reap
+        self._conns_lock = threading.Lock()
         # optional apiserver health tracker (the manager's circuit
         # breaker): told about every transport-level success/failure —
         # an HTTP error response counts as SUCCESS (the server answered)
@@ -269,33 +293,158 @@ class HttpApiClient:
                    ca_cert=ca if os.path.exists(ca) else None)
 
     # ------------------------------------------------------------ transport
+    def _new_conn(self, timeout: float, stream: bool = False):
+        scheme, host, port, _prefix = self._addr
+        if scheme == "https":
+            conn = http.client.HTTPSConnection(host, port, timeout=timeout,
+                                               context=self._ssl)
+        else:
+            conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        conn.connect()
+        # a persistent connection carries many small request/response
+        # pairs: Nagle + delayed ACK turns each into a ~40 ms stall
+        # (http.client writes headers and body in separate send()s)
+        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._connections_metric is not None:
+            # pooled vs stream: one watch stream = one connection by
+            # design (reconnect chaos churns them legitimately), so the
+            # keep-alive reuse bound is computed over pooled conns only
+            self._connections_metric.inc(
+                {"type": "stream" if stream else "pooled"})
+        return conn
+
+    def _checkout(self, timeout: float, pooled: bool):
+        """This thread's persistent connection (or a dedicated one for
+        streams). Returns ``(conn, reused)`` — ``reused`` gates the
+        stale-keep-alive retry in _request."""
+        if not pooled:
+            return self._new_conn(timeout, stream=True), False
+        slot = self._tl
+        conn = getattr(slot, "conn", None)
+        if conn is not None:
+            resp = getattr(slot, "resp", None)
+            if resp is not None and not getattr(resp, "_kt_drained", False):
+                # the previous response never finished (truncated body,
+                # abandoned or PARTIAL read): the conn is mid-message —
+                # recycle it. isclosed() alone cannot tell: a response
+                # closed before EOF (read() raised mid-body, with-block
+                # closed it) reports closed while unread bytes still sit
+                # on the socket, and the next request would parse them as
+                # its status line. Only a read that actually reached EOF
+                # (_mark_drained) proves the conn is clean.
+                self._discard(conn, pooled=True)
+                conn = None
+        reused = conn is not None
+        if conn is None:
+            conn = self._new_conn(timeout)
+            slot.conn = conn
+            with self._conns_lock:
+                self._conns.add(conn)
+        slot.resp = None
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        else:
+            conn.timeout = timeout  # applies at connect
+        return conn, reused
+
+    @staticmethod
+    def _mark_drained(resp) -> None:
+        """Record that ``resp`` was read to EOF — the proof _checkout
+        needs that the pooled connection carries no leftover body bytes
+        and is safe to reuse."""
+        resp._kt_drained = True
+
+    def _discard(self, conn, pooled: bool) -> None:
+        if pooled:
+            slot = self._tl
+            if getattr(slot, "conn", None) is conn:
+                slot.conn = None
+                slot.resp = None
+            with self._conns_lock:
+                self._conns.discard(conn)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
     def _request(self, method: str, path: str, body: dict | None = None,
                  content_type: str = "application/json",
-                 timeout: float | None = None):
+                 timeout: float | None = None, pooled: bool = True):
+        """One wire request over the keep-alive pool. ``pooled=False``
+        (watch streams) opens a dedicated connection, attached to the
+        response as ``_kt_conn`` so the stream can close it; everything
+        else reuses this thread's persistent connection — the response
+        must be fully read before the thread's next request (every caller
+        does), or the next checkout recycles the connection."""
         data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(self.base_url + path, data=data,
-                                     method=method)
-        req.add_header("Accept", "application/json")
+        headers = {"Accept": "application/json"}
         if data is not None:
-            req.add_header("Content-Type", content_type)
+            headers["Content-Type"] = content_type
         if self.token:
-            req.add_header("Authorization", f"Bearer {self.token}")
-        try:
-            resp = urllib.request.urlopen(
-                req, timeout=timeout or self.timeout, context=self._ssl)
+            headers["Authorization"] = f"Bearer {self.token}"
+        timeout = timeout or self.timeout
+        url_path = self._addr[3] + path
+        for attempt in (0, 1):
+            conn, reused = None, False
+            try:
+                conn, reused = self._checkout(timeout, pooled)
+                conn.request(method, url_path, body=data, headers=headers)
+            except (http.client.HTTPException, OSError) as err:
+                # SEND-phase failure (connect included): the server never
+                # read this request. On a REUSED keep-alive connection the
+                # overwhelming cause is the server having closed it idle —
+                # retry ONCE on a fresh connection, transparently and for
+                # EVERY verb (no bytes were processed, so no ambiguity). A
+                # fresh connection failing is a real outage: surface it.
+                if conn is not None:
+                    self._discard(conn, pooled)
+                if reused and attempt == 0:
+                    continue
+                self._count_request(method, "<error>")
+                self._health_fail()
+                err._kt_health_recorded = True  # _json must not double-count
+                raise
+            try:
+                resp = conn.getresponse()
+            except (http.client.HTTPException, OSError) as err:
+                # RESPONSE-phase failure: the request MAY have been
+                # processed (the PR-2 ambiguous shape) — owned by the
+                # RetryPolicy layer, with ONE exception: a REUSED
+                # connection closing with zero response bytes
+                # (RemoteDisconnected) on a GET is the idle-close race
+                # losing to our send — a GET retry is always safe, so
+                # recover transparently (the Go transport's rule).
+                # Mutations surface even then: without the policy layer's
+                # ambiguous_retry marker, a silently retried create could
+                # turn its own first write into a hard AlreadyExists.
+                self._discard(conn, pooled)
+                if reused and attempt == 0 and method == "GET" and \
+                        isinstance(err, http.client.RemoteDisconnected):
+                    continue
+                self._count_request(method, "<error>")
+                self._health_fail()
+                err._kt_health_recorded = True
+                raise
+            break
+        if pooled:
+            self._tl.resp = resp  # reuse gate for the next checkout
+        else:
+            resp._kt_conn = conn  # the stream's teardown closes it
+        if resp.status >= 400:
+            payload = resp.read()  # frees the conn for reuse
+            self._mark_drained(resp)
+            if not pooled:
+                # a dedicated stream connection whose request errored
+                # (e.g. watch resume → 410 Gone) never reaches the
+                # stream's teardown — close it here, not at GC time
+                self._discard(conn, pooled=False)
             self._count_request(method, resp.status)
-            self._health_ok()
-            return resp
-        except urllib.error.HTTPError as err:
-            self._count_request(method, err.code)
             self._health_ok()  # an error RESPONSE still means "reachable"
-            raise _error_from_response(err.code, err.read(),
-                                       err.headers) from None
-        except (urllib.error.URLError, OSError) as err:
-            self._count_request(method, "<error>")
-            self._health_fail()
-            err._kt_health_recorded = True  # _json must not double-count
-            raise
+            raise _error_from_response(resp.status, payload,
+                                       resp.headers) from None
+        self._count_request(method, resp.status)
+        self._health_ok()
+        return resp
 
     def _count_request(self, method: str, code) -> None:
         if self._requests_metric is not None:
@@ -351,6 +500,7 @@ class HttpApiClient:
         try:
             with self._request("GET", "/readyz", timeout=timeout) as resp:
                 resp.read()  # a reset manifests at body-read, not connect
+                self._mark_drained(resp)
             return True
         except ApiError:
             return True
@@ -373,6 +523,21 @@ class HttpApiClient:
         self._duration_metric = registry.histogram(
             "rest_client_request_duration_seconds",
             "Apiserver request latency per attempt, by verb.")
+        self._connections_metric = registry.counter(
+            "rest_client_connections_opened_total",
+            "TCP connections opened to the apiserver. With the keep-alive "
+            "pool this grows with threads and outages, not with requests — "
+            "the reuse ratio the loadtest smoke bounds.")
+        self._resumes_metric = registry.counter(
+            "watch_resumes_total",
+            "Watch stream reconnects by kind and mode: resume = replayed "
+            "from the server watch cache by resourceVersion (no LIST), "
+            "relist = full LIST+diff resync fallback (410 Gone or no "
+            "resume cursor).")
+
+    def _count_resume(self, kind: str, mode: str) -> None:
+        if self._resumes_metric is not None:
+            self._resumes_metric.inc({"kind": kind, "mode": mode})
 
     def _api_retry_wait(self, err: ApiError, method: str,
                         fallback_delay: float) -> float | None:
@@ -410,6 +575,7 @@ class HttpApiClient:
             try:
                 with self._request(method, path, body, content_type) as resp:
                     data = resp.read()
+                    self._mark_drained(resp)
                 self._observe_duration(method, started)
                 parsed = json.loads(data)
                 if validate is not None:
@@ -485,15 +651,24 @@ class HttpApiClient:
 
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict[str, str] | None = None) -> list[dict]:
-        return self._list(kind, namespace, label_selector)
+        return self._list(kind, namespace, label_selector)[0]
 
     def _list(self, kind: str, namespace: str | None,
               label_selector: dict[str, str] | None,
-              resource_version: str | None = None) -> list[dict]:
+              resource_version: str | None = None,
+              ) -> tuple[list[dict], int | None]:
         """One logical LIST, paged through ``limit``/``continue`` when
         ``list_page_size`` is set (bounds resync memory + tail latency on
         big fleets). ``resource_version="0"`` is the informer cache-ack
-        form the resync path sends."""
+        form the resync path sends. Returns ``(items, list_rv)`` —
+        ``list_rv`` is the list metadata's resourceVersion from the FIRST
+        page, the reflector's watch-from anchor, or None when the server
+        sent none. First page, not last: each page serves live state, so
+        a later page's rv covers events (e.g. a deletion of a page-1
+        object) whose frames this LIST does not reflect — resuming from
+        it would skip them forever. Resuming from the first page's rv
+        re-delivers anything that changed between pages instead:
+        duplicates are level-safe, skips are not."""
         base_query: dict[str, str] = {}
         if label_selector:
             base_query["labelSelector"] = _serialize_selector(label_selector)
@@ -501,6 +676,8 @@ class HttpApiClient:
             base_query["resourceVersion"] = resource_version
         items: list[dict] = []
         cont: str | None = None
+        list_rv: int | None = None
+        first_page = True
         while True:
             query = dict(base_query)
             if self.list_page_size:
@@ -516,9 +693,16 @@ class HttpApiClient:
             # consecutive-failure threshold like any truncated response
             body = self._json("GET", path, validate=_require_items)
             items.extend(body["items"])
-            cont = (body.get("metadata") or {}).get("continue")
+            meta = body.get("metadata") or {}
+            if first_page:
+                first_page = False
+                try:
+                    list_rv = int(meta.get("resourceVersion"))
+                except (TypeError, ValueError):
+                    list_rv = None
+            cont = meta.get("continue")
             if not cont:
-                return items
+                return items, list_rv
 
     def create(self, obj: dict) -> dict:
         kind = k8s.kind(obj)
@@ -613,18 +797,26 @@ class HttpApiClient:
 
     def _watch_loop(self, kind: str, callback, namespace, label_selector,
                     connected: threading.Event):
-        # (namespace, name) → last object DELIVERED to the callback (the
-        # informer's deleted-final-state store): the resync diff compares
-        # resourceVersions against it, and an outage-time deletion is
-        # synthesized as DELETED carrying this full final object, so
-        # owner-mapped and label-filtered watches still route it
+        # (namespace, name) → SLIM record of the last object DELIVERED to
+        # the callback (rv + the routing fields, see _slim — pinning every
+        # full object forever costs O(fleet × object size) per watch
+        # thread): the resync diff compares resourceVersions against it,
+        # and an outage-time deletion is synthesized as DELETED carrying
+        # this skeleton, so owner-mapped and label-filtered watches still
+        # route it
         seen: dict[tuple[str, str], dict] = {}
+        # shared reconnect state: ``rv`` is the resume cursor (largest
+        # resourceVersion DELIVERED on any stream, bookmark-anchored when
+        # idle) — None means the next connect must run the LIST+diff
+        # resync; ``connected_once`` separates first-connect informer
+        # replay from counted relist fallbacks
+        state: dict = {"rv": None, "connected_once": False}
         failures = 0
         in_gap = False
 
         def on_resynced() -> None:
-            # stream live again AND the RV-diff delivered: consumers'
-            # caches are converged — end the degraded window
+            # stream live again AND converged (RV replay or LIST+diff
+            # delivered): end any degraded window
             nonlocal in_gap
             if in_gap:
                 in_gap = False
@@ -635,8 +827,20 @@ class HttpApiClient:
             failed = True
             try:
                 self._watch_stream(kind, callback, namespace, label_selector,
-                                   connected, seen, on_resynced)
+                                   connected, seen, on_resynced, state)
                 failed = False  # server closed the stream cleanly
+            except GoneError:
+                if self._stopped.is_set():
+                    return
+                # the resume window was evicted server-side (or the rv
+                # belongs to another store incarnation): events WERE
+                # missed — drop the cursor so the next connect relists,
+                # and reconnect promptly (the 410 is an answer, not an
+                # outage)
+                log.debug("watch %s resume expired (410 Gone); falling "
+                          "back to LIST+diff resync", kind)
+                state["rv"] = None
+                failed = False
             except json.JSONDecodeError as err:
                 if self._stopped.is_set():
                     return  # close() aborted the read mid-body: not an error
@@ -659,10 +863,15 @@ class HttpApiClient:
                 # is NOT an OSError and previously escaped this loop.
                 log.debug("watch %s dropped (%s: %s); reconnecting", kind,
                           type(err).__name__, err)
-            # a dropped stream (clean rotation or failure) leaves a gap —
-            # events until the next resync may be missed; flag it once per
-            # outage so index-served reads fall back live for the window
-            if not self._stopped.is_set() and not in_gap:
+            # a dropped stream only opens a DEGRADED window when it cannot
+            # resume: with a cursor the missed events are retained in the
+            # server's watch cache and replay on reconnect — the informer
+            # merely lags, exactly as on a busy healthy stream, so cached
+            # reads stay authoritative. Without a cursor (first connect
+            # still failing, or a 410 just voided it) events may be
+            # missed until the LIST+diff resync lands: serve reads live.
+            if not self._stopped.is_set() and not in_gap \
+                    and state["rv"] is None:
                 in_gap = True
                 self._notify_watch_gap(kind, True)
             # a stream that served for a while then dropped is the normal
@@ -682,69 +891,142 @@ class HttpApiClient:
                 delay *= self._retry_rng.uniform(0.5, 1.0)
             self._stopped.wait(delay)
 
-    def _deliver(self, callback, event: WatchEvent, seen: dict) -> None:
-        """Invoke the callback, then record delivery. A raising callback is
-        logged and NOT recorded, so the next resync re-delivers the event
-        instead of silently losing it."""
+    #: metadata fields a slim ``seen`` record keeps: the resync diff needs
+    #: resourceVersion; a synthesized DELETED must still route through
+    #: owner mappers (ownerReferences), label mappers/selectors (labels),
+    #: and key extraction (name/namespace/uid)
+    _SLIM_METADATA_FIELDS = ("name", "namespace", "uid", "resourceVersion",
+                             "labels", "ownerReferences")
+
+    @classmethod
+    def _slim(cls, obj: dict) -> dict:
+        """Skeleton of a delivered object for the ``seen`` map — rv plus
+        only what DELETED synthesis routing needs. Pinning full objects
+        pinned O(fleet × object size) per watch thread forever."""
+        md = obj.get("metadata") or {}
+        return {"kind": obj.get("kind"), "apiVersion": obj.get("apiVersion"),
+                "metadata": {k: md[k] for k in cls._SLIM_METADATA_FIELDS
+                             if k in md}}
+
+    def _deliver(self, callback, event: WatchEvent, seen: dict) -> bool:
+        """Invoke the callback, then record delivery (returns whether it
+        was recorded — the watch stream advances its resume cursor only
+        past delivered events). A raising callback is logged and NOT
+        recorded, so the next resync/replay re-delivers the event instead
+        of silently losing it."""
         try:
             callback(event)
         except Exception:  # noqa: BLE001 — consumer bug must not kill the watch
             log.exception("watch callback failed for %s %s",
                           k8s.kind(event.obj), event.type)
-            return
+            return False
         key = self._obj_key(event.obj)
         if event.type == "DELETED":
             seen.pop(key, None)
         else:
-            seen[key] = event.obj
+            seen[key] = self._slim(event.obj)
+        return True
 
     def _resync(self, kind, callback, namespace, label_selector,
-                seen: dict) -> None:
+                seen: dict) -> int | None:
         """After a dropped stream: list and diff against what was delivered.
         Changed objects → MODIFIED, unseen → ADDED, vanished → DELETED with
-        the last-delivered object as the final state (a deletion during the
-        outage would otherwise never surface and leave ghost objects in
-        informer caches)."""
+        the last-delivered skeleton as the final state (a deletion during
+        the outage would otherwise never surface and leave ghost objects in
+        informer caches). Returns the LIST's resourceVersion — the
+        reflector's watch-from anchor: the stream is complete through it
+        the moment the diff is delivered — or None when any delivery
+        failed (anchoring would let resumes skip the failed event forever;
+        a cursorless next reconnect relists and re-delivers it)."""
         current: dict[tuple[str, str], dict] = {}
         # rv=0: the informer list-then-watch form — any stored state is
         # acceptable (the RV-diff below reconciles staleness); pages when
         # list_page_size is set, so a post-outage resync of a big fleet
         # never materializes one giant body
-        for obj in self._list(kind, namespace, label_selector,
-                              resource_version="0"):
+        items, list_rv = self._list(kind, namespace, label_selector,
+                                    resource_version="0")
+        for obj in items:
             current[self._obj_key(obj)] = obj
+        complete = True
         for key, obj in current.items():
             if key not in seen:
-                self._deliver(callback, WatchEvent("ADDED", obj), seen)
+                complete &= self._deliver(callback, WatchEvent("ADDED", obj),
+                                          seen)
             elif self._obj_rv(seen[key]) != self._obj_rv(obj):
-                self._deliver(callback, WatchEvent("MODIFIED", obj), seen)
+                complete &= self._deliver(callback,
+                                          WatchEvent("MODIFIED", obj), seen)
         for key in [key for key in seen if key not in current]:
             final_state = seen[key]
-            self._deliver(callback, WatchEvent("DELETED", final_state), seen)
+            complete &= self._deliver(callback,
+                                      WatchEvent("DELETED", final_state),
+                                      seen)
+        return list_rv if complete else None
 
     def _watch_stream(self, kind: str, callback, namespace, label_selector,
                       connected: threading.Event, seen: dict,
-                      on_resynced=None):
+                      on_resynced=None, state: dict | None = None):
+        state = state if state is not None \
+            else {"rv": None, "connected_once": False}
+        resume_rv = state.get("rv")
         query = {"watch": "true",
                  "timeoutSeconds": str(WATCH_SERVER_TIMEOUT_S)}
+        if resume_rv is not None:
+            query["resourceVersion"] = str(resume_rv)
         if label_selector:
             query["labelSelector"] = _serialize_selector(label_selector)
         path = self._path(kind, namespace, query=query)
-        with self._request("GET", path, timeout=WATCH_READ_TIMEOUT_S) as resp:
+
+        def advance(rv_raw) -> None:
+            try:
+                rv = int(rv_raw)
+            except (TypeError, ValueError):
+                return
+            # rv 0 is a VALID anchor (a from-birth stream on an empty
+            # store is complete through 0) — only None means "no cursor,
+            # must relist"
+            if state["rv"] is None or rv > state["rv"]:
+                state["rv"] = rv
+
+        # dedicated (non-pooled) connection: the stream holds it for its
+        # whole lifetime and it is never reusable afterwards
+        resp = self._request("GET", path, timeout=WATCH_READ_TIMEOUT_S,
+                             pooled=False)
+        try:
             with self._streams_lock:
                 self._live_streams.add(resp)
             try:
                 connected.set()  # server has registered the watch relay
-                # resync AFTER the stream is live (no missable gap): on the
-                # first connect this is informer semantics — initial list →
-                # ADDED for existing objects, as controller-runtime delivers
-                # at boot — and after an outage it is the diff that surfaces
-                # missed changes and deletions. Events racing the resync may
-                # deliver twice (level-based consumers tolerate that); with
-                # unchanged RVs the diff delivers nothing.
-                self._resync(kind, callback, namespace, label_selector, seen)
-                if on_resynced is not None:
-                    on_resynced()
+                if resume_rv is not None:
+                    # RV-resumable reconnect: the server is replaying the
+                    # retained window after resume_rv on THIS stream — no
+                    # LIST, no missable gap, the consumer cache just
+                    # catches up through the replayed frames below
+                    self._count_resume(kind, "resume")
+                    if on_resynced is not None:
+                        on_resynced()
+                else:
+                    # resync AFTER the stream is live (no missable gap): on
+                    # the first connect this is informer semantics —
+                    # initial list → ADDED for existing objects, as
+                    # controller-runtime delivers at boot — and after a 410
+                    # (or a drop that never delivered) it is the diff that
+                    # surfaces missed changes and deletions. Events racing
+                    # the resync may deliver twice (level-based consumers
+                    # tolerate that); with unchanged RVs the diff delivers
+                    # nothing.
+                    if state["connected_once"]:
+                        self._count_resume(kind, "relist")
+                    list_rv = self._resync(kind, callback, namespace,
+                                           label_selector, seen)
+                    # anchor the resume cursor at the LIST's rv NOW: a
+                    # stream dropped before the first bookmark is read
+                    # must still reconnect in resume mode (the reflector's
+                    # list-then-watch-from-rv contract)
+                    if list_rv is not None:
+                        advance(list_rv)
+                    if on_resynced is not None:
+                        on_resynced()
+                state["connected_once"] = True
                 while not self._stopped.is_set():
                     try:
                         line = resp.readline()
@@ -761,15 +1043,41 @@ class HttpApiClient:
                         obj = frame["object"]
                     except (ValueError, KeyError, TypeError):
                         # truncated NDJSON frame (apiserver killed
-                        # mid-write): reconnect; the resync re-covers
-                        # whatever it carried
+                        # mid-write): reconnect; the replay/resync
+                        # re-covers whatever it carried
                         return
                     if event_type == "BOOKMARK":
+                        # idle-stream resume anchor: the server guarantees
+                        # this stream is complete through the bookmark rv
+                        advance(k8s.get_in(obj, "metadata",
+                                           "resourceVersion"))
                         continue
-                    self._deliver(callback, WatchEvent(event_type, obj), seen)
+                    if self._deliver(callback, WatchEvent(event_type, obj),
+                                     seen):
+                        advance(k8s.get_in(obj, "metadata",
+                                           "resourceVersion"))
+                    else:
+                        # failed delivery: the stream is NOT complete past
+                        # this event, and a later event or bookmark must
+                        # not advance the cursor over it — drop the
+                        # stream; the reconnect resumes from the last
+                        # DELIVERED rv and replays this event (the
+                        # re-delivery _deliver's contract promises)
+                        return
             finally:
                 with self._streams_lock:
                     self._live_streams.discard(resp)
+        finally:
+            try:
+                resp.close()
+            except OSError:
+                pass
+            conn = getattr(resp, "_kt_conn", None)
+            if conn is not None:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
 
     def close(self) -> None:
         """Stop watch threads NOW: set the stop flag and shut down the live
@@ -778,6 +1086,18 @@ class HttpApiClient:
         contend on the BufferedReader lock the reading thread holds and
         block until the read timeout."""
         self._stopped.set()
+        # reap the keep-alive pool: worker threads' persistent connections
+        # are idle at shutdown (their requests are done) or their in-flight
+        # retry waits just aborted via _stopped — closing from here is the
+        # only way to reach them across threads
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
         with self._streams_lock:
             streams = list(self._live_streams)
         for resp in streams:

@@ -29,13 +29,14 @@ import itertools
 import json
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Iterator
 
 from ..utils import k8s
 from ..utils.names import generate_suffix
-from .errors import (AlreadyExistsError, ConflictError, InvalidError,
-                     NotFoundError)
+from .errors import (AlreadyExistsError, ConflictError, GoneError,
+                     InvalidError, NotFoundError)
 
 CLUSTER_SCOPED_KINDS = {
     "Namespace", "ClusterRole", "ClusterRoleBinding", "OAuthClient",
@@ -57,12 +58,77 @@ class WatchEvent:
     obj: dict
 
 
+#: per-kind watch-cache ring capacity: how many recent events a dropped
+#: watcher can resume across without a full re-LIST. Sized so a fleet-wide
+#: status churn burst (500 notebooks × a handful of writes each) fits in
+#: the window at the facade's memory cost of one shared frame per event.
+WATCH_CACHE_CAPACITY = 4096
+
+
+class EventFrame:
+    """One watch event, shared by every consumer (the real apiserver's
+    watch-cache entry): the object is deepcopied ONCE at emission and
+    treated as immutable from then on, and the wire encoding is computed
+    at most once no matter how many HTTP watchers fan it out. ``rv`` is
+    the event's resourceVersion as an int — the resume cursor."""
+
+    __slots__ = ("rv", "type", "obj", "_obj_bytes")
+
+    def __init__(self, rv: int, type_: str, obj: dict) -> None:
+        self.rv = rv
+        self.type = type_
+        self.obj = obj
+        self._obj_bytes: bytes | None = None
+
+    def obj_bytes(self) -> bytes:
+        """The object's JSON encoding, computed once and cached (benign
+        race under the GIL: two threads may both encode, one wins)."""
+        encoded = self._obj_bytes
+        if encoded is None:
+            encoded = json.dumps(self.obj,
+                                 separators=(",", ":")).encode()
+            self._obj_bytes = encoded
+        return encoded
+
+
+class _WatchRing:
+    """Bounded per-kind ring of recent EventFrames in rv order (emission
+    happens under the store lock where rvs are issued, so append order IS
+    rv order). ``evicted_rv`` is the rv of the newest frame pushed out:
+    a resume from N is servable iff every kind event with rv > N is still
+    present, i.e. N >= evicted_rv."""
+
+    __slots__ = ("frames", "evicted_rv", "capacity")
+
+    def __init__(self, capacity: int) -> None:
+        self.frames: deque[EventFrame] = deque()
+        self.evicted_rv = 0
+        self.capacity = capacity
+
+    def append(self, frame: EventFrame) -> int:
+        """Add a frame; returns how many old frames were evicted."""
+        self.frames.append(frame)
+        evicted = 0
+        while len(self.frames) > self.capacity:
+            self.evicted_rv = self.frames.popleft().rv
+            evicted += 1
+        return evicted
+
+    def since(self, rv: int) -> list[EventFrame]:
+        """Frames with rv > ``rv`` (caller verified servability)."""
+        return [f for f in self.frames if f.rv > rv]
+
+
 @dataclass
 class _Watch:
     kind: str
     callback: Callable[[WatchEvent], None]
     namespace: str | None = None
     label_selector: dict[str, str] | None = None
+    #: frame relays (the HTTP facade) receive the shared EventFrame —
+    #: cached wire bytes, no per-watcher deepcopy; plain watches receive
+    #: a WatchEvent carrying the shared object
+    frames: bool = False
 
 
 _now_iso = k8s.now_iso
@@ -97,11 +163,17 @@ class ClusterStore:
         # the same (kind, namespace) shape page after page, and re-sorting
         # the whole kind under the lock per page would make one chunked
         # LIST O(pages × N log N) of lock-held work. Keyed on _last_rv, so
-        # any write invalidates it (deletes don't bump rv — the pop loop
-        # below tolerates keys deleted since the snapshot).
+        # any write invalidates it (deletes bump rv too, for their DELETED
+        # watch frame; the pop loop below still tolerates a stale key).
         self._page_snapshot: tuple | None = None  # (kind, ns, rv, pairs)
         self._uid_counter = itertools.count(1)
         self._watches: list[_Watch] = []
+        # per-kind bounded ring of recent watch frames — the resume window
+        # ``?watch=true&resourceVersion=N`` replays from instead of forcing
+        # a LIST+diff resync; eviction makes such a resume answer 410 Gone
+        self._watch_rings: dict[str, _WatchRing] = {}
+        self.watch_cache_capacity = WATCH_CACHE_CAPACITY
+        self._evictions_metric = None  # watch_cache_evictions_total
         # admission hooks: list of (kind, fn(operation, obj, old) -> obj|raise)
         self._admission: list[tuple[str, Callable]] = []
         # CRD structural schemas: kind → {version: openAPIV3Schema}; kept in
@@ -212,6 +284,72 @@ class ClusterStore:
                 f"{k8s.kind(obj)} {k8s.namespace(obj)}/{k8s.name(obj)} "
                 f"is invalid: {shown}")
 
+    # ----------------------------------------------------------------- watch
+    # emission plumbing: every mutation builds its event frames UNDER the
+    # store lock (ring order is rv order, and a watcher registering
+    # concurrently either lands in the dispatch snapshot or gets the frame
+    # via resume replay — exactly once either way). FRAME relays (the HTTP
+    # facade's per-watcher queues) are fed under the lock too: they are
+    # pure queue appends that never re-enter the store, and in-lock
+    # delivery is what guarantees every watcher queue receives frames in
+    # rv order — two writers dispatching outside the lock could invert
+    # it, and a client whose stream died after the higher rv would resume
+    # PAST the not-yet-delivered lower one, silently losing it. Legacy
+    # WatchEvent callbacks (in-process manager watches) may re-enter the
+    # store, so they still dispatch outside the lock.
+
+    def _emit_locked(self, etype: str, obj: dict) -> tuple:
+        """Build the shared frame for one event, append it to the kind's
+        resume ring, relay it to frame watchers (in rv order, see above),
+        and snapshot matching legacy watchers. Caller holds the lock;
+        returns ``(frame, legacy_targets)`` for _dispatch_all."""
+        kind = k8s.kind(obj)
+        ns = k8s.namespace(obj)
+        try:
+            rv = int(k8s.get_in(obj, "metadata", "resourceVersion") or 0)
+        except (TypeError, ValueError):
+            rv = 0
+        frame = EventFrame(rv, etype, obj)
+        ring = self._watch_rings.get(kind)
+        if ring is None:
+            ring = self._watch_rings[kind] = \
+                _WatchRing(self.watch_cache_capacity)
+        evicted = ring.append(frame)
+        if evicted and self._evictions_metric is not None:
+            self._evictions_metric.inc({"kind": kind}, by=evicted)
+        targets = []
+        for w in self._watches:
+            if w.kind != kind \
+                    or (w.namespace is not None and w.namespace != ns) \
+                    or not k8s.matches_labels(obj, w.label_selector):
+                continue
+            if w.frames:
+                w.callback(frame)
+            else:
+                targets.append(w)
+        return frame, targets
+
+    @staticmethod
+    def _dispatch_all(emitted: list) -> None:
+        """Deliver emitted frames to their snapshotted legacy watchers
+        (outside the lock — these callbacks may re-enter the store). The
+        object is SHARED across all consumers of one event — one deepcopy
+        per event, not per watcher — and must be treated as immutable by
+        callbacks (every in-tree consumer already copies before mutating;
+        the read cache replaces, never edits)."""
+        for frame, targets in emitted:
+            for w in targets:
+                w.callback(WatchEvent(frame.type, frame.obj))
+
+    def attach_metrics(self, registry) -> None:
+        """Register the watch-cache eviction counter (CachingClient and
+        the wrappers pass their registry down here)."""
+        self._evictions_metric = registry.counter(
+            "watch_cache_evictions_total",
+            "Watch-cache ring frames evicted, by kind — each eviction "
+            "narrows the window a reconnecting watcher can resume across "
+            "without a full re-LIST.")
+
     # ----------------------------------------------------------------- verbs
     def create(self, obj: dict) -> dict:
         obj = k8s.deepcopy(obj)
@@ -242,7 +380,8 @@ class ClusterStore:
                               "ValidatingWebhookConfiguration"):
                 self._index_webhook_config(key, obj)
             stored = k8s.deepcopy(obj)
-        self._notify(WatchEvent("ADDED", stored))
+            emitted = [self._emit_locked("ADDED", stored)]
+        self._dispatch_all(emitted)
         return k8s.deepcopy(stored)
 
     def get(self, kind: str, namespace: str, name: str) -> dict:
@@ -297,9 +436,9 @@ class ClusterStore:
             last_pair: tuple[str, str] | None = None
             next_token: str | None = None
             for pair in pairs[start:]:
-                # a key may have been deleted since the snapshot (deletes
-                # don't bump rv): skip — same "objects deleted between
-                # pages may be missed" contract as the real chunked LIST
+                # a key may have been deleted since the snapshot was cut:
+                # skip — same "objects deleted between pages may be
+                # missed" contract as the real chunked LIST
                 obj = self._objects.get(ObjectKey(kind, pair[0], pair[1]))
                 if obj is None or not k8s.matches_labels(obj,
                                                          label_selector):
@@ -333,7 +472,7 @@ class ClusterStore:
 
     def update(self, obj: dict) -> dict:
         obj = k8s.deepcopy(obj)
-        deferred_events: list[WatchEvent] = []
+        emitted: list = []
         key = self._key_of(obj)
         # snapshot + early conflict check, then admit OUTSIDE the lock (see
         # create()); the post-admission check below re-validates that the
@@ -374,7 +513,7 @@ class ClusterStore:
             if (k8s.get_in(obj, "metadata", "deletionTimestamp")
                     and not k8s.get_in(obj, "metadata", "finalizers")):
                 # last finalizer stripped → actually remove (two-phase delete)
-                deferred_events = self._remove_and_gc(key, replacement=obj)
+                emitted = self._remove_and_gc(key, replacement=obj)
             else:
                 self._objects[key] = obj
                 if key.kind == "CustomResourceDefinition":
@@ -382,10 +521,9 @@ class ClusterStore:
                 elif key.kind in ("MutatingWebhookConfiguration",
                                   "ValidatingWebhookConfiguration"):
                     self._index_webhook_config(key, obj)
-                deferred_events = [WatchEvent("MODIFIED", k8s.deepcopy(obj))]
+                emitted = [self._emit_locked("MODIFIED", k8s.deepcopy(obj))]
             stored = k8s.deepcopy(obj)
-        for ev in deferred_events:
-            self._notify(ev)
+        self._dispatch_all(emitted)
         return k8s.deepcopy(stored)
 
     # bounds the patch re-merge loop: each retry re-runs admission (possibly
@@ -431,7 +569,8 @@ class ClusterStore:
             stored["metadata"]["resourceVersion"] = self._next_rv()
             self._objects[key] = stored
             out = k8s.deepcopy(stored)
-        self._notify(WatchEvent("MODIFIED", out))
+            emitted = [self._emit_locked("MODIFIED", out)]
+        self._dispatch_all(emitted)
         return k8s.deepcopy(out)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
@@ -446,7 +585,7 @@ class ClusterStore:
         # DELETE-gating webhooks (operations: ["DELETE"]) fire like the real
         # apiserver's; outside the lock (see create())
         self._run_remote_admission("DELETE", snapshot, snapshot)
-        events: list[WatchEvent] = []
+        emitted: list = []
         with self._lock:
             key = self._key(kind, namespace, name)
             obj = self._objects.get(key)
@@ -456,29 +595,36 @@ class ClusterStore:
                 if not k8s.get_in(obj, "metadata", "deletionTimestamp"):
                     obj["metadata"]["deletionTimestamp"] = _now_iso()
                     obj["metadata"]["resourceVersion"] = self._next_rv()
-                    events.append(WatchEvent("MODIFIED", k8s.deepcopy(obj)))
+                    emitted.append(self._emit_locked("MODIFIED",
+                                                     k8s.deepcopy(obj)))
             else:
-                events.extend(self._remove_and_gc(key))
-        for ev in events:
-            self._notify(ev)
+                emitted.extend(self._remove_and_gc(key))
+        self._dispatch_all(emitted)
 
     # ------------------------------------------------------- delete plumbing
     def _remove_and_gc(self, key: ObjectKey,
-                       replacement: dict | None = None) -> list[WatchEvent]:
+                       replacement: dict | None = None) -> list:
         """Remove object and cascade-delete dependents via ownerReferences,
-        honoring dependents' own finalizers. Caller holds the lock."""
+        honoring dependents' own finalizers. Caller holds the lock; returns
+        emissions for _dispatch_all. The DELETED event carries a FRESH
+        resourceVersion (as the real apiserver's does — the deletion is an
+        etcd revision): the resume ring is rv-ordered, and a DELETED frame
+        reusing the object's last-write rv would sort before newer events
+        and be skipped by any resume past it — a silently lost deletion."""
         obj = replacement if replacement is not None else self._objects.get(key)
-        events: list[WatchEvent] = []
+        emitted: list = []
         if key in self._objects:
             del self._objects[key]
         if obj is None:
-            return events
+            return emitted
         if key.kind == "CustomResourceDefinition":
             self._unindex_crd(obj)
         elif key.kind in ("MutatingWebhookConfiguration",
                           "ValidatingWebhookConfiguration"):
             self._unindex_webhook_config(key)
-        events.append(WatchEvent("DELETED", k8s.deepcopy(obj)))
+        final = k8s.deepcopy(obj)
+        final["metadata"]["resourceVersion"] = self._next_rv()
+        emitted.append(self._emit_locked("DELETED", final))
         owner_uid = k8s.uid(obj)
         if owner_uid:
             dependents = [dk for dk, dobj in self._objects.items()
@@ -491,36 +637,56 @@ class ClusterStore:
                     if not k8s.get_in(dobj, "metadata", "deletionTimestamp"):
                         dobj["metadata"]["deletionTimestamp"] = _now_iso()
                         dobj["metadata"]["resourceVersion"] = self._next_rv()
-                        events.append(WatchEvent("MODIFIED", k8s.deepcopy(dobj)))
+                        emitted.append(self._emit_locked(
+                            "MODIFIED", k8s.deepcopy(dobj)))
                 else:
-                    events.extend(self._remove_and_gc(dk))
-        return events
+                    emitted.extend(self._remove_and_gc(dk))
+        return emitted
 
-    # ----------------------------------------------------------------- watch
+    # ---------------------------------------------------- watch registration
     def watch(self, kind: str, callback: Callable[[WatchEvent], None],
               namespace: str | None = None,
               label_selector: dict[str, str] | None = None) -> None:
         with self._lock:
             self._watches.append(_Watch(kind, callback, namespace, label_selector))
 
+    def watch_frames(self, kind: str, relay: Callable,
+                     namespace: str | None = None,
+                     label_selector: dict[str, str] | None = None,
+                     since_rv: int | None = None) -> tuple[list, int]:
+        """Register a frame relay (the HTTP facade's serialize-once path)
+        and, when ``since_rv`` is given, atomically hand back the replay
+        of every retained event after it — the RV-resumable reconnect
+        that replaces the client's LIST+diff resync. Returns ``(replay,
+        anchor_rv)``; ``anchor_rv`` is the resourceVersion through which
+        the stream is complete at registration (the idle-stream BOOKMARK
+        anchor). Raises GoneError when ``since_rv`` predates the retained
+        window — or names a version this store never issued (a resume
+        against a different store incarnation must relist, never
+        silently skip)."""
+        with self._lock:
+            replay: list[EventFrame] = []
+            if since_rv is not None:
+                ring = self._watch_rings.get(kind)
+                evicted_rv = ring.evicted_rv if ring is not None else 0
+                if since_rv < evicted_rv or since_rv > self._last_rv:
+                    raise GoneError(
+                        f"too old resource version: {since_rv} (the watch "
+                        f"cache window for {kind} starts at {evicted_rv})")
+                if ring is not None:
+                    replay = [f for f in ring.since(since_rv)
+                              if (namespace is None
+                                  or k8s.namespace(f.obj) == namespace)
+                              and k8s.matches_labels(f.obj, label_selector)]
+            self._watches.append(_Watch(kind, relay, namespace,
+                                        label_selector, frames=True))
+            return replay, self._last_rv
+
     def unwatch(self, callback: Callable[[WatchEvent], None]) -> None:
         """Deregister a watch callback (watch stream teardown — the apiserver
         facade drops its per-connection relay when the HTTP client goes away)."""
         with self._lock:
             self._watches = [w for w in self._watches if w.callback is not callback]
-
-    def _notify(self, event: WatchEvent) -> None:
-        kind = k8s.kind(event.obj)
-        ns = k8s.namespace(event.obj)
-        # snapshot under lock, dispatch outside to avoid deadlocks with
-        # callbacks that call back into the store
-        with self._lock:
-            targets = [w for w in self._watches
-                       if w.kind == kind
-                       and (w.namespace is None or w.namespace == ns)
-                       and k8s.matches_labels(event.obj, w.label_selector)]
-        for w in targets:
-            w.callback(WatchEvent(event.type, k8s.deepcopy(event.obj)))
 
     # ----------------------------------------------------------- conveniences
     def get_or_none(self, kind: str, namespace: str, name: str) -> dict | None:

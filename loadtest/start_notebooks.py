@@ -140,7 +140,11 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
              fault_seed: int | None = 7,
              list_page_size: int | None = None,
              max_full_scans: int | None = None,
-             preempt_rate: float = 0.0) -> int:
+             preempt_rate: float = 0.0,
+             watch_kill_after_s: float = 0.0,
+             max_relist_resyncs: int | None = None,
+             min_conn_reuse: float | None = None,
+             settle_s: float = 0.0) -> int:
     """Controller wire-cost measurement: the full controller stack runs
     over a real HTTP apiserver while the load generator drives the store
     directly, so ``rest_client_requests_total`` counts ONLY controller
@@ -169,7 +173,17 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
     (slice atomicity — replicas must only ever be 0 or the full worker
     count), any preempted slice not repaired back to SliceReady with its
     health state cleared, and any slice quarantined by a single
-    preemption."""
+    preemption.
+
+    ``watch_kill_after_s`` arms a watch-kill-only FaultPlan: EVERY watch
+    stream is killed that long after connecting, for the whole run — the
+    RV-resume chaos shape. ``max_relist_resyncs`` bounds
+    ``watch_resumes_total{mode="relist"}`` (0 = every reconnect resumed
+    from the server watch cache, zero full re-LISTs);
+    ``min_conn_reuse`` bounds requests-per-connection from below (the
+    keep-alive pool's proof that connections don't scale with requests).
+    ``settle_s`` keeps the run alive that long after convergence so
+    reconnect chaos actually happens on an idle fleet too."""
     import tempfile
 
     from kubeflow_tpu.api import types as api
@@ -184,12 +198,22 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
     from kubeflow_tpu.utils.metrics import MetricsRegistry
 
     plan = None
+    audit_needed = False
     if fault_plan:
         plan = FaultPlan.from_file(fault_plan)
+        audit_needed = True
     elif fault_rate > 0:
         plan = FaultPlan.uniform(fault_rate, seed=fault_seed)
+        audit_needed = True
+    elif watch_kill_after_s > 0:
+        # watch-kill-only chaos: streams die, mutations never do — no
+        # duplicate-write ambiguity to audit
+        from kubeflow_tpu.cluster.faults import FAULT_WATCH_KILL, FaultRule
+        plan = FaultPlan([FaultRule(FAULT_WATCH_KILL, 1.0,
+                                    after_s=watch_kill_after_s)],
+                         seed=fault_seed)
     audit_path = None
-    if plan is not None:
+    if audit_needed:
         audit_file = tempfile.NamedTemporaryFile(suffix=".ndjson",
                                                  delete=False)
         audit_file.close()
@@ -219,6 +243,10 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
         client = HttpApiClient(proxy.url, list_page_size=list_page_size)
         cleanups.append(client.close)
         metrics = MetricsRegistry()
+        # one exposition for the whole watch path: the proxy registers the
+        # serve-side coalescing counter and passes the registry down to
+        # the store (watch-cache evictions)
+        proxy.attach_metrics(metrics)
         mgr = setup_controllers(client, metrics=metrics,
                                 max_concurrent_reconciles=workers)
         mgr.start()
@@ -298,6 +326,10 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
                 name, namespace,
                 annotations={names.TPU_ACCELERATOR_ANNOTATION: accelerator}))
         all_ready.wait(timeout)
+        if settle_s > 0:
+            # idle-fleet window: watch chaos keeps firing while nothing
+            # changes — reconnects must resume off bookmarks, not relist
+            time.sleep(settle_s)
         # preempted slices must come back: repaired slice-atomically to
         # SliceReady with the health state cleared and NO quarantine (a
         # single preemption is normal fleet weather, not a poison pill)
@@ -369,6 +401,32 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
               f"phase wall: read {read_s.total_sum():.2f}s / "
               f"write {write_s.total_sum():.2f}s over "
               f"{read_s.total_count():.0f} reconciles")
+        resumes_metric = metrics.counter("watch_resumes_total", "")
+        resumed = resumes_metric.sum_where({"mode": "resume"})
+        relisted = resumes_metric.sum_where({"mode": "relist"})
+        evictions = metrics.counter("watch_cache_evictions_total",
+                                    "").total()
+        coalesced = metrics.counter("watch_queue_coalesced_total",
+                                    "").total()
+        conns_metric = metrics.counter("rest_client_connections_opened_total",
+                                       "")
+        pooled_conns = conns_metric.sum_where({"type": "pooled"})
+        stream_conns = conns_metric.sum_where({"type": "stream"})
+        reqs_total = requests.total()
+        # reuse = request-path requests per pooled connection. Watch
+        # connect GETs each ride a dedicated stream connection (one
+        # stream = one connection by design; chaos churns those
+        # legitimately) — subtract them from the numerator or every
+        # stream would inflate the pooled ratio by ~1 request with no
+        # pooled connection in the denominator
+        pooled_reqs = max(reqs_total - stream_conns, 0.0)
+        reuse = pooled_reqs / pooled_conns if pooled_conns else 0.0
+        print(f"watch: {resumed:.0f} RV-resumes, {relisted:.0f} relist "
+              f"resyncs, {evictions:.0f} cache evictions, "
+              f"{coalesced:.0f} coalesced frames  "
+              f"transport: {pooled_conns:.0f} pooled + {stream_conns:.0f} "
+              f"stream connections for {reqs_total:.0f} requests "
+              f"(reuse {reuse:.1f}x)")
         _print_latencies(sorted(ready_at[n] - created_at[n]
                                 for n in ready_at))
         if max_requests_per_nb is not None and per_nb > max_requests_per_nb:
@@ -378,6 +436,25 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
         if max_full_scans is not None and full_scans > max_full_scans:
             print(f"FAIL: {full_scans:.0f} cache full scans exceed bound "
                   f"{max_full_scans} (an unindexed hot-path LIST crept in)")
+            return 1
+        if max_relist_resyncs is not None:
+            if watch_kill_after_s > 0 and resumed == 0:
+                # vacuous-pass guard: the kill plan must actually have
+                # forced reconnects for the zero-relist bound to mean
+                # anything
+                print("FAIL: watch-kill chaos armed but no RV-resume ever "
+                      "happened (streams never reconnected?)")
+                return 1
+            if relisted > max_relist_resyncs:
+                print(f"FAIL: {relisted:.0f} relist resyncs exceed bound "
+                      f"{max_relist_resyncs} (a reconnect fell off the "
+                      f"resume path)")
+                return 1
+        if min_conn_reuse is not None and reuse < min_conn_reuse:
+            print(f"FAIL: connection reuse {reuse:.1f}x below bound "
+                  f"{min_conn_reuse}x ({pooled_conns:.0f} pooled "
+                  f"connections for {pooled_reqs:.0f} pooled-path requests "
+                  f"— keep-alive pooling regressed)")
             return 1
         if partial_observed:
             sample = partial_observed[:5]
@@ -481,6 +558,21 @@ def main() -> int:
                          "turns Ready; the run fails on any partially "
                          "scaled StatefulSet, unrepaired slice, or "
                          "quarantine from a single preemption")
+    ap.add_argument("--watch-kill-after-s", type=float, default=0.0,
+                    help="with --wire: kill EVERY watch stream this long "
+                         "after it connects, for the whole run (the "
+                         "RV-resume chaos shape)")
+    ap.add_argument("--max-relist-resyncs", type=int, default=None,
+                    help="with --wire: fail if more than this many watch "
+                         "reconnects fell back to a full LIST+diff resync "
+                         "(0 = every reconnect resumed by resourceVersion)")
+    ap.add_argument("--min-conn-reuse", type=float, default=None,
+                    help="with --wire: fail if apiserver requests per "
+                         "opened TCP connection drop below this (keep-"
+                         "alive pooling regression guard)")
+    ap.add_argument("--settle-s", type=float, default=0.0,
+                    help="with --wire: keep the run alive this long after "
+                         "convergence (idle-fleet watch chaos window)")
     args = ap.parse_args()
     if args.emit_yaml:
         try:
@@ -501,7 +593,11 @@ def main() -> int:
                         fault_seed=args.fault_seed,
                         list_page_size=args.list_page_size,
                         max_full_scans=args.max_full_scans,
-                        preempt_rate=args.preempt_rate)
+                        preempt_rate=args.preempt_rate,
+                        watch_kill_after_s=args.watch_kill_after_s,
+                        max_relist_resyncs=args.max_relist_resyncs,
+                        min_conn_reuse=args.min_conn_reuse,
+                        settle_s=args.settle_s)
     return run_inprocess(args.count, args.namespace, args.accelerator,
                          args.timeout, server=args.server,
                          workers=args.workers)

@@ -363,9 +363,12 @@ def test_wire_pagination_same_item_set_and_rv0():
                 paged.list("ConfigMap", "ns", {"app": "x"})) == sorted(
                 k8s.name(o) for o in
                 unpaged.list("ConfigMap", "ns", {"app": "x"}))
-            # rv=0 cache-ack form (the resync list) pages identically
-            assert len(paged._list("ConfigMap", None, None,
-                                   resource_version="0")) == 10
+            # rv=0 cache-ack form (the resync list) pages identically,
+            # and the list rv anchor comes back with the items
+            items, list_rv = paged._list("ConfigMap", None, None,
+                                         resource_version="0")
+            assert len(items) == 10
+            assert list_rv == 10  # 10 creates → last issued rv
         finally:
             paged.close()
             unpaged.close()

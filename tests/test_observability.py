@@ -218,6 +218,55 @@ def test_slice_repair_metric_families_exported():
     assert 'slice_degraded{namespace="ns",state="Degraded"} 0' in text
 
 
+# ------------------------------------------------- watch-path metric families
+
+def test_watch_path_metric_families_exported():
+    """The four watch-path families land in one exposition with their
+    label shapes: client-side resume accounting (watch_resumes_total by
+    kind+mode, rest_client_connections_opened_total by type), store-side
+    ring evictions (watch_cache_evictions_total by kind), and serve-side
+    fan-out coalescing (watch_queue_coalesced_total by kind)."""
+    from kubeflow_tpu.cluster.apiserver import ApiServerProxy, _WatcherQueue
+    from kubeflow_tpu.cluster.http_client import HttpApiClient
+    from kubeflow_tpu.cluster.store import EventFrame
+
+    store = ClusterStore()
+    store.watch_cache_capacity = 1
+    metrics = MetricsRegistry()
+    proxy = ApiServerProxy(store)
+    proxy.attach_metrics(metrics)  # registers coalescing + store evictions
+    proxy.start()
+    client = HttpApiClient(proxy.url)
+    client.attach_metrics(metrics)
+    try:
+        # one pooled connection + two requests; ring of 1 → one eviction
+        client.create({"kind": "ConfigMap", "apiVersion": "v1",
+                       "metadata": {"name": "a", "namespace": "ns"}})
+        client.create({"kind": "ConfigMap", "apiVersion": "v1",
+                       "metadata": {"name": "b", "namespace": "ns"}})
+        # the serve-side queue counts coalesced frames through the same
+        # closure the watch handler wires up
+        coalesce = metrics.counter("watch_queue_coalesced_total", "")
+        q = _WatcherQueue(soft_limit=0,
+                          on_coalesce=lambda: coalesce.inc(
+                              {"kind": "ConfigMap"}))
+        obj = {"kind": "ConfigMap",
+               "metadata": {"name": "a", "namespace": "ns"}}
+        q.put(EventFrame(1, "ADDED", obj))
+        q.put(EventFrame(2, "MODIFIED", obj))
+        client._count_resume("ConfigMap", "resume")
+        client._count_resume("ConfigMap", "relist")
+    finally:
+        client.close()
+        proxy.stop()
+    text = metrics.expose()
+    assert 'watch_resumes_total{kind="ConfigMap",mode="resume"} 1' in text
+    assert 'watch_resumes_total{kind="ConfigMap",mode="relist"} 1' in text
+    assert 'watch_cache_evictions_total{kind="ConfigMap"} 1' in text
+    assert 'watch_queue_coalesced_total{kind="ConfigMap"} 1' in text
+    assert 'rest_client_connections_opened_total{type="pooled"} 1' in text
+
+
 # ------------------------------------------------------------ health server
 
 def _get(url):

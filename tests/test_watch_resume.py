@@ -1,0 +1,623 @@
+"""RV-resumable watch cache + serialize-once fan-out + keep-alive pool.
+
+The watch path's O(delta) contract, pinned end to end over the real wire:
+
+- a dropped stream reconnects with ``?resourceVersion=N`` and replays the
+  retained window from the server watch cache — NO full re-LIST resync
+  (``watch_resumes_total{mode=resume}``), and no watch-gap degraded mode;
+- a resume past the evicted window answers ``410 Gone`` and the client
+  falls back to the original LIST+diff resync (mode=relist) — never
+  silently skipping events;
+- randomized interleavings of creates/updates/deletes across repeated
+  stream kills converge the consumer to exactly the store state on both
+  the resume path and the forced-410 path;
+- a stalled watcher holds a bounded, MODIFIED-coalescing queue while
+  healthy watchers' delivery is unaffected;
+- requests ride per-thread keep-alive connections, and a stale pooled
+  connection (apiserver restart) recovers with one transparent retry.
+"""
+
+import json
+import random
+import socket
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.cluster import http_client as hc
+from kubeflow_tpu.cluster.apiserver import (ApiServerProxy, _WatcherQueue)
+from kubeflow_tpu.cluster.errors import ConflictError, GoneError
+from kubeflow_tpu.cluster.faults import FAULT_RESET, FaultPlan, FaultRule
+from kubeflow_tpu.cluster.http_client import HttpApiClient, RetryPolicy
+from kubeflow_tpu.cluster.store import ClusterStore, EventFrame
+from kubeflow_tpu.utils import k8s
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+FAST = RetryPolicy(max_attempts=4, backoff_base_s=0.01, backoff_cap_s=0.1)
+
+
+@pytest.fixture()
+def server(store):
+    proxy = ApiServerProxy(store)
+    proxy.start()
+    yield proxy
+    proxy.stop()
+
+
+def make_client(server, metrics=None):
+    cl = HttpApiClient(server.url, retry_policy=FAST)
+    if metrics is not None:
+        cl.attach_metrics(metrics)
+    return cl
+
+
+def cm(name, ns="default", data=None, labels=None):
+    obj = {"kind": "ConfigMap", "apiVersion": "v1",
+           "metadata": {"name": name, "namespace": ns},
+           "data": data or {"k": "v"}}
+    if labels:
+        obj["metadata"]["labels"] = labels
+    return obj
+
+
+def wait_for(fn, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = fn()
+        if result:
+            return result
+        time.sleep(0.01)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+# ------------------------------------------------------------ store ring
+
+def test_store_ring_replays_and_evicts(store):
+    store.watch_cache_capacity = 4
+    for i in range(3):
+        store.create(cm(f"a{i}"))
+    replay, anchor = store.watch_frames("ConfigMap", lambda f: None,
+                                        since_rv=1)
+    assert [f.type for f in replay] == ["ADDED", "ADDED"]
+    assert anchor == 3
+    # overflow the ring: resume from before the window → 410
+    for i in range(6):
+        store.patch("ConfigMap", "default", "a0", {"data": {"k": str(i)}})
+    with pytest.raises(GoneError):
+        store.watch_frames("ConfigMap", lambda f: None, since_rv=1)
+    # a future rv (another store incarnation) is Gone too, never silence
+    with pytest.raises(GoneError):
+        store.watch_frames("ConfigMap", lambda f: None, since_rv=10**9)
+
+
+def test_deleted_frame_carries_fresh_rv(store):
+    """The DELETED watch frame must carry a NEW resourceVersion: the
+    resume ring is rv-ordered, and a deletion reusing the object's
+    last-write rv would sort before newer events and be skipped by any
+    resume past it — a silently lost deletion."""
+    created = store.create(cm("doomed"))
+    store.create(cm("other"))  # bumps rv past the doomed object's
+    frames = []
+    store.watch_frames("ConfigMap", frames.append)
+    store.delete("ConfigMap", "default", "doomed")
+    deleted = [f for f in frames if f.type == "DELETED"]
+    assert len(deleted) == 1
+    assert deleted[0].rv > int(created["metadata"]["resourceVersion"]) + 1
+    # and a resume from just-before the delete replays it
+    replay, _ = store.watch_frames("ConfigMap", lambda f: None,
+                                   since_rv=deleted[0].rv - 1)
+    assert [f.type for f in replay] == ["DELETED"]
+
+
+# ------------------------------------------------- resume over the wire
+
+def test_stream_drop_resumes_without_relist(store, monkeypatch):
+    """Dropped streams (apiserver restart — every connection dies)
+    reconnect by resourceVersion: events landing while the stream is down
+    replay from the watch cache — zero LIST+diff resyncs after the first
+    connect, and no watch-gap degraded window ever opens."""
+    monkeypatch.setattr(hc, "WATCH_RECONNECT_DELAY_S", 0.05)
+    proxy = ApiServerProxy(store)
+    proxy.start()
+    port = proxy.port
+    metrics = MetricsRegistry()
+    client = HttpApiClient(proxy.url, retry_policy=FAST, metrics=metrics)
+    gaps = []
+    client.set_watch_gap_listener(lambda kind: gaps.append(("gap", kind)),
+                                  lambda kind: gaps.append(("ok", kind)))
+    try:
+        events = []
+        client.watch("ConfigMap", lambda ev: events.append(
+            (ev.type, k8s.name(ev.obj))))
+        store.create(cm("pre"))
+        wait_for(lambda: ("ADDED", "pre") in events, msg="pre event")
+        for round_no in range(2):
+            proxy.stop()  # kills the live stream AND the pooled conns
+            for i in range(3):
+                store.create(cm(f"during-{round_no}-{i}"))
+            proxy = ApiServerProxy(store, port=port)
+            proxy.start()
+            for i in range(3):
+                wait_for(lambda r=round_no, i=i:
+                         ("ADDED", f"during-{r}-{i}") in events,
+                         msg=f"during-{round_no}-{i} replayed on resume")
+        resumes = metrics.counter("watch_resumes_total", "")
+        assert resumes.sum_where({"mode": "resume"}) >= 2
+        assert resumes.sum_where({"mode": "relist"}) == 0
+        # resume path never opened a degraded window
+        assert not [g for g in gaps if g[0] == "gap"]
+    finally:
+        client.close()
+        proxy.stop()
+
+
+def test_eviction_410_falls_back_to_relist(store, monkeypatch):
+    """A resume past the evicted window gets 410 Gone and the client runs
+    the full LIST+diff resync — converging (deletions included) instead of
+    silently skipping the evicted events. The fallback IS a gap: degraded
+    mode flips for the relist window."""
+    monkeypatch.setattr(hc, "WATCH_RECONNECT_DELAY_S", 0.05)
+    store.watch_cache_capacity = 2
+    proxy = ApiServerProxy(store)
+    proxy.start()
+    port = proxy.port
+    metrics = MetricsRegistry()
+    store.attach_metrics(metrics)
+    client = HttpApiClient(proxy.url, retry_policy=FAST, metrics=metrics)
+    gaps = []
+    client.set_watch_gap_listener(lambda kind: gaps.append("gap"),
+                                  lambda kind: gaps.append("ok"))
+    try:
+        events = []
+        client.watch("ConfigMap", lambda ev: events.append(
+            (ev.type, k8s.name(ev.obj))))
+        store.create(cm("pre"))
+        wait_for(lambda: ("ADDED", "pre") in events, msg="pre event")
+        # outage with far more churn than the 2-frame ring retains
+        proxy.stop()
+        store.delete("ConfigMap", "default", "pre")
+        for i in range(10):
+            store.create(cm(f"post-{i}"))
+        proxy = ApiServerProxy(store, port=port)
+        proxy.start()
+        for i in range(10):
+            wait_for(lambda i=i: ("ADDED", f"post-{i}") in events,
+                     msg=f"post-{i} after 410 relist")
+        wait_for(lambda: ("DELETED", "pre") in events,
+                 msg="outage deletion synthesized by the relist diff")
+        resumes = metrics.counter("watch_resumes_total", "")
+        assert resumes.sum_where({"mode": "relist"}) >= 1
+        assert metrics.counter("watch_cache_evictions_total",
+                               "").total() > 0
+        assert "gap" in gaps and "ok" in gaps  # degraded window opened+closed
+    finally:
+        client.close()
+        proxy.stop()
+
+
+@pytest.mark.parametrize("capacity,expect_relist", [(4096, False), (1, True)])
+def test_resume_vs_relist_equivalence_randomized(store, capacity,
+                                                 expect_relist, monkeypatch):
+    """Randomized creates/updates/deletes across repeated stream kills:
+    the consumer's level state (upsert on ADDED/MODIFIED, drop on
+    DELETED) converges to exactly the store's state — on the pure resume
+    path (big ring, zero relists) and on the forced-eviction path (ring
+    of 1, every reconnect 410→relist) alike."""
+    monkeypatch.setattr(hc, "WATCH_RECONNECT_DELAY_S", 0.02)
+    store.watch_cache_capacity = capacity
+    proxy = ApiServerProxy(store)
+    proxy.start()
+    metrics = MetricsRegistry()
+    client = HttpApiClient(proxy.url, retry_policy=FAST, metrics=metrics)
+    state: dict[str, dict] = {}
+    state_lock = threading.Lock()
+
+    def consume(ev):
+        with state_lock:
+            if ev.type == "DELETED":
+                state.pop(k8s.name(ev.obj), None)
+            else:
+                state[k8s.name(ev.obj)] = ev.obj
+    port = proxy.port
+    try:
+        client.watch("ConfigMap", consume)
+        # land the first connect fully (initial list delivered, resume
+        # cursor anchored) before the kill rounds: the rounds measure
+        # RECONNECT behavior, not first-connect races
+        store.create(cm("sentinel", data={"v": "0"}))
+        wait_for(lambda: "sentinel" in state, msg="first connect delivered")
+        rng = random.Random(11)
+        live: list[str] = ["sentinel"]
+        counter = 0
+        for round_no in range(6):
+            # drop every stream mid-churn: mutations land while the
+            # watcher is down, in randomized interleavings
+            proxy.stop()
+            for _ in range(15):
+                op = rng.random()
+                if op < 0.5 or not live:
+                    name = f"obj-{counter}"
+                    counter += 1
+                    store.create(cm(name, data={"v": "0"}))
+                    live.append(name)
+                elif op < 0.8:
+                    name = rng.choice(live)
+                    store.patch("ConfigMap", "default", name,
+                                {"data": {"v": str(rng.randint(1, 9))}})
+                else:
+                    name = live.pop(rng.randrange(len(live)))
+                    store.delete("ConfigMap", "default", name)
+            proxy = ApiServerProxy(store, port=port)
+            proxy.start()
+            time.sleep(rng.random() * 0.1)
+
+        def converged():
+            want = {k8s.name(o): o for o in store.list("ConfigMap")}
+            with state_lock:
+                got = dict(state)
+            return set(got) == set(want) and all(
+                got[n]["metadata"]["resourceVersion"] ==
+                want[n]["metadata"]["resourceVersion"] and
+                got[n]["data"] == want[n]["data"] for n in want)
+        wait_for(converged, timeout=20.0,
+                 msg=f"consumer == store (capacity={capacity})")
+        resumes = metrics.counter("watch_resumes_total", "")
+        if expect_relist:
+            assert resumes.sum_where({"mode": "relist"}) >= 1
+        else:
+            assert resumes.sum_where({"mode": "relist"}) == 0
+            assert resumes.sum_where({"mode": "resume"}) >= 1
+    finally:
+        client.close()
+        proxy.stop()
+
+
+# ----------------------------------------------------- BOOKMARK frames
+
+def test_bookmark_frames_carry_resource_version(server, store):
+    """BOOKMARK frames carry metadata.resourceVersion (real-apiserver
+    conformance) — the resume anchor a client needs on an idle stream;
+    the connect-time bookmark hands it over immediately."""
+    store.create(cm("anchor"))
+    raw = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+    try:
+        raw.sendall(b"GET /api/v1/configmaps?watch=true HTTP/1.1\r\n"
+                    b"Host: x\r\nAccept: application/json\r\n\r\n")
+        buf = b""
+        deadline = time.monotonic() + 5
+        bookmark = None
+        while time.monotonic() < deadline and bookmark is None:
+            buf += raw.recv(65536)
+            for line in buf.split(b"\r\n")[-1].split(b"\n"):
+                if not line.startswith(b"{"):
+                    continue
+                frame = json.loads(line)
+                if frame["type"] == "BOOKMARK":
+                    bookmark = frame
+                    break
+        assert bookmark is not None, "no BOOKMARK on the stream"
+        rv = k8s.get_in(bookmark["object"], "metadata", "resourceVersion")
+        assert rv == str(store._last_rv)
+    finally:
+        raw.close()
+
+
+def test_idle_stream_drop_resumes_off_bookmark(store, monkeypatch):
+    """A stream dropped while IDLE — before any event was ever delivered
+    on it — still reconnects in resume mode: the connect-time bookmark
+    anchored it. Armed watch-kill faults cover the same shape over the
+    FaultPlan path (ci/loadtest_smoke watch-kill phase)."""
+    monkeypatch.setattr(hc, "WATCH_RECONNECT_DELAY_S", 0.05)
+    store.create(cm("existing"))
+    proxy = ApiServerProxy(store)
+    proxy.start()
+    port = proxy.port
+    metrics = MetricsRegistry()
+    client = HttpApiClient(proxy.url, retry_policy=FAST, metrics=metrics)
+    try:
+        events = []
+        client.watch("ConfigMap", lambda ev: events.append(k8s.name(ev.obj)))
+        wait_for(lambda: "existing" in events, msg="initial replay")
+        for _ in range(2):  # idle drop/reconnect cycles, nothing changing
+            proxy.stop()
+            proxy = ApiServerProxy(store, port=port)
+            proxy.start()
+            time.sleep(0.3)
+        store.create(cm("after-idle-drops"))
+        wait_for(lambda: "after-idle-drops" in events, msg="post-drop event")
+        resumes = metrics.counter("watch_resumes_total", "")
+        assert resumes.sum_where({"mode": "resume"}) >= 1
+        assert resumes.sum_where({"mode": "relist"}) == 0
+    finally:
+        client.close()
+        proxy.stop()
+
+
+# -------------------------------------------------- coalescing fan-out
+
+def frame(rv, etype, name, ns="default", payload="x"):
+    return EventFrame(rv, etype, {"kind": "ConfigMap",
+                                  "metadata": {"name": name,
+                                               "namespace": ns},
+                                  "data": {"k": payload}})
+
+
+def test_watcher_queue_coalesces_modified_under_backpressure():
+    coalesced = []
+    q = _WatcherQueue(soft_limit=4, on_coalesce=lambda: coalesced.append(1))
+    rv = 0
+    for i in range(4):  # fill to the soft limit
+        rv += 1
+        q.put(frame(rv, "ADDED", f"obj-{i}"))
+    for _ in range(50):  # MODIFIED flood on one hot key: latest wins in place
+        rv += 1
+        q.put(frame(rv, "MODIFIED", "hot", payload=str(rv)))
+    assert len(q) == 5  # 4 ADDED + ONE pending slot for the hot key
+    assert len(coalesced) == 49
+    drained = []
+    while True:
+        etype, fr = q.get(timeout=0.0)
+        if fr is None:
+            break
+        drained.append((etype, k8s.name(fr.obj), fr.obj["data"]["k"]))
+    # the coalesced slot delivers the LATEST state exactly once
+    assert drained[-1] == ("MODIFIED", "hot", str(rv))
+    assert [d for d in drained if d[0] == "MODIFIED"] == [drained[-1]]
+
+
+def test_watcher_queue_preserves_added_type_and_delete_edges():
+    q = _WatcherQueue(soft_limit=0)  # always coalescing
+    q.put(frame(1, "ADDED", "a"))
+    q.put(frame(2, "MODIFIED", "a", payload="new"))  # upgrades ADDED's state
+    etype, fr = q.get(timeout=0.0)
+    # level semantics: an undelivered ADDED stays ADDED, newest payload
+    assert etype == "ADDED" and fr.obj["data"]["k"] == "new"
+    # DELETED always appends and fences the key: a MODIFIED of the NEXT
+    # incarnation must never merge into the pre-delete slot
+    q.put(frame(3, "MODIFIED", "b"))
+    q.put(frame(4, "DELETED", "b"))
+    q.put(frame(5, "MODIFIED", "b", payload="reborn"))
+    kinds = []
+    while True:
+        etype, fr = q.get(timeout=0.0)
+        if fr is None:
+            break
+        kinds.append(etype)
+    assert kinds == ["MODIFIED", "DELETED", "MODIFIED"]
+
+
+def test_watcher_queue_hard_cap_flags_overflow():
+    """ADDED/DELETED frames never coalesce (edges must not be lost), so a
+    stalled watcher under create/delete churn is bounded by the HARD cap
+    instead: past it the queue drops its backlog and flips ``overflowed``
+    — the streaming thread closes the stream and the client's RV-resume
+    (or 410→relist) re-covers the events level-safely."""
+    q = _WatcherQueue(soft_limit=0, hard_limit=8)
+    for i in range(8):
+        q.put(frame(i + 1, "ADDED", f"obj-{i}"))
+    assert len(q) == 8 and not q.overflowed
+    q.put(frame(9, "ADDED", "straw"))  # over the cap: drop + flag
+    assert q.overflowed and len(q) == 0
+    q.put(frame(10, "ADDED", "late"))  # post-overflow puts accumulate nothing
+    assert len(q) == 0
+    assert q.get(timeout=0.0) == (None, None)
+
+
+def test_stalled_watcher_bounded_other_watchers_unaffected(server, store):
+    """A watcher that never reads holds bounded queue memory (MODIFIED
+    coalescing engaged) while a healthy watcher keeps getting events
+    promptly."""
+    metrics = MetricsRegistry()
+    server.attach_metrics(metrics)
+    store.create(cm("hot", data={"pad": "y" * 2048}))
+    # stalled watcher: open the stream, read the headers, then stop reading
+    raw = socket.create_connection(("127.0.0.1", server.port), timeout=5)
+    raw.sendall(b"GET /api/v1/configmaps?watch=true HTTP/1.1\r\n"
+                b"Host: x\r\nAccept: application/json\r\n\r\n")
+    raw.recv(1024)
+    client = make_client(server)
+    try:
+        events = []
+        client.watch("ConfigMap", lambda ev: events.append(
+            (ev.type, ev.obj["data"].get("n"))))
+        wait_for(lambda: events, msg="healthy watcher connected")
+        # MODIFIED flood on one hot key, fat payloads: the stalled
+        # watcher's socket backs up, its queue must coalesce and stay
+        # bounded instead of growing one frame per event
+        n_events = 3000
+        for i in range(n_events):
+            store.update_status({"kind": "ConfigMap", "apiVersion": "v1",
+                                 "metadata": {"name": "hot",
+                                              "namespace": "default"},
+                                 "status": {"n": str(i)}})
+        queues = server.active_watch_queues
+        assert queues, "no live watcher queues to introspect"
+        depth = max(len(q) for q in queues)
+        assert depth < 300, f"stalled watcher queue grew to {depth}"
+        assert metrics.counter("watch_queue_coalesced_total",
+                               "").total() > 0
+        # the healthy watcher saw the flood land promptly (level-wise:
+        # at least the tail state arrives)
+        store.create(cm("after-flood"))
+        wait_for(lambda: any(t == "ADDED" and events for t, _ in events),
+                 msg="healthy watcher still delivering")
+    finally:
+        client.close()
+        raw.close()
+
+
+# ----------------------------------------------------- keep-alive pool
+
+def test_pool_reuses_one_connection_per_thread(server, store):
+    metrics = MetricsRegistry()
+    client = make_client(server, metrics)
+    try:
+        client.create(cm("pool"))
+        for _ in range(20):
+            client.get("ConfigMap", "default", "pool")
+        conns = metrics.counter("rest_client_connections_opened_total", "")
+        assert conns.sum_where({"type": "pooled"}) == 1
+        assert metrics.counter("rest_client_requests_total",
+                               "").total() == 21
+    finally:
+        client.close()
+
+
+def test_pool_recovers_stale_connection_after_restart(store):
+    """Apiserver restart: the pooled connection is dead; the next request
+    retries ONCE on a fresh connection transparently — no error, no
+    RetryPolicy attempt burned."""
+    proxy = ApiServerProxy(store)
+    proxy.start()
+    port = proxy.port
+    metrics = MetricsRegistry()
+    client = make_client(proxy, metrics)
+    try:
+        client.create(cm("durable"))
+        assert client.get("ConfigMap", "default", "durable")
+        proxy.stop()
+        proxy = ApiServerProxy(store, port=port)
+        proxy.start()
+        # the stale pooled conn fails at SEND; one transparent retry wins
+        assert client.get("ConfigMap", "default", "durable")
+        conns = metrics.counter("rest_client_connections_opened_total", "")
+        assert conns.sum_where({"type": "pooled"}) == 2
+        retries = metrics.counter("rest_client_retries_total", "")
+        assert retries.total() == 0  # transparent, not a policy retry
+    finally:
+        client.close()
+        proxy.stop()
+
+
+def test_pool_recovers_from_injected_resets(server, store):
+    """FaultPlan resets compose with the pool: a response truncated
+    mid-body discards the broken connection, the RetryPolicy retries the
+    GET, and steady state goes back to reusing one connection."""
+    metrics = MetricsRegistry()
+    client = make_client(server, metrics)
+    try:
+        store.create(cm("x"))
+        server.set_fault_plan(FaultPlan([FaultRule(FAULT_RESET, 1.0,
+                                                   times=2)]))
+        assert client.get("ConfigMap", "default", "x")["data"]["k"] == "v"
+        server.set_fault_plan(None)
+        conns = metrics.counter("rest_client_connections_opened_total", "")
+        opened = conns.sum_where({"type": "pooled"})
+        for _ in range(10):
+            client.get("ConfigMap", "default", "x")
+        assert conns.sum_where({"type": "pooled"}) == opened  # reuse resumed
+    finally:
+        client.close()
+
+
+# ------------------------------------------------------- slim seen map
+
+def test_slim_seen_keeps_routing_fields_only():
+    obj = {"kind": "StatefulSet", "apiVersion": "apps/v1",
+           "metadata": {"name": "nb", "namespace": "ns", "uid": "uid-9",
+                        "resourceVersion": "42",
+                        "labels": {"notebook-name": "nb"},
+                        "ownerReferences": [{"kind": "Notebook",
+                                             "uid": "uid-1", "name": "nb",
+                                             "controller": True}],
+                        "annotations": {"big": "x" * 1000}},
+           "spec": {"replicas": 4, "template": {"huge": "y" * 4096}},
+           "status": {"readyReplicas": 4}}
+    slim = HttpApiClient._slim(obj)
+    assert set(slim) == {"kind", "apiVersion", "metadata"}
+    assert set(slim["metadata"]) == {"name", "namespace", "uid",
+                                     "resourceVersion", "labels",
+                                     "ownerReferences"}
+    assert "spec" not in slim and "status" not in slim
+    assert "annotations" not in slim["metadata"]
+
+
+def test_synthesized_deleted_routes_through_mappers(server, store,
+                                                    monkeypatch):
+    """A deletion that happens entirely inside an outage is synthesized
+    from the slim record — and must still route through owner- and
+    label-mappers (the fields DELETED-synthesis routing needs)."""
+    from kubeflow_tpu.controllers.manager import label_mapper, owner_mapper
+    monkeypatch.setattr(hc, "WATCH_RECONNECT_DELAY_S", 0.05)
+    store.watch_cache_capacity = 1  # force the relist path on reconnect
+    client = make_client(server)
+    try:
+        obj = cm("owned", labels={"notebook-name": "nb-7"})
+        obj["metadata"]["ownerReferences"] = [
+            {"kind": "Notebook", "name": "nb-7", "uid": "uid-owner",
+             "controller": True}]
+        store.create(obj)
+        deleted = []
+        client.watch("ConfigMap", lambda ev: deleted.append(ev)
+                     if ev.type == "DELETED" else None)
+        time.sleep(0.3)
+        server.set_fault_plan(FaultPlan([FaultRule(FAULT_RESET, 1.0)]))
+        store.delete("ConfigMap", "default", "owned")
+        for i in range(4):  # churn past the 1-frame ring: eviction → 410
+            store.create(cm(f"churn-{i}"))
+        server.set_fault_plan(None)
+        wait_for(lambda: deleted, msg="synthesized DELETED")
+        ev = deleted[0]
+        assert owner_mapper("Notebook")(ev.obj)[0].name == "nb-7"
+        assert label_mapper("notebook-name")(ev.obj)[0].name == "nb-7"
+    finally:
+        client.close()
+
+
+# ------------------------------------- status-subresource PATCH bound
+
+def test_status_patch_merges_against_racing_writer(server, store):
+    client = make_client(server)
+    try:
+        client.create({"kind": "Notebook",
+                       "metadata": {"name": "nb", "namespace": "ns"},
+                       "spec": {"template": {"spec": {"containers": [
+                           {"name": "nb", "image": "img"}]}}}})
+        real = store.update_status
+        races = {"n": 0}
+
+        def racing(obj):
+            # a foreign writer lands between the handler's read and its
+            # update_status on the first few attempts
+            if races["n"] < 3:
+                races["n"] += 1
+                real({"kind": "Notebook",
+                      "metadata": {"name": "nb", "namespace": "ns"},
+                      "status": {"foreign": races["n"]}})
+            return real(obj)
+        store.update_status = racing
+        out = client._json(
+            "PATCH", "/apis/kubeflow.org/v1/namespaces/ns/notebooks/nb/status",
+            {"status": {"readyReplicas": 2}},
+            content_type="application/merge-patch+json")
+        assert out["status"]["readyReplicas"] == 2
+        assert races["n"] == 3  # the re-merge loop actually raced
+    finally:
+        store.update_status = real
+        client.close()
+
+
+def test_status_patch_conflict_is_bounded_409(server, store):
+    """The re-merge loop is BOUNDED: a perpetually-conflicting object
+    surfaces 409 instead of spinning the handler thread forever."""
+    client = make_client(server)
+    orig = store.update_status
+    try:
+        client.create({"kind": "Notebook",
+                       "metadata": {"name": "hot", "namespace": "ns"},
+                       "spec": {"template": {"spec": {"containers": [
+                           {"name": "hot", "image": "img"}]}}}})
+
+        def always_conflict(obj):
+            raise ConflictError("hot object")
+        store.update_status = always_conflict
+        with pytest.raises(ConflictError):
+            client._json(
+                "PATCH",
+                "/apis/kubeflow.org/v1/namespaces/ns/notebooks/hot/status",
+                {"status": {"x": 1}},
+                content_type="application/merge-patch+json")
+    finally:
+        store.update_status = orig
+        client.close()
