@@ -65,11 +65,23 @@ MIN_CONN_REUSE = 10.0
 WATCH_KILL_COUNT = 25
 WATCH_KILL_AFTER_S = 0.4
 WATCH_KILL_SETTLE_S = 1.5
+# warm-vs-cold phase: the same fan-out twice — cold roll paying a
+# simulated 250 ms/pod provisioning cost, then warm-bind against a
+# pre-warmed SlicePool. Pins the bind path's contract: every notebook
+# binds (zero misses — run_wire fails those internally), bind-path
+# req/nb at or below the cold path, p50 at least 2x faster (at this
+# token provisioning delay; the RESULTS.md table shows 5-7x at a
+# realistic 5 s) and, via the always-on watch observer, zero
+# partial-replica states during bind/release.
+WARM_COLD_COUNT = 15
+WARM_COLD_BOOT_MS = 250.0
+WARM_MIN_SPEEDUP = 2.0
 
 
 def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
               budget_s: float = DEFAULT_BUDGET_S,
-              preempt: bool = True, watch_kill: bool = True) -> int:
+              preempt: bool = True, watch_kill: bool = True,
+              warm_cold: bool = True) -> int:
     """Run the wire fan-out; return nonzero on any failed bound."""
     from loadtest.start_notebooks import run_wire
 
@@ -84,6 +96,41 @@ def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
     if rc != 0:
         print(f"SMOKE FAIL: loadtest bounds violated (rc={rc})")
         return rc
+    if warm_cold:
+        cold_stats: dict = {}
+        warm_stats: dict = {}
+        rc = run_wire(WARM_COLD_COUNT, "cold-smoke", "v5e-4",
+                      timeout=max(budget_s - (time.monotonic() - t0), 15.0),
+                      workers=workers, boot_delay_ms=WARM_COLD_BOOT_MS,
+                      stats_out=cold_stats)
+        if rc == 0:
+            rc = run_wire(WARM_COLD_COUNT, "warm-smoke", "v5e-4",
+                          timeout=max(budget_s - (time.monotonic() - t0),
+                                      15.0),
+                          workers=workers, boot_delay_ms=WARM_COLD_BOOT_MS,
+                          pool_warm=WARM_COLD_COUNT, stats_out=warm_stats)
+        if rc != 0:
+            print(f"SMOKE FAIL: warm-vs-cold loadtest bounds violated "
+                  f"(rc={rc})")
+            return rc
+        cold_p50, warm_p50 = cold_stats["p50_s"], warm_stats["p50_s"]
+        print(f"warm-vs-cold: p50 {warm_p50 * 1000:.0f}ms vs "
+              f"{cold_p50 * 1000:.0f}ms "
+              f"({cold_p50 / max(warm_p50, 1e-9):.1f}x), req/nb "
+              f"{warm_stats['req_per_nb']:.1f} vs "
+              f"{cold_stats['req_per_nb']:.1f}")
+        if warm_p50 * WARM_MIN_SPEEDUP > cold_p50:
+            print(f"SMOKE FAIL: warm-bind p50 {warm_p50 * 1000:.0f}ms is "
+                  f"not {WARM_MIN_SPEEDUP:.0f}x faster than cold "
+                  f"{cold_p50 * 1000:.0f}ms (bind path regressed)")
+            return 1
+        if warm_stats["req_per_nb"] > cold_stats["req_per_nb"] + 0.5:
+            # +0.5 absolute slack: the two runs race background noise,
+            # but a real regression (an extra write per bind) is >= 1.0
+            print(f"SMOKE FAIL: bind-path req/nb "
+                  f"{warm_stats['req_per_nb']:.1f} above cold path "
+                  f"{cold_stats['req_per_nb']:.1f}")
+            return 1
     if watch_kill:
         rc = run_wire(WATCH_KILL_COUNT, "watchkill-smoke", "v5e-4",
                       timeout=max(budget_s - (time.monotonic() - t0), 15.0),
@@ -109,6 +156,8 @@ def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
         print(f"SMOKE FAIL: {wall:.1f}s exceeds the {budget_s:.0f}s budget")
         return 1
     phases = [f"smoke OK: {count} notebooks x {workers} workers"]
+    if warm_cold:
+        phases.append(f"{WARM_COLD_COUNT} nb warm-vs-cold bind phase")
     if watch_kill:
         phases.append(f"{WATCH_KILL_COUNT} nb watch-kill chaos "
                       f"(0 relists)")
@@ -128,10 +177,13 @@ def main() -> int:
                     help="skip the node-preemption repair phase")
     ap.add_argument("--no-watch-kill", action="store_true",
                     help="skip the watch-kill RV-resume phase")
+    ap.add_argument("--no-warm-cold", action="store_true",
+                    help="skip the warm-bind vs cold-roll phase")
     args = ap.parse_args()
     return run_smoke(args.count, args.workers, args.budget_s,
                      preempt=not args.no_preempt,
-                     watch_kill=not args.no_watch_kill)
+                     watch_kill=not args.no_watch_kill,
+                     warm_cold=not args.no_warm_cold)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,147 @@
+"""SlicePool CRD types + the bound-slice helpers every controller shares.
+
+No reference analog: the upstream notebook controller always cold-rolls a
+StatefulSet per Notebook. A ``SlicePool`` (``tpu.kubeflow.org/v1``,
+cluster-scoped like Node — pool capacity is fleet infrastructure, not
+tenant state) declares a target count of **warm slices** for one
+accelerator/topology: pre-rolled, pre-imaged StatefulSets held at full
+replicas and Ready in the pool's materialization namespace. Notebook
+creation with a matching topology *binds* a warm slice (annotation flip +
+Service repoint, NotebookOS's replicas-bind-accelerators shape, PAPERS.md)
+instead of provisioning one, and cull/stop *releases* it back to the pool.
+
+Wire shape::
+
+    apiVersion: tpu.kubeflow.org/v1
+    kind: SlicePool
+    metadata: {name: warm-v5e-16}
+    spec:
+      accelerator: v5e-16        # topology key (tpu/topology short name)
+      warmReplicas: 2            # slice CAPACITY the pool maintains:
+                                 # bound slices count toward it, so binds
+                                 # never trigger replacement creation —
+                                 # only drained (dead-capacity) slices or
+                                 # a raised target are rebuilt
+      namespace: tpu-slice-pools # where warm slices materialize
+      weights: {team-a: 3}       # fair-share admission weight per
+                                 # notebook namespace (absent → 1)
+    status: {warm: 1, warming: 1, bound: 3, pending: 0}
+
+The bound edge is annotation-carried on BOTH sides (Notebook's
+``bound-slice`` ↔ StatefulSet's ``pool-bound-to``) so a controller crash
+between the two patches is healed from either side on the next reconcile.
+"""
+
+from __future__ import annotations
+
+from ..cluster.errors import InvalidError
+from ..utils import k8s, names
+
+GROUP = "tpu.kubeflow.org"
+VERSION = "v1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND = "SlicePool"
+PLURAL = "slicepools"
+
+
+def new_slice_pool(name: str, accelerator: str, warm_replicas: int, *,
+                   namespace: str | None = None,
+                   weights: dict[str, int] | None = None) -> dict:
+    """Build a SlicePool CR in wire form. ``namespace`` is where the warm
+    slices materialize (defaults at reconcile time to
+    config.pool_namespace); ``weights`` are the per-notebook-namespace
+    fair-share admission weights."""
+    pool = {
+        "apiVersion": API_VERSION,
+        "kind": KIND,
+        "metadata": {"name": name},
+        "spec": {
+            "accelerator": accelerator,
+            "warmReplicas": int(warm_replicas),
+        },
+        "status": {},
+    }
+    if namespace:
+        pool["spec"]["namespace"] = namespace
+    if weights:
+        pool["spec"]["weights"] = dict(weights)
+    return pool
+
+
+def validate_slice_pool(pool: dict) -> None:
+    """Structural + semantic validation the CRD schema/admission enforce:
+    the accelerator must parse to a real slice shape (a pool of
+    unprovisionable slices would warm nothing, silently, forever)."""
+    from ..tpu.topology import TpuRequestError, parse_short_name
+    if k8s.kind(pool) != KIND:
+        raise InvalidError(f"kind must be {KIND}")
+    if pool.get("apiVersion") != API_VERSION:
+        raise InvalidError(f"apiVersion must be {API_VERSION}")
+    if not k8s.name(pool):
+        raise InvalidError("metadata.name required")
+    spec = pool.get("spec") or {}
+    accelerator = spec.get("accelerator")
+    if not accelerator:
+        raise InvalidError("spec.accelerator required")
+    try:
+        parse_short_name(accelerator)
+    except TpuRequestError as exc:
+        raise InvalidError(f"spec.accelerator: {exc}") from exc
+    warm = spec.get("warmReplicas")
+    if not isinstance(warm, int) or warm < 0:
+        raise InvalidError("spec.warmReplicas must be a non-negative int")
+    weights = spec.get("weights")
+    if weights is not None:
+        if not isinstance(weights, dict) or any(
+                not isinstance(w, int) or w < 1 for w in weights.values()):
+            raise InvalidError("spec.weights values must be ints >= 1")
+
+
+def install_slicepool_crd(store) -> None:
+    """Install the SlicePool CRD + admission into an apiserver — the
+    sibling of api.types.install_notebook_crd."""
+    from ..cluster.errors import AlreadyExistsError
+    from ..deploy.manifests import slicepool_crd
+    try:
+        store.create(slicepool_crd())
+    except AlreadyExistsError:
+        pass
+
+    def admit(operation, obj, old):
+        if operation in ("CREATE", "UPDATE"):
+            validate_slice_pool(obj)
+        return obj
+    store.register_admission(KIND, admit)
+
+
+# ------------------------------------------------------ bound-slice helpers
+def bound_slice_ref(notebook: dict) -> tuple[str, str] | None:
+    """The (pool namespace, StatefulSet name) a Notebook is bound to, or
+    None — THE predicate that flips the core/culling/repair controllers
+    into bound mode."""
+    raw = k8s.get_annotation(notebook, names.BOUND_SLICE_ANNOTATION)
+    if not raw or "/" not in raw:
+        return None
+    ns, _, sts = raw.partition("/")
+    return (ns, sts) if ns and sts else None
+
+
+def bound_slice_pods(client, bound: tuple[str, str]) -> list[dict]:
+    """The bound slice's worker pods — listed by the immutable
+    ``statefulset`` selector label in the POOL namespace (bound pods live
+    where the slice was warmed, not where the Notebook is)."""
+    return client.list("Pod", bound[0], {"statefulset": bound[1]})
+
+
+def pod_notebook_mapper(obj: dict):
+    """Watch mapper: a pod carrying the notebook-name label enqueues its
+    Notebook. Bound pool pods live in the pool namespace but belong to a
+    Notebook elsewhere — the bound-namespace label carries the real home
+    (plain label_mapper would enqueue a nonexistent pool-namespace key
+    and the real Notebook would never hear about its workers)."""
+    from ..controllers.manager import Request
+    nb = k8s.get_label(obj, names.NOTEBOOK_NAME_LABEL)
+    if not nb:
+        return []
+    ns = k8s.get_label(obj, names.BOUND_NAMESPACE_LABEL) or k8s.namespace(obj)
+    return [Request(ns, nb)]

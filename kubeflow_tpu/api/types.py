@@ -47,6 +47,10 @@ CONDITION_SLICE_DEGRADED = "SliceDegraded"
 CONDITION_SLICE_REPAIRING = "SliceRepairing"
 CONDITION_SLICE_QUARANTINED = "SliceQuarantined"
 SLICE_HEALTH_STATES = ("Degraded", "Repairing", "Quarantined")
+# Warm slice pools (controllers/slicepool.py): True while the notebook is
+# served by a pool-owned warm slice (bound-slice annotation present); False
+# with reason Migrating while a checkpoint migration is re-binding it.
+CONDITION_POOL_BOUND = "PoolBound"
 
 
 def new_notebook(name: str, namespace: str, *,

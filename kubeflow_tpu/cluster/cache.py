@@ -56,6 +56,7 @@ DEFAULT_DISABLE_FOR = ("Secret", "ConfigMap")
 DEFAULT_LABEL_INDEXES = (
     names.NOTEBOOK_NAME_LABEL,
     "statefulset",
+    names.POOL_LABEL,
     "opendatahub.io/runtime-image",
     "app.kubernetes.io/part-of",
 )

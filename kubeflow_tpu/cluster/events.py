@@ -86,15 +86,20 @@ class EventRecorder:
         suffix = _aggregation_suffix(ref["uid"], type_, reason, message)
         event_name = f"{ref['name']}.{suffix}"
         self._maybe_prune(namespace)
-        # get-then-write races under concurrent reconcile workers (two keys
-        # re-emitting the same aggregated event): a lost create falls back
-        # to the update branch and a conflicting update re-reads — bounded
-        # retries, never an exception for an aggregation race
+        # CREATE-first: a fresh event (the fan-out common case — every bind
+        # or repair transition emits one) costs ONE wire round trip; only
+        # an aggregation (AlreadyExists) pays the read-modify-update. The
+        # write races under concurrent reconcile workers keep the same
+        # convergence: a lost create falls into the update branch and a
+        # conflicting update re-reads — bounded retries, never an
+        # exception for an aggregation race.
         from .errors import AlreadyExistsError, ConflictError, NotFoundError
         existing = None
+        first_attempt = True
         for _attempt in range(3):
-            existing = self.client.get_or_none(EVENT_KIND, namespace,
-                                               event_name)
+            existing = None if first_attempt else \
+                self.client.get_or_none(EVENT_KIND, namespace, event_name)
+            first_attempt = False
             if existing is not None:
                 existing = k8s.deepcopy(existing)
                 existing["count"] = int(existing.get("count", 1)) + 1

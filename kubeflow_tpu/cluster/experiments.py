@@ -45,9 +45,10 @@ import yaml
 EXPERIMENT_KIND = "ChaosExperiment"
 VALID_INJECTIONS = {"PodKill", "NetworkPartition", "WebhookDisrupt",
                     "RBACRevoke", "DeploymentScaleZero", "SliceWorkerKill",
-                    "NodePreemption"}
+                    "NodePreemption", "PoolDrainPreemption"}
 VALID_CHECK_TYPES = {"conditionTrue", "resourceExists", "httpGet",
-                     "sliceAtomic", "notQuarantined"}
+                     "sliceAtomic", "notQuarantined", "notebookMigrated",
+                     "poolRewarmed"}
 
 
 def _require(cond: bool, errors: list[str], msg: str) -> None:
@@ -204,6 +205,11 @@ class _MiniCluster:
         self.config = ControllerConfig()
         self.store = ClusterStore()
         api.install_notebook_crd(self.store)
+        from ..api.slicepool import install_slicepool_crd
+        install_slicepool_crd(self.store)
+        # set by the PoolDrainPreemption injection: (notebook, old bound
+        # slice, identity, checkpointed step) the migrated check verifies
+        self.expect_migrated_from: tuple | None = None
         # server-side admission, where kube-apiserver runs it — remote
         # managers get mutated objects and denials over the wire
         NotebookMutatingWebhook(self.store, self.config).install(self.store)
@@ -278,6 +284,27 @@ class _MiniCluster:
     def expected_workers(self) -> int:
         from ..tpu import topology
         return topology.parse_short_name(self.accelerator).num_workers
+
+    # ---------------------------------------------------------- warm pools
+    def setup_pool(self, name: str, warm: int) -> None:
+        from ..api.slicepool import new_slice_pool
+        self.store.create(new_slice_pool(name, self.accelerator, warm))
+
+    def pool_slices(self, state: str | None = None) -> list[dict]:
+        from ..utils import k8s, names as nk
+        out = []
+        for sts in self.store.list("StatefulSet", None,
+                                   {nk.POOL_LABEL: None}):
+            if state is None or k8s.get_annotation(
+                    sts, nk.POOL_STATE_ANNOTATION) == state:
+                out.append(sts)
+        return out
+
+    def bound_slice_of(self, nb_name: str) -> str | None:
+        from ..utils import k8s, names as nk
+        nb = self.store.get_or_none(self.api.KIND, self.namespace, nb_name)
+        return k8s.get_annotation(nb, nk.BOUND_SLICE_ANNOTATION) \
+            if nb else None
 
     def slice_ready(self, name: str) -> bool:
         nb = self.store.get_or_none(self.api.KIND, self.namespace, name)
@@ -361,14 +388,18 @@ class _MiniCluster:
 
     def _check_sliceAtomic(self, check: dict):  # noqa: N802
         full = self.expected_workers()
-        for name in self.notebooks:
-            sts = self.store.get_or_none("StatefulSet", self.namespace, name)
+        stss = [self.store.get_or_none("StatefulSet", self.namespace, name)
+                for name in self.notebooks]
+        # pool-owned slices (warm/bound/draining) obey the same invariant:
+        # replicas only ever 0 or the full worker count, never partial
+        stss += self.pool_slices()
+        for sts in stss:
             if sts is None:
                 continue  # not created yet / culled — 0 by definition
             replicas = (sts.get("spec") or {}).get("replicas", 0)
             if replicas not in (0, full):
-                return False, (f"STS {name} at partial scale "
-                               f"{replicas} (full={full})")
+                return False, (f"STS {(sts.get('metadata') or {}).get('name')}"
+                               f" at partial scale {replicas} (full={full})")
         return True, ""
 
     def _check_notQuarantined(self, check: dict):  # noqa: N802
@@ -385,6 +416,53 @@ class _MiniCluster:
                 nb, self.api.CONDITION_SLICE_QUARANTINED)
             if cond and cond.get("status") == "True":
                 return False, f"notebook {name} SliceQuarantined is True"
+        return True, ""
+
+    def _check_notebookMigrated(self, check: dict):  # noqa: N802
+        """Every notebook is still pool-bound (no cold-roll fallback, no
+        quarantine, no migration wedged in flight); when the injection
+        recorded a pre-preemption slice, the notebook must now sit on a
+        DIFFERENT slice with the SAME hostname identity and the resumed
+        step must equal the checkpointed one (step continuity)."""
+        from ..utils import names as nk
+        from ..utils.k8s import get_annotation
+        for name in self.notebooks:
+            nb = self.store.get_or_none(self.api.KIND, self.namespace, name)
+            if nb is None:
+                return False, f"notebook {name} vanished"
+            for ann, why in ((nk.QUARANTINE_ANNOTATION, "quarantined"),
+                             (nk.MIGRATION_STATE_ANNOTATION,
+                              "migration still in flight"),
+                             (nk.POOL_BIND_MISS_ANNOTATION,
+                              "fell back to a cold roll")):
+                if get_annotation(nb, ann) is not None:
+                    return False, f"notebook {name} {why}"
+            if get_annotation(nb, nk.BOUND_SLICE_ANNOTATION) is None:
+                return False, f"notebook {name} not pool-bound"
+        if self.expect_migrated_from is not None:
+            name, old_slice, identity, step = self.expect_migrated_from
+            nb = self.store.get_or_none(self.api.KIND, self.namespace, name)
+            bound = get_annotation(nb, nk.BOUND_SLICE_ANNOTATION)
+            if bound == old_slice:
+                return False, (f"notebook {name} still on pre-preemption "
+                               f"slice {old_slice}")
+            if get_annotation(nb, nk.SLICE_IDENTITY_ANNOTATION) != identity:
+                return False, (f"notebook {name} changed hostname identity "
+                               f"across migration")
+            resumed = get_annotation(nb, nk.RESUMED_STEP_ANNOTATION)
+            if resumed != step:
+                return False, (f"notebook {name} resumed at step {resumed}, "
+                               f"checkpointed at {step}")
+        return True, ""
+
+    def _check_poolRewarmed(self, check: dict):  # noqa: N802
+        """The pool holds warm (or actively re-warming) spare capacity —
+        a consumed/drained slice was replaced, the pool did not bleed."""
+        from ..utils import names as nk
+        spares = self.pool_slices(nk.POOL_STATE_WARM) + \
+            self.pool_slices(nk.POOL_STATE_WARMING)
+        if not spares:
+            return False, "pool has no warm/warming spare slice"
         return True, ""
 
     def close(self) -> None:
@@ -429,7 +507,8 @@ def run_experiment(doc: dict, *, notebooks: int = 2,
     checks = (spec.get("steadyState") or {}).get("checks") or []
     t0 = time.monotonic()
     failures: list[str] = []
-    accelerator = ("v5e-16" if itype in ("SliceWorkerKill", "NodePreemption")
+    accelerator = ("v5e-16" if itype in ("SliceWorkerKill", "NodePreemption",
+                                         "PoolDrainPreemption")
                    else "v5e-4")
     audit = tempfile.NamedTemporaryFile(suffix=".ndjson", delete=False)
     audit.close()
@@ -446,9 +525,29 @@ def run_experiment(doc: dict, *, notebooks: int = 2,
         cluster = _MiniCluster("chaos-user", accelerator, audit.name,
                                workers=workers)
         # ------------------------------------------------ steady state
+        if itype == "PoolDrainPreemption":
+            # warm the pool FIRST so every notebook binds instead of
+            # cold-rolling; capacity is notebooks + 1, so ONE warm spare
+            # slice exists when the preemption lands — the migration
+            # target
+            from ..utils import names as nk
+            cluster.setup_pool("chaos-pool", warm=notebooks + 1)
+            if not cluster.wait(
+                    lambda: len(cluster.pool_slices(nk.POOL_STATE_WARM))
+                    >= notebooks + 1, timeout=60.0):
+                failures.append("pool never warmed to target")
         cluster.create_notebooks(notebooks)
         if not cluster.wait(cluster.converged, timeout=60.0):
             failures.append("pre-injection convergence timeout")
+        if itype == "PoolDrainPreemption":
+            from ..utils import names as nk
+            if not cluster.wait(
+                    lambda: all(cluster.bound_slice_of(n)
+                                for n in cluster.notebooks)
+                    and cluster.pool_slices(nk.POOL_STATE_WARM),
+                    timeout=60.0):
+                failures.append("notebooks not all pool-bound with a warm "
+                                "spare before injection")
         failures += [f"pre-injection {f}"
                      for f in cluster.run_checks(checks)]
         emit(f"  [{name}] steady at {notebooks} notebooks; injecting "
@@ -525,6 +624,55 @@ def run_experiment(doc: dict, *, notebooks: int = 2,
                 # first, then the node actually dies partway through the
                 # injection window. Atomicity is sampled THROUGHOUT: the
                 # repair must only ever roll the one STS 0 <-> full.
+                preempt_node(cluster.store, node_name)
+                deadline = time.monotonic() + duration
+                kill_at = time.monotonic() + duration / 2
+                killed = False
+                while time.monotonic() < deadline:
+                    if not killed and time.monotonic() >= kill_at:
+                        kill_node(cluster.store, node_name)
+                        killed = True
+                    atomic = cluster.run_checks([{"type": "sliceAtomic"}])
+                    if atomic:
+                        failures += [f"during-preemption {f}"
+                                     for f in atomic]
+                        break
+                    time.sleep(0.05)
+                if not killed:
+                    kill_node(cluster.store, node_name)
+        elif itype == "PoolDrainPreemption":
+            # preempt the node under worker 0 of a BOUND slice while the
+            # pool holds a warm spare: the repair controller must
+            # checkpoint, re-bind the spare under the SAME hostname
+            # identity, and resume — and the pool must re-warm. Slice
+            # atomicity is sampled throughout (pool slices included).
+            from ..utils import names as nk
+            from ..utils.k8s import get_annotation, get_label
+            from .kubelet import kill_node, preempt_node
+            nb0 = cluster.notebooks[0]
+            # simulate in-pod training progress the checkpoint must carry
+            cluster.store.patch(cluster.api.KIND, cluster.namespace, nb0, {
+                "metadata": {"annotations": {
+                    nk.RUNTIME_STEP_ANNOTATION: "1337"}}})
+            bound = cluster.bound_slice_of(nb0)
+            nb_obj = cluster.store.get(cluster.api.KIND, cluster.namespace,
+                                       nb0)
+            cluster.expect_migrated_from = (
+                nb0, bound,
+                get_annotation(nb_obj, nk.SLICE_IDENTITY_ANNOTATION),
+                "1337")
+            node_name = None
+            if bound:
+                pool_ns, sts_name = bound.split("/", 1)
+                for pod in cluster.store.list("Pod", pool_ns,
+                                              {"statefulset": sts_name}):
+                    if get_label(pod, "apps.kubernetes.io/pod-index") == "0":
+                        node_name = (pod.get("spec") or {}).get("nodeName")
+                        break
+            if not node_name:
+                failures.append(f"bound worker-0 of {nb0} has no node "
+                                f"binding — nothing to preempt")
+            else:
                 preempt_node(cluster.store, node_name)
                 deadline = time.monotonic() + duration
                 kill_at = time.monotonic() + duration / 2

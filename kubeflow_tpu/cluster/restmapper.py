@@ -63,8 +63,10 @@ _MAPPINGS = [
     # apps/v1
     RestMapping("StatefulSet", "apps/v1", "statefulsets"),
     RestMapping("Deployment", "apps/v1", "deployments"),
-    # our CRD
+    # our CRDs
     RestMapping("Notebook", "kubeflow.org/v1", "notebooks"),
+    RestMapping("SlicePool", "tpu.kubeflow.org/v1", "slicepools",
+                namespaced=False),
     # networking
     RestMapping("NetworkPolicy", "networking.k8s.io/v1", "networkpolicies"),
     # rbac

@@ -3,10 +3,11 @@ from .notebook import NotebookReconciler
 from .culling import CullingReconciler
 from .extension import ExtensionReconciler
 from .slicerepair import SliceRepairReconciler
+from .slicepool import SlicePoolReconciler
 
 __all__ = ["Manager", "Request", "NotebookReconciler", "CullingReconciler",
            "ExtensionReconciler", "SliceRepairReconciler",
-           "setup_controllers"]
+           "SlicePoolReconciler", "setup_controllers"]
 
 
 def setup_controllers(client, config=None, metrics=None, prober=None, *,
@@ -53,6 +54,8 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
     inprocess_admission = getattr(client, "supports_inprocess_admission", True)
     if inprocess_admission:
         install_notebook_crd(client)
+        from ..api.slicepool import install_slicepool_crd
+        install_slicepool_crd(client)
     if webhooks and inprocess_admission:
         # mutating runs before validating, as in the apiserver's phase
         # order; admission always reads/writes the LIVE client — mutating
@@ -112,8 +115,15 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
             # slice health & repair: watches Pods AND Nodes, drives the
             # Healthy → Degraded → Repairing → (Quarantined) state machine
             # with slice-atomic 0 → N rolls through the core reconciler's
-            # desired_replicas seam
+            # desired_replicas seam (pool-bound notebooks take the
+            # checkpoint-migration path instead)
             SliceRepairReconciler(client, config, metrics).setup(mgr)
+        if getattr(config, "enable_slice_pool", True):
+            # warm slice pools: pre-rolls SlicePool-declared slices to
+            # Ready and binds them on Notebook creation (bind-on-create),
+            # releases + re-warms on cull/stop, drains + replaces on
+            # migration off dying capacity
+            SlicePoolReconciler(client, config, metrics).setup(mgr)
     if extension:
         ExtensionReconciler(client, config, metrics).setup(mgr)
     if leader_elect:

@@ -31,6 +31,7 @@ import urllib.request
 from dataclasses import dataclass, field
 from typing import Callable
 
+from ..api import slicepool as pool_api
 from ..api import types as api
 from ..cluster import errors
 from ..utils import k8s, names
@@ -293,10 +294,16 @@ class CullingReconciler:
     def _worker0_pod(self, notebook: dict) -> dict | None:
         """The slice's Jupyter pod. With GenerateName STSs the pod isn't
         ``<nb>-0`` literally, so resolve through the notebook-name label +
-        pod-index 0."""
-        for pod in self.client.list("Pod", k8s.namespace(notebook),
-                                    {names.NOTEBOOK_NAME_LABEL:
-                                     k8s.name(notebook)}):
+        pod-index 0. A pool-BOUND notebook's workers live in the pool
+        namespace (controllers/slicepool.py) — probing the notebook's own
+        namespace would find nothing and strip the idle clock of a
+        perfectly live notebook."""
+        bound = pool_api.bound_slice_ref(notebook)
+        pods = pool_api.bound_slice_pods(self.client, bound) if bound \
+            else self.client.list("Pod", k8s.namespace(notebook),
+                                  {names.NOTEBOOK_NAME_LABEL:
+                                   k8s.name(notebook)})
+        for pod in pods:
             if k8s.get_label(pod, "apps.kubernetes.io/pod-index", "0") == "0":
                 return pod
         return None

@@ -25,6 +25,9 @@ from __future__ import annotations
 
 import logging
 
+import time
+
+from ..api import slicepool as pool_api
 from ..api import types as api
 from ..cluster import errors, events
 from ..cluster.cache import owned_objects
@@ -32,7 +35,7 @@ from ..tpu.topology import SliceSpec, parse_slice_request
 from ..utils import drift, k8s, names
 from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
-from .manager import Manager, Request, Result, label_mapper, owner_mapper
+from .manager import Manager, Request, Result, owner_mapper
 
 log = logging.getLogger("kubeflow_tpu.notebook")
 
@@ -64,6 +67,11 @@ class NotebookReconciler:
         # watch-fed read cache for the Event predicate (built in setup();
         # reconcilers constructed without setup() fall back to live reads)
         self._read_cache = None
+        # (ns, name) → monotonic time a poolable notebook was first seen
+        # waiting for a warm-slice bind; past pool_bind_grace_s the core
+        # stamps a BindTimeout miss and cold-rolls (in-memory is fine: a
+        # restarted controller re-arming the grace window is correct)
+        self._pool_pending_since: dict[tuple[str, str], float] = {}
 
     # ------------------------------------------------------------- wiring
     def setup(self, mgr: Manager) -> None:
@@ -100,13 +108,25 @@ class NotebookReconciler:
                   tee=tee, predicate=ne)
         mgr.watch("Service", self.name, mapper=owner_mapper(api.KIND),
                   predicate=ne)
-        mgr.watch("Pod", self.name, mapper=label_mapper(names.NOTEBOOK_NAME_LABEL),
+        # bound-aware pod mapping: pool-bound workers live in the pool
+        # namespace but belong to a Notebook elsewhere (the bound-namespace
+        # label routes them home)
+        mgr.watch("Pod", self.name, mapper=pool_api.pod_notebook_mapper,
                   tee=tee)
+        if self.config.enable_slice_pool:
+            # SlicePool reads (the bind gate) serve from the shared cache;
+            # pool events enqueue nothing here — binds surface as Notebook
+            # annotation patches, which the Notebook watch above delivers
+            mgr.watch(pool_api.KIND, self.name, mapper=lambda obj: [],
+                      tee=tee)
         # backfill AFTER the watches above are live (watch-then-list: no
         # missable gap; rv guard + tombstones make the overlap safe);
         # idempotent when the manager already backfilled the kind, and a
         # transient LIST failure degrades to live reads, never a crash
-        for kind in (api.KIND, "StatefulSet", "Pod"):
+        kinds = [api.KIND, "StatefulSet", "Pod"]
+        if self.config.enable_slice_pool:
+            kinds.append(pool_api.KIND)
+        for kind in kinds:
             try:
                 cache.backfill(kind)
             except Exception:  # noqa: BLE001 — see manager.watch
@@ -160,6 +180,9 @@ class NotebookReconciler:
         # names always carry a ".<hash>" suffix no Notebook's STS could have)
         notebook = self.client.get_or_none(api.KIND, req.namespace, req.name)
         if notebook is None:
+            # a notebook deleted while waiting for a bind must not leak
+            # its grace-window entry
+            self._pool_pending_since.pop((req.namespace, req.name), None)
             event = self.client.get_or_none(events.EVENT_KIND, req.namespace,
                                             req.name)
             if event is not None:
@@ -172,6 +195,25 @@ class NotebookReconciler:
 
         slice_spec = parse_slice_request(
             k8s.get_in(notebook, "metadata", "annotations", default={}))
+
+        # warm-pool bind mode (controllers/slicepool.py): a bound notebook
+        # is served by a pool-owned slice — the core repoints the Service
+        # and mirrors status off the BOUND slice instead of rolling its own
+        # StatefulSet (the CR→Ready collapse the pool exists for)
+        if slice_spec is not None:
+            bound = pool_api.bound_slice_ref(notebook)
+            if bound is not None:
+                self._reconcile_bound(notebook, slice_spec, bound)
+                return None
+            gate = self._pool_bind_gate(notebook, slice_spec)
+            if gate is not None:
+                # a warm slice is (or will shortly be) available: hold the
+                # cold roll — the pool controller's bind patch re-enqueues
+                # us; the requeue is only the belt-and-braces fallback.
+                # No status write while waiting: the bind is one reconcile
+                # away and a transient 0/N status would double the bind
+                # path's write cost for no operator signal.
+                return gate
 
         self._reconcile_statefulset(notebook, slice_spec)
         self._reconcile_service(notebook, slice_spec)
@@ -206,6 +248,96 @@ class NotebookReconciler:
                 str(involved.get("kind", "")).lower(),
                 involved.get("name", ""), event.get("message", "")))
 
+    # ----------------------------------------------------- warm-pool seams
+    def _pool_bind_gate(self, notebook: dict,
+                        slice_spec: SliceSpec) -> Result | None:
+        """Decide whether to hold the cold roll for a warm-pool bind.
+        Returns a Result to wait (the bind/release/migrate seam the pool
+        controller drives through annotations), or None → cold-roll now.
+        The gate times out after pool_bind_grace_s with a BindTimeout
+        miss, so a down pool controller can never strand creation."""
+        if not self.config.enable_slice_pool:
+            return None
+        if k8s.get_annotation(notebook,
+                              names.POOL_BIND_MISS_ANNOTATION) is not None:
+            return None  # fair-share loser / timed out: cold path owns it
+        if self._find_owned_sts(notebook) is not None:
+            return None  # already cold-rolled (pool appeared later)
+        key = (k8s.namespace(notebook), k8s.name(notebook))
+        if k8s.get_annotation(notebook,
+                              names.MIGRATION_STATE_ANNOTATION) is not None:
+            # mid-migration re-bind: the repair controller owns the
+            # outcome and its (longer) timeout — the cold roll waits even
+            # if the pool momentarily shows no capacity (or was deleted:
+            # the repair's bounded timeout stamps the miss that releases
+            # this hold). The Service is repointed to the endpoint-less
+            # cold shape for the window (the released OLD slice may
+            # already serve another tenant — same cross-tenant hazard as
+            # the stop branch) and status renders PoolBound=Migrating.
+            self._pool_pending_since.pop(key, None)
+            self._reconcile_service(notebook, slice_spec)
+            self._update_status(notebook, slice_spec)
+            return Result(requeue_after=self.config.pool_poll_s)
+        reader = self._read_cache or self.client
+        if not any(k8s.get_in(p, "spec", "accelerator")
+                   == slice_spec.short_name
+                   for p in reader.list(pool_api.KIND)):
+            return None  # no pool serves this topology
+        if k8s.get_annotation(notebook, names.STOP_ANNOTATION) is not None:
+            # stopped + poolable: no StatefulSet at all — resume re-enters
+            # this gate and binds a warm slice instead of cold-scaling 0→N.
+            # The Service MUST be repointed back to the (endpoint-less)
+            # cold selector shape and status re-rendered: a released slice
+            # is re-bound to OTHER tenants, and a leftover ExternalName
+            # Service would route this notebook's URL into their slice.
+            self._pool_pending_since.pop(key, None)
+            self._reconcile_service(notebook, slice_spec)
+            self._update_status(notebook, slice_spec)
+            return Result()
+        heartbeat = k8s.get_annotation(notebook,
+                                       names.POOL_BIND_PENDING_ANNOTATION)
+        if heartbeat is not None:
+            try:
+                fresh = time.time() - float(heartbeat) < \
+                    self.config.pool_bind_grace_s
+            except (TypeError, ValueError):
+                fresh = False
+            if fresh:
+                # the pool controller is ALIVE and has admitted this
+                # notebook (slice warming, or waiting for a sibling
+                # pool's spill): the grace timeout only guards against a
+                # dead pool controller — keep waiting; real slice
+                # provisioning legitimately outlives any fixed grace
+                self._pool_pending_since.pop(key, None)
+                return Result(requeue_after=self.config.pool_bind_grace_s)
+        now = time.monotonic()
+        first = self._pool_pending_since.setdefault(key, now)
+        if now - first > self.config.pool_bind_grace_s:
+            self._pool_pending_since.pop(key, None)
+            self.client.patch(api.KIND, key[0], key[1], {
+                "metadata": {"annotations": {
+                    names.POOL_BIND_MISS_ANNOTATION: "BindTimeout"}}})
+            self.recorder.eventf(
+                notebook, events.TYPE_WARNING, "PoolBindMiss",
+                f"no warm-slice bind within "
+                f"{self.config.pool_bind_grace_s:.0f}s; cold-rolling")
+            return None
+        return Result(requeue_after=self.config.pool_poll_s)
+
+    def _reconcile_bound(self, notebook: dict, slice_spec: SliceSpec,
+                         bound: tuple[str, str]) -> None:
+        """Bound mode: Service repointed at the pool slice, restart bounces
+        the BOUND workers, status mirrors the BOUND slice's pods. No owned
+        StatefulSet exists (releasing must hand the slice back intact —
+        an ownerReference would let notebook deletion GC warm capacity)."""
+        self._pool_pending_since.pop(
+            (k8s.namespace(notebook), k8s.name(notebook)), None)
+        self._reconcile_service(notebook, slice_spec, bound=bound)
+        if self.config.use_istio:
+            self._reconcile_virtual_service(notebook)
+        self._handle_restart_annotation(notebook, slice_spec, bound=bound)
+        self._update_status(notebook, slice_spec, bound=bound)
+
     # --------------------------------------------------------- generation
     def desired_replicas(self, notebook: dict, slice_spec: SliceSpec | None) -> int:
         """Stop annotation → 0, else the slice worker count (reference
@@ -213,7 +345,10 @@ class NotebookReconciler:
         invariant (SURVEY §7 stage 5). The repair controller's scale-down
         hold (controllers/slicerepair.py) rides the same single-writer
         seam: repairs roll the slice 0 → N through THIS function, so
-        replicas can only ever be 0 or full, never partial."""
+        replicas can only ever be 0 or full, never partial. Pool-BOUND
+        notebooks never reach the StatefulSet path at all (the bind seam
+        in reconcile()); this function then only sizes the status
+        expectation for the bound slice."""
         if k8s.get_annotation(notebook, names.STOP_ANNOTATION) is not None:
             return 0
         if k8s.get_annotation(notebook,
@@ -239,10 +374,12 @@ class NotebookReconciler:
             if key in (names.TPU_ACCELERATOR_ANNOTATION,
                        names.TPU_TOPOLOGY_ANNOTATION):
                 continue  # slice identity lives in labels/env, not pod annotations
-            if key in names.SLICE_REPAIR_ANNOTATIONS:
-                # repair bookkeeping would churn the pod template (every
-                # health transition a spurious template drift → rolling
-                # restart) — it describes the slice, not the pods
+            if key in names.SLICE_REPAIR_ANNOTATIONS or \
+                    key in names.POOL_ANNOTATIONS:
+                # repair/pool bookkeeping would churn the pod template
+                # (every health or bind transition a spurious template
+                # drift → rolling restart) — it describes the slice's
+                # lifecycle, not the pods
                 continue
             out[key] = val
         return out
@@ -360,9 +497,16 @@ class NotebookReconciler:
         k8s.upsert_env(container, "TPU_ACCELERATOR_TYPE", slice_spec.short_name)
         k8s.upsert_env(container, "TPU_TOPOLOGY", slice_spec.topology_str)
 
-    def generate_service(self, notebook: dict) -> dict:
+    def generate_service(self, notebook: dict,
+                         bound: tuple[str, str] | None = None) -> dict:
         """ClusterIP Service, port name "http-notebook" (Istio-compatible),
-        80 → container port (reference generateService, :525-552)."""
+        80 → container port (reference generateService, :525-552).
+
+        ``bound`` repoints the Service at a pool-owned warm slice in the
+        pool namespace: ExternalName to the slice's headless Service —
+        the cross-namespace route flip that makes a bind take effect
+        without touching any pod (and release/rebind is just another
+        flip)."""
         nb_name = k8s.name(notebook)
         container = api.notebook_container(notebook) or {}
         ports = container.get("ports") or [{"containerPort": DEFAULT_CONTAINER_PORT}]
@@ -386,6 +530,13 @@ class NotebookReconciler:
                 }],
             },
         }
+        if bound is not None:
+            svc["spec"] = {
+                "type": "ExternalName",
+                "externalName": f"{bound[1]}.{bound[0]}.svc."
+                                f"{self.config.cluster_domain}",
+                "ports": svc["spec"]["ports"],
+            }
         # serving-aware culling: the annotated model-serving endpoint
         # (runtime/server.py) must be reachable THROUGH the Service or the
         # culler's activity probe (controllers/culling.py
@@ -499,8 +650,9 @@ class NotebookReconciler:
         self._apply_drift(desired, found, copy_fields)
 
     def _reconcile_service(self, notebook: dict,
-                           slice_spec: SliceSpec | None) -> None:
-        self._create_or_update(self.generate_service(notebook),
+                           slice_spec: SliceSpec | None,
+                           bound: tuple[str, str] | None = None) -> None:
+        self._create_or_update(self.generate_service(notebook, bound=bound),
                                copy_service_fields)
 
     def _reconcile_headless_service(self, notebook: dict,
@@ -549,17 +701,22 @@ class NotebookReconciler:
 
     # ------------------------------------------------------------ restart
     def _handle_restart_annotation(self, notebook: dict,
-                                   slice_spec: SliceSpec | None) -> None:
+                                   slice_spec: SliceSpec | None,
+                                   bound: tuple[str, str] | None = None) \
+            -> None:
         """Restart path (reference :259-294): annotation → delete pod(s) →
         strip annotation. TPU extension: ALL slice workers are bounced
-        together (partial restarts would wedge the mesh)."""
+        together (partial restarts would wedge the mesh); a pool-BOUND
+        notebook bounces the bound slice's workers in the pool namespace."""
         if k8s.get_annotation(notebook, names.RESTART_ANNOTATION) != "true":
             return
         ns, nb_name = k8s.namespace(notebook), k8s.name(notebook)
-        for pod in self.client.list("Pod", ns,
-                                    {names.NOTEBOOK_NAME_LABEL: nb_name}):
+        pods = pool_api.bound_slice_pods(self.client, bound) if bound \
+            else self.client.list("Pod", ns,
+                                  {names.NOTEBOOK_NAME_LABEL: nb_name})
+        for pod in pods:
             try:
-                self.client.delete("Pod", ns, k8s.name(pod))
+                self.client.delete("Pod", k8s.namespace(pod), k8s.name(pod))
             except errors.NotFoundError:
                 pass
         self.client.patch(api.KIND, ns, nb_name, {
@@ -567,14 +724,22 @@ class NotebookReconciler:
 
     # ------------------------------------------------------------- status
     def _update_status(self, notebook: dict,
-                       slice_spec: SliceSpec | None) -> None:
+                       slice_spec: SliceSpec | None,
+                       bound: tuple[str, str] | None = None) -> None:
         """Mirror pod state into Notebook status (reference
-        updateNotebookStatus, :299-374) + aggregate SliceReady condition."""
+        updateNotebookStatus, :299-374) + aggregate SliceReady condition.
+        In bound mode the mirrored StatefulSet/pods are the POOL slice's
+        (they live in the pool namespace)."""
         ns, nb_name = k8s.namespace(notebook), k8s.name(notebook)
-        sts = self._find_owned_sts(notebook)
-        pods = sorted(self.client.list("Pod", ns,
-                                       {names.NOTEBOOK_NAME_LABEL: nb_name}),
-                      key=k8s.name)
+        if bound is not None:
+            sts = self.client.get_or_none("StatefulSet", bound[0], bound[1])
+            pods = sorted(pool_api.bound_slice_pods(self.client, bound),
+                          key=k8s.name)
+        else:
+            sts = self._find_owned_sts(notebook)
+            pods = sorted(self.client.list(
+                "Pod", ns, {names.NOTEBOOK_NAME_LABEL: nb_name}),
+                key=k8s.name)
         status: dict = {
             "readyReplicas": k8s.get_in(sts, "status", "readyReplicas",
                                         default=0) if sts else 0,
@@ -635,6 +800,20 @@ class NotebookReconciler:
                     "message": (f"slice {state.lower()} ({reason})"
                                 if active else ""),
                 })
+        # warm-pool bind state, mirrored alongside SliceReady: True while a
+        # pool slice backs this notebook, False (reason Migrating) while a
+        # checkpoint migration is between slices; lean set otherwise
+        migrating = k8s.get_annotation(notebook,
+                                       names.MIGRATION_STATE_ANNOTATION)
+        if bound is not None or migrating is not None:
+            status["conditions"].insert(1, {
+                "type": api.CONDITION_POOL_BOUND,
+                "status": "True" if bound is not None else "False",
+                "reason": "Bound" if bound is not None else "Migrating",
+                "message": (f"bound to pool slice {bound[0]}/{bound[1]}"
+                            if bound is not None else
+                            f"migration in flight ({migrating})"),
+            })
         if k8s.get_in(notebook, "status") != status:
             notebook = k8s.deepcopy(notebook)
             notebook["status"] = status
@@ -702,11 +881,15 @@ def copy_virtual_service_fields(desired: dict, found: dict) -> bool:
 
 def copy_service_fields(desired: dict, found: dict) -> bool:
     """reconcilehelper.CopyServiceFields (util.go:170-195): labels,
-    annotations, selector and ports only — NEVER clusterIP (util.go:182)."""
+    annotations, selector and ports only — NEVER clusterIP (util.go:182).
+    Extended with type/externalName so a warm-pool bind can flip a
+    ClusterIP Service to an ExternalName repoint (and back on release)
+    through the same drift-gated path."""
     changed = _copy_meta_maps(desired, found)
-    if found["spec"].get("selector") != desired["spec"].get("selector"):
-        found["spec"]["selector"] = k8s.deepcopy(desired["spec"]["selector"])
-        changed = True
+    for fld in ("selector", "type", "externalName"):
+        if found["spec"].get(fld) != desired["spec"].get(fld):
+            found["spec"][fld] = k8s.deepcopy(desired["spec"].get(fld))
+            changed = True
     if found["spec"].get("ports") != desired["spec"].get("ports"):
         found["spec"]["ports"] = k8s.deepcopy(desired["spec"]["ports"])
         changed = True

@@ -45,13 +45,18 @@ import random
 import threading
 import time
 
+from ..api import slicepool as pool_api
 from ..api import types as api
 from ..cluster import events
 from ..tpu.topology import SliceSpec, TpuRequestError, parse_slice_request
 from ..utils import k8s, names
 from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
-from .manager import Manager, Request, Result, label_mapper
+from .manager import Manager, Request, Result
+
+MIGRATION_CHECKPOINTING = "Checkpointing"
+MIGRATION_BINDING = "Binding"
+MIGRATION_RESUMING = "Resuming"
 
 log = logging.getLogger("kubeflow_tpu.slicerepair")
 
@@ -98,7 +103,8 @@ class SliceRepairReconciler:
 
     def __init__(self, client, config: ControllerConfig | None = None,
                  metrics: MetricsRegistry | None = None,
-                 clock=time.time, rng: random.Random | None = None):
+                 clock=time.time, rng: random.Random | None = None,
+                 migrator=None):
         from ..cluster.echo import EchoTrackingClient
         client = EchoTrackingClient(client)
         self.client = client
@@ -106,6 +112,12 @@ class SliceRepairReconciler:
         self.metrics = metrics or MetricsRegistry()
         self.clock = clock
         self._rng = rng or random.Random()
+        if migrator is None:
+            from ..runtime.migrate import SimulatedMigrationDriver
+            migrator = SimulatedMigrationDriver()
+        # checkpoint-migration driver (runtime/migrate.py): checkpoints the
+        # runtime on a dying BOUND slice and resumes it on the re-bound one
+        self.migrator = migrator
         self.recorder = events.EventRecorder(client, component=self.name)
         self._read_cache = None
         # per-slice decorrelated-jitter backoff state (in-memory is fine:
@@ -134,6 +146,10 @@ class SliceRepairReconciler:
             "slice_degraded",
             "Slices currently not healthy, by namespace and state "
             "(Degraded/Repairing/Quarantined).")
+        self.migrations_total = self.metrics.counter(
+            "notebook_migrations_total",
+            "Checkpoint-based notebook migrations between pool slices, by "
+            "outcome (success / fallback).")
         self.metrics.on_scrape(self._scrape_health)
 
     # ------------------------------------------------------------- wiring
@@ -153,8 +169,10 @@ class SliceRepairReconciler:
         self._read_cache = cache
         ne = self.client.not_echo
         mgr.watch(api.KIND, self.name, tee=tee, predicate=ne)
-        mgr.watch("Pod", self.name,
-                  mapper=label_mapper(names.NOTEBOOK_NAME_LABEL), tee=tee)
+        # bound-aware: pool-bound workers live in the pool namespace but
+        # their health belongs to a Notebook elsewhere
+        mgr.watch("Pod", self.name, mapper=pool_api.pod_notebook_mapper,
+                  tee=tee)
         mgr.watch("Node", self.name, mapper=self._node_requests, tee=tee)
         for kind in (api.KIND, "Pod", "Node"):
             try:
@@ -174,7 +192,11 @@ class SliceRepairReconciler:
         out, seen = [], set()
         for pod in pods_on_node(self._reader(), k8s.name(node)):
             nb = k8s.get_label(pod, names.NOTEBOOK_NAME_LABEL)
-            key = (k8s.namespace(pod), nb)
+            # a bound pool pod's notebook lives in the bound namespace,
+            # not the pool namespace the pod runs in
+            ns = k8s.get_label(pod, names.BOUND_NAMESPACE_LABEL) or \
+                k8s.namespace(pod)
+            key = (ns, nb)
             if nb and key not in seen:
                 seen.add(key)
                 out.append(Request(*key))
@@ -257,6 +279,18 @@ class SliceRepairReconciler:
                                  "resume with a fresh failure window")
             return Result(requeue_after=0)
 
+        # pool-bound notebooks take the MIGRATION path (checkpoint → rebind
+        # under the same hostname identity → resume) instead of an in-place
+        # repair roll: the slice is pool infrastructure, and warm capacity
+        # makes moving cheaper than rebuilding. A migration already in
+        # flight stays owned by this branch even after the unbind.
+        bound = pool_api.bound_slice_ref(notebook)
+        mstate = k8s.get_annotation(notebook,
+                                    names.MIGRATION_STATE_ANNOTATION)
+        if bound is not None or mstate is not None:
+            return self._reconcile_migration(notebook, slice_spec, bound,
+                                             mstate, key)
+
         # pods/nodes read through the informer cache (index-served, zero
         # wire cost on the poll loop); the notebook itself stays on
         # self.client — in the wired composition that IS the cache, and a
@@ -315,6 +349,175 @@ class SliceRepairReconciler:
                                  "SliceRecovered",
                                  "slice healthy again without repair")
         return None
+
+    # ---------------------------------------------------------- migration
+    def _reconcile_migration(self, notebook: dict, slice_spec: SliceSpec,
+                             bound: tuple[str, str] | None,
+                             mstate: str | None,
+                             key: tuple[str, str]) -> Result | None:
+        """Checkpoint-based migration of a pool-bound notebook:
+
+            (problem detected) → Checkpointing → Binding → Resuming → done
+
+        Each state is annotation-persisted BEFORE its side effect runs, so
+        a controller crash resumes exactly where it left off (the driver
+        steps are idempotent). Any failure or timeout falls back to the
+        PR-4 cold-roll path via a bind-miss — preemption must never lose
+        the notebook, only its warm start."""
+        now = self.clock()
+        poll = Result(requeue_after=self.config.slice_repair_poll_s)
+        reader = self._reader()
+        pods = pool_api.bound_slice_pods(reader, bound) if bound else []
+        state = slice_health(notebook)
+
+        if mstate is None:
+            problems = self._detect(notebook, pods)
+            if not problems and state is None:
+                # the PR-4 silent worker-replacement latch applies to
+                # bound slices too: every pod Ready but a PARTIAL UID
+                # mismatch vs the mesh-formation baseline = orphaned JAX
+                # client — migration re-forms the mesh on a fresh slice
+                replaced = self._worker_replacement(notebook, slice_spec,
+                                                   pods)
+                if replaced:
+                    problems = [replaced]
+            if not problems:
+                if state is not None:
+                    ready = sum(1 for p in pods if _pod_ready(p))
+                    if ready < slice_spec.num_workers:
+                        return poll  # still converging; stay Degraded
+                    self._patch(notebook, {
+                        names.SLICE_HEALTH_ANNOTATION: None,
+                        names.SLICE_HEALTH_REASON_ANNOTATION: None,
+                    })
+                    self._reset_backoff(key)
+                    self.recorder.eventf(
+                        notebook, events.TYPE_NORMAL, "SliceRecovered",
+                        "bound slice healthy again without migration")
+                return None
+            reason, detail = problems[0]
+            if state != DEGRADED:
+                self._patch(notebook, {
+                    names.SLICE_HEALTH_ANNOTATION: DEGRADED,
+                    names.SLICE_HEALTH_REASON_ANNOTATION: reason,
+                })
+                self.recorder.eventf(
+                    notebook, events.TYPE_WARNING, "SliceDegraded",
+                    f"bound slice degraded ({reason}): {detail}")
+            # persist the migration intent FIRST, then checkpoint
+            self._patch(notebook, {
+                names.MIGRATION_STATE_ANNOTATION: MIGRATION_CHECKPOINTING,
+                names.MIGRATION_STARTED_AT_ANNOTATION: "%.3f" % now,
+            })
+            self.recorder.eventf(
+                notebook, events.TYPE_NORMAL, "NotebookMigrationStarted",
+                f"checkpointing runtime off degraded slice "
+                f"{bound[0]}/{bound[1]} ({reason})")
+            mstate = MIGRATION_CHECKPOINTING
+
+        started_raw = k8s.get_annotation(
+            notebook, names.MIGRATION_STARTED_AT_ANNOTATION)
+        try:
+            started = float(started_raw) if started_raw else now
+        except (TypeError, ValueError):
+            started = now
+        if now - started > self.config.pool_migration_timeout_s or \
+                k8s.get_annotation(notebook,
+                                   names.POOL_BIND_MISS_ANNOTATION):
+            return self._migration_fallback(
+                notebook, key, "MigrationTimeout"
+                if not k8s.get_annotation(
+                    notebook, names.POOL_BIND_MISS_ANNOTATION)
+                else "NoWarmSlice")
+
+        if mstate == MIGRATION_CHECKPOINTING:
+            try:
+                token = self.migrator.checkpoint(self.client, notebook)
+            except Exception as exc:  # noqa: BLE001 — any checkpoint
+                # failure (driver bug, unreadable state) must degrade to
+                # the cold roll, never wedge the notebook mid-migration
+                log.warning("checkpoint for %s/%s failed: %s",
+                            key[0], key[1], exc)
+                return self._migration_fallback(notebook, key,
+                                               "CheckpointFailed")
+            # unbind: the pool controller drains/replaces the old slice
+            # and re-binds us (migration re-binds queue first) under the
+            # SAME slice-identity — TPU_WORKER_HOSTNAMES is preserved by
+            # construction
+            self._patch(notebook, {
+                names.MIGRATION_STATE_ANNOTATION: MIGRATION_BINDING,
+                names.CHECKPOINT_TOKEN_ANNOTATION: token,
+                names.BOUND_SLICE_ANNOTATION: None,
+                names.BOUND_POOL_ANNOTATION: None,
+            })
+            return poll
+
+        if mstate == MIGRATION_BINDING:
+            if bound is None:
+                return poll  # waiting for the pool controller's re-bind
+            ready = sum(1 for p in pods if _pod_ready(p))
+            if ready < slice_spec.num_workers or \
+                    self._detect(notebook, pods):
+                return poll  # re-bound slice still rolling its identity in
+            self._patch(notebook, {
+                names.MIGRATION_STATE_ANNOTATION: MIGRATION_RESUMING})
+            mstate = MIGRATION_RESUMING
+
+        if mstate == MIGRATION_RESUMING:
+            if bound is None:
+                return poll
+            token = k8s.get_annotation(
+                notebook, names.CHECKPOINT_TOKEN_ANNOTATION) or ""
+            try:
+                self.migrator.resume(self.client, notebook, token)
+            except Exception as exc:  # noqa: BLE001 — same contract as
+                # checkpoint: fall back rather than wedge
+                log.warning("resume for %s/%s failed: %s",
+                            key[0], key[1], exc)
+                return self._migration_fallback(notebook, key,
+                                               "ResumeFailed")
+            duration = max(now - started, 0.0)
+            self._patch(notebook, {
+                names.MIGRATION_STATE_ANNOTATION: None,
+                names.MIGRATION_STARTED_AT_ANNOTATION: None,
+                names.CHECKPOINT_TOKEN_ANNOTATION: None,
+                names.SLICE_HEALTH_ANNOTATION: None,
+                names.SLICE_HEALTH_REASON_ANNOTATION: None,
+            })
+            self._reset_backoff(key)
+            self.migrations_total.inc({"outcome": "success"})
+            self.recorder.eventf(
+                notebook, events.TYPE_NORMAL, "NotebookMigrated",
+                f"resumed on warm slice {bound[0]}/{bound[1]} after "
+                f"{duration:.1f}s (identity preserved)")
+            return None
+        # unknown persisted state (operator edit): treat as failed
+        return self._migration_fallback(notebook, key, "UnknownState")
+
+    def _migration_fallback(self, notebook: dict, key: tuple[str, str],
+                            reason: str) -> Result | None:
+        """Migration could not complete (no warm capacity, checkpoint or
+        resume failure, timeout): release the pool path entirely and let
+        the core reconciler cold-roll a dedicated StatefulSet — the PR-4
+        repair machinery owns the notebook from there. The checkpoint
+        token is kept: a restore-at-boot can still pick it up."""
+        self._patch(notebook, {
+            names.MIGRATION_STATE_ANNOTATION: None,
+            names.MIGRATION_STARTED_AT_ANNOTATION: None,
+            names.BOUND_SLICE_ANNOTATION: None,
+            names.BOUND_POOL_ANNOTATION: None,
+            names.POOL_BIND_MISS_ANNOTATION: reason,
+            names.SLICE_HEALTH_ANNOTATION: None,
+            names.SLICE_HEALTH_REASON_ANNOTATION: None,
+        })
+        self._reset_backoff(key)
+        self.migrations_total.inc({"outcome": "fallback"})
+        self.recorder.eventf(
+            notebook, events.TYPE_WARNING, "NotebookMigrationFallback",
+            f"migration abandoned ({reason}); cold-rolling a dedicated "
+            f"StatefulSet instead — runtime resumes from the last "
+            f"checkpoint at boot")
+        return Result(requeue_after=0)
 
     # ---------------------------------------------------------- detection
     def _detect(self, notebook: dict,

@@ -131,6 +131,72 @@ def notebook_crd() -> dict:
     }
 
 
+def slicepool_crd() -> dict:
+    """CustomResourceDefinition for the warm slice pool (tpu.kubeflow.org/v1
+    SlicePool, cluster-scoped — controllers/slicepool.py). Single served
+    version; no reference analog."""
+    from ..api import slicepool
+    schema_doc = {
+        "openAPIV3Schema": {
+            "type": "object",
+            "properties": {
+                "spec": {
+                    "type": "object",
+                    "required": ["accelerator", "warmReplicas"],
+                    "properties": {
+                        "accelerator": {"type": "string"},
+                        "warmReplicas": {"type": "integer",
+                                         "format": "int32", "minimum": 0},
+                        "namespace": {"type": "string"},
+                        "weights": {
+                            "type": "object",
+                            "additionalProperties": {"type": "integer",
+                                                     "minimum": 1},
+                        },
+                    },
+                },
+                "status": {
+                    "type": "object",
+                    "properties": {
+                        "warm": {"type": "integer", "format": "int32"},
+                        "warming": {"type": "integer", "format": "int32"},
+                        "bound": {"type": "integer", "format": "int32"},
+                        "pending": {"type": "integer", "format": "int32"},
+                    },
+                },
+            },
+        },
+    }
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{slicepool.PLURAL}.{slicepool.GROUP}"},
+        "spec": {
+            "group": slicepool.GROUP,
+            "names": {"kind": slicepool.KIND, "listKind": "SlicePoolList",
+                      "plural": slicepool.PLURAL, "singular": "slicepool"},
+            "scope": "Cluster",
+            "versions": [{
+                "name": slicepool.VERSION,
+                "served": True,
+                "storage": True,
+                "schema": schema_doc,
+                "subresources": {"status": {}},
+                "additionalPrinterColumns": [
+                    {"name": "Accelerator", "type": "string",
+                     "jsonPath": ".spec.accelerator"},
+                    {"name": "Target", "type": "integer",
+                     "jsonPath": ".spec.warmReplicas"},
+                    {"name": "Warm", "type": "integer",
+                     "jsonPath": ".status.warm"},
+                    {"name": "Bound", "type": "integer",
+                     "jsonPath": ".status.bound"},
+                ],
+            }],
+        },
+    }
+
+
 # ------------------------------------------------------------------- manager
 
 def parse_params_env(text: str) -> dict[str, str]:
@@ -464,8 +530,10 @@ def render_kustomize_tree() -> dict[str, object]:
     notebook-controller/config/overlays)."""
     tree: dict[str, object] = {
         "crd/bases/kubeflow.org_notebooks.yaml": notebook_crd(),
+        "crd/bases/tpu.kubeflow.org_slicepools.yaml": slicepool_crd(),
         "crd/kustomization.yaml":
-            _kustomization(["bases/kubeflow.org_notebooks.yaml"]),
+            _kustomization(["bases/kubeflow.org_notebooks.yaml",
+                            "bases/tpu.kubeflow.org_slicepools.yaml"]),
         "manager/manager.yaml": [manager_deployment(),
                                  extension_deployment(), culler_configmap(),
                                  manager_health_service(),

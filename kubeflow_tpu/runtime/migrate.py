@@ -1,0 +1,115 @@
+"""Checkpoint-based notebook migration drivers.
+
+The control-plane side of a migration (controllers/slicerepair.py) is a
+three-step annotation machine — Checkpointing → Binding → Resuming — and
+this module supplies the step that touches runtime state: *checkpoint the
+training/serving state on the dying slice, resume it on the freshly bound
+one*. Two drivers share one seam:
+
+- ``SimulatedMigrationDriver`` (default wiring): annotation-carried step
+  bookkeeping for the in-process cluster, where pods hold no real JAX
+  processes. ``checkpoint`` snapshots the runtime-step annotation (the
+  simulator analog of "the step the trainer had reached") into a token;
+  ``resume`` writes it back as the resumed step. Chaos asserts
+  resumed == checkpointed: step continuity across the migration.
+
+- ``CheckpointMigrationDriver``: the real thing, backed by
+  runtime/checkpoint.TrainCheckpointer (orbax, sharding-aware). The save
+  taken at ``checkpoint`` restores onto the NEW slice's mesh via abstract
+  shardings — the cross-mesh restore the checkpointer already supports is
+  exactly why migration needs no same-topology-layout guarantee beyond the
+  worker count. Orbax imports stay inside methods: constructing the driver
+  must not force jax into a control-plane-only process.
+
+Identity note: migration preserves ``TPU_WORKER_HOSTNAMES`` (the slice
+identity annotation) by construction — the pool controller imposes the
+notebook's identity on the new slice's template — so a resumed
+``jax.distributed`` client re-initializes against the same coordinator
+address list it formed the original mesh on.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from ..utils import k8s, names
+
+log = logging.getLogger("kubeflow_tpu.migrate")
+
+
+class MigrationError(RuntimeError):
+    """A checkpoint or resume step failed; the caller falls back to the
+    cold-roll path instead of retrying blindly."""
+
+
+class SimulatedMigrationDriver:
+    """Annotation-carried checkpoint/resume for the in-process cluster.
+
+    The token is self-contained JSON (not controller memory), so a manager
+    restart between Checkpointing and Resuming still resumes the right
+    step — the same restart-safety contract the repair annotations keep.
+    """
+
+    def checkpoint(self, client, notebook: dict) -> str:
+        step_raw = k8s.get_annotation(notebook,
+                                      names.RUNTIME_STEP_ANNOTATION)
+        try:
+            step = int(step_raw) if step_raw is not None else 0
+        except ValueError as exc:
+            raise MigrationError(
+                f"unparseable runtime step {step_raw!r}") from exc
+        return json.dumps({"step": step})
+
+    def resume(self, client, notebook: dict, token: str) -> None:
+        try:
+            step = int(json.loads(token)["step"])
+        except (ValueError, KeyError, TypeError) as exc:
+            raise MigrationError(f"bad checkpoint token {token!r}") from exc
+        client.patch(k8s.kind(notebook), k8s.namespace(notebook),
+                     k8s.name(notebook), {"metadata": {"annotations": {
+                         names.RESUMED_STEP_ANNOTATION: str(step)}}})
+
+
+class CheckpointMigrationDriver:
+    """Orbax-backed migration: force-save the live train state before the
+    slice dies, restore it (resharded onto the new mesh) once the bound
+    slice is up.
+
+    ``state_provider(notebook) -> (step, params, opt_state)`` and
+    ``abstract_provider(notebook) -> (abstract_params, abstract_opt_state)``
+    are the seams an in-pod agent fills in (the controller process does not
+    hold user pytrees); ``directory_for(notebook)`` maps a notebook to its
+    checkpoint location (its PVC path in production)."""
+
+    def __init__(self, directory_for, state_provider, abstract_provider):
+        self.directory_for = directory_for
+        self.state_provider = state_provider
+        self.abstract_provider = abstract_provider
+
+    def checkpoint(self, client, notebook: dict) -> str:
+        from .checkpoint import TrainCheckpointer
+        directory = self.directory_for(notebook)
+        step, params, opt_state = self.state_provider(notebook)
+        with TrainCheckpointer(directory, async_save=False) as ckpt:
+            if not ckpt.save(step, params, opt_state, force=True):
+                raise MigrationError(f"save at step {step} was skipped")
+        return json.dumps({"step": int(step), "directory": str(directory)})
+
+    def resume(self, client, notebook: dict, token: str):
+        from .checkpoint import TrainCheckpointer
+        try:
+            meta = json.loads(token)
+            step, directory = int(meta["step"]), meta["directory"]
+        except (ValueError, KeyError, TypeError) as exc:
+            raise MigrationError(f"bad checkpoint token {token!r}") from exc
+        abstract_params, abstract_opt = self.abstract_provider(notebook)
+        with TrainCheckpointer(directory) as ckpt:
+            restored = ckpt.restore(abstract_params, abstract_opt, step=step)
+        if restored is None:
+            raise MigrationError(f"no checkpoint at step {step} "
+                                 f"in {directory}")
+        client.patch(k8s.kind(notebook), k8s.namespace(notebook),
+                     k8s.name(notebook), {"metadata": {"annotations": {
+                         names.RESUMED_STEP_ANNOTATION: str(restored[0])}}})
+        return restored

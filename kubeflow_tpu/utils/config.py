@@ -78,6 +78,22 @@ class ControllerConfig:
     # safety-net requeue while a repair phase waits on pod churn (the state
     # machine is otherwise event-driven off the Pod/Node watches)
     slice_repair_poll_s: float = 0.25
+    # warm slice pools (controllers/slicepool.py): pre-rolled slices a
+    # notebook BINDS instead of cold-rolling a StatefulSet
+    enable_slice_pool: bool = True
+    # default namespace pool slices materialize in (SlicePool.spec.namespace
+    # overrides per pool)
+    pool_namespace: str = "tpu-slice-pools"
+    # how long the core reconciler holds off its cold roll waiting for the
+    # pool controller to bind a warm slice; past this it stamps a
+    # BindTimeout miss and cold-rolls (the pool being down must never
+    # strand notebook creation)
+    pool_bind_grace_s: float = 5.0
+    # checkpoint migration: bound on the unbind→rebind→resume window; past
+    # it the migration falls back to a cold roll (PR-4 repair semantics)
+    pool_migration_timeout_s: float = 60.0
+    # safety-net requeue while the pool warms slices / waits on binds
+    pool_poll_s: float = 0.25
     # TPU-native
     tpu_default_image: str = "us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest"
     image_swap_map: dict = field(default_factory=dict)  # cuda image → jax/libtpu image
@@ -121,6 +137,13 @@ class ControllerConfig:
                 env.get("SLICE_REPAIR_WINDOW", "900")),
             slice_repair_poll_s=float(
                 env.get("SLICE_REPAIR_POLL", "0.25")),
+            enable_slice_pool=_env_bool("ENABLE_SLICE_POOL", True),
+            pool_namespace=env.get("SLICE_POOL_NAMESPACE",
+                                   "tpu-slice-pools"),
+            pool_bind_grace_s=float(env.get("POOL_BIND_GRACE", "5")),
+            pool_migration_timeout_s=float(
+                env.get("POOL_MIGRATION_TIMEOUT", "60")),
+            pool_poll_s=float(env.get("POOL_POLL", "0.25")),
             tpu_default_image=env.get(
                 "TPU_NOTEBOOK_IMAGE",
                 "us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest"),

@@ -87,6 +87,74 @@ SLICE_REPAIR_ANNOTATIONS = frozenset({
 # the node before the termination hits mid-step
 PREEMPTION_TAINT_KEY = "cloud.google.com/impending-node-termination"
 
+# --- warm slice pools (controllers/slicepool.py) ---
+# label on pool-owned StatefulSets/Services/pods naming the SlicePool they
+# belong to; indexed (cluster/cache.py DEFAULT_LABEL_INDEXES) so the pool
+# controller's per-reconcile inventory is O(pool), never a cache scan
+POOL_LABEL = "tpu.kubeflow.org/pool"
+# lifecycle of a pool slice, on the pool StatefulSet:
+#   Warming  — rolling to full replicas / pods not all Ready yet
+#   Warm     — full replicas Ready, waiting for a notebook to bind
+#   Bound    — serving a notebook (POOL_BOUND_TO names it)
+#   Draining — consumed by a migration off dead capacity; torn down and
+#              replaced by a fresh Warming slice, never reused in place
+POOL_STATE_ANNOTATION = "tpu.kubeflow.org/pool-state"
+POOL_STATE_WARMING = "Warming"
+POOL_STATE_WARM = "Warm"
+POOL_STATE_BOUND = "Bound"
+POOL_STATE_DRAINING = "Draining"
+# "<namespace>/<notebook>" on a Bound pool StatefulSet — the reverse edge
+# of the Notebook's BOUND_SLICE_ANNOTATION (both survive restarts; the
+# pool controller heals a crash between the two patches from either side)
+POOL_BOUND_TO_ANNOTATION = "tpu.kubeflow.org/pool-bound-to"
+# on the Notebook: "<pool-namespace>/<statefulset>" of the bound warm
+# slice, and the SlicePool it came from. Presence of BOUND_SLICE is what
+# flips the core reconciler into bound mode (no owned StatefulSet).
+BOUND_SLICE_ANNOTATION = "tpu.kubeflow.org/bound-slice"
+BOUND_POOL_ANNOTATION = "tpu.kubeflow.org/bound-pool"
+# on bound pool pods (and the bound template): the notebook's namespace —
+# pod→notebook watch mapping must route to the NOTEBOOK's namespace, not
+# the pool namespace the pod lives in
+BOUND_NAMESPACE_LABEL = "tpu.kubeflow.org/bound-namespace"
+# comma-joined worker hostnames, stamped on the Notebook at FIRST bind and
+# never rewritten: the slice identity the runtime formed its mesh on.
+# Every later bind (checkpoint migration) imposes this identity on the new
+# slice's TPU_WORKER_HOSTNAMES — preemption moves the notebook, not its
+# mesh identity.
+SLICE_IDENTITY_ANNOTATION = "tpu.kubeflow.org/slice-identity"
+# set by the pool controller (contended pool: fair-share loser) or by the
+# core reconciler (bind-grace timeout): this notebook cold-rolls its own
+# StatefulSet instead of waiting for a warm slice. Value = reason.
+POOL_BIND_MISS_ANNOTATION = "tpu.kubeflow.org/pool-bind-miss"
+# heartbeat (epoch seconds) the pool controller refreshes on notebooks it
+# has ADMITTED but not yet bound (slice still warming / spill-waiting):
+# proof the pool controller is alive and working on it, which suspends
+# the core's bind-grace timeout — the grace exists to detect a DEAD pool
+# controller, not to race a slice's legitimate warm-up time
+POOL_BIND_PENDING_ANNOTATION = "tpu.kubeflow.org/pool-bind-pending"
+# checkpoint-based migration sub-state on the Notebook, owned by the
+# repair controller: "Checkpointing" → "Binding" → "Resuming"; absent =
+# no migration in flight. Stamped alongside MIGRATION_STARTED_AT so the
+# bind-wait timeout survives controller restarts.
+MIGRATION_STATE_ANNOTATION = "tpu.kubeflow.org/migration-state"
+MIGRATION_STARTED_AT_ANNOTATION = "tpu.kubeflow.org/migration-started-at"
+# migration driver bookkeeping (runtime/migrate.py): the checkpoint token
+# taken before unbinding, and the step the runtime resumed at on the new
+# slice (chaos asserts resumed == checkpointed: step continuity)
+CHECKPOINT_TOKEN_ANNOTATION = "tpu.kubeflow.org/checkpoint-token"
+RUNTIME_STEP_ANNOTATION = "tpu.kubeflow.org/runtime-step"
+RESUMED_STEP_ANNOTATION = "tpu.kubeflow.org/resumed-step"
+# pool/migration bookkeeping never propagates into a cold-rolled
+# StatefulSet's template (same churn rationale as SLICE_REPAIR_ANNOTATIONS)
+POOL_ANNOTATIONS = frozenset({
+    BOUND_SLICE_ANNOTATION, BOUND_POOL_ANNOTATION,
+    SLICE_IDENTITY_ANNOTATION, POOL_BIND_MISS_ANNOTATION,
+    POOL_BIND_PENDING_ANNOTATION,
+    MIGRATION_STATE_ANNOTATION, MIGRATION_STARTED_AT_ANNOTATION,
+    CHECKPOINT_TOKEN_ANNOTATION, RUNTIME_STEP_ANNOTATION,
+    RESUMED_STEP_ANNOTATION,
+})
+
 # where the apiserver facade's service-proxy subresource forwards: in the
 # in-process cluster pods hold no real sockets, so the composition root
 # (or a test) annotates the Service with the actual listener's base URL

@@ -144,7 +144,10 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
              watch_kill_after_s: float = 0.0,
              max_relist_resyncs: int | None = None,
              min_conn_reuse: float | None = None,
-             settle_s: float = 0.0) -> int:
+             settle_s: float = 0.0,
+             pool_warm: int = 0,
+             boot_delay_ms: float = 0.0,
+             stats_out: dict | None = None) -> int:
     """Controller wire-cost measurement: the full controller stack runs
     over a real HTTP apiserver while the load generator drives the store
     directly, so ``rest_client_requests_total`` counts ONLY controller
@@ -183,7 +186,15 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
     ``min_conn_reuse`` bounds requests-per-connection from below (the
     keep-alive pool's proof that connections don't scale with requests).
     ``settle_s`` keeps the run alive that long after convergence so
-    reconnect chaos actually happens on an idle fleet too."""
+    reconnect chaos actually happens on an idle fleet too.
+
+    ``pool_warm`` pre-creates a SlicePool with that warm-slice target and
+    waits for it to warm BEFORE the fan-out, so every notebook takes the
+    bind path (controllers/slicepool.py); with pool_warm >= count the run
+    fails on any bind miss (a notebook that cold-rolled). ``boot_delay_ms``
+    is the simulated per-pod provisioning cost (node spin-up + image pull)
+    — the cost a warm bind exists to not pay. ``stats_out`` (a dict)
+    receives wall/p50/req-per-notebook for phase-vs-phase comparisons."""
     import tempfile
 
     from kubeflow_tpu.api import types as api
@@ -219,8 +230,11 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
         audit_file.close()
         audit_path = audit_file.name
 
+    from kubeflow_tpu.api.slicepool import install_slicepool_crd
+
     store = ClusterStore()
     api.install_notebook_crd(store)
+    install_slicepool_crd(store)
     cleanups = []
     try:
         # the simulator reads through its own indexed informer cache (the
@@ -232,7 +246,9 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
         sim_cache = CachingClient(store, auto_informer=False,
                                   disable_for=())
         sim_mgr = Manager(sim_cache, read_cache=sim_cache)
-        StatefulSetSimulator(sim_cache, boot_delay_s=0.0).setup(sim_mgr)
+        StatefulSetSimulator(sim_cache,
+                             boot_delay_s=boot_delay_ms / 1000.0
+                             ).setup(sim_mgr)
         sim_mgr.start()
         cleanups.append(sim_mgr.stop)
         proxy = ApiServerProxy(store,
@@ -254,6 +270,29 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
         requests = metrics.counter("rest_client_requests_total", "")
         # let the watch backfills settle so the baseline excludes boot cost
         time.sleep(0.3)
+        if pool_warm > 0:
+            # warm the pool BEFORE the fan-out (and before the request
+            # baseline: warm-up is capacity provisioning, not per-notebook
+            # bind cost — exactly the cost split the pool exists for)
+            from kubeflow_tpu.api.slicepool import new_slice_pool
+            from kubeflow_tpu.utils.k8s import get_annotation
+            store.create(new_slice_pool("loadtest-pool", accelerator,
+                                        pool_warm))
+            warm_deadline = time.monotonic() + timeout
+
+            def _warm_count() -> int:
+                return sum(
+                    1 for s in store.list("StatefulSet", "tpu-slice-pools")
+                    if get_annotation(s, names.POOL_STATE_ANNOTATION)
+                    == names.POOL_STATE_WARM)
+            while time.monotonic() < warm_deadline:
+                if _warm_count() >= pool_warm:
+                    break
+                time.sleep(0.05)
+            else:
+                print(f"FAIL: pool never reached {pool_warm} warm slices "
+                      f"(have {_warm_count()})")
+                return 1
         baseline = requests.total()
         # per-notebook create→SliceReady latency, observed via a store
         # watch — a tight full-LIST poll at a 500-notebook fan-out costs
@@ -326,6 +365,10 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
                 name, namespace,
                 annotations={names.TPU_ACCELERATOR_ANNOTATION: accelerator}))
         all_ready.wait(timeout)
+        # bind-path request cost snapshot AT convergence: pool re-warming
+        # continues in the background (replacement capacity, not
+        # per-notebook cost) and must not pollute the comparison
+        converged_requests = requests.total()
         if settle_s > 0:
             # idle-fleet window: watch chaos keeps firing while nothing
             # changes — reconnects must resume off bookmarks, not relist
@@ -373,6 +416,14 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
         # one metrics scrape, so the notebook_running LIST cost is included
         metrics.expose()
         per_nb = (requests.total() - baseline) / max(count, 1)
+        latencies = sorted(ready_at[n] - created_at[n] for n in ready_at)
+        if stats_out is not None:
+            stats_out.update({
+                "wall_s": wall,
+                "p50_s": statistics.median(latencies) if latencies else None,
+                "req_per_nb": (converged_requests - baseline)
+                / max(count, 1),
+            })
         if ready < count:
             stuck = [n for n in created_at if n not in ready_at]
             print(f"FAIL: only {ready}/{count} notebooks became SliceReady "
@@ -456,6 +507,24 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
                   f"connections for {pooled_reqs:.0f} pooled-path requests "
                   f"— keep-alive pooling regressed)")
             return 1
+        if pool_warm > 0:
+            from kubeflow_tpu.utils.k8s import get_annotation
+            bound, missed = [], []
+            for name in created_at:
+                nb = store.get_or_none(api.KIND, namespace, name)
+                if nb is None:
+                    continue
+                if get_annotation(nb, names.BOUND_SLICE_ANNOTATION):
+                    bound.append(name)
+                elif get_annotation(nb, names.POOL_BIND_MISS_ANNOTATION):
+                    missed.append(name)
+            print(f"pool: {len(bound)}/{count} warm-bound, "
+                  f"{len(missed)} bind misses")
+            if pool_warm >= count and missed:
+                print(f"FAIL: pool had capacity for the whole fleet but "
+                      f"{len(missed)} notebook(s) missed the bind path: "
+                      f"{missed[:5]}")
+                return 1
         if partial_observed:
             sample = partial_observed[:5]
             print(f"FAIL: {len(partial_observed)} partial-slice replica "
@@ -573,6 +642,15 @@ def main() -> int:
     ap.add_argument("--settle-s", type=float, default=0.0,
                     help="with --wire: keep the run alive this long after "
                          "convergence (idle-fleet watch chaos window)")
+    ap.add_argument("--pool-warm", type=int, default=0,
+                    help="with --wire: pre-warm a SlicePool with this "
+                         "many slices before the fan-out so notebooks "
+                         "BIND instead of cold-rolling; >= --count also "
+                         "fails the run on any bind miss")
+    ap.add_argument("--boot-delay-ms", type=float, default=0.0,
+                    help="with --wire: simulated per-pod provisioning "
+                         "cost (node spin-up + image pull) — what a warm "
+                         "bind skips")
     args = ap.parse_args()
     if args.emit_yaml:
         try:
@@ -597,7 +675,9 @@ def main() -> int:
                         watch_kill_after_s=args.watch_kill_after_s,
                         max_relist_resyncs=args.max_relist_resyncs,
                         min_conn_reuse=args.min_conn_reuse,
-                        settle_s=args.settle_s)
+                        settle_s=args.settle_s,
+                        pool_warm=args.pool_warm,
+                        boot_delay_ms=args.boot_delay_ms)
     return run_inprocess(args.count, args.namespace, args.accelerator,
                          args.timeout, server=args.server,
                          workers=args.workers)

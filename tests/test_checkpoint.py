@@ -162,3 +162,54 @@ def test_bf16_master_state_roundtrips_and_resumes(tmp_path):
     got_leaf = np.asarray(jax.device_get(
         jax.tree.leaves(rparams2)[0]).astype(np.float32))
     np.testing.assert_array_equal(got_leaf, ref_leaf)
+
+
+# ----------------------------------------------------- migration drivers
+
+def test_checkpoint_migration_driver_roundtrip(tmp_path):
+    """The orbax-backed migration driver (runtime/migrate.py): a forced
+    save on the 'dying slice' restores on the 'new slice' via abstract
+    state, and the resumed step lands on the notebook annotation — the
+    contract the control-plane migration path drives."""
+    from kubeflow_tpu.cluster.store import ClusterStore
+    from kubeflow_tpu.runtime.migrate import CheckpointMigrationDriver
+    from kubeflow_tpu.utils import k8s, names
+
+    _, params, opt_state, _ = make_state(MeshConfig.auto(8, tp=2))
+    driver = CheckpointMigrationDriver(
+        directory_for=lambda nb: tmp_path / "mig",
+        state_provider=lambda nb: (7, params, opt_state),
+        abstract_provider=lambda nb: (abstract_state(params),
+                                      abstract_state(opt_state)))
+    store = ClusterStore()
+    from kubeflow_tpu.api import types as api
+    store.create(api.new_notebook("mig-nb", "ns"))
+    nb = store.get(api.KIND, "ns", "mig-nb")
+    token = driver.checkpoint(store, nb)
+    restored = driver.resume(store, nb, token)
+    assert restored is not None and restored[0] == 7
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)),
+        jax.device_get(params), jax.device_get(restored[1]))
+    nb = store.get(api.KIND, "ns", "mig-nb")
+    assert k8s.get_annotation(nb, names.RESUMED_STEP_ANNOTATION) == "7"
+
+
+def test_simulated_migration_driver_step_continuity():
+    from kubeflow_tpu.cluster.store import ClusterStore
+    from kubeflow_tpu.runtime.migrate import (MigrationError,
+                                              SimulatedMigrationDriver)
+    from kubeflow_tpu.api import types as api
+    from kubeflow_tpu.utils import k8s, names
+
+    store = ClusterStore()
+    store.create(api.new_notebook("sim-nb", "ns", annotations={
+        names.RUNTIME_STEP_ANNOTATION: "123"}))
+    nb = store.get(api.KIND, "ns", "sim-nb")
+    driver = SimulatedMigrationDriver()
+    token = driver.checkpoint(store, nb)
+    driver.resume(store, nb, token)
+    assert k8s.get_annotation(store.get(api.KIND, "ns", "sim-nb"),
+                              names.RESUMED_STEP_ANNOTATION) == "123"
+    with pytest.raises(MigrationError):
+        driver.resume(store, nb, "not-json")

@@ -324,3 +324,87 @@ def test_unreachable_serving_endpoint_is_not_activity(serving_world):
     nb = store.get(api.KIND, "ns", "nb")
     assert k8s.get_annotation(nb, names.STOP_ANNOTATION) is not None
     assert serving.probes > 0
+
+
+# ------------------------------------------------------- warm-pool release
+
+def test_culling_pool_bound_notebook_releases_not_deletes(store):
+    """Culling a pool-BOUND notebook must hand the backing StatefulSet
+    back to the pool (released + scrubbed + re-warmed), never delete or
+    zero it — and the scrub must strip tenant residue (user annotations,
+    any leaked idle-clock annotations) so the NEXT notebook binding this
+    slice starts with a fresh idle clock instead of inheriting a stale
+    one and being insta-culled."""
+    from kubeflow_tpu.api import slicepool as pool_api
+    from kubeflow_tpu.controllers import SlicePoolReconciler
+
+    clock = FakeClock()
+    jupyter = FakeJupyter()
+    cfg = ControllerConfig(enable_culling=True, cull_idle_time_min=60,
+                           idleness_check_period_min=1, pool_poll_s=0.02,
+                           pool_bind_grace_s=5.0)
+    metrics = MetricsRegistry()
+    mgr = Manager(store)
+    NotebookReconciler(store, cfg, metrics).setup(mgr)
+    CullingReconciler(store, cfg, metrics, prober=jupyter,
+                      clock=clock).setup(mgr)
+    SlicePoolReconciler(store, cfg, metrics).setup(mgr)
+    StatefulSetSimulator(store, boot_delay_s=0.0).setup(mgr)
+
+    store.create(pool_api.new_slice_pool("cull-pool", "v5e-16", 1))
+    drain(mgr, include_delayed_under=0.1)
+    store.create(api.new_notebook("nb", "ns", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}))
+    drain(mgr, include_delayed_under=0.1)
+    nb = store.get(api.KIND, "ns", "nb")
+    bound = pool_api.bound_slice_ref(nb)
+    assert bound is not None, "notebook never bound the warm slice"
+    # culler probes worker-0 IN THE POOL NAMESPACE and initializes the clock
+    tick(store, mgr, clock, 2)
+    assert k8s.get_annotation(store.get(api.KIND, "ns", "nb"),
+                              names.LAST_ACTIVITY_ANNOTATION) is not None
+    # simulate tenant residue leaking onto the slice (the scrub contract)
+    store.patch("StatefulSet", bound[0], bound[1], {"metadata": {
+        "annotations": {names.LAST_ACTIVITY_ANNOTATION: "2000-01-01T00:00:00Z",
+                        "user.example.com/note": "sticky"}}})
+
+    # idle past the threshold → culled
+    jupyter.activity = JupyterActivity(kernels=[{
+        "execution_state": "idle", "last_activity": format_time(clock())}])
+    tick(store, mgr, clock, 2)
+    tick(store, mgr, clock, 61)
+    assert k8s.get_annotation(store.get(api.KIND, "ns", "nb"),
+                              names.STOP_ANNOTATION) is not None
+    drain(mgr, include_delayed_under=0.1)
+
+    # released, NOT deleted — and not scaled to 0 (the cull released the
+    # bind; the slice re-warms at full replicas for the next tenant)
+    sts = store.get_or_none("StatefulSet", *bound)
+    assert sts is not None, "culling deleted the pool-backed StatefulSet"
+    assert sts["spec"]["replicas"] == 4
+    assert pool_api.bound_slice_ref(store.get(api.KIND, "ns", "nb")) is None
+    # scrub: tenant residue gone, pool bookkeeping intact
+    anns = k8s.annotations(sts) or {}
+    assert names.LAST_ACTIVITY_ANNOTATION not in anns
+    assert "user.example.com/note" not in anns
+    assert names.POOL_BOUND_TO_ANNOTATION not in anns
+    assert k8s.get_label(sts, names.POOL_LABEL) == "cull-pool"
+
+    # a NEW notebook re-binds the released slice with a fresh idle clock
+    drain(mgr, include_delayed_under=0.1)  # let the scrubbed slice re-warm
+    jupyter.activity = JupyterActivity(kernels=[{"execution_state": "busy"}])
+    store.create(api.new_notebook("nb2", "ns2", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}))
+    drain(mgr, include_delayed_under=0.1)
+    nb2 = store.get(api.KIND, "ns2", "nb2")
+    assert pool_api.bound_slice_ref(nb2) == bound, "slice never re-bound"
+    # no inherited idle clock: last-activity initializes AT re-bind time,
+    # not from the previous tenant's stale stamp
+    tick(store, mgr, clock, 2)
+    nb2 = store.get(api.KIND, "ns2", "nb2")
+    last = k8s.get_annotation(nb2, names.LAST_ACTIVITY_ANNOTATION)
+    assert last is not None
+    from kubeflow_tpu.controllers.culling import parse_time
+    assert clock() - parse_time(last) < 10 * 60, \
+        "re-bind inherited a stale idle clock"
+    assert k8s.get_annotation(nb2, names.STOP_ANNOTATION) is None

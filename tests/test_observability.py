@@ -296,3 +296,37 @@ def test_health_server_endpoints():
         assert "notebook_create_total 1" in body
     finally:
         srv.stop()
+
+
+# --------------------------------------------- warm slice pool metric families
+
+def test_slice_pool_metric_families_exported():
+    """The pool/migration families land in one exposition with their label
+    shapes: slicepool_size by pool+state (computed at scrape time from the
+    pool StatefulSet population), slicepool_bind_latency_seconds by pool,
+    slicepool_bind_misses_total by reason, and notebook_migrations_total
+    by outcome (registered by the repair controller — the migration path's
+    owner). The end-to-end values are pinned in tests/test_slicepool.py."""
+    from kubeflow_tpu.controllers.slicepool import SlicePoolReconciler
+    from kubeflow_tpu.controllers.slicerepair import SliceRepairReconciler
+
+    store = ClusterStore()
+    metrics = MetricsRegistry()
+    pool = SlicePoolReconciler(store, ControllerConfig(), metrics)
+    repair = SliceRepairReconciler(store, ControllerConfig(), metrics)
+    store.create({
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": {"name": "p-w0", "namespace": "tpu-slice-pools",
+                     "labels": {names.POOL_LABEL: "p"},
+                     "annotations": {names.POOL_STATE_ANNOTATION: "Warm"}},
+        "spec": {"replicas": 1}})
+    pool.bind_latency.observe(0.05, {"pool": "p"})
+    pool.bind_misses.inc({"reason": "PoolContended"})
+    repair.migrations_total.inc({"outcome": "success"})
+    repair.migrations_total.inc({"outcome": "fallback"})
+    text = metrics.expose()
+    assert 'slicepool_size{pool="p",state="Warm"} 1' in text
+    assert 'slicepool_bind_latency_seconds_count{pool="p"} 1' in text
+    assert 'slicepool_bind_misses_total{reason="PoolContended"} 1' in text
+    assert 'notebook_migrations_total{outcome="success"} 1' in text
+    assert 'notebook_migrations_total{outcome="fallback"} 1' in text

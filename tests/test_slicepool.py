@@ -1,0 +1,649 @@
+"""Warm slice pool controller (controllers/slicepool.py): warm-up,
+bind-on-create, fair-share admission, release/scrub on stop, and the
+checkpoint-migration path it closes with the repair controller."""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api import slicepool as pool_api
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.kubelet import (StatefulSetSimulator, kill_node,
+                                          preempt_node)
+from kubeflow_tpu.controllers import (Manager, NotebookReconciler,
+                                      SlicePoolReconciler,
+                                      SliceRepairReconciler)
+from kubeflow_tpu.controllers.slicepool import fair_share_admit
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+NS = "pool-user"
+POOL_NS = "tpu-slice-pools"
+
+
+def fast_config(**overrides) -> ControllerConfig:
+    defaults = dict(pool_poll_s=0.02, pool_bind_grace_s=2.0,
+                    pool_migration_timeout_s=10.0,
+                    slice_repair_poll_s=0.02,
+                    slice_repair_backoff_base_s=0.01,
+                    slice_repair_backoff_max_s=0.05,
+                    slice_repair_timeout_s=5.0)
+    defaults.update(overrides)
+    return ControllerConfig(**defaults)
+
+
+class PoolWorld:
+    """Started manager with core + pool + repair reconcilers and the
+    kubelet sim (node lifecycle on)."""
+
+    def __init__(self, store, config=None, with_pool_controller=True):
+        self.store = store
+        self.config = config or fast_config()
+        self.metrics = MetricsRegistry()
+        self.mgr = Manager(store)
+        NotebookReconciler(store, self.config, self.metrics).setup(self.mgr)
+        SliceRepairReconciler(store, self.config, self.metrics
+                              ).setup(self.mgr)
+        if with_pool_controller:
+            SlicePoolReconciler(store, self.config, self.metrics
+                                ).setup(self.mgr)
+        self.sim = StatefulSetSimulator(store, boot_delay_s=0.0,
+                                        node_grace_s=0.05)
+        self.sim.setup(self.mgr)
+        self.replicas_observed = set()
+        store.watch("StatefulSet", self._observe_sts)
+        self.mgr.start()
+
+    def _observe_sts(self, ev):
+        if ev.type != "DELETED":
+            self.replicas_observed.add(
+                k8s.get_in(ev.obj, "spec", "replicas"))
+
+    def create_pool(self, name="pool-a", accelerator="v5e-16", warm=2,
+                    weights=None):
+        self.store.create(pool_api.new_slice_pool(
+            name, accelerator, warm, weights=weights))
+
+    def create_notebook(self, name="nb", ns=NS, accelerator="v5e-16",
+                        annotations=None):
+        anns = {names.TPU_ACCELERATOR_ANNOTATION: accelerator}
+        anns.update(annotations or {})
+        self.store.create(api.new_notebook(name, ns, annotations=anns))
+
+    def notebook(self, name="nb", ns=NS):
+        return self.store.get(api.KIND, ns, name)
+
+    def annotation(self, key, name="nb", ns=NS):
+        return k8s.get_annotation(self.store.get_or_none(api.KIND, ns, name),
+                                  key)
+
+    def pool_slices(self, state=None):
+        out = []
+        for sts in self.store.list("StatefulSet", POOL_NS):
+            if k8s.get_label(sts, names.POOL_LABEL) is None:
+                continue
+            if state is None or k8s.get_annotation(
+                    sts, names.POOL_STATE_ANNOTATION) == state:
+                out.append(sts)
+        return out
+
+    def slice_ready(self, name="nb", ns=NS):
+        nb = self.store.get_or_none(api.KIND, ns, name)
+        cond = api.get_condition(nb, api.CONDITION_SLICE_READY) if nb else None
+        return bool(cond and cond.get("status") == "True")
+
+    def wait(self, predicate, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.01)
+        return bool(predicate())
+
+    def events(self, reason, ns=NS):
+        return [e for e in self.store.list("Event", ns)
+                if e.get("reason") == reason]
+
+    def stop(self):
+        self.mgr.stop()
+
+
+@pytest.fixture
+def world(store):
+    w = PoolWorld(store)
+    yield w
+    w.stop()
+
+
+# -------------------------------------------------------------- fair share
+
+def test_fair_share_weighted_max_min_and_fifo():
+    def nb(ns, name):
+        return api.new_notebook(name, ns)
+    pending = [nb("a", "a1"), nb("a", "a2"), nb("a", "a3"), nb("a", "a4"),
+               nb("b", "b1"), nb("b", "b2")]
+    admitted, rejected = fair_share_admit(pending, {"a": 3, "b": 1}, 4)
+    got = [(k8s.namespace(n), k8s.name(n)) for n in admitted]
+    # weight 3:1 over 4 grants → a gets 3, b gets 1; FIFO inside each ns
+    assert got == [("a", "a1"), ("a", "a2"), ("a", "a3"), ("b", "b1")]
+    assert [(k8s.namespace(n), k8s.name(n)) for n in rejected] == \
+        [("a", "a4"), ("b", "b2")]
+
+
+def test_fair_share_equal_weights_round_robin():
+    def nb(ns, name):
+        return api.new_notebook(name, ns)
+    pending = [nb("a", "a1"), nb("a", "a2"), nb("b", "b1"), nb("b", "b2")]
+    admitted, _ = fair_share_admit(pending, {}, 2)
+    assert [(k8s.namespace(n), k8s.name(n)) for n in admitted] == \
+        [("a", "a1"), ("b", "b1")]
+
+
+# ----------------------------------------------------------------- warm-up
+
+def test_pool_warms_to_target_with_slice_identity(world):
+    world.create_pool(warm=2)
+    assert world.wait(lambda: len(world.pool_slices("Warm")) == 2), \
+        "pool never warmed to target"
+    for sts in world.pool_slices("Warm"):
+        name = k8s.name(sts)
+        assert k8s.get_in(sts, "spec", "selector", "matchLabels") == \
+            {"statefulset": name}
+        assert k8s.get_in(sts, "spec", "replicas") == 4
+        env = {e["name"]: e.get("value") for e in k8s.get_in(
+            sts, "spec", "template", "spec", "containers")[0]["env"]
+            if "value" in e}
+        assert env["TPU_WORKER_HOSTNAMES"].startswith(f"{name}-0.{name}.")
+        assert env["TPU_ACCELERATOR_TYPE"] == "v5e-16"
+        # the headless Service for worker DNS exists alongside
+        assert world.store.get_or_none("Service", POOL_NS, name) is not None
+    # pool status mirrors the inventory
+    assert world.wait(lambda: k8s.get_in(
+        world.store.get(pool_api.KIND, "", "pool-a"), "status", "warm") == 2)
+
+
+# ------------------------------------------------------------------- bind
+
+def test_bind_on_create_skips_cold_roll(world):
+    world.create_pool(warm=1)
+    assert world.wait(lambda: world.pool_slices("Warm"))
+    world.create_notebook()
+    assert world.wait(lambda: world.slice_ready()), "bind never went Ready"
+    nb = world.notebook()
+    bound = pool_api.bound_slice_ref(nb)
+    assert bound is not None and bound[0] == POOL_NS
+    # NO owned StatefulSet: releasing must hand the slice back intact
+    assert world.store.list("StatefulSet", NS) == []
+    # identity stamped from the slice's own hostnames
+    identity = k8s.get_annotation(nb, names.SLICE_IDENTITY_ANNOTATION)
+    assert identity and identity.split(",")[0].startswith(f"{bound[1]}-0.")
+    # PoolBound condition mirrored; Service repointed cross-namespace
+    cond = api.get_condition(nb, api.CONDITION_POOL_BOUND)
+    assert cond and cond["status"] == "True"
+    svc = world.store.get("Service", NS, "nb")
+    assert svc["spec"]["type"] == "ExternalName"
+    assert svc["spec"]["externalName"].startswith(f"{bound[1]}.{POOL_NS}.")
+    # SliceBound event + bind latency observed
+    assert world.wait(lambda: world.events("SliceBound"))
+    assert world.metrics.histogram(
+        "slicepool_bind_latency_seconds", "").total_count() >= 1
+    # bound pods carry the watch-routing labels
+    pods = pool_api.bound_slice_pods(world.store, bound)
+    assert world.wait(lambda: all(
+        k8s.get_label(p, names.NOTEBOOK_NAME_LABEL) == "nb" and
+        k8s.get_label(p, names.BOUND_NAMESPACE_LABEL) == NS
+        for p in pool_api.bound_slice_pods(world.store, bound)))
+    assert len(pods) == 4
+
+
+def test_no_matching_pool_cold_rolls_immediately(world):
+    world.create_pool(warm=1, accelerator="v5e-16")
+    world.create_notebook(name="cpu-free", accelerator="v5e-8")
+    assert world.wait(lambda: world.slice_ready("cpu-free"))
+    # cold path: own StatefulSet, no bind, no miss (no pool ever matched)
+    assert world.store.get_or_none("StatefulSet", NS, "cpu-free") is not None
+    assert world.annotation(names.POOL_BIND_MISS_ANNOTATION,
+                            "cpu-free") is None
+
+
+def test_slow_warming_pool_does_not_bind_timeout(store):
+    """A slice warming SLOWER than the core's bind grace must not cost
+    the notebook its warm bind: the pool controller's admission heartbeat
+    suspends the grace timeout — the timeout detects a dead pool
+    controller, it must not race legitimate provisioning time."""
+    w = PoolWorld.__new__(PoolWorld)
+    w.store = store
+    w.config = fast_config(pool_bind_grace_s=0.3)
+    w.metrics = MetricsRegistry()
+    w.mgr = Manager(store)
+    NotebookReconciler(store, w.config, w.metrics).setup(w.mgr)
+    SliceRepairReconciler(store, w.config, w.metrics).setup(w.mgr)
+    SlicePoolReconciler(store, w.config, w.metrics).setup(w.mgr)
+    w.sim = StatefulSetSimulator(store, boot_delay_s=1.0,  # >> grace
+                                 node_grace_s=0.05)
+    w.sim.setup(w.mgr)
+    w.mgr.start()
+    try:
+        # pool and notebook land together: nothing is Warm inside the
+        # grace window, only Warming
+        w.create_pool(warm=1)
+        w.create_notebook()
+        assert w.wait(lambda: w.slice_ready() and pool_api.bound_slice_ref(
+            w.notebook()), 20), "never bound the slow-warming slice"
+        assert w.annotation(names.POOL_BIND_MISS_ANNOTATION) is None, \
+            "grace timed out a notebook the pool had admitted"
+        # heartbeat cleared once bound
+        assert w.annotation(names.POOL_BIND_PENDING_ANNOTATION) is None
+    finally:
+        w.mgr.stop()
+
+
+def test_bind_grace_timeout_cold_rolls_when_pool_controller_down(store):
+    # pool CR exists but NO pool controller runs: the core must not wait
+    # forever — BindTimeout miss, then the normal cold roll
+    w = PoolWorld(store, config=fast_config(pool_bind_grace_s=0.2),
+                  with_pool_controller=False)
+    try:
+        store.create(pool_api.new_slice_pool("pool-a", "v5e-16", 1))
+        w.create_notebook()
+        assert w.wait(lambda: w.slice_ready()), "never cold-rolled"
+        assert w.annotation(names.POOL_BIND_MISS_ANNOTATION) == "BindTimeout"
+        assert store.get_or_none("StatefulSet", NS, "nb") is not None
+    finally:
+        w.stop()
+
+
+# -------------------------------------------------------------- contention
+
+def test_contended_pool_fair_share_losers_cold_roll(world):
+    world.create_pool(warm=2, weights={"ns-a": 1, "ns-b": 1})
+    assert world.wait(lambda: len(world.pool_slices("Warm")) == 2)
+    for i in range(2):
+        world.create_notebook(f"a{i}", ns="ns-a")
+        world.create_notebook(f"b{i}", ns="ns-b")
+
+    def settled():
+        states = []
+        for ns, name in (("ns-a", "a0"), ("ns-a", "a1"),
+                         ("ns-b", "b0"), ("ns-b", "b1")):
+            nb = world.store.get_or_none(api.KIND, ns, name)
+            anns = k8s.annotations(nb) or {}
+            if names.BOUND_SLICE_ANNOTATION in anns:
+                states.append("bound")
+            elif names.POOL_BIND_MISS_ANNOTATION in anns:
+                states.append("miss")
+            else:
+                return None
+        return states
+    assert world.wait(lambda: settled() is not None), "admission never ran"
+    states = settled()
+    assert states.count("bound") == 2 and states.count("miss") == 2
+    # equal weights → one bind per namespace, the FIFO head of each
+    assert states[0] == "bound" and states[2] == "bound"
+    # everyone still reaches SliceReady (losers by cold roll)
+    assert world.wait(lambda: all(
+        world.slice_ready(n, ns) for ns, n in
+        (("ns-a", "a0"), ("ns-a", "a1"), ("ns-b", "b0"), ("ns-b", "b1"))))
+    assert world.metrics.counter(
+        "slicepool_bind_misses_total", "").sum_where(
+        {"reason": "PoolContended"}) == 2
+    assert world.events("PoolBindMiss", "ns-a") or \
+        world.events("PoolBindMiss", "ns-b")
+
+
+# -------------------------------------------------------- release / rebind
+
+def test_stop_releases_slice_back_to_pool_scrubbed(world):
+    world.create_pool(warm=1)
+    assert world.wait(lambda: world.pool_slices("Warm"))
+    world.create_notebook()
+    assert world.wait(lambda: world.slice_ready())
+    bound = pool_api.bound_slice_ref(world.notebook())
+    world.store.patch(api.KIND, NS, "nb", {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: "2026-08-04T00:00:00Z"}}})
+    assert world.wait(lambda: pool_api.bound_slice_ref(
+        world.notebook()) is None), "never unbound"
+    # released, NOT deleted — and scrubbed back to Warm
+    assert world.wait(lambda: k8s.get_annotation(
+        world.store.get_or_none("StatefulSet", *bound) or {},
+        names.POOL_STATE_ANNOTATION) == "Warm"), "never re-warmed"
+    sts = world.store.get("StatefulSet", *bound)
+    assert names.NOTEBOOK_NAME_LABEL not in (k8s.labels(sts) or {})
+    assert k8s.get_annotation(sts, names.POOL_BOUND_TO_ANNOTATION) is None
+    assert world.events("SliceReleased")
+    # the stopped notebook's Service must NOT keep routing into the
+    # released slice (it will be re-bound to other tenants): the core
+    # flips it back to the endpoint-less cold selector shape
+    assert world.wait(lambda: world.store.get(
+        "Service", NS, "nb")["spec"].get("type") != "ExternalName"), \
+        "stale ExternalName kept routing into the released slice"
+    # resume: stripping the stop annotation re-binds a warm slice again
+    world.store.patch(api.KIND, NS, "nb", {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: None}}})
+    assert world.wait(lambda: world.slice_ready() and
+                      pool_api.bound_slice_ref(world.notebook()))
+    assert world.store.list("StatefulSet", NS) == []  # still no cold STS
+
+
+def test_notebook_deletion_releases_slice(world):
+    world.create_pool(warm=1)
+    assert world.wait(lambda: world.pool_slices("Warm"))
+    world.create_notebook()
+    assert world.wait(lambda: world.slice_ready())
+    bound = pool_api.bound_slice_ref(world.notebook())
+    world.store.delete(api.KIND, NS, "nb")
+    assert world.wait(lambda: k8s.get_annotation(
+        world.store.get_or_none("StatefulSet", *bound) or {},
+        names.POOL_STATE_ANNOTATION) == "Warm"), \
+        "slice not released after notebook deletion"
+
+
+def test_half_bind_crash_heals_from_slice_side(world):
+    world.create_pool(warm=1)
+    assert world.wait(lambda: world.pool_slices("Warm")), "never warm"
+    world.create_notebook()
+    assert world.wait(lambda: world.slice_ready())
+    bound = pool_api.bound_slice_ref(world.notebook())
+    # simulate the crash window: the slice knows the notebook, the
+    # notebook lost its annotation (e.g. restored from backup)
+    world.store.patch(api.KIND, NS, "nb", {"metadata": {"annotations": {
+        names.BOUND_SLICE_ANNOTATION: None}}})
+    assert world.wait(lambda: pool_api.bound_slice_ref(
+        world.notebook()) == bound), "bind never healed from the slice side"
+
+
+# -------------------------------------------------------------- migration
+
+def test_preemption_migrates_bound_notebook_with_identity(world):
+    world.create_pool(warm=2)  # capacity 2: one bound + one warm spare
+    assert world.wait(lambda: len(world.pool_slices("Warm")) == 2)
+    world.create_notebook(annotations={names.RUNTIME_STEP_ANNOTATION:
+                                       "4242"})
+    assert world.wait(lambda: world.slice_ready())
+    nb = world.notebook()
+    old_bound = pool_api.bound_slice_ref(nb)
+    identity = k8s.get_annotation(nb, names.SLICE_IDENTITY_ANNOTATION)
+    pod0 = [p for p in pool_api.bound_slice_pods(world.store, old_bound)
+            if k8s.get_label(p, "apps.kubernetes.io/pod-index") == "0"][0]
+    node = pod0["spec"]["nodeName"]
+    preempt_node(world.store, node)
+    kill_node(world.store, node)
+
+    def migrated():
+        nb = world.store.get_or_none(api.KIND, NS, "nb")
+        if nb is None:
+            return False
+        b = pool_api.bound_slice_ref(nb)
+        return (b is not None and b != old_bound and
+                k8s.get_annotation(nb, names.MIGRATION_STATE_ANNOTATION)
+                is None and world.slice_ready())
+    assert world.wait(migrated, 20), "never migrated to the warm spare"
+    nb = world.notebook()
+    # identity preserved end to end: annotation AND the new pods' env
+    assert k8s.get_annotation(nb, names.SLICE_IDENTITY_ANNOTATION) == \
+        identity
+    new_bound = pool_api.bound_slice_ref(nb)
+    for pod in pool_api.bound_slice_pods(world.store, new_bound):
+        env = {e["name"]: e.get("value")
+               for e in pod["spec"]["containers"][0].get("env", [])}
+        assert env.get("TPU_WORKER_HOSTNAMES") == identity
+    # checkpoint step continuity, no quarantine, no cold roll
+    assert k8s.get_annotation(nb, names.RESUMED_STEP_ANNOTATION) == "4242"
+    assert k8s.get_annotation(nb, names.QUARANTINE_ANNOTATION) is None
+    assert k8s.get_annotation(nb, names.POOL_BIND_MISS_ANNOTATION) is None
+    assert world.store.list("StatefulSet", NS) == []
+    assert world.events("NotebookMigrated")
+    assert world.metrics.counter("notebook_migrations_total", "").sum_where(
+        {"outcome": "success"}) == 1
+    # the consumed slice left the Bound state: drained (deleted — doomed
+    # capacity) or, when the sim already replaced the dead node before the
+    # pool looked, scrubbed back toward Warm. Either way the pool holds a
+    # warm spare again — capacity was not bled by the migration.
+    def old_slice_settled():
+        sts = world.store.get_or_none("StatefulSet", *old_bound)
+        if sts is None:
+            return True
+        return k8s.get_annotation(sts, names.POOL_BOUND_TO_ANNOTATION) \
+            is None
+    assert world.wait(old_slice_settled, 20), \
+        "consumed slice never drained/released"
+    assert world.wait(lambda: len(world.pool_slices("Warm")) >= 1, 20), \
+        "pool never re-warmed after the migration"
+    # slice atomicity held throughout: replicas only ever 0 or full
+    assert world.replicas_observed <= {0, 4}
+
+
+def test_failed_migration_falls_back_to_cold_roll(world):
+    world.create_pool(warm=1)
+    assert world.wait(lambda: world.pool_slices("Warm"))
+    world.config.pool_migration_timeout_s = 0.5
+    world.create_notebook()
+    assert world.wait(lambda: world.slice_ready())
+    old_bound = pool_api.bound_slice_ref(world.notebook())
+    # zero the capacity target: the drained slice will NOT be replaced,
+    # so the migration genuinely has nowhere warm to land
+    pool = world.store.get(pool_api.KIND, "", "pool-a")
+    pool["spec"]["warmReplicas"] = 0
+    world.store.update(pool)
+    pod0 = [p for p in pool_api.bound_slice_pods(world.store, old_bound)
+            if k8s.get_label(p, "apps.kubernetes.io/pod-index") == "0"][0]
+    kill_node(world.store, pod0["spec"]["nodeName"])
+
+    def fell_back():
+        nb = world.store.get_or_none(api.KIND, NS, "nb")
+        return (nb is not None and
+                k8s.get_annotation(nb, names.POOL_BIND_MISS_ANNOTATION)
+                is not None and
+                k8s.get_annotation(nb, names.MIGRATION_STATE_ANNOTATION)
+                is None)
+    assert world.wait(fell_back, 20), "migration never fell back"
+    # the notebook is NOT lost: it cold-rolls its own StatefulSet and the
+    # PR-4 repair machinery owns it from here
+    assert world.wait(lambda: world.slice_ready() and
+                      world.store.get_or_none("StatefulSet", NS, "nb")
+                      is not None, 20), "fallback cold roll never converged"
+    assert world.metrics.counter("notebook_migrations_total", "").sum_where(
+        {"outcome": "fallback"}) == 1
+    assert world.events("NotebookMigrationFallback")
+
+
+def test_contention_spills_to_other_matching_pool(world):
+    """Fair-share losers in the first-fit pool must NOT eat a permanent
+    miss while another matching pool has spare capacity: they stay
+    pending and bind warm once first-fit moves past the exhausted pool
+    (the drain-runbook spill)."""
+    world.create_pool("pool-a", warm=1)
+    world.create_pool("pool-b", warm=2)
+    assert world.wait(lambda: len(world.pool_slices("Warm")) == 3)
+    for i in range(3):
+        world.create_notebook(f"s{i}")
+
+    def all_bound():
+        return all(pool_api.bound_slice_ref(
+            world.store.get_or_none(api.KIND, NS, f"s{i}") or {})
+            for i in range(3))
+    assert world.wait(all_bound, 15), \
+        "contention losers never spilled into the second pool"
+    for i in range(3):
+        assert world.annotation(names.POOL_BIND_MISS_ANNOTATION,
+                                f"s{i}") is None
+    pools_used = {world.annotation(names.BOUND_POOL_ANNOTATION, f"s{i}")
+                  for i in range(3)}
+    assert pools_used == {"pool-a", "pool-b"}
+
+
+def test_same_pass_release_is_biddable_capacity(store):
+    """A slice released in the same reconcile pass (tenant stopped) must
+    count as capacity for pending notebooks — a pre-release snapshot of 0
+    Warm slices must not stamp a permanent PoolContended miss for a slice
+    one poll away. Driven as ONE deterministic reconcile pass."""
+    from kubeflow_tpu.controllers.manager import Request
+    pool_api.install_slicepool_crd(store)
+    store.create(pool_api.new_slice_pool("p1", "v5e-16", 1))
+    store.create({
+        "apiVersion": "apps/v1", "kind": "StatefulSet",
+        "metadata": {"name": "p1-w0", "namespace": POOL_NS,
+                     "labels": {names.POOL_LABEL: "p1",
+                                "statefulset": "p1-w0"},
+                     "annotations": {
+                         names.POOL_STATE_ANNOTATION: "Bound",
+                         names.POOL_BOUND_TO_ANNOTATION: f"{NS}/stopped"}},
+        "spec": {"replicas": 4, "selector": {"matchLabels": {
+            "statefulset": "p1-w0"}},
+            "template": {"metadata": {}, "spec": {"containers": [
+                {"name": "warm-slice", "image": "img"}]}}}})
+    store.create(api.new_notebook("stopped", NS, annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16",
+        names.BOUND_SLICE_ANNOTATION: f"{POOL_NS}/p1-w0",
+        names.BOUND_POOL_ANNOTATION: "p1",
+        names.STOP_ANNOTATION: "t"}))
+    store.create(api.new_notebook("waiting", NS, annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}))
+    rec = SlicePoolReconciler(store, fast_config(), MetricsRegistry())
+    rec.reconcile(Request("", "p1"))
+    waiting = store.get(api.KIND, NS, "waiting")
+    assert k8s.get_annotation(waiting,
+                              names.POOL_BIND_MISS_ANNOTATION) is None, \
+        "same-pass release was not counted as biddable capacity"
+
+
+def test_contended_pool_migration_rebind_wins_over_new_create(store):
+    """A migration re-bind holds first claim on a contended pool's warm
+    slice even when fair-share tie-breaking would favor the new create's
+    namespace — the repair controller checkpointed against the promise
+    of warm capacity."""
+    from kubeflow_tpu.controllers.manager import Request
+    pool_api.install_slicepool_crd(store)
+    store.create(pool_api.new_slice_pool("p1", "v5e-16", 1))
+    rec = SlicePoolReconciler(store, fast_config(), MetricsRegistry())
+    rec.reconcile(Request("", "p1"))  # creates the warm slice
+    sts = store.list("StatefulSet", POOL_NS)[0]
+    store.patch("StatefulSet", POOL_NS, k8s.name(sts), {"metadata": {
+        "annotations": {names.POOL_STATE_ANNOTATION: "Warm"}}})
+    # 'alpha' sorts before 'zeta': plain fair share would admit it first
+    store.create(api.new_notebook("fresh", "alpha", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}))
+    store.create(api.new_notebook("moving", "zeta", annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16",
+        names.MIGRATION_STATE_ANNOTATION: "Binding",
+        names.SLICE_IDENTITY_ANNOTATION: "localhost"}))
+    rec._pending_dirty.add("p1")  # what the Notebook-watch mapper would do
+    rec.reconcile(Request("", "p1"))
+    moving = store.get(api.KIND, "zeta", "moving")
+    assert pool_api.bound_slice_ref(moving) is not None, \
+        "migration re-bind lost the contended slice to a new create"
+    fresh = store.get(api.KIND, "alpha", "fresh")
+    assert k8s.get_annotation(fresh,
+                              names.POOL_BIND_MISS_ANNOTATION) is not None
+
+
+def test_runtime_step_never_churns_cold_template(store):
+    """runtime-step updates (every training step on the fallback cold
+    path) must not propagate into the StatefulSet pod template — each
+    update would be spurious drift and roll the whole slice."""
+    rec = NotebookReconciler(store)
+    nb = api.new_notebook("nb", NS, annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16",
+        names.RUNTIME_STEP_ANNOTATION: "500",
+        names.CHECKPOINT_TOKEN_ANNOTATION: "{}"})
+    from kubeflow_tpu.tpu.topology import parse_short_name
+    sts = rec.generate_statefulset(nb, parse_short_name("v5e-16"),
+                                   actual_sts_name="nb")
+    tmpl_anns = k8s.get_in(sts, "spec", "template", "metadata",
+                           "annotations", default={}) or {}
+    assert names.RUNTIME_STEP_ANNOTATION not in tmpl_anns
+    assert names.CHECKPOINT_TOKEN_ANNOTATION not in tmpl_anns
+
+
+def test_migration_window_service_not_routed_into_old_slice(store):
+    """Between unbind and re-bind the notebook's Service must NOT keep
+    the ExternalName route into the old slice (it may already serve
+    another tenant): the core repoints it to the endpoint-less cold
+    selector shape and mirrors PoolBound=False/Migrating."""
+    from kubeflow_tpu.controllers.manager import Request
+    rec = NotebookReconciler(store, fast_config())
+    store.create(api.new_notebook("mignb", NS, annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16",
+        names.MIGRATION_STATE_ANNOTATION: "Binding",
+        names.SLICE_IDENTITY_ANNOTATION: "localhost"}))
+    # stale Service left over from the pre-migration bind
+    store.create({
+        "apiVersion": "v1", "kind": "Service",
+        "metadata": {"name": "mignb", "namespace": NS,
+                     "labels": {names.NOTEBOOK_NAME_LABEL: "mignb"}},
+        "spec": {"type": "ExternalName",
+                 "externalName": f"old-slice.{POOL_NS}.svc.cluster.local",
+                 "ports": []}})
+    rec.reconcile(Request(NS, "mignb"))
+    svc = store.get("Service", NS, "mignb")
+    assert svc["spec"].get("type") != "ExternalName"
+    assert svc["spec"].get("selector") == {"statefulset": "mignb"}
+    nb = store.get(api.KIND, NS, "mignb")
+    cond = api.get_condition(nb, api.CONDITION_POOL_BOUND)
+    assert cond and cond["status"] == "False" and \
+        cond["reason"] == "Migrating"
+    # the gate still holds the cold roll: no owned StatefulSet appeared
+    assert store.list("StatefulSet", NS) == []
+
+
+# ------------------------------------------------------------- validation
+
+def test_slicepool_admission_rejects_bad_specs(store):
+    from kubeflow_tpu.cluster.errors import InvalidError
+    pool_api.install_slicepool_crd(store)
+    store.create(pool_api.new_slice_pool("ok", "v5e-16", 2))
+    with pytest.raises(InvalidError):
+        store.create(pool_api.new_slice_pool("bad-acc", "v9z-999", 1))
+    with pytest.raises(InvalidError):
+        store.create(pool_api.new_slice_pool("bad-warm", "v5e-16", -1))
+    with pytest.raises(InvalidError):
+        store.create(pool_api.new_slice_pool("bad-weights", "v5e-16", 1,
+                                             weights={"ns": 0}))
+
+
+def test_pool_deletion_reaps_unbound_slices(world):
+    world.create_pool(warm=2)
+    assert world.wait(lambda: len(world.pool_slices("Warm")) == 2)
+    world.store.delete(pool_api.KIND, "", "pool-a")
+    assert world.wait(lambda: not world.pool_slices(), 10), \
+        "unbound warm slices not reaped with their pool"
+
+
+def test_pool_deletion_with_bound_slice_reaps_on_release(world):
+    """Deleting a pool while a notebook is bound must keep serving it —
+    and once the notebook stops, the orphaned slice is DELETED (there is
+    no pool to re-warm into), never leaked."""
+    world.create_pool(warm=1)
+    assert world.wait(lambda: world.pool_slices("Warm"))
+    world.create_notebook()
+    assert world.wait(lambda: world.slice_ready())
+    bound = pool_api.bound_slice_ref(world.notebook())
+    world.store.delete(pool_api.KIND, "", "pool-a")
+    time.sleep(0.2)  # teardown pass runs; the bound slice must survive it
+    assert world.store.get_or_none("StatefulSet", *bound) is not None, \
+        "pool deletion killed a slice still serving a notebook"
+    assert world.slice_ready()
+    world.store.patch(api.KIND, NS, "nb", {"metadata": {"annotations": {
+        names.STOP_ANNOTATION: "2026-08-04T00:00:00Z"}}})
+    assert world.wait(lambda: world.store.get_or_none(
+        "StatefulSet", *bound) is None, 15), \
+        "orphaned slice leaked after its notebook stopped"
+    assert world.wait(lambda: pool_api.bound_slice_ref(
+        world.notebook()) is None), "stopped notebook left annotated bound"
+
+
+def test_raised_target_creates_replacements_despite_bound_slices(world):
+    """warmReplicas is capacity: with 1 bound slice and the target raised
+    to 3, the pool must create 2 MORE slices (the bound one counts once,
+    not twice)."""
+    world.create_pool(warm=1)
+    assert world.wait(lambda: world.pool_slices("Warm"))
+    world.create_notebook()
+    assert world.wait(lambda: world.slice_ready())
+    pool = world.store.get(pool_api.KIND, "", "pool-a")
+    pool["spec"]["warmReplicas"] = 3
+    world.store.update(pool)
+    assert world.wait(lambda: len(world.pool_slices()) == 3 and
+                      len(world.pool_slices("Warm")) == 2, 15), \
+        "raised target did not rebuild to capacity"

@@ -212,10 +212,11 @@ def test_read_cache_shares_manager_watch_streams(http_stack):
     rec = NotebookReconciler(client)
     mgr = Manager(client)
     rec.setup(mgr)
-    # one stream per watched kind: Notebook, STS, Service, Pod, Event —
-    # no duplicates from the cache
+    # one stream per watched kind: Notebook, STS, Service, Pod, Event,
+    # SlicePool (the warm-pool bind gate's cached reads) — no duplicates
+    # from the cache
     assert sorted(opened) == sorted(
-        [api.KIND, "StatefulSet", "Service", "Pod", "Event"])
+        [api.KIND, "StatefulSet", "Service", "Pod", "Event", "SlicePool"])
     assert rec._read_cache.auto_informer is False
 
 
