@@ -8,7 +8,8 @@ TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
         test-transport gate lint manifests \
         manifests-check check-license bench numerics ctx-sweep mfu-ab capture \
         spec-acceptance prefix-cache-ab chunked-prefill-ab dryrun loadtest \
-        loadtest-faults loadtest-preempt run run-split
+        loadtest-faults loadtest-preempt loadtest-sharded loadtest-soak \
+        run run-split
 
 help: ## Display this help.
 	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_-]+:.*?##/ {printf "  %-16s %s\n", $$1, $$2}' $(MAKEFILE_LIST)
@@ -36,6 +37,12 @@ loadtest-faults: ## 200-notebook wire fan-out at a 10% injected fault rate.
 
 loadtest-preempt: ## 50 v5e-16 slices, 20% of worker-0 nodes preempted mid-fan-out.
 	$(TEST_ENV) $(PYTHON) loadtest/start_notebooks.py --wire --count 50 --accelerator v5e-16 --preempt-rate 0.20
+
+loadtest-sharded: ## 200-notebook wire fan-out across 2 sharded managers (4 shards).
+	$(TEST_ENV) $(PYTHON) loadtest/start_notebooks.py --count 200 --managers 2 --shards 4 --namespace-count 8
+
+loadtest-soak: ## 100k-notebook sharded soak, in-process, event-driven kubelet ticks.
+	$(TEST_ENV) $(PYTHON) loadtest/start_notebooks.py --soak --count 100000 --managers 2 --shards 32 --namespace-count 256 --accelerator v5e-1
 
 test-transport: ## Real-HTTP transport + multi-process HA tier.
 	$(TEST_ENV) $(PYTHON) -m pytest tests/test_http_transport.py tests/test_http_stack.py tests/test_cli.py tests/test_multihost.py -q

@@ -21,6 +21,7 @@ objects and admission denials as 4xx Status responses.
 
 from __future__ import annotations
 
+import bisect
 import itertools
 import json
 import logging
@@ -35,9 +36,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..utils import k8s, names
+from . import apf as apf_mod
 from . import faults, restmapper
 from .errors import ApiError, ConflictError, GoneError, NotFoundError
-from .store import EventFrame, WatchEvent
+from .store import EventFrame, WatchEvent, _decode_continue, _encode_continue
 
 log = logging.getLogger("kubeflow_tpu.apiserver")
 
@@ -164,6 +166,161 @@ class _WatcherQueue:
             if self._by_key.get(cell[2]) is cell:
                 del self._by_key[cell[2]]
             return cell[0], cell[1]
+
+
+#: how long an rv-gated read waits for the serve cache to catch up to the
+#: requested resourceVersion before falling back to the store path (with a
+#: single in-process store the cache is fed synchronously and never waits;
+#: the gate exists for conformance with kube's wait-until-fresh reads)
+SERVE_CACHE_FRESH_WAIT_S = 2.0
+
+
+class _KindServeCache:
+    """Server-side watch cache for one kind: the consistent-read-from-cache
+    store kube-apiserver serves ``LIST ?resourceVersion=0`` (and rv-gated
+    GETs) from, so resyncs and scrapes never touch the store's write-path
+    lock.
+
+    Fed through the store's frame relay — registered ATOMICALLY with a
+    deepcopied snapshot (``snapshot_with_frames``), and every subsequent
+    event applies under the store lock's rv ordering — so the cache is
+    never stale relative to the store: a write's frame lands here before
+    the write's lock is released. Reads therefore serve FRAME OBJECTS by
+    reference (the serialize-once immutability contract) with no deepcopy
+    and no store lock: the cost of a cache-served LIST is pure JSON
+    encoding, and N managers' resyncs stop stampeding the write path.
+
+    ``wait_for_rv`` is kube's wait-until-fresh gate for
+    ``resourceVersion=N`` reads: block (bounded) until the cache has seen
+    rv ≥ N. With the in-process store it returns immediately; a timeout
+    falls back to the authoritative store path rather than erroring."""
+
+    __slots__ = ("kind", "_cv", "objects", "rv", "_sorted", "_gen",
+                 "_ready", "_pending")
+
+    def __init__(self, store, kind: str) -> None:
+        self.kind = kind
+        self._cv = threading.Condition()
+        self.objects: dict[tuple[str, str], dict] = {}
+        self.rv = 0
+        self._sorted: list | None = None
+        self._gen = 0  # membership generation; bumps invalidate _sorted
+        self._ready = False
+        self._pending: list[EventFrame] = []
+        snapshot, anchor = store.snapshot_with_frames(kind, self._on_frame)
+        with self._cv:
+            for obj in snapshot:
+                self._apply_locked(obj, self._obj_rv(obj), deleted=False)
+            # frames that raced the snapshot application queue in _pending;
+            # all carry rv > anchor ≥ any snapshot rv, so applying them
+            # after the snapshot preserves rv order exactly
+            for frame in self._pending:
+                self._apply_locked(frame.obj, frame.rv,
+                                   deleted=frame.type == "DELETED")
+            self._pending = []
+            if anchor > self.rv:
+                self.rv = anchor
+            self._ready = True
+            self._cv.notify_all()
+
+    @staticmethod
+    def _obj_rv(obj: dict) -> int:
+        try:
+            return int(k8s.get_in(obj, "metadata", "resourceVersion") or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def _on_frame(self, frame: EventFrame) -> None:
+        # called under the STORE lock: pure dict work under our own lock,
+        # never re-enters the store (the frame-relay contract)
+        with self._cv:
+            if not self._ready:
+                self._pending.append(frame)
+                return
+            self._apply_locked(frame.obj, frame.rv,
+                               deleted=frame.type == "DELETED")
+            self._cv.notify_all()
+
+    def _apply_locked(self, obj: dict, rv: int, deleted: bool) -> None:
+        key = (k8s.namespace(obj), k8s.name(obj))
+        if deleted:
+            if self.objects.pop(key, None) is not None:
+                self._sorted = None  # membership changed; re-sort lazily
+                self._gen += 1
+        else:
+            cur = self.objects.get(key)
+            if cur is None or self._obj_rv(cur) <= rv:
+                if cur is None:
+                    self._sorted = None
+                    self._gen += 1
+                self.objects[key] = obj
+        if rv > self.rv:
+            self.rv = rv
+
+    def wait_for_rv(self, min_rv: int,
+                    timeout: float = SERVE_CACHE_FRESH_WAIT_S) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self.rv < min_rv:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cv.wait(remaining)
+            return True
+
+    def get(self, namespace: str, name: str) -> dict | None:
+        with self._cv:
+            return self.objects.get((namespace, name))
+
+    def list_page(self, namespace: str | None, selector,
+                  limit: int | None = None,
+                  continue_token: str | None = None,
+                  ) -> tuple[list[dict], str | None, str]:
+        """Same chunking semantics (and continue-token encoding) as
+        ClusterStore.list_page, served lock-free from the cache: keys in
+        deterministic (namespace, name) order, objects handed out by
+        reference (immutable frames — the HTTP layer encodes them
+        straight to bytes, no deepcopy)."""
+        start_after = (_decode_continue(continue_token)
+                       if continue_token else None)
+        if limit is not None and limit <= 0:
+            limit = None
+        # sort OUTSIDE the cv: _on_frame runs under the STORE lock and
+        # needs this cv — an O(n log n) fleet-key sort held inside it
+        # would stall every store write behind a cache LIST during
+        # churn. The lock covers only the O(n) key snapshot; the sorted
+        # list is published back iff no membership change raced it
+        # (stale pairs are fine either way: the chunked-LIST contract
+        # already tolerates objects created/deleted mid-walk).
+        with self._cv:
+            pairs = self._sorted
+            list_rv = str(self.rv)
+        if pairs is None:
+            with self._cv:
+                keys = list(self.objects)
+                gen = self._gen
+            keys.sort()
+            pairs = keys
+            with self._cv:
+                if self._gen == gen:
+                    self._sorted = pairs
+        start = (bisect.bisect_right(pairs, start_after)
+                 if start_after is not None else 0)
+        out: list[dict] = []
+        last_pair: tuple[str, str] | None = None
+        next_token: str | None = None
+        for pair in pairs[start:]:
+            obj = self.objects.get(pair)  # may have raced a delete: skip
+            if obj is None \
+                    or (namespace is not None and pair[0] != namespace) \
+                    or not k8s.matches_labels(obj, selector):
+                continue
+            if limit is not None and len(out) >= limit:
+                next_token = _encode_continue(*last_pair)
+                break
+            out.append(obj)
+            last_pair = pair
+        return out, next_token, list_rv
 
 
 def _parse_label_selector(raw: str | None) -> dict[str, str | None] | None:
@@ -385,8 +542,10 @@ class _Handler(BaseHTTPRequestHandler):
         # first (_audit_now before the body bytes, so a client's next
         # request can't overtake its own trail), the finally is the
         # catch-all for paths that never send a full response
+        parsed = urlparse(self.path)
+        qs = parse_qs(parsed.query)  # parsed ONCE for the whole request
         self._audit_method = method
-        self._audit_path = urlparse(self.path).path
+        self._audit_path = parsed.path
         self._audit_name = None
         self._audited = False
         latency = getattr(self.server, "latency_s", 0.0)
@@ -397,12 +556,11 @@ class _Handler(BaseHTTPRequestHandler):
             # their in-flight requests like they would over a real wire.
             # Watch streams are exempt below (the stream is long-lived;
             # per-frame latency is not request latency).
-            if "watch" not in parse_qs(urlparse(self.path).query):
+            if "watch" not in qs:
                 time.sleep(latency)
         if not self._authorized():
             self._send_error_status(401, "Unauthorized", "invalid bearer token")
             return
-        parsed = urlparse(self.path)
         if parsed.path in ("/healthz", "/readyz", "/livez"):
             # health endpoints are NOT exempt from wire faults (matched as
             # GET with no kind): a partitioned or dead apiserver cannot
@@ -445,12 +603,41 @@ class _Handler(BaseHTTPRequestHandler):
         self._audit_name = route.name  # POST overwrites with the created name
         self._watch_kill_after = None
         reset_rule = None
+        is_watch = method == "GET" and \
+            qs.get("watch", ["false"])[-1] in ("true", "1")
+        verb = _wire_verb(method, route, is_watch)
+        # ---------------------------------------- priority & fairness (APF)
+        # classify → seat or queue BEFORE any handler work, as the real
+        # apiserver's flow control does. Watch streams are exempt (a seat
+        # held for a stream's lifetime would permanently leak concurrency;
+        # their cost is bounded by the fan-out layer instead), health
+        # endpoints returned above. Rejections surface as 429+Retry-After,
+        # the standard flow-control path every client verb retries.
+        dispatcher = getattr(self.server, "apf", None)
+        apf_ticket = None
+        if dispatcher is not None and not is_watch:
+            try:
+                apf_ticket = dispatcher.acquire(
+                    {"user_agent": self.headers.get("User-Agent", ""),
+                     "verb": verb, "kind": route.mapping.kind})
+            except apf_mod.RejectedError as err:
+                self._send_error_status(429, "TooManyRequests", str(err),
+                                        retry_after_s=err.retry_after_s)
+                return
+        try:
+            self._dispatch_admitted(method, route, parsed, qs, verb,
+                                    is_watch, reset_rule)
+        finally:
+            if apf_ticket is not None:
+                dispatcher.release(apf_ticket)
+
+    def _dispatch_admitted(self, method: str, route: _Route, parsed,
+                           qs: dict, verb: str, is_watch: bool,
+                           reset_rule) -> None:
+        """The post-APF remainder of _dispatch: fault injection, routing
+        guards, and the verb handler (the caller holds the APF seat)."""
         plan = getattr(self.server, "fault_plan", None)
         if plan is not None:
-            is_watch = method == "GET" and \
-                parse_qs(parsed.query).get("watch", ["false"])[-1] in \
-                ("true", "1")
-            verb = _wire_verb(method, route, is_watch)
             rule = plan.decide(verb, route.mapping.kind)
             if rule is not None:
                 if rule.fault == faults.FAULT_LATENCY:
@@ -485,7 +672,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_status(405, "MethodNotAllowed",
                                     "the service proxy forwards GET only")
             return
-        query = {key: vals[-1] for key, vals in parse_qs(parsed.query).items()}
+        query = {key: vals[-1] for key, vals in qs.items()}
         # the proxy subresource forwards the RAW query string verbatim
         # (parse_qs collapses duplicate keys — fine for list options,
         # wrong for a passthrough)
@@ -659,12 +846,43 @@ class _Handler(BaseHTTPRequestHandler):
                 f"proxy to {name} failed: {err}")
 
     # ---------------------------------------------------------------- verbs
+    def _serve_cache_for(self, kind: str, rv_raw: str | None):
+        """The kind's server-side watch cache when the request is rv-gated
+        ('any state at least this fresh is acceptable') and the backing
+        store supports the frame-relay handshake; None → store path.
+        A positive rv waits until the cache is at least that fresh
+        (kube's consistent-read-from-cache); a wait timeout falls back to
+        the authoritative store rather than erroring."""
+        if rv_raw is None or not rv_raw.isdigit():
+            return None  # no rv (quorum-read semantics) → store path
+        factory = getattr(self.server, "serve_cache", None)
+        if factory is None:
+            return None
+        cache = factory(kind)
+        if cache is None:
+            return None
+        min_rv = int(rv_raw)
+        if min_rv > 0 and not cache.wait_for_rv(min_rv):
+            return None
+        return cache
+
     def _handle_GET(self, route: _Route, query: dict) -> None:
         kind = route.mapping.kind
         if route.subresource == "proxy":
             self._handle_service_proxy(route)
             return
         if route.name:
+            cache = self._serve_cache_for(kind, query.get("resourceVersion"))
+            if cache is not None:
+                # rv-gated GET: served lock-free from the watch cache —
+                # the cache is complete from birth, so a miss is an
+                # authoritative NotFound, exactly like the store's
+                obj = cache.get(route.namespace or "", route.name)
+                if obj is None:
+                    raise NotFoundError(
+                        f"{kind} {route.namespace or ''}/{route.name}")
+                self._send_json(200, obj)
+                return
             obj = self.store.get(kind, route.namespace or "", route.name)
             self._send_json(200, obj)
             return
@@ -680,9 +898,19 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error_status(400, "BadRequest",
                                     f"invalid limit {query['limit']!r}")
             return
-        pager = getattr(self.store, "list_page", None)
-        if pager is not None:
-            items, next_cont, list_rv = pager(
+        cache = self._serve_cache_for(kind, query.get("resourceVersion"))
+        if cache is not None:
+            # consistent read from the watch cache: rv=0 (and satisfied
+            # rv≥N gates) never touch the store's write-path lock — the
+            # path N managers' resyncs and the metrics scrapes ride
+            items, next_cont, list_rv = cache.list_page(
+                route.namespace, selector, limit=limit,
+                continue_token=query.get("continue"))
+            metric = getattr(self.server, "cache_list_metric", None)
+            if metric is not None:
+                metric.inc({"kind": kind})
+        elif getattr(self.store, "list_page", None) is not None:
+            items, next_cont, list_rv = self.store.list_page(
                 kind, route.namespace, selector, limit=limit,
                 continue_token=query.get("continue"),
                 resource_version=query.get("resourceVersion"))
@@ -920,13 +1148,29 @@ class ApiServerProxy:
                  keyfile: str | None = None,
                  audit_log: str | None = None,
                  latency_s: float = 0.0,
-                 fault_plan: "faults.FaultPlan | None" = None) -> None:
+                 fault_plan: "faults.FaultPlan | None" = None,
+                 apf: "apf_mod.APFDispatcher | bool | None" = None) -> None:
         self.store = store
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         self._httpd.store = store  # type: ignore[attr-defined]
         self._httpd.token = token  # type: ignore[attr-defined]
         self._httpd.shutting_down = False  # type: ignore[attr-defined]
+        # priority & fairness (cluster/apf.py): on by default with the
+        # generous default seat count — it only engages under genuine
+        # overload. Pass apf=False to disable, or a configured dispatcher.
+        if apf is None:
+            apf = apf_mod.APFDispatcher()
+        self.apf = apf or None
+        self._httpd.apf = self.apf  # type: ignore[attr-defined]
+        # server-side watch caches (consistent-read-from-cache): created
+        # lazily per kind on the first rv-gated read; requires the
+        # frame-relay handshake on the backing store
+        self._serve_caches: dict[str, _KindServeCache] = {}
+        self._serve_caches_lock = threading.Lock()
+        if hasattr(store, "snapshot_with_frames"):
+            self._httpd.serve_cache = self._serve_cache  # type: ignore[attr-defined]
+        self._httpd.cache_list_metric = None  # type: ignore[attr-defined]
         # programmable wire-fault seam (cluster/faults.py): per-verb/kind
         # 429/5xx/reset/watch-kill/latency — the chaos runner and soaks
         # flip this live via set_fault_plan()
@@ -961,15 +1205,36 @@ class ApiServerProxy:
             self.scheme = "https"
         self._thread: threading.Thread | None = None
 
+    def _serve_cache(self, kind: str) -> "_KindServeCache | None":
+        """Get-or-create the kind's server-side watch cache (the
+        consistent-read store for rv-gated reads)."""
+        cache = self._serve_caches.get(kind)
+        if cache is not None:
+            return cache
+        with self._serve_caches_lock:
+            cache = self._serve_caches.get(kind)
+            if cache is None:
+                cache = self._serve_caches[kind] = \
+                    _KindServeCache(self.store, kind)
+            return cache
+
     def attach_metrics(self, registry) -> None:
-        """Register the server-side watch fan-out counter and pass the
-        registry down to the backing store (watch-cache evictions) — the
-        loadtest attaches its controller registry here so the whole watch
-        path is measured in one exposition."""
+        """Register the server-side watch fan-out counter, the APF flow
+        control family, the cache-served LIST counter, and pass the
+        registry down to the backing store (watch-cache evictions + LIST
+        lock-hold) — the loadtest attaches its controller registry here so
+        the whole watch/read path is measured in one exposition."""
         self._httpd.watch_coalesced_metric = registry.counter(  # type: ignore[attr-defined]
             "watch_queue_coalesced_total",
             "MODIFIED watch frames coalesced per key in a backpressured "
             "per-watcher queue (latest state wins), by kind.")
+        self._httpd.cache_list_metric = registry.counter(  # type: ignore[attr-defined]
+            "apiserver_cache_lists_total",
+            "LISTs served lock-free from the server-side watch cache "
+            "(rv-gated consistent reads), by kind — the store-lock "
+            "traffic the consistent-read path removed.")
+        if self.apf is not None:
+            self.apf.attach_metrics(registry)
         if hasattr(self.store, "attach_metrics"):
             self.store.attach_metrics(registry)
 

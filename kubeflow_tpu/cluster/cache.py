@@ -336,6 +336,22 @@ class CachingClient:
             obj = t(obj)
         return obj
 
+    def _live_list(self, kind: str, namespace: str | None = None,
+                   label_selector: dict | None = None) -> list[dict]:
+        """A LIST that must leave this cache (gap/unfed/payload
+        fallbacks, backfills, resyncs): prefer the backing client's
+        rv-gated ``list_cached`` — over the wire that's the
+        consistent-read-from-cache form served lock-free from the
+        apiserver's watch cache (never stale: the facade's cache is fed
+        synchronously under the store lock), so N managers' fallback
+        LISTs can't stampede the store's write path. A backing store
+        without the method (bare ClusterStore behind another wrapper)
+        keeps the plain LIST."""
+        lister = getattr(self.store, "list_cached", None)
+        if lister is not None:
+            return lister(kind, namespace, label_selector)
+        return self.store.list(kind, namespace, label_selector)
+
     def _ensure_informer(self, kind: str) -> None:
         if not self.auto_informer:
             return  # externally fed: owner registers watches + backfills
@@ -350,7 +366,7 @@ class CachingClient:
         # copy is never overwritten by the older snapshot) and (b) the
         # tombstone set (a DELETED racing the snapshot is not resurrected).
         self.store.watch(kind, self._on_event)
-        for obj in self.store.list(kind):
+        for obj in self._live_list(kind):
             self._ingest(obj)
         with self._lock:
             self._warm.add(kind)
@@ -398,7 +414,7 @@ class CachingClient:
         with self._lock:
             if kind in self._warm:
                 return
-        for obj in self.store.list(kind):
+        for obj in self._live_list(kind):
             self._ingest(obj)
         with self._lock:
             self._watched.add(kind)
@@ -584,7 +600,7 @@ class CachingClient:
             # kind nobody backfilled must go live, not return an empty
             # cache; a watch gap likewise bypasses the (possibly stale)
             # index until the reconnect resync converges it
-            return self.store.list(kind, namespace, label_selector)
+            return self._live_list(kind, namespace, label_selector)
         self._ensure_informer(kind)
         # index lookup under the lock is O(result); the label predicate and
         # the deepcopying run OUTSIDE it — object dicts are replaced (never
@@ -600,6 +616,16 @@ class CachingClient:
                    and k8s.matches_labels(o, label_selector)]
         return [k8s.deepcopy(o) for o in matched]
 
+    def list_cached(self, kind: str, namespace: str | None = None,
+                    label_selector: dict | None = None,
+                    min_resource_version: int | None = None) -> list[dict]:
+        """Interface parity with HttpApiClient.list_cached: this cache's
+        index IS the consistent-read store (watch-fed, rv-guarded), and
+        every fallback inside list() already rides the backing client's
+        rv=0 form — so the resync path can ask any client for a
+        cache-acceptable LIST without caring about the wrapper chain."""
+        return self.list(kind, namespace, label_selector)
+
     def list_by_field(self, kind: str, path: str, value: str,
                       namespace: str | None = None) -> list[dict]:
         """Objects of ``kind`` whose field ``path`` (dot-path, e.g.
@@ -613,7 +639,7 @@ class CachingClient:
             unfed = kind not in self._watched
         if kind in self.disable_for or (unfed and not self.auto_informer) \
                 or self._is_gapped(kind):
-            return [o for o in self.store.list(kind, namespace)
+            return [o for o in self._live_list(kind, namespace)
                     if k8s.get_in(o, *parts) == value]
         self._ensure_informer(kind)
         with self._lock:
@@ -646,7 +672,7 @@ class CachingClient:
             unfed = kind not in self._watched
         if kind in self.disable_for or (unfed and not self.auto_informer) \
                 or self._is_gapped(kind):
-            return [o for o in self.store.list(kind, owner_ns)
+            return [o for o in self._live_list(kind, owner_ns)
                     if k8s.is_owned_by(o, owner_uid)]
         self._ensure_informer(kind)
         with self._lock:

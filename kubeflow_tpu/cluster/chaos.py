@@ -128,6 +128,18 @@ class ChaosClient:
         self._maybe_fail("list")
         return self._store.list(kind, namespace, label_selector)
 
+    def list_cached(self, kind, namespace=None, label_selector=None,
+                    min_resource_version=None):
+        # the rv=0 consistent-read LIST (resync/backfill path) is still a
+        # LIST on the wire — it must take list faults, not slip through
+        # the __getattr__ passthrough uninjected
+        self._maybe_fail("list")
+        fn = getattr(self._store, "list_cached", None)
+        if fn is None:
+            return self._store.list(kind, namespace, label_selector)
+        return fn(kind, namespace, label_selector,
+                  min_resource_version=min_resource_version)
+
     def update(self, obj):
         self._maybe_fail("update")
         return self._store.update(obj)

@@ -203,11 +203,17 @@ class HttpApiClient:
                  client_key: str | None = None, verify: bool = True,
                  timeout: float = 30.0, metrics=None,
                  retry_policy: RetryPolicy | None = None,
-                 list_page_size: int | None = None) -> None:
+                 list_page_size: int | None = None,
+                 user_agent: str = "kubeflow-tpu-manager") -> None:
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
         self.retry_policy = retry_policy or RetryPolicy()
+        # flow identity for the apiserver's priority & fairness layer
+        # (cluster/apf.py classifies on the User-Agent header): manager
+        # replicas keep the kubeflow-tpu prefix; tenant tooling should
+        # set its own so a LIST storm lands in its own flow's queues
+        self.user_agent = user_agent
         # LIST chunking (?limit=N&continue=…): bounds the memory and tail
         # latency of a fleet-sized LIST — the backfills and post-outage
         # resyncs page through instead of one giant body. None = unpaged.
@@ -377,7 +383,8 @@ class HttpApiClient:
         must be fully read before the thread's next request (every caller
         does), or the next checkout recycles the connection."""
         data = json.dumps(body).encode() if body is not None else None
-        headers = {"Accept": "application/json"}
+        headers = {"Accept": "application/json",
+                   "User-Agent": self.user_agent}
         if data is not None:
             headers["Content-Type"] = content_type
         if self.token:
@@ -640,8 +647,15 @@ class HttpApiClient:
         return path
 
     # ---------------------------------------------------------------- verbs
-    def get(self, kind: str, namespace: str, name: str) -> dict:
-        return self._json("GET", self._path(kind, namespace, name))
+    def get(self, kind: str, namespace: str, name: str,
+            resource_version: str | None = None) -> dict:
+        """``resource_version="0"`` (or a minimum rv) is the rv-gated form
+        the apiserver serves lock-free from its watch cache — 'any state
+        at least this fresh is acceptable'; omit for a quorum read."""
+        query = {"resourceVersion": resource_version} \
+            if resource_version is not None else None
+        return self._json("GET", self._path(kind, namespace, name,
+                                            query=query))
 
     def get_or_none(self, kind: str, namespace: str, name: str) -> dict | None:
         try:
@@ -652,6 +666,21 @@ class HttpApiClient:
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict[str, str] | None = None) -> list[dict]:
         return self._list(kind, namespace, label_selector)[0]
+
+    def list_cached(self, kind: str, namespace: str | None = None,
+                    label_selector: dict[str, str] | None = None,
+                    min_resource_version: int | None = None) -> list[dict]:
+        """Consistent read from the apiserver's watch cache:
+        ``resourceVersion=0`` (or ≥ ``min_resource_version``) LISTs are
+        served lock-free from the server-side cache — the form resyncs,
+        backfills, and scrapes ride so N managers can re-list
+        concurrently without stampeding the store's write-path lock. The
+        facade's cache is fed synchronously under the store lock, so
+        'cached' here is never stale relative to the store."""
+        rv = "0" if min_resource_version is None \
+            else str(min_resource_version)
+        return self._list(kind, namespace, label_selector,
+                          resource_version=rv)[0]
 
     def _list(self, kind: str, namespace: str | None,
               label_selector: dict[str, str] | None,

@@ -34,12 +34,73 @@ repair loop depends on:
 
 from __future__ import annotations
 
+import heapq
+import threading
 import time
 
 from ..controllers.manager import Request, Result, owner_mapper
 from ..utils import k8s, names
 from . import errors
 from .store import ClusterStore
+
+
+class _BootScheduler:
+    """Event-driven pod-boot timer wheel: one thread, one heap of
+    (due, ns, pod) entries, batched readiness flips at each deadline.
+
+    The polled alternative — every StatefulSet requeueing at
+    boot_delay/4 until its pods turn Ready — costs O(pods × polls)
+    reconciles, which at a 100k-pod soak is millions of no-op dispatches.
+    Here each booting pod costs exactly ONE timer entry and one status
+    write; the Ready flip's watch event drives the STS reconcile that
+    observes it (tick → event, not tick → poll)."""
+
+    def __init__(self, mark_ready) -> None:
+        self._mark_ready = mark_ready  # fn(ns, pod_name) -> None
+        self._heap: list[tuple[float, str, str]] = []
+        self._cv = threading.Condition()
+        self._thread: threading.Thread | None = None
+
+    #: an empty wheel parks this long before its thread exits — bounds
+    #: idle daemon threads (one per simulator) without lifecycle plumbing;
+    #: the next schedule() simply restarts the thread
+    IDLE_EXIT_S = 5.0
+
+    def schedule(self, due: float, namespace: str, pod_name: str) -> None:
+        with self._cv:
+            heapq.heappush(self._heap, (due, namespace, pod_name))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="kubelet-boot-scheduler")
+                self._thread.start()
+            self._cv.notify()
+
+    def _loop(self) -> None:
+        while True:
+            due_batch: list[tuple[str, str]] = []
+            with self._cv:
+                now = time.monotonic()
+                while self._heap and self._heap[0][0] <= now:
+                    _, ns, pod = heapq.heappop(self._heap)
+                    due_batch.append((ns, pod))
+                if not due_batch:
+                    if self._heap:
+                        self._cv.wait(self._heap[0][0] - now)
+                        continue
+                    self._cv.wait(self.IDLE_EXIT_S)
+                    if not self._heap:
+                        # idle past the grace: exit rather than pin this
+                        # simulator (and its client) via a parked thread
+                        # forever; schedule() restarts on demand
+                        self._thread = None
+                        return
+                    continue
+            for ns, pod in due_batch:
+                try:
+                    self._mark_ready(ns, pod)
+                except Exception:  # noqa: BLE001 — a single pod's flip
+                    pass           # failing must not stall the wheel
 
 
 def node_doomed(node: dict | None) -> bool:
@@ -104,18 +165,26 @@ class StatefulSetSimulator:
 
     def __init__(self, client: ClusterStore, boot_delay_s: float = 0.0,
                  ready_hook=None, manage_nodes: bool = True,
-                 node_grace_s: float = 0.25):
+                 node_grace_s: float = 0.25,
+                 event_driven_boot: bool = False):
         """``ready_hook(pod) -> bool`` lets tests/bench gate pod readiness on
         e.g. a simulated TPU runtime verification. ``manage_nodes`` binds
         every pod to a simulated Node and runs the node-lifecycle behavior
         described in the module docstring; ``node_grace_s`` is the
         NotReady→eviction window (the pod-eviction-timeout analog,
-        wall-clock seconds)."""
+        wall-clock seconds). ``event_driven_boot`` replaces the
+        boot_delay/4 polling requeues with a timer-wheel readiness flip
+        (_BootScheduler) — one scheduled event per pod instead of
+        O(polls), the 100k-pod soak shape; a ``ready_hook`` keeps the
+        polled path (its answer can change between polls)."""
         self.client = client
         self.boot_delay_s = boot_delay_s
         self.ready_hook = ready_hook
         self.manage_nodes = manage_nodes
         self.node_grace_s = node_grace_s
+        self.event_driven_boot = event_driven_boot and ready_hook is None
+        self._boot_scheduler = _BootScheduler(self._boot_pod_ready) \
+            if self.event_driven_boot else None
         self._boot_times: dict[tuple[str, str], float] = {}
         # (ns, pod) → node generation; bumped when the bound node dies so
         # the recreate lands on fresh capacity
@@ -191,8 +260,17 @@ class StatefulSetSimulator:
                     self.client.create(pod)
                 except errors.AlreadyExistsError:
                     pass
-                self._boot_times[(ns, pod_name)] = time.monotonic()
-                requeue = max(self.boot_delay_s, 0.001)
+                now = time.monotonic()
+                self._boot_times[(ns, pod_name)] = now
+                if self._boot_scheduler is not None:
+                    # event-driven: ONE timer entry flips this pod Ready
+                    # at its boot deadline; the requeue below is only a
+                    # lost-event safety net, not the readiness poll
+                    self._boot_scheduler.schedule(now + self.boot_delay_s,
+                                                  ns, pod_name)
+                    requeue = max(self.boot_delay_s * 2, 0.25)
+                else:
+                    requeue = max(self.boot_delay_s, 0.001)
                 continue
             # template drift → restart (delete; next pass recreates)
             if pod.get("spec", {}).get("containers") != \
@@ -214,6 +292,9 @@ class StatefulSetSimulator:
                 if time.monotonic() - booted_at >= self.boot_delay_s and (
                         self.ready_hook is None or self.ready_hook(pod)):
                     self._mark_ready(pod)
+                elif self._boot_scheduler is not None:
+                    # scheduler owns the flip; safety-net requeue only
+                    requeue = max(self.boot_delay_s * 2, 0.25)
                 else:
                     requeue = max(self.boot_delay_s / 4, 0.001)
 
@@ -318,6 +399,27 @@ class StatefulSetSimulator:
                                                       pod_name)
         k8s.set_controller_reference(sts, pod)
         return pod
+
+    def _boot_pod_ready(self, ns: str, pod_name: str) -> None:
+        """Timer-wheel readiness flip (event-driven boot): re-read the pod
+        at its boot deadline and mark it Ready unless it vanished, already
+        turned Ready, sits on a doomed node (the node path owns those —
+        the STS reconcile keeps its safety-net requeue either way), or was
+        RECREATED since this timer was scheduled — a restart re-stamps
+        ``_boot_times`` and schedules a fresh timer, and the predecessor's
+        stale timer must not flip the replacement Ready mid-boot."""
+        pod = self.client.get_or_none("Pod", ns, pod_name)
+        if pod is None or _pod_is_ready(pod):
+            return
+        booted_at = self._boot_times.get((ns, pod_name), 0.0)
+        if time.monotonic() < booted_at + self.boot_delay_s:
+            return  # a newer incarnation's timer owns this flip
+        if self.manage_nodes:
+            node_name = k8s.get_in(pod, "spec", "nodeName")
+            if node_name and node_doomed(
+                    self.client.get_or_none("Node", "", node_name)):
+                return
+        self._mark_ready(pod)
 
     def _mark_ready(self, pod: dict) -> None:
         now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())

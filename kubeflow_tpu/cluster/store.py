@@ -174,6 +174,7 @@ class ClusterStore:
         self._watch_rings: dict[str, _WatchRing] = {}
         self.watch_cache_capacity = WATCH_CACHE_CAPACITY
         self._evictions_metric = None  # watch_cache_evictions_total
+        self._list_lock_metric = None  # store_list_lock_seconds
         # admission hooks: list of (kind, fn(operation, obj, old) -> obj|raise)
         self._admission: list[tuple[str, Callable]] = []
         # CRD structural schemas: kind → {version: openAPIV3Schema}; kept in
@@ -343,12 +344,21 @@ class ClusterStore:
 
     def attach_metrics(self, registry) -> None:
         """Register the watch-cache eviction counter (CachingClient and
-        the wrappers pass their registry down here)."""
+        the wrappers pass their registry down here) plus the LIST
+        lock-hold histogram — the store-lock stampede measurement the
+        consistent-read-from-cache path exists to keep flat."""
         self._evictions_metric = registry.counter(
             "watch_cache_evictions_total",
             "Watch-cache ring frames evicted, by kind — each eviction "
             "narrows the window a reconnecting watcher can resume across "
             "without a full re-LIST.")
+        self._list_lock_metric = registry.histogram(
+            "store_list_lock_seconds",
+            "Wall time a LIST spent acquiring plus holding the store's "
+            "write-path lock, by kind. "
+            "Cache-served (rv=0) LISTs never appear here — this series "
+            "growing with manager count means resyncs are stampeding the "
+            "write path again.")
 
     # ----------------------------------------------------------------- verbs
     def create(self, obj: dict) -> dict:
@@ -427,6 +437,7 @@ class ClusterStore:
                        if continue_token else None)
         if limit is not None and limit <= 0:
             limit = None  # limit=0 means "no limit", as on the wire
+        lock_started = time.monotonic()
         with self._lock:
             pairs = self._sorted_pairs_locked(kind, namespace,
                                               snapshot=limit is not None)
@@ -450,7 +461,11 @@ class ClusterStore:
                     break
                 out.append(k8s.deepcopy(obj))
                 last_pair = pair
-            return out, next_token, str(self._last_rv)
+            list_rv = str(self._last_rv)
+        if self._list_lock_metric is not None:
+            self._list_lock_metric.observe(time.monotonic() - lock_started,
+                                           {"kind": kind})
+        return out, next_token, list_rv
 
     def _sorted_pairs_locked(self, kind: str, namespace: str | None,
                              snapshot: bool) -> list[tuple[str, str]]:
@@ -681,6 +696,30 @@ class ClusterStore:
             self._watches.append(_Watch(kind, relay, namespace,
                                         label_selector, frames=True))
             return replay, self._last_rv
+
+    def snapshot_with_frames(self, kind: str, relay: Callable,
+                             ) -> tuple[list[dict], int]:
+        """Atomically register a frame relay for ``kind`` and return a
+        deepcopied snapshot of its current objects plus the anchor rv —
+        the init handshake for a server-side watch cache: the cache is
+        exactly consistent from birth (every event after the snapshot
+        arrives through the relay, in rv order, under this same lock),
+        so reads served from it are never stale relative to the store."""
+        with self._lock:
+            objs = [k8s.deepcopy(obj) for key, obj in self._objects.items()
+                    if key.kind == kind]
+            self._watches.append(_Watch(kind, relay, None, None,
+                                        frames=True))
+            return objs, self._last_rv
+
+    def list_cached(self, kind: str, namespace: str | None = None,
+                    label_selector: dict[str, str] | None = None,
+                    min_resource_version: int | None = None) -> list[dict]:
+        """Interface parity with HttpApiClient.list_cached (the rv=0
+        consistent-read-from-cache LIST): this store IS the state of
+        record, so the cache-acceptable form serves current state (which
+        trivially satisfies any ``min_resource_version`` gate)."""
+        return self.list(kind, namespace, label_selector)
 
     def unwatch(self, callback: Callable[[WatchEvent], None]) -> None:
         """Deregister a watch callback (watch stream teardown — the apiserver

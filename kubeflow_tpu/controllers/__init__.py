@@ -101,6 +101,22 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
         breaker.attach_metrics(metrics)
         transport_client.set_health_tracker(breaker)
         mgr.breaker = breaker
+    # sharded reconcile ownership (controllers/sharding.py): with
+    # SHARD_COUNT > 0 this replica elects per-shard Leases, filters every
+    # enqueue through the namespace-hash shard map, and re-enqueues only
+    # the moved namespaces on rebalance. Leases ride the TRANSPORT client
+    # (election state must never be served from a stale cache) and the
+    # coordinator starts/stops with the manager.
+    if getattr(config, "shard_count", 0):
+        from .sharding import ShardCoordinator, ShardMap
+        coordinator = ShardCoordinator(
+            transport_client, config.controller_namespace,
+            ShardMap(config.shard_count),
+            identity=getattr(config, "shard_identity", "") or None,
+            lease_duration=getattr(config, "shard_lease_duration_s", 15.0),
+            renew_period=getattr(config, "shard_renew_period_s", 2.0))
+        coordinator.attach_metrics(metrics)
+        mgr.set_sharding(coordinator)
     # ``core``/``extension`` mirror the reference's TWO manager binaries:
     # notebook-controller (core reconciler + culler) and the odh extension
     # manager (extension reconciler + webhooks) — run split via

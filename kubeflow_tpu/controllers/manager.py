@@ -192,6 +192,16 @@ class Manager:
         # resync_all() replays
         self._watch_specs: list[tuple[str, str, Callable | None,
                                       Callable | None]] = []
+        # optional sharded ownership (controllers/sharding.ShardCoordinator,
+        # wired by set_sharding): when set, every enqueue and every dispatch
+        # consults owns_namespace() so this manager reconciles ONLY its
+        # shards' keys — the horizontal-scale filter. None = own everything
+        # (single-manager mode, unchanged behavior).
+        self.sharding = None
+        # optional per-reconcile observer hook: fn(controller, request)
+        # called just before each reconcile runs — the loadtest's
+        # cross-manager duplicate-ownership detector. Exceptions ignored.
+        self.reconcile_observer = None
         # controller-runtime parity metrics (attach_metrics):
         # controller_runtime_reconcile_total{controller,result} + the
         # workqueue family documented in the module docstring
@@ -332,7 +342,32 @@ class Manager:
                 log.warning("read-cache backfill for %s failed; reads stay "
                             "live", kind, exc_info=True)
 
+    def set_sharding(self, coordinator) -> None:
+        """Install sharded ownership: the coordinator's shard map filters
+        every enqueue (watch mappers included — a manager never queues a
+        foreign-shard key) and every dispatch; acquiring shards replays
+        exactly the moved namespaces' keys through resync_shards (the
+        bounded-handoff contract). The coordinator starts/stops with the
+        manager."""
+        self.sharding = coordinator
+        coordinator.on_acquired = self.resync_shards
+
+    def resync_shards(self, shards) -> int:
+        """Re-enqueue every watched key whose namespace hashes into
+        ``shards`` — the handoff resync after acquiring ownership: only
+        the moved namespaces are replayed, never the whole fleet."""
+        coordinator = self.sharding
+        if coordinator is None:
+            return 0
+        shards = set(shards)
+        shard_map = coordinator.shard_map
+        return self.resync_all(
+            namespace_filter=lambda ns: shard_map.shard_for(ns) in shards)
+
     def enqueue(self, controller: str, req: Request, after: float = 0.0) -> None:
+        if self.sharding is not None and \
+                not self.sharding.owns_namespace(req.namespace):
+            return  # foreign-shard key: its owner's watches will queue it
         with self._cv:
             if self._wq_adds is not None:
                 self._wq_adds.inc({"name": controller})
@@ -362,18 +397,29 @@ class Manager:
                                           req, timed=True))
             self._cv.notify_all()
 
-    def resync_all(self) -> int:
+    def resync_all(self, namespace_filter: Callable[[str], bool] | None
+                   = None) -> int:
         """Full resync: list every watched kind and re-enqueue through the
         registered mappers — the recovery path the circuit breaker runs on
         close (controller-runtime's informers re-list on reconnect; our
         watch threads RV-diff too, so this is belt and braces for work
         whose events raced the outage). Each re-enqueue is counted in
         ``workqueue_retries_total`` — a resync IS a retry of the world.
-        Returns the number of requests enqueued."""
+        Returns the number of requests enqueued.
+
+        The LISTs ride ``list_cached`` when the client offers it — the
+        rv=0 consistent-read-from-cache form the apiserver serves
+        lock-free from its watch cache — so a breaker storm across N
+        managers re-listing every kind at once cannot stampede the
+        store's write-path lock. ``namespace_filter`` scopes the resync
+        to matching request namespaces (resync_shards passes the
+        moved-shard predicate)."""
         count = 0
+        lister = getattr(self.client, "list_cached", None) or \
+            self.client.list
         for kind, controller, mapper, predicate in list(self._watch_specs):
             try:
-                objs = self.client.list(kind)
+                objs = lister(kind)
             except Exception as exc:  # noqa: BLE001 — a kind failing to
                 # list must not abort the rest of the resync
                 log.warning("resync list %s failed: %s", kind, exc)
@@ -397,6 +443,9 @@ class Manager:
                 reqs = (mapper(obj) if mapper is not None
                         else [Request(k8s.namespace(obj), k8s.name(obj))])
                 for req in reqs:
+                    if namespace_filter is not None and \
+                            not namespace_filter(req.namespace):
+                        continue
                     if self._wq_retries is not None:
                         self._wq_retries.inc({"name": controller})
                     self.enqueue(controller, req)
@@ -571,6 +620,19 @@ class Manager:
         rec = self._reconcilers.get(item.controller)
         if rec is None:
             return
+        if self.sharding is not None and \
+                not self.sharding.owns_namespace(item.req.namespace):
+            # ownership moved between enqueue and dispatch (rebalance /
+            # lost lease): drop — the new owner's handoff resync replays
+            # the key; processing it here would be a duplicate-owner
+            # reconcile
+            return
+        obs = self.reconcile_observer
+        if obs is not None:
+            try:
+                obs(item.controller, item.req)
+            except Exception:  # noqa: BLE001 — observability must not
+                log.exception("reconcile observer failed")  # break dispatch
         key = (item.controller, item.req)
         started = time.monotonic()
         metrics_mod.phase_collect_start()
@@ -663,6 +725,8 @@ class Manager:
             self._running = True
         if self.leader_elector is not None:
             self.leader_elector.start()
+        if self.sharding is not None:
+            self.sharding.start()
         if self.health_server is not None:
             self.health_server.start()
         # pool size: the manager-wide MaxConcurrentReconciles, raised if a
@@ -751,6 +815,10 @@ class Manager:
         self._threads = []
         if self.leader_elector is not None:
             self.leader_elector.stop()
+        if self.sharding is not None:
+            # graceful: hand every owned shard lease back so peers adopt
+            # them on their next round instead of waiting out staleness
+            self.sharding.stop()
         if self.health_server is not None:
             self.health_server.stop()
 

@@ -45,6 +45,7 @@ def build_manager(store=None, config: ControllerConfig | None = None, *,
                   simulate_kubelet: bool = False,
                   components: str = "all",
                   max_concurrent_reconciles: int | None = None,
+                  shards: int | None = None,
                   on_tls_change=None):
     """Compose the full production stack; returns (manager, shutdown_event).
 
@@ -69,6 +70,11 @@ def build_manager(store=None, config: ControllerConfig | None = None, *,
     """
     store = store if store is not None else ClusterStore()
     config = config or ControllerConfig.from_env()
+    if shards is not None:
+        # sharded multi-manager mode (--shards N): every replica must run
+        # the same count — the namespace-hash shard map is computed
+        # locally from it (SHARD_COUNT env is the manifest-friendly form)
+        config.shard_count = shards
     shutdown = threading.Event()
 
     if components not in ("all", "core", "extension"):
@@ -140,6 +146,13 @@ def build_arg_parser() -> argparse.ArgumentParser:
                          "MaxConcurrentReconciles; default from "
                          "MAX_CONCURRENT_RECONCILES env, 4; 1 = the "
                          "classic single dispatch thread)")
+    ap.add_argument("--shards", type=int, default=None, metavar="M",
+                    help="shard reconcile ownership by namespace hash into "
+                         "M shards (per-shard Lease election; run N "
+                         "replicas with the SAME M against one apiserver "
+                         "— each reconciles only its shards; SHARD_COUNT "
+                         "env is equivalent, SHARD_IDENTITY pins the "
+                         "replica identity)")
     ap.add_argument("--components", choices=("all", "core", "extension"),
                     default="all",
                     help="which manager to run: 'core' = the "
@@ -231,6 +244,7 @@ def main(argv=None) -> int:
         cert_dir=args.cert_dir,
         components=args.components,
         max_concurrent_reconciles=args.max_concurrent_reconciles,
+        shards=args.shards,
         simulate_kubelet=args.simulate_kubelet and client is None)
 
     apiserver = None
@@ -278,9 +292,13 @@ def main(argv=None) -> int:
         apiserver.stop()
     if getattr(mgr, "webhook_server", None) is not None:
         mgr.webhook_server.stop()
+    # stop the manager BEFORE closing its transport: the graceful
+    # shutdown path writes (lease releases — leader and shard) and a
+    # closed client would turn every one into a transport error, leaving
+    # peers to wait out lease staleness instead of adopting immediately
+    mgr.stop()
     if client is not None:
         client.close()
-    mgr.stop()
     if otlp is not None:
         otlp.shutdown()  # final span flush to the collector
     return 0

@@ -62,6 +62,20 @@ class ControllerConfig:
     # dispatch worker-pool size (controller-runtime MaxConcurrentReconciles;
     # 1 = the classic single dispatch thread)
     max_concurrent_reconciles: int = 4
+    # sharded multi-manager control plane (controllers/sharding.py):
+    # shard_count > 0 partitions reconcile ownership by namespace hash
+    # into that many shards; each manager replica elects per-shard Leases
+    # and reconciles only its shards' keys. 0 = sharding off (the single
+    # manager owns everything). Every replica MUST run the same count —
+    # the shard map is computed locally from it.
+    shard_count: int = 0
+    # per-shard lease timings (the crash-failover bound, like the leader
+    # lease); env-overridable so failover tests/smokes can shrink them
+    shard_lease_duration_s: float = 15.0
+    shard_renew_period_s: float = 2.0
+    # stable manager identity for shard leases/metrics (empty = random
+    # per process, the usual pod-name-injected shape in a deployment)
+    shard_identity: str = ""
     # slice health & repair controller (controllers/slicerepair.py):
     # node-preemption-aware slice-atomic recovery with poison-pill quarantine
     enable_slice_repair: bool = True
@@ -124,6 +138,11 @@ class ControllerConfig:
             leader_renew_period_s=float(env.get("LEADER_RENEW_PERIOD", "2")),
             max_concurrent_reconciles=int(
                 env.get("MAX_CONCURRENT_RECONCILES", "4")),
+            shard_count=int(env.get("SHARD_COUNT", "0")),
+            shard_lease_duration_s=float(
+                env.get("SHARD_LEASE_DURATION", "15")),
+            shard_renew_period_s=float(env.get("SHARD_RENEW_PERIOD", "2")),
+            shard_identity=env.get("SHARD_IDENTITY", ""),
             enable_slice_repair=_env_bool("ENABLE_SLICE_REPAIR", True),
             slice_repair_backoff_base_s=float(
                 env.get("SLICE_REPAIR_BACKOFF_BASE", "0.5")),

@@ -147,6 +147,7 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
              settle_s: float = 0.0,
              pool_warm: int = 0,
              boot_delay_ms: float = 0.0,
+             tenant_storm: int = 0,
              stats_out: dict | None = None) -> int:
     """Controller wire-cost measurement: the full controller stack runs
     over a real HTTP apiserver while the load generator drives the store
@@ -194,7 +195,14 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
     fails on any bind miss (a notebook that cold-rolled). ``boot_delay_ms``
     is the simulated per-pod provisioning cost (node spin-up + image pull)
     — the cost a warm bind exists to not pay. ``stats_out`` (a dict)
-    receives wall/p50/req-per-notebook for phase-vs-phase comparisons."""
+    receives wall/p50/p95/req-per-notebook for phase-vs-phase comparisons.
+
+    ``tenant_storm`` spins that many misbehaving-tenant threads for the
+    whole fan-out: each hammers unpaginated Pod LISTs through its own
+    client with a NON-controller User-Agent, so the apiserver's priority
+    & fairness layer classifies them into the global-default flow — the
+    isolation the APF chaos check pins (controller latency within 2x of
+    the quiet baseline while the storm runs)."""
     import tempfile
 
     from kubeflow_tpu.api import types as api
@@ -293,14 +301,52 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
                 print(f"FAIL: pool never reached {pool_warm} warm slices "
                       f"(have {_warm_count()})")
                 return 1
+        import math
+        import threading
+
         baseline = requests.total()
+        # misbehaving-tenant LIST storm (APF chaos shape): each thread
+        # loops unpaginated Pod LISTs under a tenant User-Agent; its
+        # traffic lands in the global-default priority level, so its
+        # seats/queues — not the controllers' — absorb the overload.
+        # Tenant clients carry no metrics registry: storm requests never
+        # pollute the controller req/nb accounting.
+        storm_stop = threading.Event() if tenant_storm > 0 else None
+        storm_threads: list = []
+        storm_stats = {"requests": 0, "rejected": 0}
+        storm_lock = threading.Lock()
+        if tenant_storm > 0:
+            from kubeflow_tpu.cluster.errors import ApiError
+
+            def _storm(idx: int) -> None:
+                tenant = HttpApiClient(
+                    proxy.url, user_agent=f"tenant-lister-{idx}")
+                try:
+                    while not storm_stop.is_set():
+                        try:
+                            tenant.list("Pod", namespace)
+                            ok = True
+                        except ApiError:
+                            ok = False  # 429'd through the retry budget
+                        except Exception:  # noqa: BLE001 — teardown races
+                            break
+                        with storm_lock:
+                            storm_stats["requests"] += 1
+                            if not ok:
+                                storm_stats["rejected"] += 1
+                finally:
+                    tenant.close()
+
+            storm_threads = [
+                threading.Thread(target=_storm, args=(i,), daemon=True,
+                                 name=f"tenant-storm-{i}")
+                for i in range(tenant_storm)]
+            for t in storm_threads:
+                t.start()
         # per-notebook create→SliceReady latency, observed via a store
         # watch — a tight full-LIST poll at a 500-notebook fan-out costs
         # ~17 ms/scan of deep copies and perturbs the very system under
         # measurement (it pins a core against the controllers' GIL time)
-        import math
-        import threading
-
         from kubeflow_tpu.cluster.kubelet import kill_node
         from kubeflow_tpu.tpu import topology
         ready_at: dict[str, float] = {}
@@ -369,6 +415,12 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
         # continues in the background (replacement capacity, not
         # per-notebook cost) and must not pollute the comparison
         converged_requests = requests.total()
+        if storm_stop is not None:
+            # the storm runs through the WHOLE fan-out (the isolation
+            # under test); stop it at convergence so teardown is clean
+            storm_stop.set()
+            for t in storm_threads:
+                t.join(timeout=10)
         if settle_s > 0:
             # idle-fleet window: watch chaos keeps firing while nothing
             # changes — reconnects must resume off bookmarks, not relist
@@ -421,9 +473,17 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
             stats_out.update({
                 "wall_s": wall,
                 "p50_s": statistics.median(latencies) if latencies else None,
+                "p95_s": (latencies[int(0.95 * (len(latencies) - 1))]
+                          if latencies else None),
                 "req_per_nb": (converged_requests - baseline)
                 / max(count, 1),
+                "storm": dict(storm_stats) if tenant_storm else None,
             })
+        if tenant_storm:
+            print(f"tenant storm: {tenant_storm} threads, "
+                  f"{storm_stats['requests']} LISTs, "
+                  f"{storm_stats['rejected']} rejected through the retry "
+                  f"budget (APF)")
         if ready < count:
             stuck = [n for n in created_at if n not in ready_at]
             print(f"FAIL: only {ready}/{count} notebooks became SliceReady "
@@ -577,6 +637,462 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
                 pass
 
 
+class _DuplicateTracker:
+    """Cross-manager duplicate-ownership detector: records which manager
+    reconciled each notebook key and when. A key reconciled by two
+    managers while BOTH were alive is a duplicate-owner reconcile — the
+    invariant the shard leases exist to prevent. A key moving to the
+    survivor AFTER a kill is the failover working."""
+
+    def __init__(self) -> None:
+        import threading
+        self._lock = threading.Lock()
+        self.touches: dict[tuple[str, str], list[tuple[int, float]]] = {}
+        self.kill_time: float | None = None
+        self.killed_manager: int | None = None
+
+    def observer(self, manager_idx: int, controller_filter: str = "notebook"):
+        def observe(controller: str, req) -> None:
+            if controller_filter not in controller:
+                return
+            with self._lock:
+                self.touches.setdefault(
+                    (req.namespace, req.name), []).append(
+                        (manager_idx, time.monotonic()))
+        return observe
+
+    def mark_kill(self, manager_idx: int) -> None:
+        self.kill_time = time.monotonic()
+        self.killed_manager = manager_idx
+
+    def violations(self) -> list[tuple]:
+        """Keys reconciled by >1 manager during a both-alive window:
+        pre-kill, every manager counts; post-kill, the SURVIVORS must
+        still be disjoint among themselves (a key moving from the killed
+        manager to one survivor is the failover working — two survivors
+        sharing it is the split-brain this exists to catch). Slightly
+        conservative at ≥3 managers: a capacity-driven survivor-to-
+        survivor handoff after the kill (legal, lease-serialized) is
+        indistinguishable from overlap here and would be flagged."""
+        out = []
+        with self._lock:
+            for key, touches in self.touches.items():
+                pre = {m for m, t in touches
+                       if self.kill_time is None or t < self.kill_time}
+                post = {m for m, t in touches
+                        if self.kill_time is not None
+                        and t >= self.kill_time
+                        and m != self.killed_manager}
+                if len(pre) > 1 or len(post) > 1:
+                    out.append((key, sorted(pre | post)))
+        return out
+
+    def managers_for(self, key: tuple[str, str]) -> set[int]:
+        with self._lock:
+            return {m for m, _ in self.touches.get(key, [])}
+
+
+def _wait_for_shard_ownership(stacks, managers: int, shards: int,
+                              deadline_s: float) -> bool:
+    """Block until every manager owns EXACTLY its steady-state share for
+    the full membership (`assign_shards` over all identities) — not a
+    transient (the first manager briefly owns everything until its
+    peers' member leases land; fanning out during that window would
+    make the ensuing rebalance hand keys over mid-run). Shared by the
+    sharded wire run and the soak."""
+    from kubeflow_tpu.controllers.sharding import assign_shards
+    identities = [f"m{m}" for m in range(managers)]
+    expected = assign_shards(shards, identities)
+    want = [frozenset(s for s, owner in expected.items() if owner == ident)
+            for ident in identities]
+
+    def settled() -> bool:
+        return all(stack[0].sharding.owned_shards() == want[m]
+                   for m, stack in enumerate(stacks))
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline and not settled():
+        time.sleep(0.05)
+    return settled()
+
+
+def run_sharded(count: int, namespace: str, accelerator: str,
+                timeout: float, managers: int, shards: int,
+                workers: int = 4, namespace_count: int = 8,
+                apiserver_latency_ms: float = 0.0,
+                list_page_size: int | None = None,
+                kill_manager_at_frac: float | None = None,
+                extra_after_kill: int = 0,
+                lease_duration_s: float = 10.0,
+                renew_period_s: float = 1.0,
+                stats_out: dict | None = None) -> int:
+    """Sharded multi-manager fan-out over the real wire: N manager stacks
+    (each its own HttpApiClient + read cache + worker pool + per-shard
+    lease election) reconcile one apiserver, ownership split by namespace
+    hash into ``shards`` shards. Notebooks spread round-robin over
+    ``namespace_count`` namespaces so every shard carries load.
+
+    Measured per manager: owned shards, notebooks reconciled, apiserver
+    requests — the per-shard req/nb + wall breakdown table. The
+    reconcile-observer hook proves ZERO duplicate-owner reconciles (no
+    key reconciled by two managers while both were alive).
+
+    ``kill_manager_at_frac`` crashes manager 0 (leases left DANGLING, the
+    hard-kill shape) once that fraction of the fleet is Ready; the
+    survivors must adopt its shards within the lease duration and
+    ``extra_after_kill`` more notebooks created post-kill must still
+    converge — no lost notebooks."""
+    import threading
+
+    from kubeflow_tpu.api import types as api
+    from kubeflow_tpu.api.slicepool import install_slicepool_crd
+    from kubeflow_tpu.cluster.apiserver import ApiServerProxy
+    from kubeflow_tpu.cluster.cache import CachingClient
+    from kubeflow_tpu.cluster.http_client import HttpApiClient
+    from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
+    from kubeflow_tpu.cluster.store import ClusterStore
+    from kubeflow_tpu.controllers import Manager, setup_controllers
+    from kubeflow_tpu.utils import names
+    from kubeflow_tpu.utils.config import ControllerConfig
+    from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+    store = ClusterStore()
+    api.install_notebook_crd(store)
+    install_slicepool_crd(store)
+    cleanups = []
+    try:
+        sim_cache = CachingClient(store, auto_informer=False, disable_for=())
+        sim_mgr = Manager(sim_cache, read_cache=sim_cache)
+        StatefulSetSimulator(sim_cache).setup(sim_mgr)
+        sim_mgr.start()
+        cleanups.append(sim_mgr.stop)
+        server_metrics = MetricsRegistry(include_notebook_metrics=False)
+        proxy = ApiServerProxy(store,
+                               latency_s=apiserver_latency_ms / 1000.0)
+        proxy.attach_metrics(server_metrics)
+        proxy.start()
+        cleanups.append(proxy.stop)
+
+        tracker = _DuplicateTracker()
+        stacks = []  # (mgr, registry, requests_counter)
+        for m in range(managers):
+            client = HttpApiClient(proxy.url, list_page_size=list_page_size,
+                                   user_agent=f"kubeflow-tpu-manager/m{m}")
+            cleanups.append(client.close)
+            cfg = ControllerConfig(
+                shard_count=shards, shard_identity=f"m{m}",
+                shard_lease_duration_s=lease_duration_s,
+                shard_renew_period_s=renew_period_s)
+            reg = MetricsRegistry()
+            mgr = setup_controllers(client, config=cfg, metrics=reg,
+                                    max_concurrent_reconciles=workers)
+            mgr.reconcile_observer = tracker.observer(m)
+            mgr.start()
+            cleanups.append(mgr.stop)
+            stacks.append((mgr, reg, reg.counter(
+                "rest_client_requests_total", "")))
+
+        # ownership must settle BEFORE the fan-out (boot cost, like the
+        # watch-backfill settle in run_wire)
+        if not _wait_for_shard_ownership(stacks, managers, shards,
+                                         min(timeout, 30.0)):
+            print("FAIL: shard ownership never settled "
+                  f"({[sorted(s[0].sharding.owned_shards()) for s in stacks]})")
+            return 1
+
+        namespaces = [f"{namespace}-{i}" for i in range(namespace_count)]
+        ready_at: dict[str, float] = {}
+        ready_cv = threading.Condition()
+
+        def on_event(ev):
+            nb = ev.obj
+            name = nb["metadata"]["name"]
+            if name not in ready_at and \
+                    (api.get_condition(nb, api.CONDITION_SLICE_READY)
+                     or {}).get("status") == "True":
+                with ready_cv:
+                    ready_at[name] = time.monotonic()
+                    ready_cv.notify_all()
+        store.watch(api.KIND, on_event)
+
+        baseline = [stack[2].total() for stack in stacks]
+        t0 = time.monotonic()
+        created_at: dict[str, float] = {}
+
+        def _create(i: int) -> None:
+            name = f"loadtest-nb-{i}"
+            created_at[name] = time.monotonic()
+            store.create(api.new_notebook(
+                name, namespaces[i % namespace_count],
+                annotations={names.TPU_ACCELERATOR_ANNOTATION: accelerator}))
+
+        for i in range(count):
+            _create(i)
+
+        def _wait_ready(target: int, deadline: float) -> bool:
+            with ready_cv:
+                while len(ready_at) < target:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return False
+                    ready_cv.wait(remaining)
+                return True
+
+        killed = False
+        total = count
+        deadline = t0 + timeout
+        if kill_manager_at_frac is not None and managers > 1:
+            if not _wait_ready(max(1, int(count * kill_manager_at_frac)),
+                               deadline):
+                print(f"FAIL: only {len(ready_at)}/{count} ready before "
+                      f"the kill point")
+                return 1
+            # CRASH manager 0: election stops with leases left dangling,
+            # then the worker pool dies. Survivors adopt its shards only
+            # after the leases go stale — the real failover bound.
+            tracker.mark_kill(0)
+            stacks[0][0].sharding.stop(release=False)
+            stacks[0][0].stop()
+            killed = True
+            for i in range(count, count + extra_after_kill):
+                _create(i)
+            total = count + extra_after_kill
+        converged = _wait_ready(total, deadline)
+        wall = time.monotonic() - t0
+        store.unwatch(on_event)
+        for _, reg, _ in stacks:
+            reg.expose()  # one scrape each, notebook_running LIST included
+
+        if not converged:
+            stuck = [n for n in created_at if n not in ready_at]
+            note = " — notebooks LOST in the failover (the survivor " \
+                "never adopted the killed manager's shards)" if killed \
+                else ""
+            print(f"FAIL: only {len(ready_at)}/{total} notebooks became "
+                  f"SliceReady within {timeout}s (stuck: {stuck[:5]}){note}")
+            return 1
+
+        duplicates = tracker.violations()
+        # per-manager / per-shard breakdown
+        per_manager = []
+        reconciled_by = {}
+        for key, touchers in ((k, tracker.managers_for(k))
+                              for k in tracker.touches):
+            for m in touchers:
+                reconciled_by.setdefault(m, set()).add(key)
+        lock_hist = server_metrics.histogram("store_list_lock_seconds", "")
+        cache_lists = server_metrics.counter("apiserver_cache_lists_total",
+                                             "").total()
+        print(f"notebooks: {total}  managers: {managers}  shards: {shards}"
+              f"  workers: {workers}/mgr  wall: {wall:.2f}s")
+        print("| manager | shards owned | notebooks | requests | req/nb |")
+        print("|---|---|---|---|---|")
+        survivors_requests = 0.0
+        for m, (mgr, reg, req_counter) in enumerate(stacks):
+            owned = sorted(mgr.sharding.owned_shards()) \
+                if not (killed and m == 0) else "(killed)"
+            nbs = len(reconciled_by.get(m, ()))
+            reqs = req_counter.total() - baseline[m]
+            survivors_requests += reqs
+            per_nb = reqs / max(nbs, 1)
+            per_manager.append({"manager": m, "shards": owned,
+                                "notebooks": nbs, "requests": reqs,
+                                "req_per_nb": per_nb})
+            print(f"| m{m} | {owned} | {nbs} | {reqs:.0f} | {per_nb:.1f} |")
+        agg_req_nb = survivors_requests / max(total, 1)
+        latencies = sorted(ready_at[n] - created_at[n] for n in ready_at)
+        p50 = statistics.median(latencies) if latencies else 0.0
+        p95 = latencies[int(0.95 * (len(latencies) - 1))] if latencies \
+            else 0.0
+        print(f"aggregate req/nb: {agg_req_nb:.1f}  p50: {p50*1000:.0f}ms  "
+              f"p95: {p95*1000:.0f}ms  duplicate-owner reconciles: "
+              f"{len(duplicates)}")
+        print(f"store: {cache_lists:.0f} cache-served LISTs, "
+              f"{lock_hist.total_count():.0f} store-lock LISTs holding "
+              f"{lock_hist.total_sum()*1000:.1f}ms total")
+        if stats_out is not None:
+            stats_out.update({
+                "wall_s": wall, "req_per_nb": agg_req_nb, "p50_s": p50,
+                "p95_s": p95, "duplicates": duplicates,
+                "per_manager": per_manager,
+                "store_lock_lists": lock_hist.total_count(),
+                "store_lock_seconds": lock_hist.total_sum(),
+                "cache_lists": cache_lists,
+            })
+        if duplicates:
+            print(f"FAIL: {len(duplicates)} keys reconciled by multiple "
+                  f"managers while both were alive: {duplicates[:5]}")
+            return 1
+        return 0
+    finally:
+        for cleanup in reversed(cleanups):
+            try:
+                cleanup()
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"loadtest: cleanup failed: {e}\n")
+
+
+def run_soak(count: int, accelerator: str, timeout: float,
+             managers: int, shards: int, workers: int = 4,
+             namespace_count: int = 64, boot_delay_ms: float = 100.0,
+             stats_out: dict | None = None) -> int:
+    """100k-notebook soak: the sharded CORE control plane in-process (no
+    HTTP wire — the wire adds ~0.5 ms/request of localhost cost that
+    would turn a 100k fan-out into hours on CI hardware; the sharded wire
+    behavior is measured by run_sharded at 2000). N manager instances
+    share one ClusterStore, ownership split by namespace hash; the
+    kubelet sim runs EVENT-DRIVEN boot ticks (one timer entry per pod,
+    zero readiness polling) and no per-pod Node objects, so the soak's
+    cost is reconcile logic, not simulator churn.
+
+    Scope: core notebook reconciler only (extension/repair/pool off —
+    their fan-outs multiply the object graph ~3x and are covered by the
+    wire phases); single-worker slices. Asserted: full convergence, ZERO
+    duplicate-owner reconciles, and the store-lock LIST profile
+    (store_list_lock_seconds), which must stay flat as managers grow —
+    manager resyncs/backfills ride the cache-served LIST path."""
+    import threading
+
+    from kubeflow_tpu.api import types as api
+    from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
+    from kubeflow_tpu.cluster.store import ClusterStore
+    from kubeflow_tpu.controllers import Manager, setup_controllers
+    from kubeflow_tpu.cluster.cache import CachingClient
+    from kubeflow_tpu.utils import names
+    from kubeflow_tpu.utils.config import ControllerConfig
+    from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+    store = ClusterStore()
+    server_metrics = MetricsRegistry(include_notebook_metrics=False)
+    api.install_notebook_crd(store)
+    cleanups = []
+    try:
+        sim_cache = CachingClient(store, auto_informer=False, disable_for=())
+        sim_mgr = Manager(sim_cache, read_cache=sim_cache,
+                          max_concurrent_reconciles=workers)
+        StatefulSetSimulator(sim_cache, boot_delay_s=boot_delay_ms / 1000.0,
+                             manage_nodes=False,
+                             event_driven_boot=True).setup(sim_mgr)
+        sim_mgr.start()
+        cleanups.append(sim_mgr.stop)
+
+        tracker = _DuplicateTracker()
+        stacks = []
+        for m in range(managers):
+            # generous lease margin: a 100k soak pegs the CPU for tens of
+            # minutes, and CPython's GIL convoy can starve the renew
+            # thread for seconds at a stretch — a flapped lease is a
+            # LEGAL serialized handoff, but it would churn ownership and
+            # trip the strict duplicate-owner accounting this soak pins
+            cfg = ControllerConfig(
+                shard_count=shards, shard_identity=f"m{m}",
+                shard_lease_duration_s=90.0, shard_renew_period_s=2.0,
+                enable_slice_repair=False, enable_slice_pool=False)
+            reg = MetricsRegistry()
+            # webhooks=False matches the wire loadtest's semantics (an
+            # HTTP manager can't install in-process admission either) —
+            # and the mutating webhook's odh stop-lock annotation would
+            # park every notebook forever with the extension manager off
+            mgr = setup_controllers(store, config=cfg, metrics=reg,
+                                    core=True, extension=False,
+                                    webhooks=False,
+                                    max_concurrent_reconciles=workers)
+            mgr.reconcile_observer = tracker.observer(m)
+            mgr.start()
+            cleanups.append(mgr.stop)
+            stacks.append((mgr, reg))
+        # attach AFTER the managers: each setup_controllers passes its own
+        # registry down to the shared store, and the LAST attach wins —
+        # the soak's lock profile must land in server_metrics
+        store.attach_metrics(server_metrics)
+        if not _wait_for_shard_ownership(stacks, managers, shards, 30.0):
+            print("FAIL: shard ownership never settled "
+                  f"({[sorted(s[0].sharding.owned_shards()) for s in stacks]})")
+            return 1
+
+        ready = [0]
+        ready_cv = threading.Condition()
+        seen_ready: set[str] = set()
+
+        def on_event(ev):
+            nb = ev.obj
+            name = nb["metadata"]["name"]
+            if name not in seen_ready and \
+                    (api.get_condition(nb, api.CONDITION_SLICE_READY)
+                     or {}).get("status") == "True":
+                with ready_cv:
+                    if name in seen_ready:
+                        return
+                    seen_ready.add(name)
+                    ready[0] += 1
+                    ready_cv.notify_all()
+        store.watch(api.KIND, on_event)
+
+        t0 = time.monotonic()
+        report_every = max(count // 20, 1)
+        for i in range(count):
+            store.create(api.new_notebook(
+                f"soak-nb-{i}", f"soak-{i % namespace_count}",
+                annotations={names.TPU_ACCELERATOR_ANNOTATION: accelerator}))
+            if (i + 1) % report_every == 0:
+                elapsed = time.monotonic() - t0
+                print(f"  created {i+1}/{count}, ready {ready[0]} "
+                      f"({elapsed:.0f}s)", flush=True)
+        create_wall = time.monotonic() - t0
+        deadline = t0 + timeout
+        last_report = time.monotonic()
+        with ready_cv:
+            while ready[0] < count:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                ready_cv.wait(min(remaining, 10.0))
+                if time.monotonic() - last_report >= 30.0:
+                    last_report = time.monotonic()
+                    print(f"  draining: ready {ready[0]}/{count} "
+                          f"({time.monotonic() - t0:.0f}s)", flush=True)
+        wall = time.monotonic() - t0
+        store.unwatch(on_event)
+        converged = ready[0] >= count
+        duplicates = tracker.violations()
+        lock_hist = server_metrics.histogram("store_list_lock_seconds", "")
+        shard_split = [sorted(s[0].sharding.owned_shards()) for s in stacks]
+        # transitions beyond the initial settle mean ownership flapped
+        # mid-run (a legal serialized handoff, but it churns resyncs)
+        rebalances = sum(
+            reg.counter("shard_rebalance_total", "").total()
+            for _, reg in stacks)
+        print(f"soak: {count} notebooks  managers: {managers}  shards: "
+              f"{shards}  wall: {wall:.1f}s (create phase "
+              f"{create_wall:.1f}s)  ready: {ready[0]}/{count}")
+        print(f"shard split: {shard_split}  ownership transitions: "
+              f"{rebalances:.0f}")
+        print(f"duplicate-owner reconciles: {len(duplicates)}  store-lock "
+              f"LISTs: {lock_hist.total_count():.0f} holding "
+              f"{lock_hist.total_sum()*1000:.1f}ms total")
+        if stats_out is not None:
+            stats_out.update({
+                "wall_s": wall, "ready": ready[0],
+                "duplicates": duplicates,
+                "store_lock_lists": lock_hist.total_count(),
+                "store_lock_seconds": lock_hist.total_sum(),
+            })
+        if not converged:
+            print(f"FAIL: only {ready[0]}/{count} notebooks became "
+                  f"SliceReady within {timeout}s")
+            return 1
+        if duplicates:
+            print(f"FAIL: {len(duplicates)} duplicate-owner reconciles: "
+                  f"{duplicates[:5]}")
+            return 1
+        return 0
+    finally:
+        for cleanup in reversed(cleanups):
+            try:
+                cleanup()
+            except Exception as e:  # noqa: BLE001
+                sys.stderr.write(f"loadtest: cleanup failed: {e}\n")
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--count", type=int, default=50)
@@ -651,6 +1167,34 @@ def main() -> int:
                     help="with --wire: simulated per-pod provisioning "
                          "cost (node spin-up + image pull) — what a warm "
                          "bind skips")
+    ap.add_argument("--tenant-storm", type=int, default=0, metavar="N",
+                    help="with --wire: run N misbehaving-tenant threads "
+                         "hammering unpaginated Pod LISTs under a tenant "
+                         "User-Agent for the whole fan-out — the APF "
+                         "isolation chaos shape")
+    ap.add_argument("--managers", type=int, default=0, metavar="N",
+                    help="sharded multi-manager mode: run N full manager "
+                         "stacks (own client/cache/worker pool/per-shard "
+                         "leases) against one apiserver over the wire; "
+                         "requires --shards")
+    ap.add_argument("--shards", type=int, default=0, metavar="M",
+                    help="shard count for --managers/--soak (namespace-"
+                         "hash reconcile ownership)")
+    ap.add_argument("--namespace-count", type=int, default=8,
+                    help="spread notebooks over this many namespaces "
+                         "(sharded/soak modes; 1 namespace = 1 shard's "
+                         "worth of load)")
+    ap.add_argument("--kill-manager-at", type=float, default=None,
+                    metavar="FRAC",
+                    help="with --managers: crash manager 0 (leases left "
+                         "dangling) once FRAC of the fleet is Ready; "
+                         "survivors must adopt its shards and no "
+                         "notebook may be lost")
+    ap.add_argument("--soak", action="store_true",
+                    help="100k-scale soak: sharded core control plane "
+                         "in-process with event-driven kubelet ticks "
+                         "(uses --count/--managers/--shards/"
+                         "--namespace-count; see run_soak)")
     args = ap.parse_args()
     if args.emit_yaml:
         try:
@@ -660,6 +1204,24 @@ def main() -> int:
         except BrokenPipeError:
             pass  # downstream consumer (head, kubectl) closed the pipe
         return 0
+    if args.soak:
+        return run_soak(args.count, args.accelerator, args.timeout,
+                        managers=max(args.managers, 1),
+                        shards=args.shards or 8, workers=args.workers,
+                        namespace_count=args.namespace_count,
+                        boot_delay_ms=args.boot_delay_ms)
+    if args.managers > 0:
+        return run_sharded(args.count, args.namespace, args.accelerator,
+                           args.timeout, managers=args.managers,
+                           shards=args.shards or args.managers * 2,
+                           workers=args.workers,
+                           namespace_count=args.namespace_count,
+                           apiserver_latency_ms=args.apiserver_latency_ms,
+                           list_page_size=args.list_page_size,
+                           kill_manager_at_frac=args.kill_manager_at,
+                           extra_after_kill=(max(args.count // 10, 4)
+                                             if args.kill_manager_at
+                                             else 0))
     if args.wire:
         return run_wire(args.count, args.namespace, args.accelerator,
                         args.timeout,
@@ -677,7 +1239,8 @@ def main() -> int:
                         min_conn_reuse=args.min_conn_reuse,
                         settle_s=args.settle_s,
                         pool_warm=args.pool_warm,
-                        boot_delay_ms=args.boot_delay_ms)
+                        boot_delay_ms=args.boot_delay_ms,
+                        tenant_storm=args.tenant_storm)
     return run_inprocess(args.count, args.namespace, args.accelerator,
                          args.timeout, server=args.server,
                          workers=args.workers)

@@ -330,3 +330,52 @@ def test_slice_pool_metric_families_exported():
     assert 'slicepool_bind_misses_total{reason="PoolContended"} 1' in text
     assert 'notebook_migrations_total{outcome="success"} 1' in text
     assert 'notebook_migrations_total{outcome="fallback"} 1' in text
+
+
+# --------------------------------- sharded control plane + APF families
+
+def test_shard_and_apf_metric_families_exported():
+    """The sharded-control-plane families land in one exposition with
+    their label shapes: shard_ownership by shard+manager (1 while the
+    lease is held, 0 after losing it), shard_rebalance_total by manager
+    (ownership transitions), and the APF flow-control trio by
+    priority_level. The end-to-end values are pinned in
+    tests/test_shard_map.py and the loadtest smoke."""
+    from kubeflow_tpu.cluster.apf import APFDispatcher, RejectedError
+    from kubeflow_tpu.controllers.sharding import ShardCoordinator, ShardMap
+
+    store = ClusterStore()
+    metrics = MetricsRegistry()
+    coord = ShardCoordinator(store, "kubeflow-tpu-system", ShardMap(2),
+                             identity="m0", lease_duration=5.0,
+                             renew_period=0.5)
+    coord.attach_metrics(metrics)
+    assert coord.run_once() == frozenset({0, 1})  # sole member owns all
+    text = metrics.expose()
+    assert 'shard_ownership{manager="m0",shard="0"} 1' in text
+    assert 'shard_ownership{manager="m0",shard="1"} 1' in text
+    assert 'shard_rebalance_total{manager="m0"} 2' in text
+    coord.stop()  # graceful: ownership gauges drain to zero
+    text = metrics.expose()
+    assert 'shard_ownership{manager="m0",shard="0"} 0' in text
+    assert 'shard_rebalance_total{manager="m0"} 4' in text
+
+    apf = APFDispatcher(queue_wait_s=0.1)
+    apf.attach_metrics(metrics)
+    meta = {"user_agent": "kubeflow-tpu-manager/m0", "verb": "list",
+            "kind": "Pod"}
+    ticket = apf.acquire(meta)
+    apf.release(ticket)
+    # saturate global-default's borrowable seats, then overflow its queue
+    # wait so a rejection lands in the counter
+    tenant = {"user_agent": "tenant", "verb": "list", "kind": "Pod"}
+    held = [apf.acquire(tenant) for _ in range(apf.total_seats)]
+    import pytest as _pytest
+    with _pytest.raises(RejectedError):
+        apf.acquire(tenant)
+    for t in held:
+        apf.release(t)
+    text = metrics.expose()
+    assert 'apf_dispatched_total{priority_level="workload-high"} 1' in text
+    assert 'apf_rejected_total{priority_level="global-default"} 1' in text
+    assert 'apf_current_inqueue{priority_level="global-default"} 0' in text
