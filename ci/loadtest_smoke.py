@@ -11,7 +11,9 @@ its budget or any loadtest bound (convergence, requests/notebook) trips.
 Additional phases: a 2-manager/4-shard sharded run (zero duplicate-owner
 reconciles, sub-linear wall, crash failover with no lost notebooks), a
 tenant-LIST-storm APF isolation check (controller p95 within 2x quiet),
-warm-vs-cold bind, watch-kill RV-resume, and node-preemption repair.
+warm-vs-cold bind, watch-kill RV-resume, node-preemption repair, and a
+flight-recorder traced run (every notebook must show a complete
+enqueue→queue-wait→reconcile→wire trace with intact parentage).
 
 Budget rationale: the run takes ~2 s on a quiet dev box; the default 60 s
 budget is ~30x headroom, loose enough to survive a loaded CI box yet tight
@@ -116,13 +118,20 @@ STORM_THREADS = 6
 STORM_RTT_MS = 5.0
 STORM_P95_FACTOR = 2.0
 STORM_P95_SLACK_S = 0.4
+# traced phase: a small fan-out with the flight-recorder tracing provider
+# installed. run_wire --trace fails internally unless EVERY notebook has a
+# complete CR→Ready lifecycle trace (enqueue → queue-wait → reconcile root
+# → wire spans, parentage intact) and the queue+wire phase sums fit inside
+# the reconcile wall within 10% — the end-to-end proof that the tracing
+# layer reports real causality, not decorative spans
+TRACED_COUNT_NB = 25
 
 
 def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
               budget_s: float = DEFAULT_BUDGET_S,
               preempt: bool = True, watch_kill: bool = True,
               warm_cold: bool = True, sharded: bool = True,
-              storm: bool = True) -> int:
+              storm: bool = True, traced: bool = True) -> int:
     """Run the wire fan-out; return nonzero on any failed bound."""
     from loadtest.start_notebooks import run_sharded, run_wire
 
@@ -275,6 +284,21 @@ def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
                   f"{STORM_P95_SLACK_S * 1000:.0f}ms) — APF isolation "
                   f"regressed")
             return 1
+    if traced:
+        traced_stats: dict = {}
+        rc = run_wire(TRACED_COUNT_NB, "traced-smoke", "v5e-4",
+                      timeout=max(budget_s - (time.monotonic() - t0), 15.0),
+                      workers=workers, trace=True,
+                      stats_out=traced_stats)
+        if rc != 0:
+            print(f"SMOKE FAIL: traced loadtest bounds violated (rc={rc})")
+            return rc
+        tr = traced_stats.get("trace") or {}
+        if tr.get("complete") != TRACED_COUNT_NB:
+            print(f"SMOKE FAIL: traced phase ran but only "
+                  f"{tr.get('complete')} of {TRACED_COUNT_NB} notebooks "
+                  f"reported complete traces (vacuous-pass guard)")
+            return 1
     wall = time.monotonic() - t0
     if wall > budget_s:
         print(f"SMOKE FAIL: {wall:.1f}s exceeds the {budget_s:.0f}s budget")
@@ -293,6 +317,9 @@ def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
     if preempt:
         phases.append(f"{PREEMPT_COUNT} slices @ {PREEMPT_RATE:.0%} "
                       f"preemptions")
+    if traced:
+        phases.append(f"{TRACED_COUNT_NB} nb traced phase "
+                      f"(complete CR→Ready traces)")
     print(" + ".join(phases) + f" in {wall:.1f}s (budget {budget_s:.0f}s)")
     return 0
 
@@ -312,13 +339,16 @@ def main() -> int:
                     help="skip the 2-manager/4-shard + failover phase")
     ap.add_argument("--no-storm", action="store_true",
                     help="skip the tenant-LIST-storm APF phase")
+    ap.add_argument("--no-trace", action="store_true",
+                    help="skip the flight-recorder traced phase")
     args = ap.parse_args()
     return run_smoke(args.count, args.workers, args.budget_s,
                      preempt=not args.no_preempt,
                      watch_kill=not args.no_watch_kill,
                      warm_cold=not args.no_warm_cold,
                      sharded=not args.no_sharded,
-                     storm=not args.no_storm)
+                     storm=not args.no_storm,
+                     traced=not args.no_trace)
 
 
 if __name__ == "__main__":

@@ -274,6 +274,124 @@ def cmd_watch(client, args) -> int:
     return 0
 
 
+def _critical_path(spans: list[dict]) -> set[str]:
+    """Span ids on the latency-critical chain: from the latest-ending root,
+    descend at each level into the child that finished last — that chain is
+    what determined when the trace finished. (Walking UP from the
+    latest-ending span would degenerate to just the root: in a synchronous
+    trace the root always ends last.)"""
+    if not spans:
+        return set()
+    by_id = {s["span_id"]: s for s in spans}
+    children: dict = {}
+    for s in spans:
+        children.setdefault(s.get("parent_id"), []).append(s)
+    roots = [s for s in spans if s.get("parent_id") not in by_id]
+    cur = max(roots or spans, key=lambda s: s["end"])
+    path: set[str] = set()
+    while cur is not None and cur["span_id"] not in path:
+        path.add(cur["span_id"])
+        kids = children.get(cur["span_id"], [])
+        cur = max(kids, key=lambda s: s["end"]) if kids else None
+    return path
+
+
+def _span_depth(span: dict, by_id: dict) -> int:
+    depth, seen = 0, set()
+    parent = span.get("parent_id")
+    while parent in by_id and parent not in seen:
+        seen.add(parent)
+        depth += 1
+        parent = by_id[parent].get("parent_id")
+    return depth
+
+
+def render_trace(payload: dict, width: int = 32) -> str:
+    """CR→Ready timeline for one notebook: each recorded trace (one per
+    reconcile dispatch) as an indented span tree with offset/duration
+    columns, a proportional bar, ``*`` on the critical path, and a phase
+    breakdown footer (queue / APF / wire / reconcile). Pure — testable
+    without an HTTP server."""
+    from .utils.tracing import trace_phase_breakdown
+
+    traces = payload.get("traces", [])
+    out = [f"Notebook:  {payload.get('namespace', '?')}/"
+           f"{payload.get('name', '?')}",
+           f"Traces:    {len(traces)} recorded (oldest first)"]
+    first_start = last_end = None
+    for i, trace in enumerate(traces):
+        spans = trace.get("spans", [])
+        if not spans:
+            continue
+        t0 = min(s["start"] for s in spans)
+        t_end = max(s["end"] for s in spans)
+        wall = max(t_end - t0, 1e-9)
+        first_start = t0 if first_start is None else min(first_start, t0)
+        last_end = t_end if last_end is None else max(last_end, t_end)
+        critical = _critical_path(spans)
+        by_id = {s["span_id"]: s for s in spans}
+        out.append("")
+        out.append(f"Trace {i + 1}/{len(traces)}  {trace['trace_id']}  "
+                   f"wall {wall:.3f}s")
+        for s in spans:
+            offset = s["start"] - t0
+            bar_from = int(offset / wall * width)
+            bar_len = max(int(s["duration_s"] / wall * width), 1)
+            bar = (" " * bar_from +
+                   "#" * min(bar_len, width - bar_from)).ljust(width)
+            mark = "*" if s["span_id"] in critical else " "
+            indent = "  " * _span_depth(s, by_id)
+            label = s["name"]
+            status = s.get("status")
+            if status == "ERROR":
+                label += " [ERROR]"
+            retries = s.get("attributes", {}).get("retries")
+            if retries:
+                label += f" (retries={retries})"
+            out.append(f"  {mark} +{offset:7.3f}s {s['duration_s']:8.3f}s "
+                       f"|{bar}| {indent}{label}")
+        phases = trace_phase_breakdown(spans)
+        out.append(f"    phases: queue {phases['queue']:.3f}s  "
+                   f"apf {phases['apf']:.3f}s (within wire)  "
+                   f"wire {phases['wire']:.3f}s  "
+                   f"reconcile {phases['reconcile']:.3f}s")
+    if first_start is not None:
+        out.append("")
+        out.append(f"Lifecycle: {last_end - first_start:.3f}s from first "
+                   f"dispatch to last span end (* = critical path)")
+    return "\n".join(out) + "\n"
+
+
+def cmd_trace(client, args) -> int:
+    """Fetch the manager flight recorder's traces for one notebook from
+    the health server's debug endpoint and render the timeline."""
+    import urllib.error
+    import urllib.request
+
+    ns, name = split_ref(args.name, args.namespace)
+    url = (f"{args.debug_server.rstrip('/')}"
+           f"/debug/notebooks/{ns}/{name}/trace")
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            payload = json.loads(resp.read().decode())
+    except urllib.error.HTTPError as err:
+        detail = err.read().decode(errors="replace").strip()
+        print(f"Error: HTTP {err.code} from {url}: {detail}",
+              file=sys.stderr)
+        return 1
+    except (urllib.error.URLError, OSError) as err:
+        print(f"Error: cannot reach debug server {url}: {err}",
+              file=sys.stderr)
+        return 1
+    if args.last:
+        payload["traces"] = payload.get("traces", [])[-args.last:]
+    if args.output == "json":
+        print(json.dumps(payload, indent=2))
+    else:
+        print(render_trace(payload), end="")
+    return 0
+
+
 def build_arg_parser() -> argparse.ArgumentParser:
     ap = argparse.ArgumentParser(
         prog="kubeflow-tpu", description=__doc__.splitlines()[0])
@@ -312,6 +430,16 @@ def build_arg_parser() -> argparse.ArgumentParser:
     p_watch.add_argument("resource")
     p_watch.add_argument("--timeout", type=float, default=None,
                          help="exit after N seconds (default: forever)")
+
+    p_trace = sub.add_parser(
+        "trace", help="per-notebook reconcile timeline (flight recorder)")
+    p_trace.add_argument("name", help="notebook as ns/name or name")
+    p_trace.add_argument("--debug-server", default="http://127.0.0.1:8081",
+                         help="manager health server base URL")
+    p_trace.add_argument("--last", type=int, default=0,
+                         help="show only the last N traces (0 = all)")
+    p_trace.add_argument("-o", "--output", choices=("timeline", "json"),
+                         default="timeline")
     return ap
 
 
@@ -330,7 +458,7 @@ def _dispatch(client, args) -> int:
     handler = {"apply": cmd_apply, "get": cmd_get, "delete": cmd_delete,
                "stop": cmd_stop, "resume": cmd_resume,
                "restart": cmd_restart, "describe": cmd_describe,
-               "watch": cmd_watch}[args.command]
+               "watch": cmd_watch, "trace": cmd_trace}[args.command]
     try:
         return handler(client, args)
     except ApiError as err:

@@ -239,11 +239,18 @@ class APFDispatcher:
     def acquire(self, meta: dict) -> str:
         """Block until the request holds a seat; returns the level name
         (the ticket for release()). Raises RejectedError → 429."""
+        return self.acquire_info(meta)[0]
+
+    def acquire_info(self, meta: dict) -> tuple[str, bool]:
+        """``acquire`` plus dispatch provenance: ``(ticket, queued)`` where
+        ``queued`` is True when the request sat in a priority-level queue
+        before getting its seat (what the server's ``apf.wait`` span
+        records) rather than being admitted immediately."""
         name, flow = self.classify(meta)
         level = self._levels.get(name) or self._fallback_level
         name = level.config.name  # the release ticket must name a REAL level
         if level.config.exempt:
-            return name
+            return name, False
         waiter = None
         with self._lock:
             if self._admit_locked(level):
@@ -251,7 +258,7 @@ class APFDispatcher:
                 self._total_in_flight += 1
                 if self._dispatched is not None:
                     self._dispatched.inc({"priority_level": name})
-                return name
+                return name, False
             queue = self._shuffle_queue_locked(level, flow)
             if len(queue) >= level.config.queue_length:
                 if self._rejected is not None:
@@ -262,11 +269,11 @@ class APFDispatcher:
             level.queued += 1
             self._set_inqueue(level)
         if waiter.event.wait(self.queue_wait_s):
-            return name  # dispatched by a releasing request
+            return name, True  # dispatched by a releasing request
         with self._lock:
             if waiter.admitted:
                 # the dispatch raced our timeout and won: we hold a seat
-                return name
+                return name, True
             waiter.abandoned = True  # lazily skipped at dispatch
             level.queued -= 1
             self._set_inqueue(level)

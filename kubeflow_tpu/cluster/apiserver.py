@@ -35,13 +35,15 @@ from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from ..utils import k8s, names
+from ..utils import k8s, names, tracing
 from . import apf as apf_mod
 from . import faults, restmapper
 from .errors import ApiError, ConflictError, GoneError, NotFoundError
 from .store import EventFrame, WatchEvent, _decode_continue, _encode_continue
 
 log = logging.getLogger("kubeflow_tpu.apiserver")
+
+_TRACER = tracing.get_tracer("kubeflow_tpu.apiserver")
 
 WATCH_BOOKMARK_INTERVAL_S = 10.0
 
@@ -526,6 +528,9 @@ class _Handler(BaseHTTPRequestHandler):
             "name": getattr(self, "_audit_name", None),
             "status": getattr(self, "_last_status", None),
             "peer": self.address_string(),
+            # the client's W3C trace id (traceparent header) — joins the
+            # audit trail against traces; null when tracing is off
+            "trace_id": getattr(self, "_trace_id_hex", None),
         }) + "\n"
         try:
             with self.server.audit_lock:  # type: ignore[attr-defined]
@@ -548,6 +553,19 @@ class _Handler(BaseHTTPRequestHandler):
         self._audit_path = parsed.path
         self._audit_name = None
         self._audited = False
+        # incoming W3C trace context: parsed whenever the CLIENT sent the
+        # header — the audit trail must correlate even when this server
+        # process has no recording provider of its own (the two-process
+        # production shape traces the manager, not the apiserver). Untraced
+        # clients send no header, so the hot path stays a dict miss;
+        # malformed headers restart the trace (None).
+        self._trace_id_hex = None
+        remote_ctx = None
+        traceparent = self.headers.get("traceparent")
+        if traceparent is not None:
+            remote_ctx = tracing.parse_traceparent(traceparent)
+            if remote_ctx is not None:
+                self._trace_id_hex = f"{remote_ctx.trace_id:032x}"
         latency = getattr(self.server, "latency_s", 0.0)
         if latency:
             # emulated network+processing round trip (ApiServerProxy
@@ -615,21 +633,36 @@ class _Handler(BaseHTTPRequestHandler):
         # the standard flow-control path every client verb retries.
         dispatcher = getattr(self.server, "apf", None)
         apf_ticket = None
-        if dispatcher is not None and not is_watch:
+        rec = tracing.is_recording()
+        # server-side root for this request, parented on the client's wire
+        # span via traceparent — one trace covers client retries, APF
+        # queueing, and the handler (a shared no-op context manager when
+        # tracing is off, so nothing is allocated)
+        with _TRACER.start_span(
+                "apiserver.request",
+                {"http.method": method, "k8s.verb": verb,
+                 "k8s.kind": route.mapping.kind} if rec else None,
+                parent=remote_ctx):
+            if dispatcher is not None and not is_watch:
+                try:
+                    with _TRACER.start_span("apf.wait") as apf_span:
+                        apf_ticket, apf_queued = dispatcher.acquire_info(
+                            {"user_agent": self.headers.get("User-Agent", ""),
+                             "verb": verb, "kind": route.mapping.kind})
+                        if rec:
+                            apf_span.set_attribute("apf.priority_level",
+                                                   apf_ticket)
+                            apf_span.set_attribute("apf.queued", apf_queued)
+                except apf_mod.RejectedError as err:
+                    self._send_error_status(429, "TooManyRequests", str(err),
+                                            retry_after_s=err.retry_after_s)
+                    return
             try:
-                apf_ticket = dispatcher.acquire(
-                    {"user_agent": self.headers.get("User-Agent", ""),
-                     "verb": verb, "kind": route.mapping.kind})
-            except apf_mod.RejectedError as err:
-                self._send_error_status(429, "TooManyRequests", str(err),
-                                        retry_after_s=err.retry_after_s)
-                return
-        try:
-            self._dispatch_admitted(method, route, parsed, qs, verb,
-                                    is_watch, reset_rule)
-        finally:
-            if apf_ticket is not None:
-                dispatcher.release(apf_ticket)
+                self._dispatch_admitted(method, route, parsed, qs, verb,
+                                        is_watch, reset_rule)
+            finally:
+                if apf_ticket is not None:
+                    dispatcher.release(apf_ticket)
 
     def _dispatch_admitted(self, method: str, route: _Route, parsed,
                            qs: dict, verb: str, is_watch: bool,
@@ -640,6 +673,13 @@ class _Handler(BaseHTTPRequestHandler):
         if plan is not None:
             rule = plan.decide(verb, route.mapping.kind)
             if rule is not None:
+                if tracing.is_recording():
+                    # fault provenance on the server span: a trace through
+                    # an injected 503/reset shows WHY the wire call failed
+                    tracing.current_span().add_event(
+                        "fault-injected", {"fault": rule.fault,
+                                           "verb": verb,
+                                           "kind": route.mapping.kind})
                 if rule.fault == faults.FAULT_LATENCY:
                     time.sleep(rule.latency_s)
                 elif rule.fault == faults.FAULT_WATCH_KILL:
@@ -678,10 +718,11 @@ class _Handler(BaseHTTPRequestHandler):
         # wrong for a passthrough)
         self._raw_query = parsed.query
         try:
-            if reset_rule is not None:
-                self._serve_then_reset(method, route, query)
-            else:
-                getattr(self, f"_handle_{method}")(route, query)
+            with _TRACER.start_span("apiserver.handle"):
+                if reset_rule is not None:
+                    self._serve_then_reset(method, route, query)
+                else:
+                    getattr(self, f"_handle_{method}")(route, query)
         except ApiError as err:
             self._send_api_error(err)
         except BrokenPipeError:

@@ -58,7 +58,7 @@ import urllib.error
 from dataclasses import dataclass
 from urllib.parse import quote, urlencode, urlsplit
 
-from ..utils import k8s
+from ..utils import k8s, tracing
 from . import restmapper
 from .errors import (AlreadyExistsError, ApiError, ConflictError,
                      ForbiddenError, GoneError, InvalidError, NotFoundError,
@@ -67,7 +67,22 @@ from .store import WatchEvent
 
 log = logging.getLogger("kubeflow_tpu.http_client")
 
+_TRACER = tracing.get_tracer("kubeflow_tpu.http_client")
+
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def _resource_from_path(path: str) -> str:
+    """Resource plural out of an API path for span attributes —
+    ``/apis/g/v1/namespaces/ns/notebooks/name`` → ``notebooks``;
+    best-effort (attribute metadata, never load-bearing)."""
+    parts = path.split("?", 1)[0].strip("/").split("/")
+    try:
+        i = parts.index("namespaces")
+        return parts[i + 2] if len(parts) > i + 2 else parts[-1]
+    except ValueError:
+        return parts[3] if parts[:1] == ["apis"] and len(parts) > 3 \
+            else parts[-1]
 
 _ERROR_BY_REASON = {
     "NotFound": NotFoundError,
@@ -389,6 +404,12 @@ class HttpApiClient:
             headers["Content-Type"] = content_type
         if self.token:
             headers["Authorization"] = f"Bearer {self.token}"
+        # W3C trace-context propagation: every request carries the active
+        # span's identity so the server's spans (APF wait, handler) and the
+        # audit trail join the client's trace; None when tracing is off
+        ctx = tracing.current_context()
+        if ctx is not None:
+            headers["traceparent"] = tracing.format_traceparent(ctx)
         timeout = timeout or self.timeout
         url_path = self._addr[3] + path
         for attempt in (0, 1):
@@ -437,6 +458,10 @@ class HttpApiClient:
             self._tl.resp = resp  # reuse gate for the next checkout
         else:
             resp._kt_conn = conn  # the stream's teardown closes it
+        if ctx is not None:
+            # the innermost span here is _json's wire span (a noop sink on
+            # untraced paths like watch streams)
+            tracing.current_span().set_attribute("http.status", resp.status)
         if resp.status >= 400:
             payload = resp.read()  # frees the conn for reuse
             self._mark_drained(resp)
@@ -464,7 +489,8 @@ class HttpApiClient:
     def _observe_duration(self, method: str, started: float) -> None:
         if self._duration_metric is not None:
             self._duration_metric.observe(time.monotonic() - started,
-                                          {"verb": method})
+                                          {"verb": method},
+                                          exemplar=tracing.current_exemplar())
 
     def _health_ok(self) -> None:
         tracker = self._health_tracker
@@ -562,6 +588,31 @@ class HttpApiClient:
               content_type: str = "application/json",
               retry_transport: bool | None = None,
               validate=None) -> dict:
+        """One logical request with the RetryPolicy applied — see
+        ``_json_impl``. When tracing records, each logical request gets one
+        wire span (verb/resource/code/retries, retry attempts as events);
+        the untraced path calls ``_json_impl`` directly, bypassing span
+        setup entirely."""
+        if not tracing.is_recording():
+            return self._json_impl(method, path, body, content_type,
+                                   retry_transport, validate)
+        with _TRACER.start_span(
+                f"rest.{method.lower()}",
+                {"http.method": method, "http.path": path.split("?", 1)[0],
+                 "k8s.resource": _resource_from_path(path)}) as span:
+            try:
+                out = self._json_impl(method, path, body, content_type,
+                                      retry_transport, validate)
+                span.set_status(tracing.STATUS_OK)
+                return out
+            except ApiError as err:
+                span.set_attribute("http.status", err.code)
+                raise
+
+    def _json_impl(self, method: str, path: str, body: dict | None = None,
+                   content_type: str = "application/json",
+                   retry_transport: bool | None = None,
+                   validate=None) -> dict:
         """One logical request with the RetryPolicy applied. Transport
         retries default to the idempotent verbs; create() opts named POSTs
         in explicitly. Errors surfacing on a retry after an ambiguous
@@ -630,6 +681,9 @@ class HttpApiClient:
                         self._retry_rng.uniform(policy.backoff_base_s,
                                                 delay * 3))
             self._count_retry(method, reason)
+            span = tracing.current_span()  # noop sink when untraced
+            span.add_event("retry", {"attempt": attempt, "reason": reason})
+            span.set_attribute("retries", attempt)
             # the cap applies to COMPUTED backoff only — a server-sent
             # Retry-After is pacing we must honor (bounded for sanity)
             if self._stopped.wait(min(wait, 30.0)):

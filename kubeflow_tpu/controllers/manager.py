@@ -79,10 +79,13 @@ from dataclasses import dataclass, field
 from typing import Callable, Protocol
 
 from ..cluster.store import WatchEvent
-from ..utils import k8s
+from ..utils import k8s, names, tracing
+from ..utils import logging as logging_mod
 from ..utils import metrics as metrics_mod
 
 log = logging.getLogger("kubeflow_tpu.manager")
+
+_TRACER = tracing.get_tracer("kubeflow_tpu.manager")
 
 
 @dataclass(frozen=True)
@@ -156,6 +159,14 @@ class Manager:
         # saturated backlog on every wake; spliced back when a slot frees
         self._capped: dict[str, list[_QueueItem]] = {}
         self._failures: dict[tuple[str, Request], int] = {}
+        # trace carry (populated only while a recording provider is
+        # installed): key → (SpanContext|None, kind, event type, wall ts of
+        # the triggering watch delivery). Written by watch callbacks,
+        # consumed (popped) when the key dispatches, so a queued key's
+        # reconcile root span joins the trace that caused it. Coalesced
+        # events overwrite — the reconcile observes the LAST cause, the
+        # level-triggered analog of event coalescing.
+        self._key_trace: dict[tuple[str, Request], tuple] = {}
         self._cv = threading.Condition()
         self._seq = 0
         self._running = False
@@ -329,8 +340,26 @@ class Manager:
                 return
             reqs = (mapper(event.obj) if mapper is not None
                     else [Request(k8s.namespace(event.obj), k8s.name(event.obj))])
+            trace_info = None
+            if tracing.is_recording():
+                # delivery→mapper→enqueue provenance: the object's carried
+                # trace context (annotation) plus what triggered this
+                # enqueue — surfaced as workqueue.enqueue/wait spans when
+                # the key dispatches
+                ann = (event.obj.get("metadata") or {}) \
+                    .get("annotations") or {}
+                trace_info = (
+                    tracing.parse_traceparent(
+                        ann.get(names.TRACE_CONTEXT_ANNOTATION)),
+                    kind, event.type, time.time())
             for req in reqs:
-                self.enqueue(controller, req)
+                # kwarg only when tracing: the untraced call shape stays
+                # exactly what it was (tests spy on enqueue with the old
+                # positional signature)
+                if trace_info is not None:
+                    self.enqueue(controller, req, trace_info=trace_info)
+                else:
+                    self.enqueue(controller, req)
         self._watch_specs.append((kind, controller, mapper, predicate))
         self.client.watch(kind, cb)
         if cache is not None:
@@ -364,7 +393,8 @@ class Manager:
         return self.resync_all(
             namespace_filter=lambda ns: shard_map.shard_for(ns) in shards)
 
-    def enqueue(self, controller: str, req: Request, after: float = 0.0) -> None:
+    def enqueue(self, controller: str, req: Request, after: float = 0.0,
+                trace_info: tuple | None = None) -> None:
         if self.sharding is not None and \
                 not self.sharding.owns_namespace(req.namespace):
             return  # foreign-shard key: its owner's watches will queue it
@@ -372,6 +402,8 @@ class Manager:
             if self._wq_adds is not None:
                 self._wq_adds.inc({"name": controller})
             key = (controller, req)
+            if trace_info is not None:
+                self._key_trace[key] = trace_info
             if after == 0.0:
                 if key in self._processing:
                     # in-flight: mark dirty; _finish re-enqueues exactly once
@@ -543,10 +575,20 @@ class Manager:
                     self._processing[(found.controller, found.req)] = started
                     self._active[found.controller] = \
                         self._active.get(found.controller, 0) + 1
+                    queue_wait = max(started - found.ready_at, 0.0)
+                    found.queue_wait = queue_wait  # read by the trace wrapper
                     if self._wq_queue_duration is not None:
+                        exemplar = None
+                        if tracing.is_recording():
+                            carried = self._key_trace.get(
+                                (found.controller, found.req))
+                            ctx = carried[0] if carried else None
+                            if ctx is not None:
+                                exemplar = {
+                                    "trace_id": f"{ctx.trace_id:032x}"}
                         self._wq_queue_duration.observe(
-                            max(started - found.ready_at, 0.0),
-                            {"name": found.controller})
+                            queue_wait, {"name": found.controller},
+                            exemplar=exemplar)
                     return found
                 if not block or not self._running:
                     return None
@@ -611,12 +653,75 @@ class Manager:
     def _observe_phases(self, controller: str) -> None:
         phases = metrics_mod.phase_collect_finish()
         if self._read_seconds is not None:
+            exemplar = tracing.current_exemplar()
             self._read_seconds.observe(phases.get("read", 0.0),
-                                       {"controller": controller})
+                                       {"controller": controller},
+                                       exemplar=exemplar)
             self._write_seconds.observe(phases.get("write", 0.0),
-                                        {"controller": controller})
+                                        {"controller": controller},
+                                        exemplar=exemplar)
+        if tracing.is_recording():
+            # phase-collector child spans: read/write TOTALS are exact;
+            # their placement (write ending at now, read just before) is
+            # an approximation — the collector sums interleaved verb
+            # durations, it doesn't record intervals
+            now = time.time()
+            read_s = phases.get("read", 0.0)
+            write_s = phases.get("write", 0.0)
+            if write_s > 0.0:
+                _TRACER.emit_span("reconcile.write", now - write_s, now,
+                                  {"controller": controller})
+            if read_s > 0.0:
+                _TRACER.emit_span("reconcile.read", now - write_s - read_s,
+                                  now - write_s,
+                                  {"controller": controller})
 
     def _process(self, item: _QueueItem) -> None:
+        """Reconcile one dispatched item. The untraced path goes straight
+        to ``_reconcile_item``; with a recording provider this opens the
+        reconcile root span (parented on the trace context the triggering
+        watch event carried), backdates it over the queue wait, and emits
+        the workqueue.enqueue/workqueue.wait child spans that make
+        serialization delay visible."""
+        key_token = logging_mod.reconcile_key_var.set(
+            f"{item.req.namespace}/{item.req.name}")
+        try:
+            if not tracing.is_recording():
+                self._reconcile_item(item)
+                return
+            with self._cv:
+                carried = self._key_trace.pop((item.controller, item.req),
+                                              None)
+            parent, kind, event_type, delivered_at = \
+                carried if carried is not None else (None, None, None, None)
+            now = time.time()
+            queue_wait = getattr(item, "queue_wait", 0.0)
+            wait_start = now - queue_wait
+            with _TRACER.start_span(
+                    "reconcile",
+                    {"controller": item.controller,
+                     "k8s.namespace": item.req.namespace,
+                     "k8s.name": item.req.name,
+                     tracing.KEY_ATTRIBUTE:
+                         f"{item.req.namespace}/{item.req.name}"},
+                    parent=parent) as span:
+                # the root covers the full dispatch cycle: backdate it to
+                # the watch delivery (or queue-ready time) so queue wait
+                # is inside the trace, not a gap before it
+                span.start_time = min(delivered_at or wait_start, wait_start)
+                if delivered_at is not None:
+                    _TRACER.emit_span(
+                        "workqueue.enqueue", delivered_at, wait_start,
+                        {"k8s.kind": kind, "event": event_type,
+                         "controller": item.controller})
+                _TRACER.emit_span(
+                    "workqueue.wait", wait_start, now,
+                    {"controller": item.controller})
+                self._reconcile_item(item)
+        finally:
+            logging_mod.reconcile_key_var.reset(key_token)
+
+    def _reconcile_item(self, item: _QueueItem) -> None:
         rec = self._reconcilers.get(item.controller)
         if rec is None:
             return
@@ -650,12 +755,14 @@ class Manager:
                 backoff = max(backoff, self.rate_limiter.next_delay())
             log.warning("reconcile %s %s failed (%s); requeue in %.3fs",
                         item.controller, item.req, exc, backoff)
+            tracing.current_span().record_exception(exc)
             self._count_reconcile(item.controller, "error")
             if self._wq_retries is not None:
                 self._wq_retries.inc({"name": item.controller})
             if self._wq_work_duration is not None:
-                self._wq_work_duration.observe(time.monotonic() - started,
-                                               {"name": item.controller})
+                self._wq_work_duration.observe(
+                    time.monotonic() - started, {"name": item.controller},
+                    exemplar=tracing.current_exemplar())
             self._observe_phases(item.controller)
             self.enqueue(item.controller, item.req, after=backoff)
             return
@@ -668,8 +775,9 @@ class Manager:
         else:
             self._count_reconcile(item.controller, "success")
         if self._wq_work_duration is not None:
-            self._wq_work_duration.observe(time.monotonic() - started,
-                                           {"name": item.controller})
+            self._wq_work_duration.observe(
+                time.monotonic() - started, {"name": item.controller},
+                exemplar=tracing.current_exemplar())
         self._observe_phases(item.controller)
 
     def run_until_idle(self, timeout: float = 30.0,

@@ -32,7 +32,7 @@ from ..api import types as api
 from ..cluster import errors, events
 from ..cluster.cache import owned_objects
 from ..tpu.topology import SliceSpec, parse_slice_request
-from ..utils import drift, k8s, names
+from ..utils import drift, k8s, names, tracing
 from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
 from .manager import Manager, Request, Result, owner_mapper
@@ -72,6 +72,11 @@ class NotebookReconciler:
         # stamps a BindTimeout miss and cold-rolls (in-memory is fine: a
         # restarted controller re-arming the grace window is correct)
         self._pool_pending_since: dict[tuple[str, str], float] = {}
+        # (ns, name) → traceparent already stamped by THIS process: dedups
+        # the trace-context annotation write across the reconciles that
+        # race the stamp's own watch echo (telemetry only; populated only
+        # while a recording tracing provider is installed)
+        self._stamped_traces: dict[tuple[str, str], str] = {}
 
     # ------------------------------------------------------------- wiring
     def setup(self, mgr: Manager) -> None:
@@ -181,8 +186,9 @@ class NotebookReconciler:
         notebook = self.client.get_or_none(api.KIND, req.namespace, req.name)
         if notebook is None:
             # a notebook deleted while waiting for a bind must not leak
-            # its grace-window entry
+            # its grace-window entry (nor its stamped-trace dedup entry)
             self._pool_pending_since.pop((req.namespace, req.name), None)
+            self._stamped_traces.pop((req.namespace, req.name), None)
             event = self.client.get_or_none(events.EVENT_KIND, req.namespace,
                                             req.name)
             if event is not None:
@@ -192,6 +198,7 @@ class NotebookReconciler:
             # upstream reconciler no-ops on deletion (reference :138-140);
             # owner-reference GC reaps STS/Service
             return None
+        self._stamp_trace_context(notebook)
 
         slice_spec = parse_slice_request(
             k8s.get_in(notebook, "metadata", "annotations", default={}))
@@ -247,6 +254,40 @@ class NotebookReconciler:
             "Reissued from %s/%s: %s" % (
                 str(involved.get("kind", "")).lower(),
                 involved.get("name", ""), event.get("message", "")))
+
+    def _stamp_trace_context(self, notebook: dict) -> None:
+        """Anchor the notebook's lifecycle trace: while a recording tracing
+        provider is installed, write the current reconcile root span's
+        traceparent onto the CR (TRACE_CONTEXT_ANNOTATION) the first time
+        this notebook is reconciled without one. Every later actor — this
+        reconciler's next pass, slicepool bind, slicerepair migration —
+        parents its spans on the carried context, stitching the CR→Ready
+        story into one trace. Pure telemetry: no-ops (and costs nothing)
+        when tracing is off, and a failed stamp never fails the
+        reconcile."""
+        if not tracing.is_recording():
+            return
+        if k8s.get_annotation(notebook,
+                              names.TRACE_CONTEXT_ANNOTATION) is not None:
+            return
+        key = (k8s.namespace(notebook), k8s.name(notebook))
+        if key in self._stamped_traces:
+            # stamped by an earlier pass whose watch echo hasn't landed in
+            # the cache yet — restamping would fork the lifecycle trace
+            return
+        ctx = tracing.current_context()
+        if ctx is None:
+            return  # no root span (reconciler driven outside a manager)
+        header = tracing.format_traceparent(ctx)
+        self._stamped_traces[key] = header
+        try:
+            self.client.patch(api.KIND, key[0], key[1], {
+                "metadata": {"annotations": {
+                    names.TRACE_CONTEXT_ANNOTATION: header}}})
+        except errors.ApiError as exc:
+            self._stamped_traces.pop(key, None)
+            log.debug("trace-context stamp for %s/%s failed: %s",
+                      key[0], key[1], exc)
 
     # ----------------------------------------------------- warm-pool seams
     def _pool_bind_gate(self, notebook: dict,
@@ -375,8 +416,9 @@ class NotebookReconciler:
                        names.TPU_TOPOLOGY_ANNOTATION):
                 continue  # slice identity lives in labels/env, not pod annotations
             if key in names.SLICE_REPAIR_ANNOTATIONS or \
-                    key in names.POOL_ANNOTATIONS:
-                # repair/pool bookkeeping would churn the pod template
+                    key in names.POOL_ANNOTATIONS or \
+                    key == names.TRACE_CONTEXT_ANNOTATION:
+                # repair/pool/trace bookkeeping would churn the pod template
                 # (every health or bind transition a spurious template
                 # drift → rolling restart) — it describes the slice's
                 # lifecycle, not the pods

@@ -49,13 +49,24 @@ from ..api import slicepool as pool_api
 from ..api import types as api
 from ..cluster import errors, events
 from ..tpu.topology import SliceSpec, parse_short_name
-from ..utils import k8s, names
+from ..utils import k8s, names, tracing
 from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
 from .manager import Manager, Request, Result
 from .slicerepair import node_problem
 
 log = logging.getLogger("kubeflow_tpu.slicepool")
+
+_TRACER = tracing.get_tracer("kubeflow_tpu.slicepool")
+
+
+def notebook_trace_parent(notebook: dict) -> tracing.SpanContext | None:
+    """The notebook's carried lifecycle-trace context
+    (TRACE_CONTEXT_ANNOTATION), or None — cross-controller spans (bind,
+    migration) parent on it so the CR→Ready trace stitches through them;
+    None falls back to the calling reconcile's own span stack."""
+    return tracing.parse_traceparent(
+        k8s.get_annotation(notebook, names.TRACE_CONTEXT_ANNOTATION))
 
 POOL_STATES = (names.POOL_STATE_WARMING, names.POOL_STATE_WARM,
                names.POOL_STATE_BOUND, names.POOL_STATE_DRAINING)
@@ -744,6 +755,25 @@ class SlicePoolReconciler:
 
     def _bind(self, pool: dict, notebook: dict, sts: dict,
               slice_spec: SliceSpec, pool_ns: str) \
+            -> tuple[dict, dict, str] | None:
+        """``_bind_inner`` wrapped in a ``pool.bind`` span parented on the
+        notebook's carried trace context — the bind leg of the stitched
+        CR→Ready trace. Untraced runs skip straight through."""
+        if not tracing.is_recording():
+            return self._bind_inner(pool, notebook, sts, slice_spec, pool_ns)
+        with _TRACER.start_span(
+                "pool.bind",
+                {"pool": k8s.name(pool),
+                 "k8s.namespace": k8s.namespace(notebook),
+                 "k8s.name": k8s.name(notebook),
+                 "slice": f"{pool_ns}/{k8s.name(sts)}"},
+                parent=notebook_trace_parent(notebook)) as span:
+            out = self._bind_inner(pool, notebook, sts, slice_spec, pool_ns)
+            span.set_attribute("bound", out is not None)
+            return out
+
+    def _bind_inner(self, pool: dict, notebook: dict, sts: dict,
+                    slice_spec: SliceSpec, pool_ns: str) \
             -> tuple[dict, dict, str] | None:
         """The bind itself: slice-side annotations/labels (+ identity
         adoption when the notebook already HAS a mesh identity from a

@@ -49,7 +49,7 @@ from ..api import slicepool as pool_api
 from ..api import types as api
 from ..cluster import events
 from ..tpu.topology import SliceSpec, TpuRequestError, parse_slice_request
-from ..utils import k8s, names
+from ..utils import k8s, names, tracing
 from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
 from .manager import Manager, Request, Result
@@ -59,6 +59,8 @@ MIGRATION_BINDING = "Binding"
 MIGRATION_RESUMING = "Resuming"
 
 log = logging.getLogger("kubeflow_tpu.slicerepair")
+
+_TRACER = tracing.get_tracer("kubeflow_tpu.slicerepair")
 
 HEALTHY = None  # annotation absent
 DEGRADED = "Degraded"
@@ -351,6 +353,23 @@ class SliceRepairReconciler:
         return None
 
     # ---------------------------------------------------------- migration
+    def _migration_span(self, notebook: dict, phase: str,
+                        attributes: dict | None = None):
+        """Span for one migration leg, parented on the notebook's carried
+        lifecycle-trace context (TRACE_CONTEXT_ANNOTATION) so the stitched
+        CR trace shows WHY a notebook went un-Ready and how long each
+        migration phase took. A shared no-op context manager when tracing
+        is off."""
+        if not tracing.is_recording():
+            return _TRACER.start_span(phase)  # no-op CM, zero alloc
+        parent = tracing.parse_traceparent(
+            k8s.get_annotation(notebook, names.TRACE_CONTEXT_ANNOTATION))
+        attrs = {"k8s.namespace": k8s.namespace(notebook),
+                 "k8s.name": k8s.name(notebook)}
+        attrs.update(attributes or {})
+        return _TRACER.start_span(f"repair.migrate.{phase}", attrs,
+                                  parent=parent)
+
     def _reconcile_migration(self, notebook: dict, slice_spec: SliceSpec,
                              bound: tuple[str, str] | None,
                              mstate: str | None,
@@ -405,14 +424,17 @@ class SliceRepairReconciler:
                     notebook, events.TYPE_WARNING, "SliceDegraded",
                     f"bound slice degraded ({reason}): {detail}")
             # persist the migration intent FIRST, then checkpoint
-            self._patch(notebook, {
-                names.MIGRATION_STATE_ANNOTATION: MIGRATION_CHECKPOINTING,
-                names.MIGRATION_STARTED_AT_ANNOTATION: "%.3f" % now,
-            })
-            self.recorder.eventf(
-                notebook, events.TYPE_NORMAL, "NotebookMigrationStarted",
-                f"checkpointing runtime off degraded slice "
-                f"{bound[0]}/{bound[1]} ({reason})")
+            with self._migration_span(notebook, "start",
+                                      {"reason": reason}):
+                self._patch(notebook, {
+                    names.MIGRATION_STATE_ANNOTATION:
+                        MIGRATION_CHECKPOINTING,
+                    names.MIGRATION_STARTED_AT_ANNOTATION: "%.3f" % now,
+                })
+                self.recorder.eventf(
+                    notebook, events.TYPE_NORMAL, "NotebookMigrationStarted",
+                    f"checkpointing runtime off degraded slice "
+                    f"{bound[0]}/{bound[1]} ({reason})")
             mstate = MIGRATION_CHECKPOINTING
 
         started_raw = k8s.get_annotation(
@@ -431,25 +453,27 @@ class SliceRepairReconciler:
                 else "NoWarmSlice")
 
         if mstate == MIGRATION_CHECKPOINTING:
-            try:
-                token = self.migrator.checkpoint(self.client, notebook)
-            except Exception as exc:  # noqa: BLE001 — any checkpoint
-                # failure (driver bug, unreadable state) must degrade to
-                # the cold roll, never wedge the notebook mid-migration
-                log.warning("checkpoint for %s/%s failed: %s",
-                            key[0], key[1], exc)
-                return self._migration_fallback(notebook, key,
-                                               "CheckpointFailed")
-            # unbind: the pool controller drains/replaces the old slice
-            # and re-binds us (migration re-binds queue first) under the
-            # SAME slice-identity — TPU_WORKER_HOSTNAMES is preserved by
-            # construction
-            self._patch(notebook, {
-                names.MIGRATION_STATE_ANNOTATION: MIGRATION_BINDING,
-                names.CHECKPOINT_TOKEN_ANNOTATION: token,
-                names.BOUND_SLICE_ANNOTATION: None,
-                names.BOUND_POOL_ANNOTATION: None,
-            })
+            with self._migration_span(notebook, "checkpoint") as span:
+                try:
+                    token = self.migrator.checkpoint(self.client, notebook)
+                except Exception as exc:  # noqa: BLE001 — any checkpoint
+                    # failure (driver bug, unreadable state) must degrade to
+                    # the cold roll, never wedge the notebook mid-migration
+                    log.warning("checkpoint for %s/%s failed: %s",
+                                key[0], key[1], exc)
+                    span.record_exception(exc)
+                    return self._migration_fallback(notebook, key,
+                                                   "CheckpointFailed")
+                # unbind: the pool controller drains/replaces the old slice
+                # and re-binds us (migration re-binds queue first) under the
+                # SAME slice-identity — TPU_WORKER_HOSTNAMES is preserved by
+                # construction
+                self._patch(notebook, {
+                    names.MIGRATION_STATE_ANNOTATION: MIGRATION_BINDING,
+                    names.CHECKPOINT_TOKEN_ANNOTATION: token,
+                    names.BOUND_SLICE_ANNOTATION: None,
+                    names.BOUND_POOL_ANNOTATION: None,
+                })
             return poll
 
         if mstate == MIGRATION_BINDING:
@@ -468,22 +492,28 @@ class SliceRepairReconciler:
                 return poll
             token = k8s.get_annotation(
                 notebook, names.CHECKPOINT_TOKEN_ANNOTATION) or ""
-            try:
-                self.migrator.resume(self.client, notebook, token)
-            except Exception as exc:  # noqa: BLE001 — same contract as
-                # checkpoint: fall back rather than wedge
-                log.warning("resume for %s/%s failed: %s",
-                            key[0], key[1], exc)
-                return self._migration_fallback(notebook, key,
-                                               "ResumeFailed")
-            duration = max(now - started, 0.0)
-            self._patch(notebook, {
-                names.MIGRATION_STATE_ANNOTATION: None,
-                names.MIGRATION_STARTED_AT_ANNOTATION: None,
-                names.CHECKPOINT_TOKEN_ANNOTATION: None,
-                names.SLICE_HEALTH_ANNOTATION: None,
-                names.SLICE_HEALTH_REASON_ANNOTATION: None,
-            })
+            with self._migration_span(
+                    notebook, "resume",
+                    {"slice": f"{bound[0]}/{bound[1]}"}) as span:
+                try:
+                    self.migrator.resume(self.client, notebook, token)
+                except Exception as exc:  # noqa: BLE001 — same contract as
+                    # checkpoint: fall back rather than wedge
+                    log.warning("resume for %s/%s failed: %s",
+                                key[0], key[1], exc)
+                    span.record_exception(exc)
+                    return self._migration_fallback(notebook, key,
+                                                   "ResumeFailed")
+                duration = max(now - started, 0.0)
+                self._patch(notebook, {
+                    names.MIGRATION_STATE_ANNOTATION: None,
+                    names.MIGRATION_STARTED_AT_ANNOTATION: None,
+                    names.CHECKPOINT_TOKEN_ANNOTATION: None,
+                    names.SLICE_HEALTH_ANNOTATION: None,
+                    names.SLICE_HEALTH_REASON_ANNOTATION: None,
+                })
+                span.set_attribute("migration.duration_s",
+                                   round(duration, 3))
             self._reset_backoff(key)
             self.migrations_total.inc({"outcome": "success"})
             self.recorder.eventf(

@@ -206,6 +206,12 @@ def build_arg_parser() -> argparse.ArgumentParser:
                          "(POSTs {URL}/v1/traces, like the reference's "
                          "OTel webhook instrumentation); absent → the "
                          "no-op provider")
+    ap.add_argument("--trace-debug", action="store_true",
+                    help="record reconcile traces in the in-process flight "
+                         "recorder (last traces per notebook) and serve "
+                         "them at /debug/notebooks/<ns>/<name>/trace on "
+                         "the health port — no collector needed; combines "
+                         "with --otlp-endpoint (recorder tees to OTLP)")
     return ap
 
 
@@ -229,11 +235,21 @@ def main(argv=None) -> int:
     setup_logging(debug=args.debug_log, fmt=args.log_format)
 
     otlp = None
-    if args.otlp_endpoint:
+    recorder = None
+    if args.otlp_endpoint or args.trace_debug:
         from .utils import tracing
-        otlp = tracing.OtlpHttpExporter(args.otlp_endpoint)
-        tracing.set_provider(tracing.SDKProvider(otlp))
-        log.info("tracing: OTLP export to %s", args.otlp_endpoint)
+        if args.otlp_endpoint:
+            otlp = tracing.OtlpHttpExporter(args.otlp_endpoint)
+        exporter = otlp
+        if args.trace_debug:
+            # flight recorder in front; tees every span to OTLP when both
+            # are requested
+            recorder = tracing.FlightRecorder(inner=otlp)
+            exporter = recorder
+        tracing.set_provider(tracing.SDKProvider(exporter))
+        log.info("tracing: otlp=%s flight_recorder=%s",
+                 args.otlp_endpoint or "off",
+                 "on" if recorder is not None else "off")
 
     client = build_client_from_args(args)
     mgr, shutdown = build_manager(
@@ -246,6 +262,10 @@ def main(argv=None) -> int:
         max_concurrent_reconciles=args.max_concurrent_reconciles,
         shards=args.shards,
         simulate_kubelet=args.simulate_kubelet and client is None)
+
+    if recorder is not None and mgr.health_server is not None:
+        # the cli.py `trace` subcommand reads this endpoint
+        mgr.health_server.flight_recorder = recorder
 
     apiserver = None
     if args.serve_apiserver is not None:

@@ -11,11 +11,15 @@ One stdlib HTTP server provides all three paths:
 - ``/healthz`` — process liveness: 200 while the manager loop is alive;
 - ``/readyz``  — readiness: 200 once every registered check passes (e.g.
   webhook server listening, informers synced);
-- ``/metrics`` — Prometheus text exposition from the MetricsRegistry.
+- ``/metrics`` — Prometheus text exposition from the MetricsRegistry;
+- ``/debug/notebooks/<ns>/<name>/trace`` — the flight recorder's last
+  lifecycle traces for one notebook as JSON (the ``cli.py trace`` data
+  source). 404 when no recorder is attached or no trace is held.
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -26,8 +30,11 @@ log = logging.getLogger("kubeflow_tpu.health")
 
 class HealthServer:
     def __init__(self, metrics_registry=None, host: str = "0.0.0.0",
-                 port: int = 0) -> None:
+                 port: int = 0, flight_recorder=None) -> None:
         self.metrics_registry = metrics_registry
+        # tracing.FlightRecorder (or None): serves the per-notebook
+        # timeline debug endpoint
+        self.flight_recorder = flight_recorder
         self._checks: dict[str, Callable[[], bool]] = {}
         self._ready_checks: dict[str, Callable[[], bool]] = {}
         self._lock = threading.Lock()
@@ -91,7 +98,27 @@ class HealthServer:
                 return 404, "no metrics registry\n", "text/plain"
             return 200, self.metrics_registry.expose(), \
                 "text/plain; version=0.0.4"
+        if path.startswith("/debug/notebooks/"):
+            return self._get_trace(path)
         return 404, "not found\n", "text/plain"
+
+    def _get_trace(self, path: str) -> tuple[int, str, str]:
+        """``/debug/notebooks/<ns>/<name>/trace`` → the recorder's held
+        traces for that notebook, newest last."""
+        if self.flight_recorder is None:
+            return 404, "no flight recorder attached\n", "text/plain"
+        parts = path.strip("/").split("/")
+        # ["debug", "notebooks", ns, name, "trace"]
+        if len(parts) != 5 or parts[4] != "trace":
+            return 404, "not found\n", "text/plain"
+        namespace, name = parts[2], parts[3]
+        traces = self.flight_recorder.trace_for(namespace, name)
+        if not traces:
+            return (404, f"no traces recorded for {namespace}/{name}\n",
+                    "text/plain")
+        body = json.dumps({"namespace": namespace, "name": name,
+                           "traces": traces}, indent=2) + "\n"
+        return 200, body, "application/json"
 
     # ------------------------------------------------------------ lifecycle
     @property

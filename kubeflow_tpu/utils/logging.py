@@ -9,9 +9,32 @@ shape log pipelines expect from controller pods.
 
 from __future__ import annotations
 
+import contextvars
 import json
 import logging
 import time
+
+from . import tracing
+
+# The reconcile key ("namespace/name") of the item a worker thread is
+# currently processing — set by Manager._process, read by the correlation
+# filter so every log line emitted mid-reconcile names its object.
+reconcile_key_var: contextvars.ContextVar[str | None] = \
+    contextvars.ContextVar("kubeflow_tpu_reconcile_key", default=None)
+
+
+class CorrelationFilter(logging.Filter):
+    """Stamps trace_id/span_id (from the active tracing span) and the
+    current reconcile key onto each record so JSON logs join against
+    traces. Always passes the record through; attributes are None when
+    there is nothing to correlate (tracing off, non-worker thread)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        ctx = tracing.current_context()
+        record.trace_id = f"{ctx.trace_id:032x}" if ctx else None
+        record.span_id = f"{ctx.span_id:016x}" if ctx else None
+        record.reconcile_key = reconcile_key_var.get()
+        return True
 
 
 class JsonFormatter(logging.Formatter):
@@ -25,6 +48,10 @@ class JsonFormatter(logging.Formatter):
             "logger": record.name,
             "msg": record.getMessage(),
         }
+        for key in ("trace_id", "span_id", "reconcile_key"):
+            value = getattr(record, key, None)
+            if value is not None:
+                entry[key] = value
         if record.exc_info:
             entry["error"] = self.formatException(record.exc_info)
         return json.dumps(entry)
@@ -37,6 +64,9 @@ def setup_logging(debug: bool = False, fmt: str = "text") -> None:
         root.removeHandler(handler)
     handler = logging.StreamHandler()
     if fmt == "json":
+        # correlation rides on the JSON handler only — the text format's
+        # line shape (and any tests pinning it) stays byte-identical
+        handler.addFilter(CorrelationFilter())
         handler.setFormatter(JsonFormatter())
     else:
         formatter = logging.Formatter(

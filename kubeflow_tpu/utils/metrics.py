@@ -15,6 +15,34 @@ import time
 from typing import Callable
 
 
+def _escape_label_value(value: object) -> str:
+    """Prometheus exposition escaping for label values: backslash, double
+    quote, and line feed must be escaped (in that order — escaping the
+    backslash first keeps the other two unambiguous)."""
+    return (str(value).replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP lines escape backslash and line feed (quotes are legal there)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _format_labels(key: tuple) -> str:
+    return ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
+
+
+def _format_exemplar(exemplar: dict[str, str], value: float,
+                     timestamp: float) -> str:
+    """OpenMetrics exemplar rendered as an exposition comment —
+    ``# {trace_id="..."} <value> <ts>`` appended to the sample line. Plain
+    Prometheus text parsers treat everything after ``#`` as a comment, so
+    the format stays 0.0.4-compatible."""
+    labels = _format_labels(tuple(sorted(exemplar.items())))
+    return f" # {{{labels}}} {value:g} {timestamp:.3f}"
+
+
 class _Metric:
     def __init__(self, name: str, help_: str, type_: str):
         self.name = name
@@ -56,14 +84,14 @@ class _Metric:
                        if want <= set(key))
 
     def expose(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} {self.type}"]
         with self._lock:
             items = sorted(self._values.items())
         if not items:
             items = [((), 0.0)]
         for key, value in items:
-            label_s = ",".join(f'{k}="{v}"' for k, v in key)
+            label_s = _format_labels(key)
             suffix = f"{{{label_s}}}" if label_s else ""
             lines.append(f"{self.name}{suffix} {value:g}")
         return "\n".join(lines)
@@ -87,13 +115,18 @@ class _Histogram:
         self.buckets = tuple(sorted(buckets))
         # labels key → [per-bucket counts..., +Inf count, sum]
         self._series: dict[tuple, list[float]] = {}
+        # labels key → (exemplar labels, observed value, unix ts): the most
+        # recent exemplared observation, attached at exposition to the
+        # bucket the value fell into (OpenMetrics exemplar semantics)
+        self._exemplars: dict[tuple, tuple[dict[str, str], float, float]] = {}
         self._lock = threading.Lock()
 
     def _labels_key(self, labels: dict[str, str] | None) -> tuple:
         return tuple(sorted((labels or {}).items()))
 
     def observe(self, value: float,
-                labels: dict[str, str] | None = None) -> None:
+                labels: dict[str, str] | None = None,
+                exemplar: dict[str, str] | None = None) -> None:
         key = self._labels_key(labels)
         with self._lock:
             series = self._series.get(key)
@@ -104,6 +137,8 @@ class _Histogram:
                     series[i] += 1
             series[-2] += 1          # +Inf / _count
             series[-1] += value      # _sum
+            if exemplar:
+                self._exemplars[key] = (dict(exemplar), value, time.time())
 
     def count(self, labels: dict[str, str] | None = None) -> float:
         with self._lock:
@@ -126,19 +161,35 @@ class _Histogram:
         with self._lock:
             return sum(series[-2] for series in self._series.values())
 
+    def _exemplar_bucket(self, value: float) -> int:
+        """Index of the lowest bucket containing ``value`` (len(buckets)
+        means +Inf)."""
+        for i, le in enumerate(self.buckets):
+            if value <= le:
+                return i
+        return len(self.buckets)
+
     def expose(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} {self.type}"]
         with self._lock:
             items = sorted((k, list(v)) for k, v in self._series.items())
+            exemplars = dict(self._exemplars)
         for key, series in items:
-            base = ",".join(f'{k}="{v}"' for k, v in key)
+            base = _format_labels(key)
+            ex = exemplars.get(key)
+            ex_bucket = self._exemplar_bucket(ex[1]) if ex else -1
             for i, le in enumerate(self.buckets):
                 label_s = (base + "," if base else "") + f'le="{le:g}"'
+                tail = (_format_exemplar(*ex)
+                        if ex and i == ex_bucket else "")
                 lines.append(f"{self.name}_bucket{{{label_s}}} "
-                             f"{series[i]:g}")
+                             f"{series[i]:g}{tail}")
             label_s = (base + "," if base else "") + 'le="+Inf"'
-            lines.append(f"{self.name}_bucket{{{label_s}}} {series[-2]:g}")
+            tail = (_format_exemplar(*ex)
+                    if ex and ex_bucket == len(self.buckets) else "")
+            lines.append(f"{self.name}_bucket{{{label_s}}} "
+                         f"{series[-2]:g}{tail}")
             suffix = f"{{{base}}}" if base else ""
             lines.append(f"{self.name}_sum{suffix} {series[-1]:g}")
             lines.append(f"{self.name}_count{suffix} {series[-2]:g}")

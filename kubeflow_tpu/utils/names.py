@@ -155,6 +155,16 @@ POOL_ANNOTATIONS = frozenset({
     RESUMED_STEP_ANNOTATION,
 })
 
+# W3C traceparent of the notebook's lifecycle trace, stamped on the
+# Notebook by its reconciler only while a recording tracing provider is
+# installed (utils/tracing.py): the cross-controller trace carrier —
+# slicepool bind and slicerepair migration parent their spans on it so a
+# create trace stitches end-to-end. Telemetry only, never load-bearing,
+# and (like the repair/pool bookkeeping above) never propagated into the
+# StatefulSet template — it must not churn the template or defeat drift
+# gating.
+TRACE_CONTEXT_ANNOTATION = "tpu.kubeflow.org/trace-context"
+
 # where the apiserver facade's service-proxy subresource forwards: in the
 # in-process cluster pods hold no real sockets, so the composition root
 # (or a test) annotates the Service with the actual listener's base URL

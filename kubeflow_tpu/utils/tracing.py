@@ -17,8 +17,10 @@ The default provider is a no-op (zero overhead on the admission hot path);
 
 from __future__ import annotations
 
+import re
 import threading
 import time
+from collections import OrderedDict
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Iterator
@@ -28,6 +30,45 @@ from typing import Iterator
 STATUS_UNSET = "UNSET"
 STATUS_OK = "OK"
 STATUS_ERROR = "ERROR"
+
+# Span attribute that binds a trace to a notebook for the flight recorder
+# (set on reconcile root spans by the manager).
+KEY_ATTRIBUTE = "reconcile.key"
+
+
+@dataclass(frozen=True)
+class SpanContext:
+    """The propagatable identity of a span — what crosses process/controller
+    boundaries (OTel's SpanContext). Carried on the wire as a W3C
+    ``traceparent`` header and between controllers as an object annotation."""
+
+    trace_id: int
+    span_id: int
+
+
+# W3C trace-context: version "00", 16-byte trace-id, 8-byte parent-id,
+# 1-byte flags, all lowercase hex. All-zero ids are invalid per spec.
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def format_traceparent(ctx: SpanContext) -> str:
+    return f"00-{ctx.trace_id:032x}-{ctx.span_id:016x}-01"
+
+
+def parse_traceparent(header: str | None) -> SpanContext | None:
+    """Strict W3C traceparent parse; malformed headers yield None (the
+    propagation spec says restart the trace, never fail the request)."""
+    if not header:
+        return None
+    m = _TRACEPARENT_RE.match(header.strip())
+    if m is None:
+        return None
+    trace_id = int(m.group(1), 16)
+    span_id = int(m.group(2), 16)
+    if trace_id == 0 or span_id == 0:
+        return None
+    return SpanContext(trace_id=trace_id, span_id=span_id)
 
 
 @dataclass
@@ -69,6 +110,9 @@ class Span:
         })
         self.set_status(STATUS_ERROR, str(exc))
 
+    def context(self) -> SpanContext:
+        return SpanContext(trace_id=self.trace_id, span_id=self.span_id)
+
 
 class _NoopSpan:
     """Attribute/event sink with no recording — the global default provider,
@@ -82,8 +126,28 @@ class _NoopSpan:
 
     def record_exception(self, exc: BaseException) -> None: ...
 
+    def context(self) -> None:
+        return None
+
 
 _NOOP_SPAN = _NoopSpan()
+
+
+class _NoopSpanCM:
+    """Reusable no-op context manager: ``NoopProvider.span`` hands out ONE
+    shared instance, so the tracing-off hot path allocates nothing per call
+    (a @contextmanager would build a fresh generator each time)."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> _NoopSpan:
+        return _NOOP_SPAN
+
+    def __exit__(self, *exc) -> bool:
+        return False
+
+
+_NOOP_SPAN_CM = _NoopSpanCM()
 
 
 # ------------------------------------------------------------------- providers
@@ -260,10 +324,14 @@ class OtlpHttpExporter:
 class NoopProvider:
     recording = False
 
-    @contextmanager
-    def span(self, tracer: str, name: str,
-             attributes: dict | None = None) -> Iterator[_NoopSpan]:
-        yield _NOOP_SPAN
+    def span(self, tracer: str, name: str, attributes: dict | None = None,
+             parent: SpanContext | None = None) -> _NoopSpanCM:
+        return _NOOP_SPAN_CM
+
+    def emit(self, tracer: str, name: str, start_time: float, end_time: float,
+             attributes: dict | None = None,
+             parent: SpanContext | None = None) -> _NoopSpan:
+        return _NOOP_SPAN
 
 
 class SDKProvider:
@@ -274,8 +342,9 @@ class SDKProvider:
 
     recording = True
 
-    def __init__(self, exporter: InMemorySpanExporter | OtlpHttpExporter) \
-            -> None:
+    def __init__(self, exporter) -> None:
+        # duck-typed exporter: InMemorySpanExporter, OtlpHttpExporter, or
+        # a FlightRecorder (optionally teeing to one of the former)
         self.exporter = exporter
         self._local = threading.local()
         self._lock = threading.Lock()
@@ -288,11 +357,17 @@ class SDKProvider:
             return i
 
     @contextmanager
-    def span(self, tracer: str, name: str,
-             attributes: dict | None = None) -> Iterator[Span]:
+    def span(self, tracer: str, name: str, attributes: dict | None = None,
+             parent: SpanContext | None = None) -> Iterator[Span]:
         stack: list[Span] = getattr(self._local, "stack", None) or []
         self._local.stack = stack
-        parent = stack[-1] if stack else None
+        if parent is None:
+            top = stack[-1] if stack else None
+            parent = top.context() if top is not None else None
+        # An explicit parent (a remote SpanContext from a traceparent header
+        # or an annotation) wins over the thread stack — that's the stitch:
+        # a span opened mid-reconcile can join ANOTHER object's trace, and
+        # its children still nest under it via the stack.
         span = Span(name=name, tracer=tracer,
                     trace_id=parent.trace_id if parent else self._ids(),
                     span_id=self._ids(),
@@ -310,6 +385,25 @@ class SDKProvider:
             stack.pop()
             self.exporter.export(span)
 
+    def emit(self, tracer: str, name: str, start_time: float, end_time: float,
+             attributes: dict | None = None,
+             parent: SpanContext | None = None) -> Span:
+        """Export an already-finished span with explicit timestamps — for
+        phases measured before a span could be opened (workqueue wait,
+        phase-collector read/write totals). Parent defaults to the current
+        thread's innermost span."""
+        if parent is None:
+            stack = getattr(self._local, "stack", None)
+            parent = stack[-1].context() if stack else None
+        span = Span(name=name, tracer=tracer,
+                    trace_id=parent.trace_id if parent else self._ids(),
+                    span_id=self._ids(),
+                    parent_id=parent.span_id if parent else None,
+                    attributes=dict(attributes or {}),
+                    start_time=start_time, end_time=end_time)
+        self.exporter.export(span)
+        return span
+
 
 _provider: NoopProvider | SDKProvider = NoopProvider()
 _provider_lock = threading.Lock()
@@ -325,6 +419,13 @@ def get_provider() -> NoopProvider | SDKProvider:
     return _provider
 
 
+def is_recording() -> bool:
+    """True when the installed provider records spans. Instrumentation sites
+    guard attribute-dict construction and carrier writes on this so the
+    no-op path stays allocation-free."""
+    return _provider.recording
+
+
 def current_span():
     """The innermost active recording span on this thread (OTel's
     trace.SpanFromContext) — a no-op sink when the provider isn't recording
@@ -337,6 +438,28 @@ def current_span():
     return _NOOP_SPAN
 
 
+def current_context() -> SpanContext | None:
+    """SpanContext of the innermost active span, or None when not recording
+    — the value a carrier (traceparent header, annotation) should serialize."""
+    provider = _provider
+    if isinstance(provider, SDKProvider):
+        stack = getattr(provider._local, "stack", None)
+        if stack:
+            return stack[-1].context()
+    return None
+
+
+def current_exemplar() -> dict[str, str] | None:
+    """Exemplar labels for the active trace (``{"trace_id": ..., "span_id":
+    ...}``) or None when not recording — what histogram ``observe(...,
+    exemplar=)`` wants."""
+    ctx = current_context()
+    if ctx is None:
+        return None
+    return {"trace_id": f"{ctx.trace_id:032x}",
+            "span_id": f"{ctx.span_id:016x}"}
+
+
 class Tracer:
     """Named tracer handle — cheap, safe to cache (the reference memoizes via
     sync.OnceValue; here the provider lookup is deferred to span start so a
@@ -345,9 +468,147 @@ class Tracer:
     def __init__(self, name: str) -> None:
         self.name = name
 
-    def start_span(self, name: str, attributes: dict | None = None):
-        return _provider.span(self.name, name, attributes)
+    def start_span(self, name: str, attributes: dict | None = None,
+                   parent: SpanContext | None = None):
+        return _provider.span(self.name, name, attributes, parent=parent)
+
+    def emit_span(self, name: str, start_time: float, end_time: float,
+                  attributes: dict | None = None,
+                  parent: SpanContext | None = None):
+        return _provider.emit(self.name, name, start_time, end_time,
+                              attributes, parent=parent)
 
 
 def get_tracer(name: str) -> Tracer:
     return Tracer(name)
+
+
+# ------------------------------------------------------------ flight recorder
+
+def _span_dict(span: Span) -> dict:
+    return {
+        "name": span.name,
+        "tracer": span.tracer,
+        "trace_id": f"{span.trace_id:032x}",
+        "span_id": f"{span.span_id:016x}",
+        "parent_id": (f"{span.parent_id:016x}"
+                      if span.parent_id is not None else None),
+        "start": span.start_time,
+        "end": span.end_time,
+        "duration_s": max(span.end_time - span.start_time, 0.0),
+        "status": span.status,
+        "attributes": dict(span.attributes),
+        "events": [{"name": ev.name, "ts": ev.timestamp,
+                    "attributes": dict(ev.attributes)}
+                   for ev in span.events],
+    }
+
+
+def trace_phase_breakdown(spans: list[dict]) -> dict[str, float]:
+    """Wall-clock decomposition of one trace (span dicts as produced by
+    ``_span_dict``): ``queue`` is workqueue enqueue-delivery plus queue
+    wait, ``wire`` is client-side REST time, ``apf`` is the server-side
+    priority-and-fairness wait (a SUBSET of wire — reported for insight,
+    excluded from the sum), and ``reconcile`` is the remaining root wall.
+    ``queue + wire + reconcile == wall`` by construction (one worker thread
+    runs the reconcile serially, so the child spans don't overlap)."""
+    if not spans:
+        return {"wall": 0.0, "queue": 0.0, "apf": 0.0, "wire": 0.0,
+                "reconcile": 0.0}
+    start = min(s["start"] for s in spans)
+    end = max(s["end"] for s in spans)
+    wall = max(end - start, 0.0)
+    queue = sum(s["duration_s"] for s in spans
+                if s["name"].startswith("workqueue."))
+    apf = sum(s["duration_s"] for s in spans
+              if s["name"].startswith("apf."))
+    wire = sum(s["duration_s"] for s in spans
+               if s["name"].startswith("rest."))
+    reconcile = max(wall - queue - wire, 0.0)
+    return {"wall": wall, "queue": queue, "apf": apf, "wire": wire,
+            "reconcile": reconcile}
+
+
+class FlightRecorder:
+    """Bounded in-process trace store: the last K lifecycle traces per
+    notebook, served by ``/debug/notebooks/<ns>/<name>/trace``.
+
+    Works as an exporter decorator — install as (or in front of) the
+    SDKProvider exporter. Spans group by trace_id; a trace binds to a
+    notebook key the first time one of its spans carries ``reconcile.key``
+    (set on reconcile root spans). Children export before their root, so
+    unbound traces park in an LRU-bounded buffer until the keyed root
+    arrives; both the per-key ring and the buffer are hard-bounded, so a
+    recorder left on forever stays O(keys·K) memory."""
+
+    def __init__(self, inner=None, max_traces: int = 512,
+                 traces_per_key: int = 8,
+                 max_spans_per_trace: int = 256) -> None:
+        self.inner = inner
+        self.max_traces = max_traces
+        self.traces_per_key = traces_per_key
+        self.max_spans_per_trace = max_spans_per_trace
+        self._lock = threading.Lock()
+        self._traces: OrderedDict[int, list[Span]] = OrderedDict()
+        self._trace_key: dict[int, str] = {}
+        self._by_key: dict[str, list[int]] = {}
+
+    def export(self, span: Span) -> None:
+        with self._lock:
+            spans = self._traces.get(span.trace_id)
+            if spans is None:
+                spans = []
+                self._traces[span.trace_id] = spans
+                while len(self._traces) > self.max_traces:
+                    self._evict_oldest_locked()
+            else:
+                self._traces.move_to_end(span.trace_id)
+            if len(spans) < self.max_spans_per_trace:
+                spans.append(span)
+            key = span.attributes.get(KEY_ATTRIBUTE)
+            if key is not None and span.trace_id not in self._trace_key:
+                self._bind_locked(span.trace_id, str(key))
+        if self.inner is not None:
+            self.inner.export(span)
+
+    def _bind_locked(self, trace_id: int, key: str) -> None:
+        self._trace_key[trace_id] = key
+        ring = self._by_key.setdefault(key, [])
+        ring.append(trace_id)
+        while len(ring) > self.traces_per_key:
+            old = ring.pop(0)
+            self._trace_key.pop(old, None)
+            self._traces.pop(old, None)
+
+    def _evict_oldest_locked(self) -> None:
+        old, _ = self._traces.popitem(last=False)
+        key = self._trace_key.pop(old, None)
+        if key is not None:
+            ring = self._by_key.get(key)
+            if ring and old in ring:
+                ring.remove(old)
+                if not ring:
+                    del self._by_key[key]
+
+    def trace_for(self, namespace: str, name: str) -> list[dict]:
+        """All recorded traces bound to ``namespace/name``, oldest first,
+        each as ``{"trace_id": hex, "spans": [span dicts sorted by start]}``
+        — the JSON body of the debug endpoint."""
+        key = f"{namespace}/{name}"
+        with self._lock:
+            ring = list(self._by_key.get(key, ()))
+            out = []
+            for trace_id in ring:
+                spans = self._traces.get(trace_id)
+                if not spans:
+                    continue
+                out.append({
+                    "trace_id": f"{trace_id:032x}",
+                    "spans": [_span_dict(s) for s in
+                              sorted(spans, key=lambda s: s.start_time)],
+                })
+        return out
+
+    def keys(self) -> list[str]:
+        with self._lock:
+            return sorted(self._by_key)

@@ -133,6 +133,94 @@ def _print_latencies(lat: list[float]) -> None:
           f"max: {lat[-1]*1000:.1f}ms")
 
 
+def _analyze_lifecycle_traces(recorder, namespace: str,
+                              nb_names: list[str]
+                              ) -> tuple[list[tuple[str, str]], dict]:
+    """Check every notebook's flight-recorder traces for one COMPLETE
+    CR→Ready lifecycle trace and aggregate a phase decomposition.
+
+    Complete means: at least one recorded trace containing (a) a
+    notebook-controller ``reconcile`` root, (b) ``workqueue.enqueue`` and
+    ``workqueue.wait`` spans parented on such a root, (c) at least one
+    ``rest.*`` wire span whose ancestry reaches a root, and (d) no span
+    whose parent_id fails to resolve inside the trace (parentage intact
+    end to end). The phase sums (queue + wire children) must also fit
+    inside the reconcile-root wall within 10% — timestamps that don't
+    nest mean the span plumbing lies about causality.
+
+    Returns ``(problems, phases)``: per-notebook failure reasons (empty =
+    all complete) and the fleet-aggregate ``{wall, queue, apf, wire,
+    reconcile}`` seconds."""
+    problems: list[tuple[str, str]] = []
+    agg = {"wall": 0.0, "queue": 0.0, "apf": 0.0, "wire": 0.0,
+           "reconcile": 0.0}
+    for nb in nb_names:
+        reason = "no trace recorded"
+        best: dict | None = None
+        for t in recorder.trace_for(namespace, nb):
+            spans = t["spans"]
+            by_id = {s["span_id"]: s for s in spans}
+            roots = [s for s in spans if s["name"] == "reconcile"
+                     and "notebook" in str(
+                         s["attributes"].get("controller", ""))]
+            if not roots:
+                reason = "no notebook reconcile root"
+                continue
+            dangling = [s for s in spans
+                        if s["parent_id"] and s["parent_id"] not in by_id]
+            if dangling:
+                reason = (f"{dangling[0]['name']} has a parent outside "
+                          f"the trace (broken stitch)")
+                continue
+            root_ids = {s["span_id"] for s in roots}
+
+            def _under_root(span: dict) -> bool:
+                cur, seen = span, set()
+                while cur is not None and cur["span_id"] not in seen:
+                    if cur["span_id"] in root_ids:
+                        return True
+                    seen.add(cur["span_id"])
+                    cur = (by_id.get(cur["parent_id"])
+                           if cur["parent_id"] else None)
+                return False
+
+            waits = [s for s in spans if s["name"] == "workqueue.wait"
+                     and s["parent_id"] in root_ids]
+            enqueues = [s for s in spans if s["name"] == "workqueue.enqueue"
+                        and s["parent_id"] in root_ids]
+            wires = [s for s in spans if s["name"].startswith("rest.")
+                     and _under_root(s)]
+            if not enqueues:
+                reason = "no workqueue.enqueue span under a root"
+                continue
+            if not waits:
+                reason = "no workqueue.wait span under a root"
+                continue
+            if not wires:
+                reason = "no wire span under a reconcile root"
+                continue
+            wall = sum(s["duration_s"] for s in roots)
+            queue = sum(s["duration_s"] for s in waits + enqueues)
+            wire = sum(s["duration_s"] for s in wires)
+            apf = sum(s["duration_s"] for s in spans
+                      if s["name"].startswith("apf.") and _under_root(s))
+            if queue + wire > wall * 1.10:
+                reason = (f"phase sums escape the reconcile wall: "
+                          f"queue {queue:.3f}s + wire {wire:.3f}s vs "
+                          f"wall {wall:.3f}s")
+                continue
+            best = {"wall": wall, "queue": queue, "apf": apf,
+                    "wire": wire,
+                    "reconcile": max(wall - queue - wire, 0.0)}
+            break
+        if best is None:
+            problems.append((nb, reason))
+        else:
+            for k in agg:
+                agg[k] += best[k]
+    return problems, agg
+
+
 def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
              max_requests_per_nb: float | None = None,
              workers: int = 4, apiserver_latency_ms: float = 0.0,
@@ -148,6 +236,7 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
              pool_warm: int = 0,
              boot_delay_ms: float = 0.0,
              tenant_storm: int = 0,
+             trace: bool = False,
              stats_out: dict | None = None) -> int:
     """Controller wire-cost measurement: the full controller stack runs
     over a real HTTP apiserver while the load generator drives the store
@@ -202,7 +291,14 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
     client with a NON-controller User-Agent, so the apiserver's priority
     & fairness layer classifies them into the global-default flow — the
     isolation the APF chaos check pins (controller latency within 2x of
-    the quiet baseline while the storm runs)."""
+    the quiet baseline while the storm runs).
+
+    ``trace`` records every reconcile in an in-process FlightRecorder
+    (SDK tracing provider for the run's duration, restored afterwards)
+    and fails the run unless EVERY notebook has a complete CR→Ready
+    lifecycle trace — enqueue → queue-wait → reconcile root → wire spans
+    with intact parentage — plus a per-phase wall decomposition whose
+    queue+wire children fit inside the reconcile roots (within 10%)."""
     import tempfile
 
     from kubeflow_tpu.api import types as api
@@ -244,6 +340,18 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
     api.install_notebook_crd(store)
     install_slicepool_crd(store)
     cleanups = []
+    recorder = None
+    if trace:
+        from kubeflow_tpu.utils import tracing
+        # traces_per_key raised well past the default ring: the kubelet
+        # simulator's STS reconciles bind fresh traces to the same
+        # ns/name key and must not evict the notebook lifecycle trace
+        recorder = tracing.FlightRecorder(traces_per_key=64)
+        prev_provider = tracing.get_provider()
+        tracing.set_provider(tracing.SDKProvider(recorder))
+        # appended FIRST so the reversed-cleanup order restores the
+        # provider LAST, after every manager stopped emitting spans
+        cleanups.append(lambda: tracing.set_provider(prev_provider))
     try:
         # the simulator reads through its own indexed informer cache (the
         # real STS controller's shape): pod lookups hit the 'statefulset'
@@ -567,6 +675,24 @@ def run_wire(count: int, namespace: str, accelerator: str, timeout: float,
                   f"connections for {pooled_reqs:.0f} pooled-path requests "
                   f"— keep-alive pooling regressed)")
             return 1
+        if recorder is not None:
+            trace_problems, phases = _analyze_lifecycle_traces(
+                recorder, namespace, sorted(created_at))
+            complete = count - len(trace_problems)
+            print(f"trace: {complete}/{count} complete CR→Ready traces  "
+                  f"phase wall: queue {phases['queue']:.2f}s  "
+                  f"apf {phases['apf']:.2f}s (inside wire)  "
+                  f"wire {phases['wire']:.2f}s  "
+                  f"reconcile {phases['reconcile']:.2f}s  "
+                  f"of {phases['wall']:.2f}s reconcile wall")
+            if stats_out is not None:
+                stats_out["trace"] = {"complete": complete,
+                                      "phases": phases}
+            if trace_problems:
+                print(f"FAIL: {len(trace_problems)} notebook(s) without a "
+                      f"complete lifecycle trace: "
+                      f"{trace_problems[:5]}")
+                return 1
         if pool_warm > 0:
             from kubeflow_tpu.utils.k8s import get_annotation
             bound, missed = [], []
@@ -1172,6 +1298,13 @@ def main() -> int:
                          "hammering unpaginated Pod LISTs under a tenant "
                          "User-Agent for the whole fan-out — the APF "
                          "isolation chaos shape")
+    ap.add_argument("--trace", action="store_true",
+                    help="with --wire: record every reconcile in a "
+                         "flight recorder and fail unless each notebook "
+                         "has a complete CR→Ready trace (enqueue → "
+                         "queue-wait → reconcile → wire, intact "
+                         "parentage); reports the queue/APF/wire/"
+                         "reconcile phase breakdown")
     ap.add_argument("--managers", type=int, default=0, metavar="N",
                     help="sharded multi-manager mode: run N full manager "
                          "stacks (own client/cache/worker pool/per-shard "
@@ -1240,7 +1373,8 @@ def main() -> int:
                         settle_s=args.settle_s,
                         pool_warm=args.pool_warm,
                         boot_delay_ms=args.boot_delay_ms,
-                        tenant_storm=args.tenant_storm)
+                        tenant_storm=args.tenant_storm,
+                        trace=args.trace)
     return run_inprocess(args.count, args.namespace, args.accelerator,
                          args.timeout, server=args.server,
                          workers=args.workers)
