@@ -5,7 +5,7 @@ PYTHON ?= python
 TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: help test test-fast test-chaos chaos-experiments chaos-smoke \
-        test-transport gate lint manifests \
+        test-transport gate lint sanitize manifests \
         manifests-check check-license bench numerics ctx-sweep mfu-ab capture \
         spec-acceptance prefix-cache-ab chunked-prefill-ab dryrun loadtest \
         loadtest-faults loadtest-preempt loadtest-sharded loadtest-soak \
@@ -49,6 +49,11 @@ test-transport: ## Real-HTTP transport + multi-process HA tier.
 
 lint: ## Repo lint rules (ci/lint.py; the fmt/vet analog).
 	$(PYTHON) ci/lint.py
+
+sanitize: ## Concurrency gate: invariant lint + armed sanitizer suite + armed chaos smoke.
+	$(PYTHON) ci/lint.py
+	$(TEST_ENV) KFTPU_SANITIZE=1 $(PYTHON) -m pytest tests/test_sanitizer.py tests/test_lint_rules.py -q
+	$(TEST_ENV) $(PYTHON) ci/chaos_smoke.py --count 20 --fault-rate 0.05
 
 manifests: ## Regenerate config/ from kubeflow_tpu/deploy/manifests.py.
 	$(PYTHON) ci/generate_manifests.py

@@ -33,6 +33,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 from pathlib import Path
@@ -48,7 +49,29 @@ DEFAULT_BUDGET_S = 180.0
 def run_smoke(count: int = DEFAULT_COUNT,
               fault_rate: float = DEFAULT_FAULT_RATE,
               budget_s: float = DEFAULT_BUDGET_S,
-              experiments: bool = True) -> int:
+              experiments: bool = True,
+              sanitize: bool = True) -> int:
+    prev_forced = None
+    if sanitize:
+        # must happen before any kubeflow_tpu import: locks bind to the
+        # sanitizer at construction time. The previous arm() override is
+        # restored on exit — this function also runs in-process under
+        # tier-1, where the suite-wide arming must survive it.
+        os.environ["KFTPU_SANITIZE"] = "1"
+        from kubeflow_tpu.utils import sanitizer
+        prev_forced = sanitizer.forced()
+        sanitizer.arm(True)
+        sanitizer.get_sanitizer().reset()
+    try:
+        return _run_phases(count, fault_rate, budget_s, experiments,
+                           sanitize)
+    finally:
+        if sanitize:
+            sanitizer.arm(prev_forced)
+
+
+def _run_phases(count: int, fault_rate: float, budget_s: float,
+                experiments: bool, sanitize: bool) -> int:
     from kubeflow_tpu.cluster.experiments import run_dir, validate_dir
     from loadtest.start_notebooks import run_wire
 
@@ -85,6 +108,15 @@ def run_smoke(count: int = DEFAULT_COUNT,
         print(f"CHAOS SMOKE FAIL: {wall:.1f}s exceeds the "
               f"{budget_s:.0f}s budget")
         return 1
+    if sanitize:
+        from kubeflow_tpu.utils import sanitizer
+        violations = sanitizer.get_sanitizer().violations()
+        if violations:
+            for rule, msg in violations:
+                print(f"  [{rule}] {msg}")
+            print(f"CHAOS SMOKE FAIL: {len(violations)} concurrency "
+                  f"violation(s) recorded by the sanitizer")
+            return 1
     print(f"chaos smoke OK: {len(list(exp_dir.glob('*.yaml')))} experiments"
           f" + {count} notebooks @ {fault_rate:.0%} faults in {wall:.1f}s "
           f"(budget {budget_s:.0f}s)")
@@ -98,9 +130,16 @@ def main() -> int:
     ap.add_argument("--budget-s", type=float, default=DEFAULT_BUDGET_S)
     ap.add_argument("--no-experiments", action="store_true",
                     help="soak only (skip the experiment runner)")
+    ap.add_argument("--sanitize", dest="sanitize", action="store_true",
+                    default=True,
+                    help="run armed: record lock-order/lockset/blocking "
+                         "violations and fail on any (the default)")
+    ap.add_argument("--no-sanitize", dest="sanitize", action="store_false",
+                    help="timing-sensitive debugging only")
     args = ap.parse_args()
     return run_smoke(args.count, args.fault_rate, args.budget_s,
-                     experiments=not args.no_experiments)
+                     experiments=not args.no_experiments,
+                     sanitize=args.sanitize)
 
 
 if __name__ == "__main__":

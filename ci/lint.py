@@ -30,15 +30,63 @@ vulnerability-scan analog):
                        plumbing
   hardcoded-secret     literal bearer tokens / private keys / cloud creds
 
+Concurrency-invariant rules (the static half of the sanitizer gate —
+utils/sanitizer.py is the dynamic half; each encodes a hard-won
+CHANGES.md invariant):
+
+  raw-lock             threading.Lock()/RLock()/Condition() constructed
+                       directly — every lock in the package must go
+                       through the tracked factory (sanitizer.tracked_lock
+                       et al.) so the lock-order sanitizer sees it
+  lock-acquire-call    .acquire()/.release() on a lock-like receiver
+                       outside `with` — manual pairing is how releases
+                       get skipped on exception paths
+  sleep-under-lock     time.sleep / urlopen / getresponse lexically inside
+                       a `with <lock>:` block — blocking under a lock
+                       convoys every other thread behind one slow peer
+                       (the dynamic no_blocking hook catches what lexical
+                       analysis can't)
+  annotation-literal   a `domain.tld/key` annotation/label key written
+                       inline instead of referencing utils/names.py —
+                       inline keys drift from the constants and break
+                       round-tripping (apiVersion `group/vN` strings are
+                       exempt)
+  metric-not-cataloged a metric family constructed whose literal name is
+                       missing from utils/metrics.py METRIC_FAMILY_CATALOG
+                       — the exposition surface is reviewed, not accreted
+
 Exit non-zero with findings; used by the code-quality CI workflow."""
 
 from __future__ import annotations
 
 import ast
+import re
 import sys
 from pathlib import Path
 
 PACKAGE = Path(__file__).resolve().parent.parent / "kubeflow_tpu"
+
+_CATALOG: frozenset | None = None
+
+
+def metric_catalog() -> frozenset:
+    """METRIC_FAMILY_CATALOG parsed out of utils/metrics.py's AST — the
+    linter never imports the package it lints."""
+    global _CATALOG
+    if _CATALOG is None:
+        tree = ast.parse((PACKAGE / "utils" / "metrics.py").read_text())
+        names: frozenset = frozenset()
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name)
+                    and t.id == "METRIC_FAMILY_CATALOG"
+                    for t in node.targets):
+                value = node.value
+                if isinstance(value, ast.Call) and value.args:
+                    value = value.args[0]  # frozenset({...}) literal
+                names = frozenset(ast.literal_eval(value))
+        _CATALOG = names
+    return _CATALOG
 
 
 class Linter(ast.NodeVisitor):
@@ -47,6 +95,7 @@ class Linter(ast.NodeVisitor):
         self.lines = source.splitlines()
         self.findings: list[tuple[int, str, str]] = []
         self._main_depth = 0  # inside `if __name__ == "__main__":`
+        self._lock_depth = 0  # inside `with <lock-like>:` (lexical)
 
     def flag(self, node: ast.AST, rule: str, msg: str) -> None:
         self.findings.append((getattr(node, "lineno", 0), rule, msg))
@@ -76,11 +125,21 @@ class Linter(ast.NodeVisitor):
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._check_defaults(node)
+        # a def nested inside a with-block runs later, outside the lock
+        saved, self._lock_depth = self._lock_depth, 0
         self.generic_visit(node)
+        self._lock_depth = saved
 
     def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
         self._check_defaults(node)
+        saved, self._lock_depth = self._lock_depth, 0
         self.generic_visit(node)
+        self._lock_depth = saved
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        saved, self._lock_depth = self._lock_depth, 0
+        self.generic_visit(node)
+        self._lock_depth = saved
 
     # stdout IS the product in a command-line tool (kubectl prints tables)
     PRINT_OK_FILES = {"cli.py"}
@@ -88,6 +147,40 @@ class Linter(ast.NodeVisitor):
     # http_client.py implements --insecure-skip-tls-verify; it is the ONE
     # place allowed to construct a non-verifying context (flag-gated)
     TLS_OK_FILES = {"http_client.py"}
+
+    # sanitizer.py IS the tracked factory: the one place allowed to build
+    # raw primitives and to call acquire/release outside `with`
+    SANITIZER_OK_FILES = {"sanitizer.py"}
+
+    # names.py IS the constants module the annotation-literal rule points at
+    NAMES_OK_FILES = {"names.py"}
+
+    # receiver names that identify a lock for lock-acquire-call and
+    # sleep-under-lock (terminal attribute/identifier; keeps e.g. the APF
+    # dispatcher's release(ticket) out of scope)
+    _LOCKISH = re.compile(r"(lock|mutex|cond|(^|_)cv)$", re.IGNORECASE)
+
+    # a domain-qualified annotation/label key: dotted domain, a slash, a
+    # path — with a negative lookahead exempting apiVersion `group/vN`
+    _ANNOTATION_KEY = re.compile(
+        r"^[a-z0-9-]+(\.[a-z0-9-]+)+/(?!v\d)[A-Za-z0-9][A-Za-z0-9_.-]*$")
+
+    @staticmethod
+    def _terminal_name(node: ast.AST) -> str:
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        if isinstance(node, ast.Name):
+            return node.id
+        return ""
+
+    def visit_With(self, node: ast.With) -> None:
+        if any(self._LOCKISH.search(self._terminal_name(item.context_expr))
+               for item in node.items):
+            self._lock_depth += 1
+            self.generic_visit(node)
+            self._lock_depth -= 1
+        else:
+            self.generic_visit(node)
 
     def visit_Call(self, node: ast.Call) -> None:
         if isinstance(node.func, ast.Name) and node.func.id == "print" \
@@ -132,6 +225,49 @@ class Linter(ast.NodeVisitor):
             self.flag(node, "tls-verify-disabled",
                       "unverified TLS context outside the flag-gated "
                       "client plumbing")
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("Lock", "RLock", "Condition")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "threading"
+                and self.path.name not in self.SANITIZER_OK_FILES):
+            self.flag(node, "raw-lock",
+                      f"raw threading.{node.func.attr}() — use "
+                      f"sanitizer.tracked_{node.func.attr.lower()}"
+                      f"(name, order=...) so the lock-order sanitizer "
+                      f"sees it")
+        if (func_name in ("acquire", "release")
+                and isinstance(node.func, ast.Attribute)
+                and self._LOCKISH.search(
+                    self._terminal_name(node.func.value))
+                and self.path.name not in self.SANITIZER_OK_FILES):
+            self.flag(node, "lock-acquire-call",
+                      f".{func_name}() on a lock outside `with` — manual "
+                      f"pairing skips the release on exception paths")
+        if self._lock_depth:
+            blocking = ""
+            if func_name == "sleep" \
+                    and self._terminal_name(node.func.value
+                                            if isinstance(node.func,
+                                                          ast.Attribute)
+                                            else node.func) == "time":
+                blocking = "time.sleep"
+            elif func_name in ("urlopen", "getresponse",
+                               "create_connection"):
+                blocking = func_name
+            if blocking and self.path.name not in self.SANITIZER_OK_FILES:
+                self.flag(node, "sleep-under-lock",
+                          f"{blocking}() lexically inside a `with <lock>:` "
+                          f"block — blocking under a lock convoys every "
+                          f"waiter behind one slow peer")
+        if (func_name in ("counter", "gauge", "histogram")
+                and isinstance(node.func, ast.Attribute)
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and node.args[0].value not in metric_catalog()):
+            self.flag(node, "metric-not-cataloged",
+                      f"metric family {node.args[0].value!r} missing from "
+                      f"utils/metrics.py METRIC_FAMILY_CATALOG")
         self.generic_visit(node)
 
     @staticmethod
@@ -157,6 +293,12 @@ class Linter(ast.NodeVisitor):
                     self.flag(node, "hardcoded-secret",
                               f"literal credential material ({marker}...)")
                     break
+        if (isinstance(node.value, str)
+                and self._ANNOTATION_KEY.match(node.value)
+                and self.path.name not in self.NAMES_OK_FILES):
+            self.flag(node, "annotation-literal",
+                      f"inline annotation/label key {node.value!r} — "
+                      f"reference the utils/names.py constant instead")
         self.generic_visit(node)
 
     def visit_Attribute(self, node: ast.Attribute) -> None:

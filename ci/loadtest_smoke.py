@@ -31,6 +31,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 from pathlib import Path
@@ -131,8 +132,46 @@ def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
               budget_s: float = DEFAULT_BUDGET_S,
               preempt: bool = True, watch_kill: bool = True,
               warm_cold: bool = True, sharded: bool = True,
-              storm: bool = True, traced: bool = True) -> int:
-    """Run the wire fan-out; return nonzero on any failed bound."""
+              storm: bool = True, traced: bool = True,
+              sanitize: bool = False) -> int:
+    """Run the wire fan-out; return nonzero on any failed bound.
+
+    ``sanitize`` defaults OFF, unlike chaos_smoke: this is the PERF
+    smoke, and its wall/budget bounds double as the proof that the
+    disabled sanitizer adds no measurable overhead — so disarmed must
+    really mean the raw pre-sanitizer hot path (plain threading
+    primitives, no proxies). The previous arm() override is restored on
+    exit: this function also runs in-process under tier-1, where the
+    suite-wide arming must survive it."""
+    os.environ.setdefault("KFTPU_SANITIZE", "1" if sanitize else "0")
+    from kubeflow_tpu.utils import sanitizer
+    prev_forced = sanitizer.forced()
+    sanitizer.arm(sanitize)
+    try:
+        if sanitize:
+            sanitizer.get_sanitizer().reset()
+        elif sanitizer.get_sanitizer() is not sanitizer.NOOP:
+            print("SMOKE FAIL: sanitizer not disarmed — perf bounds would "
+                  "measure instrumented locks")
+            return 1
+        rc = _run_phases(count, workers, budget_s, preempt, watch_kill,
+                         warm_cold, sharded, storm, traced)
+        if rc == 0 and sanitize:
+            violations = sanitizer.get_sanitizer().violations()
+            if violations:
+                for rule, msg in violations:
+                    print(f"  [{rule}] {msg}")
+                print(f"SMOKE FAIL: {len(violations)} concurrency "
+                      f"violation(s) recorded by the sanitizer")
+                return 1
+        return rc
+    finally:
+        sanitizer.arm(prev_forced)
+
+
+def _run_phases(count: int, workers: int, budget_s: float,
+                preempt: bool, watch_kill: bool, warm_cold: bool,
+                sharded: bool, storm: bool, traced: bool) -> int:
     from loadtest.start_notebooks import run_sharded, run_wire
 
     t0 = time.monotonic()
@@ -341,6 +380,10 @@ def main() -> int:
                     help="skip the tenant-LIST-storm APF phase")
     ap.add_argument("--no-trace", action="store_true",
                     help="skip the flight-recorder traced phase")
+    ap.add_argument("--sanitize", action="store_true",
+                    help="run armed (concurrency sanitizer): slower, "
+                         "fails on any recorded violation. Default off — "
+                         "the perf bounds measure the raw hot path")
     args = ap.parse_args()
     return run_smoke(args.count, args.workers, args.budget_s,
                      preempt=not args.no_preempt,
@@ -348,7 +391,8 @@ def main() -> int:
                      warm_cold=not args.no_warm_cold,
                      sharded=not args.no_sharded,
                      storm=not args.no_storm,
-                     traced=not args.no_trace)
+                     traced=not args.no_trace,
+                     sanitize=args.sanitize)
 
 
 if __name__ == "__main__":

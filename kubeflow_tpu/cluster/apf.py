@@ -49,6 +49,8 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable
 
+from ..utils import sanitizer
+
 #: default seat count: concurrency the facade will execute simultaneously.
 #: Sized well above a healthy control plane's in-flight request count (a
 #: 4-worker manager keeps ≤ ~6 requests in flight) so APF only engages
@@ -153,7 +155,8 @@ class APFDispatcher:
         self.total_seats = max(1, int(total_seats))
         self.queue_wait_s = queue_wait_s
         self.schemas = tuple(schemas)
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "apf.dispatcher", order=sanitizer.ORDER_WATCH, no_blocking=True)
         active = [lv for lv in levels if not lv.exempt]
         total_shares = sum(lv.shares for lv in active) or 1
         self._levels: dict[str, _Level] = {}

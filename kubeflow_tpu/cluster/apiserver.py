@@ -35,7 +35,7 @@ from collections import OrderedDict
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from ..utils import k8s, names, tracing
+from ..utils import k8s, names, sanitizer, tracing
 from . import apf as apf_mod
 from . import faults, restmapper
 from .errors import ApiError, ConflictError, GoneError, NotFoundError
@@ -105,11 +105,15 @@ class _WatcherQueue:
     def __init__(self, soft_limit: int = WATCH_QUEUE_SOFT_LIMIT,
                  hard_limit: int = WATCH_QUEUE_HARD_LIMIT,
                  on_coalesce=None) -> None:
-        self._cv = threading.Condition()
+        self._cv = sanitizer.tracked_condition(
+            "apiserver.watch_queue", order=sanitizer.ORDER_WATCH,
+            no_blocking=True)
         # FIFO by insertion seq; coalescing re-inserts at the tail in O(1).
         # cells: [deliver_type, frame, key, seq]
-        self._items: OrderedDict = OrderedDict()
-        self._by_key: dict = {}  # (ns, name) → pending upsert cell
+        self._items: OrderedDict = sanitizer.guarded_by(
+            OrderedDict(), self._cv, "apiserver.watch_queue.items")
+        self._by_key: dict = sanitizer.guarded_by(
+            {}, self._cv, "apiserver.watch_queue.by_key")
         self._seq = itertools.count()
         self.soft_limit = soft_limit
         self.hard_limit = hard_limit
@@ -202,7 +206,9 @@ class _KindServeCache:
 
     def __init__(self, store, kind: str) -> None:
         self.kind = kind
-        self._cv = threading.Condition()
+        self._cv = sanitizer.tracked_condition(
+            "apiserver.serve_cache", order=sanitizer.ORDER_CACHE,
+            no_blocking=True)
         self.objects: dict[tuple[str, str], dict] = {}
         self.rv = 0
         self._sorted: list | None = None
@@ -1207,8 +1213,14 @@ class ApiServerProxy:
         # server-side watch caches (consistent-read-from-cache): created
         # lazily per kind on the first rv-gated read; requires the
         # frame-relay handshake on the backing store
-        self._serve_caches: dict[str, _KindServeCache] = {}
-        self._serve_caches_lock = threading.Lock()
+        self._serve_caches_lock = sanitizer.tracked_lock(
+            "apiserver.serve_caches", order=sanitizer.ORDER_CACHE,
+            no_blocking=True)
+        self._serve_caches: dict[str, _KindServeCache] = sanitizer.guarded_by(
+            {}, self._serve_caches_lock, "apiserver.serve_caches")
+        # copy-on-write published snapshot for the lock-free read fast path
+        # (the guarded master dict is only ever touched under its lock)
+        self._serve_caches_ro: dict[str, _KindServeCache] = {}
         if hasattr(store, "snapshot_with_frames"):
             self._httpd.serve_cache = self._serve_cache  # type: ignore[attr-defined]
         self._httpd.cache_list_metric = None  # type: ignore[attr-defined]
@@ -1225,18 +1237,25 @@ class ApiServerProxy:
         # active_watch_queues lets tests assert a stalled watcher's queue
         # stays bounded while coalescing
         self._httpd.watch_coalesced_metric = None  # type: ignore[attr-defined]
-        self._httpd.active_watch_queues = set()  # type: ignore[attr-defined]
-        self._httpd.watch_queues_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd.watch_queues_lock = sanitizer.tracked_lock(  # type: ignore[attr-defined]
+            "apiserver.watch_queues", order=sanitizer.ORDER_WATCH,
+            no_blocking=True)
+        self._httpd.active_watch_queues = sanitizer.guarded_by(  # type: ignore[attr-defined]
+            set(), self._httpd.watch_queues_lock,  # type: ignore[attr-defined]
+            "apiserver.active_watch_queues")
         # accepted sockets, so stop() tears down keep-alive connections
         # (pooled clients would otherwise keep talking to a "stopped"
         # apiserver through handler threads that survive shutdown())
         self._httpd.open_connections = set()  # type: ignore[attr-defined]
-        self._httpd.conn_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd.conn_lock = sanitizer.tracked_lock(  # type: ignore[attr-defined]
+            "apiserver.conns", order=sanitizer.ORDER_WATCH,
+            no_blocking=True)
         # optional mutating-request audit trail (suite_test.go:127-157
         # analog); opened append so restarts extend the trail
         self._audit_file = open(audit_log, "a") if audit_log else None
         self._httpd.audit_log = self._audit_file  # type: ignore[attr-defined]
-        self._httpd.audit_lock = threading.Lock()  # type: ignore[attr-defined]
+        self._httpd.audit_lock = sanitizer.tracked_lock(  # type: ignore[attr-defined]
+            "apiserver.audit", order=sanitizer.ORDER_WATCH)
         self.scheme = "http"
         if certfile:
             ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
@@ -1249,15 +1268,24 @@ class ApiServerProxy:
     def _serve_cache(self, kind: str) -> "_KindServeCache | None":
         """Get-or-create the kind's server-side watch cache (the
         consistent-read store for rv-gated reads)."""
-        cache = self._serve_caches.get(kind)
+        cache = self._serve_caches_ro.get(kind)
         if cache is not None:
             return cache
+        # Build the candidate OUTSIDE the registry lock: _KindServeCache's
+        # __init__ performs the snapshot_with_frames handshake, which takes
+        # the STORE lock — holding the cache-tier registry lock across a
+        # store-tier acquisition inverts the declared store→cache order
+        # (and serialized every first-read of a new kind behind one store
+        # snapshot). Losing a creation race costs one throwaway snapshot.
+        candidate = _KindServeCache(self.store, kind)
         with self._serve_caches_lock:
             cache = self._serve_caches.get(kind)
             if cache is None:
-                cache = self._serve_caches[kind] = \
-                    _KindServeCache(self.store, kind)
-            return cache
+                cache = self._serve_caches[kind] = candidate
+                self._serve_caches_ro = dict(self._serve_caches)
+        if cache is not candidate:
+            self.store.unwatch(candidate._on_frame)
+        return cache
 
     def attach_metrics(self, registry) -> None:
         """Register the server-side watch fan-out counter, the APF flow

@@ -44,7 +44,7 @@ import threading
 import time
 from typing import Callable, Iterable
 
-from ..utils import k8s, names
+from ..utils import k8s, names, sanitizer
 from .store import WatchEvent
 
 DEFAULT_DISABLE_FOR = ("Secret", "ConfigMap")
@@ -57,8 +57,8 @@ DEFAULT_LABEL_INDEXES = (
     names.NOTEBOOK_NAME_LABEL,
     "statefulset",
     names.POOL_LABEL,
-    "opendatahub.io/runtime-image",
-    "app.kubernetes.io/part-of",
+    names.RUNTIME_IMAGE_LABEL,
+    names.PART_OF_LABEL,
 )
 
 #: object-field paths indexed by default (controller-runtime's
@@ -67,7 +67,7 @@ DEFAULT_LABEL_INDEXES = (
 #: O(pods on that node) instead of O(fleet pods) per node event
 DEFAULT_FIELD_INDEXES = ("spec.nodeName",)
 
-LAST_APPLIED_ANNOTATION = "kubectl.kubernetes.io/last-applied-configuration"
+LAST_APPLIED_ANNOTATION = names.LAST_APPLIED_ANNOTATION
 
 
 def _strip_metadata_bulk(obj: dict) -> dict:
@@ -306,7 +306,12 @@ class CachingClient:
         # duplicating every stream + LIST (the reference likewise has ONE
         # informer layer serving both dispatch and cached reads).
         self.auto_informer = auto_informer
-        self._kinds: dict[str, _KindStore] = {}
+        # cache tier: taken for index/bucket bookkeeping only — live wire
+        # reads (the miss fall-through, backfill LISTs) happen outside it
+        self._lock = sanitizer.tracked_lock(
+            "cache.index", order=sanitizer.ORDER_CACHE, no_blocking=True)
+        self._kinds: dict[str, _KindStore] = sanitizer.guarded_by(
+            {}, self._lock, "cache.kinds")
         # key → deletion time for keys DELETED by the watch stream; guards
         # the backfill (and the cache-miss fall-through) against resurrecting
         # an object whose DELETED event raced the list/get. The race window
@@ -314,7 +319,6 @@ class CachingClient:
         # the TTL this set would grow with every deletion for the process
         # lifetime
         self._tombstones: dict[tuple[str, str, str], float] = {}
-        self._lock = threading.Lock()
         self._watched: set[str] = set()
         # kinds whose backfill LIST has completed: for these a cache miss is
         # an authoritative NotFound (informer semantics) — falling through

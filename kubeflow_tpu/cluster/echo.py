@@ -43,7 +43,7 @@ import threading
 import time
 from collections import OrderedDict
 
-from ..utils import k8s
+from ..utils import k8s, sanitizer
 from ..utils.metrics import phase_record
 
 
@@ -65,7 +65,8 @@ class EchoTrackingClient:
 
     def __init__(self, client):
         self._client = client
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "echo.table", order=sanitizer.ORDER_LEAF)
         # (kind, namespace, name) → list of recent rv strings (newest last)
         self._written: OrderedDict[tuple[str, str, str], list[str]] = \
             OrderedDict()

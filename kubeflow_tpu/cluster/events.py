@@ -17,7 +17,7 @@ import hashlib
 import threading
 import time
 
-from ..utils import k8s
+from ..utils import k8s, sanitizer
 
 EVENT_KIND = "Event"
 
@@ -67,7 +67,8 @@ class EventRecorder:
         self.client = client
         self.component = component
         self.ttl_seconds = ttl_seconds
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "events.recorder", order=sanitizer.ORDER_LEAF)
         self._last_prune: dict[str, float] = {}  # namespace → monotonic time
 
     def eventf(self, involved: dict, type_: str, reason: str,

@@ -647,6 +647,7 @@ def run_experiment(doc: dict, *, notebooks: int = 2,
             # identity, and resume — and the pool must re-warm. Slice
             # atomicity is sampled throughout (pool slices included).
             from ..utils import names as nk
+            from ..utils import names
             from ..utils.k8s import get_annotation, get_label
             from .kubelet import kill_node, preempt_node
             nb0 = cluster.notebooks[0]
@@ -666,7 +667,7 @@ def run_experiment(doc: dict, *, notebooks: int = 2,
                 pool_ns, sts_name = bound.split("/", 1)
                 for pod in cluster.store.list("Pod", pool_ns,
                                               {"statefulset": sts_name}):
-                    if get_label(pod, "apps.kubernetes.io/pod-index") == "0":
+                    if get_label(pod, names.POD_INDEX_LABEL) == "0":
                         node_name = (pod.get("spec") or {}).get("nodeName")
                         break
             if not node_name:

@@ -29,6 +29,8 @@ import random
 import threading
 from dataclasses import dataclass, field
 
+from ..utils import sanitizer
+
 #: the wire verbs a rule can match (client-go's request verbs; ``watch``
 #: is a GET with ``?watch=true``, ``list`` a GET without a resource name)
 VERBS = frozenset({"get", "list", "create", "update", "patch", "delete",
@@ -105,7 +107,8 @@ class FaultPlan:
 
     def __post_init__(self) -> None:
         self._rng = random.Random(self.seed)
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "faults.plan", order=sanitizer.ORDER_LEAF)
         self._injected: dict[tuple[str, str], int] = {}
         self._fired_per_rule: dict[int, int] = {}
 

@@ -58,7 +58,7 @@ import urllib.error
 from dataclasses import dataclass
 from urllib.parse import quote, urlencode, urlsplit
 
-from ..utils import k8s, tracing
+from ..utils import k8s, sanitizer, tracing
 from . import restmapper
 from .errors import (AlreadyExistsError, ApiError, ConflictError,
                      ForbiddenError, GoneError, InvalidError, NotFoundError,
@@ -247,8 +247,11 @@ class HttpApiClient:
                       split.port or (443 if split.scheme == "https" else 80),
                       split.path.rstrip("/"))
         self._tl = threading.local()
-        self._conns: set = set()  # every pooled conn, so close() can reap
-        self._conns_lock = threading.Lock()
+        self._conns_lock = sanitizer.tracked_lock(
+            "http.conns", order=sanitizer.ORDER_WATCH, no_blocking=True)
+        # every pooled conn, so close() can reap
+        self._conns: set = sanitizer.guarded_by(
+            set(), self._conns_lock, "http.conns.pool")
         # optional apiserver health tracker (the manager's circuit
         # breaker): told about every transport-level success/failure —
         # an HTTP error response counts as SUCCESS (the server answered)
@@ -273,7 +276,8 @@ class HttpApiClient:
         # live watch responses, so close() can unblock readline() NOW
         # instead of waiting out the server's bookmark interval
         self._live_streams: set = set()
-        self._streams_lock = threading.Lock()
+        self._streams_lock = sanitizer.tracked_lock(
+            "http.streams", order=sanitizer.ORDER_WATCH, no_blocking=True)
 
     # ------------------------------------------------------------ factories
     @classmethod

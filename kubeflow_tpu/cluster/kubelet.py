@@ -39,7 +39,7 @@ import threading
 import time
 
 from ..controllers.manager import Request, Result, owner_mapper
-from ..utils import k8s, names
+from ..utils import k8s, names, sanitizer
 from . import errors
 from .store import ClusterStore
 
@@ -58,7 +58,8 @@ class _BootScheduler:
     def __init__(self, mark_ready) -> None:
         self._mark_ready = mark_ready  # fn(ns, pod_name) -> None
         self._heap: list[tuple[float, str, str]] = []
-        self._cv = threading.Condition()
+        self._cv = sanitizer.tracked_condition(
+            "kubelet.timer", order=sanitizer.ORDER_CONTROLLER)
         self._thread: threading.Thread | None = None
 
     #: an empty wheel parks this long before its thread exits — bounds
@@ -155,7 +156,7 @@ def preempt_node(client, node_name: str) -> None:
 def kill_node(client, node_name: str) -> None:
     """The termination itself: kubelet stops posting status (NotReady) and
     the taint manager marks it unreachable/NoExecute."""
-    taint_node(client, node_name, "node.kubernetes.io/unreachable",
+    taint_node(client, node_name, names.NODE_UNREACHABLE_TAINT_KEY,
                "NoExecute")
     set_node_ready(client, node_name, False, reason="NodeStatusUnknown")
 
@@ -358,7 +359,7 @@ class StatefulSetSimulator:
                         "kind": "Node",
                         "metadata": {
                             "name": node_name,
-                            "labels": {"kubeflow-tpu.org/sim-node": "true"},
+                            "labels": {names.SIM_NODE_LABEL: "true"},
                         },
                         "spec": {},
                         "status": {"conditions": [
@@ -377,7 +378,7 @@ class StatefulSetSimulator:
     def _make_pod(self, sts: dict, pod_name: str, ordinal: int,
                   selector: dict, template: dict) -> dict:
         pod_labels = dict(selector)
-        pod_labels["apps.kubernetes.io/pod-index"] = str(ordinal)
+        pod_labels[names.POD_INDEX_LABEL] = str(ordinal)
         pod = {
             "apiVersion": "v1",
             "kind": "Pod",

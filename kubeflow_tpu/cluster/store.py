@@ -33,7 +33,7 @@ from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Iterator
 
-from ..utils import k8s
+from ..utils import k8s, sanitizer
 from ..utils.names import generate_suffix
 from .errors import (AlreadyExistsError, ConflictError, GoneError,
                      InvalidError, NotFoundError)
@@ -155,7 +155,11 @@ class ClusterStore:
     of the stored object (as the real apiserver returns the canonical form)."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        # RLock (validation helpers re-enter from the write path); store
+        # tier — nothing blocking may run under it, and the cache/watch
+        # tiers may be acquired under it (frame relays) but never above it
+        self._lock = sanitizer.tracked_rlock(
+            "store.state", order=sanitizer.ORDER_STORE, no_blocking=True)
         self._objects: dict[ObjectKey, dict] = {}
         self._rv_counter = itertools.count(1)
         self._last_rv = 0  # latest issued rv — reported in LIST metadata
@@ -167,11 +171,13 @@ class ClusterStore:
         # watch frame; the pop loop below still tolerates a stale key).
         self._page_snapshot: tuple | None = None  # (kind, ns, rv, pairs)
         self._uid_counter = itertools.count(1)
-        self._watches: list[_Watch] = []
+        self._watches: list[_Watch] = sanitizer.guarded_by(
+            [], self._lock, "store.watches")
         # per-kind bounded ring of recent watch frames — the resume window
         # ``?watch=true&resourceVersion=N`` replays from instead of forcing
         # a LIST+diff resync; eviction makes such a resume answer 410 Gone
-        self._watch_rings: dict[str, _WatchRing] = {}
+        self._watch_rings: dict[str, _WatchRing] = sanitizer.guarded_by(
+            {}, self._lock, "store.watch_rings")
         self.watch_cache_capacity = WATCH_CACHE_CAPACITY
         self._evictions_metric = None  # watch_cache_evictions_total
         self._list_lock_metric = None  # store_list_lock_seconds
@@ -725,7 +731,13 @@ class ClusterStore:
         """Deregister a watch callback (watch stream teardown — the apiserver
         facade drops its per-connection relay when the HTTP client goes away)."""
         with self._lock:
-            self._watches = [w for w in self._watches if w.callback is not callback]
+            # equality, not identity: a bound method (the serve cache's
+            # _on_frame relay) is a fresh object per attribute access, and
+            # == compares __self__/__func__; for plain functions/closures
+            # == degrades to identity, so other callers are unchanged.
+            # In-place slice assignment keeps the guarded list registered.
+            self._watches[:] = [w for w in self._watches
+                                if w.callback != callback]
 
     # ----------------------------------------------------------- conveniences
     def get_or_none(self, kind: str, namespace: str, name: str) -> dict | None:

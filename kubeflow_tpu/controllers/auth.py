@@ -55,7 +55,7 @@ def new_tls_service(notebook: dict) -> dict:
             "namespace": k8s.namespace(notebook),
             "labels": {names.NOTEBOOK_NAME_LABEL: nb_name},
             "annotations": {
-                "service.beta.openshift.io/serving-cert-secret-name":
+                names.SERVING_CERT_SECRET_ANNOTATION:
                     f"{nb_name}-tls",
             },
         },

@@ -14,7 +14,7 @@ import binascii
 import logging
 
 from ..cluster import errors
-from ..utils import k8s
+from ..utils import k8s, names
 
 log = logging.getLogger("kubeflow_tpu.cacert")
 
@@ -103,7 +103,7 @@ def reconcile_ca_bundle(client, controller_namespace: str,
                 "metadata": {
                     "name": WORKBENCH_BUNDLE,
                     "namespace": user_namespace,
-                    "labels": {"opendatahub.io/managed-by": "workbenches"},
+                    "labels": {names.MANAGED_BY_LABEL: "workbenches"},
                 },
                 "data": desired_data,
             })

@@ -304,7 +304,7 @@ class CullingReconciler:
                                   {names.NOTEBOOK_NAME_LABEL:
                                    k8s.name(notebook)})
         for pod in pods:
-            if k8s.get_label(pod, "apps.kubernetes.io/pod-index", "0") == "0":
+            if k8s.get_label(pod, names.POD_INDEX_LABEL, "0") == "0":
                 return pod
         return None
 

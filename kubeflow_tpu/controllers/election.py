@@ -24,6 +24,8 @@ import time
 import uuid
 from typing import Callable
 
+from ..utils import sanitizer
+
 from ..cluster.errors import (AlreadyExistsError, ConflictError,
                               NotFoundError)
 
@@ -48,7 +50,8 @@ class LeaderElector:
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self._leading = False
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "election.state", order=sanitizer.ORDER_CONTROLLER)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 

@@ -22,7 +22,7 @@ import json
 import logging
 
 from ..cluster import errors
-from ..utils import k8s
+from ..utils import k8s, names
 from ..utils.config import ControllerConfig
 
 log = logging.getLogger("kubeflow_tpu.elyra")
@@ -30,7 +30,7 @@ log = logging.getLogger("kubeflow_tpu.elyra")
 SECRET_NAME = "ds-pipeline-config"
 VOLUME_NAME = "elyra-dsp-config"
 MOUNT_PATH = "/opt/app-root/src/.local/share/jupyter/metadata/runtimes"
-MANAGED_BY_KEY = "opendatahub.io/managed-by"
+MANAGED_BY_KEY = names.MANAGED_BY_LABEL
 MANAGED_BY_VALUE = "workbenches"
 
 

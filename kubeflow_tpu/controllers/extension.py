@@ -29,9 +29,9 @@ from .manager import Manager, Request, Result, owner_mapper
 
 log = logging.getLogger("kubeflow_tpu.extension")
 
-FINALIZER_ROUTES = "kubeflow-tpu.org/route-cleanup"
-FINALIZER_REFGRANT = "kubeflow-tpu.org/referencegrant-cleanup"
-FINALIZER_CRB = "kubeflow-tpu.org/crb-cleanup"
+FINALIZER_ROUTES = names.ROUTES_CLEANUP_FINALIZER
+FINALIZER_REFGRANT = names.REFGRANT_CLEANUP_FINALIZER
+FINALIZER_CRB = names.CRB_CLEANUP_FINALIZER
 ALL_FINALIZERS = (FINALIZER_ROUTES, FINALIZER_REFGRANT, FINALIZER_CRB)
 
 

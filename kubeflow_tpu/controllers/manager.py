@@ -79,7 +79,7 @@ from dataclasses import dataclass, field
 from typing import Callable, Protocol
 
 from ..cluster.store import WatchEvent
-from ..utils import k8s, names, tracing
+from ..utils import k8s, names, sanitizer, tracing
 from ..utils import logging as logging_mod
 from ..utils import metrics as metrics_mod
 
@@ -167,7 +167,8 @@ class Manager:
         # events overwrite — the reconcile observes the LAST cause, the
         # level-triggered analog of event coalescing.
         self._key_trace: dict[tuple[str, Request], tuple] = {}
-        self._cv = threading.Condition()
+        self._cv = sanitizer.tracked_condition(
+            "manager.workqueue", order=sanitizer.ORDER_CONTROLLER)
         self._seq = 0
         self._running = False
         self._threads: list[threading.Thread] = []

@@ -34,7 +34,7 @@ def new_notebook_network_policy(notebook: dict, controller_namespace: str) -> di
             "policyTypes": ["Ingress"],
             "ingress": [{
                 "from": [{"namespaceSelector": {"matchLabels": {
-                    "kubernetes.io/metadata.name": controller_namespace,
+                    names.NAMESPACE_NAME_LABEL: controller_namespace,
                 }}}],
                 "ports": [{"protocol": "TCP", "port": 8888}],
             }],

@@ -520,8 +520,8 @@ class NotebookReconciler:
             return  # structurally invalid CR; admission validation rejects these
         resources = container.setdefault("resources", {})
         qty = str(slice_spec.chips_per_worker)
-        resources.setdefault("requests", {})["google.com/tpu"] = qty
-        resources.setdefault("limits", {})["google.com/tpu"] = qty
+        resources.setdefault("requests", {})[names.TPU_RESOURCE_KEY] = qty
+        resources.setdefault("limits", {})[names.TPU_RESOURCE_KEY] = qty
 
         headless = headless_service_name(nb_name)
         if slice_spec.multi_host:
@@ -535,7 +535,7 @@ class NotebookReconciler:
         # Worker id = StatefulSet pod ordinal, surfaced by the apps controller
         # as the pod-index label (stable across pod restarts).
         k8s.upsert_env_from(container, "TPU_WORKER_ID", {"fieldRef": {
-            "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"}})
+            "fieldPath": f"metadata.labels['{names.POD_INDEX_LABEL}']"}})
         k8s.upsert_env(container, "TPU_ACCELERATOR_TYPE", slice_spec.short_name)
         k8s.upsert_env(container, "TPU_TOPOLOGY", slice_spec.topology_str)
 

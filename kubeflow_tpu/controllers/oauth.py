@@ -18,13 +18,13 @@ from __future__ import annotations
 import logging
 
 from ..cluster import errors
-from ..utils import k8s
+from ..utils import k8s, names
 
 log = logging.getLogger("kubeflow_tpu.oauth")
 
 OAUTH_CLIENT_KIND = "OAuthClient"
 # the legacy finalizer old controllers stamped on Notebooks
-LEGACY_OAUTH_FINALIZER = "notebooks.kubeflow-tpu.org/oauth-client"
+LEGACY_OAUTH_FINALIZER = names.LEGACY_OAUTH_FINALIZER
 
 
 def oauth_client_name(namespace: str, name: str) -> str:

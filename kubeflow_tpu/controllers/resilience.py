@@ -38,6 +38,8 @@ import logging
 import threading
 import time
 
+from ..utils import sanitizer
+
 log = logging.getLogger("kubeflow_tpu.resilience")
 
 STATE_CLOSED = "closed"
@@ -61,7 +63,8 @@ class TokenBucket:
         self._clock = clock
         self._tokens = self.burst
         self._last = clock()
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "resilience.ratelimiter", order=sanitizer.ORDER_LEAF)
 
     def next_delay(self) -> float:
         """Reserve one token; seconds the caller should wait before acting
@@ -97,8 +100,10 @@ class CircuitBreaker:
         self.on_resume = on_resume
         self.on_open = on_open
         self._clock = clock
-        self._lock = threading.Lock()
-        self._probe_lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "breaker.state", order=sanitizer.ORDER_CONTROLLER)
+        self._probe_lock = sanitizer.tracked_lock(
+            "breaker.probe", order=sanitizer.ORDER_CONTROLLER)
         self._state = STATE_CLOSED
         self._consecutive_failures = 0
         self._opened_at: float | None = None
@@ -172,9 +177,9 @@ class CircuitBreaker:
                     self._clock() < self._next_probe_at:
                 return False
             self._transition_locked(STATE_HALF_OPEN)
-        if not self._probe_lock.acquire(blocking=False):
-            return False
-        try:
+        with sanitizer.try_lock(self._probe_lock) as got:
+            if not got:
+                return False
             ok = False
             try:
                 ok = bool(self.probe())
@@ -196,8 +201,6 @@ class CircuitBreaker:
             if ok and changed:
                 self._resume()
             return True
-        finally:
-            self._probe_lock.release()
 
     # ------------------------------------------------------------ plumbing
     def _transition_locked(self, to_state: str) -> bool:

@@ -126,7 +126,7 @@ def new_reference_grant(namespace: str, config: ControllerConfig) -> dict:
         "metadata": {
             "name": REFERENCE_GRANT_NAME,
             "namespace": namespace,
-            "labels": {"opendatahub.io/managed-by": "workbenches"},
+            "labels": {names.MANAGED_BY_LABEL: "workbenches"},
         },
         "spec": {
             "from": [{

@@ -14,12 +14,12 @@ import logging
 import re
 
 from ..cluster import errors
-from ..utils import k8s
+from ..utils import k8s, names
 
 log = logging.getLogger("kubeflow_tpu.runtime_images")
 
-RUNTIME_IMAGE_LABEL = "opendatahub.io/runtime-image"
-METADATA_ANNOTATION = "opendatahub.io/runtime-image-metadata"
+RUNTIME_IMAGE_LABEL = names.RUNTIME_IMAGE_LABEL
+METADATA_ANNOTATION = names.RUNTIME_IMAGE_METADATA_ANNOTATION
 CONFIGMAP_NAME = "pipeline-runtime-images"
 
 _invalid_chars = re.compile(r"[^-._a-zA-Z0-9]+")
@@ -121,7 +121,7 @@ def sync_runtime_images_config_map(client, controller_namespace: str,
                 "metadata": {
                     "name": CONFIGMAP_NAME,
                     "namespace": user_namespace,
-                    "labels": {"opendatahub.io/managed-by": "workbenches"},
+                    "labels": {names.MANAGED_BY_LABEL: "workbenches"},
                 },
                 "data": data,
             })

@@ -49,7 +49,7 @@ from ..api import slicepool as pool_api
 from ..api import types as api
 from ..cluster import errors, events
 from ..tpu.topology import SliceSpec, parse_short_name
-from ..utils import k8s, names, tracing
+from ..utils import k8s, names, sanitizer, tracing
 from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
 from .manager import Manager, Request, Result
@@ -129,7 +129,8 @@ class SlicePoolReconciler:
         self.clock = clock
         self.recorder = events.EventRecorder(client, component=self.name)
         self._read_cache = None
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "slicepool.state", order=sanitizer.ORDER_CONTROLLER)
         # (ns, nb) → monotonic time first seen pending, for bind latency
         self._first_pending: dict[tuple[str, str], float] = {}
         # pending-scan gating: a pool scans the Notebook fleet only when a
@@ -565,16 +566,16 @@ class SlicePoolReconciler:
             "name": "warm-slice",
             "image": self.config.tpu_default_image,
             "resources": {
-                "requests": {"google.com/tpu":
+                "requests": {names.TPU_RESOURCE_KEY:
                              str(slice_spec.chips_per_worker)},
-                "limits": {"google.com/tpu":
+                "limits": {names.TPU_RESOURCE_KEY:
                            str(slice_spec.chips_per_worker)},
             },
         }
         k8s.upsert_env(container, "TPU_WORKER_HOSTNAMES",
                        slice_hostnames(slice_spec, name, pool_ns))
         k8s.upsert_env_from(container, "TPU_WORKER_ID", {"fieldRef": {
-            "fieldPath": "metadata.labels['apps.kubernetes.io/pod-index']"}})
+            "fieldPath": f"metadata.labels['{names.POD_INDEX_LABEL}']"}})
         k8s.upsert_env(container, "TPU_ACCELERATOR_TYPE",
                        slice_spec.short_name)
         k8s.upsert_env(container, "TPU_TOPOLOGY", slice_spec.topology_str)

@@ -49,7 +49,7 @@ from ..api import slicepool as pool_api
 from ..api import types as api
 from ..cluster import events
 from ..tpu.topology import SliceSpec, TpuRequestError, parse_slice_request
-from ..utils import k8s, names, tracing
+from ..utils import k8s, names, sanitizer, tracing
 from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
 from .manager import Manager, Request, Result
@@ -126,7 +126,8 @@ class SliceRepairReconciler:
         # a restarted controller starting its first repair immediately is
         # correct — the QUARANTINE window, which must survive restarts,
         # rides the repair-failures annotation instead)
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "slicerepair.state", order=sanitizer.ORDER_CONTROLLER)
         self._backoff: dict[tuple[str, str], float] = {}
         self._not_before: dict[tuple[str, str], float] = {}
         # label combinations the slice_degraded gauge has ever exported —

@@ -30,6 +30,7 @@ import yaml
 
 from ..api import schema
 from ..api import types as api
+from ..utils import names
 
 MANAGER_IMAGE_PARAM = "kubeflow-tpu-notebook-controller"
 DEFAULT_MANAGER_IMAGE = \
@@ -464,7 +465,7 @@ def webhook_objects() -> list[dict]:
         "metadata": {
             "name": "kubeflow-tpu-webhook-service",
             "namespace": NAMESPACE,
-            "annotations": {"service.beta.openshift.io/serving-cert-secret-name":
+            "annotations": {names.SERVING_CERT_SECRET_ANNOTATION:
                             "kubeflow-tpu-webhook-certs"}},
         "spec": {
             "ports": [{"port": 443, "targetPort": 8443,
@@ -484,8 +485,7 @@ def webhook_objects() -> list[dict]:
         "kind": "MutatingWebhookConfiguration",
         "metadata": {
             "name": "kubeflow-tpu-mutating-webhook",
-            "annotations": {"service.beta.openshift.io/inject-cabundle":
-                            "true"}},
+            "annotations": {names.INJECT_CABUNDLE_ANNOTATION: "true"}},
         "webhooks": [{
             "name": f"notebooks.{api.GROUP}",
             "admissionReviewVersions": ["v1"],
@@ -500,8 +500,7 @@ def webhook_objects() -> list[dict]:
         "kind": "ValidatingWebhookConfiguration",
         "metadata": {
             "name": "kubeflow-tpu-validating-webhook",
-            "annotations": {"service.beta.openshift.io/inject-cabundle":
-                            "true"}},
+            "annotations": {names.INJECT_CABUNDLE_ANNOTATION: "true"}},
         "webhooks": [{
             "name": f"validating.notebooks.{api.GROUP}",
             "admissionReviewVersions": ["v1"],

@@ -41,6 +41,8 @@ from concurrent.futures import CancelledError, Future
 from dataclasses import dataclass, field
 from functools import partial
 
+from ..utils import sanitizer
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -131,7 +133,8 @@ class BatchedGenerator:
         self._pending: collections.deque = collections.deque()
         self._key = jax.random.key(seed)
         self._closed = False
-        self._lifecycle = threading.Lock()  # submit/close atomicity
+        self._lifecycle = sanitizer.tracked_lock(  # submit/close atomicity
+            "serving.lifecycle", order=sanitizer.ORDER_CONTROLLER)
         self.batch_sizes: collections.deque = collections.deque(maxlen=1024)
         self.batches_total = 0
         self.requests_total = 0
@@ -443,7 +446,8 @@ class ContinuousBatchedGenerator:
         self._admitting: dict[int, _Admission] = {}
         self._key = jax.random.key(seed)
         self._closed = False
-        self._lifecycle = threading.Lock()
+        self._lifecycle = sanitizer.tracked_lock(
+            "serving.lifecycle", order=sanitizer.ORDER_CONTROLLER)
         # metrics: the serving-test observable — how many requests were
         # admitted while other rows were mid-generation
         # requests_total counts SUBMISSIONS (like BatchedGenerator's) —

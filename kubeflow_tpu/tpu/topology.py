@@ -88,8 +88,8 @@ class SliceSpec:
 
     def node_selectors(self) -> dict[str, str]:
         return {
-            "cloud.google.com/gke-tpu-accelerator": self.gke_accelerator,
-            "cloud.google.com/gke-tpu-topology": self.topology_str,
+            names.GKE_TPU_ACCELERATOR_LABEL: self.gke_accelerator,
+            names.GKE_TPU_TOPOLOGY_LABEL: self.topology_str,
         }
 
     def worker_hostnames(self, sts_name: str, headless_svc: str,

@@ -25,6 +25,8 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable
 
+from . import sanitizer
+
 log = logging.getLogger("kubeflow_tpu.health")
 
 
@@ -37,7 +39,8 @@ class HealthServer:
         self.flight_recorder = flight_recorder
         self._checks: dict[str, Callable[[], bool]] = {}
         self._ready_checks: dict[str, Callable[[], bool]] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "health.checks", order=sanitizer.ORDER_LEAF)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):

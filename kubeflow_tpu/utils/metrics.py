@@ -14,6 +14,71 @@ import threading
 import time
 from typing import Callable
 
+from . import sanitizer
+
+# Every metric family name constructed anywhere in the package (one
+# dynamic exception: runtime/server.py's scrape-mirrored
+# ``serving_engine_<counter>`` gauges, whose names come from the engine).
+# ci/lint.py's metric-catalog rule parses this literal from the AST and
+# rejects any ``.counter("x", ...)``/``.gauge``/``.histogram`` whose
+# literal name is missing — so a new family is a deliberate, reviewed
+# addition to the exposition surface, never an accidental one.
+METRIC_FAMILY_CATALOG = frozenset({
+    # reference notebook metrics (metrics.go:13-99)
+    "notebook_create_total",
+    "notebook_create_failed_total",
+    "notebook_culling_total",
+    "last_notebook_culling_timestamp_seconds",
+    "notebook_running",
+    # controller-runtime analogs (manager)
+    "controller_runtime_reconcile_total",
+    "workqueue_adds_total",
+    "workqueue_retries_total",
+    "workqueue_queue_duration_seconds",
+    "workqueue_work_duration_seconds",
+    "workqueue_depth",
+    "workqueue_unfinished_work_seconds",
+    "workqueue_longest_running_processor_seconds",
+    "reconcile_read_seconds",
+    "reconcile_write_seconds",
+    # sharding / resilience
+    "shard_ownership",
+    "shard_rebalance_total",
+    "apiserver_available",
+    "apiserver_breaker_state",
+    "apiserver_breaker_transitions_total",
+    # slice pool / repair
+    "slicepool_bind_latency_seconds",
+    "slicepool_bind_misses_total",
+    "slicepool_size",
+    "slice_repairs_total",
+    "slice_repair_duration_seconds",
+    "slice_quarantines_total",
+    "slice_degraded",
+    "notebook_migrations_total",
+    # serving
+    "serving_http_requests_total",
+    "serving_generate_seconds_sum",
+    "serving_generate_seconds_count",
+    # apiserver wire / store / cache
+    "apf_dispatched_total",
+    "apf_rejected_total",
+    "apf_current_inqueue",
+    "cache_index_lookups_total",
+    "cache_full_scans_total",
+    "rest_client_requests_total",
+    "rest_client_retries_total",
+    "rest_client_request_duration_seconds",
+    "rest_client_connections_opened_total",
+    "watch_resumes_total",
+    "watch_cache_evictions_total",
+    "store_list_lock_seconds",
+    "watch_queue_coalesced_total",
+    "apiserver_cache_lists_total",
+    # concurrency sanitizer
+    "sanitizer_violations_total",
+})
+
 
 def _escape_label_value(value: object) -> str:
     """Prometheus exposition escaping for label values: backslash, double
@@ -49,7 +114,8 @@ class _Metric:
         self.help = help_
         self.type = type_
         self._values: dict[tuple, float] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "metrics.family", order=sanitizer.ORDER_LEAF)
 
     def _labels_key(self, labels: dict[str, str] | None) -> tuple:
         return tuple(sorted((labels or {}).items()))
@@ -119,7 +185,8 @@ class _Histogram:
         # recent exemplared observation, attached at exposition to the
         # bucket the value fell into (OpenMetrics exemplar semantics)
         self._exemplars: dict[tuple, tuple[dict[str, str], float, float]] = {}
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "metrics.family", order=sanitizer.ORDER_LEAF)
 
     def _labels_key(self, labels: dict[str, str] | None) -> tuple:
         return tuple(sorted((labels or {}).items()))

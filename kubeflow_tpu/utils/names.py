@@ -175,6 +175,37 @@ TRACE_CONTEXT_ANNOTATION = "tpu.kubeflow.org/trace-context"
 # is the single-listener fallback.
 PROXY_BACKEND_ANNOTATION = "tpu.kubeflow.org/proxy-backend"
 
+# --- well-known upstream/platform keys (lint rule: annotation-literal) ---
+# Every domain-qualified annotation/label/taint/resource key the package
+# references lives here; ci/lint.py rejects inline copies, which drift
+# from the canonical spelling and break round-tripping.
+RUNTIME_IMAGE_LABEL = "opendatahub.io/runtime-image"
+RUNTIME_IMAGE_METADATA_ANNOTATION = "opendatahub.io/runtime-image-metadata"
+MANAGED_BY_LABEL = "opendatahub.io/managed-by"
+PART_OF_LABEL = "app.kubernetes.io/part-of"
+LAST_APPLIED_ANNOTATION = "kubectl.kubernetes.io/last-applied-configuration"
+# StatefulSet pod ordinal label (stable since k8s 1.28); worker-0 selection
+POD_INDEX_LABEL = "apps.kubernetes.io/pod-index"
+# taint the node-lifecycle manager applies to an unreachable node
+NODE_UNREACHABLE_TAINT_KEY = "node.kubernetes.io/unreachable"
+# immutable namespace-name label (NamespaceDefaultLabelName)
+NAMESPACE_NAME_LABEL = "kubernetes.io/metadata.name"
+SERVING_CERT_SECRET_ANNOTATION = (
+    "service.beta.openshift.io/serving-cert-secret-name")
+INJECT_CABUNDLE_ANNOTATION = "service.beta.openshift.io/inject-cabundle"
+# extended-resource key TPU chips are requested under
+TPU_RESOURCE_KEY = "google.com/tpu"
+GKE_TPU_ACCELERATOR_LABEL = "cloud.google.com/gke-tpu-accelerator"
+GKE_TPU_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+# node minted by the kubelet simulator (cluster/kubelet.py)
+SIM_NODE_LABEL = "kubeflow-tpu.org/sim-node"
+# extension-manager finalizers (controllers/extension.py)
+ROUTES_CLEANUP_FINALIZER = "kubeflow-tpu.org/route-cleanup"
+REFGRANT_CLEANUP_FINALIZER = "kubeflow-tpu.org/referencegrant-cleanup"
+CRB_CLEANUP_FINALIZER = "kubeflow-tpu.org/crb-cleanup"
+# the legacy finalizer old controllers stamped on Notebooks
+LEGACY_OAUTH_FINALIZER = "notebooks.kubeflow-tpu.org/oauth-client"
+
 # Kubernetes DNS-1123 subdomain limit for the pod hostname contributed by the
 # StatefulSet name; the reference caps STS names at 52 chars so the "-<ordinal>"
 # suffixed pod name stays a valid label (notebook_controller.go:59,144-149).

@@ -1,0 +1,639 @@
+"""Concurrency sanitizer: lock-order + lockset (guarded-by) checking.
+
+The control plane is genuinely concurrent — sharded managers with worker
+pools, watch fan-out queues fed under the store lock, keep-alive
+connection pools, APF dispatch, breaker/election threads — and its
+hardest historical bugs (the rv-inversion under out-of-lock dispatch,
+silent watch-thread death, the GIL lease convoy) were ordering bugs
+caught late by chaos timing. This module makes lock discipline
+machine-checked instead of folklore, in the same shape as
+``utils/tracing.py``: a no-op singleton when disabled (the production
+default — ``tracked_lock()`` returns a plain ``threading.Lock``,
+``guarded_by()`` returns the structure itself, nothing is allocated on
+the hot path) and a recording ``Sanitizer`` when armed.
+
+Armed (env ``KFTPU_SANITIZE=1`` — the default under pytest via
+``tests/conftest.py`` and under ``ci/chaos_smoke.py``), three detectors
+run:
+
+1. **lock-order**: every lock built by ``tracked_lock(name, order=...)``
+   /``tracked_rlock``/``tracked_condition`` records a per-thread
+   held-lock stack and feeds a global acquisition graph (edge A→B =
+   "B acquired while A held"). A cycle in the graph is a potential
+   deadlock (``lock-order-cycle``); acquiring a lock whose declared
+   ``order`` is LOWER than the highest order currently held violates
+   the declared hierarchy (``lock-hierarchy`` — the ARCHITECTURE.md
+   "Concurrency correctness" table is the source of truth: orders
+   ascend outer→inner).
+2. **blocking-under-lock**: ``time.sleep`` and socket
+   connect/recv/send executed while a ``no_blocking`` lock (the
+   store/cache/watch-queue tiers) is held are flagged — wire I/O under
+   those locks convoys every writer behind one slow peer.
+3. **lockset**: ``guarded_by(obj, lock, name)`` wraps a hot shared
+   structure (watch ring, serve-cache registry, cache buckets, watcher
+   queues, pool state) in a proxy that records a violation whenever it
+   is touched without the declared lock held — the unsynchronized
+   access chaos timing happens to miss.
+
+Violations are RECORDED (deduplicated), never raised into the code
+under test: a long armed soak exports them via
+``sanitizer_violations_total{rule}`` (``attach_metrics``), the tier-1
+gate asserts ``violations() == []``, and ``check()`` raises for
+callers that want a hard stop. ``ci/lint.py`` enforces statically that
+every ``threading.Lock/RLock/Condition`` in the package goes through
+this factory.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+
+# rule ids — the ``rule`` label of sanitizer_violations_total
+RULE_CYCLE = "lock-order-cycle"
+RULE_HIERARCHY = "lock-hierarchy"
+RULE_BLOCKING = "blocking-under-lock"
+RULE_LOCKSET = "lockset-unguarded"
+
+# declared hierarchy tiers: orders ascend from outermost (acquired first)
+# to innermost. See ARCHITECTURE.md "Concurrency correctness" for the
+# full per-lock table.
+ORDER_CONTROLLER = 10   # manager workqueue, controller state, breakers
+ORDER_STORE = 20        # the apiserver store's write-path lock
+ORDER_CACHE = 30        # serve caches, client read-cache index
+ORDER_WATCH = 40        # watcher queues, conn pools, APF dispatch
+ORDER_LEAF = 50         # metrics, tracing, events, health — call nothing
+
+# the raw constructors, captured once: the factory (and ONLY the
+# factory — ci/lint.py's raw-lock rule) may build undecorated primitives
+_RAW_LOCK = threading.Lock
+_RAW_RLOCK = threading.RLock
+_RAW_CONDITION = threading.Condition
+
+_TRUTHY_OFF = ("", "0", "false", "no", "off")
+
+
+def _env_armed() -> bool:
+    return os.environ.get("KFTPU_SANITIZE", "").lower() not in _TRUTHY_OFF
+
+
+# explicit override (arm()/disarm()) wins over the environment
+_forced: bool | None = None
+
+
+def is_armed() -> bool:
+    if _forced is not None:
+        return _forced
+    return _env_armed()
+
+
+class _NoopSanitizer:
+    """The disabled-mode singleton (identity-checked by tests, like
+    tracing's NoopProvider): every query returns empty, every hook is a
+    no-op, and the factory never routes hot-path calls through it."""
+
+    armed = False
+
+    def violations(self) -> list:
+        return []
+
+    def counts(self) -> dict:
+        return {}
+
+    def reset(self) -> None: ...
+
+    def check(self) -> None: ...
+
+    def attach_metrics(self, registry) -> None: ...
+
+
+NOOP = _NoopSanitizer()
+
+_active: "Sanitizer | None" = None
+_active_guard = _RAW_LOCK()
+
+
+class Sanitizer:
+    """The armed detector. One instance per process (``get_sanitizer``);
+    its own registry lock is a raw leaf primitive that never wraps a
+    tracked acquisition, so the sanitizer cannot deadlock the code it
+    watches."""
+
+    armed = True
+
+    def __init__(self) -> None:
+        self._reg_lock = _RAW_LOCK()
+        self._tls = threading.local()
+        # acquisition graph over lock NAMES: edges[a] = names acquired
+        # while a was held. Name-level (not instance-level) so the
+        # invariant generalizes across instances of the same role.
+        self._edges: dict[str, set[str]] = {}
+        self._violations: list[tuple[str, str]] = []
+        self._seen: set[tuple[str, str]] = set()
+        self._metric = None  # sanitizer_violations_total
+
+    # ------------------------------------------------------------ queries
+    def violations(self) -> list[tuple[str, str]]:
+        with self._reg_lock:
+            return list(self._violations)
+
+    def counts(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        with self._reg_lock:
+            for rule, _ in self._violations:
+                out[rule] = out.get(rule, 0) + 1
+        return out
+
+    def reset(self) -> None:
+        """Clear recorded violations AND the acquisition graph — per-test
+        isolation (the metric, a counter, keeps its monotonic total)."""
+        with self._reg_lock:
+            self._violations.clear()
+            self._seen.clear()
+            self._edges.clear()
+
+    def check(self) -> None:
+        vs = self.violations()
+        if vs:
+            lines = "\n".join(f"  [{rule}] {msg}" for rule, msg in vs)
+            raise AssertionError(
+                f"sanitizer recorded {len(vs)} violation(s):\n{lines}")
+
+    def attach_metrics(self, registry) -> None:
+        self._metric = registry.counter(
+            "sanitizer_violations_total",
+            "Concurrency-sanitizer violations recorded, by rule "
+            "(lock-order-cycle, lock-hierarchy, blocking-under-lock, "
+            "lockset-unguarded) — an armed soak exports these instead "
+            "of only raising.")
+
+    # ---------------------------------------------------------- recording
+    def record(self, rule: str, message: str) -> None:
+        key = (rule, message)
+        with self._reg_lock:
+            if key in self._seen:
+                return
+            self._seen.add(key)
+            self._violations.append(key)
+            metric = self._metric
+        if metric is not None:
+            # the metric's own tracked lock must not re-enter the checks
+            self._tls.busy = True
+            try:
+                metric.inc({"rule": rule})
+            finally:
+                self._tls.busy = False
+
+    # ------------------------------------------------------- held tracking
+    def _held(self) -> list:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = []
+            self._tls.held = held
+        return held
+
+    def holds(self, lock) -> bool:
+        lock = getattr(lock, "_kt_lock_part", lock)
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return False
+        return any(h is lock for h in held)
+
+    def note_intent(self, lock) -> None:
+        """Pre-acquire checks (hierarchy + graph edges + cycle). Runs
+        BEFORE the blocking acquire so a would-be deadlock is recorded
+        even if the thread then parks forever."""
+        if getattr(self._tls, "busy", False):
+            return
+        held = self._held()
+        if not held:
+            return
+        if any(h is lock for h in held):
+            return  # RLock re-entry: no new edge, no new constraint
+        max_order, max_name = None, ""
+        names = {}
+        for h in held:
+            names[h.name] = h
+            if h.order is not None and (max_order is None
+                                        or h.order > max_order):
+                max_order, max_name = h.order, h.name
+        if lock.order is not None and max_order is not None \
+                and lock.order < max_order:
+            self.record(RULE_HIERARCHY,
+                        f"acquired {lock.name!r} (order {lock.order}) while "
+                        f"holding {max_name!r} (order {max_order}); the "
+                        f"declared hierarchy ascends outer-to-inner")
+        for name in names:
+            if name != lock.name:
+                self._note_edge(name, lock.name)
+
+    def note_acquired(self, lock) -> None:
+        self._held().append(lock)
+
+    def note_released(self, lock) -> None:
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is lock:
+                del held[i]
+                return
+
+    def release_all(self, lock) -> int:
+        """Pop EVERY held entry of ``lock`` (Condition.wait releases an
+        RLock completely); returns the count for reacquire_n."""
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return 0
+        n = len(held)
+        held[:] = [h for h in held if h is not lock]
+        return n - len(held)
+
+    def reacquire_n(self, lock, n: int) -> None:
+        held = self._held()
+        for _ in range(n):
+            held.append(lock)
+
+    def _note_edge(self, a: str, b: str) -> None:
+        with self._reg_lock:
+            succ = self._edges.setdefault(a, set())
+            if b in succ:
+                return
+            succ.add(b)
+            path = self._find_path(b, a)
+        if path is not None:
+            cycle = " -> ".join([a] + path)
+            self.record(RULE_CYCLE,
+                        f"lock acquisition cycle (potential deadlock): "
+                        f"{cycle}")
+
+    def _find_path(self, src: str, dst: str) -> list[str] | None:
+        """DFS path src→dst over the edge graph (caller holds _reg_lock);
+        returns the node list src..dst or None."""
+        stack = [(src, [src])]
+        visited = {src}
+        while stack:
+            node, path = stack.pop()
+            if node == dst:
+                return path
+            for nxt in self._edges.get(node, ()):
+                if nxt not in visited:
+                    visited.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # ------------------------------------------------------ blocking calls
+    def note_blocking(self, what: str) -> None:
+        if getattr(self._tls, "busy", False):
+            return
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return
+        for h in held:
+            if h.no_blocking:
+                self.record(RULE_BLOCKING,
+                            f"blocking call ({what}) while holding "
+                            f"{h.name!r} — a no-blocking-tier lock")
+                return
+
+    def note_wait(self, cv_lock) -> None:
+        """Condition.wait releases its OWN lock but parks the thread while
+        every OTHER held lock stays held — flag if any of those is a
+        no-blocking-tier lock."""
+        if getattr(self._tls, "busy", False):
+            return
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return
+        for h in held:
+            if h is not cv_lock and h.no_blocking:
+                self.record(RULE_BLOCKING,
+                            f"condition wait on {cv_lock.name!r} while "
+                            f"holding {h.name!r} — a no-blocking-tier lock")
+                return
+
+    # -------------------------------------------------------- guard checks
+    def guard_check(self, name: str, lock) -> None:
+        if getattr(self._tls, "busy", False):
+            return
+        if not self.holds(lock):
+            self.record(RULE_LOCKSET,
+                        f"unsynchronized access to {name!r} (declared "
+                        f"guarded_by {lock.name!r}) — lock not held by "
+                        f"the accessing thread")
+
+
+def get_sanitizer() -> "Sanitizer | _NoopSanitizer":
+    """The process sanitizer: the recording instance when armed, the
+    shared no-op singleton otherwise (identity-stable, like
+    ``tracing.get_provider`` with the default NoopProvider)."""
+    if not is_armed():
+        return NOOP
+    return _ensure_active()
+
+
+def _ensure_active() -> Sanitizer:
+    global _active
+    san = _active
+    if san is None:
+        with _active_guard:
+            san = _active
+            if san is None:
+                san = _active = Sanitizer()
+                _install_blocking_hooks()
+    return san
+
+
+def arm(enabled: bool | None = True) -> None:
+    """Explicitly arm/disarm for this process (overrides the env flag;
+    ``None`` clears the override so the env decides again). Arming
+    installs the blocking-call hooks; locks constructed WHILE armed are
+    tracked — already-constructed raw locks stay raw, the same
+    construct-time binding tracing's provider swap has."""
+    global _forced
+    _forced = enabled
+    if enabled:
+        _ensure_active()
+
+
+def forced() -> bool | None:
+    """The current arm() override (None = env decides) — callers that
+    arm temporarily (the smoke CLIs run in-process under tier-1) save
+    this and restore it so the suite-wide arming survives them."""
+    return _forced
+
+
+# ------------------------------------------------------------- lock factory
+
+class _TrackedLock:
+    """A tracked Lock/RLock: same acquire/release/context protocol over
+    the raw primitive, with held-stack bookkeeping and pre-acquire
+    ordering checks routed through the process Sanitizer."""
+
+    __slots__ = ("_inner", "name", "order", "no_blocking", "_san")
+
+    def __init__(self, inner, name: str, order: int | None,
+                 no_blocking: bool, san: Sanitizer) -> None:
+        self._inner = inner
+        self.name = name
+        self.order = order
+        self.no_blocking = no_blocking
+        self._san = san
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        self._san.note_intent(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._san.note_acquired(self)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        self._san.note_released(self)
+
+    def __enter__(self) -> "_TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        probe = getattr(self._inner, "locked", None)
+        return probe() if probe is not None else False
+
+    def __repr__(self) -> str:
+        return f"<TrackedLock {self.name} order={self.order}>"
+
+
+class _TrackedCondition:
+    """A tracked Condition: a tracked RLock for the bookkeeping plus a
+    raw Condition built on that lock's INNER primitive, so the stdlib
+    wait/notify machinery runs untouched while wait() keeps the
+    held-stack honest (the lock is released for the park, every OTHER
+    held no-blocking lock is flagged)."""
+
+    __slots__ = ("_lock", "_cond", "_san", "_kt_lock_part")
+
+    def __init__(self, name: str, order: int | None, no_blocking: bool,
+                 san: Sanitizer) -> None:
+        self._lock = _TrackedLock(_RAW_RLOCK(), name, order, no_blocking,
+                                  san)
+        self._cond = _RAW_CONDITION(self._lock._inner)
+        self._san = san
+        # guarded_by(structure, <this condition>) guards on the lock part
+        self._kt_lock_part = self._lock
+
+    @property
+    def name(self) -> str:
+        return self._lock.name
+
+    def __enter__(self) -> "_TrackedCondition":
+        self._lock.__enter__()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        return self._lock.__exit__(*exc)
+
+    def wait(self, timeout: float | None = None) -> bool:
+        self._san.note_wait(self._lock)
+        n = self._san.release_all(self._lock)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            self._san.reacquire_n(self._lock, n)
+
+    def wait_for(self, predicate, timeout: float | None = None):
+        # stdlib loop re-implemented over our wait() so the bookkeeping
+        # holds across every park
+        endtime = None
+        result = predicate()
+        while not result:
+            if timeout is not None:
+                if endtime is None:
+                    endtime = time.monotonic() + timeout
+                waittime = endtime - time.monotonic()
+                if waittime <= 0:
+                    break
+                self.wait(waittime)
+            else:
+                self.wait()
+            result = predicate()
+        return result
+
+    def notify(self, n: int = 1) -> None:
+        self._cond.notify(n)
+
+    def notify_all(self) -> None:
+        self._cond.notify_all()
+
+    def __repr__(self) -> str:
+        return f"<TrackedCondition {self._lock.name}>"
+
+
+def tracked_lock(name: str, *, order: int | None = None,
+                 no_blocking: bool = False):
+    """The package-wide Lock constructor (ci/lint.py's raw-lock rule
+    rejects bare ``threading.Lock()``). Disabled → a plain
+    ``threading.Lock`` — byte-for-byte the pre-sanitizer hot path."""
+    if not is_armed():
+        return _RAW_LOCK()
+    return _TrackedLock(_RAW_LOCK(), name, order, no_blocking,
+                        _ensure_active())
+
+
+def tracked_rlock(name: str, *, order: int | None = None,
+                  no_blocking: bool = False):
+    if not is_armed():
+        return _RAW_RLOCK()
+    return _TrackedLock(_RAW_RLOCK(), name, order, no_blocking,
+                        _ensure_active())
+
+
+def tracked_condition(name: str, *, order: int | None = None,
+                      no_blocking: bool = False):
+    if not is_armed():
+        return _RAW_CONDITION()
+    return _TrackedCondition(name, order, no_blocking, _ensure_active())
+
+
+class _TryLock:
+    """``with try_lock(lock) as got:`` — non-blocking acquire that still
+    releases on every exit path. The only sanctioned way to call
+    ``acquire(blocking=False)``: ci/lint.py's lock-acquire-call rule
+    rejects bare acquire/release pairs, whose manual release bookkeeping
+    is exactly what the ``with`` requirement exists to eliminate."""
+
+    __slots__ = ("_lock", "acquired")
+
+    def __init__(self, lock) -> None:
+        self._lock = lock
+        self.acquired = False
+
+    def __enter__(self) -> bool:
+        self.acquired = self._lock.acquire(blocking=False)
+        return self.acquired
+
+    def __exit__(self, *exc) -> None:
+        if self.acquired:
+            self.acquired = False
+            self._lock.release()
+
+
+def try_lock(lock) -> _TryLock:
+    return _TryLock(lock)
+
+
+# --------------------------------------------------------------- guarded_by
+
+class _Guarded:
+    """Lockset proxy: forwards everything to the wrapped structure,
+    recording a violation when touched without the declared lock held.
+    Dunder access (item get/set, len, iter, contains) is spelled out —
+    special-method lookup bypasses __getattr__."""
+
+    __slots__ = ("_kt_obj", "_kt_lock", "_kt_name", "_kt_san")
+
+    def __init__(self, obj, lock, name: str, san: Sanitizer) -> None:
+        object.__setattr__(self, "_kt_obj", obj)
+        object.__setattr__(self, "_kt_lock", lock)
+        object.__setattr__(self, "_kt_name", name)
+        object.__setattr__(self, "_kt_san", san)
+
+    def _kt_check(self) -> None:
+        self._kt_san.guard_check(self._kt_name, self._kt_lock)
+
+    def __getattr__(self, attr):
+        self._kt_check()
+        return getattr(object.__getattribute__(self, "_kt_obj"), attr)
+
+    def __getitem__(self, key):
+        self._kt_check()
+        return self._kt_obj[key]
+
+    def __setitem__(self, key, value) -> None:
+        self._kt_check()
+        self._kt_obj[key] = value
+
+    def __delitem__(self, key) -> None:
+        self._kt_check()
+        del self._kt_obj[key]
+
+    def __contains__(self, key) -> bool:
+        self._kt_check()
+        return key in self._kt_obj
+
+    def __len__(self) -> int:
+        self._kt_check()
+        return len(self._kt_obj)
+
+    def __iter__(self):
+        self._kt_check()
+        return iter(self._kt_obj)
+
+    def __bool__(self) -> bool:
+        self._kt_check()
+        return bool(self._kt_obj)
+
+    def __repr__(self) -> str:
+        return f"<Guarded {self._kt_name}: {self._kt_obj!r}>"
+
+
+def guarded_by(obj, lock, name: str):
+    """Register ``obj`` (a hot shared dict/set/OrderedDict) as guarded by
+    ``lock`` (a tracked lock or tracked condition). Disabled — or when
+    the lock predates arming and is a raw primitive — returns ``obj``
+    ITSELF (identity-preserving, zero overhead); armed returns the
+    checking proxy."""
+    if not is_armed():
+        return obj
+    lock = getattr(lock, "_kt_lock_part", lock)
+    if not isinstance(lock, _TrackedLock):
+        return obj  # raw lock from a disarmed construction window
+    return _Guarded(obj, lock, name, _ensure_active())
+
+
+# --------------------------------------------------------- blocking hooks
+# Armed-only instrumentation of the blocking primitives the control plane
+# actually uses: time.sleep and the socket send/recv/connect family.
+# Installed once; each hook is a thread-local held-stack peek (no
+# allocation) ahead of the original call, and consults the live
+# sanitizer so a later disarm turns them into pure passthroughs.
+
+_hooks_installed = False
+
+
+def _install_blocking_hooks() -> None:
+    global _hooks_installed
+    if _hooks_installed:
+        return
+    _hooks_installed = True
+
+    orig_sleep = time.sleep
+
+    def _sleep(seconds):
+        san = _active
+        if san is not None and seconds and seconds > 0:
+            san.note_blocking("time.sleep")
+        return orig_sleep(seconds)
+
+    time.sleep = _sleep
+
+    for meth in ("connect", "recv", "recv_into", "sendall", "send"):
+        _wrap_socket_method(meth)
+
+
+def _wrap_socket_method(meth: str) -> None:
+    orig = getattr(socket.socket, meth)
+
+    def _hooked(self, *args, **kwargs):
+        san = _active
+        if san is not None:
+            san.note_blocking(f"socket.{meth}")
+        return orig(self, *args, **kwargs)
+
+    _hooked.__name__ = meth
+    setattr(socket.socket, meth, _hooked)

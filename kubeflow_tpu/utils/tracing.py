@@ -25,6 +25,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Iterator
 
+from . import sanitizer
+
 # ------------------------------------------------------------------ data model
 
 STATUS_UNSET = "UNSET"
@@ -157,7 +159,8 @@ class InMemorySpanExporter:
     (opentelemetry_test.go:26-78)."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "tracing.exporter", order=sanitizer.ORDER_LEAF)
         self._spans: list[Span] = []
 
     def export(self, span: Span) -> None:
@@ -200,7 +203,8 @@ class OtlpHttpExporter:
         self.batch_size = batch_size
         self.flush_interval_s = flush_interval_s
         self._buf: list[Span] = []
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "tracing.exporter", order=sanitizer.ORDER_LEAF)
         self._wake = threading.Event()
         self._closed = False
         self._last_error_t = 0.0
@@ -347,7 +351,8 @@ class SDKProvider:
         # a FlightRecorder (optionally teeing to one of the former)
         self.exporter = exporter
         self._local = threading.local()
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "tracing.ids", order=sanitizer.ORDER_LEAF)
         self._next_id = 1
 
     def _ids(self) -> int:
@@ -406,7 +411,8 @@ class SDKProvider:
 
 
 _provider: NoopProvider | SDKProvider = NoopProvider()
-_provider_lock = threading.Lock()
+_provider_lock = sanitizer.tracked_lock(
+    "tracing.provider", order=sanitizer.ORDER_LEAF)
 
 
 def set_provider(provider: NoopProvider | SDKProvider) -> None:
@@ -548,7 +554,8 @@ class FlightRecorder:
         self.max_traces = max_traces
         self.traces_per_key = traces_per_key
         self.max_spans_per_trace = max_spans_per_trace
-        self._lock = threading.Lock()
+        self._lock = sanitizer.tracked_lock(
+            "tracing.recorder", order=sanitizer.ORDER_LEAF)
         self._traces: OrderedDict[int, list[Span]] = OrderedDict()
         self._trace_key: dict[int, str] = {}
         self._by_key: dict[str, list[int]] = {}

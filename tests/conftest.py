@@ -7,6 +7,14 @@ reference validates multi-node behavior at the API-object level without nodes
 
 import os
 
+# Arm the concurrency sanitizer (utils/sanitizer.py) for the whole suite
+# unless the runner explicitly disabled it: every tracked lock constructed
+# under pytest records ordering/lockset/blocking violations, and the tier-1
+# gate (tests/test_sanitizer.py) asserts the control plane stays clean.
+# Must be set before any kubeflow_tpu import — the factory binds at
+# construction time.
+os.environ.setdefault("KFTPU_SANITIZE", "1")
+
 # Must be set before jax initializes its backends. Note: this environment
 # pre-exports JAX_PLATFORMS=axon (TPU tunnel) and re-asserts it at interpreter
 # startup, so the env var alone is not enough — use jax.config too.

@@ -61,6 +61,26 @@ CASES = [
      "    subprocess.run('ls', shell=True)\n"),
     ("eval-exec",
      "if __name__ == '__main__':\n    eval('1+1')\n"),
+    # --- concurrency-invariant rules (sanitizer gate) ---
+    ("raw-lock", "import threading\nlock = threading.Lock()\n"),
+    ("raw-lock", "import threading\nlock = threading.RLock()\n"),
+    ("raw-lock", "import threading\ncv = threading.Condition()\n"),
+    ("lock-acquire-call", "lock.acquire()\n"),
+    ("lock-acquire-call", "self._probe_lock.release()\n"),
+    ("lock-acquire-call", "self._cv.acquire(blocking=False)\n"),
+    ("sleep-under-lock",
+     "import time\nwith lock:\n    time.sleep(1)\n"),
+    ("sleep-under-lock",
+     "with self._lock:\n    resp = conn.getresponse()\n"),
+    # nested function bodies under a lexical lock still count
+    ("sleep-under-lock",
+     "import time\nwith self._cv:\n    if slow:\n        time.sleep(0.5)\n"),
+    ("annotation-literal",
+     'MANAGED_BY = "opendatahub.io/managed-by"\n'),
+    ("annotation-literal",
+     'pod["metadata"]["labels"]["apps.kubernetes.io/pod-index"] = "0"\n'),
+    ("metric-not-cataloged",
+     'registry.counter("totally_novel_metric_total")\n'),
 ]
 
 
@@ -93,12 +113,54 @@ NEGATIVE_CASES = [
     # print in a __main__ block stays exempt
     ("print-in-package",
      "if __name__ == '__main__':\n    print('usage: ...')\n"),
+    # --- concurrency-invariant rules: safe variants ---
+    # the tracked factory is the sanctioned constructor
+    ("raw-lock",
+     "from kubeflow_tpu.utils import sanitizer\n"
+     "lock = sanitizer.tracked_lock('x', order=50)\n"),
+    # acquire on a non-lockish receiver (e.g. a semaphore-like queue slot)
+    ("lock-acquire-call", "self._slots.acquire()\n"),
+    # sleep OUTSIDE any lexical lock block
+    ("sleep-under-lock",
+     "import time\nwith lock:\n    pass\ntime.sleep(1)\n"),
+    # a function defined under a `with lock:` runs LATER, not under it
+    ("sleep-under-lock",
+     "import time\nwith lock:\n"
+     "    def later():\n        time.sleep(1)\n"),
+    # apiVersion strings (group/vN) are not annotation keys
+    ("annotation-literal",
+     'api_version = "admissionregistration.k8s.io/v1"\n'),
+    # a single-segment domain (no dot) is a plain path, not a key
+    ("annotation-literal", 'path = "apps/v1"\n'),
+    ("metric-not-cataloged",
+     'registry.counter("workqueue_adds_total")\n'),
+    # dynamically-named families can't be checked lexically
+    ("metric-not-cataloged", 'registry.gauge(f"serving_engine_{name}")\n'),
 ]
 
 
 @pytest.mark.parametrize("rule,code", NEGATIVE_CASES)
 def test_rule_spares_safe_pattern(rule, code):
     assert rule not in findings_for(code), f"{rule} false-positive"
+
+
+def test_raw_lock_exempt_in_sanitizer_module():
+    code = "import threading\nlock = threading.Lock()\n"
+    assert "raw-lock" not in findings_for(code, "sanitizer.py")
+    assert "lock-acquire-call" not in findings_for(
+        "self._reg_lock.acquire()\n", "sanitizer.py")
+
+
+def test_annotation_literal_exempt_in_names_module():
+    code = 'K = "opendatahub.io/managed-by"\n'
+    assert "annotation-literal" not in findings_for(code, "names.py")
+
+
+def test_metric_catalog_parsed_from_metrics_module():
+    catalog = lint_mod.metric_catalog()
+    assert "sanitizer_violations_total" in catalog
+    assert "workqueue_depth" in catalog
+    assert len(catalog) > 30
 
 
 def test_tls_rule_allowlists_the_flag_gated_client():

@@ -1,0 +1,400 @@
+"""Concurrency sanitizer (utils/sanitizer.py): each detector must catch
+its target bug class on deliberately-broken code, stay silent on correct
+code under thread stress, and cost nothing when disabled — plus the
+tier-1 gate: a real manager+apiserver reconcile and a chaos experiment
+run armed with zero violations."""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.chaos import ChaosClient, FaultConfig
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.controllers import Manager, NotebookReconciler
+from kubeflow_tpu.controllers.manager import Request
+from kubeflow_tpu.utils import sanitizer
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def clean_sanitizer():
+    """Arm + wipe recorded state around every test: deliberate violations
+    made here must never leak into the suite-wide gate, and vice versa."""
+    sanitizer.arm(True)
+    sanitizer.get_sanitizer().reset()
+    yield
+    sanitizer.arm(True)
+    sanitizer.get_sanitizer().reset()
+
+
+def wait_for(fn, timeout=60.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = fn()
+        if result:
+            return result
+        time.sleep(0.05)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+# ------------------------------------------------------------- lock order
+
+
+def test_ab_ba_inversion_reports_cycle():
+    """The classic two-lock deadlock: A→B in one place, B→A in another.
+    Neither path deadlocks alone — the GRAPH has the cycle."""
+    a = sanitizer.tracked_lock("t.cycle.A")
+    b = sanitizer.tracked_lock("t.cycle.B")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    san = sanitizer.get_sanitizer()
+    assert san.counts().get(sanitizer.RULE_CYCLE) == 1
+    ((rule, msg),) = [v for v in san.violations()
+                      if v[0] == sanitizer.RULE_CYCLE]
+    assert "t.cycle.A" in msg and "t.cycle.B" in msg
+    with pytest.raises(AssertionError, match="lock-order-cycle"):
+        san.check()
+
+
+def test_three_lock_cycle_through_intermediate():
+    a = sanitizer.tracked_lock("t.tri.A")
+    b = sanitizer.tracked_lock("t.tri.B")
+    c = sanitizer.tracked_lock("t.tri.C")
+    with a, b:
+        pass
+    with b, c:
+        pass
+    assert sanitizer.get_sanitizer().violations() == []
+    with c, a:  # closes A -> B -> C -> A
+        pass
+    assert sanitizer.get_sanitizer().counts().get(
+        sanitizer.RULE_CYCLE) == 1
+
+
+def test_hierarchy_violation_reported():
+    """Acquiring a lower-order (outer-tier) lock while holding a
+    higher-order one inverts the declared hierarchy even without a
+    second code path to complete a cycle."""
+    store_l = sanitizer.tracked_lock("t.hier.store",
+                                     order=sanitizer.ORDER_STORE)
+    ctrl_l = sanitizer.tracked_lock("t.hier.ctrl",
+                                    order=sanitizer.ORDER_CONTROLLER)
+    with store_l:
+        with ctrl_l:
+            pass
+    counts = sanitizer.get_sanitizer().counts()
+    assert counts.get(sanitizer.RULE_HIERARCHY) == 1
+    # the legal direction is clean (same pair, declared order)
+    sanitizer.get_sanitizer().reset()
+    with ctrl_l:
+        with store_l:
+            pass
+    assert sanitizer.get_sanitizer().violations() == []
+
+
+def test_rlock_reentry_is_not_a_violation():
+    r = sanitizer.tracked_rlock("t.reent", order=sanitizer.ORDER_STORE)
+    with r:
+        with r:
+            pass
+    assert sanitizer.get_sanitizer().violations() == []
+
+
+# ------------------------------------------------------ blocking under lock
+
+
+def test_sleep_under_no_blocking_lock_reported():
+    hot = sanitizer.tracked_lock("t.hot", order=sanitizer.ORDER_STORE,
+                                 no_blocking=True)
+    with hot:
+        time.sleep(0.001)
+    counts = sanitizer.get_sanitizer().counts()
+    assert counts.get(sanitizer.RULE_BLOCKING) == 1
+
+
+def test_sleep_under_ordinary_lock_is_fine():
+    calm = sanitizer.tracked_lock("t.calm",
+                                  order=sanitizer.ORDER_CONTROLLER)
+    with calm:
+        time.sleep(0.001)
+    assert sanitizer.get_sanitizer().violations() == []
+
+
+def test_condition_wait_releases_its_own_lock():
+    """cv.wait() fully releases the cv's (R)lock for the park — the
+    held-stack must reflect that, so a timed wait on a no-blocking cv
+    is NOT a blocking-under-lock violation against itself."""
+    cv = sanitizer.tracked_condition("t.cv", order=sanitizer.ORDER_WATCH,
+                                     no_blocking=True)
+    with cv:
+        cv.wait(timeout=0.01)
+    assert sanitizer.get_sanitizer().violations() == []
+
+
+def test_condition_wait_flags_other_held_no_blocking_lock():
+    hot = sanitizer.tracked_lock("t.wait.hot",
+                                 order=sanitizer.ORDER_STORE,
+                                 no_blocking=True)
+    cv = sanitizer.tracked_condition("t.wait.cv",
+                                     order=sanitizer.ORDER_WATCH)
+    with hot:
+        with cv:
+            cv.wait(timeout=0.01)
+    assert sanitizer.get_sanitizer().counts().get(
+        sanitizer.RULE_BLOCKING) == 1
+
+
+# ------------------------------------------------------------------ lockset
+
+
+def test_unsynchronized_write_to_guarded_structure_reported():
+    lock = sanitizer.tracked_lock("t.guard.lock",
+                                  order=sanitizer.ORDER_CACHE)
+    shared = sanitizer.guarded_by({}, lock, "t.guard.map")
+    shared["racy"] = 1  # no lock held
+    counts = sanitizer.get_sanitizer().counts()
+    assert counts.get(sanitizer.RULE_LOCKSET) == 1
+    with lock:
+        shared["fine"] = 2  # held: no new violation
+        assert "racy" in shared and len(shared) == 2
+    assert sanitizer.get_sanitizer().counts().get(
+        sanitizer.RULE_LOCKSET) == 1
+
+
+def test_guarded_by_condition_guards_on_its_lock_part():
+    cv = sanitizer.tracked_condition("t.guard.cv",
+                                     order=sanitizer.ORDER_WATCH)
+    q = sanitizer.guarded_by({}, cv, "t.guard.queue")
+    with cv:
+        q["item"] = 1
+    assert sanitizer.get_sanitizer().violations() == []
+    list(q)  # iteration without the cv held
+    assert sanitizer.get_sanitizer().counts().get(
+        sanitizer.RULE_LOCKSET) == 1
+
+
+# ----------------------------------------------------------------- try_lock
+
+
+def test_try_lock_releases_on_every_path():
+    lock = sanitizer.tracked_lock("t.try", order=sanitizer.ORDER_LEAF)
+    with lock:
+        with sanitizer.try_lock(lock) as got:
+            assert not got  # contended: non-blocking miss, no deadlock
+    with sanitizer.try_lock(lock) as got:
+        assert got
+    assert not lock.locked()
+    with pytest.raises(RuntimeError):
+        with sanitizer.try_lock(lock) as got:
+            assert got
+            raise RuntimeError("boom")
+    assert not lock.locked()  # released on the exception path too
+    assert sanitizer.get_sanitizer().violations() == []
+
+
+# ------------------------------------------------------------ metric export
+
+
+def test_violations_exported_as_counter_by_rule():
+    metrics = MetricsRegistry()
+    san = sanitizer.get_sanitizer()
+    san.attach_metrics(metrics)
+    try:
+        lo = sanitizer.tracked_lock("t.metric.low",
+                                    order=sanitizer.ORDER_CONTROLLER)
+        hi = sanitizer.tracked_lock("t.metric.high",
+                                    order=sanitizer.ORDER_LEAF)
+        with hi:
+            with lo:
+                pass
+        counter = metrics.counter("sanitizer_violations_total", "")
+        assert counter.get({"rule": sanitizer.RULE_HIERARCHY}) == 1
+    finally:
+        san._metric = None  # detach: later suites use other registries
+
+
+# --------------------------------------------------------- disabled = no-op
+
+
+def test_disabled_mode_is_the_noop_singleton():
+    sanitizer.arm(False)
+    try:
+        assert sanitizer.get_sanitizer() is sanitizer.NOOP
+        assert sanitizer.get_sanitizer() is sanitizer.NOOP  # stable
+        assert sanitizer.NOOP.violations() == []
+        assert sanitizer.NOOP.counts() == {}
+        sanitizer.NOOP.check()  # never raises
+        sanitizer.NOOP.reset()
+        # the factory returns RAW primitives — byte-for-byte the
+        # pre-sanitizer hot path, nothing wrapped, nothing allocated
+        lock = sanitizer.tracked_lock("t.off", order=sanitizer.ORDER_LEAF)
+        assert type(lock) is type(threading.Lock())  # noqa: E721
+        rlock = sanitizer.tracked_rlock("t.off.r")
+        assert type(rlock) is type(threading.RLock())  # noqa: E721
+        cv = sanitizer.tracked_condition("t.off.cv")
+        assert isinstance(cv, threading.Condition)
+        # guarded_by is identity-preserving
+        obj = {"k": 1}
+        assert sanitizer.guarded_by(obj, lock, "t.off.map") is obj
+    finally:
+        sanitizer.arm(True)
+
+
+def test_guarded_by_raw_lock_is_identity():
+    """A lock constructed in a disarmed window stays raw; registering a
+    structure against it later (now armed) must degrade to identity, not
+    crash or false-positive."""
+    sanitizer.arm(False)
+    raw = sanitizer.tracked_lock("t.window")
+    sanitizer.arm(True)
+    obj = []
+    assert sanitizer.guarded_by(obj, raw, "t.window.list") is obj
+
+
+# ------------------------------------------------------------------- stress
+
+
+def test_four_thread_stress_on_correct_code_stays_clean():
+    """4 threads × 300 iterations of disciplined two-tier locking over a
+    guarded structure: zero violations, and the counts actually add up
+    (the bookkeeping itself is thread-safe)."""
+    outer = sanitizer.tracked_lock("t.stress.outer",
+                                   order=sanitizer.ORDER_CONTROLLER)
+    inner = sanitizer.tracked_lock("t.stress.inner",
+                                   order=sanitizer.ORDER_STORE)
+    state = sanitizer.guarded_by({"n": 0}, inner, "t.stress.state")
+
+    def worker():
+        for _ in range(300):
+            with outer:
+                with inner:
+                    state["n"] = state["n"] + 1
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    with inner:
+        assert state["n"] == 4 * 300
+    assert sanitizer.get_sanitizer().violations() == []
+
+
+# --------------------------------------- regression: serve-cache inversion
+
+
+def test_serve_cache_creation_does_not_invert_store_order():
+    """Regression for the inversion this gate surfaced: ApiServerProxy
+    used to construct _KindServeCache (whose __init__ takes the STORE
+    lock for the snapshot handshake) while HOLDING the cache-tier
+    registry lock. Concurrent first-reads of a new kind must now stay
+    clean, converge on one cache instance, and leave no leaked relay."""
+    from kubeflow_tpu.cluster.apiserver import ApiServerProxy
+
+    store = ClusterStore()
+    api.install_notebook_crd(store)
+    store.create(api.new_notebook("nb", "ns"))
+    proxy = ApiServerProxy(store)
+    san = sanitizer.get_sanitizer()
+    san.reset()
+
+    caches, barrier = [], threading.Barrier(4)
+
+    def first_read():
+        barrier.wait(timeout=10)
+        caches.append(proxy._serve_cache("Notebook"))
+
+    threads = [threading.Thread(target=first_read, daemon=True)
+               for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert len(caches) == 4 and all(c is caches[0] for c in caches)
+    assert san.violations() == []
+    # losing candidates' relays were unregistered from the store
+    assert sum(1 for w in store._watches
+               if getattr(w.callback, "__name__", "") == "_on_frame") == 1
+
+
+def test_reconstructed_inversion_is_detected():
+    """The OLD nesting (store lock under the cache-tier registry lock)
+    must be exactly what the sanitizer reports — proving the regression
+    test above fails loudly if someone reintroduces it."""
+    from kubeflow_tpu.cluster.apiserver import ApiServerProxy
+
+    store = ClusterStore()
+    proxy = ApiServerProxy(store)
+    with proxy._serve_caches_lock:    # cache tier (30) ...
+        with store._lock:             # ... then store tier (20): inverted
+            pass
+    assert sanitizer.get_sanitizer().counts().get(
+        sanitizer.RULE_HIERARCHY, 0) >= 1
+
+
+# -------------------------------------------------------------- tier-1 gate
+
+
+def test_gate_reconcile_and_chaos_run_clean(config):
+    """The acceptance gate: a real manager + apiserver reconcile over the
+    wire AND a chaos experiment, all under the armed sanitizer, with
+    ZERO violations across the store/serve-cache/watch-queue tiers."""
+    from kubeflow_tpu.cluster.apiserver import ApiServerProxy
+    from kubeflow_tpu.cluster.http_client import HttpApiClient, RetryPolicy
+    from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
+    from kubeflow_tpu.controllers import setup_controllers
+
+    san = sanitizer.get_sanitizer()
+    san.reset()
+
+    # --- phase 1: manager + apiserver reconcile over the real wire
+    store = ClusterStore()
+    api.install_notebook_crd(store)
+    sim_mgr = Manager(store)
+    StatefulSetSimulator(store, boot_delay_s=0.0).setup(sim_mgr)
+    sim_mgr.start()
+    proxy = ApiServerProxy(store)
+    proxy.start()
+    client = HttpApiClient(proxy.url, retry_policy=RetryPolicy(
+        max_attempts=3, backoff_base_s=0.01, backoff_cap_s=0.05))
+    metrics = MetricsRegistry()
+    mgr = setup_controllers(client, config, metrics=metrics, health_port=0)
+    mgr.start()
+    try:
+        for i in range(3):
+            store.create(api.new_notebook(f"san-nb-{i}", "ns"))
+        wait_for(lambda: all(
+            store.get_or_none("Pod", "ns", f"san-nb-{i}-0")
+            for i in range(3)), msg="wire reconcile of 3 notebooks")
+    finally:
+        mgr.stop()
+        client.close()
+        proxy.stop()
+        sim_mgr.stop()
+
+    # --- phase 2: one chaos experiment (intermittent multi-verb noise,
+    # deactivate, reconverge) — the timing chaos the sanitizer turns
+    # from flake-hunting into an invariant
+    store2 = ClusterStore()
+    faults = FaultConfig(create=0.3, update=0.3, get=0.2, seed=11)
+    chaos = ChaosClient(store2, faults)
+    chaos_mgr = Manager(chaos)
+    NotebookReconciler(chaos).setup(chaos_mgr)
+    store2.create(api.new_notebook("chaos-nb", "ns"))
+    chaos_mgr.run_until_idle(timeout=10.0, include_delayed_under=0.5)
+    faults.deactivate()
+    chaos_mgr.enqueue("notebook-controller", Request("ns", "chaos-nb"))
+    chaos_mgr.run_until_idle(timeout=10.0, include_delayed_under=0.5)
+    assert store2.get("StatefulSet", "ns", "chaos-nb")
+
+    assert san.violations() == [], (
+        "concurrency violations during the gate run:\n" +
+        "\n".join(f"  [{r}] {m}" for r, m in san.violations()))
+    san.check()
