@@ -47,12 +47,14 @@ loadtest-soak: ## 100k-notebook sharded soak, in-process, event-driven kubelet t
 test-transport: ## Real-HTTP transport + multi-process HA tier.
 	$(TEST_ENV) $(PYTHON) -m pytest tests/test_http_transport.py tests/test_http_stack.py tests/test_cli.py tests/test_multihost.py -q
 
-lint: ## Repo lint rules (ci/lint.py; the fmt/vet analog).
+lint: ## Repo lint rules + effect contracts + schema drift gate.
 	$(PYTHON) ci/lint.py
+	$(PYTHON) ci/effects.py
+	$(PYTHON) ci/schema_gate.py
 
 sanitize: ## Concurrency gate: invariant lint + armed sanitizer suite + armed chaos smoke.
 	$(PYTHON) ci/lint.py
-	$(TEST_ENV) KFTPU_SANITIZE=1 $(PYTHON) -m pytest tests/test_sanitizer.py tests/test_lint_rules.py -q
+	$(TEST_ENV) KFTPU_SANITIZE=1 $(PYTHON) -m pytest tests/test_sanitizer.py tests/test_lint_rules.py tests/test_effects.py -q
 	$(TEST_ENV) $(PYTHON) ci/chaos_smoke.py --count 20 --fault-rate 0.05
 
 manifests: ## Regenerate config/ from kubeflow_tpu/deploy/manifests.py.

@@ -1,0 +1,1045 @@
+#!/usr/bin/env python
+"""API-effect contract gate for the controller package.
+
+PR 7's shard ownership, PR 1's echo suppression, and PR 6's bound-mode
+ownership rules all rest on assumptions about what each reconciler reads
+and writes. This gate makes those assumptions *declared and checked*: an
+AST-based interprocedural analyzer infers, per controllers/ module, the
+set of kinds it GETs/LISTs/watches, the kinds+verbs it writes (including
+the status subresource), the annotation/label constants it touches, and
+whether any write leaves the request's namespace — then diffs the
+inferred summary against a module-level ``CONTRACT`` literal.
+
+Contract rules (each encodes a correctness invariant, not style):
+
+  missing-contract     every controllers/ module must declare a CONTRACT
+  effects-*-drift      declared reads/watches/writes/annotations must
+                       equal the inferred sets — both directions, so the
+                       ARCHITECTURE.md table can never silently rot
+  write-without-watch  a reconciler that mutates a kind it cannot observe
+                       hot-loops past echo suppression (its own writes
+                       come back as foreign edits); every written kind
+                       must be watched or carry a declared
+                       ``unwatched_writes`` reason (Events are exempt:
+                       append-only telemetry no reconciler converges on)
+  cross-namespace      a write outside the request's home namespace
+                       breaks PR-7 namespace-hash shard ownership unless
+                       declared in ``cross_namespace`` with a reason (the
+                       slicepool bound-mode writes are the canonical
+                       declared exceptions; its primary kind is
+                       cluster-scoped, so *every* namespaced write it
+                       issues is cross-namespace by construction)
+  dynamic-write        a write whose kind the resolver cannot pin down
+                       must be enumerated in ``dynamic_kinds`` per
+                       function, so the watch/cross-ns rules still apply
+  spec-status-write    mutating ``status`` and shipping it through a
+                       non-status write (update / a patch that also
+                       carries spec or metadata) bypasses the status
+                       subresource split and stomps concurrent writers
+
+Hygiene rules (controllers/, cluster/, loadtest/ for clocks;
+the whole package + loadtest/ for loops):
+
+  wall-clock           time.time() / datetime.now() / argless gmtime()
+                       outside the injected-clock seams — wall clocks in
+                       reconcile logic make replays and tests flaky and
+                       couple correctness to host time; the allowlist
+                       names the few protocol-mandated sites (Lease
+                       renewTime, OTLP span stamps, audit timestamps)
+  unseeded-random      random.Random() / module-level random.* outside an
+                       injected-rng seam (``rng or random.Random()`` as a
+                       constructor default arm is the sanctioned shape)
+  unbounded-loop       ``while True:`` without a ``# pump: <reason>``
+                       (intentional dispatch/daemon loop) or
+                       ``# bounded: <reason>`` (termination argument)
+                       marker on the line — the PR-5 status-PATCH spin,
+                       found statically this time
+
+The analyzer never imports the package it checks (same stance as
+ci/lint.py). Exit non-zero with findings; ``--dump`` prints the inferred
+contract for each module to bootstrap or repair declarations.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "kubeflow_tpu"
+CONTROLLERS = PACKAGE / "controllers"
+LOADTEST = REPO / "loadtest"
+
+READ_VERBS = frozenset({"get", "get_or_none", "list", "list_cached",
+                        "list_by_field", "get_owned"})
+WRITE_VERBS = frozenset({"create", "update", "update_status", "patch",
+                         "delete"})
+# receivers treated as API-client handles (self.client, a bare `client`
+# param, the live-reader seam, the read cache)
+CLIENT_RECEIVERS = frozenset({"client", "_client", "live", "reader",
+                              "store", "_read_cache", "cache"})
+WATCH_RECEIVERS = frozenset({"mgr", "manager", "client", "_client"})
+RECORDER_RECEIVERS = frozenset({"recorder", "_recorder"})
+
+DYNAMIC = "?"
+
+CLUSTER_SCOPED_KINDS = frozenset({
+    "ClusterRole", "ClusterRoleBinding", "OAuthClient", "SlicePool",
+    "Node", "Namespace", "CustomResourceDefinition",
+    "PriorityLevelConfiguration", "FlowSchema",
+})
+
+# Kinds exempt from write-without-watch: append-only, never reconciled
+# from a watch by their writer, so an unobserved write cannot hot-loop.
+EXEMPT_WRITE_KINDS = frozenset({"Event"})
+
+ROLES = frozenset({"reconciler", "coordinator", "manager", "helper",
+                   "generator", "wiring", "infrastructure"})
+
+# namespace-expression substrings that mark a write as leaving the
+# request's home namespace (config-routed and pool/bound plumbing)
+FOREIGN_NS_MARKERS = ("controller_namespace", "pool_namespace",
+                      "gateway_namespace", "central_ns", "pool_ns",
+                      "bound_slice", "bound[")
+# parameter names that carry a foreign namespace into a helper
+FOREIGN_NS_PARAMS = frozenset({"pool_ns", "central_ns",
+                               "controller_namespace", "pool_namespace"})
+
+# last rung of the kind-resolution ladder: the package's ubiquitous
+# object-variable naming convention. Only consulted for *object*
+# arguments (create/update/update_status) after every structural rung
+# fails, never for kind-string or namespace positions.
+PARAM_KINDS = {
+    "notebook": "Notebook", "nb": "Notebook", "pool": "SlicePool",
+    "sts": "StatefulSet", "pod": "Pod", "node": "Node", "lease": "Lease",
+    "svc": "Service", "secret": "Secret",
+}
+
+# (file name, enclosing function) -> why this wall-clock read is not a
+# logic clock. Protocol-mandated wall timestamps only — everything else
+# routes through an injected clock/rng seam.
+CLOCK_ALLOWLIST = {
+    # Lease renewTime is a cross-process wire protocol: other managers
+    # compare it against *their* wall clocks, so monotonic/injected time
+    # cannot express it.
+    ("election.py", "_lease_obj"): "Lease renewTime wire protocol",
+    ("election.py", "try_acquire_or_renew"): "Lease renewTime wire protocol",
+    ("sharding.py", "_lease"): "Lease renewTime wire protocol",
+    ("sharding.py", "_renew_membership"): "Lease renewTime wire protocol",
+    ("sharding.py", "_live_members"): "Lease renewTime wire protocol",
+    ("sharding.py", "_try_acquire_shard"): "Lease renewTime wire protocol",
+    # OTLP span timestamps are epoch wall time by spec; backends order
+    # spans by them across hosts.
+    ("manager.py", "watch"): "OTLP span wall timestamps",
+    ("manager.py", "_observe_phases"): "OTLP span wall timestamps",
+    ("manager.py", "_process"): "OTLP span wall timestamps",
+    # Audit log entries are forensic records correlated with external
+    # systems; they must carry real wall time.
+    ("apiserver.py", "_audit"): "audit-trail wall timestamps",
+}
+
+_LOOP_MARKER = re.compile(r"#\s*(pump|bounded):\s*\S")
+
+
+# --------------------------------------------------------------------------
+# shared helpers
+
+
+def _terminal_name(node: ast.AST) -> str:
+    """Last path segment of a Name/Attribute chain (self.client -> client)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target (k8s.kind, time.time)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def module_constants(tree: ast.Module) -> dict[str, str]:
+    """Module-level NAME = 'literal' string constants (KIND tables)."""
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _resolve_import(pkg_dir: Path, node: ast.ImportFrom) -> dict[str, Path]:
+    """alias -> module file for ``from .x import y as z`` style imports."""
+    out: dict[str, Path] = {}
+    base = pkg_dir
+    for _ in range(max(node.level - 1, 0)):
+        base = base.parent
+    if node.level == 0:
+        return out  # absolute imports never target this package's modules
+    parts = (node.module or "").split(".") if node.module else []
+    target = base
+    for part in parts:
+        target = target / part
+    for alias in node.names:
+        name = alias.asname or alias.name
+        cand = target / f"{alias.name}.py"
+        if cand.is_file():
+            out[name] = cand
+        elif (target / alias.name / "__init__.py").is_file():
+            out[name] = target / alias.name / "__init__.py"
+        elif target.with_suffix(".py").is_file():
+            # ``from .manager import Manager`` — alias is a symbol inside
+            # the module, not a module; map the symbol to the module file
+            # so bare-name calls can resolve returns there if needed.
+            out[name] = target.with_suffix(".py")
+    return out
+
+
+# --------------------------------------------------------------------------
+# per-function effect summaries
+
+
+class FnSummary:
+    def __init__(self) -> None:
+        self.reads: set[str] = set()
+        self.writes: set[tuple[str, str, str]] = set()  # (kind, verb, ns)
+        self.dynamic_writes: list[tuple[int, str, str]] = []  # lineno, verb, ns
+        self.watches: set[str] = set()
+        self.spec_status: list[tuple[int, str]] = []
+        self.calls: set[tuple[str, str]] = set()  # (alias|self|local, name)
+        self.returns_kind: frozenset[str] | None = None
+        self.returns_ns: str | None = None
+
+    def reset_effects(self) -> None:
+        self.reads, self.writes = set(), set()
+        self.dynamic_writes, self.spec_status = [], []
+        self.watches, self.calls = set(), set()
+
+
+class _FnVisitor(ast.NodeVisitor):
+    """Single pass over one function body, statement order preserved."""
+
+    def __init__(self, mod: "ModuleInfo", project: "Project",
+                 summary: FnSummary, args: list[str]) -> None:
+        self.m, self.p, self.s = mod, project, summary
+        self.var_kinds: dict[str, frozenset[str]] = {}
+        self.var_ns: dict[str, str] = {}
+        self.var_str: dict[str, str] = {}
+        self.tainted: set[str] = set(a for a in args
+                                     if a in FOREIGN_NS_PARAMS)
+        self.status_mut: set[str] = set()
+        self._returns: list[ast.AST] = []
+
+    # ---------------------------------------------------- kind resolution
+    def resolve_kinds(self, node: ast.AST | None) -> frozenset[str] | None:
+        if node is None:
+            return None
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return frozenset({node.value})
+        if isinstance(node, ast.Name):
+            if node.id in self.var_kinds:
+                return self.var_kinds[node.id]
+            if node.id in self.m.constants:
+                return frozenset({self.m.constants[node.id]})
+            return None
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.value, ast.Name):
+            const = self.p.imported_constant(self.m, node.value.id,
+                                             node.attr)
+            if const is not None:
+                return frozenset({const})
+            return None
+        if isinstance(node, ast.Subscript) and \
+                isinstance(node.slice, ast.Constant) and \
+                node.slice.value == "kind":
+            return self.object_kind(node.value)
+        if isinstance(node, ast.Call):
+            dotted = _dotted(node.func)
+            if dotted.endswith("k8s.kind") and node.args:
+                return self.object_kind(node.args[0])
+            callee = self._callee_summary(node)
+            if callee is not None and callee.returns_kind:
+                return callee.returns_kind
+            return None
+        return None
+
+    def object_kind(self, node: ast.AST) -> frozenset[str] | None:
+        """Kind(s) of an object expression (create/update argument)."""
+        if isinstance(node, ast.Dict):
+            for key, val in zip(node.keys, node.values):
+                if isinstance(key, ast.Constant) and key.value == "kind":
+                    return self.resolve_kinds(val)
+            return None
+        if isinstance(node, ast.Name):
+            if node.id in self.var_kinds:
+                return self.var_kinds[node.id]
+            if node.id in PARAM_KINDS:
+                return frozenset({PARAM_KINDS[node.id]})
+            return None
+        if isinstance(node, ast.Call):
+            kinds = self._read_call_kind(node)
+            if kinds:
+                return kinds
+            callee = self._callee_summary(node)
+            if callee is not None and callee.returns_kind:
+                return callee.returns_kind
+        if isinstance(node, ast.ListComp) and node.generators:
+            return self.object_kind(node.generators[0].iter)
+        return None
+
+    def _read_call_kind(self, node: ast.Call) -> frozenset[str] | None:
+        """Kind fetched by a direct client read call expression."""
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr in READ_VERBS and \
+                _terminal_name(func.value) in CLIENT_RECEIVERS and node.args:
+            return self.resolve_kinds(node.args[0])
+        return None
+
+    def object_ns(self, node: ast.AST) -> str:
+        if isinstance(node, ast.Dict):
+            for key, val in zip(node.keys, node.values):
+                if isinstance(key, ast.Constant) and key.value == "metadata" \
+                        and isinstance(val, ast.Dict):
+                    for mk, mv in zip(val.keys, val.values):
+                        if isinstance(mk, ast.Constant) and \
+                                mk.value == "namespace":
+                            return self.classify_ns(mv)
+            return "home"
+        if isinstance(node, ast.Name):
+            if node.id in self.var_ns:
+                return self.var_ns[node.id]
+            return "foreign" if node.id in self.tainted else "home"
+        if isinstance(node, ast.Call):
+            callee = self._callee_summary(node)
+            if callee is not None and callee.returns_ns:
+                return callee.returns_ns
+        return "home"
+
+    def classify_ns(self, node: ast.AST | None) -> str:
+        if node is None:
+            return "home"
+        if isinstance(node, ast.Constant):
+            if node.value == "":
+                return "cluster"
+            return "foreign"  # a hard-coded namespace is never the request's
+        text = ast.unparse(node)
+        if any(marker in text for marker in FOREIGN_NS_MARKERS):
+            return "foreign"
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                return "foreign"
+            if isinstance(sub, ast.Name) and \
+                    self.var_str.get(sub.id) == "":
+                return "cluster"
+        return "home"
+
+    def _callee_summary(self, call: ast.Call) -> FnSummary | None:
+        func = call.func
+        if isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name):
+            owner = func.value.id
+            if owner == "self":
+                return self.m.functions.get(func.attr)
+            target = self.m.aliases.get(owner)
+            if target is not None:
+                mod = self.p.module_for_path(target)
+                if mod is not None:
+                    return mod.functions.get(func.attr)
+        elif isinstance(func, ast.Name):
+            return self.m.functions.get(func.id)
+        return None
+
+    # ------------------------------------------------------- assignments
+    def _record_value(self, name: str, value: ast.AST) -> None:
+        text = ast.unparse(value)
+        if any(marker in text for marker in FOREIGN_NS_MARKERS) or any(
+                isinstance(sub, ast.Name) and sub.id in self.tainted
+                for sub in ast.walk(value)):
+            self.tainted.add(name)
+        if isinstance(value, ast.Constant) and isinstance(value.value, str):
+            self.var_str[name] = value.value
+            self.var_kinds[name] = frozenset({value.value})
+            return
+        if isinstance(value, ast.Name):
+            if value.id in self.var_kinds:
+                self.var_kinds[name] = self.var_kinds[value.id]
+            if value.id in self.var_ns:
+                self.var_ns[name] = self.var_ns[value.id]
+            return
+        if isinstance(value, ast.Dict):
+            kinds = self.object_kind(value)
+            if kinds:
+                self.var_kinds[name] = kinds
+            self.var_ns[name] = self.object_ns(value)
+            return
+        if isinstance(value, (ast.List, ast.Tuple)) and value.elts:
+            # a literal collection of objects: the var carries the union
+            # of element kinds (iteration hands them out one by one)
+            kinds = set()
+            for elem in value.elts:
+                k = self.object_kind(elem) or self.resolve_kinds(elem)
+                if k:
+                    kinds |= k
+            if kinds:
+                self.var_kinds[name] = frozenset(kinds)
+            self.var_ns[name] = self.object_ns(value.elts[0])
+            return
+        if isinstance(value, ast.Call):
+            func = value.func
+            verb = func.attr if isinstance(func, ast.Attribute) else ""
+            if verb in READ_VERBS and \
+                    _terminal_name(getattr(func, "value", None)) in \
+                    CLIENT_RECEIVERS:
+                kinds = self.resolve_kinds(value.args[0]) if value.args \
+                    else None
+                if kinds:
+                    self.var_kinds[name] = kinds
+                self.var_ns[name] = self.classify_ns(
+                    value.args[1] if len(value.args) > 1 else None)
+                return
+            callee = self._callee_summary(value)
+            if callee is not None:
+                if callee.returns_kind:
+                    self.var_kinds[name] = callee.returns_kind
+                if callee.returns_ns:
+                    self.var_ns[name] = callee.returns_ns
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if len(node.targets) == 1 and isinstance(node.targets[0], ast.Name):
+            self._record_value(node.targets[0].id, node.value)
+        for target in node.targets:
+            # obj["status"] = ... / obj["status"]["x"] = ... marks obj as
+            # status-mutated for the spec-status rule
+            sub = target
+            while isinstance(sub, ast.Subscript):
+                if isinstance(sub.slice, ast.Constant) and \
+                        sub.slice.value == "status" and \
+                        isinstance(sub.value, ast.Name):
+                    self.status_mut.add(sub.value.id)
+                sub = sub.value
+        self.generic_visit(node)
+
+    def visit_For(self, node: ast.For) -> None:
+        if isinstance(node.target, ast.Name):
+            name = node.target.id
+            text = ast.unparse(node.iter)
+            if any(marker in text for marker in FOREIGN_NS_MARKERS) or any(
+                    isinstance(sub, ast.Name) and sub.id in self.tainted
+                    for sub in ast.walk(node.iter)):
+                self.tainted.add(name)
+            if isinstance(node.iter, (ast.Tuple, ast.List)):
+                kinds: set[str] = set()
+                resolved = True
+                for elem in node.iter.elts:
+                    k = self.resolve_kinds(elem) or self.object_kind(elem)
+                    if k:
+                        kinds |= k
+                    else:
+                        resolved = False
+                if resolved and kinds:
+                    self.var_kinds[name] = frozenset(kinds)
+            elif isinstance(node.iter, ast.Name):
+                # iterating a collection var: elements carry its kinds/ns
+                if node.iter.id in self.var_kinds:
+                    self.var_kinds[name] = self.var_kinds[node.iter.id]
+                if node.iter.id in self.var_ns:
+                    self.var_ns[name] = self.var_ns[node.iter.id]
+            elif isinstance(node.iter, ast.Call):
+                kinds2 = self.object_kind(node.iter)
+                if kinds2:
+                    self.var_kinds[name] = kinds2
+                callee = self._callee_summary(node.iter)
+                if callee is not None and callee.returns_ns:
+                    self.var_ns[name] = callee.returns_ns
+        elif isinstance(node.target, ast.Tuple) and \
+                isinstance(node.iter, (ast.Tuple, ast.List)) and \
+                node.target.elts and \
+                isinstance(node.target.elts[0], ast.Name):
+            # for kind, name in (("ServiceAccount", ...), ...)
+            kinds = set()
+            resolved = True
+            for elem in node.iter.elts:
+                first = elem.elts[0] if isinstance(elem, ast.Tuple) and \
+                    elem.elts else None
+                k = self.resolve_kinds(first) if first is not None else None
+                if k:
+                    kinds |= k
+                else:
+                    resolved = False
+            if resolved and kinds:
+                self.var_kinds[node.target.elts[0].id] = frozenset(kinds)
+        self.generic_visit(node)
+
+    def visit_Return(self, node: ast.Return) -> None:
+        if node.value is not None:
+            self._returns.append(node.value)
+        self.generic_visit(node)
+
+    def finish_returns(self) -> None:
+        kinds: set[str] = set()
+        ns: str | None = None
+        for value in self._returns:
+            k = self.object_kind(value)
+            if k:
+                kinds |= k
+                ns = ns or self.object_ns(value)
+            if isinstance(value, ast.Call):
+                rk = self._read_call_kind(value)
+                if rk:
+                    kinds |= rk
+                    ns = ns or self.classify_ns(
+                        value.args[1] if len(value.args) > 1 else None)
+        if kinds:
+            self.s.returns_kind = frozenset(kinds)
+            self.s.returns_ns = ns
+
+    # ------------------------------------------------------------- calls
+    def _record_write(self, node: ast.Call, verb: str,
+                      kinds: frozenset[str] | None, ns: str) -> None:
+        if kinds is None:
+            self.s.dynamic_writes.append((node.lineno, verb, ns))
+            return
+        for kind in kinds:
+            if kind in CLUSTER_SCOPED_KINDS:
+                ns = "cluster"
+            self.s.writes.add((kind, verb, ns))
+
+    def _patch_spec_status(self, node: ast.Call, body: ast.AST) -> None:
+        if not isinstance(body, ast.Dict):
+            return
+        keys = {k.value for k in body.keys
+                if isinstance(k, ast.Constant)}
+        if "status" in keys and keys & {"spec", "metadata"}:
+            self.s.spec_status.append((
+                node.lineno,
+                "patch mixes status with spec/metadata in one write; "
+                "route status through update_status"))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            recv = _terminal_name(func.value)
+            verb = func.attr
+            if verb in READ_VERBS and recv in CLIENT_RECEIVERS:
+                kinds = self.resolve_kinds(node.args[0]) if node.args \
+                    else None
+                if kinds:
+                    self.s.reads |= kinds
+            elif verb in WRITE_VERBS and recv in CLIENT_RECEIVERS:
+                if verb in ("create", "update", "update_status"):
+                    obj = node.args[0] if node.args else None
+                    kinds = self.object_kind(obj) if obj is not None \
+                        else None
+                    ns = self.object_ns(obj) if obj is not None else "home"
+                    if verb == "update" and isinstance(obj, ast.Name) and \
+                            obj.id in self.status_mut:
+                        self.s.spec_status.append((
+                            node.lineno,
+                            f"update({obj.id}) after mutating "
+                            f"{obj.id}['status']; use update_status"))
+                    self._record_write(node, verb, kinds, ns)
+                else:  # patch / delete
+                    kinds = self.resolve_kinds(node.args[0]) if node.args \
+                        else None
+                    ns = self.classify_ns(
+                        node.args[1] if len(node.args) > 1 else None)
+                    if verb == "patch" and len(node.args) > 3:
+                        self._patch_spec_status(node, node.args[3])
+                    self._record_write(node, verb, kinds, ns)
+            elif verb == "watch" and recv in WATCH_RECEIVERS:
+                kinds = self.resolve_kinds(node.args[0]) if node.args \
+                    else None
+                if kinds:
+                    self.s.watches |= kinds
+            elif verb in ("eventf", "event") and recv in RECORDER_RECEIVERS:
+                self.s.writes.add(("Event", "create", "home"))
+            elif verb == "update_with_conflict_retry":
+                self._seam_conflict_retry(node)
+            elif verb == "bound_slice_pods":
+                self.s.reads.add("Pod")
+            elif verb == "owned_objects" and len(node.args) > 1:
+                kinds = self.resolve_kinds(node.args[1])
+                if kinds:
+                    self.s.reads |= kinds
+            elif verb == "append" and isinstance(func.value, ast.Name) and \
+                    func.value.id in self.var_kinds and node.args:
+                extra = self.object_kind(node.args[0])
+                if extra:
+                    self.var_kinds[func.value.id] = \
+                        self.var_kinds[func.value.id] | extra
+            # call-graph edges
+            if isinstance(func.value, ast.Name):
+                owner = func.value.id
+                if owner == "self":
+                    self.s.calls.add(("self", verb))
+                elif owner in self.m.aliases:
+                    self.s.calls.add((owner, verb))
+        elif isinstance(func, ast.Name):
+            if func.id == "owned_objects" and len(node.args) > 1:
+                kinds = self.resolve_kinds(node.args[1])
+                if kinds:
+                    self.s.reads |= kinds
+            elif func.id == "bound_slice_pods":
+                self.s.reads.add("Pod")
+            self.s.calls.add(("local", func.id))
+        self.generic_visit(node)
+
+    def _seam_conflict_retry(self, node: ast.Call) -> None:
+        """errors.update_with_conflict_retry(client, read, mutate): a GET
+        plus a conflict-retried UPDATE of whatever the read thunk
+        fetches."""
+        if len(node.args) < 2:
+            return
+        read = node.args[1]
+        kinds: frozenset[str] | None = None
+        ns = "home"
+        if isinstance(read, ast.Call):
+            # self._live_get("StatefulSet", ns, name) style factory
+            if read.args:
+                kinds = self.resolve_kinds(read.args[0])
+                ns = self.classify_ns(
+                    read.args[1] if len(read.args) > 1 else None)
+        elif isinstance(read, ast.Lambda):
+            for sub in ast.walk(read.body):
+                if isinstance(sub, ast.Call) and \
+                        isinstance(sub.func, ast.Attribute) and \
+                        sub.func.attr in READ_VERBS and sub.args:
+                    kinds = self.resolve_kinds(sub.args[0])
+                    ns = self.classify_ns(
+                        sub.args[1] if len(sub.args) > 1 else None)
+                    break
+        if kinds:
+            self.s.reads |= kinds
+        self._record_write(node, "update", kinds, ns)
+
+
+# --------------------------------------------------------------------------
+# module + project
+
+
+class ModuleInfo:
+    def __init__(self, path: Path, source: str) -> None:
+        self.path = path
+        self.source = source
+        self.tree = ast.parse(source)
+        self.constants = module_constants(self.tree)
+        self.aliases: dict[str, Path] = {}
+        for node in self.tree.body:
+            if isinstance(node, ast.ImportFrom):
+                self.aliases.update(_resolve_import(path.parent, node))
+        self.functions: dict[str, FnSummary] = {}
+        self.fn_nodes: dict[str, tuple[ast.AST, list[str]]] = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = [a.arg for a in node.args.args]
+                self.fn_nodes[node.name] = (node, args)
+                self.functions.setdefault(node.name, FnSummary())
+        self.contract: dict | None = None
+        self.contract_line = 0
+        self.contract_error: str | None = None
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name) and \
+                    node.targets[0].id == "CONTRACT":
+                self.contract_line = node.lineno
+                try:
+                    self.contract = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    self.contract_error = \
+                        "CONTRACT must be a pure literal dict"
+
+    def annotation_refs(self) -> set[str]:
+        out: set[str] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "names" and \
+                    ("ANNOTATION" in node.attr or "LABEL" in node.attr):
+                out.add(node.attr)
+        return out
+
+
+class Project:
+    """All controllers/ modules, analyzed interprocedurally."""
+
+    def __init__(self, files: dict[str, tuple[Path, str]]) -> None:
+        self.modules: dict[str, ModuleInfo] = {
+            name: ModuleInfo(path, source)
+            for name, (path, source) in files.items()}
+        self._by_path = {m.path.resolve(): m
+                         for m in self.modules.values()}
+        self._const_cache: dict[Path, dict[str, str]] = {}
+        # two passes: pass 1 pins returns_kind for literal-returning
+        # generators; pass 2 re-runs with the returns table populated so
+        # create(self.generate_x(...)) chains resolve
+        for _ in range(2):
+            for mod in self.modules.values():
+                for name, (node, args) in mod.fn_nodes.items():
+                    summary = mod.functions[name]
+                    summary.reset_effects()
+                    visitor = _FnVisitor(mod, self, summary, args)
+                    for stmt in node.body:
+                        visitor.visit(stmt)
+                    visitor.finish_returns()
+
+    def module_for_path(self, path: Path) -> ModuleInfo | None:
+        return self._by_path.get(path.resolve())
+
+    def imported_constant(self, mod: ModuleInfo, alias: str,
+                          attr: str) -> str | None:
+        target = mod.aliases.get(alias)
+        if target is None:
+            return None
+        target = target.resolve()
+        sibling = self.module_for_path(target)
+        if sibling is not None:
+            return sibling.constants.get(attr)
+        if target not in self._const_cache:
+            try:
+                self._const_cache[target] = module_constants(
+                    ast.parse(target.read_text()))
+            except (OSError, SyntaxError):
+                self._const_cache[target] = {}
+        return self._const_cache[target].get(attr)
+
+    # ----------------------------------------------------------- closure
+    def merged(self, mod_name: str) -> tuple[set, set, set, list]:
+        """Transitive (reads, writes, watches, undeclared-dynamic) over
+        every function the module defines plus everything they call in
+        other controllers/ modules. Dynamic writes resolve through the
+        defining module's CONTRACT['dynamic_kinds']."""
+        reads: set[str] = set()
+        writes: set[tuple[str, str, str]] = set()
+        watches: set[str] = set()
+        undeclared: list[tuple[str, int, str]] = []  # mod, lineno, verb
+        seen: set[tuple[str, str]] = set()
+
+        def absorb(mod: ModuleInfo, mname: str, fname: str) -> None:
+            if (mname, fname) in seen:
+                return
+            seen.add((mname, fname))
+            summary = mod.functions.get(fname)
+            if summary is None:
+                return
+            reads.update(k for k in summary.reads if k != DYNAMIC)
+            writes.update(summary.writes)
+            watches.update(summary.watches)
+            declared = (mod.contract or {}).get("dynamic_kinds", {})
+            for lineno, verb, ns in summary.dynamic_writes:
+                if fname in declared:
+                    for kind in declared[fname]:
+                        eff_ns = "cluster" if kind in CLUSTER_SCOPED_KINDS \
+                            else ns
+                        writes.add((kind, verb, eff_ns))
+                else:
+                    undeclared.append((mname, lineno, verb))
+            for owner, callee in summary.calls:
+                if owner in ("self", "local"):
+                    absorb(mod, mname, callee)
+                else:
+                    target = mod.aliases.get(owner)
+                    sibling = self.module_for_path(target) if target \
+                        else None
+                    if sibling is not None:
+                        sib_name = next(
+                            (n for n, m in self.modules.items()
+                             if m is sibling), None)
+                        if sib_name is not None:
+                            absorb(sibling, sib_name, callee)
+
+        mod = self.modules[mod_name]
+        for fname in mod.fn_nodes:
+            absorb(mod, mod_name, fname)
+        return reads, writes, watches, undeclared
+
+    # ------------------------------------------------------------ checks
+    def inferred_contract(self, mod_name: str) -> dict:
+        mod = self.modules[mod_name]
+        reads, writes, watches, _ = self.merged(mod_name)
+        verb_map: dict[str, set[str]] = {}
+        for kind, verb, _ns in writes:
+            verb_map.setdefault(kind, set()).add(verb)
+        return {
+            "reads": sorted(reads),
+            "watches": sorted(watches),
+            "writes": {k: sorted(v) for k, v in sorted(verb_map.items())},
+            "annotations": sorted(mod.annotation_refs()),
+        }
+
+    def check(self) -> list[tuple[str, int, str, str]]:
+        findings: list[tuple[str, int, str, str]] = []
+
+        def flag(mod_name: str, lineno: int, rule: str, msg: str) -> None:
+            findings.append((mod_name, lineno, rule, msg))
+
+        for mod_name, mod in sorted(self.modules.items()):
+            if mod.contract_error:
+                flag(mod_name, mod.contract_line, "contract-parse",
+                     mod.contract_error)
+                continue
+            if mod.contract is None:
+                flag(mod_name, 1, "missing-contract",
+                     "controllers module without a CONTRACT declaration")
+                continue
+            contract = mod.contract
+            line = mod.contract_line
+            role = contract.get("role")
+            if role not in ROLES:
+                flag(mod_name, line, "contract-parse",
+                     f"role {role!r} not in {sorted(ROLES)}")
+                continue
+
+            reads, writes, watches, undeclared = self.merged(mod_name)
+            for src_mod, lineno, verb in undeclared:
+                flag(src_mod, lineno, "dynamic-write",
+                     f"{verb} of unresolvable kind; declare the function "
+                     f"in CONTRACT['dynamic_kinds']")
+
+            inferred = self.inferred_contract(mod_name)
+            for field in ("reads", "watches", "annotations"):
+                declared = set(contract.get(field, []))
+                actual = set(inferred[field])
+                for extra in sorted(actual - declared):
+                    flag(mod_name, line, f"effects-{field}-drift",
+                         f"inferred but undeclared: {extra}")
+                for stale in sorted(declared - actual):
+                    flag(mod_name, line, f"effects-{field}-drift",
+                         f"declared but not inferred: {stale}")
+            declared_writes = {k: sorted(v) for k, v in
+                              contract.get("writes", {}).items()}
+            if declared_writes != inferred["writes"]:
+                for kind in sorted(set(declared_writes) |
+                                   set(inferred["writes"])):
+                    want = inferred["writes"].get(kind)
+                    have = declared_writes.get(kind)
+                    if want != have:
+                        flag(mod_name, line, "effects-writes-drift",
+                             f"{kind}: declared {have}, inferred {want}")
+
+            for lineno, msg in self._spec_status(mod_name):
+                flag(mod_name, lineno, "spec-status-write", msg)
+
+            if role != "reconciler":
+                continue
+            primary = contract.get("primary")
+            written_kinds = {k for (k, _v, _ns) in writes}
+            unwatched_ok = contract.get("unwatched_writes", {})
+            for kind in sorted(written_kinds):
+                if kind in EXEMPT_WRITE_KINDS or kind in watches:
+                    continue
+                if kind not in unwatched_ok:
+                    flag(mod_name, line, "write-without-watch",
+                         f"writes {kind} but never watches it (hot-loop "
+                         f"past echo suppression); watch it or declare "
+                         f"it in CONTRACT['unwatched_writes'] with a "
+                         f"reason")
+            for kind in sorted(unwatched_ok):
+                if kind not in written_kinds or kind in watches:
+                    flag(mod_name, line, "write-without-watch",
+                         f"stale unwatched_writes entry: {kind}")
+
+            cross_ok = contract.get("cross_namespace", {})
+            if primary in CLUSTER_SCOPED_KINDS:
+                crossing = {k for k in written_kinds
+                            if k != primary and
+                            k not in EXEMPT_WRITE_KINDS}
+            else:
+                crossing = {k for (k, _v, ns) in writes
+                            if k != primary and
+                            k not in EXEMPT_WRITE_KINDS and
+                            (ns in ("foreign", "cluster") or
+                             k in CLUSTER_SCOPED_KINDS)}
+            for kind in sorted(crossing):
+                if kind not in cross_ok:
+                    flag(mod_name, line, "cross-namespace",
+                         f"writes {kind} outside the request namespace; "
+                         f"declare it in CONTRACT['cross_namespace'] "
+                         f"with a reason")
+            for kind in sorted(cross_ok):
+                if kind not in written_kinds:
+                    flag(mod_name, line, "cross-namespace",
+                         f"stale cross_namespace entry: {kind}")
+        return findings
+
+    def _spec_status(self, mod_name: str) -> list[tuple[int, str]]:
+        mod = self.modules[mod_name]
+        out: list[tuple[int, str]] = []
+        for summary in mod.functions.values():
+            out.extend(summary.spec_status)
+        return out
+
+
+# --------------------------------------------------------------------------
+# hygiene rules (wall clock / rng / unbounded loops)
+
+
+class HygieneLinter(ast.NodeVisitor):
+    def __init__(self, path: Path, source: str, *,
+                 check_clock: bool = True, check_loops: bool = True) -> None:
+        self.path = path
+        self.lines = source.splitlines()
+        self.check_clock = check_clock
+        self.check_loops = check_loops
+        self.findings: list[tuple[int, str, str]] = []
+        self.used_allowlist: set[tuple[str, str]] = set()
+        self._fn_stack: list[str] = []
+        self._sanctioned_rng: set[ast.Call] = set()
+
+    def flag(self, node: ast.AST, rule: str, msg: str) -> None:
+        self.findings.append((node.lineno, rule, msg))
+
+    def _allowlisted(self) -> bool:
+        for fn in self._fn_stack:
+            if (self.path.name, fn) in CLOCK_ALLOWLIST:
+                self.used_allowlist.add((self.path.name, fn))
+                return True
+        return False
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fn_stack.append(node.name)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+    def visit_BoolOp(self, node: ast.BoolOp) -> None:
+        # `rng or random.Random()` — the sanctioned injected-seam default
+        if isinstance(node.op, ast.Or):
+            has_seam = any(isinstance(v, (ast.Name, ast.Attribute))
+                           for v in node.values)
+            for value in node.values:
+                if has_seam and isinstance(value, ast.Call) and \
+                        _dotted(value.func) == "random.Random":
+                    self._sanctioned_rng.add(value)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        if self.check_loops and isinstance(node.test, ast.Constant) and \
+                node.test.value in (True, 1):
+            line = self.lines[node.lineno - 1] \
+                if node.lineno - 1 < len(self.lines) else ""
+            if not _LOOP_MARKER.search(line):
+                self.flag(node, "unbounded-loop",
+                          "while True: without a '# pump: <reason>' or "
+                          "'# bounded: <reason>' marker — state the "
+                          "termination/dispatch argument inline")
+        self.generic_visit(node)
+
+    def _clock_violation(self, node: ast.Call) -> tuple[str, str] | None:
+        dotted = _dotted(node.func)
+        if dotted in ("time.time", "datetime.now", "datetime.utcnow",
+                      "datetime.today", "date.today",
+                      "datetime.datetime.now",
+                      "datetime.datetime.utcnow"):
+            return ("wall-clock",
+                    f"{dotted}() in controller logic; inject a clock "
+                    f"seam (clock=time.time parameter) or add a "
+                    f"CLOCK_ALLOWLIST entry with a protocol reason")
+        if dotted in ("time.gmtime", "time.localtime") and not node.args:
+            return ("wall-clock",
+                    f"argless {dotted}() reads the wall clock; pass the "
+                    f"injected clock's value")
+        if dotted == "time.strftime" and len(node.args) < 2:
+            return ("wall-clock",
+                    "time.strftime without an explicit time tuple reads "
+                    "the wall clock")
+        if dotted == "random.Random" and \
+                node not in self._sanctioned_rng and not node.args:
+            return ("unseeded-random",
+                    "unseeded random.Random() outside an injected seam; "
+                    "accept `rng: random.Random | None` and default with "
+                    "`rng or random.Random()`")
+        if dotted.startswith("random.") and dotted.split(".")[1] in (
+                "random", "randint", "uniform", "choice", "choices",
+                "shuffle", "sample", "randrange", "gauss", "expovariate"):
+            return ("unseeded-random",
+                    f"module-level {dotted}() uses the shared unseeded "
+                    f"RNG; route through an injected random.Random "
+                    f"instance")
+        return None
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.check_clock:
+            violation = self._clock_violation(node)
+            if violation is not None and not self._allowlisted():
+                self.flag(node, *violation)
+        self.generic_visit(node)
+
+
+# --------------------------------------------------------------------------
+# drivers
+
+
+def _iter_files(*dirs: Path):
+    for d in dirs:
+        if d.is_dir():
+            yield from sorted(d.rglob("*.py"))
+
+
+def hygiene_findings() -> list[tuple[Path, int, str, str]]:
+    out: list[tuple[Path, int, str, str]] = []
+    clock_dirs = {CONTROLLERS, PACKAGE / "cluster", LOADTEST}
+    used: set[tuple[str, str]] = set()
+    for path in _iter_files(PACKAGE, LOADTEST):
+        check_clock = any(d in path.parents for d in clock_dirs)
+        source = path.read_text()
+        linter = HygieneLinter(path, source, check_clock=check_clock,
+                               check_loops=True)
+        linter.visit(ast.parse(source))
+        used |= linter.used_allowlist
+        out.extend((path, lineno, rule, msg)
+                   for lineno, rule, msg in linter.findings)
+    # the allowlist rots like any suppression: an entry that no longer
+    # shields a real wall-clock call must be deleted
+    for key in sorted(set(CLOCK_ALLOWLIST) - used):
+        out.append((Path(__file__), 1, "stale-allowlist",
+                    f"CLOCK_ALLOWLIST entry {key} suppresses nothing"))
+    return out
+
+
+def load_project() -> Project:
+    files = {}
+    for path in sorted(CONTROLLERS.glob("*.py")):
+        files[path.name] = (path, path.read_text())
+    return Project(files)
+
+
+def main(argv: list[str]) -> int:
+    project = load_project()
+    if "--dump" in argv:
+        import json
+        for mod_name in sorted(project.modules):
+            print(f"# {mod_name}")
+            print(json.dumps(project.inferred_contract(mod_name),
+                             indent=2, sort_keys=True))
+        return 0
+    failures = 0
+    for mod_name, lineno, rule, msg in project.check():
+        rel = (CONTROLLERS / mod_name).relative_to(REPO) \
+            if not Path(mod_name).is_absolute() else mod_name
+        print(f"{rel}:{lineno}: [{rule}] {msg}")
+        failures += 1
+    for path, lineno, rule, msg in hygiene_findings():
+        print(f"{path.relative_to(REPO)}:{lineno}: [{rule}] {msg}")
+        failures += 1
+    if failures:
+        print(f"\nci/effects.py: {failures} finding(s)", file=sys.stderr)
+        return 1
+    print("ci/effects.py: effect contracts and hygiene rules clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,331 @@
+"""API schema drift gate: manifests, CRDs, and chaos plans vs the schemas.
+
+Where ci/effects.py never imports the package, this gate deliberately does:
+the schemas under ``kubeflow_tpu/api`` are the single source of truth the
+in-process apiserver enforces at runtime, so the shipped YAML and every
+literal manifest the deploy generator emits must validate against them
+*before* a cluster ever sees them. Checks:
+
+  crd-structural      every schema node in the generated CRDs is a valid
+                      structural schema: typed (or explicitly
+                      preserve-unknown), compilable patterns, non-empty
+                      list enums, ``required`` keys declared in
+                      ``properties``
+  crd-roundtrip       the committed config/crd/bases YAML is byte-identical
+                      to what kubeflow_tpu/deploy/manifests.py regenerates
+                      (catches hand-edits to generated files and generator
+                      changes that never got re-rendered)
+  manifest-schema     every YAML document in the rendered kustomize tree
+                      parses, names a kind the REST mapper knows (so the
+                      controllers could actually GET what we deploy), and
+                      carries the apiVersion the mapper would serve it
+                      under; Deployment pod templates additionally validate
+                      against api.schema.pod_spec_schema()
+  manifest-literal    AST census of deploy/manifests.py: every literal dict
+                      carrying both "apiVersion" and "kind" uses a mapped
+                      kind + matching apiVersion (drift here ships 404s)
+  chaos-schema        chaos/experiments/*.yaml validate against both the
+                      semantic validator (cluster.experiments) and a
+                      structural JSON Schema enforced via
+                      api.schema.validate_schema
+
+Run: ``python ci/schema_gate.py`` — prints findings, exit 1 on any.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+import yaml
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from kubeflow_tpu.api import schema as api_schema  # noqa: E402
+from kubeflow_tpu.cluster import experiments, restmapper  # noqa: E402
+from kubeflow_tpu.deploy import manifests  # noqa: E402
+
+PRESERVE = api_schema.PRESERVE
+
+#: rendered-tree kinds that are kustomize build inputs, not API objects
+NON_API_KINDS = frozenset({"Kustomization"})
+
+
+# --------------------------------------------------------------------------
+# crd-structural
+# --------------------------------------------------------------------------
+def _walk_schema(node: dict, path: str, findings: list[str]) -> None:
+    if not isinstance(node, dict):
+        findings.append(f"{path}: schema node is not a mapping")
+        return
+    typed = "type" in node or node.get(PRESERVE) is True
+    if not typed and ("properties" in node or "items" in node
+                      or "additionalProperties" in node):
+        findings.append(f"{path}: untyped schema node (no 'type' and no "
+                        f"{PRESERVE})")
+    pattern = node.get("pattern")
+    if pattern is not None:
+        try:
+            re.compile(pattern)
+        except re.error as err:
+            findings.append(f"{path}: uncompilable pattern: {err}")
+    enum = node.get("enum")
+    if enum is not None and (not isinstance(enum, list) or not enum):
+        findings.append(f"{path}: enum must be a non-empty list")
+    props = node.get("properties") or {}
+    required = node.get("required") or []
+    for req in required:
+        if props and req not in props:
+            findings.append(f"{path}: required key {req!r} not declared "
+                            f"in properties")
+    for name, sub in props.items():
+        _walk_schema(sub, f"{path}.properties.{name}", findings)
+    if isinstance(node.get("items"), dict):
+        _walk_schema(node["items"], f"{path}.items", findings)
+    if isinstance(node.get("additionalProperties"), dict):
+        _walk_schema(node["additionalProperties"],
+                     f"{path}.additionalProperties", findings)
+
+
+def check_crd_structural() -> list[str]:
+    findings: list[str] = []
+    for crd in (manifests.notebook_crd(), manifests.slicepool_crd()):
+        name = crd["metadata"]["name"]
+        for version in crd["spec"]["versions"]:
+            root = (version.get("schema") or {}).get("openAPIV3Schema")
+            where = f"{name}/{version['name']}"
+            if root is None:
+                findings.append(f"{where}: version without openAPIV3Schema")
+                continue
+            _walk_schema(root, where, findings)
+    return [f"[crd-structural] {f}" for f in findings]
+
+
+# --------------------------------------------------------------------------
+# crd-roundtrip
+# --------------------------------------------------------------------------
+def check_crd_roundtrip() -> list[str]:
+    findings = []
+    rendered = manifests.generate_all()
+    for rel in sorted(r for r in rendered if r.startswith("crd/bases/")):
+        committed = REPO / "config" / rel
+        if not committed.exists():
+            findings.append(f"[crd-roundtrip] config/{rel} missing — run "
+                            f"ci/generate_manifests.py")
+            continue
+        if committed.read_text() != rendered[rel]:
+            findings.append(f"[crd-roundtrip] config/{rel} drifted from "
+                            f"the generator — run ci/generate_manifests.py")
+    return findings
+
+
+# --------------------------------------------------------------------------
+# manifest-schema
+# --------------------------------------------------------------------------
+def _validate_pod_template(doc: dict, where: str) -> list[str]:
+    spec = (((doc.get("spec") or {}).get("template") or {})
+            .get("spec") or {})
+    errs = api_schema.validate_schema(spec, api_schema.pod_spec_schema())
+    return [f"{where}: pod template: {e}" for e in errs]
+
+
+def check_rendered_tree() -> list[str]:
+    findings: list[str] = []
+    for rel, text in sorted(manifests.generate_all().items()):
+        if not rel.endswith((".yaml", ".yml")):
+            continue
+        try:
+            docs = list(yaml.safe_load_all(text))
+        except yaml.YAMLError as err:
+            findings.append(f"[manifest-schema] {rel}: unparseable: {err}")
+            continue
+        for doc in docs:
+            if not isinstance(doc, dict) or "kind" not in doc:
+                continue
+            kind = doc["kind"]
+            where = f"{rel}#{((doc.get('metadata') or {}).get('name'))}"
+            if kind in NON_API_KINDS:
+                continue
+            try:
+                mapping = restmapper.mapping_for(kind)
+            except KeyError:
+                findings.append(f"[manifest-schema] {where}: kind {kind!r} "
+                                f"has no REST mapping — controllers could "
+                                f"never read it back")
+                continue
+            want = mapping.api_version
+            have = doc.get("apiVersion")
+            if have != want:
+                findings.append(f"[manifest-schema] {where}: apiVersion "
+                                f"{have!r} != mapped {want!r}")
+            if kind == "Deployment":
+                findings.extend(
+                    f"[manifest-schema] {e}"
+                    for e in _validate_pod_template(doc, where))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# manifest-literal
+# --------------------------------------------------------------------------
+def _literal_manifests(tree: ast.AST) -> list[tuple[int, str, str]]:
+    """(lineno, kind, apiVersion) for every literal dict in the module
+    that spells out both keys as string constants."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        keys = {}
+        for key, value in zip(node.keys, node.values):
+            if isinstance(key, ast.Constant) and isinstance(key.value, str) \
+                    and isinstance(value, ast.Constant) \
+                    and isinstance(value.value, str):
+                keys[key.value] = value.value
+        if "kind" in keys and "apiVersion" in keys:
+            out.append((node.lineno, keys["kind"], keys["apiVersion"]))
+    return out
+
+
+def check_manifest_literals() -> list[str]:
+    findings = []
+    path = REPO / "kubeflow_tpu/deploy/manifests.py"
+    tree = ast.parse(path.read_text())
+    for lineno, kind, api_version in _literal_manifests(tree):
+        if kind in NON_API_KINDS:
+            continue
+        try:
+            mapping = restmapper.mapping_for(kind)
+        except KeyError:
+            findings.append(
+                f"[manifest-literal] deploy/manifests.py:{lineno}: literal "
+                f"manifest of unmapped kind {kind!r}")
+            continue
+        if api_version != mapping.api_version:
+            findings.append(
+                f"[manifest-literal] deploy/manifests.py:{lineno}: {kind} "
+                f"apiVersion {api_version!r} != mapped "
+                f"{mapping.api_version!r}")
+    return findings
+
+
+# --------------------------------------------------------------------------
+# chaos-schema
+# --------------------------------------------------------------------------
+def chaos_experiment_schema() -> dict:
+    """Structural shape of a ChaosExperiment, enforced on top of the
+    semantic validator in cluster/experiments.py (which checks enum
+    membership and required-ness; this catches type-level drift like a
+    string tier or a scalar checks list)."""
+    duration = {"type": "string",
+                "pattern": r"^\d+(\.\d+)?(ms|s|m|h)$"}
+    return {
+        "type": "object",
+        "required": ["apiVersion", "kind", "metadata", "spec"],
+        "properties": {
+            "apiVersion": {"type": "string"},
+            "kind": {"type": "string",
+                     "enum": [experiments.EXPERIMENT_KIND]},
+            "metadata": {
+                "type": "object",
+                "required": ["name"],
+                "properties": {"name": {"type": "string", "minLength": 1}},
+                PRESERVE: True,
+            },
+            "spec": {
+                "type": "object",
+                "required": ["tier", "target", "steadyState", "injection",
+                             "hypothesis", "blastRadius"],
+                "properties": {
+                    "tier": {"type": "integer", "minimum": 1, "maximum": 4},
+                    "target": {"type": "object", PRESERVE: True},
+                    "steadyState": {
+                        "type": "object",
+                        "required": ["timeout", "checks"],
+                        "properties": {
+                            "timeout": duration,
+                            "checks": {
+                                "type": "array",
+                                "minItems": 1,
+                                "items": {"type": "object", PRESERVE: True},
+                            },
+                        },
+                    },
+                    "injection": {
+                        "type": "object",
+                        "required": ["type"],
+                        "properties": {
+                            "type": {
+                                "type": "string",
+                                "enum": sorted(
+                                    experiments.VALID_INJECTIONS),
+                            },
+                            "parameters": {"type": "object",
+                                           PRESERVE: True},
+                        },
+                    },
+                    "hypothesis": {
+                        "type": "object",
+                        "required": ["description", "recoveryTimeout"],
+                        "properties": {
+                            "description": {"type": "string",
+                                            "minLength": 1},
+                            "recoveryTimeout": duration,
+                        },
+                    },
+                    "blastRadius": {
+                        "type": "object",
+                        "required": ["allowedNamespaces"],
+                        "properties": {
+                            "allowedNamespaces": {
+                                "type": "array",
+                                "minItems": 1,
+                                "items": {"type": "string"},
+                            },
+                        },
+                        PRESERVE: True,
+                    },
+                },
+            },
+        },
+    }
+
+
+def check_chaos() -> list[str]:
+    findings = []
+    exp_dir = REPO / "chaos/experiments"
+    findings.extend(f"[chaos-schema] {e}"
+                    for e in experiments.validate_dir(exp_dir))
+    schema = chaos_experiment_schema()
+    for path in sorted(exp_dir.glob("*.yaml")):
+        for doc in yaml.safe_load_all(path.read_text()):
+            if doc is None:
+                continue
+            findings.extend(
+                f"[chaos-schema] {path.relative_to(REPO)}: {e}"
+                for e in api_schema.validate_schema(doc, schema))
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    findings: list[str] = []
+    findings.extend(check_crd_structural())
+    findings.extend(check_crd_roundtrip())
+    findings.extend(check_rendered_tree())
+    findings.extend(check_manifest_literals())
+    findings.extend(check_chaos())
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(f"ci/schema_gate.py: {len(findings)} finding(s)",
+              file=sys.stderr)
+        return 1
+    print("ci/schema_gate.py: manifests, CRDs, and chaos plans match "
+          "the schemas", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -16,6 +16,7 @@ import calendar
 import hashlib
 import threading
 import time
+from typing import Callable
 
 from ..utils import k8s, sanitizer
 
@@ -63,10 +64,14 @@ class EventRecorder:
     """
 
     def __init__(self, client, component: str = "notebook-controller",
-                 ttl_seconds: float = EVENT_TTL_SECONDS):
+                 ttl_seconds: float = EVENT_TTL_SECONDS,
+                 clock: Callable[[], float] = time.time):
         self.client = client
         self.component = component
         self.ttl_seconds = ttl_seconds
+        # injected wall clock: TTL pruning compares Event timestamps, so
+        # tests can age events without sleeping
+        self.clock = clock
         self._lock = sanitizer.tracked_lock(
             "events.recorder", order=sanitizer.ORDER_LEAF)
         self._last_prune: dict[str, float] = {}  # namespace → monotonic time
@@ -143,7 +148,7 @@ class EventRecorder:
             if now_mono - last < _PRUNE_INTERVAL_SECONDS:
                 return
             self._last_prune[namespace] = now_mono
-        cutoff = time.time() - self.ttl_seconds
+        cutoff = self.clock() - self.ttl_seconds
         for ev in self.client.list(EVENT_KIND, namespace):
             # externally-created Events may carry only eventTime (events.k8s.io
             # shape) or none of the timestamps; never prune what we can't date

@@ -219,7 +219,8 @@ class HttpApiClient:
                  timeout: float = 30.0, metrics=None,
                  retry_policy: RetryPolicy | None = None,
                  list_page_size: int | None = None,
-                 user_agent: str = "kubeflow-tpu-manager") -> None:
+                 user_agent: str = "kubeflow-tpu-manager",
+                 rng: random.Random | None = None) -> None:
         self.base_url = base_url.rstrip("/")
         self.token = token
         self.timeout = timeout
@@ -233,7 +234,9 @@ class HttpApiClient:
         # latency of a fleet-sized LIST — the backfills and post-outage
         # resyncs page through instead of one giant body. None = unpaged.
         self.list_page_size = list_page_size
-        self._retry_rng = random.Random()  # decorrelated jitter source
+        # decorrelated jitter source; injectable so fault-injection tests
+        # can seed the backoff schedule deterministically
+        self._retry_rng = rng or random.Random()
         self._requests_metric = None
         self._retries_metric = None
         self._duration_metric = None
@@ -631,7 +634,7 @@ class HttpApiClient:
         ambiguous = False
         delay = policy.backoff_base_s
         attempt = 0
-        while True:
+        while True:  # bounded: raises once attempt reaches policy.max_attempts
             attempt += 1
             started = time.monotonic()
             try:
@@ -765,7 +768,7 @@ class HttpApiClient:
         cont: str | None = None
         list_rv: int | None = None
         first_page = True
-        while True:
+        while True:  # bounded: returns when continue token absent
             query = dict(base_query)
             if self.list_page_size:
                 query["limit"] = str(self.list_page_size)

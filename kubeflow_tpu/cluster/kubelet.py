@@ -78,7 +78,7 @@ class _BootScheduler:
             self._cv.notify()
 
     def _loop(self) -> None:
-        while True:
+        while True:  # pump: boot scheduler cv-wait; idle-exit after IDLE_EXIT_S
             due_batch: list[tuple[str, str]] = []
             with self._cv:
                 now = time.monotonic()
@@ -167,7 +167,8 @@ class StatefulSetSimulator:
     def __init__(self, client: ClusterStore, boot_delay_s: float = 0.0,
                  ready_hook=None, manage_nodes: bool = True,
                  node_grace_s: float = 0.25,
-                 event_driven_boot: bool = False):
+                 event_driven_boot: bool = False,
+                 wall_clock=time.time):
         """``ready_hook(pod) -> bool`` lets tests/bench gate pod readiness on
         e.g. a simulated TPU runtime verification. ``manage_nodes`` binds
         every pod to a simulated Node and runs the node-lifecycle behavior
@@ -184,6 +185,9 @@ class StatefulSetSimulator:
         self.manage_nodes = manage_nodes
         self.node_grace_s = node_grace_s
         self.event_driven_boot = event_driven_boot and ready_hook is None
+        # injected wall clock for status timestamps (startedAt): logic
+        # timing stays monotonic; only the rendered RFC3339 stamps differ
+        self.wall_clock = wall_clock
         self._boot_scheduler = _BootScheduler(self._boot_pod_ready) \
             if self.event_driven_boot else None
         self._boot_times: dict[tuple[str, str], float] = {}
@@ -349,7 +353,7 @@ class StatefulSetSimulator:
         changes)."""
         key = (ns, pod_name)
         gen = self._node_gen.get(key, 0)
-        while True:
+        while True:  # bounded: gen increments until a fresh node name creates
             node_name = f"sim-node-{ns}-{pod_name}-{gen}"
             node = self.client.get_or_none("Node", "", node_name)
             if node is None:
@@ -423,7 +427,8 @@ class StatefulSetSimulator:
         self._mark_ready(pod)
 
     def _mark_ready(self, pod: dict) -> None:
-        now = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+        now = time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                            time.gmtime(self.wall_clock()))
         container_statuses = [
             {"name": c.get("name", ""), "ready": True, "restartCount": 0,
              "state": {"running": {"startedAt": now}}}

@@ -5,6 +5,19 @@ from .extension import ExtensionReconciler
 from .slicerepair import SliceRepairReconciler
 from .slicepool import SlicePoolReconciler
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "wiring",
+    "reads": [],
+    "watches": [],
+    "writes": {},
+    "annotations": [],
+}
+
+
+
+
 __all__ = ["Manager", "Request", "NotebookReconciler", "CullingReconciler",
            "ExtensionReconciler", "SliceRepairReconciler",
            "SlicePoolReconciler", "setup_controllers"]

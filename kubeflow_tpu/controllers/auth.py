@@ -11,6 +11,19 @@ from __future__ import annotations
 
 from ..utils import k8s, names
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "generator",
+    "reads": [],
+    "watches": [],
+    "writes": {},
+    "annotations": ["NOTEBOOK_NAME_LABEL", "SERVING_CERT_SECRET_ANNOTATION"],
+}
+
+
+
+
 
 def sa_name(nb_name: str) -> str:
     return f"{nb_name}-auth-sa"[:63]

@@ -16,6 +16,21 @@ import logging
 from ..cluster import errors
 from ..utils import k8s, names
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "helper",
+    "reads": ["ConfigMap"],
+    "watches": [],
+    "writes": {
+        "ConfigMap": ["create", "delete", "update"],
+    },
+    "annotations": ["MANAGED_BY_LABEL"],
+}
+
+
+
+
 log = logging.getLogger("kubeflow_tpu.cacert")
 
 TRUSTED_CA_BUNDLE = "odh-trusted-ca-bundle"
@@ -33,7 +48,7 @@ def extract_valid_pem_blocks(data: str) -> list[str]:
     pem.Decode + x509.ParseCertificate per block)."""
     blocks: list[str] = []
     rest = data or ""
-    while True:
+    while True:  # bounded: rest strictly shrinks past each END marker
         start = rest.find(_BEGIN)
         if start < 0:
             break

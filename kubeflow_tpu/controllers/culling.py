@@ -39,6 +39,27 @@ from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
 from .manager import Manager, Request, Result
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "reconciler",
+    "primary": "Notebook",
+    "reads": ["Notebook", "Pod"],
+    "watches": ["Notebook"],
+    "writes": {
+        "Notebook": ["patch"],
+    },
+    "annotations": [
+        "LAST_ACTIVITY_ANNOTATION", "LAST_ACTIVITY_CHECK_TIMESTAMP_ANNOTATION",
+        "NOTEBOOK_NAME_LABEL", "POD_INDEX_LABEL", "SERVING_PORT_ANNOTATION",
+        "SERVING_REQUESTS_OBSERVED_ANNOTATION", "SLICE_HEALTH_ANNOTATION",
+        "STOP_ANNOTATION",
+    ],
+}
+
+
+
+
 log = logging.getLogger("kubeflow_tpu.culling")
 
 TIME_FORMAT = "%Y-%m-%dT%H:%M:%SZ"

@@ -29,6 +29,21 @@ from ..utils import sanitizer
 from ..cluster.errors import (AlreadyExistsError, ConflictError,
                               NotFoundError)
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "coordinator",
+    "reads": ["Lease"],
+    "watches": [],
+    "writes": {
+        "Lease": ["create", "update"],
+    },
+    "annotations": [],
+}
+
+
+
+
 log = logging.getLogger("kubeflow_tpu.election")
 
 LEASE_KIND = "Lease"

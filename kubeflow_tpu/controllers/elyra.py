@@ -25,6 +25,21 @@ from ..cluster import errors
 from ..utils import k8s, names
 from ..utils.config import ControllerConfig
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "helper",
+    "reads": ["DataSciencePipelinesApplication", "Gateway", "Route", "Secret"],
+    "watches": [],
+    "writes": {
+        "Secret": ["create", "delete", "update"],
+    },
+    "annotations": ["MANAGED_BY_LABEL"],
+}
+
+
+
+
 log = logging.getLogger("kubeflow_tpu.elyra")
 
 SECRET_NAME = "ds-pipeline-config"

@@ -27,6 +27,52 @@ from ..utils.metrics import MetricsRegistry
 from . import auth, cacert, netpol, oauth, rbac, routes, runtime_images
 from .manager import Manager, Request, Result, owner_mapper
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "reconciler",
+    "primary": "Notebook",
+    "reads": [
+        "ClusterRole", "ClusterRoleBinding", "ConfigMap", "HTTPRoute",
+        "ImageStream", "NetworkPolicy", "Notebook", "ReferenceGrant", "Role",
+        "RoleBinding", "Service", "ServiceAccount",
+    ],
+    "watches": [
+        "ConfigMap", "HTTPRoute", "ImageStream", "NetworkPolicy", "Notebook",
+        "ReferenceGrant", "RoleBinding", "Service", "ServiceAccount",
+    ],
+    "writes": {
+        "ClusterRoleBinding": ["create", "delete"],
+        "ConfigMap": ["create", "delete", "patch", "update"],
+        "Event": ["create"],
+        "HTTPRoute": ["create", "delete", "update"],
+        "NetworkPolicy": ["create", "delete", "update"],
+        "Notebook": ["patch", "update"],
+        "OAuthClient": ["delete"],
+        "ReferenceGrant": ["create", "delete", "update"],
+        "RoleBinding": ["create", "delete", "update"],
+        "Service": ["create", "delete", "patch"],
+        "ServiceAccount": ["create", "delete", "patch"],
+    },
+    "annotations": [
+        "INJECT_AUTH_ANNOTATION", "NOTEBOOK_NAME_LABEL", "STOP_ANNOTATION",
+    ],
+    "unwatched_writes": {
+        "ClusterRoleBinding": "one-shot OAuth proxy RBAC; deleted via "
+            "finalizer, no drift to reconcile",
+        "OAuthClient": "finalizer-only cleanup of the cluster OAuth "
+            "registration",
+    },
+    "cross_namespace": {
+        "ClusterRoleBinding": "cluster-scoped OAuth proxy RBAC",
+        "HTTPRoute": "routes live in the gateway controller namespace",
+        "OAuthClient": "cluster-scoped OAuth registration",
+    },
+}
+
+
+
+
 log = logging.getLogger("kubeflow_tpu.extension")
 
 FINALIZER_ROUTES = names.ROUTES_CLEANUP_FINALIZER

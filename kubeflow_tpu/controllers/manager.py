@@ -83,6 +83,19 @@ from ..utils import k8s, names, sanitizer, tracing
 from ..utils import logging as logging_mod
 from ..utils import metrics as metrics_mod
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "manager",
+    "reads": [],
+    "watches": [],
+    "writes": {},
+    "annotations": ["TRACE_CONTEXT_ANNOTATION"],
+}
+
+
+
+
 log = logging.getLogger("kubeflow_tpu.manager")
 
 _TRACER = tracing.get_tracer("kubeflow_tpu.manager")
@@ -543,7 +556,7 @@ class Manager:
         stay queued (stashed in _capped, returned to the heap when a slot
         frees) while this call waits for a worker to finish."""
         with self._cv:
-            while True:
+            while True:  # pump: cv-wait dispatch; exits on _running=False
                 now = time.monotonic()
                 found: _QueueItem | None = None
                 while self._queue and self._queue[0].ready_at <= now:
@@ -858,7 +871,7 @@ class Manager:
                                            for t in self._threads)
 
     def _worker(self) -> None:
-        while True:
+        while True:  # pump: worker drain; exits on _running=False
             with self._cv:
                 if not self._running:
                     return

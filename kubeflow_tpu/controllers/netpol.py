@@ -10,6 +10,21 @@ from __future__ import annotations
 from ..cluster import errors
 from ..utils import k8s, names
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "helper",
+    "reads": ["NetworkPolicy"],
+    "watches": [],
+    "writes": {
+        "NetworkPolicy": ["create", "delete", "update"],
+    },
+    "annotations": ["NAMESPACE_NAME_LABEL", "NOTEBOOK_NAME_LABEL"],
+}
+
+
+
+
 
 def notebook_policy_name(nb_name: str) -> str:
     return f"{nb_name}-ctrl-np"[:63]

@@ -37,6 +37,47 @@ from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
 from .manager import Manager, Request, Result, owner_mapper
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "reconciler",
+    "primary": "Notebook",
+    "reads": ["Event", "Notebook", "Pod", "SlicePool", "StatefulSet"],
+    "watches": [
+        "Event", "Notebook", "Pod", "Service", "SlicePool", "StatefulSet",
+        "VirtualService",
+    ],
+    "writes": {
+        "Event": ["create"],
+        "Notebook": ["patch", "update_status"],
+        "Pod": ["delete"],
+        "Service": ["create", "patch"],
+        "StatefulSet": ["create", "patch"],
+        "VirtualService": ["create", "patch"],
+    },
+    "annotations": [
+        "MIGRATION_STATE_ANNOTATION", "NOTEBOOK_NAME_LABEL", "POD_INDEX_LABEL",
+        "POOL_ANNOTATIONS", "POOL_BIND_MISS_ANNOTATION",
+        "POOL_BIND_PENDING_ANNOTATION", "REPAIR_SCALE_DOWN_ANNOTATION",
+        "RESTART_ANNOTATION", "SERVING_PORT_ANNOTATION",
+        "SLICE_HEALTH_ANNOTATION", "SLICE_HEALTH_REASON_ANNOTATION",
+        "SLICE_REPAIR_ANNOTATIONS", "STOP_ANNOTATION",
+        "TPU_ACCELERATOR_ANNOTATION", "TPU_SLICE_LABEL",
+        "TPU_TOPOLOGY_ANNOTATION", "TRACE_CONTEXT_ANNOTATION",
+    ],
+    "cross_namespace": {
+        "Pod": "restart of a pool-bound notebook bounces the bound slice's "
+            "workers in the pool namespace",
+    },
+    "dynamic_kinds": {
+        "_apply_drift": ["Service", "StatefulSet", "VirtualService"],
+        "_create_or_update": ["Service", "VirtualService"],
+    },
+}
+
+
+
+
 log = logging.getLogger("kubeflow_tpu.notebook")
 
 DEFAULT_CONTAINER_PORT = 8888
@@ -53,7 +94,8 @@ class NotebookReconciler:
     name = "notebook-controller"
 
     def __init__(self, client, config: ControllerConfig | None = None,
-                 metrics: MetricsRegistry | None = None):
+                 metrics: MetricsRegistry | None = None,
+                 wall_clock=time.time):
         # every write records its rv so our watches drop the echo of our
         # own writes (cluster/echo.py — essential once the manager runs
         # concurrent workers: echoes no longer vanish into queue backlog)
@@ -63,6 +105,11 @@ class NotebookReconciler:
         self.config = config or ControllerConfig()
         self.metrics = metrics or MetricsRegistry()
         self.metrics.on_scrape(self._scrape_running)
+        # wall clock for the bind-pending heartbeat check: the pool
+        # controller stamps epoch seconds from ITS wall clock, so the
+        # freshness comparison must be wall-to-wall — injected so tests
+        # can expire the heartbeat without sleeping
+        self.wall_clock = wall_clock
         self.recorder = events.EventRecorder(client, component=self.name)
         # watch-fed read cache for the Event predicate (built in setup();
         # reconcilers constructed without setup() fall back to live reads)
@@ -339,7 +386,7 @@ class NotebookReconciler:
                                        names.POOL_BIND_PENDING_ANNOTATION)
         if heartbeat is not None:
             try:
-                fresh = time.time() - float(heartbeat) < \
+                fresh = self.wall_clock() - float(heartbeat) < \
                     self.config.pool_bind_grace_s
             except (TypeError, ValueError):
                 fresh = False

@@ -20,6 +20,21 @@ import logging
 from ..cluster import errors
 from ..utils import k8s, names
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "helper",
+    "reads": [],
+    "watches": [],
+    "writes": {
+        "OAuthClient": ["delete"],
+    },
+    "annotations": [],
+}
+
+
+
+
 log = logging.getLogger("kubeflow_tpu.oauth")
 
 OAUTH_CLIENT_KIND = "OAuthClient"

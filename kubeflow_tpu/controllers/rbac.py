@@ -11,6 +11,22 @@ from __future__ import annotations
 from ..cluster import errors
 from ..utils import k8s, names
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "helper",
+    "reads": ["ClusterRole", "Role", "RoleBinding"],
+    "watches": [],
+    "writes": {
+        "Event": ["create"],
+        "RoleBinding": ["create", "delete", "update"],
+    },
+    "annotations": ["MLFLOW_INSTANCE_ANNOTATION", "NOTEBOOK_NAME_LABEL"],
+}
+
+
+
+
 PIPELINE_ROLE = "ds-pipeline-user-access-dspa"
 MLFLOW_CLUSTER_ROLE = "mlflow-operator-mlflow-integration"
 MLFLOW_IDENTIFIER = "mlflow"

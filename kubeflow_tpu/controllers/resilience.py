@@ -40,6 +40,19 @@ import time
 
 from ..utils import sanitizer
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "infrastructure",
+    "reads": [],
+    "watches": [],
+    "writes": {},
+    "annotations": [],
+}
+
+
+
+
 log = logging.getLogger("kubeflow_tpu.resilience")
 
 STATE_CLOSED = "closed"

@@ -15,6 +15,22 @@ from ..utils import k8s, names
 from ..utils.config import ControllerConfig
 from .auth import tls_service_name
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "helper",
+    "reads": ["HTTPRoute", "Notebook", "ReferenceGrant"],
+    "watches": [],
+    "writes": {
+        "HTTPRoute": ["create", "delete", "update"],
+        "ReferenceGrant": ["create", "delete", "update"],
+    },
+    "annotations": ["MANAGED_BY_LABEL", "NOTEBOOK_NAME_LABEL"],
+}
+
+
+
+
 ROUTE_NAMESPACE_LABEL = "notebook-namespace"
 REFERENCE_GRANT_NAME = "notebook-httproute-access"
 

@@ -16,6 +16,24 @@ import re
 from ..cluster import errors
 from ..utils import k8s, names
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "helper",
+    "reads": ["ConfigMap", "ImageStream"],
+    "watches": [],
+    "writes": {
+        "ConfigMap": ["create", "update"],
+    },
+    "annotations": [
+        "MANAGED_BY_LABEL", "RUNTIME_IMAGE_LABEL",
+        "RUNTIME_IMAGE_METADATA_ANNOTATION",
+    ],
+}
+
+
+
+
 log = logging.getLogger("kubeflow_tpu.runtime_images")
 
 RUNTIME_IMAGE_LABEL = names.RUNTIME_IMAGE_LABEL

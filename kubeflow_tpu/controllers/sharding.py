@@ -57,6 +57,21 @@ from ..cluster.errors import (AlreadyExistsError, ApiError, ConflictError,
                               NotFoundError)
 from ..cluster.http_client import TRANSPORT_ERRORS
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "coordinator",
+    "reads": ["Lease"],
+    "watches": [],
+    "writes": {
+        "Lease": ["create", "update"],
+    },
+    "annotations": [],
+}
+
+
+
+
 log = logging.getLogger("kubeflow_tpu.sharding")
 
 SHARD_LEASE_PREFIX = "kubeflow-tpu-shard-"

@@ -55,6 +55,46 @@ from ..utils.metrics import MetricsRegistry
 from .manager import Manager, Request, Result
 from .slicerepair import node_problem
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "reconciler",
+    "primary": "SlicePool",
+    "reads": ["Node", "Notebook", "Pod", "SlicePool", "StatefulSet"],
+    "watches": ["Notebook", "Pod", "SlicePool", "StatefulSet"],
+    "writes": {
+        "Event": ["create"],
+        "Notebook": ["patch"],
+        "Pod": ["delete", "patch"],
+        "Service": ["create", "delete"],
+        "SlicePool": ["update_status"],
+        "StatefulSet": ["create", "delete", "patch", "update"],
+    },
+    "annotations": [
+        "BOUND_NAMESPACE_LABEL", "BOUND_POOL_ANNOTATION",
+        "BOUND_SLICE_ANNOTATION", "MIGRATION_STATE_ANNOTATION",
+        "NOTEBOOK_NAME_LABEL", "POD_INDEX_LABEL", "POOL_BIND_MISS_ANNOTATION",
+        "POOL_BIND_PENDING_ANNOTATION", "POOL_BOUND_TO_ANNOTATION",
+        "POOL_LABEL", "POOL_STATE_ANNOTATION", "SLICE_IDENTITY_ANNOTATION",
+        "STOP_ANNOTATION", "TPU_SLICE_LABEL", "TRACE_CONTEXT_ANNOTATION",
+    ],
+    "unwatched_writes": {
+        "Service": "headless per-slice Service is create-once and deleted "
+            "with its StatefulSet",
+    },
+    "cross_namespace": {
+        "Notebook": "bound-mode bind/unbind patches into the notebook's "
+            "namespace",
+        "Pod": "repair evicts bound-notebook pods in their namespace",
+        "Service": "per-slice headless Service lands in the bound namespace",
+        "StatefulSet": "warm slices materialize in the pool-configured "
+            "namespace",
+    },
+}
+
+
+
+
 log = logging.getLogger("kubeflow_tpu.slicepool")
 
 _TRACER = tracing.get_tracer("kubeflow_tpu.slicepool")
@@ -120,13 +160,18 @@ class SlicePoolReconciler:
 
     def __init__(self, client, config: ControllerConfig | None = None,
                  metrics: MetricsRegistry | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic, wall_clock=time.time):
         from ..cluster.echo import EchoTrackingClient
         client = EchoTrackingClient(client)
         self.client = client
         self.config = config or ControllerConfig()
         self.metrics = metrics or MetricsRegistry()
         self.clock = clock
+        # wall clock for the bind-pending heartbeat annotation: it is a
+        # cross-controller epoch-seconds protocol (the notebook reconciler
+        # compares it against ITS wall clock), so it cannot be monotonic —
+        # but it can be injected, keeping bind-timeout tests sleepless
+        self.wall_clock = wall_clock
         self.recorder = events.EventRecorder(client, component=self.name)
         self._read_cache = None
         self._lock = sanitizer.tracked_lock(
@@ -556,12 +601,11 @@ class SlicePoolReconciler:
         create — a late selector fix would orphan pods the StatefulSet
         controller already rolled from the unlabeled template."""
         pool_name = k8s.name(pool)
-        i = 0
-        while True:
+        # len(taken)+1 candidates always contain a free name (pigeonhole)
+        for i in range(len(taken) + 1):
             name = f"{pool_name[: names.MAX_STS_NAME_LENGTH - 5]}-w{i}"
             if name not in taken:
                 break
-            i += 1
         container = {
             "name": "warm-slice",
             "image": self.config.tpu_default_image,
@@ -712,7 +756,7 @@ class SlicePoolReconciler:
             last = float(raw) if raw else 0.0
         except (TypeError, ValueError):
             last = 0.0
-        now = time.time()
+        now = self.wall_clock()
         if now - last < self.config.pool_bind_grace_s / 2:
             return
         try:

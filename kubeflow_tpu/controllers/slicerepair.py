@@ -54,6 +54,32 @@ from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
 from .manager import Manager, Request, Result
 
+# API effect contract — ci/effects.py checks this declaration
+# against the AST-inferred effect summary; update both together.
+CONTRACT = {
+    "role": "reconciler",
+    "primary": "Notebook",
+    "reads": ["Notebook", "Pod"],
+    "watches": ["Node", "Notebook", "Pod"],
+    "writes": {
+        "Event": ["create"],
+        "Notebook": ["patch"],
+    },
+    "annotations": [
+        "BOUND_NAMESPACE_LABEL", "BOUND_POOL_ANNOTATION",
+        "BOUND_SLICE_ANNOTATION", "CHECKPOINT_TOKEN_ANNOTATION",
+        "MIGRATION_STARTED_AT_ANNOTATION", "MIGRATION_STATE_ANNOTATION",
+        "NOTEBOOK_NAME_LABEL", "POOL_BIND_MISS_ANNOTATION",
+        "QUARANTINE_ANNOTATION", "REPAIR_FAILURES_ANNOTATION",
+        "REPAIR_SCALE_DOWN_ANNOTATION", "REPAIR_STARTED_AT_ANNOTATION",
+        "SLICE_HEALTH_ANNOTATION", "SLICE_HEALTH_REASON_ANNOTATION",
+        "STOP_ANNOTATION", "TRACE_CONTEXT_ANNOTATION",
+    ],
+}
+
+
+
+
 MIGRATION_CHECKPOINTING = "Checkpointing"
 MIGRATION_BINDING = "Binding"
 MIGRATION_RESUMING = "Resuming"

@@ -204,7 +204,7 @@ def prefetch_to_device(source: Iterable, mesh: Mesh,
         def close(self) -> None:
             done.set()
             # unblock a producer waiting on a full queue
-            while True:
+            while True:  # bounded: drains buffer until Empty
                 try:
                     buf.get_nowait()
                 except queue.Empty:

@@ -676,7 +676,7 @@ class ServingServer:
 
         t_end = time.monotonic() + self.request_timeout_s
         n_tokens = 0
-        while True:
+        while True:  # bounded: t_end deadline raises/returns within request_timeout_s
             try:
                 tok = q.get(timeout=min(0.25, max(0.0, t_end -
                                                   time.monotonic())))
@@ -688,7 +688,7 @@ class ServingServer:
                 pass
             if future.done():
                 # drain ids emitted between the last get and completion
-                while True:
+                while True:  # bounded: drains queue until Empty
                     try:
                         tok = q.get_nowait()
                     except queue.Empty:

@@ -215,7 +215,7 @@ class BatchedGenerator:
         return batch
 
     def _scheduler(self) -> None:
-        while True:
+        while True:  # pump: scheduler; sentinel batch=None breaks via return
             batch = self._take_batch()
             if batch is None:
                 # drain: fail any stragglers so callers don't hang. close()
@@ -223,7 +223,7 @@ class BatchedGenerator:
                 # last possible submit, so everything is visible here.
                 stragglers = list(self._pending)
                 self._pending.clear()
-                while True:
+                while True:  # bounded: drains queue until Empty
                     try:
                         req = self._queue.get_nowait()
                     except queue.Empty:
@@ -1067,7 +1067,7 @@ class ContinuousBatchedGenerator:
 
     def _loop(self) -> None:
         draining = False
-        while True:
+        while True:  # pump: decode loop; exits when draining and slots idle
             # stage as many arrivals as there are free slots; block for
             # work only when fully idle (nothing decoding, nothing
             # admitting)
@@ -1166,7 +1166,7 @@ class ContinuousBatchedGenerator:
 
     def _shutdown(self) -> None:
         stragglers = [s.req for s in self._slots if s.req is not None]
-        while True:
+        while True:  # bounded: drains queue until Empty
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:

@@ -237,7 +237,7 @@ class OtlpHttpExporter:
         self._flush()
 
     def _flusher(self) -> None:
-        while True:
+        while True:  # pump: flusher; returns after observing _closed
             self._wake.wait(self.flush_interval_s)
             self._wake.clear()
             with self._lock:

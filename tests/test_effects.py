@@ -1,0 +1,285 @@
+"""The effect-contract analyzer (ci/effects.py) — every rule must fire on
+a mini-controller built to violate it, the escape hatches must actually
+suppress, and the shipped package must be contract-clean."""
+
+from __future__ import annotations
+
+import importlib.util
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+spec = importlib.util.spec_from_file_location("effects_mod",
+                                              REPO / "ci/effects.py")
+effects = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(effects)
+
+
+def project_rules(files: dict[str, str]) -> set[str]:
+    """Rule names the contract checker emits over fixture modules (keyed
+    by filename, as if they lived under kubeflow_tpu/controllers/)."""
+    proj = effects.Project({
+        name: (effects.CONTROLLERS / name, src)
+        for name, src in files.items()})
+    return {rule for (_mod, _line, rule, _msg) in proj.check()}
+
+
+def hygiene_rules(code: str, filename: str = "mini.py") -> set[str]:
+    import ast
+    linter = effects.HygieneLinter(Path("/tmp") / filename, code)
+    linter.visit(ast.parse(code))
+    return {rule for (_line, rule, _msg) in linter.findings}
+
+
+# a contract-complete reconciler every violating fixture is a twist on
+CLEAN_RECONCILER = '''\
+CONTRACT = {
+    "role": "reconciler",
+    "primary": "Notebook",
+    "reads": ["Notebook"],
+    "watches": ["Notebook"],
+    "writes": {"Notebook": ["update_status"]},
+    "annotations": [],
+}
+
+
+class Mini:
+    def register(self, mgr):
+        mgr.watch("Notebook", self)
+
+    def reconcile(self, req):
+        nb = self.client.get("Notebook", req.namespace, req.name)
+        self.client.update_status(nb)
+'''
+
+
+CONTRACT_CASES = [
+    # no CONTRACT at all
+    ("missing-contract", "class Mini:\n    pass\n"),
+    # CONTRACT must be a pure literal
+    ("contract-parse",
+     "ROLE = 'helper'\nCONTRACT = {'role': ROLE}\n"),
+    # role outside the closed vocabulary
+    ("contract-parse", "CONTRACT = {'role': 'pilot'}\n"),
+    # reads a kind the contract never declares
+    ("effects-reads-drift",
+     CLEAN_RECONCILER.replace(
+         'nb = self.client.get("Notebook", req.namespace, req.name)',
+         'nb = self.client.get("Notebook", req.namespace, req.name)\n'
+         '        self.client.get("Pod", req.namespace, req.name)')),
+    # declares a watch the code never registers
+    ("effects-watches-drift",
+     CLEAN_RECONCILER.replace('"watches": ["Notebook"]',
+                              '"watches": ["Notebook", "Pod"]')),
+    # touches an annotation constant the contract omits
+    ("effects-annotations-drift",
+     CLEAN_RECONCILER.replace(
+         "self.client.update_status(nb)",
+         "self.client.update_status(nb)\n"
+         "        k8s.get_annotation(nb, names.STOP_ANNOTATION)")),
+    # writes with a verb the contract does not declare
+    ("effects-writes-drift",
+     CLEAN_RECONCILER.replace(
+         "self.client.update_status(nb)",
+         "self.client.update_status(nb)\n"
+         "        self.client.update(nb)")),
+    # write of a kind the resolver cannot pin, not declared dynamic
+    ("dynamic-write",
+     CLEAN_RECONCILER.replace(
+         "self.client.update_status(nb)",
+         "self.client.update_status(nb)\n"
+         "        self.client.create(req.mystery)")),
+    # one patch body carrying both spec and status
+    ("spec-status-write",
+     CLEAN_RECONCILER.replace(
+         "self.client.update_status(nb)",
+         "self.client.update_status(nb)\n"
+         '        self.client.patch("Notebook", req.namespace, req.name,\n'
+         '                          {"spec": {}, "status": {}})')),
+    # update() after mutating obj["status"]
+    ("spec-status-write",
+     CLEAN_RECONCILER.replace(
+         "self.client.update_status(nb)",
+         'nb["status"] = {}\n        self.client.update(nb)')),
+    # writes a kind it never watches (echo-suppression hot loop)
+    ("write-without-watch",
+     CLEAN_RECONCILER.replace(
+         '"writes": {"Notebook": ["update_status"]}',
+         '"writes": {"ConfigMap": ["create"],\n'
+         '               "Notebook": ["update_status"]}').replace(
+         "self.client.update_status(nb)",
+         "self.client.update_status(nb)\n"
+         '        self.client.create({"kind": "ConfigMap",\n'
+         '                            "metadata": {"name": "x"}})')),
+    # unwatched_writes entry that shields nothing
+    ("write-without-watch",
+     CLEAN_RECONCILER.replace(
+         '"annotations": [],',
+         '"annotations": [],\n'
+         '    "unwatched_writes": {"ConfigMap": "stale"},')),
+    # write landing in a literal foreign namespace, undeclared
+    ("cross-namespace",
+     CLEAN_RECONCILER.replace(
+         '"writes": {"Notebook": ["update_status"]}',
+         '"writes": {"Notebook": ["update_status"],\n'
+         '               "Service": ["create"]},\n'
+         '    "unwatched_writes": {"Service": "create-once"}').replace(
+         "self.client.update_status(nb)",
+         "self.client.update_status(nb)\n"
+         '        self.client.create({"kind": "Service", "metadata":\n'
+         '                            {"namespace": "gateway-system"}})')),
+    # cross_namespace entry for a kind that is never written
+    ("cross-namespace",
+     CLEAN_RECONCILER.replace(
+         '"annotations": [],',
+         '"annotations": [],\n'
+         '    "cross_namespace": {"Service": "stale"},')),
+    # every write of a cluster-scoped primary's OTHER kinds must be
+    # declared (bound-mode writes land in foreign namespaces by design)
+    ("cross-namespace",
+     '''CONTRACT = {
+    "role": "reconciler",
+    "primary": "SlicePool",
+    "reads": ["SlicePool"],
+    "watches": ["Notebook", "SlicePool"],
+    "writes": {"Notebook": ["patch"], "SlicePool": ["update_status"]},
+    "annotations": [],
+}
+
+
+class Mini:
+    def register(self, mgr):
+        mgr.watch("SlicePool", self)
+        mgr.watch("Notebook", self)
+
+    def reconcile(self, req):
+        pool = self.client.get("SlicePool", "", req.name)
+        self.client.patch("Notebook", req.namespace, req.name, {})
+        self.client.update_status(pool)
+'''),
+]
+
+
+@pytest.mark.parametrize("rule,code", CONTRACT_CASES)
+def test_contract_rule_fires(rule, code):
+    assert rule in project_rules({"mini.py": code})
+
+
+def test_clean_reconciler_has_no_findings():
+    assert project_rules({"mini.py": CLEAN_RECONCILER}) == set()
+
+
+def test_cluster_scoped_primary_clean_with_declared_crossings():
+    code = CONTRACT_CASES[-1][1].replace(
+        '"annotations": [],',
+        '"annotations": [],\n'
+        '    "cross_namespace": {"Notebook": "bound-mode bind patch"},')
+    assert project_rules({"mini.py": code}) == set()
+
+
+def test_dynamic_kinds_declaration_resolves_the_write():
+    code = CLEAN_RECONCILER.replace(
+        '"writes": {"Notebook": ["update_status"]}',
+        '"writes": {"Notebook": ["update_status"],\n'
+        '               "Service": ["create"]},\n'
+        '    "unwatched_writes": {"Service": "create-once"},\n'
+        '    "cross_namespace": {"Service": "mesh config"},\n'
+        '    "dynamic_kinds": {"reconcile": ["Service"]}').replace(
+        "self.client.update_status(nb)",
+        "self.client.update_status(nb)\n"
+        "        self.client.create(req.mystery)")
+    rules = project_rules({"mini.py": code})
+    assert "dynamic-write" not in rules
+    assert "effects-writes-drift" not in rules
+
+
+def test_event_writes_exempt_from_watch_requirement():
+    code = CLEAN_RECONCILER.replace(
+        '"writes": {"Notebook": ["update_status"]}',
+        '"writes": {"Event": ["create"],\n'
+        '               "Notebook": ["update_status"]}').replace(
+        "self.client.update_status(nb)",
+        "self.client.update_status(nb)\n"
+        '        self.recorder.eventf(nb, "Normal", "Synced", "ok")')
+    assert project_rules({"mini.py": code}) == set()
+
+
+HYGIENE_CASES = [
+    ("wall-clock", "import time\n\n\ndef f():\n    return time.time()\n"),
+    ("wall-clock",
+     "from datetime import datetime\n\n\ndef f():\n"
+     "    return datetime.now()\n"),
+    ("wall-clock",
+     "import time\n\n\ndef f():\n    return time.gmtime()\n"),
+    ("wall-clock",
+     "import time\n\n\ndef f():\n"
+     "    return time.strftime('%Y')\n"),
+    ("unseeded-random",
+     "import random\n\n\ndef f():\n    return random.Random()\n"),
+    ("unseeded-random",
+     "import random\n\n\ndef f():\n    return random.randint(0, 9)\n"),
+    ("unbounded-loop", "def f():\n    while True:\n        pass\n"),
+]
+
+
+@pytest.mark.parametrize("rule,code", HYGIENE_CASES)
+def test_hygiene_rule_fires(rule, code):
+    assert rule in hygiene_rules(code)
+
+
+NEGATIVE_HYGIENE = [
+    # injected-seam default is the sanctioned spelling
+    ("unseeded-random",
+     "import random\n\n\ndef f(rng=None):\n"
+     "    return rng or random.Random()\n"),
+    # seeded RNG is deterministic, fine anywhere
+    ("unseeded-random",
+     "import random\n\n\ndef f():\n    return random.Random(0)\n"),
+    # monotonic time is not the wall clock
+    ("wall-clock", "import time\n\n\ndef f():\n"
+     "    return time.monotonic()\n"),
+    # an explicit time tuple pins strftime
+    ("wall-clock",
+     "import time\n\n\ndef f(t):\n"
+     "    return time.strftime('%Y', time.gmtime(t))\n"),
+    ("unbounded-loop",
+     "def f():\n    while True:  # pump: cv-wait loop\n        pass\n"),
+    ("unbounded-loop",
+     "def f():\n    while True:  # bounded: raises at max\n"
+     "        pass\n"),
+]
+
+
+@pytest.mark.parametrize("rule,code", NEGATIVE_HYGIENE)
+def test_hygiene_rule_stays_quiet(rule, code):
+    assert rule not in hygiene_rules(code)
+
+
+def test_clock_allowlist_suppresses_by_file_and_function():
+    code = ("import time\n\n\ndef try_acquire_or_renew():\n"
+            "    return time.time()\n")
+    # same code: allowlisted in election.py, a violation elsewhere
+    assert hygiene_rules(code, filename="election.py") == set()
+    assert "wall-clock" in hygiene_rules(code, filename="mini.py")
+
+
+def test_stale_allowlist_entry_is_flagged(monkeypatch):
+    patched = dict(effects.CLOCK_ALLOWLIST)
+    patched[("nope.py", "nothing")] = "bogus entry"
+    monkeypatch.setattr(effects, "CLOCK_ALLOWLIST", patched)
+    findings = effects.hygiene_findings()
+    assert any(r == "stale-allowlist" and "nope.py" in m
+               for (_p, _l, r, m) in findings)
+    # and ONLY the injected entry is stale — the shipped list is live
+    assert sum(1 for (_p, _l, r, _m) in findings
+               if r == "stale-allowlist") == 1
+
+
+def test_shipped_package_is_contract_clean():
+    proc = subprocess.run([sys.executable, str(REPO / "ci/effects.py")],
+                          capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -1,0 +1,189 @@
+"""The schema drift gate (ci/schema_gate.py) — each check must catch its
+target drift, the committed CRD YAML must round-trip byte-identical
+through the generator, and the shipped tree must be clean."""
+
+from __future__ import annotations
+
+import ast
+import importlib.util
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+spec = importlib.util.spec_from_file_location("schema_gate_mod",
+                                              REPO / "ci/schema_gate.py")
+gate = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(gate)
+
+from kubeflow_tpu.api import schema as api_schema  # noqa: E402
+from kubeflow_tpu.deploy import manifests  # noqa: E402
+
+
+# ----------------------------------------------------------- crd-structural
+def _findings_for_schema(node: dict) -> list[str]:
+    findings: list[str] = []
+    gate._walk_schema(node, "root", findings)
+    return findings
+
+
+def test_untyped_schema_node_fires():
+    bad = {"type": "object",
+           "properties": {"x": {"properties": {"y": {"type": "string"}}}}}
+    assert any("untyped" in f for f in _findings_for_schema(bad))
+
+
+def test_preserve_unknown_counts_as_typed():
+    ok = {"type": "object",
+          "properties": {"x": {api_schema.PRESERVE: True,
+                               "properties": {}}}}
+    assert _findings_for_schema(ok) == []
+
+
+def test_uncompilable_pattern_fires():
+    bad = {"type": "string", "pattern": "([unclosed"}
+    assert any("pattern" in f for f in _findings_for_schema(bad))
+
+
+def test_empty_enum_fires():
+    bad = {"type": "string", "enum": []}
+    assert any("enum" in f for f in _findings_for_schema(bad))
+
+
+def test_required_key_missing_from_properties_fires():
+    bad = {"type": "object", "required": ["gone"],
+           "properties": {"here": {"type": "string"}}}
+    assert any("required" in f for f in _findings_for_schema(bad))
+
+
+def test_shipped_crd_schemas_are_structural():
+    assert gate.check_crd_structural() == []
+
+
+# ------------------------------------------------------------ crd-roundtrip
+@pytest.mark.parametrize("rel", ["crd/bases/kubeflow.org_notebooks.yaml",
+                                 "crd/bases/tpu.kubeflow.org_slicepools.yaml"])
+def test_committed_crd_yaml_round_trips_byte_identical(rel):
+    """Regenerating the CRD from the api/ schemas must reproduce the
+    committed file exactly — a hand-edit to the YAML or a schema change
+    that never got re-rendered both fail here."""
+    rendered = manifests.generate_all()
+    committed = (REPO / "config" / rel).read_text()
+    assert committed == rendered[rel]
+
+
+def test_roundtrip_check_flags_a_drifted_generator(monkeypatch):
+    real = manifests.generate_all()
+    drifted = dict(real)
+    key = "crd/bases/kubeflow.org_notebooks.yaml"
+    drifted[key] = real[key] + "# sneaky hand edit\n"
+    monkeypatch.setattr(gate.manifests, "generate_all", lambda: drifted)
+    assert any("drifted" in f for f in gate.check_crd_roundtrip())
+
+
+# ----------------------------------------------------------- manifest-schema
+def test_unmapped_kind_in_rendered_tree_fires(monkeypatch):
+    monkeypatch.setattr(gate.manifests, "generate_all", lambda: {
+        "weird/thing.yaml":
+            "apiVersion: made.up/v1\nkind: FluxCapacitor\n"
+            "metadata:\n  name: x\n"})
+    assert any("no REST mapping" in f for f in gate.check_rendered_tree())
+
+
+def test_wrong_api_version_in_rendered_tree_fires(monkeypatch):
+    monkeypatch.setattr(gate.manifests, "generate_all", lambda: {
+        "apps/dep.yaml":
+            "apiVersion: apps/v1beta1\nkind: Deployment\n"
+            "metadata:\n  name: x\n"})
+    assert any("apiVersion" in f for f in gate.check_rendered_tree())
+
+
+def test_bad_pod_template_in_deployment_fires(monkeypatch):
+    monkeypatch.setattr(gate.manifests, "generate_all", lambda: {
+        "apps/dep.yaml": "\n".join([
+            "apiVersion: apps/v1",
+            "kind: Deployment",
+            "metadata:",
+            "  name: x",
+            "spec:",
+            "  template:",
+            "    spec:",
+            "      containers:",
+            "      - image: img",   # missing required container name
+            ""])})
+    assert any("pod template" in f for f in gate.check_rendered_tree())
+
+
+def test_shipped_rendered_tree_is_clean():
+    assert gate.check_rendered_tree() == []
+
+
+# ---------------------------------------------------------- manifest-literal
+def test_literal_census_sees_nested_dicts():
+    tree = ast.parse(
+        "def f():\n"
+        "    return {'wrapper': {'apiVersion': 'v1', 'kind': 'Pod'}}\n")
+    assert gate._literal_manifests(tree) == [(2, "Pod", "v1")]
+
+
+def test_literal_census_ignores_computed_values():
+    tree = ast.parse("x = {'apiVersion': ver, 'kind': 'Pod'}\n")
+    assert gate._literal_manifests(tree) == []
+
+
+def test_shipped_manifest_literals_are_mapped():
+    assert gate.check_manifest_literals() == []
+
+
+# --------------------------------------------------------------- chaos-schema
+def _valid_experiment() -> dict:
+    return {
+        "apiVersion": "chaos.kubeflow-tpu.org/v1alpha1",
+        "kind": "ChaosExperiment",
+        "metadata": {"name": "x"},
+        "spec": {
+            "tier": 1,
+            "target": {"operator": "o", "component": "c", "resource": "r"},
+            "steadyState": {"timeout": "30s",
+                            "checks": [{"type": "resourceExists"}]},
+            "injection": {"type": "PodKill"},
+            "hypothesis": {"description": "d", "recoveryTimeout": "60s"},
+            "blastRadius": {"allowedNamespaces": ["ns"]},
+        },
+    }
+
+
+def test_valid_experiment_passes_structural_schema():
+    errs = api_schema.validate_schema(_valid_experiment(),
+                                      gate.chaos_experiment_schema())
+    assert errs == []
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda d: d["spec"].__setitem__("tier", "one"),
+    lambda d: d["spec"].__setitem__("tier", 9),
+    lambda d: d["spec"]["injection"].__setitem__("type", "MeteorStrike"),
+    lambda d: d["spec"]["steadyState"].__setitem__("checks", []),
+    lambda d: d["spec"]["steadyState"].__setitem__("timeout", "soonish"),
+    lambda d: d["spec"]["hypothesis"].pop("recoveryTimeout"),
+    lambda d: d["spec"]["blastRadius"].__setitem__("allowedNamespaces", []),
+])
+def test_broken_experiment_fails_structural_schema(mutate):
+    doc = _valid_experiment()
+    mutate(doc)
+    errs = api_schema.validate_schema(doc, gate.chaos_experiment_schema())
+    assert errs
+
+
+def test_shipped_chaos_experiments_are_clean():
+    assert gate.check_chaos() == []
+
+
+# ------------------------------------------------------------------- gate e2e
+def test_shipped_tree_passes_the_whole_gate():
+    proc = subprocess.run([sys.executable, str(REPO / "ci/schema_gate.py")],
+                          capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
