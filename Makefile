@@ -6,6 +6,7 @@ TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 
 .PHONY: help test test-fast test-chaos chaos-experiments chaos-smoke \
         test-transport gate lint sanitize manifests \
+        gate-fast gate-full \
         manifests-check check-license bench numerics ctx-sweep mfu-ab capture \
         spec-acceptance prefix-cache-ab chunked-prefill-ab dryrun loadtest \
         loadtest-faults loadtest-preempt loadtest-sharded loadtest-soak \
@@ -17,7 +18,13 @@ help: ## Display this help.
 test: ## Run the full suite on the virtual 8-device CPU mesh.
 	$(TEST_ENV) $(PYTHON) -m pytest tests/ -q
 
-gate: ## Full suite via ci/gate.py — stamps CI_STATUS.json, exits nonzero on red.
+gate: ## Consolidated static-gate stack with per-gate wall time (ci/static_gates.py).
+	$(PYTHON) ci/static_gates.py
+
+gate-fast: ## Static gates minus the pytest-backed tiers — sub-second pre-commit loop.
+	$(PYTHON) ci/static_gates.py --fast
+
+gate-full: ## Full unit-test suite via ci/gate.py — stamps CI_STATUS.json/GATE.md.
 	$(PYTHON) ci/gate.py
 
 test-fast: ## Suite minus the subprocess/multi-process tests.

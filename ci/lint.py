@@ -55,6 +55,18 @@ CHANGES.md invariant):
                        missing from utils/metrics.py METRIC_FAMILY_CATALOG
                        — the exposition surface is reviewed, not accreted
 
+Whole-project rules (computed across every file, not per file):
+
+  dead-code            a module-level function or class in kubeflow_tpu/
+                       referenced nowhere in the package, tests/, or ci/
+                       (by identifier, attribute, import, or literal
+                       string) — dead code is where stale invariants
+                       hide. Deliberate exceptions live in
+                       DEADCODE_ALLOWLIST with a reason, and the
+                       allowlist is usage-tracked: an entry whose code
+                       grew a caller (or was deleted) is itself flagged
+                       as dead-code-allowlist-stale.
+
 Exit non-zero with findings; used by the code-quality CI workflow."""
 
 from __future__ import annotations
@@ -329,6 +341,86 @@ class Linter(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# Deliberately-unreferenced module-level definitions, keyed by
+# (path relative to the repo root, name) with the reason they stay.
+# Stale entries fail the gate (dead-code-allowlist-stale).
+DEADCODE_ALLOWLIST: dict[tuple[str, str], str] = {
+    ("kubeflow_tpu/models/moe.py", "count_active_params"):
+        "public sizing helper: per-token active parameter count is the "
+        "MoE efficiency headline users compute when picking a config",
+    ("kubeflow_tpu/models/train.py", "train_step"):
+        "public training-loop entry point (value_and_grad + update); "
+        "driven from user scripts, not from the controller package",
+    ("kubeflow_tpu/models/transformer.py", "count_params"):
+        "public sizing helper paired with count_active_params",
+    ("kubeflow_tpu/parallel/mesh.py", "factor_devices"):
+        "quick-start mesh heuristic for user scripts that do not want "
+        "to hand-pick tp/fsdp factors",
+    ("kubeflow_tpu/parallel/sharding.py", "constrain"):
+        "with_sharding_constraint shorthand meant to be called inside "
+        "user-jitted model code",
+    ("kubeflow_tpu/utils/k8s.py", "set_in"):
+        "symmetric counterpart to get_in; kept so object-path access "
+        "has a matched read/write API",
+    ("kubeflow_tpu/utils/names.py", "is_dns1123_label"):
+        "K8s apimachinery validation parity next to the name builders",
+}
+
+
+def deadcode_findings() -> list[tuple[Path, int, str, str]]:
+    """Whole-project pass: module-level defs in the package that nothing
+    in kubeflow_tpu/, tests/, or ci/ references. A decorator on the def
+    counts as a registration (route tables etc.), imports and literal
+    identifier strings count as references."""
+    repo = PACKAGE.parent
+    defs: list[tuple[Path, int, str]] = []
+    refs: set[str] = set()
+    for path in sorted(PACKAGE.rglob("*.py")):
+        tree = ast.parse(path.read_text())
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) and \
+                    not node.decorator_list:
+                defs.append((path, node.lineno, node.name))
+    for root in (PACKAGE, repo / "tests", repo / "ci"):
+        for path in sorted(root.rglob("*.py")):
+            if path == Path(__file__).resolve():
+                # The allowlist keys below would otherwise count as
+                # string references and mark every entry stale.
+                continue
+            for node in ast.walk(ast.parse(path.read_text())):
+                if isinstance(node, ast.Name):
+                    refs.add(node.id)
+                elif isinstance(node, ast.Attribute):
+                    refs.add(node.attr)
+                elif isinstance(node, ast.ImportFrom):
+                    refs.update(a.name for a in node.names)
+                elif isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str) and \
+                        node.value.isidentifier():
+                    refs.add(node.value)
+    findings: list[tuple[Path, int, str, str]] = []
+    used_allow: set[tuple[str, str]] = set()
+    for path, lineno, name in defs:
+        if name.startswith("__") or name in refs:
+            continue
+        key = (path.relative_to(repo).as_posix(), name)
+        if key in DEADCODE_ALLOWLIST:
+            used_allow.add(key)
+            continue
+        findings.append((path, lineno, "dead-code",
+                         f"module-level {name!r} is referenced nowhere "
+                         f"in the package, tests/, or ci/ — delete it "
+                         f"or add a DEADCODE_ALLOWLIST entry with a "
+                         f"reason"))
+    for key in sorted(set(DEADCODE_ALLOWLIST) - used_allow):
+        findings.append((repo / key[0], 1, "dead-code-allowlist-stale",
+                         f"DEADCODE_ALLOWLIST entry {key!r} no longer "
+                         f"matches an unreferenced definition — remove "
+                         f"it"))
+    return findings
+
+
 def lint_file(path: Path) -> list[tuple[int, str, str]]:
     source = path.read_text()
     tree = ast.parse(source, filename=str(path))
@@ -348,6 +440,10 @@ def main() -> int:
             rel = path.relative_to(PACKAGE.parent)
             sys.stderr.write(f"{rel}:{lineno}: [{rule}] {msg}\n")
             total += 1
+    for path, lineno, rule, msg in deadcode_findings():
+        rel = path.relative_to(PACKAGE.parent)
+        sys.stderr.write(f"{rel}:{lineno}: [{rule}] {msg}\n")
+        total += 1
     if total:
         sys.stderr.write(f"{total} finding(s)\n")
         return 1

@@ -1,0 +1,382 @@
+#!/usr/bin/env python
+"""Protocol-machine model checker: exhaustive exploration of the
+declared annotation state machines under interleaving and crash.
+
+Complements ci/protocol_gate.py: the gate proves the CODE performs only
+declared transitions in the declared order; this checker proves the
+DECLARATIONS themselves are safe to run on a crashy distributed store.
+It imports kubeflow_tpu.utils.protocol (declarations only — no client,
+no controllers) and checks two layers:
+
+Per machine (graph + crash obligations):
+  - every state is reachable from the initial state;
+  - from every reachable state some terminal state is reachable (no
+    non-terminal dead state: a crash can strand an object in ANY
+    declared state, so every state needs a way home);
+  - annotation machines declare a fresh-read mechanism (echo-tracking /
+    optimistic-concurrency / lock) — that is what makes a re-delivered
+    stale event a rejected retry instead of a lost-update, so the
+    interleaving model may treat persists as atomic;
+  - every effectful transition declares effects_idempotent: the
+    crash-heal contract persists state BEFORE the effect, so a crash
+    between persist and effect re-runs the effect on the next reconcile
+    (slice-health) or loses it until re-delivery (events) — both only
+    sound when the effect is idempotent;
+  - re-deliverable transitions are self-loops or idempotent.
+
+Composed (the checker's centerpiece): an explicit-state BFS over the
+migration × pool-slice product — one notebook, a bound slice A and a
+warm spare S — with every controller persist modeled as one atomic
+store step and every interleaving of the two controllers explored.
+The pool's genuinely multi-step sequences (the two-phase bind and the
+half-bind heal: decide from an observed snapshot, then stamp the
+notebook) carry a program counter, and a crash-restart (pc reset) is
+explored at every transition boundary; single-persist controllers are
+store-driven, so their crash-restarts are exactly the action prefixes
+the BFS already enumerates. The checker proves:
+
+  - convergence: from EVERY reachable configuration a settled
+    configuration is reachable (notebook bound to a live slice that
+    points back, or cleanly bind-missed into the cold-roll path with no
+    slice still bound to it) — a notebook is never lost between the two
+    owners;
+  - no deadlock: every unsettled configuration has an enabled action;
+  - declaration pinning: every state edge the model takes exists in the
+    PROTOCOL declarations (the model cannot silently drift from them).
+
+``PoolMigrationModel(heal_checks_miss=False)`` reproduces the pre-fix
+pool behavior (the healthy-bind early-return that ignored a concurrent
+migration-fallback bind-miss); tests/test_protocol_crash.py pins that
+the checker catches the resulting leaked-slice configuration.
+
+Run: ``python ci/protocol_check.py`` (exit 1 on any violation;
+``--stats`` prints exploration sizes).
+"""
+
+from __future__ import annotations
+
+import sys
+from collections import deque
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+from kubeflow_tpu.utils import protocol  # noqa: E402
+
+
+# --------------------------------------------------------------------------
+# per-machine checks
+
+
+def _forward_reach(machine, start: str) -> set:
+    adj: dict[str, set] = {s: set() for s in machine.states}
+    for t in machine.transitions:
+        for src in t.sources:
+            adj[src].add(t.target)
+    seen = {start}
+    queue = deque([start])
+    while queue:
+        for nxt in adj[queue.popleft()]:
+            if nxt not in seen:
+                seen.add(nxt)
+                queue.append(nxt)
+    return seen
+
+
+def _backward_reach(machine, targets) -> set:
+    radj: dict[str, set] = {s: set() for s in machine.states}
+    for t in machine.transitions:
+        for src in t.sources:
+            radj[t.target].add(src)
+    seen = set(targets)
+    queue = deque(targets)
+    while queue:
+        for prev in radj[queue.popleft()]:
+            if prev not in seen:
+                seen.add(prev)
+                queue.append(prev)
+    return seen
+
+
+def check_machine(machine) -> list[str]:
+    errs = []
+    name = machine.name
+    reached = _forward_reach(machine, machine.initial)
+    for state in sorted(set(machine.states) - reached):
+        errs.append(f"{name}: state {state!r} is unreachable from "
+                    f"initial {machine.initial!r}")
+    can_terminate = _backward_reach(machine, machine.terminal)
+    for state in sorted(reached - can_terminate):
+        errs.append(f"{name}: state {state!r} is a non-terminal dead "
+                    f"state — no path to any of {machine.terminal}")
+    if machine.internal:
+        # realized under a lock / CAS loop inside one process
+        pass
+    elif machine.fresh_reads not in protocol.FRESH_READ_MECHANISMS:
+        errs.append(f"{name}: annotation machines must declare a "
+                    f"fresh_reads mechanism "
+                    f"{protocol.FRESH_READ_MECHANISMS} — without one a "
+                    f"stale-read echo re-applies old transitions")
+    for t in machine.transitions:
+        label = f"{name}: {'/'.join(t.sources)} -> {t.target}"
+        if t.effects and not t.effects_idempotent:
+            errs.append(f"{label}: effectful transitions must declare "
+                        f"effects_idempotent (crash between persist and "
+                        f"effect re-runs or drops the effect)")
+        if t.redeliverable and not (t.self_loop or t.effects_idempotent):
+            errs.append(f"{label}: redeliverable transitions must be "
+                        f"self-loops or idempotent")
+    return errs
+
+
+# --------------------------------------------------------------------------
+# composed migration × pool-slice model
+
+MIG_STATES = (None, "Checkpointing", "Binding", "Resuming")
+IDLE_PC = ("idle",)
+
+
+def _mig_name(value) -> str:
+    return "Idle" if value is None else value
+
+
+class Config(tuple):
+    """(mig, bound, miss, ckpt, a_state, a_to, s_state, s_to, pc)"""
+
+    __slots__ = ()
+    FIELDS = ("mig", "bound", "miss", "ckpt", "a_state", "a_to",
+              "s_state", "s_to", "pc")
+
+    def field(self, key: str):
+        return self[self.FIELDS.index(key)]
+
+    def replace(self, **kw) -> "Config":
+        vals = list(self)
+        for key, value in kw.items():
+            vals[self.FIELDS.index(key)] = value
+        return Config(vals)
+
+    def slice_of(self, which: str) -> tuple:
+        return (self.field(f"{which.lower()}_state"),
+                self.field(f"{which.lower()}_to"))
+
+    def __repr__(self) -> str:
+        parts = [f"{k}={v!r}" for k, v in zip(self.FIELDS, self)]
+        return f"Config({', '.join(parts)})"
+
+
+class PoolMigrationModel:
+    """One notebook, slice "A" (initially bound) and warm spare "S".
+
+    Persist-level actions for the repair controller's migration machine
+    (all store-driven single persists) interleaved with the pool
+    controller (two-phase bind and heal carry a pc; crash resets it).
+    ``heal_checks_miss=False`` models the pre-fix pool, whose
+    healthy-bind early-return ignored POOL_BIND_MISS — the fallback/heal
+    race then leaks the slice forever.
+    """
+
+    SLICES = ("A", "S")
+
+    def __init__(self, heal_checks_miss: bool = True) -> None:
+        self.heal_checks_miss = heal_checks_miss
+
+    def initial(self) -> Config:
+        return Config((None, "A", False, False,
+                       "Bound", "nb", "Warm", None, IDLE_PC))
+
+    def settled(self, cfg: Config) -> bool:
+        if cfg.field("pc") != IDLE_PC:
+            return False
+        mig, bound, miss = cfg[0], cfg[1], cfg[2]
+        held = [x for x in self.SLICES if cfg.slice_of(x)[1] == "nb"]
+        if miss:
+            # cold-roll rest: the core controller rebuilds a dedicated
+            # slice; the pool must hold nothing for this notebook
+            return bound is None and not held
+        return (mig is None and bound in self.SLICES and
+                cfg.slice_of(bound) == ("Bound", "nb") and
+                held == [bound])
+
+    def _set_slice(self, cfg: Config, which: str, state: str,
+                   to) -> Config:
+        low = which.lower()
+        return cfg.replace(**{f"{low}_state": state, f"{low}_to": to})
+
+    def actions(self, cfg: Config) -> list:
+        mig, bound, miss, _ckpt = cfg[0], cfg[1], cfg[2], cfg[3]
+        pc = cfg.field("pc")
+        out = []
+
+        # ---- pool controller (single-threaded: pc gates its actions)
+        if pc == IDLE_PC:
+            for x in self.SLICES:
+                state, to = cfg.slice_of(x)
+                if state == "Warm" and bound is None and not miss:
+                    # _bind_inner phase 1: persist slice Bound+bound_to
+                    nxt = self._set_slice(cfg, x, "Bound", "nb")
+                    out.append((f"bind1-{x}",
+                                nxt.replace(pc=("bind", x)),
+                                [("pool-slice", "Warm", "Bound")]))
+                if state == "Bound" and to == "nb":
+                    if self.heal_checks_miss:
+                        healthy = bound == x and not miss
+                    else:
+                        healthy = bound == x  # pre-fix leak
+                    heal_ok = (bound is None and not miss and
+                               mig is None)
+                    if healthy:
+                        continue
+                    if heal_ok:
+                        out.append((f"heal1-{x}",
+                                    cfg.replace(pc=("heal", x)), []))
+                    elif bound == x:
+                        # bind-missed but still edged: _unbind_notebook
+                        out.append((f"unbind-{x}",
+                                    cfg.replace(bound=None), []))
+                    else:
+                        # _release_slice scrub: back to Warming
+                        out.append((f"release-{x}",
+                                    self._set_slice(cfg, x, "Warming",
+                                                    None),
+                                    [("pool-slice", "Bound",
+                                      "Warming")]))
+        else:
+            kind, x = pc
+            stamped = cfg.replace(bound=x, pc=IDLE_PC)
+            # _stamp_notebook_bound does not re-check the notebook: the
+            # decision was made at phase 1 / heal guard time
+            out.append((f"{kind}2-{x}", stamped, []))
+            out.append(("crash-pool", cfg.replace(pc=IDLE_PC), []))
+
+        # ---- environment: scrubbed slices come ready again
+        for x in self.SLICES:
+            state, to = cfg.slice_of(x)
+            if state == "Warming":
+                out.append((f"warm-{x}",
+                            self._set_slice(cfg, x, "Warm", to),
+                            [("pool-slice", "Warming", "Warm")]))
+
+        # ---- repair controller (each step is one atomic persist, so a
+        # crash-restart is a prefix + re-derivation: already explored)
+        if mig is None and bound is not None and not miss:
+            out.append(("migrate-start",
+                        cfg.replace(mig="Checkpointing"),
+                        [("migration", "Idle", "Checkpointing")]))
+        if mig == "Checkpointing":
+            # the Binding persist clears the bound-slice edge
+            out.append(("ckpt-taken",
+                        cfg.replace(mig="Binding", bound=None,
+                                    ckpt=True),
+                        [("migration", "Checkpointing", "Binding")]))
+        if mig == "Binding" and bound is not None:
+            out.append(("rebound",
+                        cfg.replace(mig="Resuming"),
+                        [("migration", "Binding", "Resuming")]))
+        if mig == "Resuming":
+            out.append(("resumed",
+                        cfg.replace(mig=None, ckpt=False),
+                        [("migration", "Resuming", "Idle")]))
+        if mig is not None:
+            # deadline blown at ANY phase: one atomic fallback patch
+            # clears migration + bound edge and stamps the bind miss
+            out.append(("fallback",
+                        cfg.replace(mig=None, bound=None, miss=True),
+                        [("migration", mig, "Idle")]))
+        return out
+
+
+def _declared_edge(machines: dict, edge: tuple) -> bool:
+    mname, src, dst = edge
+    machine = machines.get(mname)
+    if machine is None:
+        return False
+    return any(src in t.sources and t.target == dst
+               for t in machine.transitions)
+
+
+def explore(model: PoolMigrationModel, machines: dict) -> dict:
+    init = model.initial()
+    seen = {init}
+    queue = deque([init])
+    preds: dict[Config, set] = {}
+    settled = set()
+    deadlocks = []
+    undeclared = set()
+    transitions = 0
+    while queue:
+        cfg = queue.popleft()
+        if model.settled(cfg):
+            settled.add(cfg)
+        acts = model.actions(cfg)
+        if not acts and not model.settled(cfg):
+            deadlocks.append(cfg)
+        for _name, nxt, edges in acts:
+            transitions += 1
+            for edge in edges:
+                if not _declared_edge(machines, edge):
+                    undeclared.add(edge)
+            preds.setdefault(nxt, set()).add(cfg)
+            if nxt not in seen:
+                seen.add(nxt)
+                queue.append(nxt)
+    can_settle = set(settled)
+    queue = deque(settled)
+    while queue:
+        for prev in preds.get(queue.popleft(), ()):
+            if prev not in can_settle:
+                can_settle.add(prev)
+                queue.append(prev)
+    return {
+        "configs": len(seen),
+        "transitions": transitions,
+        "settled": len(settled),
+        "stuck": sorted(seen - can_settle),
+        "deadlocks": deadlocks,
+        "undeclared_edges": sorted(undeclared),
+    }
+
+
+# --------------------------------------------------------------------------
+# driver
+
+
+def run(stats: bool = False) -> int:
+    machines = protocol.load_machines()
+    errs: list[str] = []
+    for machine in machines.values():
+        errs.extend(check_machine(machine))
+    result = explore(PoolMigrationModel(), machines)
+    for cfg in result["stuck"]:
+        errs.append(f"composed migration×pool: reachable configuration "
+                    f"cannot settle (leaked between owners): {cfg!r}")
+    for cfg in result["deadlocks"]:
+        errs.append(f"composed migration×pool: unsettled deadlock: "
+                    f"{cfg!r}")
+    for edge in result["undeclared_edges"]:
+        errs.append(f"composed migration×pool: model edge {edge!r} is "
+                    f"not a declared transition")
+    if stats:
+        print(f"machines: {len(machines)}; composed exploration: "
+              f"{result['configs']} configs, {result['transitions']} "
+              f"transitions, {result['settled']} settled")
+    for err in errs:
+        print(f"ci/protocol_check.py: [protocol-model] {err}")
+    if errs:
+        print(f"\nci/protocol_check.py: {len(errs)} violation(s)",
+              file=sys.stderr)
+        return 1
+    total = sum(len(m.transitions) for m in machines.values())
+    print(f"ci/protocol_check.py: {len(machines)} machine(s), {total} "
+          f"transition(s); composed model: {result['configs']} "
+          f"configuration(s) all converge")
+    return 0
+
+
+def main(argv: list[str]) -> int:
+    return run(stats="--stats" in argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,864 @@
+#!/usr/bin/env python
+"""Protocol-machine gate: AST extraction of every protocol-annotation
+write in controllers/, checked against the declared state machines.
+
+The hardest control-plane invariants live in annotation-carried
+distributed state machines (slice health, checkpoint migration, the
+warm-pool slice lifecycle) plus two in-process machines (the apiserver
+circuit breaker, the shard-lease handoff). Each owning module declares
+its machines in a module-level ``PROTOCOL`` literal (the PR-12
+``CONTRACT`` pattern; schema in kubeflow_tpu/utils/protocol.py). This
+gate parses declarations and code out of the source AST — it NEVER
+imports the package (same stance as ci/effects.py and ci/lint.py) — and
+fails on:
+
+  protocol-undeclared-transition   a write of a machine's carrier whose
+                                   value is not a declared state, or for
+                                   which no declared transition exists
+                                   from any statically-possible source
+                                   state (source states are inferred
+                                   path-sensitively from ``==``/``!=``/
+                                   ``is None`` guards and state-constant
+                                   assignments)
+  protocol-wrong-writer            a write of a machine's carrier or an
+                                   owned auxiliary annotation outside the
+                                   owner module, unless the machine
+                                   declares the (writer, annotation)
+                                   handoff explicitly — single-writer
+                                   ownership is what makes the machines
+                                   analyzable at all
+  protocol-effect-before-persist   a side effect declared on a candidate
+                                   transition (``event:<Reason>`` /
+                                   ``call:<suffix>``) executes between
+                                   the machine's previous write and this
+                                   one — the crash-heal contract is
+                                   "state persisted BEFORE its side
+                                   effect", so the effect must come after
+  protocol-stale-transition        a declared transition no code performs
+                                   (dead protocol rots into documentation
+                                   that lies); internal-machine
+                                   transitions without a ``via`` are
+                                   environmental (e.g. holder-crash) and
+                                   exempt
+  protocol-stale-handoff           a declared cross-controller handoff no
+                                   code exercises (usage-tracked, like
+                                   the CLOCK_ALLOWLIST)
+  protocol-parse                   a malformed PROTOCOL literal, unknown
+                                   carrier constant, or a machine
+                                   declared away from its owner module
+
+Exit non-zero with findings; ``--dump`` prints every extracted write with
+its inferred source set. The companion ci/protocol_check.py model-checks
+the same declarations (convergence, crash-restart, re-delivery).
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "kubeflow_tpu"
+CONTROLLERS = PACKAGE / "controllers"
+NAMES_PATH = PACKAGE / "utils" / "names.py"
+
+RECORDER_RECEIVERS = frozenset({"recorder", "_recorder"})
+#: calls whose dict arguments are field selectors / reads, never writes
+READ_VERBS = frozenset({"get", "get_or_none", "list", "list_cached",
+                        "list_by_field", "get_owned", "get_annotation",
+                        "get_label", "get_in"})
+
+UNRESOLVED = object()
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _terminal_name(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _names_attr(node: ast.AST) -> str | None:
+    """``names.X`` -> ``"X"`` (the package-wide annotation-constant
+    idiom), else None."""
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "names":
+        return node.attr
+    return None
+
+
+def module_constants(tree: ast.Module) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def names_constants() -> dict[str, str]:
+    return module_constants(ast.parse(NAMES_PATH.read_text()))
+
+
+# --------------------------------------------------------------------------
+# declarations (parsed, never imported)
+
+
+class Trans:
+    def __init__(self, machine: "Machine", index: int, raw: dict) -> None:
+        src = raw["from"]
+        self.sources: tuple[str, ...] = \
+            (src,) if isinstance(src, str) else tuple(src)
+        self.target: str = raw["to"]
+        self.trigger: str = raw["trigger"]
+        self.effects: tuple[str, ...] = tuple(raw.get("effects", ()))
+        self.via: str | None = raw.get("via")
+        self.self_loop = bool(raw.get("self_loop", False))
+        self.machine = machine
+        self.index = index
+
+    def __repr__(self) -> str:
+        return (f"{self.machine.name}: {'/'.join(self.sources)} -> "
+                f"{self.target} ({self.trigger})")
+
+
+class Machine:
+    def __init__(self, decl: dict, module: str, lineno: int) -> None:
+        self.name: str = decl["machine"]
+        self.owner: str = decl["owner"]
+        self.module = module
+        self.lineno = lineno
+        carrier = decl["carrier"]
+        self.internal = carrier.get("object") == "internal"
+        self.carrier_const: str | None = carrier.get("annotation")
+        self.carrier_via: str | None = carrier.get("via")
+        self.states: dict[str, object] = dict(decl["states"])
+        self.initial: str = decl["initial"]
+        self.terminal: tuple[str, ...] = tuple(
+            (decl["terminal"],) if isinstance(decl["terminal"], str)
+            else decl["terminal"])
+        self.aux: dict[str, str] = dict(decl.get("aux", {}))
+        self.handoffs: tuple[dict, ...] = tuple(decl.get("handoffs", ()))
+        self.transitions = [Trans(self, i, raw)
+                            for i, raw in enumerate(decl["transitions"])]
+
+    def states_for_value(self, value) -> frozenset[str]:
+        return frozenset(s for s, v in self.states.items() if v == value)
+
+    @property
+    def all_states(self) -> frozenset[str]:
+        return frozenset(self.states)
+
+
+# --------------------------------------------------------------------------
+# per-function flow scan
+
+
+class _Fn:
+    """Path-sensitive scan of one function body: tracks which states each
+    state-carrying expression can hold (narrowed by guards), extracts
+    annotation/via writes in statement order, and checks each against the
+    declared transitions."""
+
+    def __init__(self, analyzer: "Analyzer", module: str,
+                 consts: dict[str, str], helpers: dict[str, Machine]) \
+            -> None:
+        self.a = analyzer
+        self.module = module
+        self.stem = Path(module).stem
+        self.consts = consts
+        self.helpers = helpers
+
+    # ------------------------------------------------------------ values
+    def resolve_values(self, node: ast.AST) -> tuple:
+        if isinstance(node, ast.Constant) and (
+                node.value is None or isinstance(node.value, str)):
+            return (node.value,)
+        if isinstance(node, ast.Name) and node.id in self.consts:
+            return (self.consts[node.id],)
+        attr = _names_attr(node)
+        if attr is not None and attr in self.a.names_map:
+            return (self.a.names_map[attr],)
+        if isinstance(node, ast.IfExp):
+            return self.resolve_values(node.body) + \
+                self.resolve_values(node.orelse)
+        return (UNRESOLVED,)
+
+    def machine_of_state_expr(self, node: ast.AST) -> Machine | None:
+        """The machine whose current state this expression reads:
+        ``k8s.get_annotation(obj, names.<CARRIER>)`` or a module helper
+        wrapping it (``slice_health(nb)``, ``pool_state(sts)``)."""
+        if not isinstance(node, ast.Call):
+            return None
+        if _terminal_name(node.func) == "get_annotation" and \
+                len(node.args) >= 2:
+            attr = _names_attr(node.args[1])
+            if attr is not None:
+                return self.a.carrier_map.get(attr)
+        helper = self.helpers.get(_terminal_name(node.func))
+        return helper
+
+    def source_set(self, env: dict, machine: Machine) -> frozenset[str]:
+        sets = [s for (m, s) in env.values() if m == machine.name]
+        if not sets:
+            return machine.all_states
+        inter = frozenset(machine.states)
+        for s in sets:
+            inter &= s
+        if inter:
+            return inter
+        union: frozenset[str] = frozenset()
+        for s in sets:
+            union |= s
+        return union or machine.all_states
+
+    # ------------------------------------------------------------ guards
+    def constraints(self, test: ast.AST, env: dict,
+                    positive: bool) -> list:
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self.constraints(test.operand, env, not positive)
+        if isinstance(test, ast.BoolOp):
+            conj = isinstance(test.op, ast.And)
+            # And narrows the true branch; ¬(A or B) = ¬A and ¬B narrows
+            # the false branch. The disjunctive cases give no single-path
+            # narrowing.
+            if conj is positive:
+                out = []
+                for value in test.values:
+                    out.extend(self.constraints(value, env, positive))
+                return out
+            return []
+        if not (isinstance(test, ast.Compare) and len(test.ops) == 1):
+            return []
+        op = test.ops[0]
+        if not isinstance(op, (ast.Eq, ast.NotEq, ast.Is, ast.IsNot)):
+            return []
+        eq = isinstance(op, (ast.Eq, ast.Is))
+        if not positive:
+            eq = not eq
+        for expr, const in ((test.left, test.comparators[0]),
+                            (test.comparators[0], test.left)):
+            vals = self.resolve_values(const)
+            if len(vals) != 1 or vals[0] is UNRESOLVED:
+                continue
+            value = vals[0]
+            key = ast.unparse(expr)
+            machine = None
+            if key in env:
+                machine = self.a.machines.get(env[key][0])
+            if machine is None:
+                machine = self.machine_of_state_expr(expr)
+            if machine is None and value is not None:
+                machine = self.a.unique_value_machine.get(value)
+            if machine is None:
+                continue
+            states_v = machine.states_for_value(value)
+            if not states_v:
+                continue  # not a state of this machine (e.g. aux value)
+            allowed = states_v if eq else machine.all_states - states_v
+            return [(key, machine, allowed)]
+        return []
+
+    @staticmethod
+    def apply(env: dict, constraints: list) -> dict:
+        for key, machine, allowed in constraints:
+            base = env.get(key, (machine.name, machine.all_states))[1]
+            env[key] = (machine.name, base & allowed)
+        return env
+
+    # ------------------------------------------------------------ writes
+    def _clear_machine(self, env: dict, machine: Machine,
+                       dsts: frozenset[str]) -> None:
+        for key in [k for k, (m, _s) in env.items() if m == machine.name]:
+            del env[key]
+        if machine.internal and dsts:
+            # the breaker/lease is a singleton, so the just-written state
+            # IS the source of the next write in this flow; annotation
+            # machines span many objects per function (loops), where a
+            # store binding would leak across objects
+            env[("store", machine.name)] = (machine.name, dsts)
+
+    def _check_transition(self, machine: Machine, cands: list,
+                          lineno: int, pending: dict) -> None:
+        allowed_effects = set()
+        for t in cands:
+            allowed_effects.update(t.effects)
+        for eff_line, sig in pending[machine.name]:
+            if sig in allowed_effects:
+                self.a.flag(self.module, lineno,
+                            "protocol-effect-before-persist",
+                            f"{machine.name}: effect {sig} (line "
+                            f"{eff_line}) runs before the state persist "
+                            f"that licenses it — persist first, then "
+                            f"perform the effect (crash-heal contract)")
+        for t in cands:
+            self.a.covered.add((machine.name, t.index))
+
+    def annotation_write(self, const: str, value: ast.AST, lineno: int,
+                         env: dict, pending: dict) -> None:
+        machine = self.a.carrier_map.get(const)
+        if machine is not None:
+            if self.stem != machine.owner:
+                if not self.a.use_handoff(self.module, const):
+                    self.a.flag(
+                        self.module, lineno, "protocol-wrong-writer",
+                        f"{const} carries the {machine.name} machine "
+                        f"owned by {machine.owner}; cross-controller "
+                        f"writes need a declared handoff")
+                return
+            vals = self.resolve_values(value)
+            dsts: frozenset[str] = frozenset()
+            for v in vals:
+                if v is UNRESOLVED:
+                    self.a.flag(
+                        self.module, lineno,
+                        "protocol-undeclared-transition",
+                        f"{machine.name}: cannot resolve the value "
+                        f"written to {const} to a declared state")
+                    continue
+                states = machine.states_for_value(v)
+                if not states:
+                    self.a.flag(
+                        self.module, lineno,
+                        "protocol-undeclared-transition",
+                        f"{machine.name}: {v!r} is not a declared state "
+                        f"value")
+                dsts |= states
+            if dsts:
+                srcs = self.source_set(env, machine)
+                cands = [t for t in machine.transitions
+                         if t.via is None and t.target in dsts and
+                         set(t.sources) & srcs]
+                self.a.writes_log.append(
+                    (self.module, lineno, machine.name, sorted(dsts),
+                     sorted(srcs)))
+                if not cands:
+                    self.a.flag(
+                        self.module, lineno,
+                        "protocol-undeclared-transition",
+                        f"{machine.name}: no declared transition to "
+                        f"{'/'.join(sorted(dsts))} from possible "
+                        f"source(s) {'/'.join(sorted(srcs))}")
+                else:
+                    self._check_transition(machine, cands, lineno, pending)
+            self._clear_machine(env, machine, dsts)
+            pending[machine.name] = []
+            return
+        machine = self.a.aux_map.get(const)
+        if machine is not None and self.stem != machine.owner:
+            if not self.a.use_handoff(self.module, const):
+                self.a.flag(
+                    self.module, lineno, "protocol-wrong-writer",
+                    f"{const} is an auxiliary annotation of the "
+                    f"{machine.name} machine owned by {machine.owner}; "
+                    f"cross-controller writes need a declared handoff")
+
+    def via_write(self, call: ast.Call, lineno: int, env: dict,
+                  pending: dict) -> None:
+        name = _terminal_name(call.func)
+        machine = self.a.via_map[name]
+        if self.stem != machine.owner:
+            self.a.flag(self.module, lineno, "protocol-wrong-writer",
+                        f"{name}() realizes {machine.name} transitions "
+                        f"owned by {machine.owner}")
+            return
+        dsts: frozenset[str] = frozenset()
+        for arg in call.args:
+            vals = self.resolve_values(arg)
+            for v in vals:
+                if v is not UNRESOLVED:
+                    dsts |= machine.states_for_value(v)
+        vts = [t for t in machine.transitions if t.via == name]
+        srcs = self.source_set(env, machine)
+        if dsts:
+            cands = [t for t in vts
+                     if t.target in dsts and set(t.sources) & srcs]
+            if not cands:
+                self.a.flag(
+                    self.module, lineno, "protocol-undeclared-transition",
+                    f"{machine.name}: no declared via-{name} transition "
+                    f"to {'/'.join(sorted(dsts))} from possible "
+                    f"source(s) {'/'.join(sorted(srcs))}")
+        else:
+            cands = vts
+            dsts = frozenset(t.target for t in vts)
+        self.a.writes_log.append(
+            (self.module, lineno, machine.name, sorted(dsts),
+             sorted(srcs)))
+        if cands:
+            self._check_transition(machine, cands, lineno, pending)
+        self._clear_machine(env, machine,
+                            frozenset(t.target for t in cands) or dsts)
+        pending[machine.name] = []
+
+    # ----------------------------------------------------------- effects
+    def record_call(self, call: ast.Call, env: dict,
+                    pending: dict) -> None:
+        name = _terminal_name(call.func)
+        if name in self.a.via_map:
+            self.via_write(call, call.lineno, env, pending)
+            return
+        if name in ("eventf", "event") and \
+                _terminal_name(getattr(call.func, "value", None)) in \
+                RECORDER_RECEIVERS:
+            for arg in call.args:
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and \
+                        arg.value in self.a.event_reasons:
+                    self._effect(f"event:{arg.value}", call.lineno,
+                                 pending)
+        dotted = _dotted(call.func)
+        for suffix in self.a.call_suffixes:
+            if dotted == suffix or dotted.endswith("." + suffix):
+                self._effect(f"call:{suffix}", call.lineno, pending)
+
+    def _effect(self, sig: str, lineno: int, pending: dict) -> None:
+        for mname in self.a.sig_machines.get(sig, ()):
+            pending[mname].append((lineno, sig))
+
+    # ------------------------------------------------------- expressions
+    def scan_expr(self, node: ast.AST | None, env: dict, pending: dict,
+                  suppress: bool = False) -> None:
+        if node is None:
+            return
+        if isinstance(node, ast.Call):
+            self.scan_expr(node.func, env, pending, suppress)
+            sub_suppress = suppress or \
+                _terminal_name(node.func) in READ_VERBS
+            for arg in node.args:
+                self.scan_expr(arg, env, pending, sub_suppress)
+            for kw in node.keywords:
+                self.scan_expr(kw.value, env, pending, sub_suppress)
+            self.record_call(node, env, pending)
+            return
+        if isinstance(node, ast.Dict):
+            for key, value in zip(node.keys, node.values):
+                self.scan_expr(key, env, pending, suppress)
+                self.scan_expr(value, env, pending, suppress)
+                attr = _names_attr(key) if key is not None else None
+                if attr is not None and not suppress:
+                    self.annotation_write(attr, value, node.lineno, env,
+                                          pending)
+            return
+        if isinstance(node, ast.Lambda):
+            self.scan_expr(node.body, dict(env),
+                           {m: [] for m in self.a.machines}, suppress)
+            return
+        for child in ast.iter_child_nodes(node):
+            self.scan_expr(child, env, pending, suppress)
+
+    # -------------------------------------------------------- statements
+    def record_assign(self, target: ast.Name, value: ast.AST,
+                      env: dict) -> None:
+        key = target.id
+        machine = self.machine_of_state_expr(value)
+        if machine is not None:
+            env[key] = (machine.name, machine.all_states)
+            return
+        vkey = ast.unparse(value)
+        if vkey in env:
+            env[key] = env[vkey]
+            return
+        vals = self.resolve_values(value)
+        if len(vals) == 1 and vals[0] is not UNRESOLVED and \
+                vals[0] is not None:
+            machine = self.a.unique_value_machine.get(vals[0])
+            if machine is not None:
+                env[key] = (machine.name,
+                            machine.states_for_value(vals[0]))
+                return
+        env.pop(key, None)
+
+    @staticmethod
+    def _copy_pending(pending: dict) -> dict:
+        return {m: list(v) for m, v in pending.items()}
+
+    def _merge(self, env: dict, pending: dict, survivors: list) -> None:
+        env.clear()
+        if survivors:
+            first_env = survivors[0][0]
+            for key, (mname, states) in first_env.items():
+                merged = states
+                ok = True
+                for other_env, _p in survivors[1:]:
+                    got = other_env.get(key)
+                    if got is None or got[0] != mname:
+                        ok = False
+                        break
+                    merged = merged | got[1]
+                if ok:
+                    env[key] = (mname, merged)
+        for mname in pending:
+            seen: list = []
+            for _e, p in survivors:
+                for entry in p[mname]:
+                    if entry not in seen:
+                        seen.append(entry)
+            pending[mname] = seen
+
+    def walk(self, stmts: list, env: dict, pending: dict) -> bool:
+        """Scan a statement list; returns True when the flow terminates
+        (return/raise/break/continue) before falling off the end."""
+        for stmt in stmts:
+            if isinstance(stmt, ast.Return):
+                self.scan_expr(stmt.value, env, pending)
+                return True
+            if isinstance(stmt, ast.Raise):
+                self.scan_expr(stmt.exc, env, pending)
+                return True
+            if isinstance(stmt, (ast.Break, ast.Continue)):
+                return True
+            if isinstance(stmt, ast.If):
+                self.scan_expr(stmt.test, env, pending)
+                then_env = self.apply(
+                    dict(env), self.constraints(stmt.test, env, True))
+                then_pending = self._copy_pending(pending)
+                t_term = self.walk(stmt.body, then_env, then_pending)
+                else_env = self.apply(
+                    dict(env), self.constraints(stmt.test, env, False))
+                else_pending = self._copy_pending(pending)
+                e_term = self.walk(stmt.orelse, else_env, else_pending) \
+                    if stmt.orelse else False
+                survivors = []
+                if not t_term:
+                    survivors.append((then_env, then_pending))
+                if not e_term:
+                    survivors.append((else_env, else_pending))
+                if not survivors:
+                    return True
+                self._merge(env, pending, survivors)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self.scan_expr(stmt.iter, env, pending)
+                body_env = dict(env)
+                body_pending = self._copy_pending(pending)
+                term = self.walk(stmt.body, body_env, body_pending)
+                survivors = [(env.copy(), self._copy_pending(pending))]
+                if not term:
+                    survivors.append((body_env, body_pending))
+                self._merge(env, pending, survivors)
+                if stmt.orelse:
+                    self.walk(stmt.orelse, env, pending)
+            elif isinstance(stmt, ast.While):
+                self.scan_expr(stmt.test, env, pending)
+                body_env = dict(env)
+                body_pending = self._copy_pending(pending)
+                term = self.walk(stmt.body, body_env, body_pending)
+                survivors = [(env.copy(), self._copy_pending(pending))]
+                if not term:
+                    survivors.append((body_env, body_pending))
+                self._merge(env, pending, survivors)
+            elif isinstance(stmt, ast.Try):
+                body_env = dict(env)
+                body_pending = self._copy_pending(pending)
+                term = self.walk(stmt.body, body_env, body_pending)
+                survivors = []
+                if not term:
+                    survivors.append((body_env, body_pending))
+                for handler in stmt.handlers:
+                    h_env = dict(env)
+                    h_pending = self._copy_pending(pending)
+                    if not self.walk(handler.body, h_env, h_pending):
+                        survivors.append((h_env, h_pending))
+                if not survivors and not stmt.finalbody:
+                    return True
+                if survivors:
+                    self._merge(env, pending, survivors)
+                if stmt.finalbody and self.walk(stmt.finalbody, env,
+                                                pending):
+                    return True
+            elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                for item in stmt.items:
+                    self.scan_expr(item.context_expr, env, pending)
+                if self.walk(stmt.body, env, pending):
+                    return True
+            elif isinstance(stmt, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                # closures (scrub/stamp) run later under their own retry
+                # seam: scan with a snapshot env and fresh pending, and
+                # keep their effects out of the enclosing flow
+                self.walk(stmt.body, dict(env),
+                          {m: [] for m in self.a.machines})
+            elif isinstance(stmt, ast.Assign):
+                self.scan_expr(stmt.value, env, pending)
+                for target in stmt.targets:
+                    if isinstance(target, ast.Subscript):
+                        attr = _names_attr(target.slice)
+                        if attr is not None:
+                            self.annotation_write(attr, stmt.value,
+                                                  stmt.lineno, env,
+                                                  pending)
+                if len(stmt.targets) == 1 and \
+                        isinstance(stmt.targets[0], ast.Name):
+                    self.record_assign(stmt.targets[0], stmt.value, env)
+            elif isinstance(stmt, ast.AugAssign):
+                self.scan_expr(stmt.value, env, pending)
+                if isinstance(stmt.target, ast.Name):
+                    env.pop(stmt.target.id, None)
+            elif isinstance(stmt, ast.AnnAssign):
+                self.scan_expr(stmt.value, env, pending)
+                if stmt.value is not None and \
+                        isinstance(stmt.target, ast.Name):
+                    self.record_assign(stmt.target, stmt.value, env)
+            elif isinstance(stmt, ast.Expr):
+                self.scan_expr(stmt.value, env, pending)
+            elif isinstance(stmt, ast.Assert):
+                self.scan_expr(stmt.test, env, pending)
+            elif isinstance(stmt, ast.ClassDef):
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self.walk(sub.body, {},
+                                  {m: [] for m in self.a.machines})
+        return False
+
+
+# --------------------------------------------------------------------------
+# project analyzer
+
+
+class Analyzer:
+    """All provided controller sources, checked against the PROTOCOL
+    declarations they carry. ``files`` maps module name (``"x.py"``) to
+    source text, so tests can run the gate on in-memory fixtures."""
+
+    def __init__(self, files: dict[str, str],
+                 names_map: dict[str, str] | None = None) -> None:
+        self.files = files
+        self.names_map = names_map if names_map is not None \
+            else names_constants()
+        self.findings: list[tuple[str, int, str, str]] = []
+        self.writes_log: list = []
+        self.covered: set[tuple[str, int]] = set()
+        self.machines: dict[str, Machine] = {}
+        self.carrier_map: dict[str, Machine] = {}
+        self.aux_map: dict[str, Machine] = {}
+        self.via_map: dict[str, Machine] = {}
+        self.handoffs: dict[tuple[str, str], list] = {}
+        self.handoff_used: set[tuple[str, str]] = set()
+        self.event_reasons: set[str] = set()
+        self.call_suffixes: set[str] = set()
+        self.sig_machines: dict[str, set[str]] = {}
+        self.unique_value_machine: dict[object, Machine | None] = {}
+        self.trees: dict[str, ast.Module] = {}
+        for fname, source in sorted(files.items()):
+            try:
+                self.trees[fname] = ast.parse(source)
+            except SyntaxError as exc:
+                self.flag(fname, exc.lineno or 1, "protocol-parse",
+                          f"syntax error: {exc.msg}")
+        self._load_declarations()
+
+    def flag(self, module: str, lineno: int, rule: str, msg: str) -> None:
+        self.findings.append((module, lineno, rule, msg))
+
+    def use_handoff(self, writer_module: str, const: str) -> bool:
+        key = (Path(writer_module).stem, const)
+        if key in self.handoffs:
+            self.handoff_used.add(key)
+            return True
+        return False
+
+    # ----------------------------------------------------- declarations
+    def _load_declarations(self) -> None:
+        for fname, tree in sorted(self.trees.items()):
+            for node in tree.body:
+                if not (isinstance(node, ast.Assign) and
+                        len(node.targets) == 1 and
+                        isinstance(node.targets[0], ast.Name) and
+                        node.targets[0].id == "PROTOCOL"):
+                    continue
+                try:
+                    decls = ast.literal_eval(node.value)
+                except (ValueError, SyntaxError):
+                    self.flag(fname, node.lineno, "protocol-parse",
+                              "PROTOCOL must be a pure literal list")
+                    continue
+                for decl in decls:
+                    self._add_machine(decl, fname, node.lineno)
+        for machine in self.machines.values():
+            for t in machine.transitions:
+                for sig in t.effects:
+                    if sig.startswith("event:"):
+                        self.event_reasons.add(sig[len("event:"):])
+                    elif sig.startswith("call:"):
+                        self.call_suffixes.add(sig[len("call:"):])
+                    self.sig_machines.setdefault(sig, set()).add(
+                        machine.name)
+            for value in machine.states.values():
+                if value is None:
+                    continue
+                if value in self.unique_value_machine:
+                    self.unique_value_machine[value] = None  # ambiguous
+                else:
+                    self.unique_value_machine[value] = machine
+        self.unique_value_machine = {
+            v: m for v, m in self.unique_value_machine.items()
+            if m is not None}
+
+    def _add_machine(self, decl: dict, fname: str, lineno: int) -> None:
+        try:
+            machine = Machine(decl, fname, lineno)
+        except (KeyError, TypeError) as exc:
+            self.flag(fname, lineno, "protocol-parse",
+                      f"malformed machine declaration: {exc!r}")
+            return
+        if machine.name in self.machines:
+            self.flag(fname, lineno, "protocol-parse",
+                      f"duplicate machine {machine.name!r}")
+            return
+        if machine.owner != Path(fname).stem:
+            self.flag(fname, lineno, "protocol-parse",
+                      f"{machine.name}: declared in {fname} but owned by "
+                      f"{machine.owner!r} — machines live next to their "
+                      f"owner")
+            return
+        if machine.carrier_const is not None:
+            if machine.carrier_const not in self.names_map:
+                self.flag(fname, lineno, "protocol-parse",
+                          f"{machine.name}: carrier "
+                          f"{machine.carrier_const!r} is not a "
+                          f"utils/names.py constant")
+                return
+            prev = self.carrier_map.get(machine.carrier_const)
+            if prev is not None:
+                self.flag(fname, lineno, "protocol-parse",
+                          f"carrier {machine.carrier_const} claimed by "
+                          f"both {prev.name} and {machine.name}")
+                return
+            self.carrier_map[machine.carrier_const] = machine
+        self.machines[machine.name] = machine
+        for const in machine.aux:
+            prev = self.aux_map.get(const)
+            if prev is not None:
+                self.flag(fname, lineno, "protocol-parse",
+                          f"aux {const} claimed by both {prev.name} and "
+                          f"{machine.name}")
+                continue
+            self.aux_map[const] = machine
+        for via in {t.via for t in machine.transitions if t.via} | (
+                {machine.carrier_via} if machine.carrier_via else set()):
+            prev = self.via_map.get(via)
+            if prev is not None and prev is not machine:
+                self.flag(fname, lineno, "protocol-parse",
+                          f"via {via}() claimed by both {prev.name} and "
+                          f"{machine.name}")
+                continue
+            self.via_map[via] = machine
+        for h in machine.handoffs:
+            self.handoffs.setdefault(
+                (h.get("writer", ""), h.get("annotation", "")),
+                []).append(machine)
+
+    # ------------------------------------------------------------- scan
+    def run(self) -> list[tuple[str, int, str, str]]:
+        for fname, tree in sorted(self.trees.items()):
+            consts = module_constants(tree)
+            helpers = self._state_helpers(fname, tree)
+            scanner = _Fn(self, fname, consts, helpers)
+            for fn in self._top_functions(tree):
+                scanner.walk(fn.body, {},
+                             {m: [] for m in self.machines})
+        for machine in self.machines.values():
+            for t in machine.transitions:
+                if (machine.name, t.index) in self.covered:
+                    continue
+                if machine.internal and t.via is None:
+                    continue  # environmental (e.g. holder-crash)
+                self.flag(machine.module, machine.lineno,
+                          "protocol-stale-transition",
+                          f"declared transition {t!r} is performed by no "
+                          f"code — delete it or implement it")
+        for key, owners in sorted(self.handoffs.items()):
+            if key not in self.handoff_used and all(key[0] != m.owner
+                                                    for m in owners):
+                machine = owners[0]
+                self.flag(machine.module, machine.lineno,
+                          "protocol-stale-handoff",
+                          f"{machine.name}: handoff ({key[0]} -> "
+                          f"{key[1]}) is exercised by no code")
+        return self.findings
+
+    @staticmethod
+    def _top_functions(tree: ast.Module):
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+            elif isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        yield sub
+
+    def _state_helpers(self, fname: str,
+                       tree: ast.Module) -> dict[str, Machine]:
+        """Module-level helpers that return a carrier annotation read
+        (``slice_health``, ``pool_state``): calls to them bind the
+        returned expression to that machine."""
+        helpers: dict[str, Machine] = {}
+        for node in tree.body:
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and sub.value is not None:
+                    for call in ast.walk(sub.value):
+                        if isinstance(call, ast.Call) and \
+                                _terminal_name(call.func) == \
+                                "get_annotation" and len(call.args) >= 2:
+                            attr = _names_attr(call.args[1])
+                            machine = self.carrier_map.get(attr or "")
+                            if machine is not None:
+                                helpers[node.name] = machine
+        return helpers
+
+
+# --------------------------------------------------------------------------
+# driver
+
+
+def load_files(controllers_dir: Path | None = None) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for path in sorted((controllers_dir or CONTROLLERS).glob("*.py")):
+        out[path.name] = path.read_text()
+    return out
+
+
+def main(argv: list[str]) -> int:
+    analyzer = Analyzer(load_files())
+    findings = analyzer.run()
+    if "--dump" in argv:
+        for module, lineno, mname, dsts, srcs in analyzer.writes_log:
+            print(f"{module}:{lineno}: {mname} "
+                  f"{'/'.join(srcs)} -> {'/'.join(dsts)}")
+        return 0
+    for module, lineno, rule, msg in sorted(findings):
+        rel = CONTROLLERS / module
+        shown = rel.relative_to(REPO) if rel.is_file() else module
+        print(f"{shown}:{lineno}: [{rule}] {msg}")
+    if findings:
+        print(f"\nci/protocol_gate.py: {len(findings)} finding(s)",
+              file=sys.stderr)
+        return 1
+    count = sum(len(m.transitions) for m in analyzer.machines.values())
+    print(f"ci/protocol_gate.py: {len(analyzer.machines)} machine(s), "
+          f"{count} declared transition(s), "
+          f"{len(analyzer.writes_log)} write site(s) clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

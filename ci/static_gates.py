@@ -1,0 +1,109 @@
+"""The consolidated static-gate stack behind ``make gate``.
+
+Before this driver existed, the static gates were spread over three Make
+targets (``lint``, ``sanitize``, and the ad-hoc workflow steps in
+code_quality.yaml) that each re-ran overlapping pieces and none of which
+covered the protocol verifier.  This script is the single entry point:
+it runs every static gate as a subprocess, prints per-gate wall time,
+and exits nonzero if any gate fails — so "is the tree gate-clean?" is
+one command locally and one step in CI.
+
+The stack, in order (cheap and most-frequently-red first):
+
+  lint            ci/lint.py          AST rules + dead-code sweep
+  effects         ci/effects.py       controller effect contracts
+  schema          ci/schema_gate.py   manifest/CRD/chaos-plan drift
+  protocol-gate   ci/protocol_gate.py annotation state-machine writes
+  protocol-check  ci/protocol_check.py exhaustive interleaving + crash
+                                      model checker over the declarations
+  sanitize        armed pytest tier   sanitizer/lint/effects/schema/
+                                      protocol gate self-tests
+  chaos-smoke     ci/chaos_smoke.py   20-notebook armed wire-fault soak
+
+``python ci/static_gates.py --fast`` skips the two pytest-backed gates
+(sanitize, chaos-smoke) for a sub-second pre-commit loop.  The full
+unit-test gate (``ci/gate.py``, which stamps GATE.md) stays separate as
+``make gate-full``; unit_tests.yaml invokes it directly.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+TEST_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+}
+
+SANITIZE_SUITE = [
+    "tests/test_sanitizer.py",
+    "tests/test_lint_rules.py",
+    "tests/test_effects.py",
+    "tests/test_schema_gate.py",
+    "tests/test_protocol_gate.py",
+]
+
+# (name, argv, extra-env, fast) — fast gates run even under --fast.
+GATES: list[tuple[str, list[str], dict[str, str], bool]] = [
+    ("lint", [sys.executable, "ci/lint.py"], {}, True),
+    ("effects", [sys.executable, "ci/effects.py"], {}, True),
+    ("schema", [sys.executable, "ci/schema_gate.py"], {}, True),
+    ("protocol-gate", [sys.executable, "ci/protocol_gate.py"], {}, True),
+    ("protocol-check", [sys.executable, "ci/protocol_check.py"], {}, True),
+    ("sanitize",
+     [sys.executable, "-m", "pytest", *SANITIZE_SUITE, "-q"],
+     {**TEST_ENV, "KFTPU_SANITIZE": "1"}, False),
+    ("chaos-smoke",
+     [sys.executable, "ci/chaos_smoke.py", "--count", "20",
+      "--fault-rate", "0.05"],
+     TEST_ENV, False),
+]
+
+
+def run_gate(name: str, argv: list[str],
+             extra_env: dict[str, str]) -> tuple[bool, float, str]:
+    env = {**os.environ, **extra_env}
+    start = time.monotonic()
+    proc = subprocess.run(argv, cwd=REPO, env=env,
+                          capture_output=True, text=True)
+    elapsed = time.monotonic() - start
+    output = (proc.stdout + proc.stderr).strip()
+    return proc.returncode == 0, elapsed, output
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = sys.argv[1:] if argv is None else argv
+    fast = "--fast" in args
+    results: list[tuple[str, bool, float]] = []
+    failed_output: list[tuple[str, str]] = []
+    for name, cmd, extra_env, is_fast in GATES:
+        if fast and not is_fast:
+            print(f"  {name:<16} SKIP (--fast)")
+            continue
+        ok, elapsed, output = run_gate(name, cmd, extra_env)
+        verdict = "ok" if ok else "FAIL"
+        print(f"  {name:<16} {verdict:<4} {elapsed:7.2f}s")
+        results.append((name, ok, elapsed))
+        if not ok:
+            failed_output.append((name, output))
+    total = sum(elapsed for _, _, elapsed in results)
+    bad = [name for name, ok, _ in results if not ok]
+    for name, output in failed_output:
+        print(f"\n--- {name} output ---")
+        print(output)
+    if bad:
+        print(f"\nci/static_gates.py: {len(bad)} gate(s) FAILED "
+              f"({', '.join(bad)}) in {total:.2f}s")
+        return 1
+    print(f"ci/static_gates.py: {len(results)} gate(s) clean "
+          f"in {total:.2f}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -352,7 +352,3 @@ class APFDispatcher:
                            "limit": lv.limit}
                     for name, lv in self._levels.items()}
 
-
-def wait_briefly(seconds: float) -> None:
-    """Test helper: a seat-holding sleep that releases the GIL."""
-    time.sleep(seconds)

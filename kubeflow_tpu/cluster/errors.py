@@ -103,14 +103,3 @@ def update_with_conflict_retry(client, read, mutate, attempts: int = 3):
             return None
     return None
 
-
-def is_not_found(err: Exception) -> bool:
-    return isinstance(err, NotFoundError)
-
-
-def is_conflict(err: Exception) -> bool:
-    return isinstance(err, ConflictError)
-
-
-def is_already_exists(err: Exception) -> bool:
-    return isinstance(err, AlreadyExistsError)

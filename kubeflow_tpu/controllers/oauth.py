@@ -50,9 +50,6 @@ def oauth_client_name(namespace: str, name: str) -> str:
     return f"{name}-{namespace}-oauth-client"
 
 
-def has_legacy_finalizer(notebook: dict) -> bool:
-    return k8s.has_finalizer(notebook, LEGACY_OAUTH_FINALIZER)
-
 
 def delete_oauth_client(client, notebook: dict) -> None:
     """Delete the orphaned cluster-scoped OAuthClient; absent is success

@@ -50,7 +50,46 @@ CONTRACT = {
     "annotations": [],
 }
 
-
+# Protocol state machine — checked by ci/protocol_gate.py (AST) and
+# ci/protocol_check.py (model checker); update with the code. The
+# breaker is in-process (not annotation-carried): every transition is
+# realized by _transition_locked under the breaker lock.
+PROTOCOL = [
+    {
+        "machine": "breaker",
+        "doc": "Apiserver circuit breaker gating the worker pool; healthy "
+               "rest state is closed, open is pressure relief that must "
+               "always find its way back.",
+        "owner": "resilience",
+        "carrier": {"object": "internal", "via": "_transition_locked"},
+        "fresh_reads": "lock",
+        "states": {"closed": "closed", "open": "open",
+                   "half_open": "half_open"},
+        "initial": "closed",
+        "terminal": ["closed"],
+        "transitions": [
+            {"from": "closed", "to": "open",
+             "trigger": "failure-threshold",
+             "effects": ["call:on_open"], "effects_idempotent": True,
+             "via": "_transition_locked",
+             "doc": "consecutive-failure threshold parks the worker pool"},
+            {"from": "open", "to": "half_open", "trigger": "probe-due",
+             "via": "_transition_locked"},
+            {"from": "half_open", "to": "closed", "trigger": "probe-ok",
+             "effects": ["call:_resume"], "effects_idempotent": True,
+             "via": "_transition_locked"},
+            {"from": "half_open", "to": "open", "trigger": "probe-failed",
+             "via": "_transition_locked",
+             "doc": "probe interval doubles (capped) on each re-open"},
+            {"from": ["open", "half_open"], "to": "closed",
+             "trigger": "organic-success",
+             "effects": ["call:_resume"], "effects_idempotent": True,
+             "via": "_transition_locked",
+             "doc": "any request success closes — recovery is detected "
+                    "even without a configured probe"},
+        ],
+    },
+]
 
 
 log = logging.getLogger("kubeflow_tpu.resilience")

@@ -69,7 +69,43 @@ CONTRACT = {
     "annotations": [],
 }
 
-
+# Protocol state machine — checked by ci/protocol_gate.py (AST) and
+# ci/protocol_check.py (model checker); update with the code. Lease
+# state lives on the apiserver Lease object; transitions are realized
+# by the acquire/release helpers under optimistic concurrency
+# (resourceVersion-checked update, conflict means another manager won).
+PROTOCOL = [
+    {
+        "machine": "shard-lease",
+        "doc": "Per-shard reconcile-ownership lease; a shard is held by "
+               "at most one manager, goes stale when its holder dies, and "
+               "is re-acquired by the rendezvous winner.",
+        "owner": "sharding",
+        "carrier": {"object": "internal", "via": "_try_acquire_shard"},
+        "fresh_reads": "optimistic-concurrency",
+        "states": {"unheld": "unheld", "held": "held",
+                   "released": "released", "stale": "stale"},
+        "initial": "unheld",
+        "terminal": ["held", "released"],
+        "transitions": [
+            {"from": ["unheld", "released", "stale"], "to": "held",
+             "trigger": "rendezvous-owner", "via": "_try_acquire_shard",
+             "doc": "the jump-hash owner stamps holderIdentity+renewTime; "
+                    "a Conflict means another manager won the race"},
+            {"from": "held", "to": "held", "trigger": "renew",
+             "via": "_try_acquire_shard", "self_loop": True,
+             "redeliverable": True,
+             "doc": "heartbeat re-stamps renewTime every sync"},
+            {"from": "held", "to": "released", "trigger":
+             "graceful-rebalance", "via": "_release_shard",
+             "doc": "membership change moved the shard: zero renewTime "
+                    "so the new owner acquires immediately"},
+            {"from": "held", "to": "stale", "trigger": "holder-crash",
+             "doc": "environmental — no code path; the lease simply ages "
+                    "past the duration and any member may claim it"},
+        ],
+    },
+]
 
 
 log = logging.getLogger("kubeflow_tpu.sharding")

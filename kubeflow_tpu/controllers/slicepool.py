@@ -92,7 +92,70 @@ CONTRACT = {
     },
 }
 
-
+# Protocol state machine — checked by ci/protocol_gate.py (AST) and
+# ci/protocol_check.py (model checker); update with the code.
+PROTOCOL = [
+    {
+        "machine": "pool-slice",
+        "doc": "Warm-slice lifecycle on the pool StatefulSet; the bound "
+               "edge is mirrored on the Notebook so a crash between the "
+               "two bind patches heals from either side.",
+        "owner": "slicepool",
+        "carrier": {"object": "StatefulSet",
+                    "annotation": "POOL_STATE_ANNOTATION"},
+        "fresh_reads": "optimistic-concurrency",
+        "states": {"Warming": "Warming", "Warm": "Warm", "Bound": "Bound",
+                   "Draining": "Draining", "Gone": "__deleted__"},
+        "initial": "Warming",
+        "terminal": ["Warm", "Bound", "Gone"],
+        "aux": {
+            "POOL_BOUND_TO_ANNOTATION": "slice-side half of the bound edge",
+            "BOUND_SLICE_ANNOTATION":
+                "notebook-side half of the bound edge",
+            "BOUND_POOL_ANNOTATION": "which pool owns the bound slice",
+            "SLICE_IDENTITY_ANNOTATION":
+                "TPU_WORKER_HOSTNAMES stamped at first bind, imposed on "
+                "every re-bind (migration keeps the SAME identity)",
+            "POOL_BIND_PENDING_ANNOTATION":
+                "admission-queue heartbeat while the notebook waits",
+            "POOL_BIND_MISS_ANNOTATION":
+                "terminal pool verdict: the notebook cold-rolls",
+        },
+        "handoffs": [
+            {"writer": "slicerepair", "annotation": "BOUND_SLICE_ANNOTATION",
+             "reason": "migration Checkpointing->Binding clears the bound "
+                       "edge atomically with the state flip"},
+            {"writer": "slicerepair", "annotation": "BOUND_POOL_ANNOTATION",
+             "reason": "cleared with BOUND_SLICE on migration unbind"},
+            {"writer": "slicerepair",
+             "annotation": "POOL_BIND_MISS_ANNOTATION",
+             "reason": "migration fallback stamps a miss so the notebook "
+                       "cold-rolls instead of re-queueing"},
+            {"writer": "notebook", "annotation": "POOL_BIND_MISS_ANNOTATION",
+             "reason": "bind-wait timeout: the notebook gives up on the "
+                       "pool and cold-rolls"},
+        ],
+        "transitions": [
+            {"from": "Warming", "to": "Warm", "trigger": "workers-ready"},
+            {"from": "Warm", "to": "Bound", "trigger": "notebook-admitted",
+             "effects": ["event:SliceBound"], "effects_idempotent": True},
+            {"from": "Bound", "to": "Warming", "trigger": "released-scrub",
+             "effects": ["event:SliceReleased"],
+             "effects_idempotent": True,
+             "doc": "cull/stop/unbind: scrub tenant residue, delete pods "
+                    "for a fresh boot, re-warm"},
+            {"from": "Bound", "to": "Draining", "trigger": "doomed-capacity",
+             "effects": ["call:_delete_slice", "event:SliceReleased"],
+             "effects_idempotent": True,
+             "doc": "slice consumed by a migration off dying capacity is "
+                    "torn down, not re-warmed"},
+            {"from": "Draining", "to": "Gone", "trigger": "draining-sweep",
+             "via": "_delete_slice"},
+            {"from": ["Warming", "Warm", "Bound"], "to": "Gone",
+             "trigger": "pool-teardown", "via": "_delete_slice"},
+        ],
+    },
+]
 
 
 log = logging.getLogger("kubeflow_tpu.slicepool")
@@ -473,23 +536,28 @@ class SlicePoolReconciler:
         nb = self.client.get_or_none(api.KIND, nb_ns, nb_name) \
             if nb_ns and nb_name else None
         if nb is not None and not k8s.is_deleting(nb) and \
-                k8s.get_annotation(nb, names.STOP_ANNOTATION) is None:
+                k8s.get_annotation(nb, names.STOP_ANNOTATION) is None and \
+                k8s.get_annotation(
+                    nb, names.POOL_BIND_MISS_ANNOTATION) is None:
+            # a bind-missed notebook is NEVER a healthy bind, even when
+            # the bound-slice edge still points here: a migration
+            # fallback can stamp the miss concurrently with our
+            # _stamp_notebook_bound re-writing the edge, and the core
+            # controller cold-rolls on the miss — holding the slice
+            # Bound to it would leak the slice until an operator clears
+            # the miss. Fall through and release/drain instead.
             bound = pool_api.bound_slice_ref(nb)
             if bound == (k8s.namespace(sts), k8s.name(sts)):
                 return None  # healthy bind
             if bound is None and k8s.get_annotation(
                     nb, names.MIGRATION_STATE_ANNOTATION) is None and \
-                    k8s.get_annotation(
-                        nb, names.POOL_BIND_MISS_ANNOTATION) is None and \
                     not self._slice_nodes_doomed(sts) and \
                     not _has_own_sts(self._reader(), nb):
                 # crash between the two bind patches: the slice knows the
                 # notebook but not vice versa — finish the bind from this
                 # side (idempotent: the annotations converge either way).
-                # NOT healed: bind-missed notebooks (a migration fallback
-                # just abandoned this slice — re-stamping would livelock
-                # against the repair controller) and doomed slices (the
-                # drain below owns those).
+                # NOT healed: doomed slices (the drain below owns those);
+                # bind-missed notebooks never reach here (outer guard).
                 self._stamp_notebook_bound(pool, nb, sts, slice_spec,
                                            pool_ns)
                 healed = self.client.get_or_none(api.KIND, nb_ns, nb_name)

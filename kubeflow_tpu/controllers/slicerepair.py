@@ -77,7 +77,118 @@ CONTRACT = {
     ],
 }
 
-
+# Protocol state machines — ci/protocol_gate.py checks every annotation
+# write below against these declarations (undeclared transition, wrong
+# writer, side effect before its persist, stale machine) and
+# ci/protocol_check.py model-checks them (convergence, crash-restart at
+# every transition boundary, re-delivery idempotency). Update the
+# declarations and the code together.
+PROTOCOL = [
+    {
+        "machine": "slice-health",
+        "doc": "Slice-atomic repair with poison-pill quarantine; state "
+               "rides the Notebook so restarts/failover resume it.",
+        "owner": "slicerepair",
+        "carrier": {"object": "Notebook",
+                    "annotation": "SLICE_HEALTH_ANNOTATION"},
+        "fresh_reads": "echo-tracking",
+        "states": {"Healthy": None, "Degraded": "Degraded",
+                   "Repairing": "Repairing", "Quarantined": "Quarantined"},
+        "initial": "Healthy",
+        "terminal": ["Healthy", "Quarantined"],
+        "aux": {
+            "SLICE_HEALTH_REASON_ANNOTATION": "why not Healthy",
+            "REPAIR_SCALE_DOWN_ANNOTATION":
+                "hold-at-0 handshake with the core's desired_replicas",
+            "REPAIR_STARTED_AT_ANNOTATION": "repair timeout clock",
+            "REPAIR_FAILURES_ANNOTATION":
+                "sliding quarantine window (survives restarts)",
+            "QUARANTINE_ANNOTATION":
+                "poison pill; cleared only by an operator",
+        },
+        "transitions": [
+            {"from": "Healthy", "to": "Degraded",
+             "trigger": "problem-detected",
+             "effects": ["event:SliceDegraded"],
+             "effects_idempotent": True},
+            {"from": "Degraded", "to": "Repairing",
+             "trigger": "backoff-elapsed",
+             "effects": ["event:SliceRepairStarted"],
+             "effects_idempotent": True},
+            {"from": "Repairing", "to": "Healthy",
+             "trigger": "workers-ready",
+             "effects": ["event:SliceRepaired"],
+             "effects_idempotent": True},
+            {"from": "Repairing", "to": "Degraded",
+             "trigger": "repair-timeout",
+             "effects": ["event:SliceRepairFailed"],
+             "effects_idempotent": True},
+            {"from": "Degraded", "to": "Healthy",
+             "trigger": "transient-recovery",
+             "effects": ["event:SliceRecovered"],
+             "effects_idempotent": True},
+            {"from": ["Degraded", "Repairing"], "to": "Quarantined",
+             "trigger": "failure-window-full",
+             "effects": ["event:SliceQuarantined"],
+             "effects_idempotent": True},
+            {"from": "Quarantined", "to": "Healthy",
+             "trigger": "operator-cleared",
+             "effects": ["event:SliceQuarantineCleared"],
+             "effects_idempotent": True},
+            {"from": ["Degraded", "Repairing"], "to": "Healthy",
+             "trigger": "notebook-stopped",
+             "doc": "deliberate scale-to-0 drops transient repair state"},
+            {"from": ["Healthy", "Degraded", "Repairing"],
+             "to": "Quarantined", "trigger": "quarantine-normalize",
+             "doc": "quarantine annotation present (restored from backup "
+                    "or the patch raced): quarantined means NOT repairing"},
+        ],
+    },
+    {
+        "machine": "migration",
+        "doc": "Checkpoint-based move of a pool-bound notebook; every "
+               "state is persisted BEFORE its driver side effect so a "
+               "crash resumes exactly where it left off.",
+        "owner": "slicerepair",
+        "carrier": {"object": "Notebook",
+                    "annotation": "MIGRATION_STATE_ANNOTATION"},
+        "fresh_reads": "echo-tracking",
+        "states": {"Idle": None, "Checkpointing": "Checkpointing",
+                   "Binding": "Binding", "Resuming": "Resuming"},
+        "initial": "Idle",
+        "terminal": ["Idle"],
+        "aux": {
+            "MIGRATION_STARTED_AT_ANNOTATION": "migration timeout clock",
+            "CHECKPOINT_TOKEN_ANNOTATION":
+                "kept across fallback: restore-at-boot picks it up",
+        },
+        "transitions": [
+            {"from": "Idle", "to": "Checkpointing",
+             "trigger": "bound-slice-degraded",
+             "effects": ["event:NotebookMigrationStarted",
+                         "call:migrator.checkpoint"],
+             "effects_idempotent": True},
+            {"from": "Checkpointing", "to": "Binding",
+             "trigger": "checkpoint-taken",
+             "doc": "the unbind rides the SAME patch — atomic handoff to "
+                    "the pool controller's re-bind queue"},
+            {"from": "Binding", "to": "Resuming",
+             "trigger": "rebound-and-ready",
+             "effects": ["call:migrator.resume"],
+             "effects_idempotent": True},
+            {"from": "Resuming", "to": "Idle", "trigger": "resumed",
+             "effects": ["event:NotebookMigrated"],
+             "effects_idempotent": True},
+            {"from": ["Checkpointing", "Binding", "Resuming"],
+             "to": "Idle", "trigger": "fallback",
+             "effects": ["event:NotebookMigrationFallback"],
+             "effects_idempotent": True,
+             "doc": "timeout / bind-miss / driver failure: release the "
+                    "pool path, cold-roll a dedicated StatefulSet — "
+                    "preemption must never lose the notebook"},
+        ],
+    },
+]
 
 
 MIGRATION_CHECKPOINTING = "Checkpointing"
@@ -723,14 +834,13 @@ class SliceRepairReconciler:
             notebook, names.SLICE_HEALTH_REASON_ANNOTATION) or "RepairTimeout"
         failures = self._failure_window(notebook, now)
         failures.append(now)
-        self.recorder.eventf(
-            notebook, events.TYPE_WARNING, "SliceRepairFailed",
-            f"repair did not converge within "
-            f"{self.config.slice_repair_timeout_s:.0f}s "
-            f"(failure {len(failures)}/"
-            f"{self.config.slice_repair_max_failures} in window)")
         if len(failures) >= self.config.slice_repair_max_failures:
             return self._quarantine(notebook, reason, failures)
+        # persist the Degraded fallback AND the failure window before
+        # emitting: a crash after the event but before the persist would
+        # leave Repairing with a stale started-at stamp — the restarted
+        # controller re-times-out immediately, re-emits, and the window
+        # never fills, so quarantine never engages (event storm)
         self._patch(notebook, {
             names.SLICE_HEALTH_ANNOTATION: DEGRADED,
             names.SLICE_HEALTH_REASON_ANNOTATION: reason,
@@ -738,6 +848,12 @@ class SliceRepairReconciler:
             names.REPAIR_STARTED_AT_ANNOTATION: None,
             names.REPAIR_FAILURES_ANNOTATION: _join_stamps(failures),
         })
+        self.recorder.eventf(
+            notebook, events.TYPE_WARNING, "SliceRepairFailed",
+            f"repair did not converge within "
+            f"{self.config.slice_repair_timeout_s:.0f}s "
+            f"(failure {len(failures)}/"
+            f"{self.config.slice_repair_max_failures} in window)")
         # decorrelated-jitter gate before the NEXT attempt — armed on
         # failure (a successful repair resets it), so a wedged slice
         # backs off instead of restart-storming

@@ -79,6 +79,61 @@ METRIC_FAMILY_CATALOG = frozenset({
     "sanitizer_violations_total",
 })
 
+# Label names per family — the cardinality contract that goes with the
+# name contract above. Every literal label dict passed to
+# ``.inc``/``.set``/``.observe`` anywhere in the package must use only
+# these keys (tests/test_observability.py scans the AST and pins it);
+# adding a label is a deliberate, reviewed cardinality change. Families
+# with ``()`` expose a single unlabeled series.
+METRIC_FAMILY_LABELS = {
+    "apf_current_inqueue": ("priority_level",),
+    "apf_dispatched_total": ("priority_level",),
+    "apf_rejected_total": ("priority_level",),
+    "apiserver_available": (),
+    "apiserver_breaker_state": (),
+    "apiserver_breaker_transitions_total": ("to",),
+    "apiserver_cache_lists_total": (),
+    "cache_full_scans_total": ("kind",),
+    "cache_index_lookups_total": ("index", "kind"),
+    "controller_runtime_reconcile_total": ("controller", "result"),
+    "last_notebook_culling_timestamp_seconds": (),
+    "notebook_create_failed_total": (),
+    "notebook_create_total": (),
+    "notebook_culling_total": ("name", "namespace"),
+    "notebook_migrations_total": ("outcome",),
+    "notebook_running": (),
+    "reconcile_read_seconds": ("controller",),
+    "reconcile_write_seconds": ("controller",),
+    "rest_client_connections_opened_total": ("type",),
+    "rest_client_request_duration_seconds": ("verb",),
+    "rest_client_requests_total": ("code", "method"),
+    "rest_client_retries_total": ("reason", "verb"),
+    "sanitizer_violations_total": ("rule",),
+    "serving_generate_seconds_count": (),
+    "serving_generate_seconds_sum": (),
+    "serving_http_requests_total": ("code", "method", "route"),
+    "shard_ownership": ("manager", "shard"),
+    "shard_rebalance_total": ("manager",),
+    "slice_degraded": ("namespace", "state"),
+    "slice_quarantines_total": ("namespace",),
+    "slice_repair_duration_seconds": ("namespace",),
+    "slice_repairs_total": ("namespace", "reason"),
+    "slicepool_bind_latency_seconds": ("pool",),
+    "slicepool_bind_misses_total": ("reason",),
+    "slicepool_size": ("pool", "state"),
+    "store_list_lock_seconds": ("kind",),
+    "watch_cache_evictions_total": ("kind",),
+    "watch_queue_coalesced_total": (),
+    "watch_resumes_total": ("kind", "mode"),
+    "workqueue_adds_total": ("name",),
+    "workqueue_depth": ("name",),
+    "workqueue_longest_running_processor_seconds": ("name",),
+    "workqueue_queue_duration_seconds": ("name",),
+    "workqueue_retries_total": ("name",),
+    "workqueue_unfinished_work_seconds": ("name",),
+    "workqueue_work_duration_seconds": ("name",),
+}
+
 
 def _escape_label_value(value: object) -> str:
     """Prometheus exposition escaping for label values: backslash, double

@@ -1,0 +1,246 @@
+"""Declarative protocol state machines — the registry behind the
+protocol-correctness gate (ci/protocol_gate.py + ci/protocol_check.py).
+
+The control plane's hardest invariants live in annotation-carried
+distributed state machines: slice health and checkpoint migration
+(controllers/slicerepair.py), the warm-pool slice lifecycle
+(controllers/slicepool.py), the apiserver circuit breaker
+(controllers/resilience.py) and the shard-lease handoff
+(controllers/sharding.py). Each of those modules declares its machines in
+a module-level ``PROTOCOL`` literal — the same in-module pattern as the
+``CONTRACT`` effect declarations checked by ci/effects.py — and this
+module loads, validates and objectifies them WITHOUT importing any
+controller code (declarations are parsed out of the source AST), so the
+model checker runs against declarations only.
+
+A machine declaration is a pure literal dict:
+
+``machine``      unique machine name (kebab-case)
+``owner``        controllers/<owner>.py — the single writer module
+``carrier``      where the state lives: ``{"object": "Notebook",
+                 "annotation": "SLICE_HEALTH_ANNOTATION"}`` (a constant
+                 name from utils/names.py), or ``{"object": "internal",
+                 "via": "_transition_locked"}`` for in-process machines
+                 whose transitions are realized by one function
+``states``       logical state name → stored value (None = annotation
+                 absent; internal machines store the value directly)
+``initial``      state a fresh object is born in
+``terminal``     acceptable resting states (healthy/converged)
+``fresh_reads``  why the owner's reads are not stale relative to its own
+                 writes: "echo-tracking" | "lock" |
+                 "optimistic-concurrency"
+``aux``          auxiliary annotations owned by this machine's owner
+                 (constant name → why), single-writer unless handed off
+``handoffs``     explicit cross-controller writes of owned annotations:
+                 ``{"writer": module, "annotation": const, "reason": …}``
+``transitions``  list of ``{"from": state|list, "to": state,
+                 "trigger": …, "effects": [...], …}``
+
+Transition fields beyond from/to/trigger:
+
+``effects``             side effects licensed by this persist, matched by
+                        ci/protocol_gate.py in the owner's CFG:
+                        ``event:<Reason>`` (recorder.eventf reason) or
+                        ``call:<suffix>`` (dotted call suffix). The
+                        persist must dominate every effect — "state
+                        persisted BEFORE its side effect" is the
+                        crash-heal contract.
+``effects_idempotent``  a crash between persist and effect heals by
+                        re-running the effect on re-entry (level
+                        triggered); required on every effectful
+                        transition unless ``via``-realized
+``via``                 the transition is realized by calling this
+                        function (internal machines, and deletions that
+                        are not annotation writes)
+``self_loop``           from == to is intentional (e.g. lease renew)
+``redeliverable``       re-delivering the trigger in the target state may
+                        legitimately re-fire this transition
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from . import names
+
+#: fresh-read mechanisms the checker accepts; anything else (or nothing)
+#: makes the checker explore stale pre-transition echo deliveries.
+FRESH_READ_MECHANISMS = ("echo-tracking", "lock", "optimistic-concurrency")
+
+_CONTROLLERS = Path(__file__).resolve().parent.parent / "controllers"
+
+
+class ProtocolError(ValueError):
+    """A machine declaration is malformed or internally inconsistent."""
+
+
+@dataclass(frozen=True)
+class Transition:
+    sources: tuple[str, ...]
+    target: str
+    trigger: str
+    effects: tuple[str, ...] = ()
+    effects_idempotent: bool = False
+    via: str | None = None
+    self_loop: bool = False
+    redeliverable: bool = False
+    doc: str = ""
+
+
+@dataclass
+class StateMachine:
+    name: str
+    owner: str
+    carrier: dict
+    states: dict[str, object]          # logical name -> stored value
+    initial: str
+    terminal: tuple[str, ...]
+    transitions: tuple[Transition, ...]
+    fresh_reads: str | None = None
+    aux: dict[str, str] = field(default_factory=dict)
+    handoffs: tuple[dict, ...] = ()
+    doc: str = ""
+
+    # ------------------------------------------------------------ lookups
+    @property
+    def annotation_const(self) -> str | None:
+        return self.carrier.get("annotation")
+
+    @property
+    def annotation_key(self) -> str | None:
+        const = self.annotation_const
+        return getattr(names, const) if const else None
+
+    @property
+    def internal(self) -> bool:
+        return self.carrier.get("object") == "internal"
+
+    def state_for_value(self, value) -> list[str]:
+        """Logical state name(s) storing ``value`` (None may be shared)."""
+        return [s for s, v in self.states.items() if v == value]
+
+    def transitions_from(self, state: str) -> list[Transition]:
+        return [t for t in self.transitions if state in t.sources]
+
+    def transitions_to(self, state: str) -> list[Transition]:
+        return [t for t in self.transitions if t.target == state]
+
+
+def _as_tuple(value) -> tuple[str, ...]:
+    if isinstance(value, str):
+        return (value,)
+    return tuple(value)
+
+
+def build_machine(decl: dict) -> StateMachine:
+    """Validate one declaration literal and build its StateMachine."""
+    for req in ("machine", "owner", "carrier", "states", "initial",
+                "terminal", "transitions"):
+        if req not in decl:
+            raise ProtocolError(
+                f"machine declaration missing {req!r}: {decl!r:.120}")
+    name = decl["machine"]
+    states = dict(decl["states"])
+    if not states:
+        raise ProtocolError(f"{name}: no states declared")
+    values = list(states.values())
+    if len(set(map(repr, values))) != len(values):
+        raise ProtocolError(f"{name}: duplicate stored state values")
+    carrier = dict(decl["carrier"])
+    if carrier.get("object") != "internal":
+        const = carrier.get("annotation")
+        if not const or not hasattr(names, const):
+            raise ProtocolError(
+                f"{name}: carrier annotation {const!r} is not a "
+                f"utils/names.py constant")
+    elif not carrier.get("via"):
+        raise ProtocolError(f"{name}: internal carrier needs a 'via'")
+    for aux_const in decl.get("aux", {}):
+        if not hasattr(names, aux_const):
+            raise ProtocolError(
+                f"{name}: aux annotation {aux_const!r} is not a "
+                f"utils/names.py constant")
+    transitions = []
+    for raw in decl["transitions"]:
+        t = Transition(
+            sources=_as_tuple(raw["from"]), target=raw["to"],
+            trigger=raw["trigger"],
+            effects=tuple(raw.get("effects", ())),
+            effects_idempotent=bool(raw.get("effects_idempotent", False)),
+            via=raw.get("via"),
+            self_loop=bool(raw.get("self_loop", False)),
+            redeliverable=bool(raw.get("redeliverable", False)),
+            doc=raw.get("doc", ""))
+        for s in t.sources + (t.target,):
+            if s not in states:
+                raise ProtocolError(
+                    f"{name}: transition {t.sources}->{t.target} "
+                    f"references undeclared state {s!r}")
+        if t.target in t.sources and not t.self_loop:
+            raise ProtocolError(
+                f"{name}: {t.target}->{t.target} must declare self_loop")
+        transitions.append(t)
+    terminal = _as_tuple(decl["terminal"])
+    for s in terminal + (decl["initial"],):
+        if s not in states:
+            raise ProtocolError(f"{name}: undeclared state {s!r}")
+    if not terminal:
+        raise ProtocolError(f"{name}: no terminal states")
+    for h in decl.get("handoffs", ()):
+        if not h.get("writer") or not h.get("annotation"):
+            raise ProtocolError(f"{name}: handoff needs writer+annotation")
+    return StateMachine(
+        name=name, owner=decl["owner"], carrier=carrier, states=states,
+        initial=decl["initial"], terminal=terminal,
+        transitions=tuple(transitions),
+        fresh_reads=decl.get("fresh_reads"),
+        aux=dict(decl.get("aux", {})),
+        handoffs=tuple(decl.get("handoffs", ())),
+        doc=decl.get("doc", ""))
+
+
+def raw_declarations(source: str) -> list[dict]:
+    """The PROTOCOL literal of one module's source, or [] — extracted via
+    ast.literal_eval so loading declarations never executes controller
+    code (the same trick as ci/effects.py's CONTRACT parsing)."""
+    tree = ast.parse(source)
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "PROTOCOL":
+            try:
+                value = ast.literal_eval(node.value)
+            except (ValueError, SyntaxError) as exc:
+                raise ProtocolError(f"PROTOCOL is not a pure literal: "
+                                    f"{exc}") from exc
+            if not isinstance(value, list):
+                raise ProtocolError("PROTOCOL must be a list of machines")
+            return value
+    return []
+
+
+def load_machines(controllers_dir: Path | None = None) \
+        -> dict[str, StateMachine]:
+    """All machines declared across controllers/*.py, keyed by name."""
+    machines: dict[str, StateMachine] = {}
+    owners: dict[str, str] = {}
+    for path in sorted((controllers_dir or _CONTROLLERS).glob("*.py")):
+        for decl in raw_declarations(path.read_text()):
+            m = build_machine(decl)
+            if m.name in machines:
+                raise ProtocolError(f"duplicate machine {m.name!r}")
+            if m.owner != path.stem:
+                raise ProtocolError(
+                    f"{m.name}: declared in {path.stem}.py but owned by "
+                    f"{m.owner!r} — machines live next to their owner")
+            key = m.annotation_const
+            if key is not None:
+                prev = owners.setdefault(key, m.name)
+                if prev != m.name:
+                    raise ProtocolError(
+                        f"carrier {key} claimed by both {prev} and "
+                        f"{m.name}")
+            machines[m.name] = m
+    return machines

@@ -953,6 +953,7 @@ METRIC_FAMILY_CATALOG = {
     "rest_client_request_duration_seconds",
     "rest_client_requests_total",
     "rest_client_retries_total",
+    "sanitizer_violations_total",
     "serving_generate_seconds_count",
     "serving_generate_seconds_sum",
     "serving_http_requests_total",
@@ -978,8 +979,11 @@ METRIC_FAMILY_CATALOG = {
     "workqueue_work_duration_seconds",
 }
 
+# the leading \w keeps prose mentions like ``.counter("x", ...)`` in
+# docstrings/comments out of scope — a real registration always has a
+# receiver identifier before the dot
 _REGISTRATION_RE = re.compile(
-    r'\.(?:counter|gauge|histogram)\(\s*(?:#[^\n]*)?\n?\s*"([a-z_0-9]+)"')
+    r'\w\.(?:counter|gauge|histogram)\(\s*(?:#[^\n]*)?\n?\s*"([a-z_0-9]+)"')
 
 
 def test_metric_family_catalog_matches_source():
@@ -995,6 +999,107 @@ def test_metric_family_catalog_matches_source():
         f"metric families drifted — unlisted in catalog: {sorted(new)}, "
         f"listed but no longer registered: {sorted(gone)}. Update "
         f"METRIC_FAMILY_CATALOG and the ARCHITECTURE.md metric catalog.")
+
+
+def _labeled_use_sites():
+    """AST scan of every package module: map each literal label dict
+    passed to ``.inc``/``.set``/``.observe``/``.get`` back to the metric
+    family of its receiver (resolved through the ``self.x = registry
+    .counter("fam", ...)`` registration in the same module). Dynamic
+    label dicts are skipped — the pin governs the literal sites."""
+    import ast
+
+    pkg = Path(__file__).resolve().parent.parent / "kubeflow_tpu"
+    sites = []  # (path, lineno, family, label_keys)
+    for path in sorted(pkg.rglob("*.py")):
+        tree = ast.parse(path.read_text())
+        local = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                call = node.value
+                if isinstance(call.func, ast.Attribute) and \
+                        call.func.attr in ("counter", "gauge",
+                                           "histogram") and \
+                        call.args and \
+                        isinstance(call.args[0], ast.Constant):
+                    for target in node.targets:
+                        if isinstance(target, ast.Attribute):
+                            local[target.attr] = call.args[0].value
+                        elif isinstance(target, ast.Name):
+                            local[target.id] = call.args[0].value
+        for _ in range(2):  # resolve aliases like `metric = self._metric`
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, (ast.Attribute, ast.Name)):
+                    src = node.value.attr \
+                        if isinstance(node.value, ast.Attribute) \
+                        else node.value.id
+                    if src in local:
+                        for target in node.targets:
+                            if isinstance(target, ast.Name):
+                                local[target.id] = local[src]
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Attribute) and
+                    node.func.attr in ("inc", "set", "observe", "get")):
+                continue
+            recv = node.func.value
+            rname = recv.attr if isinstance(recv, ast.Attribute) else (
+                recv.id if isinstance(recv, ast.Name) else "")
+            family = local.get(rname)
+            if family is None:
+                continue
+            dicts = [a for a in node.args if isinstance(a, ast.Dict)]
+            dicts += [kw.value for kw in node.keywords
+                      if kw.arg == "labels" and
+                      isinstance(kw.value, ast.Dict)]
+            for d in dicts:
+                keys = [k.value for k in d.keys
+                        if isinstance(k, ast.Constant) and
+                        isinstance(k.value, str)]
+                if len(keys) == len(d.keys):
+                    sites.append((path, node.lineno, family,
+                                  frozenset(keys)))
+    return sites
+
+
+def test_metric_label_names_pinned_per_family():
+    """Every literal label key used with a family must be declared in
+    METRIC_FAMILY_LABELS — a new label is a cardinality change that gets
+    reviewed, not accreted. The pin's keys must exactly match the
+    family catalog so the two contracts cannot drift apart."""
+    from kubeflow_tpu.utils.metrics import METRIC_FAMILY_LABELS
+
+    assert set(METRIC_FAMILY_LABELS) == METRIC_FAMILY_CATALOG, (
+        "METRIC_FAMILY_LABELS keys must match the family catalog")
+    violations = []
+    for path, lineno, family, keys in _labeled_use_sites():
+        declared = set(METRIC_FAMILY_LABELS.get(family, ()))
+        extra = keys - declared
+        if extra:
+            violations.append(
+                f"{path.name}:{lineno}: {family} uses undeclared "
+                f"label(s) {sorted(extra)} (declared: {sorted(declared)})")
+    assert not violations, "\n".join(violations)
+
+
+def test_every_declared_label_is_used_somewhere():
+    """The converse drift direction: a label declared for a family but
+    used at no literal site is stale (renamed or removed in code)."""
+    from kubeflow_tpu.utils.metrics import METRIC_FAMILY_LABELS
+
+    used: dict = {}
+    for _path, _lineno, family, keys in _labeled_use_sites():
+        used.setdefault(family, set()).update(keys)
+    stale = []
+    for family, labels in sorted(METRIC_FAMILY_LABELS.items()):
+        missing = set(labels) - used.get(family, set())
+        if missing:
+            stale.append(f"{family}: declared label(s) "
+                         f"{sorted(missing)} never used at any literal "
+                         f"site")
+    assert not stale, "\n".join(stale)
 
 
 def test_every_catalog_family_is_referenced_in_tests():
@@ -1026,7 +1131,7 @@ def test_workqueue_and_client_families_exported_via_manager():
     serving_generate_seconds_sum, serving_http_requests_total,
     notebook_create_failed_total, notebook_culling_total,
     notebook_running, last_notebook_culling_timestamp_seconds,
-    notebook_migrations_total.)"""
+    notebook_migrations_total, sanitizer_violations_total.)"""
     store = ClusterStore()
     metrics = MetricsRegistry()
     mgr = Manager(store)

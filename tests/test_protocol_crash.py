@@ -1,0 +1,348 @@
+"""Crash-at-every-transition-boundary regressions for the migration
+protocol machine, pinned BOTH ways:
+
+- against the model: ci/protocol_check.py's composed pool x migration
+  exploration must converge from every reachable config (and the
+  pre-fix pool model — healthy-bind ignoring POOL_BIND_MISS — must
+  still reproduce the slice leak, so the checker keeps teeth);
+- against the code: for each persisted migration state, a fresh
+  controller world started on a store frozen at that exact crash
+  window must converge to a settled config (re-bind + resume, or the
+  fallback cold roll) — every state is annotation-persisted BEFORE its
+  side effect, so restart-at-boundary is the whole crash model.
+
+Plus the two ordering regressions the protocol gates surfaced:
+the repair-failure persist must precede its SliceRepairFailed event
+(a crash between them re-timed-out forever on the stale started-at
+stamp), and a bind-missed notebook must never count as a healthy bind
+(the fallback/stamp race leaked the slice Bound forever).
+"""
+
+import importlib.util
+import json
+import sys
+import time
+from pathlib import Path
+
+import pytest
+
+from kubeflow_tpu.api import slicepool as pool_api
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator, preempt_node
+from kubeflow_tpu.controllers import (Manager, NotebookReconciler,
+                                      SlicePoolReconciler,
+                                      SliceRepairReconciler)
+from kubeflow_tpu.controllers.slicerepair import (DEGRADED,
+                                                  MIGRATION_BINDING,
+                                                  MIGRATION_CHECKPOINTING,
+                                                  MIGRATION_RESUMING)
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+REPO = Path(__file__).resolve().parent.parent
+
+spec = importlib.util.spec_from_file_location(
+    "protocol_check_mod", REPO / "ci/protocol_check.py")
+protocol_check = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(protocol_check)
+
+NS = "crash-ns"
+POOL_NS = "tpu-slice-pools"
+
+
+def fast_config(**overrides) -> ControllerConfig:
+    defaults = dict(pool_poll_s=0.02, pool_bind_grace_s=2.0,
+                    pool_migration_timeout_s=10.0,
+                    slice_repair_poll_s=0.02,
+                    slice_repair_backoff_base_s=0.01,
+                    slice_repair_backoff_max_s=0.05,
+                    slice_repair_timeout_s=5.0)
+    defaults.update(overrides)
+    return ControllerConfig(**defaults)
+
+
+class World:
+    """Core + pool + repair reconcilers and the kubelet sim — the full
+    migration cast, restartable on the same store."""
+
+    def __init__(self, store, config=None, ready_hook=None):
+        self.store = store
+        self.config = config or fast_config()
+        self.metrics = MetricsRegistry()
+        self.mgr = Manager(store)
+        NotebookReconciler(store, self.config, self.metrics).setup(self.mgr)
+        SliceRepairReconciler(store, self.config, self.metrics
+                              ).setup(self.mgr)
+        SlicePoolReconciler(store, self.config, self.metrics
+                            ).setup(self.mgr)
+        self.sim = StatefulSetSimulator(store, boot_delay_s=0.0,
+                                        node_grace_s=0.05,
+                                        ready_hook=ready_hook)
+        self.sim.setup(self.mgr)
+        self.mgr.start()
+        # a restarted controller's informers re-list on start: replay the
+        # pre-existing objects (a fresh world over an empty store enqueues
+        # nothing here, so first-boot worlds are unaffected)
+        self.mgr.resync_all()
+
+    def notebook(self, name="nb"):
+        return self.store.get_or_none(api.KIND, NS, name)
+
+    def annotation(self, key, name="nb"):
+        return k8s.get_annotation(self.notebook(name), key)
+
+    def pool_slices(self, state=None):
+        out = []
+        for sts in self.store.list("StatefulSet", POOL_NS):
+            if k8s.get_label(sts, names.POOL_LABEL) is None:
+                continue
+            if state is None or k8s.get_annotation(
+                    sts, names.POOL_STATE_ANNOTATION) == state:
+                out.append(sts)
+        return out
+
+    def slice_ready(self, name="nb"):
+        nb = self.notebook(name)
+        cond = api.get_condition(nb, api.CONDITION_SLICE_READY) \
+            if nb else None
+        return bool(cond and cond.get("status") == "True")
+
+    def wait(self, predicate, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.01)
+        return bool(predicate())
+
+    def stop(self):
+        self.mgr.stop()
+
+
+def bound_world(store, warm=2):
+    """A pool-bound, slice-ready notebook — the migration start state."""
+    w = World(store)
+    w.store.create(pool_api.new_slice_pool("pool-a", "v5e-16", warm))
+    assert w.wait(lambda: len(w.pool_slices("Warm")) == warm), "never warm"
+    w.store.create(api.new_notebook("nb", NS, annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}))
+    assert w.wait(lambda: w.slice_ready()), "never bound"
+    return w
+
+
+def converged(w):
+    nb = w.notebook()
+    return (nb is not None and
+            k8s.get_annotation(nb, names.MIGRATION_STATE_ANNOTATION)
+            is None and
+            pool_api.bound_slice_ref(nb) is not None and
+            w.slice_ready())
+
+
+# ------------------------------------------------------ model regressions
+
+MACHINES = protocol_check.protocol.load_machines()
+
+
+def test_model_converges_from_every_reachable_config():
+    result = protocol_check.explore(
+        protocol_check.PoolMigrationModel(), MACHINES)
+    assert result["stuck"] == []
+    assert result["deadlocks"] == []
+    assert result["undeclared_edges"] == []
+    assert result["settled"] > 0
+
+
+def test_model_without_miss_guard_reproduces_the_slice_leak():
+    """The checker must keep teeth: the pre-fix pool (healthy-bind
+    early-return ignoring POOL_BIND_MISS) leaks the slice when the
+    migration fallback races the bind stamp."""
+    result = protocol_check.explore(
+        protocol_check.PoolMigrationModel(heal_checks_miss=False),
+        MACHINES)
+    assert result["stuck"], "pre-fix model no longer shows the leak"
+    assert any(
+        cfg.field("miss") and
+        ("nb" in (cfg.field("a_to"), cfg.field("s_to")))
+        for cfg in result["stuck"]), \
+        "stuck configs lost the leak shape (miss + slice still edged)"
+
+
+def test_every_machine_passes_the_graph_checks():
+    for machine in MACHINES.values():
+        assert protocol_check.check_machine(machine) == []
+
+
+# -------------------------------------------- crash windows, per boundary
+
+def _restart_into(store, window: dict, ready_hook=None) -> World:
+    """Freeze the store at a persisted crash window, then start a fresh
+    controller world on it — restart-at-boundary, the crash model the
+    persist-before-effect contract promises to heal."""
+    store.patch(api.KIND, NS, "nb",
+                {"metadata": {"annotations": window}})
+    return World(store, ready_hook=ready_hook)
+
+
+def test_crash_after_checkpointing_persist_resumes(store):
+    w = bound_world(store)
+    w.stop()
+    w2 = _restart_into(store, {
+        names.SLICE_HEALTH_ANNOTATION: DEGRADED,
+        names.SLICE_HEALTH_REASON_ANNOTATION: "NodeDied",
+        names.MIGRATION_STATE_ANNOTATION: MIGRATION_CHECKPOINTING,
+        names.MIGRATION_STARTED_AT_ANNOTATION: "%.3f" % time.time(),
+    })
+    try:
+        assert w2.wait(lambda: converged(w2), 20), \
+            "restart at Checkpointing never converged"
+        assert w2.annotation(names.SLICE_HEALTH_ANNOTATION) is None
+        assert w2.annotation(names.CHECKPOINT_TOKEN_ANNOTATION) is None
+    finally:
+        w2.stop()
+
+
+def test_crash_after_binding_persist_rebinds_and_resumes(store):
+    # checkpoint taken and the notebook side unbound; the slice side
+    # still edges the notebook (the pool had not acted yet)
+    w = bound_world(store)
+    w.stop()
+    w2 = _restart_into(store, {
+        names.SLICE_HEALTH_ANNOTATION: DEGRADED,
+        names.SLICE_HEALTH_REASON_ANNOTATION: "NodeDied",
+        names.MIGRATION_STATE_ANNOTATION: MIGRATION_BINDING,
+        names.MIGRATION_STARTED_AT_ANNOTATION: "%.3f" % time.time(),
+        names.CHECKPOINT_TOKEN_ANNOTATION: json.dumps({"step": 7}),
+        names.BOUND_SLICE_ANNOTATION: None,
+        names.BOUND_POOL_ANNOTATION: None,
+    })
+    try:
+        assert w2.wait(lambda: converged(w2), 20), \
+            "restart at Binding never converged"
+        # the checkpoint token survived the crash: step continuity
+        assert w2.annotation(names.RESUMED_STEP_ANNOTATION) == "7"
+    finally:
+        w2.stop()
+
+
+def test_crash_after_resuming_persist_completes(store):
+    w = bound_world(store)
+    w.stop()
+    w2 = _restart_into(store, {
+        names.SLICE_HEALTH_ANNOTATION: DEGRADED,
+        names.SLICE_HEALTH_REASON_ANNOTATION: "NodeDied",
+        names.MIGRATION_STATE_ANNOTATION: MIGRATION_RESUMING,
+        names.MIGRATION_STARTED_AT_ANNOTATION: "%.3f" % time.time(),
+        names.CHECKPOINT_TOKEN_ANNOTATION: json.dumps({"step": 9}),
+    })
+    try:
+        assert w2.wait(lambda: converged(w2), 20), \
+            "restart at Resuming never converged"
+        assert w2.annotation(names.RESUMED_STEP_ANNOTATION) == "9"
+        assert w2.annotation(names.MIGRATION_STARTED_AT_ANNOTATION) is None
+    finally:
+        w2.stop()
+
+
+def test_crash_after_fallback_persist_releases_leaked_slice(store):
+    """Bug regression: the fallback (miss stamped, bound cleared) raced
+    the pool's in-flight bind stamp, leaving POOL_BIND_MISS *and* a
+    bound edge on both sides. The pre-fix pool treated bound==slice as
+    a healthy bind and early-returned — the slice stayed Bound forever
+    while the core cold-rolled a second slice. The pool must instead
+    unbind the notebook and release the slice back toward Warm."""
+    w = bound_world(store, warm=1)
+    bound = pool_api.bound_slice_ref(w.notebook())
+    w.stop()
+    w2 = _restart_into(store, {
+        names.POOL_BIND_MISS_ANNOTATION: "NoWarmSlice",
+        names.MIGRATION_STATE_ANNOTATION: None,
+        names.MIGRATION_STARTED_AT_ANNOTATION: None,
+    })
+    try:
+        # pool side: the leaked edge is dropped and the slice released
+        assert w2.wait(lambda: pool_api.bound_slice_ref(
+            w2.notebook() or {}) is None, 20), \
+            "bind-missed notebook kept its slice edge"
+        assert w2.wait(lambda: k8s.get_annotation(
+            store.get_or_none("StatefulSet", *bound) or {},
+            names.POOL_BOUND_TO_ANNOTATION) is None, 20), \
+            "slice stayed Bound to the bind-missed notebook (leak)"
+        # core side: the miss cold-rolls a dedicated StatefulSet
+        assert w2.wait(lambda: w2.slice_ready() and
+                       store.get_or_none("StatefulSet", NS, "nb")
+                       is not None, 20), "fallback cold roll never ran"
+    finally:
+        w2.stop()
+
+
+# --------------------------------------------- persist-before-effect pin
+
+def test_repair_failure_persist_precedes_its_event(store):
+    """Bug regression: _repair_failed emitted SliceRepairFailed before
+    persisting Degraded + the failure window. A crash between the two
+    left Repairing with a stale started-at stamp — instant re-timeout,
+    re-emit, and a quarantine window that never fills. Pin the order:
+    whenever the event lands in the store, the notebook already shows
+    the persisted outcome."""
+    log = []
+    store.watch(api.KIND, lambda ev: log.append(
+        ("nb",
+         k8s.get_annotation(ev.obj, names.SLICE_HEALTH_ANNOTATION),
+         k8s.get_annotation(ev.obj, names.REPAIR_STARTED_AT_ANNOTATION))))
+    store.watch("Event", lambda ev: log.append(
+        ("event", ev.obj.get("reason"), None)))
+    w = World(store,
+              config=fast_config(slice_repair_timeout_s=0.3,
+                                 slice_repair_max_failures=3),
+              ready_hook=lambda pod: False)
+    try:
+        store.create(api.new_notebook("nb", NS, annotations={
+            names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}))
+        assert w.wait(lambda: len(store.list(
+            "Pod", NS, {names.NOTEBOOK_NAME_LABEL: "nb"})) == 4)
+        preempt_node(store, store.list(
+            "Pod", NS, {names.NOTEBOOK_NAME_LABEL: "nb"})[0]
+            ["spec"]["nodeName"])
+        assert w.wait(lambda: any(e[0] == "event" and
+                                  e[1] == "SliceRepairFailed"
+                                  for e in log), 20), \
+            "repair never timed out"
+    finally:
+        w.stop()
+    snapshot = list(log)
+    for i, entry in enumerate(snapshot):
+        if entry[0] == "event" and entry[1] == "SliceRepairFailed":
+            before = [e for e in snapshot[:i] if e[0] == "nb"]
+            assert before, "event landed before any notebook write"
+            health, started = before[-1][1], before[-1][2]
+            assert health == DEGRADED and started is None, \
+                (f"SliceRepairFailed emitted before its persist "
+                 f"(health={health!r}, started-at={started!r})")
+
+
+def test_quarantine_supersedes_the_repair_failed_event(store):
+    """The quarantine check runs before the failure event: the K-th
+    failure emits SliceQuarantined, not a SliceRepairFailed the poison
+    pill immediately contradicts."""
+    w = World(store,
+              config=fast_config(slice_repair_timeout_s=0.2,
+                                 slice_repair_max_failures=1),
+              ready_hook=lambda pod: False)
+    try:
+        store.create(api.new_notebook("nb", NS, annotations={
+            names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}))
+        assert w.wait(lambda: len(store.list(
+            "Pod", NS, {names.NOTEBOOK_NAME_LABEL: "nb"})) == 4)
+        preempt_node(store, store.list(
+            "Pod", NS, {names.NOTEBOOK_NAME_LABEL: "nb"})[0]
+            ["spec"]["nodeName"])
+        assert w.wait(lambda: w.annotation(
+            names.QUARANTINE_ANNOTATION) is not None, 20), \
+            "never quarantined"
+    finally:
+        w.stop()
+    reasons = [e["reason"] for e in store.list("Event", NS)]
+    assert "SliceQuarantined" in reasons
+    assert "SliceRepairFailed" not in reasons

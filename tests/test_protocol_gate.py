@@ -1,0 +1,222 @@
+"""The protocol-machine verifier (ci/protocol_gate.py) and its model
+checker (ci/protocol_check.py) — every rule must fire on a
+mini-controller built to violate it, declared handoffs must actually
+suppress the single-writer rule, and the shipped package must be
+protocol-clean (zero suppressions)."""
+
+from __future__ import annotations
+
+import importlib.util
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+spec = importlib.util.spec_from_file_location(
+    "protocol_gate_mod", REPO / "ci/protocol_gate.py")
+protocol_gate = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(protocol_gate)
+
+NAMES_MAP = {
+    "PHASE_ANNOTATION": "mini.example.org/phase",
+    "NOTE_ANNOTATION": "mini.example.org/note",
+}
+
+
+def project_rules(files: dict[str, str]) -> set[str]:
+    """Rule names the gate emits over fixture modules (keyed by
+    filename, as if they lived under kubeflow_tpu/controllers/)."""
+    analyzer = protocol_gate.Analyzer(files, names_map=NAMES_MAP)
+    return {rule for (_mod, _line, rule, _msg) in analyzer.run()}
+
+
+# a protocol-complete mini controller every violating fixture twists:
+# Idle -> Running -> Done -> Idle, each effect after its persist.
+MINI_PROTOCOL = '''\
+PROTOCOL = [
+    {
+        "machine": "mini-phase",
+        "doc": "fixture",
+        "owner": "mini",
+        "carrier": {"object": "Notebook",
+                    "annotation": "PHASE_ANNOTATION"},
+        "fresh_reads": "echo-tracking",
+        "states": {"Idle": None, "Running": "Running", "Done": "Done"},
+        "initial": "Idle",
+        "terminal": ["Idle", "Done"],
+        "aux": {"NOTE_ANNOTATION": "operator-facing note"},
+        "transitions": [
+            {"from": "Idle", "to": "Running", "trigger": "start",
+             "effects": ["event:MiniStarted"],
+             "effects_idempotent": True},
+            {"from": "Running", "to": "Done", "trigger": "finish",
+             "effects": ["event:MiniDone"],
+             "effects_idempotent": True},
+            {"from": "Done", "to": "Idle", "trigger": "reset"},
+        ],
+    },
+]
+
+RUNNING = "Running"
+DONE = "Done"
+'''
+
+CLEAN_MINI = MINI_PROTOCOL + '''\
+
+
+class MiniController:
+    def reconcile(self, nb):
+        state = k8s.get_annotation(nb, names.PHASE_ANNOTATION)
+        if state is None:
+            self._patch(nb, {names.PHASE_ANNOTATION: RUNNING})
+            self.recorder.eventf(nb, "Normal", "MiniStarted", "go")
+        elif state == RUNNING:
+            self._patch(nb, {names.PHASE_ANNOTATION: DONE,
+                             names.NOTE_ANNOTATION: "ok"})
+            self.recorder.eventf(nb, "Normal", "MiniDone", "done")
+        elif state == DONE:
+            self._patch(nb, {names.PHASE_ANNOTATION: None})
+'''
+
+
+def test_clean_mini_controller_has_no_findings():
+    assert project_rules({"mini.py": CLEAN_MINI}) == set()
+
+
+def test_undeclared_transition_fires_on_skipped_state():
+    # Idle -> Done is not declared; the guard proves the source is Idle.
+    bad = CLEAN_MINI.replace(
+        "self._patch(nb, {names.PHASE_ANNOTATION: RUNNING})",
+        "self._patch(nb, {names.PHASE_ANNOTATION: DONE})")
+    assert "protocol-undeclared-transition" in project_rules(
+        {"mini.py": bad})
+
+
+def test_undeclared_transition_fires_on_unknown_state_value():
+    bad = CLEAN_MINI.replace(
+        "self._patch(nb, {names.PHASE_ANNOTATION: RUNNING})",
+        'self._patch(nb, {names.PHASE_ANNOTATION: "Exploded"})')
+    assert "protocol-undeclared-transition" in project_rules(
+        {"mini.py": bad})
+
+
+def test_wrong_writer_fires_on_cross_controller_carrier_write():
+    other = '''\
+def poke(self, nb):
+    self._patch(nb, {names.PHASE_ANNOTATION: "Running"})
+'''
+    rules = project_rules({"mini.py": CLEAN_MINI, "other.py": other})
+    assert "protocol-wrong-writer" in rules
+
+
+def test_wrong_writer_fires_on_cross_controller_aux_write():
+    other = '''\
+def annotate(self, nb):
+    self._patch(nb, {names.NOTE_ANNOTATION: "meddling"})
+'''
+    rules = project_rules({"mini.py": CLEAN_MINI, "other.py": other})
+    assert "protocol-wrong-writer" in rules
+
+
+def test_declared_handoff_suppresses_wrong_writer():
+    mini = CLEAN_MINI.replace(
+        '"aux": {"NOTE_ANNOTATION": "operator-facing note"},',
+        '"aux": {"NOTE_ANNOTATION": "operator-facing note"},\n'
+        '        "handoffs": [{"writer": "other",\n'
+        '                      "annotation": "NOTE_ANNOTATION",\n'
+        '                      "doc": "other stamps the note"}],')
+    other = '''\
+def annotate(self, nb):
+    self._patch(nb, {names.NOTE_ANNOTATION: "sanctioned"})
+'''
+    assert project_rules({"mini.py": mini, "other.py": other}) == set()
+
+
+def test_stale_handoff_fires_when_no_code_exercises_it():
+    mini = CLEAN_MINI.replace(
+        '"aux": {"NOTE_ANNOTATION": "operator-facing note"},',
+        '"aux": {"NOTE_ANNOTATION": "operator-facing note"},\n'
+        '        "handoffs": [{"writer": "other",\n'
+        '                      "annotation": "NOTE_ANNOTATION",\n'
+        '                      "doc": "other stamps the note"}],')
+    assert "protocol-stale-handoff" in project_rules({"mini.py": mini})
+
+
+def test_effect_before_persist_fires_on_swapped_order():
+    bad = CLEAN_MINI.replace(
+        '''self._patch(nb, {names.PHASE_ANNOTATION: RUNNING})
+            self.recorder.eventf(nb, "Normal", "MiniStarted", "go")''',
+        '''self.recorder.eventf(nb, "Normal", "MiniStarted", "go")
+            self._patch(nb, {names.PHASE_ANNOTATION: RUNNING})''')
+    assert bad != CLEAN_MINI
+    assert "protocol-effect-before-persist" in project_rules(
+        {"mini.py": bad})
+
+
+def test_stale_transition_fires_on_unimplemented_declaration():
+    mini = CLEAN_MINI.replace(
+        '{"from": "Done", "to": "Idle", "trigger": "reset"},',
+        '{"from": "Done", "to": "Idle", "trigger": "reset"},\n'
+        '            {"from": "Running", "to": "Idle",\n'
+        '             "trigger": "abort"},')
+    assert "protocol-stale-transition" in project_rules(
+        {"mini.py": mini})
+
+
+def test_parse_fires_on_malformed_declaration():
+    assert "protocol-parse" in project_rules(
+        {"mini.py": 'PROTOCOL = [{"machine": "broken"}]\n'})
+
+
+def test_parse_fires_on_non_literal_protocol():
+    assert "protocol-parse" in project_rules(
+        {"mini.py": "PROTOCOL = [make_machine()]\n"})
+
+
+def test_parse_fires_on_foreign_owner():
+    mini = MINI_PROTOCOL.replace('"owner": "mini"', '"owner": "elsewhere"')
+    assert "protocol-parse" in project_rules({"mini.py": mini})
+
+
+def test_parse_fires_on_unknown_carrier_constant():
+    mini = CLEAN_MINI.replace('"annotation": "PHASE_ANNOTATION"',
+                              '"annotation": "MYSTERY_ANNOTATION"')
+    assert "protocol-parse" in project_rules({"mini.py": mini})
+
+
+def test_guard_narrowing_tracks_the_read_state():
+    # Done -> Running is undeclared; without narrowing the write would
+    # pass via the Idle -> Running transition (source unknown = any).
+    bad = CLEAN_MINI.replace(
+        "self._patch(nb, {names.PHASE_ANNOTATION: None})",
+        "self._patch(nb, {names.PHASE_ANNOTATION: RUNNING})")
+    assert "protocol-undeclared-transition" in project_rules(
+        {"mini.py": bad})
+
+
+def test_read_verbs_do_not_count_as_writes():
+    # an annotation Dict inside a list() read filter is not a persist
+    mini = CLEAN_MINI + '''\
+
+
+def lookup(self, client):
+    return client.list("Notebook",
+                       {names.PHASE_ANNOTATION: "Running"})
+'''
+    assert project_rules({"mini.py": mini}) == set()
+
+
+def test_shipped_package_is_protocol_clean():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "ci/protocol_gate.py")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "machine(s)" in proc.stdout
+
+
+def test_shipped_declarations_model_check_clean():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "ci/protocol_check.py")],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
