@@ -287,6 +287,130 @@ class PoolMigrationModel:
         return out
 
 
+# --------------------------------------------------------------------------
+# composed elastic-resize × slice-health model
+
+
+class EConfig(tuple):
+    """(el, ack, cur, tgt, prob, health)"""
+
+    __slots__ = ()
+    FIELDS = ("el", "ack", "cur", "tgt", "prob", "health")
+
+    def field(self, key: str):
+        return self[self.FIELDS.index(key)]
+
+    def replace(self, **kw) -> "EConfig":
+        vals = list(self)
+        for key, value in kw.items():
+            vals[self.FIELDS.index(key)] = value
+        return EConfig(vals)
+
+    def __repr__(self) -> str:
+        parts = [f"{k}={v!r}" for k, v in zip(self.FIELDS, self)]
+        return f"EConfig({', '.join(parts)})"
+
+
+class ElasticRepairModel:
+    """One elastic notebook (requested REQ slices) under the slicerepair
+    controller, the trainer-side agent, and a hostile environment that
+    injects/clears slice problems at will.
+
+    Three writers interleave, every persist one atomic store step:
+
+    - controller: the combined Degraded+Draining shrink persist, the
+      grow-start persist, the ack-gated Draining→Resharding advance, the
+      completion scrub, the timeout abort (which LATCHES ack="Aborted"),
+      and the plain repair ladder (start/finish/transient-recover) —
+      gated exactly as controllers/slicerepair.py gates them (shrink and
+      grow require slice-health Healthy AND no Aborted latch; the repair
+      ladder requires no resize in flight);
+    - agent (runtime/elastic.py): echoes the carrier into the ack, writes
+      the new current-slices count at reshard time, clears the Aborted
+      latch when the carrier is absent;
+    - environment: problems appear and clear without restriction.
+
+    Every controller action is a single persist, so a crash-restart is an
+    action prefix the BFS already enumerates (same argument as the repair
+    side of PoolMigrationModel). The checker proves every reachable
+    configuration can still reach settled — Healthy, no resize in flight,
+    back at the requested slice count, no ack residue — i.e. the shrink /
+    grow / abort / repair races cannot strand the notebook.
+    """
+
+    REQ = 3
+
+    def initial(self) -> EConfig:
+        return EConfig((None, None, self.REQ, None, False, None))
+
+    def settled(self, cfg: EConfig) -> bool:
+        el, ack, cur, _tgt, prob, health = cfg
+        return (el is None and ack is None and health is None and
+                not prob and cur == self.REQ)
+
+    def actions(self, cfg: EConfig) -> list:
+        el, ack, cur, tgt, prob, health = cfg
+        out = []
+
+        # ---- slicerepair controller
+        if el is None and ack != "Aborted" and prob and health is None \
+                and cur > 1:
+            # ONE persist covers both machines (the combined patch)
+            out.append(("shrink-start",
+                        cfg.replace(health="Degraded", el="Draining",
+                                    tgt=cur - 1, ack=None),
+                        [("slice-health", "Healthy", "Degraded"),
+                         ("elastic-resize", "Stable", "Draining")]))
+        if el is None and ack != "Aborted" and not prob \
+                and health is None and cur < self.REQ:
+            out.append(("grow-start",
+                        cfg.replace(el="Draining", tgt=cur + 1, ack=None),
+                        [("elastic-resize", "Stable", "Draining")]))
+        if el == "Draining" and ack == "Draining":
+            out.append(("advance-resharding",
+                        cfg.replace(el="Resharding"),
+                        [("elastic-resize", "Draining", "Resharding")]))
+        if el == "Resharding" and ack == "Resharding":
+            # the controller stamps current-slices at completion (single
+            # writer; the agent only acks)
+            out.append(("complete",
+                        cfg.replace(el=None, cur=tgt, tgt=None, ack=None),
+                        [("elastic-resize", "Resharding", "Stable")]))
+        if el is not None:
+            # handshake deadline blown at either phase
+            out.append(("abort",
+                        cfg.replace(el=None, tgt=None, ack="Aborted"),
+                        [("elastic-resize", el, "Stable")]))
+        if el is None and health == "Degraded" and prob:
+            out.append(("repair-start",
+                        cfg.replace(health="Repairing"),
+                        [("slice-health", "Degraded", "Repairing")]))
+        if el is None and health == "Repairing" and not prob:
+            out.append(("repaired",
+                        cfg.replace(health=None),
+                        [("slice-health", "Repairing", "Healthy")]))
+        if el is None and health == "Degraded" and not prob:
+            out.append(("transient-recover",
+                        cfg.replace(health=None),
+                        [("slice-health", "Degraded", "Healthy")]))
+
+        # ---- trainer-side agent
+        if el == "Draining" and ack != "Draining":
+            out.append(("drain-ack", cfg.replace(ack="Draining"), []))
+        if el == "Resharding" and ack != "Resharding" and tgt is not None:
+            out.append(("reshard-ack",
+                        cfg.replace(ack="Resharding"), []))
+        if el is None and ack == "Aborted":
+            out.append(("agent-clear-abort", cfg.replace(ack=None), []))
+
+        # ---- environment
+        if not prob:
+            out.append(("problem-appears", cfg.replace(prob=True), []))
+        else:
+            out.append(("problem-clears", cfg.replace(prob=False), []))
+        return out
+
+
 def _declared_edge(machines: dict, edge: tuple) -> bool:
     mname, src, dst = edge
     machine = machines.get(mname)
@@ -332,7 +456,9 @@ def explore(model: PoolMigrationModel, machines: dict) -> dict:
         "configs": len(seen),
         "transitions": transitions,
         "settled": len(settled),
-        "stuck": sorted(seen - can_settle),
+        # key=repr: config fields mix None/str/int, which tuple < cannot
+        # order directly
+        "stuck": sorted(seen - can_settle, key=repr),
         "deadlocks": deadlocks,
         "undeclared_edges": sorted(undeclared),
     }
@@ -357,10 +483,24 @@ def run(stats: bool = False) -> int:
     for edge in result["undeclared_edges"]:
         errs.append(f"composed migration×pool: model edge {edge!r} is "
                     f"not a declared transition")
+    e_result = explore(ElasticRepairModel(), machines)
+    for cfg in e_result["stuck"]:
+        errs.append(f"composed elastic×repair: reachable configuration "
+                    f"cannot settle (resize/repair race strands the "
+                    f"notebook): {cfg!r}")
+    for cfg in e_result["deadlocks"]:
+        errs.append(f"composed elastic×repair: unsettled deadlock: "
+                    f"{cfg!r}")
+    for edge in e_result["undeclared_edges"]:
+        errs.append(f"composed elastic×repair: model edge {edge!r} is "
+                    f"not a declared transition")
     if stats:
         print(f"machines: {len(machines)}; composed exploration: "
-              f"{result['configs']} configs, {result['transitions']} "
-              f"transitions, {result['settled']} settled")
+              f"migration×pool {result['configs']} configs, "
+              f"{result['transitions']} transitions, {result['settled']} "
+              f"settled; elastic×repair {e_result['configs']} configs, "
+              f"{e_result['transitions']} transitions, "
+              f"{e_result['settled']} settled")
     for err in errs:
         print(f"ci/protocol_check.py: [protocol-model] {err}")
     if errs:
@@ -369,8 +509,8 @@ def run(stats: bool = False) -> int:
         return 1
     total = sum(len(m.transitions) for m in machines.values())
     print(f"ci/protocol_check.py: {len(machines)} machine(s), {total} "
-          f"transition(s); composed model: {result['configs']} "
-          f"configuration(s) all converge")
+          f"transition(s); composed models: {result['configs']} + "
+          f"{e_result['configs']} configuration(s) all converge")
     return 0
 
 

@@ -45,10 +45,11 @@ import yaml
 EXPERIMENT_KIND = "ChaosExperiment"
 VALID_INJECTIONS = {"PodKill", "NetworkPartition", "WebhookDisrupt",
                     "RBACRevoke", "DeploymentScaleZero", "SliceWorkerKill",
-                    "NodePreemption", "PoolDrainPreemption"}
+                    "NodePreemption", "PoolDrainPreemption",
+                    "ElasticPreemption"}
 VALID_CHECK_TYPES = {"conditionTrue", "resourceExists", "httpGet",
                      "sliceAtomic", "notQuarantined", "notebookMigrated",
-                     "poolRewarmed"}
+                     "poolRewarmed", "elasticResized"}
 
 
 def _require(cond: bool, errors: list[str], msg: str) -> None:
@@ -210,6 +211,9 @@ class _MiniCluster:
         # set by the PoolDrainPreemption injection: (notebook, old bound
         # slice, identity, checkpointed step) the migrated check verifies
         self.expect_migrated_from: tuple | None = None
+        # set by the ElasticPreemption injection: the simulated
+        # trainer-side agent the elasticResized check reads
+        self.elastic_agent = None
         # server-side admission, where kube-apiserver runs it — remote
         # managers get mutated objects and denials over the wire
         NotebookMutatingWebhook(self.store, self.config).install(self.store)
@@ -455,6 +459,40 @@ class _MiniCluster:
                                f"checkpointed at {step}")
         return True, ""
 
+    def _check_elasticResized(self, check: dict):  # noqa: N802
+        """The elastic run shrank AND grew back without a restart: the
+        simulated agent saw ≥ 2 resizes, a monotone step counter and a
+        continuous loss curve (zero violations), the handshake machine is
+        back at Stable with current == requested slices, and virtual MFU
+        stayed at/above the floor (default 0.9 of static-mesh)."""
+        from ..utils import names as nk
+        from ..utils.k8s import get_annotation
+        agent = self.elastic_agent
+        if agent is None:
+            return True, ""  # armed by the injection; vacuous before it
+        if agent.violations:
+            return False, f"runtime violations: {agent.violations[:3]}"
+        if agent.resizes < 2:
+            return False, (f"expected a shrink AND a grow-back, saw "
+                           f"{agent.resizes} resize(s)")
+        nb = self.store.get_or_none(self.api.KIND, self.namespace,
+                                    self.notebooks[0])
+        if nb is None:
+            return False, "elastic notebook vanished"
+        if get_annotation(nb, nk.ELASTIC_RESIZE_ANNOTATION) is not None:
+            return False, "resize handshake still in flight"
+        requested = get_annotation(nb, nk.ELASTIC_SLICES_ANNOTATION)
+        current = get_annotation(nb, nk.ELASTIC_CURRENT_SLICES_ANNOTATION)
+        if requested != current:
+            return False, (f"current slices {current} != requested "
+                           f"{requested} — grow-back incomplete")
+        min_mfu = float(check.get("minMfu", 0.9))
+        if agent.mfu() < min_mfu:
+            return False, (f"virtual MFU {agent.mfu():.3f} below the "
+                           f"{min_mfu} floor ({agent.steps} steps, "
+                           f"{agent.resizes} resizes)")
+        return True, ""
+
     def _check_poolRewarmed(self, check: dict):  # noqa: N802
         """The pool holds warm (or actively re-warming) spare capacity —
         a consumed/drained slice was replaced, the pool did not bleed."""
@@ -466,7 +504,9 @@ class _MiniCluster:
         return True, ""
 
     def close(self) -> None:
-        for attr, method in (("mgr", "stop"), ("client", "close"),
+        # the agent thread first: it polls the store this teardown razes
+        for attr, method in (("elastic_agent", "stop"), ("mgr", "stop"),
+                             ("client", "close"),
                              ("proxy", "stop"), ("sim_mgr", "stop")):
             obj = getattr(self, attr, None)
             if obj is None:
@@ -508,7 +548,8 @@ def run_experiment(doc: dict, *, notebooks: int = 2,
     t0 = time.monotonic()
     failures: list[str] = []
     accelerator = ("v5e-16" if itype in ("SliceWorkerKill", "NodePreemption",
-                                         "PoolDrainPreemption")
+                                         "PoolDrainPreemption",
+                                         "ElasticPreemption")
                    else "v5e-4")
     audit = tempfile.NamedTemporaryFile(suffix=".ndjson", delete=False)
     audit.close()
@@ -690,6 +731,59 @@ def run_experiment(doc: dict, *, notebooks: int = 2,
                     time.sleep(0.05)
                 if not killed:
                     kill_node(cluster.store, node_name)
+        elif itype == "ElasticPreemption":
+            # preemption notice on one slice of an elastic multi-slice
+            # training run: the controller must SHRINK the run (drain →
+            # checkpoint → drop a slice) instead of stopping it, repair
+            # the slice, then grow back — step counter monotone, loss
+            # continuous, handshake machine back at Stable throughout.
+            from ..runtime.elastic import SimulatedElasticAgent
+            from ..utils import names as nk
+            from .kubelet import kill_node, preempt_node
+            nb0 = cluster.notebooks[0]
+            slices = int(params.get("slices", 3))
+            cluster.store.patch(cluster.api.KIND, cluster.namespace, nb0, {
+                "metadata": {"annotations": {
+                    nk.ELASTIC_ANNOTATION: "true",
+                    nk.ELASTIC_SLICES_ANNOTATION: str(slices),
+                    nk.ELASTIC_CURRENT_SLICES_ANNOTATION: str(slices),
+                }}})
+            cluster.elastic_agent = SimulatedElasticAgent(
+                cluster.store, cluster.namespace, nb0,
+                current_slices=slices).start()
+            # let the virtual run bank productive steps before the blip,
+            # as a real run would have
+            cluster.wait(lambda: cluster.elastic_agent.steps >= 20,
+                         timeout=30.0)
+            ordinal = int(params.get("ordinal", 0))
+            victim = f"{nb0}-{ordinal}"
+            pod = cluster.store.get_or_none("Pod", cluster.namespace,
+                                            victim)
+            node_name = (pod.get("spec") or {}).get("nodeName") if pod \
+                else None
+            if not node_name:
+                failures.append(f"worker {victim} has no node binding — "
+                                f"kubelet node lifecycle not active")
+            else:
+                preempt_node(cluster.store, node_name)
+                # the notice alone must drive the shrink handshake to
+                # completion BEFORE the node actually dies
+                if not cluster.wait(
+                        lambda: cluster.elastic_agent.current
+                        == slices - 1, timeout=recovery):
+                    failures.append(
+                        f"shrink to {slices - 1} slice(s) never completed "
+                        f"after the preemption notice")
+                kill_node(cluster.store, node_name)
+                # slice atomicity is sampled while the repair rolls
+                deadline = time.monotonic() + duration
+                while time.monotonic() < deadline:
+                    atomic = cluster.run_checks([{"type": "sliceAtomic"}])
+                    if atomic:
+                        failures += [f"during-preemption {f}"
+                                     for f in atomic]
+                        break
+                    time.sleep(0.05)
         elif itype == "SliceWorkerKill":
             ordinal = int(params.get("ordinal", 1))
             victim = f"{cluster.notebooks[0]}-{ordinal}"

@@ -56,6 +56,7 @@ CONTRACT = {
         "VirtualService": ["create", "patch"],
     },
     "annotations": [
+        "ELASTIC_ANNOTATIONS",
         "MIGRATION_STATE_ANNOTATION", "NOTEBOOK_NAME_LABEL", "POD_INDEX_LABEL",
         "POOL_ANNOTATIONS", "POOL_BIND_MISS_ANNOTATION",
         "POOL_BIND_PENDING_ANNOTATION", "REPAIR_SCALE_DOWN_ANNOTATION",
@@ -464,11 +465,12 @@ class NotebookReconciler:
                 continue  # slice identity lives in labels/env, not pod annotations
             if key in names.SLICE_REPAIR_ANNOTATIONS or \
                     key in names.POOL_ANNOTATIONS or \
+                    key in names.ELASTIC_ANNOTATIONS or \
                     key == names.TRACE_CONTEXT_ANNOTATION:
-                # repair/pool/trace bookkeeping would churn the pod template
-                # (every health or bind transition a spurious template
-                # drift → rolling restart) — it describes the slice's
-                # lifecycle, not the pods
+                # repair/pool/elastic/trace bookkeeping would churn the pod
+                # template (every health, bind, or resize-handshake
+                # transition a spurious template drift → rolling restart)
+                # — it describes the slice's lifecycle, not the pods
                 continue
             out[key] = val
         return out

@@ -68,6 +68,10 @@ CONTRACT = {
     "annotations": [
         "BOUND_NAMESPACE_LABEL", "BOUND_POOL_ANNOTATION",
         "BOUND_SLICE_ANNOTATION", "CHECKPOINT_TOKEN_ANNOTATION",
+        "ELASTIC_ACK_ANNOTATION", "ELASTIC_ANNOTATION",
+        "ELASTIC_CURRENT_SLICES_ANNOTATION", "ELASTIC_RESIZE_ANNOTATION",
+        "ELASTIC_RESIZE_STARTED_AT_ANNOTATION", "ELASTIC_SLICES_ANNOTATION",
+        "ELASTIC_TARGET_ANNOTATION",
         "MIGRATION_STARTED_AT_ANNOTATION", "MIGRATION_STATE_ANNOTATION",
         "NOTEBOOK_NAME_LABEL", "POOL_BIND_MISS_ANNOTATION",
         "QUARANTINE_ANNOTATION", "REPAIR_FAILURES_ANNOTATION",
@@ -188,12 +192,70 @@ PROTOCOL = [
                     "preemption must never lose the notebook"},
         ],
     },
+    {
+        "machine": "elastic-resize",
+        "doc": "Elastic shrink/grow handshake with the trainer-side agent "
+               "(runtime/elastic.py): the controller never releases a "
+               "slice the runtime has not confirmed it drained off, and "
+               "never counts a resize done before the runtime resharded. "
+               "Each controller advance waits on the agent echoing the "
+               "carrier state into the ack annotation.",
+        "owner": "slicerepair",
+        "carrier": {"object": "Notebook",
+                    "annotation": "ELASTIC_RESIZE_ANNOTATION"},
+        "fresh_reads": "echo-tracking",
+        "states": {"Stable": None, "Draining": "Draining",
+                   "Resharding": "Resharding"},
+        "initial": "Stable",
+        "terminal": ["Stable"],
+        "aux": {
+            "ELASTIC_TARGET_ANNOTATION":
+                "slice count this cycle resizes to",
+            "ELASTIC_CURRENT_SLICES_ANNOTATION":
+                "controller-written slice count, stamped at cycle "
+                "completion so the pre-resize count stays readable for "
+                "the whole handshake",
+            "ELASTIC_RESIZE_STARTED_AT_ANNOTATION":
+                "handshake timeout clock (dead-agent bound)",
+            "ELASTIC_ACK_ANNOTATION":
+                "agent-written echo of the carrier; the controller only "
+                "clears it or latches 'Aborted' on timeout — a latch only "
+                "a LIVE agent clears, so a dead agent cannot re-trigger "
+                "an endless resize loop",
+        },
+        "transitions": [
+            {"from": "Stable", "to": "Draining",
+             "trigger": "elastic-resize-needed",
+             "effects": ["event:ElasticResizeStarted"],
+             "effects_idempotent": True},
+            {"from": "Draining", "to": "Resharding",
+             "trigger": "runtime-drained",
+             "doc": "agent acked Draining: queue drained, checkpoint "
+                    "durable — the slice may now be released"},
+            {"from": "Resharding", "to": "Stable",
+             "trigger": "runtime-resharded",
+             "effects": ["event:ElasticResized"],
+             "effects_idempotent": True},
+            {"from": ["Draining", "Resharding"], "to": "Stable",
+             "trigger": "resize-timeout-or-agent-dead",
+             "effects": ["event:ElasticResizeAborted"],
+             "effects_idempotent": True,
+             "doc": "no ack within elastic_resize_timeout_s: latch the "
+                    "Aborted ack and fall back to the plain repair roll"},
+        ],
+    },
 ]
 
 
 MIGRATION_CHECKPOINTING = "Checkpointing"
 MIGRATION_BINDING = "Binding"
 MIGRATION_RESUMING = "Resuming"
+
+# elastic-resize machine states (carrier absent = Stable) and the ack
+# latch value the controller stamps when the agent goes dark
+ELASTIC_DRAINING = "Draining"
+ELASTIC_RESHARDING = "Resharding"
+ELASTIC_ABORTED = "Aborted"
 
 log = logging.getLogger("kubeflow_tpu.slicerepair")
 
@@ -235,6 +297,20 @@ def slice_health(notebook: dict) -> str | None:
     "Degraded" / "Repairing" / "Quarantined", or None = healthy. The
     culler consults this to pause the idle clock mid-repair."""
     return k8s.get_annotation(notebook, names.SLICE_HEALTH_ANNOTATION)
+
+
+def elastic_resize_state(notebook: dict) -> str | None:
+    """Current elastic-resize handshake state (annotation-carried):
+    "Draining" / "Resharding", or None = Stable (no resize in flight)."""
+    return k8s.get_annotation(notebook, names.ELASTIC_RESIZE_ANNOTATION)
+
+
+def _int_annotation(notebook: dict, anno: str, default: int) -> int:
+    raw = k8s.get_annotation(notebook, anno)
+    try:
+        return max(1, int(raw)) if raw is not None else default
+    except (TypeError, ValueError):
+        return default
 
 
 class SliceRepairReconciler:
@@ -290,6 +366,10 @@ class SliceRepairReconciler:
             "notebook_migrations_total",
             "Checkpoint-based notebook migrations between pool slices, by "
             "outcome (success / fallback).")
+        self.elastic_resizes_total = self.metrics.counter(
+            "elastic_resizes_total",
+            "Elastic resize handshake outcomes, by namespace and outcome "
+            "(shrink / grow / abort).")
         self.metrics.on_scrape(self._scrape_health)
 
     # ------------------------------------------------------------- wiring
@@ -448,6 +528,15 @@ class SliceRepairReconciler:
             if replaced:
                 problems = [replaced]
 
+        # elastic notebooks: a preemption notice shrinks the hybrid mesh
+        # (checkpoint → drop a slice → keep training) instead of stopping
+        # the run; the handshake machine owns the notebook while a resize
+        # is in flight. Falls through (None) when there is nothing elastic
+        # to do — the plain repair ladder below then proceeds as ever.
+        eres = self._reconcile_elastic(notebook, problems, state, key)
+        if eres is not None:
+            return eres
+
         if state == REPAIRING:
             return self._continue_repair(notebook, slice_spec, problems,
                                          pods, key)
@@ -488,6 +577,153 @@ class SliceRepairReconciler:
             self.recorder.eventf(notebook, events.TYPE_NORMAL,
                                  "SliceRecovered",
                                  "slice healthy again without repair")
+            # echo-filtered watches won't re-deliver our own patch: an
+            # elastic notebook below its requested slice count needs an
+            # explicit requeue to start the grow-back cycle
+            return self._elastic_followup(notebook)
+        return None
+
+    # ------------------------------------------------------------ elastic
+    def _reconcile_elastic(self, notebook: dict, problems: list,
+                           state: str | None,
+                           key: tuple[str, str]) -> Result | None:
+        """Drive the elastic-resize handshake:
+
+            Stable ──(preemption notice / capacity freed)──▶ Draining
+                   ──(agent ack: drained + durable save)──▶ Resharding
+                   ──(agent ack: resharded, new slice count)──▶ Stable
+
+        Shrink and grow run the SAME cycle — only the target differs.
+        Every controller advance is gated on the trainer-side agent
+        echoing the carrier state into the ack annotation; an agent that
+        stays silent past ``elastic_resize_timeout_s`` aborts the cycle
+        with the ``Aborted`` ack latch (only a live agent clears it), and
+        the plain repair ladder takes the notebook from there.
+
+        Returns None when the elastic path has nothing to do — the caller
+        falls through to the ordinary repair logic."""
+        elastic = elastic_resize_state(notebook)
+        if k8s.get_annotation(notebook, names.ELASTIC_ANNOTATION) is None \
+                and elastic is None:
+            return None  # not an elastic notebook, nothing in flight
+        poll = Result(requeue_after=self.config.slice_repair_poll_s)
+        now = self.clock()
+        requested = _int_annotation(notebook,
+                                    names.ELASTIC_SLICES_ANNOTATION, 1)
+        current = _int_annotation(
+            notebook, names.ELASTIC_CURRENT_SLICES_ANNOTATION, requested)
+        ack = k8s.get_annotation(notebook, names.ELASTIC_ACK_ANNOTATION)
+
+        if elastic is not None:
+            started_raw = k8s.get_annotation(
+                notebook, names.ELASTIC_RESIZE_STARTED_AT_ANNOTATION)
+            try:
+                started = float(started_raw) if started_raw else now
+            except (TypeError, ValueError):
+                started = now
+            if now - started > self.config.elastic_resize_timeout_s:
+                # dead agent: abort the cycle and LATCH the ack, so the
+                # shrink/grow gates below stay closed until a live agent
+                # clears it — without the latch an agentless notebook
+                # would re-enter Draining forever
+                self._patch(notebook, {
+                    names.ELASTIC_RESIZE_ANNOTATION: None,
+                    names.ELASTIC_TARGET_ANNOTATION: None,
+                    names.ELASTIC_RESIZE_STARTED_AT_ANNOTATION: None,
+                    names.ELASTIC_ACK_ANNOTATION: ELASTIC_ABORTED,
+                })
+                self.elastic_resizes_total.inc(
+                    {"namespace": key[0], "outcome": "abort"})
+                self.recorder.eventf(
+                    notebook, events.TYPE_WARNING, "ElasticResizeAborted",
+                    f"trainer agent did not ack within "
+                    f"{self.config.elastic_resize_timeout_s:.0f}s; "
+                    f"falling back to the repair roll")
+                return Result(requeue_after=0)
+            if elastic == ELASTIC_DRAINING and ack == ELASTIC_DRAINING:
+                # runtime drained + checkpoint durable: the slice may go
+                self._patch(notebook, {
+                    names.ELASTIC_RESIZE_ANNOTATION: ELASTIC_RESHARDING,
+                })
+                return poll
+            if elastic == ELASTIC_RESHARDING and ack == ELASTIC_RESHARDING:
+                target = _int_annotation(
+                    notebook, names.ELASTIC_TARGET_ANNOTATION, current)
+                outcome = "shrink" if target < current else "grow"
+                # the controller is the single writer of current-slices:
+                # stamping it HERE (not agent-side with the ack) keeps the
+                # pre-resize count readable until the cycle completes —
+                # which is also what makes the outcome label above correct
+                self._patch(notebook, {
+                    names.ELASTIC_CURRENT_SLICES_ANNOTATION: str(target),
+                    names.ELASTIC_RESIZE_ANNOTATION: None,
+                    names.ELASTIC_TARGET_ANNOTATION: None,
+                    names.ELASTIC_RESIZE_STARTED_AT_ANNOTATION: None,
+                    names.ELASTIC_ACK_ANNOTATION: None,
+                })
+                self.elastic_resizes_total.inc(
+                    {"namespace": key[0], "outcome": outcome})
+                self.recorder.eventf(
+                    notebook, events.TYPE_NORMAL, "ElasticResized",
+                    f"runtime resharded onto {target} slice(s) "
+                    f"({outcome}); training continued without restart")
+                return Result(requeue_after=0)
+            return poll  # waiting on the agent's ack
+
+        if problems and state is None and current > 1 \
+                and ack != ELASTIC_ABORTED:
+            # shrink instead of stopping: Degraded and Draining persist in
+            # ONE patch — a crash between two separate patches would leave
+            # a Degraded notebook whose repair ladder races the elastic
+            # cycle we intended. Both events follow the persist.
+            reason, detail = problems[0]
+            self._patch(notebook, {
+                names.SLICE_HEALTH_ANNOTATION: DEGRADED,
+                names.SLICE_HEALTH_REASON_ANNOTATION: reason,
+                names.ELASTIC_RESIZE_ANNOTATION: ELASTIC_DRAINING,
+                names.ELASTIC_TARGET_ANNOTATION: str(current - 1),
+                names.ELASTIC_RESIZE_STARTED_AT_ANNOTATION: "%.3f" % now,
+                names.ELASTIC_ACK_ANNOTATION: None,
+            })
+            self.recorder.eventf(
+                notebook, events.TYPE_WARNING, "SliceDegraded",
+                f"slice degraded ({reason}): {detail}")
+            self.recorder.eventf(
+                notebook, events.TYPE_NORMAL, "ElasticResizeStarted",
+                f"shrinking {current} → {current - 1} slice(s) instead of "
+                f"stopping ({reason})")
+            return poll
+
+        if not problems and state is None and current < requested \
+                and ack != ELASTIC_ABORTED:
+            # grow back: repair completed (or capacity freed) while the
+            # run holds fewer slices than requested
+            self._patch(notebook, {
+                names.ELASTIC_RESIZE_ANNOTATION: ELASTIC_DRAINING,
+                names.ELASTIC_TARGET_ANNOTATION: str(current + 1),
+                names.ELASTIC_RESIZE_STARTED_AT_ANNOTATION: "%.3f" % now,
+                names.ELASTIC_ACK_ANNOTATION: None,
+            })
+            self.recorder.eventf(
+                notebook, events.TYPE_NORMAL, "ElasticResizeStarted",
+                f"growing {current} → {current + 1} slice(s) after "
+                f"repair")
+            return poll
+        return None
+
+    def _elastic_followup(self, notebook: dict) -> Result | None:
+        """After a repair/recovery leaves the slice Healthy: requeue
+        immediately if an elastic notebook still holds fewer slices than
+        requested, so the grow-back cycle starts without waiting for an
+        external event (our own patches are echo-filtered)."""
+        if k8s.get_annotation(notebook, names.ELASTIC_ANNOTATION) is None:
+            return None
+        requested = _int_annotation(notebook,
+                                    names.ELASTIC_SLICES_ANNOTATION, 1)
+        current = _int_annotation(
+            notebook, names.ELASTIC_CURRENT_SLICES_ANNOTATION, requested)
+        if current < requested:
+            return Result(requeue_after=0)
         return None
 
     # ---------------------------------------------------------- migration
@@ -822,7 +1058,9 @@ class SliceRepairReconciler:
                 notebook, events.TYPE_NORMAL, "SliceRepaired",
                 f"all {slice_spec.num_workers} workers ready again "
                 f"after {duration:.1f}s")
-            return None
+            # an elastic notebook that shrank during the outage grows
+            # back now that the slice is whole again
+            return self._elastic_followup(notebook)
         return poll
 
     def _repair_failed(self, notebook: dict, key: tuple[str, str],

@@ -1,0 +1,141 @@
+"""Regex partition rules: tree-path patterns → PartitionSpecs for ANY pytree.
+
+The logical-axis rules in parallel/sharding.py need every model family to
+hand-write a spec tree (param_logical_specs / moe_param_logical_specs) and
+every optimizer wrapper to mirror it (opt_state_shardings). The elastic
+trainer cannot afford that coupling: on every shrink/grow it must re-shard
+whatever pytree the user trains — params, optax state, bf16 master copies —
+onto a mesh it just rebuilt. This module is the EasyLM-style alternative:
+an ordered list of ``(regex, PartitionSpec)`` rules matched (``re.search``,
+first match wins) against the '/'-joined tree path of each leaf, so one
+rule table shards the param tree AND any optimizer state embedding it (an
+adamw ``mu/blocks/wq`` path ends with the same suffix as the param's
+``blocks/wq``). Scalars and size-1 leaves replicate unconditionally.
+
+``TRANSFORMER_RULES`` / ``MOE_RULES`` reproduce the hand specs exactly —
+tests/test_partition_rules.py pins the equivalence against
+param_logical_specs on stock configs — and the per-family split exists
+because one table cannot serve both: dense ``w_gate`` is
+(layers, embed, mlp) where MoE ``w_gate`` is (layers, experts, embed, mlp).
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.tree_util import (DictKey, FlattenedIndexKey, GetAttrKey,
+                           SequenceKey, tree_flatten_with_path,
+                           tree_unflatten)
+
+# Megatron TP + ZeRO-3 FSDP, matching DEFAULT_RULES in sharding.py:
+#   column-parallel weights shard their output dim on tp, row-parallel
+#   their input dim on tp, the other big dim on fsdp; norms/head_dim/
+#   layers replicate; the embedding table puts vocab on tp.
+TRANSFORMER_RULES: tuple[tuple[str, P], ...] = (
+    (r"w[qkv]$", P(None, "fsdp", "tp", None)),
+    (r"wo$", P(None, "tp", None, "fsdp")),
+    (r"(w_gate|w_up)$", P(None, "fsdp", "tp")),
+    (r"w_down$", P(None, "tp", "fsdp")),
+    (r"(attn_norm|mlp_norm)$", P(None, None)),
+    (r"final_norm$", P(None)),
+    (r"lm_head$", P("fsdp", "tp")),
+    (r"embed$", P("tp", "fsdp")),
+)
+
+# MoE: expert MLPs gain a leading experts axis (→ ep); the router projects
+# embed → n_experts. Attention/embedding/norm rules are shared with dense.
+MOE_RULES: tuple[tuple[str, P], ...] = (
+    (r"w[qkv]$", P(None, "fsdp", "tp", None)),
+    (r"wo$", P(None, "tp", None, "fsdp")),
+    (r"router$", P(None, "fsdp", "ep")),
+    (r"(w_gate|w_up)$", P(None, "ep", "fsdp", "tp")),
+    (r"w_down$", P(None, "ep", "tp", "fsdp")),
+    (r"(attn_norm|mlp_norm)$", P(None, None)),
+    (r"final_norm$", P(None)),
+    (r"lm_head$", P("fsdp", "tp")),
+    (r"embed$", P("tp", "fsdp")),
+)
+
+
+def rules_for(config) -> tuple[tuple[str, P], ...]:
+    """Rule table for a model config (MoEConfig subclasses dense)."""
+    from ..models.moe import MoEConfig
+    return MOE_RULES if isinstance(config, MoEConfig) else TRANSFORMER_RULES
+
+
+def _key_str(key) -> str:
+    if isinstance(key, DictKey):
+        return str(key.key)
+    if isinstance(key, GetAttrKey):
+        return key.name
+    if isinstance(key, SequenceKey):
+        return str(key.idx)
+    if isinstance(key, FlattenedIndexKey):
+        return str(key.key)
+    return str(key)
+
+
+def tree_path_of(path) -> str:
+    """'/'-joined name of one leaf's key path: ('blocks','wq') → 'blocks/wq',
+    and an optimizer path like (0, 'mu', 'blocks', 'wq') →
+    '0/mu/blocks/wq' — the suffix the rules anchor on."""
+    return "/".join(_key_str(k) for k in path)
+
+
+def _leaf_dims(leaf) -> tuple[int, int]:
+    """(ndim, size) for arrays AND abstract leaves (ShapeDtypeStruct)."""
+    shape = tuple(getattr(leaf, "shape", ()))
+    return len(shape), int(np.prod(shape)) if shape else 1
+
+
+def match_partition_rules(rules, tree):
+    """Pytree of PartitionSpecs, same structure as ``tree``. Scalars and
+    size-1 leaves get P() (replicated — sharding a singleton buys nothing
+    and a rule written for the full-size tensor would over-constrain it);
+    every other leaf must match a rule or the call raises, because a
+    silently-replicated large tensor is exactly the OOM a partition-rule
+    engine exists to prevent."""
+    leaves, treedef = tree_flatten_with_path(tree)
+    specs = []
+    for path, leaf in leaves:
+        ndim, size = _leaf_dims(leaf)
+        if ndim == 0 or size == 1:
+            specs.append(P())
+            continue
+        name = tree_path_of(path)
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                specs.append(spec)
+                break
+        else:
+            raise ValueError(f"no partition rule matches leaf {name!r} "
+                             f"(shape {tuple(leaf.shape)})")
+    return tree_unflatten(treedef, specs)
+
+
+def named_shardings(mesh: Mesh, spec_tree):
+    """PartitionSpec tree → NamedSharding tree on ``mesh``."""
+    import jax
+    return jax.tree.map(lambda s: NamedSharding(mesh, s), spec_tree,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def make_shard_and_gather_fns(mesh: Mesh, spec_tree):
+    """Per-leaf (shard_fns, gather_fns) trees: ``shard`` lays a host/
+    replicated leaf out on ``mesh`` per its rule spec, ``gather`` pulls it
+    back fully replicated — both jitted identities whose out_shardings do
+    the data movement (XLA inserts the collectives)."""
+    import jax
+
+    def shard_fn(spec):
+        return jax.jit(lambda x: x,
+                       out_shardings=NamedSharding(mesh, spec))
+
+    def gather_fn(spec):
+        return jax.jit(lambda x: x, out_shardings=NamedSharding(mesh, P()))
+
+    is_spec = lambda x: isinstance(x, P)  # noqa: E731
+    return (jax.tree.map(shard_fn, spec_tree, is_leaf=is_spec),
+            jax.tree.map(gather_fn, spec_tree, is_leaf=is_spec))

@@ -1,0 +1,334 @@
+"""Elastic training: shrink on preemption, grow back on repair — no restart.
+
+Two halves, one handshake:
+
+``ElasticTrainer`` (runtime) wraps :class:`~..runtime.trainer.Trainer` on a
+``build_hybrid_mesh`` whose ``dp`` axis spans slices. ``resize(n)`` is the
+Podracer move — drain the async dispatch queue, force an orbax save, rebuild
+the mesh with the new slice count, and let the trainer's cross-mesh restore
+path (regex partition rules → restore targets on the NEW mesh) re-shard
+params/opt-state. The step counter and loss curve continue; the only cost is
+the drain+save+restore blip.
+
+The controller side (controllers/slicerepair.py) drives WHEN to resize via
+the ``tpu.kubeflow.org/elastic-resize`` annotation machine
+(Stable → Draining → Resharding → Stable). The trainer-side agent here
+answers it: ack Draining once the queue is drained and the checkpoint
+durable, perform the resize when the controller advances to Resharding, ack
+again, and the controller completes the cycle — the slice is never released
+before the runtime has confirmed it no longer needs it.
+
+``SimulatedElasticAgent`` is the chaos-tier stand-in: same protocol thread,
+but productive work is a virtual step counter with a deterministic loss
+curve, so the elastic-preemption experiment can assert step monotonicity,
+loss continuity, and an MFU floor without real devices or wall-clock flake.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import jax
+
+from ..parallel.mesh import MeshConfig, build_hybrid_mesh
+from ..utils import k8s, names
+from .trainer import Trainer
+
+log = logging.getLogger("kubeflow_tpu.elastic")
+
+# states the runtime agent writes into ELASTIC_ACK_ANNOTATION (the echo of
+# the controller's carrier states, plus the dead-agent latch the controller
+# stamps on abort and only a LIVE agent clears)
+ACK_DRAINING = "Draining"
+ACK_RESHARDING = "Resharding"
+ACK_ABORTED = "Aborted"
+
+# virtual-tick cost of one resize in the simulated agent's MFU accounting:
+# a drain + forced save + cross-mesh restore is worth about this many lost
+# productive steps at chaos scale (deterministic — no wall-clock)
+ELASTIC_BLIP_STEPS = 2
+
+
+class ElasticTrainer:
+    """A Trainer that can change its slice count mid-run.
+
+    ``per_slice`` is the intra-slice mesh (fsdp/tp/... over ICI);
+    ``n_slices`` multiplies ``dp`` across slices (DCN). ``checkpoint_dir``
+    is mandatory — resize IS checkpoint-mediated, there is nothing elastic
+    about a trainer that cannot save.
+
+    ``resize`` rebuilds the inner Trainer; construction re-inits params on
+    the new mesh and immediately overwrites them from the checkpoint (the
+    same resume path a culled slice takes), so correctness never depends on
+    in-memory state surviving the mesh swap.
+    """
+
+    def __init__(self, per_slice: MeshConfig, n_slices: int, config,
+                 train_config=None, checkpoint_dir=None, *, devices=None,
+                 **trainer_kwargs):
+        if checkpoint_dir is None:
+            raise ValueError("ElasticTrainer requires checkpoint_dir: "
+                             "resize is checkpoint-mediated")
+        self.per_slice = per_slice
+        self.config = config
+        self.train_config = train_config
+        self.checkpoint_dir = checkpoint_dir
+        self._devices = list(devices) if devices is not None \
+            else list(jax.devices())
+        self._kwargs = dict(trainer_kwargs)
+        self.n_slices = n_slices
+        # (old_n, new_n, step, seconds) per completed resize
+        self.resize_events: list = []
+        self.trainer = self._build(n_slices)
+
+    def _build(self, n_slices: int) -> Trainer:
+        devs = self._devices[: n_slices * self.per_slice.size]
+        mesh, _full = build_hybrid_mesh(n_slices, self.per_slice,
+                                        devices=devs)
+        return Trainer(mesh, self.config, self.train_config,
+                       self.checkpoint_dir, partition_rules="auto",
+                       **self._kwargs)
+
+    # ------------------------------------------------------------- resize
+    def resize(self, n_slices: int) -> None:
+        """Drain → save → rebuild mesh → cross-mesh restore → keep going."""
+        if n_slices == self.n_slices:
+            return
+        if n_slices < 1:
+            raise ValueError(f"n_slices must be >= 1, got {n_slices}")
+        if n_slices * self.per_slice.size > len(self._devices):
+            raise ValueError(
+                f"{n_slices} slices × {self.per_slice.size} devices/slice "
+                f"exceed the {len(self._devices)} available devices")
+        t0 = time.perf_counter()
+        old = self.trainer
+        # drain the async dispatch queue: every in-flight step must land
+        # before the snapshot, or the checkpoint would be mid-step
+        jax.block_until_ready((old.params, old.opt_state))
+        old.save(force=True)
+        old_stats = old.stats
+        old.close()
+        self.trainer = self._build(n_slices)
+        st = self.trainer.stats
+        if st.step != old_stats.step:
+            raise RuntimeError(
+                f"elastic restore landed on step {st.step}, expected "
+                f"{old_stats.step} — checkpoint continuity broken")
+        # history/counters live host-side; carry them across the rebuild
+        st.losses.extend(old_stats.losses)
+        st.evals.extend(old_stats.evals)
+        st.tokens_seen = old_stats.tokens_seen
+        st.last_loss = old_stats.last_loss
+        dt = time.perf_counter() - t0
+        self.resize_events.append((self.n_slices, n_slices, st.step, dt))
+        log.info("elastic resize %d → %d slices at step %d (%.2fs)",
+                 self.n_slices, n_slices, st.step, dt)
+        self.n_slices = n_slices
+
+    def shrink(self) -> None:
+        self.resize(self.n_slices - 1)
+
+    def grow(self) -> None:
+        self.resize(self.n_slices + 1)
+
+    # ---------------------------------------------------------- delegates
+    @property
+    def mesh(self):
+        return self.trainer.mesh
+
+    @property
+    def params(self):
+        return self.trainer.params
+
+    @property
+    def opt_state(self):
+        return self.trainer.opt_state
+
+    @property
+    def stats(self):
+        return self.trainer.stats
+
+    def fit(self, source, **kw):
+        return self.trainer.fit(source, **kw)
+
+    def evaluate(self, source, **kw):
+        return self.trainer.evaluate(source, **kw)
+
+    def save(self, **kw):
+        return self.trainer.save(**kw)
+
+    def close(self) -> None:
+        self.trainer.close()
+
+    def __enter__(self) -> "ElasticTrainer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class _ElasticAgentBase:
+    """The runtime half of the elastic-resize handshake.
+
+    Polls the Notebook's elastic-resize carrier and answers it:
+
+    - ``Draining``    → :meth:`_on_drain` (stop stepping, durable save),
+                        then ack ``Draining``;
+    - ``Resharding``  → :meth:`_on_reshard` (rebuild onto the target slice
+                        count), then ack ``Resharding`` (the controller
+                        stamps the new current-slices count when it
+                        completes the cycle);
+    - absent (Stable) → :meth:`_on_tick` (productive work), and clear the
+                        ``Aborted`` dead-agent latch if the controller left
+                        one — only a live agent may clear it, which is
+                        exactly what clearing it proves.
+
+    Acks are idempotent (state-compared before writing) so a poll racing a
+    controller patch never double-writes.
+    """
+
+    def __init__(self, client, namespace: str, name: str, *,
+                 poll_s: float = 0.02):
+        self.client = client
+        self.namespace = namespace
+        self.name = name
+        self.poll_s = poll_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # hooks -------------------------------------------------------------
+    def _on_drain(self) -> None:
+        raise NotImplementedError
+
+    def _on_reshard(self, target: int) -> None:
+        raise NotImplementedError
+
+    def _on_tick(self) -> None:
+        raise NotImplementedError
+
+    # wire --------------------------------------------------------------
+    def _patch(self, annotations: dict) -> None:
+        self.client.patch("Notebook", self.namespace, self.name,
+                          {"metadata": {"annotations": annotations}})
+
+    def poll_once(self) -> None:
+        """One handshake turn. Drive this from a thread (:meth:`start`) or
+        synchronously between fit() chunks when the resize work must run on
+        the caller's thread (real JAX resizes are not thread-safe against a
+        concurrently stepping loop)."""
+        nb = self.client.get("Notebook", self.namespace, self.name)
+        state = k8s.get_annotation(nb, names.ELASTIC_RESIZE_ANNOTATION)
+        ack = k8s.get_annotation(nb, names.ELASTIC_ACK_ANNOTATION)
+        if state == ACK_DRAINING:
+            if ack != ACK_DRAINING:
+                self._on_drain()
+                self._patch({names.ELASTIC_ACK_ANNOTATION: ACK_DRAINING})
+        elif state == ACK_RESHARDING:
+            if ack != ACK_RESHARDING:
+                target = k8s.get_annotation(
+                    nb, names.ELASTIC_TARGET_ANNOTATION)
+                if target is not None:
+                    self._on_reshard(int(target))
+                    # the ack is the agent's ONLY annotation: the
+                    # controller stamps current-slices itself when it
+                    # completes the cycle (single writer, and the
+                    # pre-resize count stays readable until then)
+                    self._patch({
+                        names.ELASTIC_ACK_ANNOTATION: ACK_RESHARDING,
+                    })
+        else:
+            if ack == ACK_ABORTED:
+                self._patch({names.ELASTIC_ACK_ANNOTATION: None})
+            self._on_tick()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 — agent must outlive races
+                log.debug("elastic agent poll failed", exc_info=True)
+            self._stop.wait(self.poll_s)
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"elastic-agent-{self.name}")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+class ElasticAgent(_ElasticAgentBase):
+    """Handshake agent bound to a real :class:`ElasticTrainer`. Drive it
+    with :meth:`poll_once` between fit() chunks — the resize must run on
+    the training thread."""
+
+    def __init__(self, trainer: ElasticTrainer, client, namespace: str,
+                 name: str, **kw):
+        super().__init__(client, namespace, name, **kw)
+        self.trainer = trainer
+
+    def _on_drain(self) -> None:
+        t = self.trainer.trainer
+        jax.block_until_ready((t.params, t.opt_state))
+        t.save(force=True)
+
+    def _on_reshard(self, target: int) -> None:
+        self.trainer.resize(target)
+
+    def _on_tick(self) -> None:
+        pass
+
+
+class SimulatedElasticAgent(_ElasticAgentBase):
+    """Protocol-faithful agent with virtual training: each Stable-state
+    poll is one productive step on a deterministic loss curve; each resize
+    costs :data:`ELASTIC_BLIP_STEPS` virtual steps of MFU. Chaos checks
+    read ``steps``/``resizes``/``current``/``violations``/``mfu()``."""
+
+    def __init__(self, client, namespace: str, name: str, *,
+                 poll_s: float = 0.02, current_slices: int | None = None):
+        super().__init__(client, namespace, name, poll_s=poll_s)
+        self.steps = 0
+        self.resizes = 0
+        self.current = current_slices
+        self.losses: list = []
+        self.violations: list = []
+
+    def _loss_at(self, step: int) -> float:
+        # smooth monotone-decreasing curve: per-step delta < 0.02, so a
+        # step-counter reset (loss jumping back toward 2.0) is detectable
+        # while honest resumption is continuous by construction
+        return 2.0 / (1.0 + 0.01 * step)
+
+    def _on_drain(self) -> None:
+        pass
+
+    def _on_reshard(self, target: int) -> None:
+        self.current = target
+        self.resizes += 1
+
+    def _on_tick(self) -> None:
+        self.steps += 1
+        loss = self._loss_at(self.steps)
+        if self.losses:
+            last_step, last_loss = self.losses[-1]
+            if self.steps <= last_step:
+                self.violations.append(
+                    f"step counter reset: {last_step} → {self.steps}")
+            if abs(loss - last_loss) > 0.05:
+                self.violations.append(
+                    f"loss discontinuity at step {self.steps}: "
+                    f"{last_loss:.4f} → {loss:.4f}")
+        self.losses.append((self.steps, loss))
+
+    def mfu(self) -> float:
+        """Fraction of virtual ticks spent stepping vs. resize blips,
+        relative to a static mesh (which spends every tick stepping)."""
+        blip = ELASTIC_BLIP_STEPS * self.resizes
+        return self.steps / (self.steps + blip) if self.steps else 0.0

@@ -43,6 +43,22 @@ class MigrationError(RuntimeError):
     cold-roll path instead of retrying blindly."""
 
 
+# Token schema version, embedded as "v" by both drivers. During a rolling
+# deploy an OLD manager can pick up a Resuming-phase notebook whose token a
+# NEW manager wrote; a version it does not know means fields it cannot
+# half-read (e.g. future elastic-resize metadata) — fail the migration
+# (MigrationError → cold-roll fallback) instead of resuming on a guess.
+TOKEN_VERSION = 1
+
+
+def _check_token_version(meta: dict, token: str) -> None:
+    v = meta.get("v", TOKEN_VERSION)  # pre-versioning tokens are v1 shaped
+    if v != TOKEN_VERSION:
+        raise MigrationError(
+            f"checkpoint token version {v!r} not supported "
+            f"(this manager speaks v{TOKEN_VERSION}): {token!r}")
+
+
 class SimulatedMigrationDriver:
     """Annotation-carried checkpoint/resume for the in-process cluster.
 
@@ -59,12 +75,14 @@ class SimulatedMigrationDriver:
         except ValueError as exc:
             raise MigrationError(
                 f"unparseable runtime step {step_raw!r}") from exc
-        return json.dumps({"step": step})
+        return json.dumps({"v": TOKEN_VERSION, "step": step})
 
     def resume(self, client, notebook: dict, token: str) -> None:
         try:
-            step = int(json.loads(token)["step"])
-        except (ValueError, KeyError, TypeError) as exc:
+            meta = json.loads(token)
+            _check_token_version(meta, token)
+            step = int(meta["step"])
+        except (ValueError, KeyError, TypeError, AttributeError) as exc:
             raise MigrationError(f"bad checkpoint token {token!r}") from exc
         client.patch(k8s.kind(notebook), k8s.namespace(notebook),
                      k8s.name(notebook), {"metadata": {"annotations": {
@@ -94,14 +112,16 @@ class CheckpointMigrationDriver:
         with TrainCheckpointer(directory, async_save=False) as ckpt:
             if not ckpt.save(step, params, opt_state, force=True):
                 raise MigrationError(f"save at step {step} was skipped")
-        return json.dumps({"step": int(step), "directory": str(directory)})
+        return json.dumps({"v": TOKEN_VERSION, "step": int(step),
+                           "directory": str(directory)})
 
     def resume(self, client, notebook: dict, token: str):
         from .checkpoint import TrainCheckpointer
         try:
             meta = json.loads(token)
+            _check_token_version(meta, token)
             step, directory = int(meta["step"]), meta["directory"]
-        except (ValueError, KeyError, TypeError) as exc:
+        except (ValueError, KeyError, TypeError, AttributeError) as exc:
             raise MigrationError(f"bad checkpoint token {token!r}") from exc
         abstract_params, abstract_opt = self.abstract_provider(notebook)
         with TrainCheckpointer(directory) as ckpt:

@@ -22,6 +22,7 @@ from __future__ import annotations
 import itertools
 import logging
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 import jax
@@ -47,8 +48,12 @@ class TrainerStats:
     steps_per_sec: float = 0.0
     tokens_per_sec: float = 0.0
     model_tflops_per_sec: float = 0.0
-    losses: list = field(default_factory=list)  # (step, loss) at log points
-    evals: list = field(default_factory=list)   # (step, eval loss)
+    # (step, loss) / (step, eval loss) at log points — bounded: a
+    # week-long elastic run hits log points forever, and an unbounded
+    # list is a slow host-memory leak (Trainer's stats_history_cap
+    # overrides the maxlen)
+    losses: deque = field(default_factory=lambda: deque(maxlen=1000))
+    evals: deque = field(default_factory=lambda: deque(maxlen=1000))
 
 
 class Trainer:
@@ -64,11 +69,21 @@ class Trainer:
                  checkpoint_dir=None, *, checkpoint_interval: int = 100,
                  max_checkpoints: int = 3, seed: int = 0,
                  profile_dir=None, profile_steps: tuple = (10, 15),
-                 lora=None, base_params=None):
+                 lora=None, base_params=None, partition_rules=None,
+                 stats_history_cap: int = 1000):
         self.mesh = mesh
         self.config = config
         self.tc = train_config or train_lib.TrainConfig()
         self.is_moe = isinstance(config, MoEConfig)
+        # regex partition rules (parallel/partition_rules.py): when set,
+        # restore targets are matched from the rules instead of the
+        # per-model hand specs, so a checkpoint reshards onto whatever
+        # mesh this trainer holds — the elastic resize path. "auto"
+        # selects the family table from the config type.
+        if partition_rules == "auto":
+            from ..parallel.partition_rules import rules_for
+            partition_rules = rules_for(config)
+        self.partition_rules = partition_rules
         # LoRA finetune mode: self.params are the ADAPTERS (tiny), the
         # frozen base rides every step as a non-donated input; the
         # checkpoint/resume/eval machinery below sees adapters where it
@@ -97,7 +112,9 @@ class Trainer:
         else:
             self.init_fn, self.step_fn = train_lib.make_sharded_train_step(
                 mesh, config, tc=self.tc)
-        self.stats = TrainerStats()
+        self.stats = TrainerStats(
+            losses=deque(maxlen=stats_history_cap),
+            evals=deque(maxlen=stats_history_cap))
         self.checkpointer = None
         if checkpoint_dir is not None:
             self.checkpointer = TrainCheckpointer(
@@ -129,6 +146,21 @@ class Trainer:
                 lp_sh, NamedSharding(self.mesh, P()))
             return (abstract_state(self.params, lp_sh),
                     abstract_state(self.opt_state, opt_sh))
+        if self.partition_rules is not None:
+            # rules engine: one table shards params AND the optimizer
+            # state embedding them (suffix match), so no hand-written
+            # opt_state mirror — whatever the step function's state
+            # pytree looks like (optax, MasterOptState, ...), matching
+            # THIS trainer's live trees yields restore targets with the
+            # right structure by construction
+            from ..parallel.partition_rules import (match_partition_rules,
+                                                    named_shardings)
+            p_sh = named_shardings(self.mesh, match_partition_rules(
+                self.partition_rules, self.params))
+            o_sh = named_shardings(self.mesh, match_partition_rules(
+                self.partition_rules, self.opt_state))
+            return (abstract_state(self.params, p_sh),
+                    abstract_state(self.opt_state, o_sh))
         if self.is_moe:
             specs = moe_model.moe_param_logical_specs(self.config)
             init = lambda k: moe_model.init_moe_params(k, self.config)  # noqa: E731

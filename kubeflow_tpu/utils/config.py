@@ -92,6 +92,10 @@ class ControllerConfig:
     # safety-net requeue while a repair phase waits on pod churn (the state
     # machine is otherwise event-driven off the Pod/Node watches)
     slice_repair_poll_s: float = 0.25
+    # elastic resize: bound on the Draining/Resharding handshake with the
+    # trainer-side agent; past it the resize aborts (dead-agent latch) and
+    # the notebook falls back to the plain repair roll
+    elastic_resize_timeout_s: float = 30.0
     # warm slice pools (controllers/slicepool.py): pre-rolled slices a
     # notebook BINDS instead of cold-rolling a StatefulSet
     enable_slice_pool: bool = True
@@ -156,6 +160,8 @@ class ControllerConfig:
                 env.get("SLICE_REPAIR_WINDOW", "900")),
             slice_repair_poll_s=float(
                 env.get("SLICE_REPAIR_POLL", "0.25")),
+            elastic_resize_timeout_s=float(
+                env.get("ELASTIC_RESIZE_TIMEOUT", "30")),
             enable_slice_pool=_env_bool("ENABLE_SLICE_POOL", True),
             pool_namespace=env.get("SLICE_POOL_NAMESPACE",
                                    "tpu-slice-pools"),

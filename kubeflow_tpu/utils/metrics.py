@@ -56,6 +56,7 @@ METRIC_FAMILY_CATALOG = frozenset({
     "slice_quarantines_total",
     "slice_degraded",
     "notebook_migrations_total",
+    "elastic_resizes_total",
     # serving
     "serving_http_requests_total",
     "serving_generate_seconds_sum",
@@ -96,6 +97,7 @@ METRIC_FAMILY_LABELS = {
     "cache_full_scans_total": ("kind",),
     "cache_index_lookups_total": ("index", "kind"),
     "controller_runtime_reconcile_total": ("controller", "result"),
+    "elastic_resizes_total": ("namespace", "outcome"),
     "last_notebook_culling_timestamp_seconds": (),
     "notebook_create_failed_total": (),
     "notebook_create_total": (),

@@ -155,6 +155,42 @@ POOL_ANNOTATIONS = frozenset({
     RESUMED_STEP_ANNOTATION,
 })
 
+# --- elastic training (controllers/slicerepair.py + runtime/elastic.py) ---
+# opt-in marker: the notebook runs an ElasticTrainer that can shrink/grow
+# its hybrid mesh by whole slices — a preemption notice drains and
+# reshards instead of rolling the full slice set
+ELASTIC_ANNOTATION = "tpu.kubeflow.org/elastic"
+# requested slice count (user intent, stable) and the slice count the
+# runtime currently holds (agent-written after every reshard)
+ELASTIC_SLICES_ANNOTATION = "tpu.kubeflow.org/elastic-slices"
+ELASTIC_CURRENT_SLICES_ANNOTATION = "tpu.kubeflow.org/elastic-current-slices"
+# elastic-resize state machine carrier, owned by the repair controller:
+# "Draining" → "Resharding"; absent = Stable. Persisted BEFORE the
+# matching event, so a controller crash resumes the handshake.
+ELASTIC_RESIZE_ANNOTATION = "tpu.kubeflow.org/elastic-resize"
+# slice count this resize is heading to, stamped with the Draining persist
+ELASTIC_TARGET_ANNOTATION = "tpu.kubeflow.org/elastic-target"
+# trainer-side agent's acknowledgement of the carrier state ("Draining" /
+# "Resharding"); the controller only advances the machine after the ack,
+# so the slice is never released under an undrained dispatch queue.
+# "Aborted" is the controller's dead-agent latch: a timed-out resize
+# parks here and only a LIVE agent clears it — a dead agent degrades the
+# notebook to the plain repair roll instead of a retry storm.
+ELASTIC_ACK_ANNOTATION = "tpu.kubeflow.org/elastic-ack"
+# resize timeout clock (epoch seconds), same shape as REPAIR_STARTED_AT
+ELASTIC_RESIZE_STARTED_AT_ANNOTATION = \
+    "tpu.kubeflow.org/elastic-resize-started-at"
+# elastic bookkeeping churns on every resize handshake step — it must
+# never reach the StatefulSet template (same rationale as
+# SLICE_REPAIR_ANNOTATIONS: template drift → spurious rolling restart,
+# here MID-RESIZE)
+ELASTIC_ANNOTATIONS = frozenset({
+    ELASTIC_ANNOTATION, ELASTIC_SLICES_ANNOTATION,
+    ELASTIC_CURRENT_SLICES_ANNOTATION, ELASTIC_RESIZE_ANNOTATION,
+    ELASTIC_TARGET_ANNOTATION, ELASTIC_ACK_ANNOTATION,
+    ELASTIC_RESIZE_STARTED_AT_ANNOTATION,
+})
+
 # W3C traceparent of the notebook's lifecycle trace, stamped on the
 # Notebook by its reconciler only while a recording tracing provider is
 # installed (utils/tracing.py): the cross-controller trace carrier —

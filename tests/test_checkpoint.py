@@ -83,6 +83,57 @@ def test_cross_mesh_restore_reshards(tmp_path):
         jax.device_get(params), jax.device_get(r_params))
 
 
+@pytest.mark.parametrize("new_cfg", [MeshConfig(dp=3, fsdp=2),
+                                     MeshConfig(dp=5, fsdp=1)],
+                         ids=["dp4-to-dp3", "dp4-to-dp5"])
+def test_elastic_cross_dp_restore_bitwise(tmp_path, new_cfg):
+    """The elastic resize path: a dp=4 checkpoint restores onto dp=3 and
+    dp=5 meshes (fewer AND more data shards, device count not a divisor
+    of the old one) with bitwise-identical params and the step counter
+    intact — restore targets come from the regex partition rules, exactly
+    as ElasticTrainer builds them."""
+    from kubeflow_tpu.parallel.partition_rules import (TRANSFORMER_RULES,
+                                                       match_partition_rules,
+                                                       named_shardings)
+
+    mesh = build_mesh(MeshConfig(dp=4, fsdp=2), devices=jax.devices()[:8])
+    init_fn, step_fn = make_sharded_train_step(
+        mesh, tiny_config(), tc=TrainConfig(warmup_steps=1))
+    params, opt_state = init_fn(jax.random.key(0))
+    tokens = jax.random.randint(jax.random.key(1), (8, 16), 0, 128)
+    targets = jnp.roll(tokens, -1, axis=1)
+    params, opt_state, _ = step_fn(params, opt_state, tokens, targets)
+    want = jax.device_get(params)
+
+    with TrainCheckpointer(tmp_path / "ckpt") as ckpt:
+        assert ckpt.save(3, params, opt_state)
+        ckpt.wait()
+        new_mesh = build_mesh(new_cfg, devices=jax.devices()[:new_cfg.size])
+        p_sh = named_shardings(new_mesh, match_partition_rules(
+            TRANSFORMER_RULES, params))
+        o_sh = named_shardings(new_mesh, match_partition_rules(
+            TRANSFORMER_RULES, opt_state))
+        restored = ckpt.restore(abstract_state(params, p_sh),
+                                abstract_state(opt_state, o_sh))
+    assert restored is not None
+    step, r_params, r_opt = restored
+    assert step == 3, "step continuity broken across the mesh swap"
+    wq = r_params["blocks"]["wq"]
+    assert wq.sharding.mesh.shape["dp"] == new_cfg.dp
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), want, jax.device_get(r_params))
+
+    # and training continues on the new mesh: one step runs and syncs
+    init2, step2 = make_sharded_train_step(
+        build_mesh(new_cfg, devices=jax.devices()[:new_cfg.size]),
+        tiny_config(), tc=TrainConfig(warmup_steps=1))
+    batch = 2 * new_cfg.dp * new_cfg.fsdp
+    tokens2 = jax.random.randint(jax.random.key(2), (batch, 16), 0, 128)
+    _, _, loss = step2(r_params, r_opt, tokens2,
+                       jnp.roll(tokens2, -1, axis=1))
+    assert np.isfinite(float(loss))
+
+
 def test_retention_and_interval(tmp_path):
     _, params, opt_state, _ = make_state(MeshConfig.auto(8, tp=2))
     with TrainCheckpointer(tmp_path / "ckpt", max_to_keep=2,
@@ -213,3 +264,34 @@ def test_simulated_migration_driver_step_continuity():
                               names.RESUMED_STEP_ANNOTATION) == "123"
     with pytest.raises(MigrationError):
         driver.resume(store, nb, "not-json")
+
+
+def test_migration_token_versioning():
+    """Tokens carry a version; an unknown version is rejected loudly
+    (mixed-version manager fleets must not silently misparse a future
+    token shape) while a pre-versioning token — no 'v' field — still
+    resumes as v1."""
+    import json
+
+    from kubeflow_tpu.cluster.store import ClusterStore
+    from kubeflow_tpu.runtime.migrate import (TOKEN_VERSION, MigrationError,
+                                              SimulatedMigrationDriver)
+    from kubeflow_tpu.api import types as api
+    from kubeflow_tpu.utils import k8s, names
+
+    store = ClusterStore()
+    store.create(api.new_notebook("ver-nb", "ns", annotations={
+        names.RUNTIME_STEP_ANNOTATION: "42"}))
+    nb = store.get(api.KIND, "ns", "ver-nb")
+    driver = SimulatedMigrationDriver()
+    meta = json.loads(driver.checkpoint(store, nb))
+    assert meta["v"] == TOKEN_VERSION
+
+    future = dict(meta, v=TOKEN_VERSION + 1)
+    with pytest.raises(MigrationError, match="version"):
+        driver.resume(store, nb, json.dumps(future))
+
+    legacy = {k: v for k, v in meta.items() if k != "v"}
+    driver.resume(store, nb, json.dumps(legacy))
+    assert k8s.get_annotation(store.get(api.KIND, "ns", "ver-nb"),
+                              names.RESUMED_STEP_ANNOTATION) == "42"

@@ -1,0 +1,209 @@
+"""Elastic trainer (runtime/elastic.py): shrink/grow mid-run without a
+restart — step counter monotone, params bitwise-identical across the
+mesh swap — plus the runtime half of the annotation handshake (the
+controller half lives in test_slice_repair.py)."""
+
+import jax
+import numpy as np
+import pytest
+
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.models.train import TrainConfig
+from kubeflow_tpu.models.transformer import TransformerConfig
+from kubeflow_tpu.parallel.mesh import MeshConfig
+from kubeflow_tpu.runtime.data import synthetic_lm_batches
+from kubeflow_tpu.runtime.elastic import (ElasticTrainer,
+                                          SimulatedElasticAgent)
+from kubeflow_tpu.utils import k8s, names
+
+NS = "elastic-ns"
+
+
+def tiny_config():
+    return TransformerConfig(vocab_size=128, d_model=32, n_layers=2,
+                             n_heads=4, n_kv_heads=4, d_ff=48,
+                             dtype="float32", max_seq_len=64)
+
+
+def batches(n, seed=3):
+    # batch 12 divides every data extent the test visits:
+    # dp×fsdp = 6 (3 slices), 4 (2 slices), 6 again after grow-back
+    return list(synthetic_lm_batches(12, 16, 128, n_batches=n, seed=seed))
+
+
+def tree_equal(a, b):
+    jax.tree.map(lambda x, y: np.testing.assert_array_equal(
+        np.asarray(x), np.asarray(y)), a, b)
+
+
+# --------------------------------------------------------- resize cycle
+def test_shrink_grow_continuity(tmp_path):
+    """3 → 2 → 3 slices mid-run: every resize preserves the step counter
+    and the exact parameter bytes; training continues on each mesh."""
+    per = MeshConfig(dp=1, fsdp=2)
+    with ElasticTrainer(per, 3, tiny_config(),
+                        TrainConfig(warmup_steps=1),
+                        tmp_path / "ckpt",
+                        devices=jax.devices()[:8]) as et:
+        assert et.mesh.shape["dp"] == 3 and et.mesh.shape["fsdp"] == 2
+        et.fit(batches(4), steps=4, log_every=2)
+        assert et.stats.step == 4
+        before = jax.device_get(et.params)
+
+        et.shrink()
+        assert et.n_slices == 2 and et.mesh.shape["dp"] == 2
+        assert et.stats.step == 4, "resize must not move the step counter"
+        tree_equal(before, jax.device_get(et.params))
+
+        et.fit(batches(3, seed=5), steps=3, log_every=1)
+        assert et.stats.step == 7
+        at7 = jax.device_get(et.params)
+
+        et.grow()
+        assert et.n_slices == 3 and et.mesh.shape["dp"] == 3
+        assert et.stats.step == 7
+        tree_equal(at7, jax.device_get(et.params))
+
+        et.fit(batches(3, seed=7), steps=3, log_every=1)
+        assert et.stats.step == 10
+        assert [(a, b, s) for a, b, s, _ in et.resize_events] == \
+            [(3, 2, 4), (2, 3, 7)]
+        # loss history carried across both rebuilds, steps monotone
+        steps = [s for s, _ in et.stats.losses]
+        assert steps == sorted(steps) and len(steps) >= 5
+
+
+def test_resize_noop_and_bounds(tmp_path):
+    per = MeshConfig(dp=1, fsdp=2)
+    with ElasticTrainer(per, 2, tiny_config(),
+                        TrainConfig(warmup_steps=1),
+                        tmp_path / "ckpt",
+                        devices=jax.devices()[:8]) as et:
+        et.resize(2)  # no-op, no checkpoint roundtrip
+        assert et.resize_events == []
+        with pytest.raises(ValueError, match=">= 1"):
+            et.resize(0)
+        with pytest.raises(ValueError, match="exceed"):
+            et.resize(5)  # 5 × 2 devices > 8 available
+
+
+def test_checkpoint_dir_is_mandatory():
+    with pytest.raises(ValueError, match="checkpoint_dir"):
+        ElasticTrainer(MeshConfig(fsdp=2), 2, tiny_config())
+
+
+# ----------------------------------------------------- handshake agent
+def elastic_notebook(store, current="3"):
+    store.create(api.new_notebook("nb", NS, annotations={
+        names.ELASTIC_ANNOTATION: "true",
+        names.ELASTIC_SLICES_ANNOTATION: "3",
+        names.ELASTIC_CURRENT_SLICES_ANNOTATION: current,
+    }))
+    return store.get(api.KIND, NS, "nb")
+
+
+def anno(store, name):
+    return k8s.get_annotation(store.get(api.KIND, NS, "nb"), name)
+
+
+def set_anno(store, annotations):
+    store.patch(api.KIND, NS, "nb", {"metadata": {
+        "annotations": annotations}})
+
+
+def test_agent_acks_drain_then_reshards():
+    """Synchronous poll_once walk through one shrink cycle: the agent
+    echoes Draining, performs the reshard only at Resharding, and acks —
+    the controller stamps the new current-slices count at completion."""
+    store = ClusterStore()
+    elastic_notebook(store)
+    agent = SimulatedElasticAgent(store, NS, "nb", current_slices=3)
+
+    agent.poll_once()                       # Stable: productive step
+    assert agent.steps == 1 and agent.resizes == 0
+
+    set_anno(store, {names.ELASTIC_RESIZE_ANNOTATION: "Draining",
+                     names.ELASTIC_TARGET_ANNOTATION: "2"})
+    agent.poll_once()
+    assert anno(store, names.ELASTIC_ACK_ANNOTATION) == "Draining"
+    assert agent.resizes == 0, "must not reshard before the controller " \
+        "advances the carrier"
+    agent.poll_once()                       # idempotent: no double-ack work
+    assert agent.resizes == 0
+
+    set_anno(store, {names.ELASTIC_RESIZE_ANNOTATION: "Resharding"})
+    agent.poll_once()
+    assert agent.resizes == 1 and agent.current == 2
+    assert anno(store, names.ELASTIC_ACK_ANNOTATION) == "Resharding"
+    # the ack is the agent's only annotation: current-slices is
+    # controller-written at cycle completion, so the pre-resize count
+    # is still readable here
+    assert anno(store, names.ELASTIC_CURRENT_SLICES_ANNOTATION) == "3"
+
+    set_anno(store, {names.ELASTIC_RESIZE_ANNOTATION: None,
+                     names.ELASTIC_ACK_ANNOTATION: None})
+    agent.poll_once()                       # back to productive stepping
+    assert agent.steps == 2 and agent.violations == []
+
+
+def test_agent_clears_aborted_latch():
+    """Only a live agent clears the controller's Aborted latch — clearing
+    it IS the liveness proof that re-opens the shrink/grow gates."""
+    store = ClusterStore()
+    elastic_notebook(store)
+    set_anno(store, {names.ELASTIC_ACK_ANNOTATION: "Aborted"})
+    agent = SimulatedElasticAgent(store, NS, "nb", current_slices=3)
+    agent.poll_once()
+    assert anno(store, names.ELASTIC_ACK_ANNOTATION) is None
+    assert agent.steps == 1
+
+
+def test_simulated_agent_detects_restart():
+    """The chaos checks rest on the agent actually catching a restart:
+    a step-counter reset must register as a violation."""
+    store = ClusterStore()
+    elastic_notebook(store)
+    agent = SimulatedElasticAgent(store, NS, "nb", current_slices=3)
+    for _ in range(10):
+        agent.poll_once()
+    assert agent.violations == []
+    agent.steps = 0                          # simulate a restart
+    agent.poll_once()
+    assert any("reset" in v for v in agent.violations)
+    assert any("discontinuity" in v for v in agent.violations)
+
+
+def test_real_agent_drives_trainer_resize(tmp_path):
+    """ElasticAgent bound to a real ElasticTrainer: poll_once between fit
+    chunks performs the drain (forced save) and the reshard (mesh swap)
+    on the calling thread, exactly as a training loop would drive it."""
+    from kubeflow_tpu.runtime.elastic import ElasticAgent
+
+    store = ClusterStore()
+    elastic_notebook(store, current="2")
+    per = MeshConfig(dp=1, fsdp=2)
+    with ElasticTrainer(per, 2, tiny_config(),
+                        TrainConfig(warmup_steps=1),
+                        tmp_path / "ckpt",
+                        devices=jax.devices()[:8]) as et:
+        agent = ElasticAgent(et, store, NS, "nb")
+        et.fit(batches(2), steps=2, log_every=1)
+
+        set_anno(store, {names.ELASTIC_RESIZE_ANNOTATION: "Draining",
+                         names.ELASTIC_TARGET_ANNOTATION: "1"})
+        agent.poll_once()                    # drain: forced durable save
+        assert anno(store, names.ELASTIC_ACK_ANNOTATION) == "Draining"
+        assert et.n_slices == 2, "reshard must wait for the controller"
+
+        set_anno(store, {names.ELASTIC_RESIZE_ANNOTATION: "Resharding"})
+        agent.poll_once()                    # reshard onto 1 slice
+        assert anno(store, names.ELASTIC_ACK_ANNOTATION) == "Resharding"
+        assert et.n_slices == 1 and et.mesh.shape["dp"] == 1
+        assert et.stats.step == 2
+
+        set_anno(store, {names.ELASTIC_RESIZE_ANNOTATION: None,
+                         names.ELASTIC_ACK_ANNOTATION: None})
+        agent.poll_once()                    # Stable: back to training
+        et.fit(batches(2, seed=9), steps=2, log_every=1)
+        assert et.stats.step == 4

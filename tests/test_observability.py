@@ -941,6 +941,7 @@ METRIC_FAMILY_CATALOG = {
     "cache_full_scans_total",
     "cache_index_lookups_total",
     "controller_runtime_reconcile_total",
+    "elastic_resizes_total",
     "last_notebook_culling_timestamp_seconds",
     "notebook_create_failed_total",
     "notebook_create_total",
@@ -1131,7 +1132,8 @@ def test_workqueue_and_client_families_exported_via_manager():
     serving_generate_seconds_sum, serving_http_requests_total,
     notebook_create_failed_total, notebook_culling_total,
     notebook_running, last_notebook_culling_timestamp_seconds,
-    notebook_migrations_total, sanitizer_violations_total.)"""
+    notebook_migrations_total, sanitizer_violations_total,
+    elastic_resizes_total.)"""
     store = ClusterStore()
     metrics = MetricsRegistry()
     mgr = Manager(store)

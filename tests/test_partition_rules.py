@@ -1,0 +1,165 @@
+"""Regex partition rules (parallel/partition_rules.py): the rule tables
+must reproduce the hand-written logical-axis specs exactly — for params
+AND optimizer state, both model families — plus scalar replication, the
+no-match guard, and the shard/gather roundtrip."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.models.moe import (MoEConfig, init_moe_params,
+                                     moe_param_logical_specs)
+from kubeflow_tpu.models.train import (MasterOptState, TrainConfig,
+                                       make_optimizer, opt_state_shardings)
+from kubeflow_tpu.models.transformer import (TransformerConfig, init_params,
+                                             param_logical_specs)
+from kubeflow_tpu.parallel.mesh import MeshConfig, build_mesh
+from kubeflow_tpu.parallel.partition_rules import (MOE_RULES,
+                                                   TRANSFORMER_RULES,
+                                                   make_shard_and_gather_fns,
+                                                   match_partition_rules,
+                                                   named_shardings,
+                                                   rules_for, tree_path_of)
+from kubeflow_tpu.parallel.sharding import param_shardings
+
+
+def dense_config():
+    return TransformerConfig(vocab_size=128, d_model=32, n_layers=2,
+                             n_heads=4, n_kv_heads=4, d_ff=48,
+                             dtype="float32", max_seq_len=64)
+
+
+def moe_config():
+    return MoEConfig(vocab_size=128, d_model=32, n_layers=2, n_heads=4,
+                     n_kv_heads=4, d_ff=48, dtype="float32", max_seq_len=64,
+                     n_experts=4, experts_per_token=2)
+
+
+def assert_shardings_match(got_tree, want_tree, shape_tree):
+    """Per-leaf NamedSharding equivalence at the leaf's rank (P(None,None)
+    vs P() etc. compare equal when they lay the array out identically)."""
+    got = jax.tree.leaves(got_tree)
+    want = jax.tree.leaves(want_tree)
+    from jax.tree_util import tree_flatten_with_path
+    leaves = tree_flatten_with_path(shape_tree)[0]
+    assert len(got) == len(want) == len(leaves)
+    for (path, leaf), g, w in zip(leaves, got, want):
+        assert g.is_equivalent_to(w, len(leaf.shape)), (
+            f"{tree_path_of(path)}: rules gave {g.spec}, "
+            f"hand spec gives {w.spec}")
+
+
+# ------------------------------------------------- rules ≡ hand specs
+@pytest.mark.parametrize("family", ["dense", "moe"])
+def test_rules_match_hand_param_specs(family):
+    if family == "dense":
+        cfg, rules = dense_config(), TRANSFORMER_RULES
+        init, specs = init_params, param_logical_specs(cfg)
+        mesh_cfg = MeshConfig(dp=2, fsdp=2, tp=2)
+    else:
+        cfg, rules = moe_config(), MOE_RULES
+        init, specs = init_moe_params, moe_param_logical_specs(cfg)
+        mesh_cfg = MeshConfig(fsdp=2, tp=2, ep=2)  # real ep axis
+    mesh = build_mesh(mesh_cfg, devices=jax.devices()[:mesh_cfg.size])
+    params = jax.eval_shape(lambda k: init(k, cfg), jax.random.key(0))
+    got = named_shardings(mesh, match_partition_rules(rules, params))
+    want = param_shardings(mesh, specs)
+    assert_shardings_match(got, want, params)
+
+
+@pytest.mark.parametrize("family", ["dense", "moe"])
+def test_rules_match_hand_opt_state_specs(family):
+    """One rule table shards the optimizer state too: an adamw mu/nu leaf's
+    path ends with the param path the rules anchor on, and scalars (the
+    optax step counter) replicate — byte-for-byte what the hand-written
+    opt_state_shardings suffix machinery produces."""
+    if family == "dense":
+        cfg, rules = dense_config(), TRANSFORMER_RULES
+        init, specs = init_params, param_logical_specs(cfg)
+    else:
+        cfg, rules = moe_config(), MOE_RULES
+        init, specs = init_moe_params, moe_param_logical_specs(cfg)
+    mesh = build_mesh(MeshConfig(fsdp=2, tp=2, ep=2),
+                      devices=jax.devices()[:8])
+    opt = make_optimizer(TrainConfig())
+    params = jax.eval_shape(lambda k: init(k, cfg), jax.random.key(0))
+    opt_shape = jax.eval_shape(opt.init, params)
+    got = named_shardings(mesh, match_partition_rules(rules, opt_shape))
+    p_sh = param_shardings(mesh, specs)
+    want = opt_state_shardings(opt, lambda k: init(k, cfg), p_sh,
+                               NamedSharding(mesh, P()))
+    assert_shardings_match(got, want, opt_shape)
+
+
+def test_rules_shard_master_opt_state():
+    """bf16 training wraps the optax state in MasterOptState(inner, master);
+    the f32 master copies are a params-shaped tree under a different prefix
+    and the suffix-anchored rules shard them like the params."""
+    cfg = dense_config()
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2),
+                      devices=jax.devices()[:8])
+    opt = make_optimizer(TrainConfig())
+    params = jax.eval_shape(lambda k: init_params(k, cfg), jax.random.key(0))
+    state = MasterOptState(inner=jax.eval_shape(opt.init, params),
+                           master=params)
+    got = named_shardings(mesh,
+                          match_partition_rules(TRANSFORMER_RULES, state))
+    p_sh = param_shardings(mesh, param_logical_specs(cfg))
+    want = MasterOptState(
+        inner=opt_state_shardings(opt, lambda k: init_params(k, cfg), p_sh,
+                                  NamedSharding(mesh, P())),
+        master=p_sh)
+    assert_shardings_match(got, want, state)
+
+
+def test_rules_for_selects_family_table():
+    assert rules_for(dense_config()) is TRANSFORMER_RULES
+    assert rules_for(moe_config()) is MOE_RULES
+
+
+# ----------------------------------------------------- engine semantics
+def test_scalars_and_singletons_replicate():
+    tree = {
+        "blocks": {"wq": jax.ShapeDtypeStruct((2, 32, 4, 8), np.float32)},
+        "count": jax.ShapeDtypeStruct((), np.int32),
+        "one": jax.ShapeDtypeStruct((1,), np.float32),
+    }
+    specs = match_partition_rules(TRANSFORMER_RULES, tree)
+    assert specs["blocks"]["wq"] == P(None, "fsdp", "tp", None)
+    assert specs["count"] == P()
+    assert specs["one"] == P()
+
+
+def test_unmatched_leaf_raises():
+    tree = {"blocks": {"mystery_weight": np.zeros((4, 4), np.float32)}}
+    with pytest.raises(ValueError, match="blocks/mystery_weight"):
+        match_partition_rules(TRANSFORMER_RULES, tree)
+
+
+def test_optimizer_path_suffix_matches():
+    """A leaf nested under optimizer-ish prefixes ('0/mu/blocks/wq') hits
+    the same rule as the bare param path — re.search anchors the suffix."""
+    tree = ((({"mu": {"blocks": {"wq": np.zeros((2, 32, 4, 8),
+                                               np.float32)}}},),),)
+    specs = match_partition_rules(TRANSFORMER_RULES, tree)
+    leaf_spec = jax.tree.leaves(
+        specs, is_leaf=lambda x: isinstance(x, P))[0]
+    assert leaf_spec == P(None, "fsdp", "tp", None)
+
+
+def test_shard_and_gather_roundtrip():
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2),
+                      devices=jax.devices()[:8])
+    tree = {"blocks": {"wq": np.arange(2 * 32 * 4 * 8, dtype=np.float32)
+                       .reshape(2, 32, 4, 8)}}
+    specs = match_partition_rules(TRANSFORMER_RULES, tree)
+    shard_fns, gather_fns = make_shard_and_gather_fns(mesh, specs)
+    sharded = jax.tree.map(lambda f, x: f(x), shard_fns, tree)
+    wq = sharded["blocks"]["wq"]
+    assert wq.sharding.is_equivalent_to(
+        NamedSharding(mesh, P(None, "fsdp", "tp", None)), 4)
+    gathered = jax.tree.map(lambda f, x: f(x), gather_fns, sharded)
+    assert gathered["blocks"]["wq"].sharding.is_fully_replicated
+    np.testing.assert_array_equal(np.asarray(gathered["blocks"]["wq"]),
+                                  tree["blocks"]["wq"])

@@ -475,3 +475,117 @@ def test_sim_preemption_notice_blocks_new_bindings_only(store):
     drain(mgr, include_delayed_under=0.1)
     pod = store.get("Pod", NS, k8s.name(pod))
     assert pod["spec"]["nodeName"] != node
+
+
+# ------------------------------------------------------ elastic resize path
+
+def create_elastic(w, slices=3):
+    w.store.create(api.new_notebook("nb", NS, annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16",
+        names.ELASTIC_ANNOTATION: "true",
+        names.ELASTIC_SLICES_ANNOTATION: str(slices),
+        names.ELASTIC_CURRENT_SLICES_ANNOTATION: str(slices),
+    }))
+
+
+def eanno(w, which):
+    return k8s.get_annotation(w.notebook(), which)
+
+
+def test_elastic_shrink_then_grow_back(world):
+    """The full elastic cycle against the live controller: a preemption
+    notice shrinks the run 3 → 2 through the ack-gated handshake instead
+    of stopping it, the repair ladder rolls the slice, and on repair
+    completion the controller grows the run back to 3 — the agent sees a
+    monotone step counter and a continuous loss curve throughout."""
+    from kubeflow_tpu.runtime.elastic import SimulatedElasticAgent
+
+    create_elastic(world)
+    world.wait_ready()
+    agent = SimulatedElasticAgent(world.store, NS, "nb",
+                                  current_slices=3).start()
+    try:
+        preempt_node(world.store, world.pods()[0]["spec"]["nodeName"])
+        assert world.wait(lambda: agent.current == 2), \
+            "shrink handshake never completed"
+        assert world.wait(lambda: agent.current == 3, timeout=15), \
+            "grow-back never completed after repair"
+        assert world.wait(
+            lambda: world.slice_ready() and world.health() is None and
+            eanno(world, names.ELASTIC_RESIZE_ANNOTATION) is None)
+        assert agent.violations == []
+        assert agent.resizes == 2
+        counter = world.metrics.counter("elastic_resizes_total", "")
+        assert counter.get({"namespace": NS, "outcome": "shrink"}) >= 1
+        assert counter.get({"namespace": NS, "outcome": "grow"}) >= 1
+        reasons = {e["reason"] for e in world.store.list("Event", NS)}
+        assert {"ElasticResizeStarted", "ElasticResized",
+                "SliceDegraded"} <= reasons
+    finally:
+        agent.stop()
+
+
+def test_elastic_controller_gates_on_agent_ack(world):
+    """The slice is never released before the runtime confirms the drain:
+    the carrier holds at Draining until the agent acks, advances to
+    Resharding only then, and completes only on the reshard ack."""
+    create_elastic(world)
+    world.wait_ready()
+    preempt_node(world.store, world.pods()[0]["spec"]["nodeName"])
+    assert world.wait(lambda: eanno(
+        world, names.ELASTIC_RESIZE_ANNOTATION) == "Draining")
+    assert eanno(world, names.ELASTIC_TARGET_ANNOTATION) == "2"
+    time.sleep(0.2)  # many controller poll periods, no ack written
+    assert eanno(world, names.ELASTIC_RESIZE_ANNOTATION) == "Draining"
+
+    world.store.patch(api.KIND, NS, "nb", {"metadata": {"annotations": {
+        names.ELASTIC_ACK_ANNOTATION: "Draining"}}})
+    assert world.wait(lambda: eanno(
+        world, names.ELASTIC_RESIZE_ANNOTATION) == "Resharding")
+
+    world.store.patch(api.KIND, NS, "nb", {"metadata": {"annotations": {
+        names.ELASTIC_ACK_ANNOTATION: "Resharding"}}})
+    assert world.wait(lambda: world.metrics.counter(
+        "elastic_resizes_total", "").get(
+            {"namespace": NS, "outcome": "shrink"}) >= 1)
+    # the controller stamped the new slice count when it completed
+    assert eanno(world, names.ELASTIC_CURRENT_SLICES_ANNOTATION) == "2"
+
+
+def test_elastic_abort_latches_when_agent_is_dead(store):
+    """No agent ever acks: the cycle aborts after the timeout, the
+    Aborted latch keeps the shrink/grow gates closed (no Draining
+    re-entry loop), and the ordinary repair ladder recovers the slice."""
+    w = RepairWorld(store, config=fast_config(elastic_resize_timeout_s=0.25))
+    try:
+        create_elastic(w)
+        w.wait_ready()
+        preempt_node(w.store, w.pods()[0]["spec"]["nodeName"])
+        assert w.wait(lambda: eanno(
+            w, names.ELASTIC_ACK_ANNOTATION) == "Aborted" and
+            eanno(w, names.ELASTIC_RESIZE_ANNOTATION) is None), \
+            "abort never latched"
+        assert w.metrics.counter("elastic_resizes_total", "").get(
+            {"namespace": NS, "outcome": "abort"}) >= 1
+        assert w.wait(lambda: w.slice_ready() and w.health() is None), \
+            "repair ladder never recovered the slice after the abort"
+        # latch holds: no new cycle, slice count never moved
+        assert eanno(w, names.ELASTIC_RESIZE_ANNOTATION) is None
+        assert eanno(w, names.ELASTIC_ACK_ANNOTATION) == "Aborted"
+        assert eanno(w, names.ELASTIC_CURRENT_SLICES_ANNOTATION) == "3"
+        reasons = {e["reason"] for e in w.store.list("Event", NS)}
+        assert "ElasticResizeAborted" in reasons
+    finally:
+        w.stop()
+
+
+def test_non_elastic_notebook_skips_the_elastic_path(world):
+    """Without the elastic opt-in annotation a preemption runs the plain
+    repair ladder — no handshake fields appear, no resize counter."""
+    world.create()
+    world.wait_ready()
+    preempt_node(world.store, world.pods()[0]["spec"]["nodeName"])
+    assert world.wait(
+        lambda: world.slice_ready() and world.health() is None)
+    assert eanno(world, names.ELASTIC_RESIZE_ANNOTATION) is None
+    assert world.metrics.counter("elastic_resizes_total", "").total() == 0

@@ -197,7 +197,7 @@ def test_evaluate_reports_heldout_perplexity():
         tr.fit(train, steps=30, log_every=30)
         r1 = tr.evaluate(heldout)
         assert r1["loss"] < r0["loss"]
-        assert tr.stats.evals == [(0, r0["loss"]), (30, r1["loss"])]
+        assert list(tr.stats.evals) == [(0, r0["loss"]), (30, r1["loss"])]
 
 
 def test_evaluate_moe_excludes_aux_from_perplexity():
@@ -368,3 +368,17 @@ def test_evaluate_on_sequence_parallel_mesh():
         r_sp = tr_sp.evaluate(heldout)
         r_flat = tr_flat.evaluate(heldout)
     assert np.isclose(r_sp["loss"], r_flat["loss"], rtol=1e-4)
+
+
+def test_stats_history_is_bounded():
+    """A long-running (elastic) trainer hits log points forever: the loss
+    and eval histories are deques capped by stats_history_cap, not an
+    unbounded host-memory leak."""
+    with Trainer(mesh8(), tiny_config(), TrainConfig(warmup_steps=1),
+                 stats_history_cap=3) as tr:
+        assert tr.stats.losses.maxlen == 3 and tr.stats.evals.maxlen == 3
+        src = list(synthetic_lm_batches(8, 16, 128, n_batches=6, seed=1))
+        tr.fit(src, steps=6, log_every=1)
+        assert len(tr.stats.losses) == 3
+        # the cap drops the OLDEST entries: the latest step is retained
+        assert [s for s, _ in tr.stats.losses] == [4, 5, 6]
