@@ -87,7 +87,7 @@ DYNAMIC = "?"
 
 CLUSTER_SCOPED_KINDS = frozenset({
     "ClusterRole", "ClusterRoleBinding", "OAuthClient", "SlicePool",
-    "Node", "Namespace", "CustomResourceDefinition",
+    "TPUQuota", "Node", "Namespace", "CustomResourceDefinition",
     "PriorityLevelConfiguration", "FlowSchema",
 })
 

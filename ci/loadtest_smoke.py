@@ -11,9 +11,12 @@ its budget or any loadtest bound (convergence, requests/notebook) trips.
 Additional phases: a 2-manager/4-shard sharded run (zero duplicate-owner
 reconciles, sub-linear wall, crash failover with no lost notebooks), a
 tenant-LIST-storm APF isolation check (controller p95 within 2x quiet),
-warm-vs-cold bind, watch-kill RV-resume, node-preemption repair, and a
+warm-vs-cold bind, watch-kill RV-resume, node-preemption repair, a
 flight-recorder traced run (every notebook must show a complete
-enqueue→queue-wait→reconcile→wire trace with intact parentage).
+enqueue→queue-wait→reconcile→wire trace with intact parentage), and a
+mixed-trace fleet-scheduler run (interactive storm + serving burst +
+background elastic training: no tier starves, utilization floor holds,
+the fleet is never oversubscribed).
 
 Budget rationale: the run takes ~2 s on a quiet dev box; the default 60 s
 budget is ~30x headroom, loose enough to survive a loaded CI box yet tight
@@ -119,6 +122,21 @@ STORM_THREADS = 6
 STORM_RTT_MS = 5.0
 STORM_P95_FACTOR = 2.0
 STORM_P95_SLACK_S = 0.4
+# mixed-trace scheduler phase: background 4-slice elastic training +
+# serving burst + interactive gang-storm waves on an 8-slice fleet, every
+# wave sized one slice past free capacity so admission MUST ride a
+# preemption cascade through the elastic shrink handshake. run_mixed
+# fails internally on tier starvation, a leaked hold, oversubscription,
+# a sub-floor mean utilization, or a storm that never forced a
+# preemption (vacuous-pass guard). Two waves keep the phase ~2-3 s; the
+# manual --mixed-trace run uses three.
+MIXED_CAPACITY = 8
+MIXED_TRAINING_SLICES = 4
+MIXED_SERVING = 2
+MIXED_WAVES = 2
+MIXED_WAVE_SIZE = 3
+MIXED_DWELL_S = 0.3
+MIXED_MIN_UTILIZATION = 0.5
 # traced phase: a small fan-out with the flight-recorder tracing provider
 # installed. run_wire --trace fails internally unless EVERY notebook has a
 # complete CR→Ready lifecycle trace (enqueue → queue-wait → reconcile root
@@ -133,7 +151,7 @@ def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
               preempt: bool = True, watch_kill: bool = True,
               warm_cold: bool = True, sharded: bool = True,
               storm: bool = True, traced: bool = True,
-              sanitize: bool = False) -> int:
+              mixed: bool = True, sanitize: bool = False) -> int:
     """Run the wire fan-out; return nonzero on any failed bound.
 
     ``sanitize`` defaults OFF, unlike chaos_smoke: this is the PERF
@@ -155,7 +173,7 @@ def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
                   "measure instrumented locks")
             return 1
         rc = _run_phases(count, workers, budget_s, preempt, watch_kill,
-                         warm_cold, sharded, storm, traced)
+                         warm_cold, sharded, storm, traced, mixed)
         if rc == 0 and sanitize:
             violations = sanitizer.get_sanitizer().violations()
             if violations:
@@ -171,8 +189,9 @@ def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
 
 def _run_phases(count: int, workers: int, budget_s: float,
                 preempt: bool, watch_kill: bool, warm_cold: bool,
-                sharded: bool, storm: bool, traced: bool) -> int:
-    from loadtest.start_notebooks import run_sharded, run_wire
+                sharded: bool, storm: bool, traced: bool,
+                mixed: bool) -> int:
+    from loadtest.start_notebooks import run_mixed, run_sharded, run_wire
 
     t0 = time.monotonic()
     rc = run_wire(count, "loadtest-smoke", "v5e-4",
@@ -338,6 +357,25 @@ def _run_phases(count: int, workers: int, budget_s: float,
                   f"{tr.get('complete')} of {TRACED_COUNT_NB} notebooks "
                   f"reported complete traces (vacuous-pass guard)")
             return 1
+    if mixed:
+        mixed_stats: dict = {}
+        rc = run_mixed("mixed-smoke", "v5e-4",
+                       timeout=max(budget_s - (time.monotonic() - t0),
+                                   20.0),
+                       capacity=MIXED_CAPACITY,
+                       training_slices=MIXED_TRAINING_SLICES,
+                       serving_gangs=MIXED_SERVING, waves=MIXED_WAVES,
+                       wave_size=MIXED_WAVE_SIZE, dwell_s=MIXED_DWELL_S,
+                       min_utilization=MIXED_MIN_UTILIZATION,
+                       workers=workers, stats_out=mixed_stats)
+        if rc != 0:
+            print(f"SMOKE FAIL: mixed-trace scheduler bounds violated "
+                  f"(rc={rc})")
+            return rc
+        if not mixed_stats.get("preemptions_scheduled"):
+            print("SMOKE FAIL: mixed-trace phase ran but no preemption "
+                  "cascade was recorded (vacuous-pass guard)")
+            return 1
     wall = time.monotonic() - t0
     if wall > budget_s:
         print(f"SMOKE FAIL: {wall:.1f}s exceeds the {budget_s:.0f}s budget")
@@ -359,6 +397,9 @@ def _run_phases(count: int, workers: int, budget_s: float,
     if traced:
         phases.append(f"{TRACED_COUNT_NB} nb traced phase "
                       f"(complete CR→Ready traces)")
+    if mixed:
+        phases.append(f"{MIXED_WAVES}-wave mixed-trace scheduler phase "
+                      f"(no tier starved)")
     print(" + ".join(phases) + f" in {wall:.1f}s (budget {budget_s:.0f}s)")
     return 0
 
@@ -380,6 +421,8 @@ def main() -> int:
                     help="skip the tenant-LIST-storm APF phase")
     ap.add_argument("--no-trace", action="store_true",
                     help="skip the flight-recorder traced phase")
+    ap.add_argument("--no-mixed", action="store_true",
+                    help="skip the mixed-trace fleet-scheduler phase")
     ap.add_argument("--sanitize", action="store_true",
                     help="run armed (concurrency sanitizer): slower, "
                          "fails on any recorded violation. Default off — "
@@ -392,6 +435,7 @@ def main() -> int:
                      sharded=not args.no_sharded,
                      storm=not args.no_storm,
                      traced=not args.no_trace,
+                     mixed=not args.no_mixed,
                      sanitize=args.sanitize)
 
 

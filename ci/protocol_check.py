@@ -411,6 +411,161 @@ class ElasticRepairModel:
         return out
 
 
+# --------------------------------------------------------------------------
+# composed sched-admission × elastic-resize model (preemption cascade)
+
+
+class SConfig(tuple):
+    """(want, sched, resv, hold, el, ack, cur, tgt)"""
+
+    __slots__ = ()
+    FIELDS = ("want", "sched", "resv", "hold", "el", "ack", "cur", "tgt")
+
+    def field(self, key: str):
+        return self[self.FIELDS.index(key)]
+
+    def replace(self, **kw) -> "SConfig":
+        vals = list(self)
+        for key, value in kw.items():
+            vals[self.FIELDS.index(key)] = value
+        return SConfig(vals)
+
+    def __repr__(self) -> str:
+        parts = [f"{k}={v!r}" for k, v in zip(self.FIELDS, self)]
+        return f"SConfig({', '.join(parts)})"
+
+
+class SchedulerCascadeModel:
+    """One interactive gang (1 slice) arriving on a full fleet (capacity
+    CAP) held by one elastic training run (REQ slices): admission MUST go
+    through a preemption cascade — Draining handoff onto the victim, the
+    trainer agent's drain/reshard acks, reservation, verification — and
+    after the gang releases, the victim must grow back to REQ.
+
+    Writers interleave exactly as in the code, every persist one atomic
+    store step:
+
+    - scheduler (controllers/scheduler.py): enqueue, reserve (state +
+      reservation ONE patch), preemption stamp (Draining + target + hold
+      ONE patch on the victim — the declared elastic-resize handoffs),
+      verify-admit / verify-revert (usage re-derived fresh each pass),
+      release / withdraw, and the hold sweep;
+    - slicerepair: the ack-gated Draining→Resharding advance, the
+      completion scrub (single writer of current-slices), the dead-agent
+      abort latch, and the grow-back gate (blocked by the hold);
+    - agent (runtime/elastic.py): carrier echoes into the ack, Aborted
+      latch clearance;
+    - environment: the gang request is withdrawn/released at will (the
+      one-shot lifecycle: a gang eventually leaves).
+
+    Every controller action here is a SINGLE persist — the scheduler's
+    two-phase admission stores its reservation atomically with the
+    Reserving flip, and each preemption stamp is one patch — so a
+    crash-restart at any phase boundary (mid-cascade controller restart
+    included) is exactly an action prefix plus re-derivation from
+    annotations, which the BFS already enumerates (the same argument as
+    the repair side of PoolMigrationModel). The checker proves every
+    reachable configuration — including every crash world at every
+    Reserving/Draining boundary — can still reach settled: gang gone,
+    reservation cleared, hold cleared, no resize in flight, victim back
+    at its requested slice count. No half-admitted gang, no leaked
+    reservation, no permanently shrunk victim.
+    """
+
+    CAP = 2   # fleet slice capacity
+    REQ = 2   # the elastic victim's requested (and initial) slice count
+    GANG = 1  # the interactive gang's slice request
+
+    def initial(self) -> SConfig:
+        return SConfig((True, None, False, False, None, None,
+                        self.REQ, None))
+
+    def settled(self, cfg: SConfig) -> bool:
+        want, sched, resv, hold, el, ack, cur, _tgt = cfg
+        return (not want and sched is None and not resv and not hold and
+                el is None and ack is None and cur == self.REQ)
+
+    def actions(self, cfg: SConfig) -> list:
+        want, sched, resv, hold, el, ack, cur, tgt = cfg
+        out = []
+        free = self.CAP - cur  # usage derived fresh, excluding the gang
+
+        # ---- scheduler (every action one atomic persist)
+        if sched is None and want:
+            out.append(("enqueue", cfg.replace(sched="Pending"),
+                        [("sched-admission", "Idle", "Pending")]))
+        if sched == "Pending" and want and free >= self.GANG:
+            # reservation + state flip: ONE patch
+            out.append(("reserve",
+                        cfg.replace(sched="Reserving", resv=True),
+                        [("sched-admission", "Pending", "Reserving")]))
+        if sched == "Pending" and want and free < self.GANG \
+                and el is None and ack is None and cur > 1:
+            # the declared cross-controller handoff: Draining + target +
+            # started-at + hold in ONE patch on the victim
+            out.append(("preempt-stamp",
+                        cfg.replace(el="Draining", tgt=cur - 1,
+                                    hold=True),
+                        [("elastic-resize", "Stable", "Draining")]))
+        if sched == "Reserving" and free >= self.GANG:
+            out.append(("verify-admit",
+                        cfg.replace(sched="Admitted"),
+                        [("sched-admission", "Reserving", "Admitted")]))
+        if sched == "Reserving" and free < self.GANG:
+            out.append(("verify-revert",
+                        cfg.replace(sched="Pending", resv=False),
+                        [("sched-admission", "Reserving", "Pending")]))
+        if sched == "Admitted" and not want:
+            out.append(("release",
+                        cfg.replace(sched=None, resv=False),
+                        [("sched-admission", "Admitted", "Idle")]))
+        if sched == "Reserving" and not want:
+            out.append(("withdraw-reserving",
+                        cfg.replace(sched="Pending", resv=False),
+                        [("sched-admission", "Reserving", "Pending")]))
+        if sched == "Pending" and not want:
+            out.append(("withdraw",
+                        cfg.replace(sched=None),
+                        [("sched-admission", "Pending", "Idle")]))
+        if hold and sched is None:
+            # sweep: the preemptor released (or vanished) — aux-only
+            # persist, no machine edge
+            out.append(("sweep-hold", cfg.replace(hold=False), []))
+
+        # ---- slicerepair controller (victim side)
+        if el == "Draining" and ack == "Draining":
+            out.append(("advance-resharding",
+                        cfg.replace(el="Resharding"),
+                        [("elastic-resize", "Draining", "Resharding")]))
+        if el == "Resharding" and ack == "Resharding":
+            out.append(("complete",
+                        cfg.replace(el=None, cur=tgt, tgt=None, ack=None),
+                        [("elastic-resize", "Resharding", "Stable")]))
+        if el is not None:
+            out.append(("abort",
+                        cfg.replace(el=None, tgt=None, ack="Aborted"),
+                        [("elastic-resize", el, "Stable")]))
+        if el is None and ack != "Aborted" and cur < self.REQ and not hold:
+            # grow-back: gated on the scheduler's hold being gone
+            out.append(("grow-start",
+                        cfg.replace(el="Draining", tgt=cur + 1, ack=None),
+                        [("elastic-resize", "Stable", "Draining")]))
+
+        # ---- trainer-side agent
+        if el == "Draining" and ack != "Draining":
+            out.append(("drain-ack", cfg.replace(ack="Draining"), []))
+        if el == "Resharding" and ack != "Resharding" and tgt is not None:
+            out.append(("reshard-ack",
+                        cfg.replace(ack="Resharding"), []))
+        if el is None and ack == "Aborted":
+            out.append(("agent-clear-abort", cfg.replace(ack=None), []))
+
+        # ---- environment: the gang eventually leaves (one-shot)
+        if want:
+            out.append(("gang-leaves", cfg.replace(want=False), []))
+        return out
+
+
 def _declared_edge(machines: dict, edge: tuple) -> bool:
     mname, src, dst = edge
     machine = machines.get(mname)
@@ -494,13 +649,27 @@ def run(stats: bool = False) -> int:
     for edge in e_result["undeclared_edges"]:
         errs.append(f"composed elastic×repair: model edge {edge!r} is "
                     f"not a declared transition")
+    s_result = explore(SchedulerCascadeModel(), machines)
+    for cfg in s_result["stuck"]:
+        errs.append(f"composed scheduler×elastic: reachable configuration "
+                    f"cannot settle (stranded gang / leaked reservation / "
+                    f"permanently shrunk victim): {cfg!r}")
+    for cfg in s_result["deadlocks"]:
+        errs.append(f"composed scheduler×elastic: unsettled deadlock: "
+                    f"{cfg!r}")
+    for edge in s_result["undeclared_edges"]:
+        errs.append(f"composed scheduler×elastic: model edge {edge!r} is "
+                    f"not a declared transition")
     if stats:
         print(f"machines: {len(machines)}; composed exploration: "
               f"migration×pool {result['configs']} configs, "
               f"{result['transitions']} transitions, {result['settled']} "
               f"settled; elastic×repair {e_result['configs']} configs, "
               f"{e_result['transitions']} transitions, "
-              f"{e_result['settled']} settled")
+              f"{e_result['settled']} settled; scheduler×elastic "
+              f"{s_result['configs']} configs, "
+              f"{s_result['transitions']} transitions, "
+              f"{s_result['settled']} settled")
     for err in errs:
         print(f"ci/protocol_check.py: [protocol-model] {err}")
     if errs:
@@ -510,7 +679,8 @@ def run(stats: bool = False) -> int:
     total = sum(len(m.transitions) for m in machines.values())
     print(f"ci/protocol_check.py: {len(machines)} machine(s), {total} "
           f"transition(s); composed models: {result['configs']} + "
-          f"{e_result['configs']} configuration(s) all converge")
+          f"{e_result['configs']} + {s_result['configs']} "
+          f"configuration(s) all converge")
     return 0
 
 

@@ -46,10 +46,11 @@ EXPERIMENT_KIND = "ChaosExperiment"
 VALID_INJECTIONS = {"PodKill", "NetworkPartition", "WebhookDisrupt",
                     "RBACRevoke", "DeploymentScaleZero", "SliceWorkerKill",
                     "NodePreemption", "PoolDrainPreemption",
-                    "ElasticPreemption"}
+                    "ElasticPreemption", "SchedulerPreemptionCascade"}
 VALID_CHECK_TYPES = {"conditionTrue", "resourceExists", "httpGet",
                      "sliceAtomic", "notQuarantined", "notebookMigrated",
-                     "poolRewarmed", "elasticResized"}
+                     "poolRewarmed", "elasticResized", "gangAdmitted",
+                     "noReservationLeak"}
 
 
 def _require(cond: bool, errors: list[str], msg: str) -> None:
@@ -208,6 +209,8 @@ class _MiniCluster:
         api.install_notebook_crd(self.store)
         from ..api.slicepool import install_slicepool_crd
         install_slicepool_crd(self.store)
+        from ..api.tpuquota import install_tpuquota_crd
+        install_tpuquota_crd(self.store)
         # set by the PoolDrainPreemption injection: (notebook, old bound
         # slice, identity, checkpointed step) the migrated check verifies
         self.expect_migrated_from: tuple | None = None
@@ -493,6 +496,69 @@ class _MiniCluster:
                            f"{agent.resizes} resizes)")
         return True, ""
 
+    def _check_gangAdmitted(self, check: dict):  # noqa: N802
+        """No gang is ever half-admitted, in ANY interleaving the sample
+        catches: a Reserving/Admitted notebook carries a reservation
+        matching its gang request, a reservation never rides any other
+        state, and a gang the scheduler has queued never rolls its
+        StatefulSet before the Admitted verdict."""
+        from ..controllers.scheduler import (SCHED_ADMITTED, SCHED_RESERVING,
+                                             gang_slices, sched_state)
+        from ..utils import names as nk
+        from ..utils.k8s import get_annotation
+        for nb in self.store.list(self.api.KIND, self.namespace):
+            name = (nb.get("metadata") or {}).get("name")
+            state = sched_state(nb)
+            reserved = get_annotation(nb, nk.SCHED_RESERVED_ANNOTATION)
+            gang = gang_slices(nb)
+            if state in (SCHED_RESERVING, SCHED_ADMITTED):
+                if reserved is None:
+                    return False, f"{name} is {state} with no reservation"
+                if gang is not None and reserved != str(gang):
+                    return False, (f"{name} reserved {reserved} for a "
+                                   f"{gang}-slice gang — half-admitted")
+            elif reserved is not None:
+                return False, (f"{name} leaked reservation {reserved} "
+                               f"in state {state}")
+            if gang is not None and state is not None \
+                    and state != SCHED_ADMITTED \
+                    and self.store.get_or_none(
+                        "StatefulSet", self.namespace, name) is not None:
+                # grace-degrade rolls are legal only when the scheduler
+                # never stamped ANY state — a queued gang must hold
+                return False, f"{name} rolled while {state}, not Admitted"
+        return True, ""
+
+    def _check_noReservationLeak(self, check: dict):  # noqa: N802
+        """Fleet usage re-derived from annotations never exceeds
+        capacity, and every preemption hold names a preemptor that still
+        wants the capacity — a cascade crashed at any phase boundary must
+        leak neither a reservation nor a grow-back hold."""
+        from ..controllers.scheduler import (SCHED_ADMITTED, SCHED_PENDING,
+                                             SCHED_RESERVING,
+                                             notebook_usage, sched_state)
+        from ..utils import names as nk
+        from ..utils.k8s import get_annotation
+        capacity = int(check.get("capacity",
+                                 self.config.sched_default_capacity))
+        fleet = self.store.list(self.api.KIND, self.namespace)
+        usage = sum(notebook_usage(nb) for nb in fleet)
+        if usage > capacity:
+            return False, f"fleet usage {usage} exceeds capacity {capacity}"
+        for nb in fleet:
+            hold = get_annotation(nb, nk.SCHED_PREEMPTED_ANNOTATION)
+            if hold is None:
+                continue
+            ns, _, pname = hold.partition("/")
+            preemptor = self.store.get_or_none(self.api.KIND, ns, pname) \
+                if ns and pname else None
+            if preemptor is None or sched_state(preemptor) not in (
+                    SCHED_PENDING, SCHED_RESERVING, SCHED_ADMITTED):
+                return False, ((nb.get("metadata") or {}).get("name", "?") +
+                               f" carries a stale preemption hold from "
+                               f"{hold}")
+        return True, ""
+
     def _check_poolRewarmed(self, check: dict):  # noqa: N802
         """The pool holds warm (or actively re-warming) spare capacity —
         a consumed/drained slice was replaced, the pool did not bleed."""
@@ -549,7 +615,8 @@ def run_experiment(doc: dict, *, notebooks: int = 2,
     failures: list[str] = []
     accelerator = ("v5e-16" if itype in ("SliceWorkerKill", "NodePreemption",
                                          "PoolDrainPreemption",
-                                         "ElasticPreemption")
+                                         "ElasticPreemption",
+                                         "SchedulerPreemptionCascade")
                    else "v5e-4")
     audit = tempfile.NamedTemporaryFile(suffix=".ndjson", delete=False)
     audit.close()
@@ -784,6 +851,86 @@ def run_experiment(doc: dict, *, notebooks: int = 2,
                                      for f in atomic]
                         break
                     time.sleep(0.05)
+        elif itype == "SchedulerPreemptionCascade":
+            # interactive storm against a 3-slice elastic training run,
+            # with the controller pod killed and recreated MID-CASCADE:
+            # the fleet scheduler's two-phase admission plus the elastic
+            # Draining handshake must converge from annotations alone —
+            # no gang ever half-admitted, no reservation or grow-back
+            # hold leaked, and the trainer sees a monotone step counter
+            # with a continuous loss curve through shrink AND grow-back.
+            from ..controllers.scheduler import SCHED_ADMITTED as _ADMITTED
+            from ..controllers.scheduler import sched_state as _sched_state
+            from ..runtime.elastic import SimulatedElasticAgent
+            from ..utils import names as nk
+            from ..utils.k8s import get_annotation
+            nb0 = cluster.notebooks[0]
+            slices = int(params.get("slices", 3))
+            storm = int(params.get("storm", 2))
+            cluster.store.patch(cluster.api.KIND, cluster.namespace, nb0, {
+                "metadata": {"annotations": {
+                    nk.ELASTIC_ANNOTATION: "true",
+                    nk.ELASTIC_SLICES_ANNOTATION: str(slices),
+                    nk.ELASTIC_CURRENT_SLICES_ANNOTATION: str(slices),
+                }}})
+            cluster.elastic_agent = SimulatedElasticAgent(
+                cluster.store, cluster.namespace, nb0,
+                current_slices=slices).start()
+            # bank productive steps before the storm, as a real run would
+            cluster.wait(lambda: cluster.elastic_agent.steps >= 20,
+                         timeout=30.0)
+            storm_names = []
+            for i in range(storm):
+                nm = f"storm-nb-{i}"
+                cluster.store.create(cluster.api.new_notebook(
+                    nm, cluster.namespace, annotations={
+                        nk.TPU_ACCELERATOR_ANNOTATION: cluster.accelerator,
+                        nk.SCHED_GANG_ANNOTATION: "1",
+                        nk.SCHED_TIER_ANNOTATION: "interactive"}))
+                cluster.notebooks.append(nm)
+                storm_names.append(nm)
+            # the cascade is in flight once the victim carries the hold
+            if not cluster.wait(lambda: get_annotation(
+                    cluster.store.get(cluster.api.KIND, cluster.namespace,
+                                      nb0),
+                    nk.SCHED_PREEMPTED_ANNOTATION) is not None,
+                    timeout=recovery):
+                failures.append("preemption cascade never started (no "
+                                "hold stamped on the elastic victim)")
+            # controller crash-restart MID-CASCADE: a new pod with fresh
+            # watches — every phase boundary must be recoverable from
+            # the persisted annotations, never from controller memory
+            cluster.stop_manager()
+            time.sleep(min(duration, 1.0))
+            cluster.start_manager()
+            # sample the admission invariants WHILE the cascade completes
+            gate_checks = [{"type": "gangAdmitted"},
+                           {"type": "noReservationLeak"},
+                           {"type": "sliceAtomic"}]
+            deadline = time.monotonic() + recovery
+            admitted_all = False
+            while time.monotonic() < deadline:
+                probs = cluster.run_checks(gate_checks)
+                if probs:
+                    failures += [f"mid-cascade {f}" for f in probs]
+                    break
+                admitted_all = all(
+                    _sched_state(cluster.store.get_or_none(
+                        cluster.api.KIND, cluster.namespace, nm))
+                    == _ADMITTED for nm in storm_names)
+                if admitted_all:
+                    break
+                time.sleep(0.05)
+            if not admitted_all and not failures:
+                failures.append("interactive storm never fully admitted "
+                                "after the mid-cascade restart")
+            # the storm subsides: withdrawing the gangs sweeps the holds
+            # and re-opens grow-back — the recovery-phase checks verify
+            # the full round trip (elasticResized: shrink AND grow)
+            for nm in storm_names:
+                cluster.store.patch(cluster.api.KIND, cluster.namespace,
+                                    nm, {"metadata": {"annotations": {
+                                        nk.SCHED_GANG_ANNOTATION: None}}})
         elif itype == "SliceWorkerKill":
             ordinal = int(params.get("ordinal", 1))
             victim = f"{cluster.notebooks[0]}-{ordinal}"

@@ -67,6 +67,8 @@ _MAPPINGS = [
     RestMapping("Notebook", "kubeflow.org/v1", "notebooks"),
     RestMapping("SlicePool", "tpu.kubeflow.org/v1", "slicepools",
                 namespaced=False),
+    RestMapping("TPUQuota", "tpu.kubeflow.org/v1", "tpuquotas",
+                namespaced=False),
     # networking
     RestMapping("NetworkPolicy", "networking.k8s.io/v1", "networkpolicies"),
     # rbac

@@ -4,6 +4,7 @@ from .culling import CullingReconciler
 from .extension import ExtensionReconciler
 from .slicerepair import SliceRepairReconciler
 from .slicepool import SlicePoolReconciler
+from .scheduler import SchedulerReconciler
 
 # API effect contract — ci/effects.py checks this declaration
 # against the AST-inferred effect summary; update both together.
@@ -20,7 +21,8 @@ CONTRACT = {
 
 __all__ = ["Manager", "Request", "NotebookReconciler", "CullingReconciler",
            "ExtensionReconciler", "SliceRepairReconciler",
-           "SlicePoolReconciler", "setup_controllers"]
+           "SlicePoolReconciler", "SchedulerReconciler",
+           "setup_controllers"]
 
 
 def setup_controllers(client, config=None, metrics=None, prober=None, *,
@@ -69,6 +71,8 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
         install_notebook_crd(client)
         from ..api.slicepool import install_slicepool_crd
         install_slicepool_crd(client)
+        from ..api.tpuquota import install_tpuquota_crd
+        install_tpuquota_crd(client)
     if webhooks and inprocess_admission:
         # mutating runs before validating, as in the apiserver's phase
         # order; admission always reads/writes the LIVE client — mutating
@@ -153,6 +157,11 @@ def setup_controllers(client, config=None, metrics=None, prober=None, *,
             # releases + re-warms on cull/stop, drains + replaces on
             # migration off dying capacity
             SlicePoolReconciler(client, config, metrics).setup(mgr)
+        if getattr(config, "enable_scheduler", True):
+            # fleet scheduler: gang admission + tenant quota for
+            # gang-annotated notebooks, tier preemption routed through
+            # the repair controller's elastic shrink handshake
+            SchedulerReconciler(client, config, metrics).setup(mgr)
     if extension:
         ExtensionReconciler(client, config, metrics).setup(mgr)
     if leader_elect:

@@ -60,7 +60,8 @@ CONTRACT = {
         "MIGRATION_STATE_ANNOTATION", "NOTEBOOK_NAME_LABEL", "POD_INDEX_LABEL",
         "POOL_ANNOTATIONS", "POOL_BIND_MISS_ANNOTATION",
         "POOL_BIND_PENDING_ANNOTATION", "REPAIR_SCALE_DOWN_ANNOTATION",
-        "RESTART_ANNOTATION", "SERVING_PORT_ANNOTATION",
+        "RESTART_ANNOTATION", "SCHED_ANNOTATIONS", "SCHED_GANG_ANNOTATION",
+        "SCHED_STATE_ANNOTATION", "SERVING_PORT_ANNOTATION",
         "SLICE_HEALTH_ANNOTATION", "SLICE_HEALTH_REASON_ANNOTATION",
         "SLICE_REPAIR_ANNOTATIONS", "STOP_ANNOTATION",
         "TPU_ACCELERATOR_ANNOTATION", "TPU_SLICE_LABEL",
@@ -120,6 +121,11 @@ class NotebookReconciler:
         # stamps a BindTimeout miss and cold-rolls (in-memory is fine: a
         # restarted controller re-arming the grace window is correct)
         self._pool_pending_since: dict[tuple[str, str], float] = {}
+        # (ns, name) → monotonic time a gang-annotated notebook was first
+        # seen waiting for the fleet scheduler's Admitted verdict; past
+        # sched_admission_grace_s with no scheduler progress the core
+        # proceeds anyway (a down scheduler must never strand creation)
+        self._sched_pending_since: dict[tuple[str, str], float] = {}
         # (ns, name) → traceparent already stamped by THIS process: dedups
         # the trace-context annotation write across the reconciles that
         # race the stamp's own watch echo (telemetry only; populated only
@@ -236,6 +242,7 @@ class NotebookReconciler:
             # a notebook deleted while waiting for a bind must not leak
             # its grace-window entry (nor its stamped-trace dedup entry)
             self._pool_pending_since.pop((req.namespace, req.name), None)
+            self._sched_pending_since.pop((req.namespace, req.name), None)
             self._stamped_traces.pop((req.namespace, req.name), None)
             event = self.client.get_or_none(events.EVENT_KIND, req.namespace,
                                             req.name)
@@ -247,6 +254,16 @@ class NotebookReconciler:
             # owner-reference GC reaps STS/Service
             return None
         self._stamp_trace_context(notebook)
+
+        # fleet-scheduler admission (controllers/scheduler.py): a
+        # gang-annotated notebook rolls nothing until the scheduler
+        # admits its gang — the hold that makes multi-slice acquisition
+        # atomic fleet-wide. Bounded by a grace timeout, so a down
+        # scheduler degrades to unscheduled creation instead of
+        # stranding it.
+        gate = self._sched_admission_gate(notebook)
+        if gate is not None:
+            return gate
 
         slice_spec = parse_slice_request(
             k8s.get_in(notebook, "metadata", "annotations", default={}))
@@ -413,6 +430,52 @@ class NotebookReconciler:
             return None
         return Result(requeue_after=self.config.pool_poll_s)
 
+    def _sched_admission_gate(self, notebook: dict) -> Result | None:
+        """Hold the roll of a gang-annotated notebook until the fleet
+        scheduler admits its gang. Returns a Result to wait, or None →
+        proceed. Two regimes:
+
+        * scheduler has made progress (any sched-state present): the
+          admission queue owns the wait — a gang legitimately queued
+          behind capacity or a preemption drain must NOT cold-roll out
+          from under its own atomicity guarantee, however long it takes
+          (withdrawing the gang annotation is the operator's exit).
+        * scheduler silent (no state ever stamped): after
+          sched_admission_grace_s the notebook proceeds unscheduled with
+          a warning event — a down scheduler must never strand creation
+          (the same degrade rule as the pool's BindTimeout)."""
+        if not getattr(self.config, "enable_scheduler", True):
+            return None
+        key = (k8s.namespace(notebook), k8s.name(notebook))
+        if k8s.get_annotation(notebook,
+                              names.SCHED_GANG_ANNOTATION) is None:
+            self._sched_pending_since.pop(key, None)
+            return None
+        state = k8s.get_annotation(notebook, names.SCHED_STATE_ANNOTATION)
+        if state == "Admitted":
+            self._sched_pending_since.pop(key, None)
+            return None
+        if self._find_owned_sts(notebook) is not None:
+            # already rolled (grace expired earlier, or the gang
+            # annotation arrived after creation): admission now only
+            # gates NEW rolls, it never tears down a running notebook
+            return None
+        if state is not None:
+            # the scheduler is alive and has this gang queued
+            self._sched_pending_since.pop(key, None)
+            return Result(requeue_after=self.config.sched_poll_s)
+        now = time.monotonic()
+        first = self._sched_pending_since.setdefault(key, now)
+        if now - first > self.config.sched_admission_grace_s:
+            self._sched_pending_since.pop(key, None)
+            self.recorder.eventf(
+                notebook, events.TYPE_WARNING, "SchedulerAdmissionTimeout",
+                f"no scheduler verdict within "
+                f"{self.config.sched_admission_grace_s:.0f}s; proceeding "
+                f"unscheduled")
+            return None
+        return Result(requeue_after=self.config.sched_poll_s)
+
     def _reconcile_bound(self, notebook: dict, slice_spec: SliceSpec,
                          bound: tuple[str, str]) -> None:
         """Bound mode: Service repointed at the pool slice, restart bounces
@@ -466,11 +529,13 @@ class NotebookReconciler:
             if key in names.SLICE_REPAIR_ANNOTATIONS or \
                     key in names.POOL_ANNOTATIONS or \
                     key in names.ELASTIC_ANNOTATIONS or \
+                    key in names.SCHED_ANNOTATIONS or \
                     key == names.TRACE_CONTEXT_ANNOTATION:
-                # repair/pool/elastic/trace bookkeeping would churn the pod
-                # template (every health, bind, or resize-handshake
-                # transition a spurious template drift → rolling restart)
-                # — it describes the slice's lifecycle, not the pods
+                # repair/pool/elastic/sched/trace bookkeeping would churn
+                # the pod template (every health, bind, resize-handshake,
+                # or admission transition a spurious template drift →
+                # rolling restart) — it describes the slice's lifecycle,
+                # not the pods
                 continue
             out[key] = val
         return out

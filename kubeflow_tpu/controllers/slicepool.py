@@ -50,6 +50,7 @@ from ..api import types as api
 from ..cluster import errors, events
 from ..tpu.topology import SliceSpec, parse_short_name
 from ..utils import k8s, names, sanitizer, tracing
+from ..utils.fairness import fair_share_admit
 from ..utils.config import ControllerConfig
 from ..utils.metrics import MetricsRegistry
 from .manager import Manager, Request, Result
@@ -179,28 +180,6 @@ POOL_STATES = (names.POOL_STATE_WARMING, names.POOL_STATE_WARM,
 _POOL_KEEP_ANNOTATIONS = frozenset({
     names.POOL_STATE_ANNOTATION,
 })
-
-
-def fair_share_admit(pending: list[dict], weights: dict[str, int],
-                     capacity: int) -> tuple[list[dict], list[dict]]:
-    """Weighted max-min admission over a contended pool: repeatedly grant
-    one slice to the namespace with the highest ``weight / (granted + 1)``
-    (ties by namespace name), FIFO within a namespace. Returns
-    (admitted, rejected) preserving each namespace's arrival order —
-    the Hadoop-fair-scheduler shape, deterministic for tests."""
-    queues: dict[str, list[dict]] = {}
-    for nb in pending:
-        queues.setdefault(k8s.namespace(nb), []).append(nb)
-    granted = {ns: 0 for ns in queues}
-    admitted: list[dict] = []
-    while capacity > 0 and any(queues.values()):
-        ns = min((ns for ns in queues if queues[ns]),
-                 key=lambda n: (-(weights.get(n, 1) / (granted[n] + 1)), n))
-        admitted.append(queues[ns].pop(0))
-        granted[ns] += 1
-        capacity -= 1
-    rejected = [nb for ns in sorted(queues) for nb in queues[ns]]
-    return admitted, rejected
 
 
 def pool_state(sts: dict) -> str:

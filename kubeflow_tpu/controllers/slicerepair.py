@@ -76,6 +76,7 @@ CONTRACT = {
         "NOTEBOOK_NAME_LABEL", "POOL_BIND_MISS_ANNOTATION",
         "QUARANTINE_ANNOTATION", "REPAIR_FAILURES_ANNOTATION",
         "REPAIR_SCALE_DOWN_ANNOTATION", "REPAIR_STARTED_AT_ANNOTATION",
+        "SCHED_PREEMPTED_ANNOTATION",
         "SLICE_HEALTH_ANNOTATION", "SLICE_HEALTH_REASON_ANNOTATION",
         "STOP_ANNOTATION", "TRACE_CONTEXT_ANNOTATION",
     ],
@@ -223,6 +224,24 @@ PROTOCOL = [
                 "a LIVE agent clears, so a dead agent cannot re-trigger "
                 "an endless resize loop",
         },
+        "handoffs": [
+            {"writer": "scheduler", "annotation": "ELASTIC_RESIZE_ANNOTATION",
+             "reason": "tier preemption enters Draining through THIS "
+                       "handshake — a preempted trainer is drained to a "
+                       "durable save and resharded, never killed; from "
+                       "the stamp on, this controller drives the cycle"},
+            {"writer": "scheduler", "annotation": "ELASTIC_TARGET_ANNOTATION",
+             "reason": "preemption target (current-1) rides the same "
+                       "patch as the Draining stamp"},
+            {"writer": "scheduler",
+             "annotation": "ELASTIC_RESIZE_STARTED_AT_ANNOTATION",
+             "reason": "preemption arms the SAME dead-agent timeout clock "
+                       "so a dark trainer falls back to the repair roll"},
+            {"writer": "scheduler", "annotation": "ELASTIC_ACK_ANNOTATION",
+             "reason": "cleared with the Draining stamp so a stale ack "
+                       "from the previous cycle cannot fast-forward this "
+                       "one"},
+        ],
         "transitions": [
             {"from": "Stable", "to": "Draining",
              "trigger": "elastic-resize-needed",
@@ -695,9 +714,14 @@ class SliceRepairReconciler:
             return poll
 
         if not problems and state is None and current < requested \
-                and ack != ELASTIC_ABORTED:
+                and ack != ELASTIC_ABORTED \
+                and k8s.get_annotation(
+                    notebook, names.SCHED_PREEMPTED_ANNOTATION) is None:
             # grow back: repair completed (or capacity freed) while the
-            # run holds fewer slices than requested
+            # run holds fewer slices than requested. The scheduler's
+            # preemption hold blocks this gate — the reclaimed slice is
+            # serving a higher tier; the hold's clearance (preemptor
+            # released) is what re-opens grow-back.
             self._patch(notebook, {
                 names.ELASTIC_RESIZE_ANNOTATION: ELASTIC_DRAINING,
                 names.ELASTIC_TARGET_ANNOTATION: str(current + 1),

@@ -198,6 +198,60 @@ def slicepool_crd() -> dict:
     }
 
 
+def tpuquota_crd() -> dict:
+    """CustomResourceDefinition for per-tenant slice quota
+    (tpu.kubeflow.org/v1 TPUQuota, cluster-scoped — the scheduler's
+    admission ceiling, controllers/scheduler.py). Single served version;
+    no reference analog."""
+    from ..api import tpuquota
+    schema_doc = {
+        "openAPIV3Schema": {
+            "type": "object",
+            "properties": {
+                "spec": {
+                    "type": "object",
+                    "required": ["tenant", "maxSlices"],
+                    "properties": {
+                        "tenant": {"type": "string"},
+                        "maxSlices": {"type": "integer",
+                                      "format": "int32", "minimum": 0},
+                    },
+                },
+                "status": {
+                    "type": "object",
+                    "properties": {
+                        "used": {"type": "integer", "format": "int32"},
+                    },
+                },
+            },
+        },
+    }
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"{tpuquota.PLURAL}.{tpuquota.GROUP}"},
+        "spec": {
+            "group": tpuquota.GROUP,
+            "names": {"kind": tpuquota.KIND, "listKind": "TPUQuotaList",
+                      "plural": tpuquota.PLURAL, "singular": "tpuquota"},
+            "scope": "Cluster",
+            "versions": [{
+                "name": tpuquota.VERSION,
+                "served": True,
+                "storage": True,
+                "schema": schema_doc,
+                "subresources": {"status": {}},
+                "additionalPrinterColumns": [
+                    {"name": "Tenant", "type": "string",
+                     "jsonPath": ".spec.tenant"},
+                    {"name": "MaxSlices", "type": "integer",
+                     "jsonPath": ".spec.maxSlices"},
+                ],
+            }],
+        },
+    }
+
+
 # ------------------------------------------------------------------- manager
 
 def parse_params_env(text: str) -> dict[str, str]:
@@ -530,9 +584,11 @@ def render_kustomize_tree() -> dict[str, object]:
     tree: dict[str, object] = {
         "crd/bases/kubeflow.org_notebooks.yaml": notebook_crd(),
         "crd/bases/tpu.kubeflow.org_slicepools.yaml": slicepool_crd(),
+        "crd/bases/tpu.kubeflow.org_tpuquotas.yaml": tpuquota_crd(),
         "crd/kustomization.yaml":
             _kustomization(["bases/kubeflow.org_notebooks.yaml",
-                            "bases/tpu.kubeflow.org_slicepools.yaml"]),
+                            "bases/tpu.kubeflow.org_slicepools.yaml",
+                            "bases/tpu.kubeflow.org_tpuquotas.yaml"]),
         "manager/manager.yaml": [manager_deployment(),
                                  extension_deployment(), culler_configmap(),
                                  manager_health_service(),

@@ -29,6 +29,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 
 import jax
 
@@ -67,7 +68,7 @@ class ElasticTrainer:
 
     def __init__(self, per_slice: MeshConfig, n_slices: int, config,
                  train_config=None, checkpoint_dir=None, *, devices=None,
-                 **trainer_kwargs):
+                 resize_events_cap: int = 1000, **trainer_kwargs):
         if checkpoint_dir is None:
             raise ValueError("ElasticTrainer requires checkpoint_dir: "
                              "resize is checkpoint-mediated")
@@ -79,8 +80,11 @@ class ElasticTrainer:
             else list(jax.devices())
         self._kwargs = dict(trainer_kwargs)
         self.n_slices = n_slices
-        # (old_n, new_n, step, seconds) per completed resize
-        self.resize_events: list = []
+        # (old_n, new_n, step, seconds) per completed resize — bounded
+        # like TrainerStats' losses/evals (stats_history_cap): a
+        # long-lived run under preemption churn must not leak host memory
+        # one tuple per shrink/grow cycle, so the deque drops oldest
+        self.resize_events: deque = deque(maxlen=resize_events_cap)
         self.trainer = self._build(n_slices)
 
     def _build(self, n_slices: int) -> Trainer:

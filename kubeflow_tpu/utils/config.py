@@ -112,6 +112,20 @@ class ControllerConfig:
     pool_migration_timeout_s: float = 60.0
     # safety-net requeue while the pool warms slices / waits on binds
     pool_poll_s: float = 0.25
+    # fleet scheduler (controllers/scheduler.py): gang admission + tenant
+    # quota + tier preemption for gang-annotated notebooks
+    enable_scheduler: bool = True
+    # fleet slice capacity assumed when no SlicePool declares any (the
+    # pools' warmReplicas sum is the live capacity signal otherwise)
+    sched_default_capacity: int = 4
+    # safety-net requeue while a gang waits on capacity / a preemption
+    # handshake (the scheduler is otherwise event-driven)
+    sched_poll_s: float = 0.25
+    # how long the core reconciler holds a gang-annotated notebook's roll
+    # waiting for the scheduler's Admitted verdict; past it the notebook
+    # proceeds anyway (a down scheduler must never strand creation — the
+    # same degrade rule as pool_bind_grace_s)
+    sched_admission_grace_s: float = 5.0
     # TPU-native
     tpu_default_image: str = "us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest"
     image_swap_map: dict = field(default_factory=dict)  # cuda image → jax/libtpu image
@@ -169,6 +183,12 @@ class ControllerConfig:
             pool_migration_timeout_s=float(
                 env.get("POOL_MIGRATION_TIMEOUT", "60")),
             pool_poll_s=float(env.get("POOL_POLL", "0.25")),
+            enable_scheduler=_env_bool("ENABLE_SCHEDULER", True),
+            sched_default_capacity=int(
+                env.get("SCHED_DEFAULT_CAPACITY", "4")),
+            sched_poll_s=float(env.get("SCHED_POLL", "0.25")),
+            sched_admission_grace_s=float(
+                env.get("SCHED_ADMISSION_GRACE", "5")),
             tpu_default_image=env.get(
                 "TPU_NOTEBOOK_IMAGE",
                 "us-docker.pkg.dev/kubeflow-tpu/jax-notebook:latest"),

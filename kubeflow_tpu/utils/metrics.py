@@ -57,6 +57,11 @@ METRIC_FAMILY_CATALOG = frozenset({
     "slice_degraded",
     "notebook_migrations_total",
     "elastic_resizes_total",
+    # fleet scheduler
+    "scheduler_admissions_total",
+    "scheduler_preemptions_total",
+    "scheduler_gang_wait_seconds",
+    "scheduler_quota_used",
     # serving
     "serving_http_requests_total",
     "serving_generate_seconds_sum",
@@ -111,6 +116,10 @@ METRIC_FAMILY_LABELS = {
     "rest_client_requests_total": ("code", "method"),
     "rest_client_retries_total": ("reason", "verb"),
     "sanitizer_violations_total": ("rule",),
+    "scheduler_admissions_total": ("outcome", "tenant"),
+    "scheduler_gang_wait_seconds": ("tenant",),
+    "scheduler_preemptions_total": ("outcome", "tier"),
+    "scheduler_quota_used": ("tenant",),
     "serving_generate_seconds_count": (),
     "serving_generate_seconds_sum": (),
     "serving_http_requests_total": ("code", "method", "route"),

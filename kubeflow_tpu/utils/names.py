@@ -191,6 +191,41 @@ ELASTIC_ANNOTATIONS = frozenset({
     ELASTIC_RESIZE_STARTED_AT_ANNOTATION,
 })
 
+# --- fleet scheduler (controllers/scheduler.py) ---
+# opt-in gang request: the number of slices this notebook's job needs —
+# all acquired atomically or none held (a multi-slice serving/training
+# job never deadlocks on a partial hold). Notebooks without it bypass
+# the scheduler entirely.
+SCHED_GANG_ANNOTATION = "tpu.kubeflow.org/gang-slices"
+# priority tier ("interactive" > "serving" > "training"); an interactive
+# bind may preempt a training job's slice through the elastic
+# checkpoint-shrink handshake
+SCHED_TIER_ANNOTATION = "tpu.kubeflow.org/tier"
+# sched-admission state machine carrier, owned by the scheduler:
+# "Pending" → "Reserving" → "Admitted"; absent = Idle. The reservation
+# (SCHED_RESERVED) is persisted in the SAME patch as the Reserving flip,
+# so a controller crash never strands a gang half-admitted — restart
+# re-derives fleet usage from annotations and completes or reverts.
+SCHED_STATE_ANNOTATION = "tpu.kubeflow.org/sched-state"
+# slice count reserved/held for this gang, stamped atomically with
+# Reserving and kept through Admitted; cleared when the gang releases
+SCHED_RESERVED_ANNOTATION = "tpu.kubeflow.org/sched-reserved"
+# gang wait clock (epoch seconds), stamped with Pending — feeds
+# scheduler_gang_wait_seconds at admission
+SCHED_ENQUEUED_AT_ANNOTATION = "tpu.kubeflow.org/sched-enqueued-at"
+# scheduler's preemption hold on a training victim: while present, the
+# repair controller must NOT grow the elastic run back — the reclaimed
+# slice is serving a higher tier. Cleared when the preemptor releases.
+SCHED_PREEMPTED_ANNOTATION = "tpu.kubeflow.org/sched-preempted"
+# scheduler bookkeeping churns on every admission step — never
+# propagated into the StatefulSet template (same rationale as
+# ELASTIC_ANNOTATIONS: template drift → spurious rolling restart)
+SCHED_ANNOTATIONS = frozenset({
+    SCHED_GANG_ANNOTATION, SCHED_TIER_ANNOTATION, SCHED_STATE_ANNOTATION,
+    SCHED_RESERVED_ANNOTATION, SCHED_ENQUEUED_AT_ANNOTATION,
+    SCHED_PREEMPTED_ANNOTATION,
+})
+
 # W3C traceparent of the notebook's lifecycle trace, stamped on the
 # Notebook by its reconciler only while a recording tracing provider is
 # installed (utils/tracing.py): the cross-controller trace carrier —

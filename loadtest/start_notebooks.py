@@ -124,6 +124,267 @@ def run_inprocess(count: int, namespace: str, accelerator: str,
     return 0
 
 
+def run_mixed(namespace: str, accelerator: str, timeout: float,
+              capacity: int = 8, training_slices: int = 4,
+              serving_gangs: int = 2, waves: int = 3, wave_size: int = 3,
+              dwell_s: float = 0.5, min_utilization: float = 0.5,
+              # a quiet box measures ~0.90; the agent's step counter is
+              # poll-thread-driven while the per-resize blip cost is
+              # fixed, so a loaded CI box reads lower through no fault
+              # of the scheduler — the floor keeps headroom for that
+              min_mfu: float = 0.75, workers: int = 4,
+              stats_out: dict | None = None) -> int:
+    """Mixed-trace fleet-scheduler phase: a background elastic training
+    run holds most of the fleet, a serving burst takes the remainder,
+    and interactive gang storms arrive in waves sized so each wave can
+    only fit by preempting the training run through the elastic shrink
+    handshake. The full admission stack runs live — scheduler, repair
+    controller, core reconciler, kubelet sim, a SimulatedElasticAgent
+    acking the drains — and the run asserts the scheduler's fairness
+    contract end to end:
+
+    - NO TIER STARVES: every serving and interactive gang admits within
+      its wave deadline, and the training run is back at its requested
+      slice count (steps monotone, loss continuous, no hold left) once
+      the storm subsides — preemption is a round-trip migration.
+    - UTILIZATION FLOOR: time-averaged fleet usage, derived from the
+      same annotations the scheduler admits against, stays at or above
+      ``min_utilization`` for the storm's duration — admission control
+      must pack the fleet, not park it.
+    - NEVER OVERSUBSCRIBED: no usage sample exceeds capacity (the
+      grow-back entitlement accounting under churn).
+    - vacuous-pass guards: at least one preemption cascade actually ran
+      (else the trace is undersized for the capacity), every scheduled
+      hold was released, and the sampler took a real number of samples.
+    """
+    import threading
+
+    from kubeflow_tpu.api import types as api
+    from kubeflow_tpu.api.tpuquota import new_tpu_quota
+    from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
+    from kubeflow_tpu.cluster.store import ClusterStore
+    from kubeflow_tpu.controllers import setup_controllers
+    from kubeflow_tpu.controllers.scheduler import (SCHED_ADMITTED,
+                                                    notebook_usage,
+                                                    sched_state)
+    from kubeflow_tpu.runtime.elastic import SimulatedElasticAgent
+    from kubeflow_tpu.utils import names
+    from kubeflow_tpu.utils.config import ControllerConfig
+    from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+    train_ns = f"{namespace}-training"
+    serve_ns = f"{namespace}-serving"
+    inter_ns = f"{namespace}-interactive"
+    cfg = ControllerConfig(
+        sched_default_capacity=capacity, sched_poll_s=0.02,
+        slice_repair_poll_s=0.02, slice_repair_backoff_base_s=0.01,
+        slice_repair_backoff_max_s=0.05)
+    metrics = MetricsRegistry()
+    store = ClusterStore()
+    mgr = setup_controllers(store, config=cfg, metrics=metrics,
+                            max_concurrent_reconciles=workers)
+    StatefulSetSimulator(mgr.read_cache or store,
+                         boot_delay_s=0.0).setup(mgr)
+    mgr.start()
+    agent = None
+    sampler_stop = threading.Event()
+    samples: list[float] = []
+
+    def _sample() -> None:
+        while not sampler_stop.is_set():
+            usage = sum(notebook_usage(nb) for nb in store.list(api.KIND))
+            samples.append(usage / capacity)
+            time.sleep(0.02)
+
+    sampler = threading.Thread(target=_sample, daemon=True,
+                               name="mixed-utilization-sampler")
+
+    def _wait(predicate, deadline: float) -> bool:
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.01)
+        return bool(predicate())
+
+    def _spawn_gangs(tier: str, ns: str, count: int,
+                     prefix: str) -> list[str]:
+        out = []
+        for i in range(count):
+            nb_name = f"{prefix}-{i}"
+            store.create(api.new_notebook(nb_name, ns, annotations={
+                names.TPU_ACCELERATOR_ANNOTATION: accelerator,
+                names.SCHED_GANG_ANNOTATION: "1",
+                names.SCHED_TIER_ANNOTATION: tier,
+            }))
+            out.append(nb_name)
+        return out
+
+    def _admitted(ns: str, nbs: list[str]) -> bool:
+        for nb_name in nbs:
+            obj = store.get_or_none(api.KIND, ns, nb_name)
+            if obj is None or sched_state(obj) != SCHED_ADMITTED:
+                return False
+        return True
+
+    def _withdraw(ns: str, nbs: list[str]) -> None:
+        for nb_name in nbs:
+            store.patch(api.KIND, ns, nb_name, {
+                "metadata": {"annotations": {
+                    names.SCHED_GANG_ANNOTATION: None,
+                    names.SCHED_TIER_ANNOTATION: None,
+                }}})
+
+    try:
+        # per-tenant quotas sized to the trace: the admission path reads
+        # them every pass; a withdrawn wave's not-yet-released
+        # reservation makes the next wave's quota check bind briefly,
+        # which is the transient-denial path being exercised
+        for qname, tenant, cap in (
+                ("mixed-training", train_ns, training_slices),
+                ("mixed-serving", serve_ns, serving_gangs),
+                ("mixed-interactive", inter_ns, wave_size)):
+            store.create(new_tpu_quota(qname, tenant, cap))
+        # background training: an elastic run holding most of the fleet
+        store.create(api.new_notebook("bg-train", train_ns, annotations={
+            names.TPU_ACCELERATOR_ANNOTATION: accelerator,
+            names.ELASTIC_ANNOTATION: "true",
+            names.ELASTIC_SLICES_ANNOTATION: str(training_slices),
+            names.ELASTIC_CURRENT_SLICES_ANNOTATION: str(training_slices),
+        }))
+        agent = SimulatedElasticAgent(store, train_ns, "bg-train",
+                                      current_slices=training_slices
+                                      ).start()
+        deadline = time.monotonic() + timeout
+        if not _wait(lambda: agent.steps >= 20, deadline):
+            print("FAIL: training agent banked no steps — elastic "
+                  "runtime never reached Stable")
+            return 1
+        t0 = time.monotonic()
+        sampler.start()
+
+        # serving burst: takes the capacity the training run leaves free
+        serving = _spawn_gangs("serving", serve_ns, serving_gangs, "serve")
+        t_serve = time.monotonic()
+        if not _wait(lambda: _admitted(serve_ns, serving), deadline):
+            print(f"FAIL: serving tier starved — {serving} not all "
+                  f"admitted within {timeout}s")
+            return 1
+        serving_wait = time.monotonic() - t_serve
+
+        # interactive storm: each wave wants one slice more than the
+        # fleet has free, so the last gang in every wave rides a
+        # preemption cascade; the wave dwells, then withdraws, which
+        # sweeps the hold and re-opens the training run's grow-back
+        wave_waits: list[float] = []
+        for w in range(waves):
+            wave = _spawn_gangs("interactive", inter_ns, wave_size,
+                                f"storm-{w}")
+            t_wave = time.monotonic()
+            if not _wait(lambda: _admitted(inter_ns, wave), deadline):
+                stuck = [nb_name for nb_name in wave
+                         if sched_state(store.get(api.KIND, inter_ns,
+                                                  nb_name))
+                         != SCHED_ADMITTED]
+                print(f"FAIL: interactive tier starved — wave {w} gangs "
+                      f"{stuck} never admitted")
+                return 1
+            wave_waits.append(time.monotonic() - t_wave)
+            time.sleep(dwell_s)
+            _withdraw(inter_ns, wave)
+        _withdraw(serve_ns, serving)
+        storm_wall = time.monotonic() - t0
+        sampler_stop.set()
+        sampler.join(timeout=5)
+
+        # storm over: the training run must be made whole — the
+        # "training tier never starves" half of the fairness contract
+        def _training_restored() -> bool:
+            nb = store.get(api.KIND, train_ns, "bg-train")
+            anns = nb.get("metadata", {}).get("annotations", {}) or {}
+            return (agent.current == training_slices
+                    and anns.get(names.ELASTIC_RESIZE_ANNOTATION) is None
+                    and anns.get(names.SCHED_PREEMPTED_ANNOTATION) is None)
+
+        if not _wait(_training_restored, deadline):
+            print(f"FAIL: training tier starved — run at {agent.current}/"
+                  f"{training_slices} slices after the storm withdrew")
+            return 1
+
+        preempts = metrics.counter("scheduler_preemptions_total", "")
+        scheduled = preempts.sum_where({"outcome": "scheduled"})
+        released = preempts.sum_where({"outcome": "released"})
+        util_mean = sum(samples) / len(samples) if samples else 0.0
+        util_min = min(samples) if samples else 0.0
+        util_max = max(samples) if samples else 0.0
+        mfu = agent.mfu()
+        print(f"mixed trace: capacity {capacity}  training "
+              f"{training_slices}-slice elastic run  {serving_gangs} "
+              f"serving + {waves}x{wave_size} interactive gangs  "
+              f"storm wall: {storm_wall:.2f}s")
+        print(f"tiers: serving admitted in {serving_wait:.2f}s  "
+              f"interactive waves "
+              f"{['%.2fs' % t for t in wave_waits]}  "
+              f"preemptions: {scheduled:.0f} scheduled / "
+              f"{released:.0f} released")
+        print(f"utilization: mean {util_mean:.0%} min {util_min:.0%} "
+              f"max {util_max:.0%} over {len(samples)} samples  "
+              f"training: {agent.resizes} resizes, {agent.steps} steps, "
+              f"mfu {mfu:.2f}, {len(agent.violations)} violations")
+        if stats_out is not None:
+            stats_out.update({
+                "storm_wall_s": storm_wall,
+                "serving_wait_s": serving_wait,
+                "wave_waits_s": wave_waits,
+                "preemptions_scheduled": scheduled,
+                "preemptions_released": released,
+                "utilization_mean": util_mean,
+                "utilization_max": util_max,
+                "samples": len(samples),
+                "resizes": agent.resizes,
+                "mfu": mfu,
+                "violations": list(agent.violations),
+            })
+        if scheduled < 1:
+            print("FAIL: the storm never forced a preemption — the trace "
+                  "is undersized for the capacity (vacuous pass)")
+            return 1
+        if released < scheduled:
+            print(f"FAIL: {scheduled - released:.0f} preemption hold(s) "
+                  f"never released — grow-back gate leaked")
+            return 1
+        if len(samples) < 20:
+            print(f"FAIL: only {len(samples)} utilization samples — the "
+                  f"floor check is vacuous")
+            return 1
+        if util_max > 1.0 + 1e-9:
+            print(f"FAIL: fleet oversubscribed — usage peaked at "
+                  f"{util_max:.0%} of capacity")
+            return 1
+        if util_mean < min_utilization:
+            print(f"FAIL: mean fleet utilization {util_mean:.0%} below "
+                  f"the {min_utilization:.0%} floor — admission control "
+                  f"parked capacity the trace wanted")
+            return 1
+        if agent.violations:
+            print(f"FAIL: training telemetry violated elasticity "
+                  f"invariants: {agent.violations[:3]}")
+            return 1
+        if agent.resizes < 2:
+            print(f"FAIL: training run logged {agent.resizes} resize(s) — "
+                  f"the preemption never round-tripped shrink + grow-back")
+            return 1
+        if mfu < min_mfu:
+            print(f"FAIL: training mfu {mfu:.2f} under churn below the "
+                  f"{min_mfu:.2f} floor")
+            return 1
+        return 0
+    finally:
+        sampler_stop.set()
+        if agent is not None:
+            agent.stop()
+        mgr.stop()
+
+
 def _print_latencies(lat: list[float]) -> None:
     """The shared create→SliceReady percentile line (both modes)."""
     if not lat:
@@ -1323,6 +1584,13 @@ def main() -> int:
                          "dangling) once FRAC of the fleet is Ready; "
                          "survivors must adopt its shards and no "
                          "notebook may be lost")
+    ap.add_argument("--mixed-trace", action="store_true",
+                    help="fleet-scheduler mixed-trace phase: background "
+                         "elastic training + serving burst + interactive "
+                         "gang-storm waves arbitrated by the scheduler; "
+                         "fails on tier starvation, a sub-floor fleet "
+                         "utilization, oversubscription, or a missing "
+                         "preemption cascade (see run_mixed)")
     ap.add_argument("--soak", action="store_true",
                     help="100k-scale soak: sharded core control plane "
                          "in-process with event-driven kubelet ticks "
@@ -1337,6 +1605,9 @@ def main() -> int:
         except BrokenPipeError:
             pass  # downstream consumer (head, kubectl) closed the pipe
         return 0
+    if args.mixed_trace:
+        return run_mixed(args.namespace, args.accelerator, args.timeout,
+                         workers=args.workers)
     if args.soak:
         return run_soak(args.count, args.accelerator, args.timeout,
                         managers=max(args.managers, 1),

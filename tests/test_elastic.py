@@ -81,11 +81,27 @@ def test_resize_noop_and_bounds(tmp_path):
                         tmp_path / "ckpt",
                         devices=jax.devices()[:8]) as et:
         et.resize(2)  # no-op, no checkpoint roundtrip
-        assert et.resize_events == []
+        assert list(et.resize_events) == []
         with pytest.raises(ValueError, match=">= 1"):
             et.resize(0)
         with pytest.raises(ValueError, match="exceed"):
             et.resize(5)  # 5 × 2 devices > 8 available
+
+
+def test_resize_events_bounded_oldest_dropped(tmp_path):
+    """resize_events is capped like TrainerStats history (deque maxlen):
+    a long-lived run under preemption churn keeps only the newest
+    events — the oldest entry is the one dropped."""
+    per = MeshConfig(dp=1, fsdp=2)
+    with ElasticTrainer(per, 3, tiny_config(),
+                        TrainConfig(warmup_steps=1),
+                        tmp_path / "ckpt",
+                        devices=jax.devices()[:8],
+                        resize_events_cap=1) as et:
+        et.shrink()   # (3, 2, 0, _) — dropped when the next lands
+        et.grow()     # (2, 3, 0, _) — the survivor
+        assert et.resize_events.maxlen == 1
+        assert [(a, b) for a, b, _, _ in et.resize_events] == [(2, 3)]
 
 
 def test_checkpoint_dir_is_mandatory():

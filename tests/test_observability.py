@@ -341,6 +341,45 @@ def test_slice_pool_metric_families_exported():
     assert 'notebook_migrations_total{outcome="fallback"} 1' in text
 
 
+# ------------------------------------------- fleet scheduler metric families
+
+def test_scheduler_metric_families_exported():
+    """The fleet-scheduler families land in one exposition with their
+    label shapes: scheduler_admissions_total by tenant+outcome,
+    scheduler_preemptions_total by tier+outcome,
+    scheduler_gang_wait_seconds by tenant, and scheduler_quota_used by
+    tenant — the gauge computed at scrape time from the fleet's
+    annotations, the same usage derivation admission runs on. End-to-end
+    values are pinned in tests/test_scheduler.py."""
+    from kubeflow_tpu.api import types as api
+    from kubeflow_tpu.controllers.scheduler import SchedulerReconciler
+
+    store = ClusterStore()
+    api.install_notebook_crd(store)
+    metrics = MetricsRegistry()
+    sched = SchedulerReconciler(store, ControllerConfig(), metrics)
+    store.create(api.new_notebook("train", "team-a", annotations={
+        names.ELASTIC_ANNOTATION: "true",
+        names.ELASTIC_SLICES_ANNOTATION: "3",
+        names.ELASTIC_CURRENT_SLICES_ANNOTATION: "3",
+    }))
+    sched.admissions_total.inc({"tenant": "team-a", "outcome": "admitted"})
+    sched.admissions_total.inc({"tenant": "team-a",
+                                "outcome": "quota-denied"})
+    sched.preemptions_total.inc({"tier": "training",
+                                 "outcome": "scheduled"})
+    sched.gang_wait.observe(1.5, {"tenant": "team-a"})
+    text = metrics.expose()
+    assert ('scheduler_admissions_total{outcome="admitted",'
+            'tenant="team-a"} 1') in text
+    assert ('scheduler_admissions_total{outcome="quota-denied",'
+            'tenant="team-a"} 1') in text
+    assert ('scheduler_preemptions_total{outcome="scheduled",'
+            'tier="training"} 1') in text
+    assert 'scheduler_gang_wait_seconds_count{tenant="team-a"} 1' in text
+    assert 'scheduler_quota_used{tenant="team-a"} 3' in text
+
+
 # --------------------------------- sharded control plane + APF families
 
 def test_shard_and_apf_metric_families_exported():
@@ -955,6 +994,10 @@ METRIC_FAMILY_CATALOG = {
     "rest_client_requests_total",
     "rest_client_retries_total",
     "sanitizer_violations_total",
+    "scheduler_admissions_total",
+    "scheduler_gang_wait_seconds",
+    "scheduler_preemptions_total",
+    "scheduler_quota_used",
     "serving_generate_seconds_count",
     "serving_generate_seconds_sum",
     "serving_http_requests_total",

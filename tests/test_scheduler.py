@@ -1,0 +1,469 @@
+"""Fleet scheduler (controllers/scheduler.py): gang admission, tenant
+quota, and tier preemption routed through the elastic shrink handshake —
+the controller half of the sched-admission machine, driven against the
+live manager the way test_slice_repair.py drives the repair ladder."""
+
+import time
+
+import pytest
+
+from kubeflow_tpu.api import slicepool as pool_api
+from kubeflow_tpu.api import tpuquota as quota_api
+from kubeflow_tpu.api import types as api
+from kubeflow_tpu.api.tpuquota import (install_tpuquota_crd, new_tpu_quota,
+                                       validate_tpu_quota)
+from kubeflow_tpu.cluster.errors import InvalidError
+from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
+from kubeflow_tpu.cluster.store import ClusterStore
+from kubeflow_tpu.controllers import (Manager, NotebookReconciler,
+                                      SchedulerReconciler,
+                                      SliceRepairReconciler)
+from kubeflow_tpu.controllers.scheduler import (SCHED_ADMITTED,
+                                                SCHED_PENDING,
+                                                SCHED_RESERVING,
+                                                notebook_usage, sched_state)
+from kubeflow_tpu.utils import k8s, names
+from kubeflow_tpu.utils.config import ControllerConfig
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+NS = "sched-ns"
+
+
+def fast_config(**overrides) -> ControllerConfig:
+    defaults = dict(sched_poll_s=0.02,
+                    sched_admission_grace_s=0.4,
+                    sched_default_capacity=4,
+                    slice_repair_backoff_base_s=0.01,
+                    slice_repair_backoff_max_s=0.05,
+                    slice_repair_poll_s=0.02)
+    defaults.update(overrides)
+    return ControllerConfig(**defaults)
+
+
+class SchedWorld:
+    """Started manager + core/repair/scheduler reconcilers + kubelet sim:
+    the full admission path from gang annotation to (gated) STS roll."""
+
+    def __init__(self, store, config=None, scheduler=True):
+        self.store = store
+        self.config = config or fast_config()
+        self.metrics = MetricsRegistry()
+        install_tpuquota_crd(store)
+        from kubeflow_tpu.api.slicepool import install_slicepool_crd
+        install_slicepool_crd(store)
+        self.mgr = Manager(store)
+        NotebookReconciler(store, self.config, self.metrics).setup(self.mgr)
+        SliceRepairReconciler(store, self.config,
+                              self.metrics).setup(self.mgr)
+        self.scheduler = None
+        if scheduler:
+            self.scheduler = SchedulerReconciler(store, self.config,
+                                                 self.metrics)
+            self.scheduler.setup(self.mgr)
+        self.sim = StatefulSetSimulator(store, boot_delay_s=0.0,
+                                        node_grace_s=0.05)
+        self.sim.setup(self.mgr)
+        self.mgr.start()
+
+    def create_gang(self, name, slices, tier=None, ns=NS,
+                    accelerator="v5e-16"):
+        annotations = {names.TPU_ACCELERATOR_ANNOTATION: accelerator,
+                       names.SCHED_GANG_ANNOTATION: str(slices)}
+        if tier is not None:
+            annotations[names.SCHED_TIER_ANNOTATION] = tier
+        self.store.create(api.new_notebook(name, ns,
+                                           annotations=annotations))
+
+    def create_elastic(self, name="train", slices=3, ns=NS):
+        self.store.create(api.new_notebook(name, ns, annotations={
+            names.TPU_ACCELERATOR_ANNOTATION: "v5e-16",
+            names.ELASTIC_ANNOTATION: "true",
+            names.ELASTIC_SLICES_ANNOTATION: str(slices),
+            names.ELASTIC_CURRENT_SLICES_ANNOTATION: str(slices),
+        }))
+
+    def notebook(self, name, ns=NS):
+        return self.store.get(api.KIND, ns, name)
+
+    def state(self, name, ns=NS):
+        return sched_state(self.notebook(name, ns))
+
+    def anno(self, name, annotation, ns=NS):
+        return k8s.get_annotation(self.notebook(name, ns), annotation)
+
+    def set_anno(self, name, annotations, ns=NS):
+        self.store.patch(api.KIND, ns, name,
+                         {"metadata": {"annotations": annotations}})
+
+    def rolled(self, name, ns=NS):
+        stss = self.store.list("StatefulSet", ns,
+                               {names.NOTEBOOK_NAME_LABEL: name})
+        return bool(stss)
+
+    def events(self, ns=NS):
+        return {e["reason"] for e in self.store.list("Event", ns)}
+
+    def counter(self, family, labels):
+        return self.metrics.counter(family, "").get(labels)
+
+    def wait(self, predicate, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if predicate():
+                return True
+            time.sleep(0.01)
+        return bool(predicate())
+
+    def stop(self):
+        self.mgr.stop()
+
+
+@pytest.fixture
+def world(store):
+    w = SchedWorld(store)
+    yield w
+    w.stop()
+
+
+# ----------------------------------------------------------- admission
+def test_gang_admission_two_phase_then_roll(world):
+    """The happy path walks Idle → Pending → Reserving → Admitted, the
+    reservation annotation survives into Admitted (it IS the usage
+    record), and the core reconciler rolls the StatefulSet only once the
+    verdict lands."""
+    world.create_gang("g1", 2, tier="interactive")
+    assert world.wait(lambda: world.state("g1") == SCHED_ADMITTED), \
+        "gang never admitted"
+    assert world.anno("g1", names.SCHED_RESERVED_ANNOTATION) == "2"
+    assert world.anno("g1", names.SCHED_ENQUEUED_AT_ANNOTATION) is not None
+    assert world.wait(lambda: world.rolled("g1")), \
+        "admitted gang never rolled its StatefulSet"
+    assert world.wait(lambda: "GangAdmitted" in world.events())
+    assert world.counter("scheduler_admissions_total",
+                         {"tenant": NS, "outcome": "admitted"}) >= 1
+    assert world.metrics.histogram(
+        "scheduler_gang_wait_seconds", "").total_count() >= 1
+    assert notebook_usage(world.notebook("g1")) == 2
+
+
+def test_non_gang_notebook_bypasses_the_scheduler(world):
+    """No gang annotation → no admission hold, no sched state ever
+    stamped: the fleet scheduler is strictly opt-in."""
+    world.store.create(api.new_notebook("plain", NS, annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16"}))
+    assert world.wait(lambda: world.rolled("plain"))
+    assert world.state("plain") is None
+
+
+def test_quota_denies_until_quota_lifted(world):
+    """A TPUQuota below the gang size keeps it Pending (and unrolled);
+    deleting the quota admits it — quota gates new grants only."""
+    world.store.create(new_tpu_quota("cap", NS, 1))
+    world.create_gang("g1", 2)
+    assert world.wait(lambda: world.counter(
+        "scheduler_admissions_total",
+        {"tenant": NS, "outcome": "quota-denied"}) >= 2)
+    assert world.state("g1") == SCHED_PENDING
+    assert not world.rolled("g1")
+
+    world.store.delete(quota_api.KIND, "", "cap")
+    assert world.wait(lambda: world.state("g1") == SCHED_ADMITTED), \
+        "gang never admitted after the quota lifted"
+    assert world.wait(lambda: world.rolled("g1"))
+
+
+def test_min_quota_wins_across_duplicates(world):
+    """Two quotas naming one tenant resolve to the MINIMUM — the
+    conservative read that makes duplicate applies harmless."""
+    world.store.create(new_tpu_quota("cap-a", NS, 3))
+    world.store.create(new_tpu_quota("cap-b", NS, 1))
+    assert world.scheduler._tenant_quota(NS) == 1
+    assert quota_api.tenant_quota(world.store, NS) == 1
+    assert quota_api.tenant_quota(world.store, "other-ns") is None
+
+
+def test_capacity_blocks_second_gang_until_release(world):
+    """Gang atomicity at the capacity edge: a gang that cannot get ALL
+    its slices gets none; releasing the incumbent (annotation removed)
+    frees the whole reservation in one patch and the waiter admits."""
+    world.create_gang("g1", 3)
+    assert world.wait(lambda: world.state("g1") == SCHED_ADMITTED)
+    world.create_gang("g2", 2)
+    assert world.wait(lambda: world.counter(
+        "scheduler_admissions_total",
+        {"tenant": NS, "outcome": "no-capacity"}) >= 2)
+    assert world.state("g2") == SCHED_PENDING
+
+    world.set_anno("g1", {names.SCHED_GANG_ANNOTATION: None})
+    assert world.wait(lambda: world.state("g2") == SCHED_ADMITTED), \
+        "waiter never admitted after the incumbent released"
+    assert world.wait(lambda: world.state("g1") is None)
+    assert world.anno("g1", names.SCHED_RESERVED_ANNOTATION) is None
+    assert world.wait(lambda: "GangReleased" in world.events())
+
+
+def test_gang_fits_requires_one_topology_bin(world):
+    """With SlicePools declaring per-accelerator capacity, a gang must
+    land WHOLE in one bin: 3 slices across two 2-slice pools is refused
+    even though 4 are free in aggregate; a 2-slice gang admits."""
+    world.store.create(pool_api.new_slice_pool("pool-a", "v5e-16", 2))
+    world.store.create(pool_api.new_slice_pool("pool-b", "v5e-32", 2))
+    world.create_gang("wide", 3)
+    assert world.wait(lambda: world.counter(
+        "scheduler_admissions_total",
+        {"tenant": NS, "outcome": "no-capacity"}) >= 2)
+    assert world.state("wide") == SCHED_PENDING
+    world.create_gang("narrow", 2)
+    assert world.wait(lambda: world.state("narrow") == SCHED_ADMITTED)
+    assert world.state("wide") == SCHED_PENDING
+
+
+# ------------------------------------------------------- crash recovery
+def test_reserving_state_found_at_startup_converges_to_admitted(world):
+    """A notebook arriving already in Reserving (the controller crashed
+    between reserve and admit) is verified from annotations alone and
+    completes the admission — no in-memory state required."""
+    world.store.create(api.new_notebook("crashed", NS, annotations={
+        names.SCHED_GANG_ANNOTATION: "2",
+        names.SCHED_STATE_ANNOTATION: SCHED_RESERVING,
+        names.SCHED_RESERVED_ANNOTATION: "2",
+        names.SCHED_ENQUEUED_AT_ANNOTATION: "%.3f" % time.time(),
+    }))
+    assert world.wait(lambda: world.state("crashed") == SCHED_ADMITTED)
+
+
+def test_stale_reservation_over_capacity_reverts(world):
+    """A Reserving gang whose capacity disappeared (here: an elastic run
+    holding 3 of 4 slices) reverts to Pending and clears its
+    reservation — never admitted over capacity, never leaked."""
+    world.create_elastic("train", slices=3)
+    world.store.create(api.new_notebook("crashed", NS, annotations={
+        names.SCHED_GANG_ANNOTATION: "2",
+        names.SCHED_TIER_ANNOTATION: "training",
+        names.SCHED_STATE_ANNOTATION: SCHED_RESERVING,
+        names.SCHED_RESERVED_ANNOTATION: "2",
+    }))
+    assert world.wait(
+        lambda: world.state("crashed") == SCHED_PENDING and
+        world.anno("crashed", names.SCHED_RESERVED_ANNOTATION) is None), \
+        "stale reservation never reverted"
+    assert world.counter("scheduler_admissions_total",
+                         {"tenant": NS, "outcome": "reverted"}) >= 1
+    assert world.wait(lambda: "GangReservationReverted" in world.events())
+
+
+# ----------------------------------------------------------- preemption
+def test_interactive_gang_preempts_training_through_elastic_handshake(
+        world):
+    """The full cascade: an interactive gang that cannot fit stamps the
+    elastic Draining handoff on a training victim, the agent drains and
+    reshards (step counter monotone — preemption is a migration, not a
+    kill), the freed slice admits the gang, and releasing the gang
+    clears the hold so the victim grows back."""
+    from kubeflow_tpu.runtime.elastic import SimulatedElasticAgent
+
+    world.create_elastic("train", slices=3)
+    assert world.wait(lambda: world.rolled("train"))
+    agent = SimulatedElasticAgent(world.store, NS, "train",
+                                  current_slices=3).start()
+    try:
+        world.create_gang("burst", 2, tier="interactive")
+        # the scheduler stamps the victim's drain + the grow-back hold
+        assert world.wait(
+            lambda: world.anno("train",
+                               names.SCHED_PREEMPTED_ANNOTATION) ==
+            f"{NS}/burst"), "preemption hold never stamped"
+        assert world.wait(lambda: agent.current == 2), \
+            "victim never drained to 2 slices"
+        assert world.wait(lambda: world.state("burst") == SCHED_ADMITTED), \
+            "gang never admitted after the drain freed a slice"
+        assert world.counter("scheduler_preemptions_total",
+                             {"tier": "training",
+                              "outcome": "scheduled"}) >= 1
+        assert world.wait(lambda: "GangPreempting" in world.events())
+        # the hold keeps the repair controller from growing back while
+        # the preemptor is entitled to the capacity
+        time.sleep(0.2)
+        assert agent.current == 2
+
+        world.set_anno("burst", {names.SCHED_GANG_ANNOTATION: None})
+        assert world.wait(
+            lambda: world.anno(
+                "train", names.SCHED_PREEMPTED_ANNOTATION) is None), \
+            "hold never swept after the preemptor released"
+        assert world.wait(lambda: agent.current == 3, timeout=15), \
+            "victim never grew back after the hold cleared"
+        assert agent.violations == []
+        assert agent.resizes == 2
+        assert world.counter("scheduler_preemptions_total",
+                             {"tier": "training",
+                              "outcome": "released"}) >= 1
+        assert world.wait(
+            lambda: "GangPreemptionReleased" in world.events())
+    finally:
+        agent.stop()
+
+
+def test_gang_admitted_elastic_victim_reservation_yields_to_preemption(
+        world):
+    """An elastic run that ENTERED via gang admission carries its
+    admission-size ``sched-reserved`` annotation while Admitted. When it
+    is later preempted, the capped entitlement — not that stale
+    reservation — must be its ledger count, or the freed slice never
+    shows up as capacity: the preemptor's gang stays Pending and the
+    scheduler keeps cascading the victim down to the last-slice guard.
+    Capacity 4, victim admitted at 4 → one preemption must admit a
+    1-slice interactive gang, and the victim must shrink exactly once."""
+    from kubeflow_tpu.runtime.elastic import SimulatedElasticAgent
+
+    world.store.create(api.new_notebook("train", NS, annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16",
+        names.ELASTIC_ANNOTATION: "true",
+        names.ELASTIC_SLICES_ANNOTATION: "4",
+        names.SCHED_GANG_ANNOTATION: "4",
+        names.SCHED_TIER_ANNOTATION: "training",
+    }))
+    assert world.wait(lambda: world.state("train") == SCHED_ADMITTED), \
+        "training gang never admitted"
+    assert world.anno("train", names.SCHED_RESERVED_ANNOTATION) == "4"
+    agent = SimulatedElasticAgent(world.store, NS, "train",
+                                  current_slices=4).start()
+    try:
+        world.create_gang("urgent", 1, tier="interactive")
+        assert world.wait(lambda: agent.current == 3), \
+            "victim never drained"
+        assert world.wait(lambda: world.state("urgent") == SCHED_ADMITTED), \
+            "gang never admitted off the victim's freed slice — the " \
+            "stale admission reservation is pinning the ledger"
+        # exactly one shrink: the freed slice satisfied the gang, so the
+        # cascade must not have run the victim further down
+        time.sleep(0.2)
+        assert agent.current == 3
+        assert world.counter("scheduler_preemptions_total",
+                             {"tier": "training",
+                              "outcome": "scheduled"}) == 1
+
+        world.set_anno("urgent", {names.SCHED_GANG_ANNOTATION: None,
+                                  names.SCHED_TIER_ANNOTATION: None})
+        assert world.wait(lambda: agent.current == 4, timeout=15), \
+            "victim never grew back to its admitted size"
+        assert agent.violations == []
+    finally:
+        agent.stop()
+
+
+def test_grow_back_headroom_is_never_readmitted(world):
+    """A shrunk-but-unheld elastic run (hold swept, grow-back pending)
+    counts at its REQUESTED size: the capacity it is about to grow back
+    into is the victim's, not the queue's. Admitting a gang into that
+    window would oversubscribe the fleet the moment the grow lands.
+    Capacity 4, run at current=2/requested=3 → entitlement 3, so a
+    2-slice gang must wait while a 1-slice gang still fits."""
+    world.store.create(api.new_notebook("train", NS, annotations={
+        names.TPU_ACCELERATOR_ANNOTATION: "v5e-16",
+        names.ELASTIC_ANNOTATION: "true",
+        names.ELASTIC_SLICES_ANNOTATION: "3",
+        names.ELASTIC_CURRENT_SLICES_ANNOTATION: "2",
+    }))
+    assert notebook_usage(world.notebook("train")) == 3
+    world.create_gang("greedy", 2)  # no tier → training, never preempts
+    assert world.wait(lambda: world.counter(
+        "scheduler_admissions_total",
+        {"tenant": NS, "outcome": "no-capacity"}) >= 3)
+    assert world.state("greedy") == SCHED_PENDING
+    world.create_gang("modest", 1)
+    assert world.wait(lambda: world.state("modest") == SCHED_ADMITTED), \
+        "the one genuinely free slice stopped admitting"
+    assert world.state("greedy") == SCHED_PENDING
+
+
+def test_equal_tier_never_preempts(world):
+    """A training-tier gang (the default) cannot preempt a training
+    victim: it waits at Pending and the victim is untouched — only
+    strictly higher tiers preempt."""
+    world.create_elastic("train", slices=3)
+    world.create_gang("peer", 2)  # no tier → training
+    assert world.wait(lambda: world.counter(
+        "scheduler_admissions_total",
+        {"tenant": NS, "outcome": "no-capacity"}) >= 3)
+    assert world.state("peer") == SCHED_PENDING
+    assert world.anno("train", names.ELASTIC_RESIZE_ANNOTATION) is None
+    assert world.anno("train", names.SCHED_PREEMPTED_ANNOTATION) is None
+
+
+def test_victim_on_last_slice_is_never_preempted(world):
+    """An elastic run down to one slice cannot shrink further: the gang
+    waits rather than killing the run."""
+    world.store.create(api.new_notebook("train", NS, annotations={
+        names.ELASTIC_ANNOTATION: "true",
+        names.ELASTIC_SLICES_ANNOTATION: "1",
+        names.ELASTIC_CURRENT_SLICES_ANNOTATION: "1",
+    }))
+    world.create_gang("burst", 4, tier="interactive")
+    assert world.wait(lambda: world.counter(
+        "scheduler_admissions_total",
+        {"tenant": NS, "outcome": "no-capacity"}) >= 2)
+    assert world.anno("train", names.ELASTIC_RESIZE_ANNOTATION) is None
+    assert world.anno("train", names.SCHED_PREEMPTED_ANNOTATION) is None
+    assert world.state("burst") == SCHED_PENDING
+
+
+# --------------------------------------------------- dead-scheduler path
+def test_dead_scheduler_grace_degrades_to_unscheduled_roll(store):
+    """With no scheduler running and no sched-state ever stamped, the
+    core reconciler proceeds after the grace window with a warning
+    event — a down scheduler must never strand creation."""
+    w = SchedWorld(store, config=fast_config(sched_admission_grace_s=0.2),
+                   scheduler=False)
+    try:
+        w.create_gang("g1", 2)
+        time.sleep(0.1)
+        assert not w.rolled("g1"), "gate must hold inside the grace window"
+        assert w.wait(lambda: w.rolled("g1")), \
+            "notebook never rolled after the dead-scheduler grace"
+        assert "SchedulerAdmissionTimeout" in w.events()
+        assert w.state("g1") is None
+    finally:
+        w.stop()
+
+
+def test_scheduler_progress_disables_the_grace_timeout(store):
+    """Once the scheduler has stamped ANY state, the core waits
+    indefinitely: gang atomicity outranks the grace degrade (the
+    operator's exit is withdrawing the gang annotation)."""
+    w = SchedWorld(store, config=fast_config(sched_admission_grace_s=0.2),
+                   scheduler=False)
+    try:
+        w.store.create(api.new_notebook("g1", NS, annotations={
+            names.TPU_ACCELERATOR_ANNOTATION: "v5e-16",
+            names.SCHED_GANG_ANNOTATION: "2",
+            names.SCHED_STATE_ANNOTATION: SCHED_PENDING,
+        }))
+        time.sleep(0.6)  # several grace windows
+        assert not w.rolled("g1"), \
+            "a queued gang must not cold-roll out from under admission"
+        assert "SchedulerAdmissionTimeout" not in w.events()
+    finally:
+        w.stop()
+
+
+# ------------------------------------------------------------ API layer
+def test_tpuquota_validation_and_builder():
+    """The CRD admission enforces the wire shape new_tpu_quota builds."""
+    store = ClusterStore()
+    install_tpuquota_crd(store)
+    store.create(new_tpu_quota("ok", "team-a", 0))  # 0 = explicit freeze
+    with pytest.raises(InvalidError, match="tenant"):
+        store.create({"apiVersion": quota_api.API_VERSION,
+                      "kind": quota_api.KIND,
+                      "metadata": {"name": "no-tenant"},
+                      "spec": {"maxSlices": 2}})
+    with pytest.raises(InvalidError, match="non-negative"):
+        store.create(new_tpu_quota("neg", "team-a", -1))
+    with pytest.raises(InvalidError, match="non-negative"):
+        # raw wire dict: the builder would coerce the bool away
+        validate_tpu_quota({"apiVersion": quota_api.API_VERSION,
+                            "kind": quota_api.KIND,
+                            "metadata": {"name": "bool"},
+                            "spec": {"tenant": "team-a",
+                                     "maxSlices": True}})
