@@ -10,7 +10,7 @@ TEST_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
         manifests-check check-license bench numerics ctx-sweep mfu-ab capture \
         spec-acceptance prefix-cache-ab chunked-prefill-ab dryrun loadtest \
         loadtest-faults loadtest-preempt loadtest-sharded loadtest-soak \
-        run run-split
+        loadtest-frontends run run-split
 
 help: ## Display this help.
 	@awk 'BEGIN {FS = ":.*##"} /^[a-zA-Z_-]+:.*?##/ {printf "  %-16s %s\n", $$1, $$2}' $(MAKEFILE_LIST)
@@ -50,6 +50,9 @@ loadtest-sharded: ## 200-notebook wire fan-out across 2 sharded managers (4 shar
 
 loadtest-soak: ## 100k-notebook sharded soak, in-process, event-driven kubelet ticks.
 	$(TEST_ENV) $(PYTHON) loadtest/start_notebooks.py --soak --count 100000 --managers 2 --shards 32 --namespace-count 256 --accelerator v5e-1
+
+loadtest-frontends: ## 200-notebook fan-out over 2 replicated binary-wire apiserver frontends, frontend 0 killed mid-run.
+	$(TEST_ENV) $(PYTHON) loadtest/start_notebooks.py --count 200 --managers 2 --shards 4 --namespace-count 8 --frontends 2 --wire-format binary --kill-frontend-at 0.5
 
 test-transport: ## Real-HTTP transport + multi-process HA tier.
 	$(TEST_ENV) $(PYTHON) -m pytest tests/test_http_transport.py tests/test_http_stack.py tests/test_cli.py tests/test_multihost.py -q

@@ -13,10 +13,13 @@ reconciles, sub-linear wall, crash failover with no lost notebooks), a
 tenant-LIST-storm APF isolation check (controller p95 within 2x quiet),
 warm-vs-cold bind, watch-kill RV-resume, node-preemption repair, a
 flight-recorder traced run (every notebook must show a complete
-enqueue→queue-wait→reconcile→wire trace with intact parentage), and a
+enqueue→queue-wait→reconcile→wire trace with intact parentage), a
 mixed-trace fleet-scheduler run (interactive storm + serving burst +
 background elastic training: no tier starves, utilization floor holds,
-the fleet is never oversubscribed).
+the fleet is never oversubscribed), and a replicated-frontend run (two
+apiserver frontends over one sharded store, JSON baseline then binary
+wire with a mid-run frontend kill: fan-out bytes/event cut >= 2x, zero
+lost or duplicated watch events across the kill, zero relists).
 
 Budget rationale: the run takes ~2 s on a quiet dev box; the default 60 s
 budget is ~30x headroom, loose enough to survive a loaded CI box yet tight
@@ -44,9 +47,11 @@ sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 DEFAULT_COUNT = 50
 DEFAULT_WORKERS = 4
 # raised from 60 s when the sharded (1-mgr baseline + 2-mgr + failover)
-# and tenant-storm (quiet + storm) phases joined: a quiet box runs the
-# full set in ~30 s, so 90 s keeps the ~3x contention headroom
-DEFAULT_BUDGET_S = 90.0
+# and tenant-storm (quiet + storm) phases joined, then to 120 s when the
+# replicated-frontend pair (JSON baseline + binary kill run) joined: a
+# quiet box runs the full set in ~35 s, so 120 s keeps the ~3x
+# contention headroom
+DEFAULT_BUDGET_S = 120.0
 # steady-state ceiling: measured ≈5-5.5 req/notebook at this fan-out after
 # the indexed-read/minimal-write path; 12 is ~2x headroom for a loaded CI
 # box while sitting BELOW the 15-19 req/nb the pre-index write path
@@ -65,11 +70,15 @@ LIST_PAGE_SIZE = 20
 # repairs legitimately add writes.
 PREEMPT_COUNT = 16
 PREEMPT_RATE = 0.25
-# the request path must ride the keep-alive pool: ≥10 requests per opened
-# pooled TCP connection on the clean fan-out (the acceptance bound; a
-# healthy run measures 20-40x — connections scale with threads, not
-# requests)
-MIN_CONN_REUSE = 10.0
+# the request path must ride the keep-alive pool: ≥7 requests per opened
+# pooled TCP connection on the clean fan-out (the acceptance bound —
+# connections scale with threads, not requests). Lowered from 10 when
+# watch() gained initial-cache-sync blocking: double-delivered ADDEDs no
+# longer trigger redundant reconcile GETs, so the healthy-run request
+# count (the numerator) dropped to ~250-390 over the same ~31
+# thread-scaled connections (8-12x); a pooling regression still reads
+# ~1x
+MIN_CONN_REUSE = 7.0
 # watch-kill phase: every watch stream is killed this long after connect
 # for the whole run, plus an idle-fleet settle window. Every reconnect
 # must RESUME from the server watch cache by resourceVersion: zero full
@@ -82,13 +91,15 @@ WATCH_KILL_SETTLE_S = 1.5
 # simulated 250 ms/pod provisioning cost, then warm-bind against a
 # pre-warmed SlicePool. Pins the bind path's contract: every notebook
 # binds (zero misses — run_wire fails those internally), bind-path
-# req/nb at or below the cold path, p50 at least 2x faster (at this
-# token provisioning delay; the RESULTS.md table shows 5-7x at a
-# realistic 5 s) and, via the always-on watch observer, zero
-# partial-replica states during bind/release.
+# req/nb at or below the cold path, and warm p50 saves at least 40% of
+# the provisioning delay (the RESULTS.md table shows 5-7x p50 speedups
+# at a realistic 5 s delay). The bound is the ABSOLUTE p50 saving, not
+# a ratio: a loaded CI box inflates both runs' fixed overhead, which
+# sinks a ratio while leaving the skipped-provisioning saving intact —
+# a real bind-path regression (warm paying the boot delay) reads ~0.
 WARM_COLD_COUNT = 15
 WARM_COLD_BOOT_MS = 250.0
-WARM_MIN_SPEEDUP = 2.0
+WARM_MIN_SAVED_FRAC = 0.4
 # sharded control-plane phase: 2 managers × 4 shards over the wire, the
 # same fan-out first run with 1 manager as its baseline. Pins: ZERO
 # duplicate-owner reconciles (lease-enforced shard ownership), sub-linear
@@ -137,6 +148,20 @@ MIXED_WAVES = 2
 MIXED_WAVE_SIZE = 3
 MIXED_DWELL_S = 0.3
 MIXED_MIN_UTILIZATION = 0.5
+# replicated-frontend phase: the same sharded fan-out served by TWO
+# ApiServerProxy frontends over ONE sharded store, run twice — JSON wire
+# as the bytes/event baseline, then binary wire with frontend 0
+# hard-stopped at half convergence. Pins: the binary codec cuts watch
+# fan-out bytes/event by >= 2x against the SAME workload on JSON (the
+# serialize-once contract measured, not asserted), and the frontend kill
+# loses exactly zero watch events — run_sharded's always-on JSON observer
+# diffs its delivered (type, name, rv) record against the store's resume
+# ring and fails itself on any lost, duplicated, or relist-recovered
+# event (the resume-cursor check), plus zero duplicate-owner reconciles.
+FRONTEND_COUNT = 2
+FRONTEND_NB = 30
+FRONTEND_KILL_AT = 0.5
+FRONTEND_BYTES_RATIO = 2.0
 # traced phase: a small fan-out with the flight-recorder tracing provider
 # installed. run_wire --trace fails internally unless EVERY notebook has a
 # complete CR→Ready lifecycle trace (enqueue → queue-wait → reconcile root
@@ -151,7 +176,8 @@ def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
               preempt: bool = True, watch_kill: bool = True,
               warm_cold: bool = True, sharded: bool = True,
               storm: bool = True, traced: bool = True,
-              mixed: bool = True, sanitize: bool = False) -> int:
+              mixed: bool = True, frontends: bool = True,
+              sanitize: bool = False) -> int:
     """Run the wire fan-out; return nonzero on any failed bound.
 
     ``sanitize`` defaults OFF, unlike chaos_smoke: this is the PERF
@@ -173,7 +199,8 @@ def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
                   "measure instrumented locks")
             return 1
         rc = _run_phases(count, workers, budget_s, preempt, watch_kill,
-                         warm_cold, sharded, storm, traced, mixed)
+                         warm_cold, sharded, storm, traced, mixed,
+                         frontends)
         if rc == 0 and sanitize:
             violations = sanitizer.get_sanitizer().violations()
             if violations:
@@ -190,7 +217,7 @@ def run_smoke(count: int = DEFAULT_COUNT, workers: int = DEFAULT_WORKERS,
 def _run_phases(count: int, workers: int, budget_s: float,
                 preempt: bool, watch_kill: bool, warm_cold: bool,
                 sharded: bool, storm: bool, traced: bool,
-                mixed: bool) -> int:
+                mixed: bool, frontends: bool = True) -> int:
     from loadtest.start_notebooks import run_mixed, run_sharded, run_wire
 
     t0 = time.monotonic()
@@ -227,10 +254,15 @@ def _run_phases(count: int, workers: int, budget_s: float,
               f"({cold_p50 / max(warm_p50, 1e-9):.1f}x), req/nb "
               f"{warm_stats['req_per_nb']:.1f} vs "
               f"{cold_stats['req_per_nb']:.1f}")
-        if warm_p50 * WARM_MIN_SPEEDUP > cold_p50:
-            print(f"SMOKE FAIL: warm-bind p50 {warm_p50 * 1000:.0f}ms is "
-                  f"not {WARM_MIN_SPEEDUP:.0f}x faster than cold "
-                  f"{cold_p50 * 1000:.0f}ms (bind path regressed)")
+        min_saved_s = WARM_COLD_BOOT_MS / 1000.0 * WARM_MIN_SAVED_FRAC
+        if cold_p50 - warm_p50 < min_saved_s:
+            print(f"SMOKE FAIL: warm-bind p50 {warm_p50 * 1000:.0f}ms "
+                  f"saves only {(cold_p50 - warm_p50) * 1000:.0f}ms over "
+                  f"cold {cold_p50 * 1000:.0f}ms (< "
+                  f"{min_saved_s * 1000:.0f}ms = "
+                  f"{WARM_MIN_SAVED_FRAC:.0%} of the "
+                  f"{WARM_COLD_BOOT_MS:.0f}ms provisioning delay — bind "
+                  f"path regressed)")
             return 1
         if warm_stats["req_per_nb"] > cold_stats["req_per_nb"] + 0.5:
             # +0.5 absolute slack: the two runs race background noise,
@@ -307,6 +339,67 @@ def _run_phases(count: int, workers: int, budget_s: float,
         if rc != 0:
             print(f"SMOKE FAIL: sharded failover phase violated (rc={rc})")
             return rc
+    if frontends:
+        json_stats: dict = {}
+        bin_stats: dict = {}
+        # baseline: identical workload on the JSON wire (no kill) — the
+        # denominator for the bytes/event ratio and proof the integrity
+        # observer sees a healthy replicated fleet
+        rc = run_sharded(FRONTEND_NB, "fe-json", "v5e-4",
+                         timeout=max(budget_s - (time.monotonic() - t0),
+                                     20.0),
+                         managers=SHARD_MANAGERS, shards=SHARD_SHARDS,
+                         workers=workers,
+                         namespace_count=SHARD_NAMESPACES,
+                         frontends=FRONTEND_COUNT, wire_format="json",
+                         stats_out=json_stats)
+        if rc == 0:
+            # binary wire + frontend 0 hard-stopped at half convergence:
+            # run_sharded fails internally on any lost/duplicated watch
+            # event, observer relist, or duplicate-owner reconcile
+            rc = run_sharded(FRONTEND_NB, "fe-kill", "v5e-4",
+                             timeout=max(budget_s - (time.monotonic() - t0),
+                                         30.0),
+                             managers=SHARD_MANAGERS, shards=SHARD_SHARDS,
+                             workers=workers,
+                             namespace_count=SHARD_NAMESPACES,
+                             frontends=FRONTEND_COUNT,
+                             wire_format="binary",
+                             kill_frontend_at_frac=FRONTEND_KILL_AT,
+                             stats_out=bin_stats)
+        if rc != 0:
+            print(f"SMOKE FAIL: replicated-frontend bounds violated "
+                  f"(rc={rc})")
+            return rc
+        jf = json_stats.get("fanout", {}).get("json", {})
+        bf = bin_stats.get("fanout", {}).get("binary", {})
+        if not jf.get("frames") or not bf.get("frames"):
+            print("SMOKE FAIL: replicated-frontend phase ran but a wire "
+                  "recorded no watch frames (vacuous-pass guard)")
+            return 1
+        if not bin_stats.get("watch_events"):
+            print("SMOKE FAIL: frontend-kill run delivered no events to "
+                  "the integrity observer (vacuous-pass guard)")
+            return 1
+        if not bin_stats.get("killed_frontend_requests"):
+            print("SMOKE FAIL: the killed frontend served no requests "
+                  "before the kill (vacuous-pass guard)")
+            return 1
+        if not sum(bin_stats.get("frontend_requests", [])[1:]):
+            print("SMOKE FAIL: no surviving frontend served requests "
+                  "after the kill (vacuous-pass guard)")
+            return 1
+        json_bpe = jf["bytes"] / jf["frames"]
+        bin_bpe = bf["bytes"] / bf["frames"]
+        print(f"frontends: binary {bin_bpe:.0f} B/event vs json "
+              f"{json_bpe:.0f} B/event ({json_bpe / bin_bpe:.2f}x), "
+              f"kill-run integrity lost={bin_stats['watch_lost']} "
+              f"dup={bin_stats['watch_dup']}")
+        if bin_bpe * FRONTEND_BYTES_RATIO > json_bpe:
+            print(f"SMOKE FAIL: binary wire {bin_bpe:.0f} B/event is not "
+                  f"{FRONTEND_BYTES_RATIO:.0f}x below the JSON baseline "
+                  f"{json_bpe:.0f} B/event — the codec win regressed")
+            return 1
     if storm:
         quiet_stats: dict = {}
         storm_stats: dict = {}
@@ -384,6 +477,10 @@ def _run_phases(count: int, workers: int, budget_s: float,
     if sharded:
         phases.append(f"{SHARD_MANAGERS}x{SHARD_SHARDS} sharded phase "
                       f"(0 duplicate owners) + failover")
+    if frontends:
+        phases.append(f"{FRONTEND_COUNT}-frontend binary-wire phase "
+                      f"(>= {FRONTEND_BYTES_RATIO:.0f}x fan-out cut, "
+                      f"0 lost events across the kill)")
     if storm:
         phases.append(f"{STORM_THREADS}-thread tenant-storm APF phase")
     if warm_cold:
@@ -423,6 +520,8 @@ def main() -> int:
                     help="skip the flight-recorder traced phase")
     ap.add_argument("--no-mixed", action="store_true",
                     help="skip the mixed-trace fleet-scheduler phase")
+    ap.add_argument("--no-frontends", action="store_true",
+                    help="skip the replicated-frontend binary-wire phase")
     ap.add_argument("--sanitize", action="store_true",
                     help="run armed (concurrency sanitizer): slower, "
                          "fails on any recorded violation. Default off — "
@@ -436,6 +535,7 @@ def main() -> int:
                      storm=not args.no_storm,
                      traced=not args.no_trace,
                      mixed=not args.no_mixed,
+                     frontends=not args.no_frontends,
                      sanitize=args.sanitize)
 
 

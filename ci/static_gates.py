@@ -46,6 +46,11 @@ SANITIZE_SUITE = [
     "tests/test_effects.py",
     "tests/test_schema_gate.py",
     "tests/test_protocol_gate.py",
+    # the sharded-store rebuild and its wire codec run armed here: the
+    # shard→rv lock cascade and the mixed-fleet watch path are exactly
+    # the code the sanitizer's ordering graph exists to police
+    "tests/test_store_sharding.py",
+    "tests/test_wire_codec.py",
 ]
 
 # (name, argv, extra-env, fast) — fast gates run even under --fast.

@@ -37,8 +37,9 @@ from urllib.parse import parse_qs, urlparse
 
 from ..utils import k8s, names, sanitizer, tracing
 from . import apf as apf_mod
-from . import faults, restmapper
-from .errors import ApiError, ConflictError, GoneError, NotFoundError
+from . import codec, faults, restmapper
+from .errors import (ApiError, ConflictError, GoneError, InvalidError,
+                     NotFoundError)
 from .store import EventFrame, WatchEvent, _decode_continue, _encode_continue
 
 log = logging.getLogger("kubeflow_tpu.apiserver")
@@ -73,6 +74,14 @@ def _frame_line(etype: str, frame: EventFrame) -> bytes:
     type envelope is composed per watcher."""
     return b'{"type":"' + etype.encode() + b'","object":' + \
         frame.obj_bytes() + b"}\n"
+
+
+def _frame_line_binary(etype: str, frame: EventFrame) -> bytes:
+    """The binary-wire twin of _frame_line: a length-prefixed frame
+    spliced around the event's cached binary object payload — a mixed
+    fleet (JSON + binary watchers on one ring) encodes each event at
+    most once per format, never per watcher."""
+    return codec.frame_event(etype, frame.obj_bytes_binary())
 
 
 class _WatcherQueue:
@@ -466,9 +475,19 @@ class _Handler(BaseHTTPRequestHandler):
         return got == f"Bearer {token}"
 
     def _send_json(self, code: int, body: dict) -> None:
-        data = json.dumps(body).encode()
+        """Send a success body in the NEGOTIATED encoding: binary when the
+        request's Accept names the binary media type, JSON (the default
+        and the debugging path) otherwise. Error Status bodies always go
+        through _send_error_status as JSON — a client that cannot decode
+        its error would be debugging blind."""
+        if codec.accepts_binary(self.headers.get("Accept")):
+            data = codec.encode(body)
+            ctype = codec.BINARY_CONTENT_TYPE
+        else:
+            data = json.dumps(body).encode()
+            ctype = "application/json"
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()
         # audit BEFORE the body reaches the socket: once the client sees
@@ -497,8 +516,20 @@ class _Handler(BaseHTTPRequestHandler):
         self._send_error_status(err.code, err.reason, err.message)
 
     def _read_body(self) -> dict:
+        """Decode the request body by its Content-Type: the binary media
+        type routes through the codec (a malformed binary body is a typed
+        422 Status — the client treats its own failure to DECODE a binary
+        response as a retryable transport error, but a body the server
+        cannot parse is the sender's bug, not a wire flake); everything
+        else stays on the JSON default."""
         length = int(self.headers.get("Content-Length", 0))
-        return json.loads(self.rfile.read(length) or b"{}")
+        raw = self.rfile.read(length)
+        if codec.accepts_binary(self.headers.get("Content-Type")):
+            try:
+                return codec.decode(raw)
+            except codec.CodecError as exc:
+                raise InvalidError(f"malformed binary body: {exc}") from None
+        return json.loads(raw or b"{}")
 
     def send_response(self, code, message=None):  # noqa: D102 — audit tap
         self._last_status = code
@@ -555,6 +586,13 @@ class _Handler(BaseHTTPRequestHandler):
         # catch-all for paths that never send a full response
         parsed = urlparse(self.path)
         qs = parse_qs(parsed.query)  # parsed ONCE for the whole request
+        # per-frontend request accounting (replicated frontends over one
+        # store): the loadtest's per-frontend table reads this to show
+        # the client-side endpoint spreading actually spread
+        req_lock = getattr(self.server, "req_count_lock", None)
+        if req_lock is not None:
+            with req_lock:
+                self.server.requests_total += 1  # type: ignore[attr-defined]
         self._audit_method = method
         self._audit_path = parsed.path
         self._audit_name = None
@@ -1058,6 +1096,26 @@ class _Handler(BaseHTTPRequestHandler):
         slows the others. BOOKMARK frames carry the resourceVersion the
         stream is complete through — the resume anchor on an idle watch."""
         kind = route.mapping.kind
+        # wire negotiation: a binary-accepting watcher gets length-prefixed
+        # codec frames (cached once per event alongside the JSON bytes —
+        # serialize-once fan-out holds for a mixed fleet); everyone else
+        # gets the NDJSON default
+        binary = codec.accepts_binary(self.headers.get("Accept"))
+        encoding = "binary" if binary else "json"
+        # plain attribute reads (__init__ pre-sets both to None): the
+        # observability label-pin scan resolves these aliases to their
+        # registered families
+        fan_bytes = self.server.watch_fanout_bytes_metric
+        fan_frames = self.server.watch_frames_metric
+
+        def account(payload: bytes) -> None:
+            # fan-out cost accounting per stream encoding: the bytes/event
+            # ratio between the two series is the measured codec win
+            if fan_bytes is not None:
+                fan_bytes.inc({"encoding": encoding}, by=len(payload))
+            if fan_frames is not None:
+                fan_frames.inc({"encoding": encoding})
+
         resume_raw = query.get("resourceVersion")
         since_rv = None
         if resume_raw:
@@ -1108,6 +1166,8 @@ class _Handler(BaseHTTPRequestHandler):
 
         def bookmark_bytes() -> bytes:
             obj = {"metadata": {"resourceVersion": str(stream_rv)}}
+            if binary:
+                return codec.frame_event("BOOKMARK", codec.encode(obj))
             return json.dumps({"type": "BOOKMARK", "object": obj},
                               separators=(",", ":")).encode() + b"\n"
 
@@ -1121,7 +1181,9 @@ class _Handler(BaseHTTPRequestHandler):
                 with self.server.watch_queues_lock:  # type: ignore[attr-defined]
                     queues.add(frame_q)
             self.send_response(200)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type",
+                             codec.BINARY_CONTENT_TYPE if binary
+                             else "application/json")
             self.send_header("Connection", "close")
             self.end_headers()
             self.close_connection = True
@@ -1132,7 +1194,10 @@ class _Handler(BaseHTTPRequestHandler):
             if getattr(self, "_watch_kill_after", None) is not None:
                 kill_at = time.monotonic() + self._watch_kill_after
             for frame in replay:
-                self.wfile.write(_frame_line(frame.type, frame))
+                line = (_frame_line_binary(frame.type, frame) if binary
+                        else _frame_line(frame.type, frame))
+                self.wfile.write(line)
+                account(line)
                 stream_rv = max(stream_rv, frame.rv)
             # connect-time BOOKMARK: hand the client its resume anchor
             # immediately (the real apiserver's initial-events bookmark) —
@@ -1140,7 +1205,9 @@ class _Handler(BaseHTTPRequestHandler):
             # would otherwise have no cursor and pay a full relist on
             # reconnect. Sent even at rv 0: an empty store is a valid
             # anchor, not a missing one.
-            self.wfile.write(bookmark_bytes())
+            connect_bookmark = bookmark_bytes()
+            self.wfile.write(connect_bookmark)
+            account(connect_bookmark)
             self.wfile.flush()
             while not self.server.shutting_down:  # type: ignore[attr-defined]
                 timeout = WATCH_BOOKMARK_INTERVAL_S
@@ -1153,9 +1220,13 @@ class _Handler(BaseHTTPRequestHandler):
                 if legacy_q is not None:
                     try:
                         event: WatchEvent = legacy_q.get(timeout=timeout)
-                        payload = json.dumps(
-                            {"type": event.type,
-                             "object": event.obj}).encode() + b"\n"
+                        if binary:
+                            payload = codec.frame_event(
+                                event.type, codec.encode(event.obj))
+                        else:
+                            payload = json.dumps(
+                                {"type": event.type,
+                                 "object": event.obj}).encode() + b"\n"
                     except queue.Empty:
                         pass
                 else:
@@ -1166,7 +1237,8 @@ class _Handler(BaseHTTPRequestHandler):
                         # from the watch-cache ring, or relists on 410
                         return
                     if frame is not None:
-                        payload = _frame_line(etype, frame)
+                        payload = (_frame_line_binary(etype, frame)
+                                   if binary else _frame_line(etype, frame))
                         stream_rv = max(stream_rv, frame.rv)
                 if payload is None:
                     if kill_at is not None and time.monotonic() >= kill_at:
@@ -1176,6 +1248,7 @@ class _Handler(BaseHTTPRequestHandler):
                     # anchor when no events are flowing
                     payload = bookmark_bytes()
                 self.wfile.write(payload)
+                account(payload)
                 self.wfile.flush()
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
@@ -1237,6 +1310,14 @@ class ApiServerProxy:
         # active_watch_queues lets tests assert a stalled watcher's queue
         # stays bounded while coalescing
         self._httpd.watch_coalesced_metric = None  # type: ignore[attr-defined]
+        self._httpd.watch_fanout_bytes_metric = None  # type: ignore[attr-defined]
+        self._httpd.watch_frames_metric = None  # type: ignore[attr-defined]
+        # per-frontend request counter (leaf lock: taken for a single
+        # increment, nothing acquired under it)
+        self._httpd.requests_total = 0  # type: ignore[attr-defined]
+        self._httpd.req_count_lock = sanitizer.tracked_lock(  # type: ignore[attr-defined]
+            "apiserver.req_count", order=sanitizer.ORDER_LEAF,
+            no_blocking=True)
         self._httpd.watch_queues_lock = sanitizer.tracked_lock(  # type: ignore[attr-defined]
             "apiserver.watch_queues", order=sanitizer.ORDER_WATCH,
             no_blocking=True)
@@ -1302,10 +1383,28 @@ class ApiServerProxy:
             "LISTs served lock-free from the server-side watch cache "
             "(rv-gated consistent reads), by kind — the store-lock "
             "traffic the consistent-read path removed.")
+        self._httpd.watch_fanout_bytes_metric = registry.counter(  # type: ignore[attr-defined]
+            "watch_fanout_bytes_total",
+            "Watch-stream bytes written, by wire encoding — the "
+            "bytes/event ratio between the binary and json series is the "
+            "measured codec win the negotiation is judged by.")
+        self._httpd.watch_frames_metric = registry.counter(  # type: ignore[attr-defined]
+            "watch_frames_sent_total",
+            "Watch frames written (events, replays, and bookmarks), by "
+            "wire encoding — the denominator for "
+            "watch_fanout_bytes_total's bytes/event ratio.")
         if self.apf is not None:
             self.apf.attach_metrics(registry)
         if hasattr(self.store, "attach_metrics"):
             self.store.attach_metrics(registry)
+
+    @property
+    def requests_served(self) -> int:
+        """Total HTTP requests this frontend dispatched (watch connects
+        included) — the per-frontend load-spread number the replicated
+        soak tables report."""
+        with self._httpd.req_count_lock:  # type: ignore[attr-defined]
+            return self._httpd.requests_total  # type: ignore[attr-defined]
 
     @property
     def active_watch_queues(self) -> list:

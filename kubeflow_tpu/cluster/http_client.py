@@ -45,12 +45,14 @@ from __future__ import annotations
 
 import base64
 import http.client
+import itertools
 import json
 import logging
 import os
 import random
 import socket
 import ssl
+import struct
 import tempfile
 import threading
 import time
@@ -59,7 +61,7 @@ from dataclasses import dataclass
 from urllib.parse import quote, urlencode, urlsplit
 
 from ..utils import k8s, sanitizer, tracing
-from . import restmapper
+from . import codec, restmapper
 from .errors import (AlreadyExistsError, ApiError, ConflictError,
                      ForbiddenError, GoneError, InvalidError, NotFoundError,
                      ServiceUnavailableError, TooManyRequestsError)
@@ -113,6 +115,14 @@ class MalformedListError(http.client.HTTPException):
     would synthesize DELETED for every live object."""
 
 
+class MalformedBinaryError(http.client.HTTPException):
+    """A binary-negotiated response body that failed to decode — the
+    codec's CodecError lifted into the transport-error taxonomy
+    (⊂ TRANSPORT_ERRORS), so a truncated or foreign binary body rides the
+    same bounded retry + breaker accounting as a JSONDecodeError on a
+    truncated JSON body. Never a silent partial decode."""
+
+
 @dataclass(frozen=True)
 class RetryPolicy:
     """client-go-style bounded retries with decorrelated-jitter backoff.
@@ -151,6 +161,18 @@ WATCH_RECONNECT_DELAY_S = 1.0
 # long before dropping resets the backoff
 WATCH_BACKOFF_MAX_S = 30.0
 WATCH_BACKOFF_RESET_AFTER_S = 5.0
+
+
+def _read_exact(resp, n: int) -> bytes:
+    """Read exactly ``n`` bytes from a streaming response, short only at
+    EOF (http.client's read(n) may return fewer on a chunk boundary)."""
+    out = b""
+    while len(out) < n:  # bounded: returns short the moment read() EOFs
+        part = resp.read(n - len(out))
+        if not part:
+            return out
+        out += part
+    return out
 
 
 def _require_items(parsed: dict) -> None:
@@ -213,15 +235,42 @@ class HttpApiClient:
 
     supports_inprocess_admission = False
 
-    def __init__(self, base_url: str, token: str | None = None,
+    def __init__(self, base_url, token: str | None = None,
                  ca_cert: str | None = None, client_cert: str | None = None,
                  client_key: str | None = None, verify: bool = True,
                  timeout: float = 30.0, metrics=None,
                  retry_policy: RetryPolicy | None = None,
                  list_page_size: int | None = None,
                  user_agent: str = "kubeflow-tpu-manager",
-                 rng: random.Random | None = None) -> None:
-        self.base_url = base_url.rstrip("/")
+                 rng: random.Random | None = None,
+                 wire_format: str = "json") -> None:
+        # ``base_url`` accepts one URL, a comma-separated list, or a
+        # list/tuple — the replicated-frontend form: every request can be
+        # served by any frontend (one shared store behind them), so NEW
+        # connections rotate endpoints and a connect failure transparently
+        # fails over to the next one (mid-soak frontend kill: in-flight
+        # requests on the dead frontend surface through the normal retry
+        # machinery; every reconnect lands on a live one)
+        if isinstance(base_url, (list, tuple)):
+            urls = [u.rstrip("/") for u in base_url if u]
+        else:
+            urls = [u.strip().rstrip("/")
+                    for u in base_url.split(",") if u.strip()]
+        if not urls:
+            raise ValueError("base_url names no endpoints")
+        self.base_url = urls[0]
+        self.endpoints = tuple(urls)
+        # wire negotiation: "binary" sends/accepts the compact codec media
+        # type (error Status bodies stay JSON — decode is driven by the
+        # RESPONSE Content-Type, so a mixed fleet or a binary-unaware
+        # server degrades to JSON transparently); "json" is the default
+        # and the debugging path
+        if wire_format not in ("json", "binary"):
+            raise ValueError(f"unknown wire_format {wire_format!r}")
+        self.wire_format = wire_format
+        self._binary = wire_format == "binary"
+        self._accept = (codec.BINARY_CONTENT_TYPE + ", application/json"
+                        if self._binary else "application/json")
         self.token = token
         self.timeout = timeout
         self.retry_policy = retry_policy or RetryPolicy()
@@ -245,10 +294,17 @@ class HttpApiClient:
         # keep-alive pool: one persistent connection per (thread, client) —
         # http.client connections are not thread-safe, and a thread's
         # requests are serial, so thread affinity IS the pool discipline
-        split = urlsplit(self.base_url)
-        self._addr = (split.scheme, split.hostname or "127.0.0.1",
-                      split.port or (443 if split.scheme == "https" else 80),
-                      split.path.rstrip("/"))
+        self._addrs = []
+        for url in self.endpoints:
+            split = urlsplit(url)
+            self._addrs.append(
+                (split.scheme, split.hostname or "127.0.0.1",
+                 split.port or (443 if split.scheme == "https" else 80),
+                 split.path.rstrip("/")))
+        self._addr = self._addrs[0]
+        # round-robin cursor for new connections (itertools.count is
+        # GIL-atomic; modulo at the use site)
+        self._endpoint_counter = itertools.count()
         self._tl = threading.local()
         self._conns_lock = sanitizer.tracked_lock(
             "http.conns", order=sanitizer.ORDER_WATCH, no_blocking=True)
@@ -322,24 +378,39 @@ class HttpApiClient:
 
     # ------------------------------------------------------------ transport
     def _new_conn(self, timeout: float, stream: bool = False):
-        scheme, host, port, _prefix = self._addr
-        if scheme == "https":
-            conn = http.client.HTTPSConnection(host, port, timeout=timeout,
-                                               context=self._ssl)
-        else:
-            conn = http.client.HTTPConnection(host, port, timeout=timeout)
-        conn.connect()
-        # a persistent connection carries many small request/response
-        # pairs: Nagle + delayed ACK turns each into a ~40 ms stall
-        # (http.client writes headers and body in separate send()s)
-        conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        if self._connections_metric is not None:
-            # pooled vs stream: one watch stream = one connection by
-            # design (reconnect chaos churns them legitimately), so the
-            # keep-alive reuse bound is computed over pooled conns only
-            self._connections_metric.inc(
-                {"type": "stream" if stream else "pooled"})
-        return conn
+        """Open a connection to the next endpoint in rotation, failing
+        over across the remaining endpoints on a connect failure (a
+        killed frontend disappears from new connections immediately; only
+        when EVERY endpoint refuses does the failure surface)."""
+        last_err: OSError | None = None
+        for _ in range(len(self._addrs)):
+            pick = next(self._endpoint_counter) % len(self._addrs)
+            scheme, host, port, prefix = self._addrs[pick]
+            if scheme == "https":
+                conn = http.client.HTTPSConnection(host, port,
+                                                   timeout=timeout,
+                                                   context=self._ssl)
+            else:
+                conn = http.client.HTTPConnection(host, port,
+                                                  timeout=timeout)
+            try:
+                conn.connect()
+            except OSError as err:
+                last_err = err
+                continue
+            # a persistent connection carries many small request/response
+            # pairs: Nagle + delayed ACK turns each into a ~40 ms stall
+            # (http.client writes headers and body in separate send()s)
+            conn.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn._kt_prefix = prefix  # per-endpoint path prefix
+            if self._connections_metric is not None:
+                # pooled vs stream: one watch stream = one connection by
+                # design (reconnect chaos churns them legitimately), so the
+                # keep-alive reuse bound is computed over pooled conns only
+                self._connections_metric.inc(
+                    {"type": "stream" if stream else "pooled"})
+            return conn
+        raise last_err if last_err is not None else OSError("no endpoints")
 
     def _checkout(self, timeout: float, pooled: bool):
         """This thread's persistent connection (or a dedicated one for
@@ -404,8 +475,16 @@ class HttpApiClient:
         else reuses this thread's persistent connection — the response
         must be fully read before the thread's next request (every caller
         does), or the next checkout recycles the connection."""
-        data = json.dumps(body).encode() if body is not None else None
-        headers = {"Accept": "application/json",
+        data = None
+        if body is not None:
+            if self._binary:
+                data = codec.encode(body)
+                content_type = (codec.BINARY_PATCH_CONTENT_TYPE
+                                if "merge-patch" in content_type
+                                else codec.BINARY_CONTENT_TYPE)
+            else:
+                data = json.dumps(body).encode()
+        headers = {"Accept": self._accept,
                    "User-Agent": self.user_agent}
         if data is not None:
             headers["Content-Type"] = content_type
@@ -418,12 +497,16 @@ class HttpApiClient:
         if ctx is not None:
             headers["traceparent"] = tracing.format_traceparent(ctx)
         timeout = timeout or self.timeout
-        url_path = self._addr[3] + path
         for attempt in (0, 1):
             conn, reused = None, False
             try:
                 conn, reused = self._checkout(timeout, pooled)
-                conn.request(method, url_path, body=data, headers=headers)
+                # path prefix is per-endpoint (a pooled conn remembers
+                # which frontend it reached)
+                conn.request(method,
+                             getattr(conn, "_kt_prefix", self._addr[3])
+                             + path,
+                             body=data, headers=headers)
             except (http.client.HTTPException, OSError) as err:
                 # SEND-phase failure (connect included): the server never
                 # read this request. On a REUSED keep-alive connection the
@@ -639,10 +722,23 @@ class HttpApiClient:
             started = time.monotonic()
             try:
                 with self._request(method, path, body, content_type) as resp:
+                    resp_ctype = resp.headers.get("Content-Type", "")
                     data = resp.read()
                     self._mark_drained(resp)
                 self._observe_duration(method, started)
-                parsed = json.loads(data)
+                # decode by the RESPONSE Content-Type, not the negotiated
+                # preference: error Status bodies are always JSON, and a
+                # binary-unaware server answering JSON degrades cleanly
+                if codec.accepts_binary(resp_ctype):
+                    try:
+                        parsed = codec.decode(data)
+                    except codec.CodecError as exc:
+                        # truncated/garbled binary body → retryable
+                        # transport failure (PR-2 semantics), same as a
+                        # JSONDecodeError on a truncated JSON body
+                        raise MalformedBinaryError(str(exc)) from None
+                else:
+                    parsed = json.loads(data)
                 if validate is not None:
                     validate(parsed)
                 return parsed
@@ -858,24 +954,35 @@ class HttpApiClient:
         """Blocks until the first stream is connected (up to 5 s) so that,
         as with ClusterStore.watch, no event after watch() returns can be
         missed — CachingClient's watch-then-list backfill depends on this
-        ordering to never go stale. If the stream can't connect in time
-        (transient network failure), the eventual first connect resyncs
-        creations/updates from that gap as ADDED; one narrow hole remains —
-        an object both created-and-deleted (or listed by the consumer and
-        deleted) entirely within the pre-connect gap leaves no trace for the
-        diff, so a consumer that listed during the gap can hold it until its
+        ordering to never go stale — AND until the initial LIST+diff resync
+        has delivered (informer cache-sync semantics): an object created
+        after watch() returns is delivered exactly once, by the live
+        stream, never a second time by a still-in-flight initial list.
+        If the stream can't connect in time (transient network failure),
+        the eventual first connect resyncs creations/updates from that gap
+        as ADDED; one narrow hole remains — an object both
+        created-and-deleted (or listed by the consumer and deleted)
+        entirely within the pre-connect gap leaves no trace for the diff,
+        so a consumer that listed during the gap can hold it until its
         next list. Level-based reconcilers tolerate this; it closes the
         moment the object changes again."""
         connected = threading.Event()
+        synced = threading.Event()
         thread = threading.Thread(
             target=self._watch_loop,
-            args=(kind, callback, namespace, label_selector, connected),
+            args=(kind, callback, namespace, label_selector, connected,
+                  synced),
             daemon=True, name=f"kubeflow-tpu-watch-{kind}")
         self._watch_threads.append(thread)
         thread.start()
+        deadline = time.monotonic() + 5.0
         if not connected.wait(timeout=5.0):
             log.warning("watch %s not connected after 5s; resync will run "
                         "on first connect", kind)
+        elif not synced.wait(timeout=max(deadline - time.monotonic(), 0.1)):
+            log.warning("watch %s connected but initial resync still in "
+                        "flight after 5s; racing events may deliver twice",
+                        kind)
 
     @staticmethod
     def _obj_key(obj: dict) -> tuple[str, str]:
@@ -886,7 +993,8 @@ class HttpApiClient:
         return str(k8s.get_in(obj, "metadata", "resourceVersion", default=""))
 
     def _watch_loop(self, kind: str, callback, namespace, label_selector,
-                    connected: threading.Event):
+                    connected: threading.Event,
+                    synced: threading.Event | None = None):
         # (namespace, name) → SLIM record of the last object DELIVERED to
         # the callback (rv + the routing fields, see _slim — pinning every
         # full object forever costs O(fleet × object size) per watch
@@ -906,8 +1014,11 @@ class HttpApiClient:
 
         def on_resynced() -> None:
             # stream live again AND converged (RV replay or LIST+diff
-            # delivered): end any degraded window
+            # delivered): end any degraded window, and release a watch()
+            # caller still blocked on initial cache sync
             nonlocal in_gap
+            if synced is not None:
+                synced.set()
             if in_gap:
                 in_gap = False
                 self._notify_watch_gap(kind, False)
@@ -1117,24 +1228,42 @@ class HttpApiClient:
                     if on_resynced is not None:
                         on_resynced()
                 state["connected_once"] = True
+                # stream framing follows the RESPONSE Content-Type:
+                # length-prefixed codec frames when the server honored a
+                # binary Accept, NDJSON otherwise (a binary-unaware or
+                # older server degrades the stream to JSON transparently)
+                binary_stream = codec.accepts_binary(
+                    resp.headers.get("Content-Type"))
                 while not self._stopped.is_set():
                     try:
-                        line = resp.readline()
+                        if binary_stream:
+                            head = _read_exact(resp, 4)
+                            if len(head) < 4:
+                                return  # server closed the stream
+                            (total,) = struct.unpack(">I", head)
+                            payload = _read_exact(resp, total)
+                            if len(payload) < total:
+                                return  # truncated frame: reconnect
+                        else:
+                            line = resp.readline()
+                            if not line:
+                                return  # server closed the stream
                     except ValueError:
                         # close()'s fallback path closed the file under us
                         # ("I/O operation on closed file") — shutdown race,
                         # scoped here so resync JSON errors stay loud
                         return
-                    if not line:
-                        return  # server closed the stream
                     try:
-                        frame = json.loads(line)
-                        event_type = frame["type"]
-                        obj = frame["object"]
+                        if binary_stream:
+                            event_type, obj = codec.parse_event(payload)
+                        else:
+                            frame = json.loads(line)
+                            event_type = frame["type"]
+                            obj = frame["object"]
                     except (ValueError, KeyError, TypeError):
-                        # truncated NDJSON frame (apiserver killed
-                        # mid-write): reconnect; the replay/resync
-                        # re-covers whatever it carried
+                        # truncated/garbled frame (apiserver killed
+                        # mid-write; CodecError ⊂ ValueError): reconnect;
+                        # the replay/resync re-covers whatever it carried
                         return
                     if event_type == "BOOKMARK":
                         # idle-stream resume anchor: the server guarantees

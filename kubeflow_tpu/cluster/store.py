@@ -17,24 +17,40 @@ through API-server state"). We reproduce the semantics the controllers rely on:
 - watch fan-out with ADDED/MODIFIED/DELETED events feeding controller workqueues
   (SetupWithManager watches, notebook_controller.go:778-826).
 
-Thread-safe; a single ``threading.RLock`` guards the state — the apiserver is
-the serialization point exactly as in Kubernetes.
+Thread-safe and SHARDED, the etcd-style split: object state lives in
+per-(kind, namespace-hash) shards, each under its own write lock
+(``store.shard[i]``), while resourceVersion allocation and watch plumbing
+serialize under one tiny global allocator lock (``store.rv``) — etcd's
+per-range state under a single global revision. Writers acquire their
+shard lock, then the rv lock for the stamp+emit critical section; watch
+order IS rv order because allocation and ring append share one rv-lock
+hold. Multi-shard operations (cascade GC, serve-cache snapshots) take
+every shard lock in index order first — the canonical order
+``shard[0] < shard[1] < ... < store.rv`` that keeps the name-level
+acquisition graph acyclic (ARCHITECTURE.md lock-hierarchy table).
+
+Stored objects are IMMUTABLE once published: every write replaces the
+shard slot with a fresh dict (delete-marking and DELETED frames use
+copy-on-write metadata), so watch frames, serve caches, and LIST walks
+share the stored object without a defensive deepcopy — the emit path
+copies zero times where it used to copy once per event.
 """
 
 from __future__ import annotations
 
 import base64
 import bisect
+import contextlib
 import itertools
 import json
-import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Iterator
 
 from ..utils import k8s, sanitizer
 from ..utils.names import generate_suffix
+from . import codec
 from .errors import (AlreadyExistsError, ConflictError, GoneError,
                      InvalidError, NotFoundError)
 
@@ -43,6 +59,11 @@ CLUSTER_SCOPED_KINDS = {
     "CustomResourceDefinition", "PriorityClass", "Node", "APIServer",
     "MutatingWebhookConfiguration", "ValidatingWebhookConfiguration",
 }
+
+#: default shard count: enough to spread a multi-frontend write load
+#: (kinds × namespaces hash well past 8) while keeping the all-shards
+#: acquisition of cascade GC cheap
+DEFAULT_SHARDS = 8
 
 
 @dataclass(frozen=True)
@@ -67,18 +88,20 @@ WATCH_CACHE_CAPACITY = 4096
 
 class EventFrame:
     """One watch event, shared by every consumer (the real apiserver's
-    watch-cache entry): the object is deepcopied ONCE at emission and
-    treated as immutable from then on, and the wire encoding is computed
-    at most once no matter how many HTTP watchers fan it out. ``rv`` is
-    the event's resourceVersion as an int — the resume cursor."""
+    watch-cache entry): the object is the STORED object itself — stored
+    state is immutable post-publish, so no per-event copy is needed —
+    and each wire encoding (JSON and binary) is computed at most once no
+    matter how many HTTP watchers fan it out. ``rv`` is the event's
+    resourceVersion as an int — the resume cursor."""
 
-    __slots__ = ("rv", "type", "obj", "_obj_bytes")
+    __slots__ = ("rv", "type", "obj", "_obj_bytes", "_obj_bytes_binary")
 
     def __init__(self, rv: int, type_: str, obj: dict) -> None:
         self.rv = rv
         self.type = type_
         self.obj = obj
         self._obj_bytes: bytes | None = None
+        self._obj_bytes_binary: bytes | None = None
 
     def obj_bytes(self) -> bytes:
         """The object's JSON encoding, computed once and cached (benign
@@ -90,13 +113,23 @@ class EventFrame:
             self._obj_bytes = encoded
         return encoded
 
+    def obj_bytes_binary(self) -> bytes:
+        """The object's binary-codec encoding, cached like obj_bytes():
+        a mixed fleet (JSON + binary watchers on one ring) costs one
+        encode per format per event, not per watcher."""
+        encoded = self._obj_bytes_binary
+        if encoded is None:
+            encoded = codec.encode(self.obj)
+            self._obj_bytes_binary = encoded
+        return encoded
+
 
 class _WatchRing:
     """Bounded per-kind ring of recent EventFrames in rv order (emission
-    happens under the store lock where rvs are issued, so append order IS
-    rv order). ``evicted_rv`` is the rv of the newest frame pushed out:
-    a resume from N is servable iff every kind event with rv > N is still
-    present, i.e. N >= evicted_rv."""
+    happens under the rv-allocator lock where rvs are issued, so append
+    order IS rv order). ``evicted_rv`` is the rv of the newest frame
+    pushed out: a resume from N is servable iff every kind event with
+    rv > N is still present, i.e. N >= evicted_rv."""
 
     __slots__ = ("frames", "evicted_rv", "capacity")
 
@@ -131,6 +164,24 @@ class _Watch:
     frames: bool = False
 
 
+class _Shard:
+    """One slice of object state: its own write lock plus the objects it
+    owns. Shard locks carry per-index names — the sanitizer's acquisition
+    graph is name-level, and the canonical multi-shard order (ascending
+    index) must be visible to it as distinct nodes."""
+
+    __slots__ = ("lock", "objects")
+
+    def __init__(self, index: int) -> None:
+        # store tier — nothing blocking may run under it, and the
+        # cache/watch tiers may be acquired under it but never above it
+        self.lock = sanitizer.tracked_rlock(
+            f"store.shard[{index}]", order=sanitizer.ORDER_STORE,
+            no_blocking=True)
+        self.objects: dict[ObjectKey, dict] = sanitizer.guarded_by(
+            {}, self.lock, f"store.shard[{index}].objects")
+
+
 _now_iso = k8s.now_iso
 
 
@@ -150,49 +201,70 @@ def _decode_continue(token: str) -> tuple[str, str]:
         raise InvalidError(f"malformed continue token {token!r}") from None
 
 
+def _shard_index(kind: str, namespace: str, nshards: int) -> int:
+    """FNV-1a over the shard key ``kind/namespace`` — deterministic
+    across processes and Python hash-randomization (the shard-key
+    contract: one (kind, namespace) pair always lands on one shard, so
+    a namespaced LIST touches exactly one shard lock)."""
+    h = 0x811C9DC5
+    for byte in f"{kind}/{namespace}".encode():
+        h ^= byte
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    return h % nshards
+
+
 class ClusterStore:
     """The in-process apiserver + etcd. All mutating verbs return a deep copy
     of the stored object (as the real apiserver returns the canonical form)."""
 
-    def __init__(self) -> None:
-        # RLock (validation helpers re-enter from the write path); store
-        # tier — nothing blocking may run under it, and the cache/watch
-        # tiers may be acquired under it (frame relays) but never above it
-        self._lock = sanitizer.tracked_rlock(
-            "store.state", order=sanitizer.ORDER_STORE, no_blocking=True)
-        self._objects: dict[ObjectKey, dict] = {}
+    def __init__(self, shards: int = DEFAULT_SHARDS) -> None:
+        self._nshards = max(int(shards), 1)
+        self._shards = [_Shard(i) for i in range(self._nshards)]
+        # the global rv allocator: the ONE serialization point left on
+        # the write path — a tiny stamp+emit critical section (rv issue,
+        # ring append, relay feed), always acquired AFTER shard locks
+        self._rv_lock = sanitizer.tracked_rlock(
+            "store.rv", order=sanitizer.ORDER_STORE, no_blocking=True)
+        # CRD-schema / webhook-config indexes: written under a shard
+        # lock (nested), read standalone during admission
+        self._config_lock = sanitizer.tracked_rlock(
+            "store.config", order=sanitizer.ORDER_STORE, no_blocking=True)
         self._rv_counter = itertools.count(1)
         self._last_rv = 0  # latest issued rv — reported in LIST metadata
         # one-entry sorted-key snapshot for paginated LISTs: a pager walks
         # the same (kind, namespace) shape page after page, and re-sorting
-        # the whole kind under the lock per page would make one chunked
-        # LIST O(pages × N log N) of lock-held work. Keyed on _last_rv, so
-        # any write invalidates it (deletes bump rv too, for their DELETED
-        # watch frame; the pop loop below still tolerates a stale key).
+        # the whole kind per page would make one chunked LIST
+        # O(pages × N log N). Keyed on _last_rv, so any write invalidates
+        # it (deletes bump rv too, for their DELETED watch frame; the
+        # page walk below still tolerates a stale key).
         self._page_snapshot: tuple | None = None  # (kind, ns, rv, pairs)
         self._uid_counter = itertools.count(1)
         self._watches: list[_Watch] = sanitizer.guarded_by(
-            [], self._lock, "store.watches")
+            [], self._rv_lock, "store.watches")
         # per-kind bounded ring of recent watch frames — the resume window
         # ``?watch=true&resourceVersion=N`` replays from instead of forcing
         # a LIST+diff resync; eviction makes such a resume answer 410 Gone
         self._watch_rings: dict[str, _WatchRing] = sanitizer.guarded_by(
-            {}, self._lock, "store.watch_rings")
+            {}, self._rv_lock, "store.watch_rings")
         self.watch_cache_capacity = WATCH_CACHE_CAPACITY
         self._evictions_metric = None  # watch_cache_evictions_total
         self._list_lock_metric = None  # store_list_lock_seconds
+        self._write_lock_metric = None  # store_write_lock_seconds
         # admission hooks: list of (kind, fn(operation, obj, old) -> obj|raise)
         self._admission: list[tuple[str, Callable]] = []
         # CRD structural schemas: kind → {version: openAPIV3Schema}; kept in
         # step with CustomResourceDefinition objects so CRs are validated
         # server-side, as kube-apiserver does for installed CRDs
-        self._crd_schemas: dict[str, dict[str, dict]] = {}
+        self._crd_schemas: dict[str, dict[str, dict]] = sanitizer.guarded_by(
+            {}, self._config_lock, "store.crd_schemas")
         # Mutating/ValidatingWebhookConfiguration objects, indexed so writes
         # call out over real HTTPS AdmissionReview (cluster/remote_admission)
-        self._webhook_configs: dict[str, dict[ObjectKey, dict]] = {}
+        self._webhook_configs: dict[str, dict[ObjectKey, dict]] = \
+            sanitizer.guarded_by({}, self._config_lock,
+                                 "store.webhook_configs")
 
     def _next_rv(self) -> str:
-        """Issue the next resourceVersion (caller holds the lock) and
+        """Issue the next resourceVersion (caller holds the rv lock) and
         remember it — LIST metadata reports the latest issued rv, the
         anchor for informer-style ``resourceVersion=0`` list-then-watch."""
         self._last_rv = next(self._rv_counter)
@@ -206,6 +278,34 @@ class ClusterStore:
 
     def _key_of(self, obj: dict) -> ObjectKey:
         return self._key(k8s.kind(obj), k8s.namespace(obj), k8s.name(obj))
+
+    def _shard_of(self, key: ObjectKey) -> _Shard:
+        return self._shards[_shard_index(key.kind, key.namespace,
+                                         self._nshards)]
+
+    def _shards_for(self, kind: str, namespace: str | None) -> list[_Shard]:
+        """The shards a LIST must visit: exactly one for a namespaced
+        LIST (the shard key is (kind, namespace)), all of them for a
+        cross-namespace LIST."""
+        if namespace is None:
+            return self._shards
+        key = self._key(kind, namespace, "")
+        return [self._shard_of(key)]
+
+    @contextlib.contextmanager
+    def _all_shards_locked(self):
+        """Acquire EVERY shard lock in canonical (ascending index) order
+        — the multi-shard entry point for cascade GC and atomic
+        snapshots. The rv lock is still acquired after, never before."""
+        with contextlib.ExitStack() as stack:
+            for shard in self._shards:
+                stack.enter_context(shard.lock)
+            yield
+
+    def _observe_write(self, kind: str, started: float) -> None:
+        if self._write_lock_metric is not None:
+            self._write_lock_metric.observe(time.monotonic() - started,
+                                            {"kind": kind})
 
     # ------------------------------------------------------------- admission
     def register_admission(self, kind: str, fn: Callable) -> None:
@@ -230,12 +330,12 @@ class ClusterStore:
                               old: dict | None) -> dict:
         """HTTPS AdmissionReview against registered webhook configurations
         (mutating phase, then validating — the apiserver's order). The
-        config index is snapshotted under the lock; the HTTP calls run
+        config index is snapshotted under its lock; the HTTP calls run
         outside it (see create())."""
         from . import remote_admission as ra
         if k8s.kind(obj) in ra.CONFIG_KINDS:
             return obj  # configurations themselves are not gated
-        with self._lock:
+        with self._config_lock:
             mutating = [k8s.deepcopy(c) for c in
                         self._webhook_configs.get(ra.MUTATING_KIND,
                                                   {}).values()]
@@ -250,10 +350,13 @@ class ClusterStore:
         return obj
 
     def _index_webhook_config(self, key: ObjectKey, obj: dict) -> None:
-        self._webhook_configs.setdefault(key.kind, {})[key] = k8s.deepcopy(obj)
+        with self._config_lock:
+            self._webhook_configs.setdefault(key.kind, {})[key] = \
+                k8s.deepcopy(obj)
 
     def _unindex_webhook_config(self, key: ObjectKey) -> None:
-        self._webhook_configs.get(key.kind, {}).pop(key, None)
+        with self._config_lock:
+            self._webhook_configs.get(key.kind, {}).pop(key, None)
 
     # -------------------------------------------------------- CRD schemas
     def _index_crd(self, crd: dict) -> None:
@@ -266,14 +369,16 @@ class ClusterStore:
             if v.get("served") and s:
                 versions[v["name"]] = s
         if versions:
-            self._crd_schemas[kind] = versions
+            with self._config_lock:
+                self._crd_schemas[kind] = versions
 
     def _unindex_crd(self, crd: dict) -> None:
         kind = k8s.get_in(crd, "spec", "names", "kind")
-        self._crd_schemas.pop(kind, None)
+        with self._config_lock:
+            self._crd_schemas.pop(kind, None)
 
     def _validate_against_crd(self, obj: dict) -> None:
-        with self._lock:  # schema index is written under the lock
+        with self._config_lock:  # schema index is written under this lock
             versions = self._crd_schemas.get(k8s.kind(obj))
         if not versions:
             return
@@ -292,24 +397,25 @@ class ClusterStore:
                 f"is invalid: {shown}")
 
     # ----------------------------------------------------------------- watch
-    # emission plumbing: every mutation builds its event frames UNDER the
-    # store lock (ring order is rv order, and a watcher registering
-    # concurrently either lands in the dispatch snapshot or gets the frame
-    # via resume replay — exactly once either way). FRAME relays (the HTTP
-    # facade's per-watcher queues) are fed under the lock too: they are
-    # pure queue appends that never re-enter the store, and in-lock
-    # delivery is what guarantees every watcher queue receives frames in
-    # rv order — two writers dispatching outside the lock could invert
-    # it, and a client whose stream died after the higher rv would resume
-    # PAST the not-yet-delivered lower one, silently losing it. Legacy
-    # WatchEvent callbacks (in-process manager watches) may re-enter the
-    # store, so they still dispatch outside the lock.
+    # emission plumbing: every mutation builds its event frame UNDER the
+    # rv-allocator lock, in the same hold that issued the frame's rv —
+    # ring order is rv order BY CONSTRUCTION, even with writers on
+    # different shards (two writers allocating outside one hold could
+    # append inverted). A watcher registering concurrently either lands
+    # in the dispatch snapshot or gets the frame via resume replay —
+    # exactly once either way. FRAME relays (the HTTP facade's
+    # per-watcher queues) are fed under the rv lock too: they are pure
+    # queue appends that never re-enter the store, and in-lock delivery
+    # is what guarantees every watcher queue receives frames in rv order.
+    # Legacy WatchEvent callbacks (in-process manager watches) may
+    # re-enter the store, so they still dispatch outside all locks.
 
     def _emit_locked(self, etype: str, obj: dict) -> tuple:
         """Build the shared frame for one event, append it to the kind's
         resume ring, relay it to frame watchers (in rv order, see above),
-        and snapshot matching legacy watchers. Caller holds the lock;
-        returns ``(frame, legacy_targets)`` for _dispatch_all."""
+        and snapshot matching legacy watchers. Caller holds the rv lock
+        and has already stamped ``obj``'s resourceVersion under the same
+        hold; returns ``(frame, legacy_targets)`` for _dispatch_all."""
         kind = k8s.kind(obj)
         ns = k8s.namespace(obj)
         try:
@@ -339,20 +445,21 @@ class ClusterStore:
     @staticmethod
     def _dispatch_all(emitted: list) -> None:
         """Deliver emitted frames to their snapshotted legacy watchers
-        (outside the lock — these callbacks may re-enter the store). The
-        object is SHARED across all consumers of one event — one deepcopy
-        per event, not per watcher — and must be treated as immutable by
-        callbacks (every in-tree consumer already copies before mutating;
-        the read cache replaces, never edits)."""
+        (outside the locks — these callbacks may re-enter the store). The
+        object is SHARED across all consumers of one event — it IS the
+        immutable stored object, zero copies — and must be treated as
+        immutable by callbacks (every in-tree consumer already copies
+        before mutating; the read cache replaces, never edits)."""
         for frame, targets in emitted:
             for w in targets:
                 w.callback(WatchEvent(frame.type, frame.obj))
 
     def attach_metrics(self, registry) -> None:
         """Register the watch-cache eviction counter (CachingClient and
-        the wrappers pass their registry down here) plus the LIST
-        lock-hold histogram — the store-lock stampede measurement the
-        consistent-read-from-cache path exists to keep flat."""
+        the wrappers pass their registry down here) plus the LIST and
+        write lock-hold histograms — both registered EAGERLY here so
+        every verb observes from the first call after attachment (the
+        lock-stampede measurements the shard split is judged by)."""
         self._evictions_metric = registry.counter(
             "watch_cache_evictions_total",
             "Watch-cache ring frames evicted, by kind — each eviction "
@@ -361,52 +468,65 @@ class ClusterStore:
         self._list_lock_metric = registry.histogram(
             "store_list_lock_seconds",
             "Wall time a LIST spent acquiring plus holding the store's "
-            "write-path lock, by kind. "
+            "shard locks, by kind. "
             "Cache-served (rv=0) LISTs never appear here — this series "
             "growing with manager count means resyncs are stampeding the "
             "write path again.")
+        self._write_lock_metric = registry.histogram(
+            "store_write_lock_seconds",
+            "Wall time a write verb spent acquiring plus holding its "
+            "shard's write lock (and the rv allocator nested under it), "
+            "by kind — the sibling of store_list_lock_seconds that the "
+            "shard split is measured by: per-frontend write rates stay "
+            "flat when shards spread contention.")
 
     # ----------------------------------------------------------------- verbs
     def create(self, obj: dict) -> dict:
         obj = k8s.deepcopy(obj)
-        # admission runs OUTSIDE the store lock (kube-apiserver holds no
+        # admission runs OUTSIDE the store locks (kube-apiserver holds no
         # global lock around webhook calls): remote webhooks are HTTPS
         # round-trips whose handlers read back into this store from their
-        # own threads — under the lock that is a deadlock. Races admitted
+        # own threads — under a lock that is a deadlock. Races admitted
         # here are caught at persist (AlreadyExists / Conflict).
         obj = self._admit("CREATE", obj, None)
-        with self._lock:
-            md = k8s.meta(obj)
-            if not md.get("name") and md.get("generateName"):
-                md["name"] = md["generateName"] + generate_suffix(
-                    f'{md["generateName"]}{next(self._uid_counter)}', 5)
-            if not md.get("name"):
-                raise InvalidError("metadata.name or generateName required")
-            key = self._key_of(obj)
-            if key in self._objects:
-                raise AlreadyExistsError(f"{key.kind} {key.namespace}/{key.name}")
+        md = k8s.meta(obj)
+        if not md.get("name") and md.get("generateName"):
+            md["name"] = md["generateName"] + generate_suffix(
+                f'{md["generateName"]}{next(self._uid_counter)}', 5)
+        if not md.get("name"):
+            raise InvalidError("metadata.name or generateName required")
+        key = self._key_of(obj)
+        shard = self._shard_of(key)
+        md.setdefault("creationTimestamp", _now_iso())
+        started = time.monotonic()
+        with shard.lock:
+            if key in shard.objects:
+                raise AlreadyExistsError(
+                    f"{key.kind} {key.namespace}/{key.name}")
             md["uid"] = f"uid-{next(self._uid_counter)}"
-            md["resourceVersion"] = self._next_rv()
             md["generation"] = 1
-            md.setdefault("creationTimestamp", _now_iso())
-            self._objects[key] = obj
+            with self._rv_lock:
+                md["resourceVersion"] = self._next_rv()
+                shard.objects[key] = obj
+                emitted = [self._emit_locked("ADDED", obj)]
             if key.kind == "CustomResourceDefinition":
                 self._index_crd(obj)
             elif key.kind in ("MutatingWebhookConfiguration",
                               "ValidatingWebhookConfiguration"):
                 self._index_webhook_config(key, obj)
-            stored = k8s.deepcopy(obj)
-            emitted = [self._emit_locked("ADDED", stored)]
+        self._observe_write(key.kind, started)
         self._dispatch_all(emitted)
-        return k8s.deepcopy(stored)
+        return k8s.deepcopy(obj)
 
     def get(self, kind: str, namespace: str, name: str) -> dict:
-        with self._lock:
-            key = self._key(kind, namespace, name)
-            obj = self._objects.get(key)
-            if obj is None:
-                raise NotFoundError(f"{kind} {namespace}/{name}")
-            return k8s.deepcopy(obj)
+        key = self._key(kind, namespace, name)
+        shard = self._shard_of(key)
+        with shard.lock:
+            obj = shard.objects.get(key)
+        if obj is None:
+            raise NotFoundError(f"{kind} {namespace}/{name}")
+        # the stored object is immutable: copy outside the lock
+        return k8s.deepcopy(obj)
 
     def list(self, kind: str, namespace: str | None = None,
              label_selector: dict[str, str] | None = None) -> list[dict]:
@@ -438,84 +558,115 @@ class ClusterStore:
         a special case). Exact/minimum-rv forms are likewise served from
         current state — there are no historical snapshots here. ``list_rv``
         is the latest issued resourceVersion, the anchor a watch would
-        start from."""
+        start from — anchored BEFORE the shard walk, so a write racing
+        the collection lands with rv > list_rv and a watch from list_rv
+        replays it (duplicate-tolerant) rather than losing it."""
         start_after = (_decode_continue(continue_token)
                        if continue_token else None)
         if limit is not None and limit <= 0:
             limit = None  # limit=0 means "no limit", as on the wire
         lock_started = time.monotonic()
-        with self._lock:
-            pairs = self._sorted_pairs_locked(kind, namespace,
-                                              snapshot=limit is not None)
-            start = (bisect.bisect_right(pairs, start_after)
-                     if start_after is not None else 0)
-            out: list[dict] = []
-            last_pair: tuple[str, str] | None = None
-            next_token: str | None = None
-            for pair in pairs[start:]:
-                # a key may have been deleted since the snapshot was cut:
-                # skip — same "objects deleted between pages may be
-                # missed" contract as the real chunked LIST
-                obj = self._objects.get(ObjectKey(kind, pair[0], pair[1]))
-                if obj is None or not k8s.matches_labels(obj,
-                                                         label_selector):
-                    continue
-                if limit is not None and len(out) >= limit:
-                    # page full with at least one candidate left: hand out
-                    # a cursor at the last key actually served
-                    next_token = _encode_continue(*last_pair)
-                    break
-                out.append(k8s.deepcopy(obj))
-                last_pair = pair
-            list_rv = str(self._last_rv)
+        with self._rv_lock:
+            list_rv_int = self._last_rv
+            snap = self._page_snapshot
+        # collect object REFS under brief per-shard locks (a namespaced
+        # LIST visits exactly ONE shard); the sort, page walk, and output
+        # deepcopies all run OUTSIDE the locks — stored objects are
+        # immutable, so the refs stay valid after release
+        refs: dict[tuple[str, str], dict] = {}
+        for shard in self._shards_for(kind, namespace):
+            with shard.lock:
+                for okey, oobj in shard.objects.items():
+                    if okey.kind == kind and (namespace is None
+                                              or okey.namespace == namespace):
+                        refs[(okey.namespace, okey.name)] = oobj
+        lock_elapsed = time.monotonic() - lock_started
+        token = (kind, namespace, list_rv_int)
+        if limit is not None and snap is not None and snap[:3] == token:
+            pairs = snap[3]
+        else:
+            pairs = sorted(refs)
+            if limit is not None:
+                with self._rv_lock:
+                    self._page_snapshot = (*token, pairs)
+        start = (bisect.bisect_right(pairs, start_after)
+                 if start_after is not None else 0)
+        out: list[dict] = []
+        last_pair: tuple[str, str] | None = None
+        next_token: str | None = None
+        for pair in pairs[start:]:
+            # a key may have been deleted since the pair snapshot was
+            # cut: skip — same "objects deleted between pages may be
+            # missed" contract as the real chunked LIST
+            obj = refs.get(pair)
+            if obj is None or not k8s.matches_labels(obj, label_selector):
+                continue
+            if limit is not None and len(out) >= limit:
+                # page full with at least one candidate left: hand out
+                # a cursor at the last key actually served
+                next_token = _encode_continue(*last_pair)
+                break
+            out.append(k8s.deepcopy(obj))
+            last_pair = pair
         if self._list_lock_metric is not None:
-            self._list_lock_metric.observe(time.monotonic() - lock_started,
-                                           {"kind": kind})
-        return out, next_token, list_rv
-
-    def _sorted_pairs_locked(self, kind: str, namespace: str | None,
-                             snapshot: bool) -> list[tuple[str, str]]:
-        """Sorted (namespace, name) pairs for a kind (caller holds the
-        lock). Paginated calls (``snapshot=True``) reuse the one-entry
-        snapshot while no write has bumped ``_last_rv``, so walking a big
-        fleet in pages sorts once, not once per page."""
-        token = (kind, namespace, self._last_rv)
-        if snapshot and self._page_snapshot is not None and \
-                self._page_snapshot[:3] == token:
-            return self._page_snapshot[3]
-        pairs = sorted(
-            (key.namespace, key.name) for key in self._objects
-            if key.kind == kind
-            and (namespace is None or key.namespace == namespace))
-        if snapshot:
-            self._page_snapshot = (*token, pairs)
-        return pairs
+            self._list_lock_metric.observe(lock_elapsed, {"kind": kind})
+        return out, next_token, str(list_rv_int)
 
     def update(self, obj: dict) -> dict:
         obj = k8s.deepcopy(obj)
-        emitted: list = []
         key = self._key_of(obj)
-        # snapshot + early conflict check, then admit OUTSIDE the lock (see
-        # create()); the post-admission check below re-validates that the
-        # state admitted against is still the state being replaced
-        with self._lock:
-            old_snapshot = self._objects.get(key)
-            if old_snapshot is None:
-                raise NotFoundError(f"{key.kind} {key.namespace}/{key.name}")
-            old_snapshot = k8s.deepcopy(old_snapshot)
+        shard = self._shard_of(key)
+        # snapshot + early conflict check, then admit OUTSIDE the locks
+        # (see create()); the post-admission check below re-validates that
+        # the state admitted against is still the state being replaced
+        with shard.lock:
+            old_snapshot = shard.objects.get(key)
+        if old_snapshot is None:
+            raise NotFoundError(f"{key.kind} {key.namespace}/{key.name}")
         snapshot_rv = old_snapshot["metadata"]["resourceVersion"]
         new_rv = k8s.get_in(obj, "metadata", "resourceVersion")
         if new_rv is not None and new_rv != snapshot_rv:
             raise ConflictError(
                 f"{key.kind} {key.namespace}/{key.name}: stale resourceVersion")
-        obj = self._admit("UPDATE", obj, old_snapshot)
-        with self._lock:
-            old = self._objects.get(key)
+        obj = self._admit("UPDATE", obj, k8s.deepcopy(old_snapshot))
+        # a finalizer-stripping update of a deleting object removes the
+        # object and cascades — that needs every shard lock. Decide from
+        # the snapshot; if the single-shard pass discovers the cascade
+        # branch anyway (a concurrent delete marked the object during
+        # admission), it retries once with all shard locks.
+        take_all = bool(
+            (k8s.get_in(obj, "metadata", "deletionTimestamp")
+             or k8s.get_in(old_snapshot, "metadata", "deletionTimestamp"))
+            and not k8s.get_in(obj, "metadata", "finalizers"))
+        started = time.monotonic()
+        emitted: list | None = None
+        for all_shards in ([True] if take_all else [False, True]):
+            emitted = self._apply_update_locked(key, shard, obj, new_rv,
+                                                snapshot_rv, all_shards)
+            if emitted is not None:
+                break
+        self._observe_write(key.kind, started)
+        self._dispatch_all(emitted)
+        return k8s.deepcopy(obj)
+
+    def _apply_update_locked(self, key: ObjectKey, shard: _Shard, obj: dict,
+                             new_rv, snapshot_rv,
+                             take_all: bool) -> list | None:
+        """One locked attempt at applying an update; returns the
+        emissions, or None when the cascade branch was reached without
+        every shard lock held (the caller retries with all of them)."""
+        with contextlib.ExitStack() as stack:
+            if take_all:
+                stack.enter_context(self._all_shards_locked())
+            else:
+                stack.enter_context(shard.lock)
+            old = shard.objects.get(key)
             if old is None:
                 raise NotFoundError(f"{key.kind} {key.namespace}/{key.name}")
-            # re-check ONLY for optimistic writers: a no-RV update keeps the
-            # apiserver's unconditional last-write-wins semantics even when a
-            # concurrent write landed during the out-of-lock admission window
+            # re-check ONLY for optimistic writers: a no-RV update keeps
+            # the apiserver's unconditional last-write-wins semantics even
+            # when a concurrent write landed during the out-of-lock
+            # admission window
             if new_rv is not None and \
                     old["metadata"]["resourceVersion"] != snapshot_rv:
                 raise ConflictError(
@@ -526,26 +677,29 @@ class ClusterStore:
             md["creationTimestamp"] = old["metadata"]["creationTimestamp"]
             if k8s.get_in(old, "metadata", "deletionTimestamp"):
                 md["deletionTimestamp"] = old["metadata"]["deletionTimestamp"]
-            md["resourceVersion"] = self._next_rv()
             if obj.get("spec") != old.get("spec"):
                 md["generation"] = old["metadata"].get("generation", 1) + 1
             else:
                 md["generation"] = old["metadata"].get("generation", 1)
             if (k8s.get_in(obj, "metadata", "deletionTimestamp")
                     and not k8s.get_in(obj, "metadata", "finalizers")):
-                # last finalizer stripped → actually remove (two-phase delete)
-                emitted = self._remove_and_gc(key, replacement=obj)
-            else:
-                self._objects[key] = obj
-                if key.kind == "CustomResourceDefinition":
-                    self._index_crd(obj)
-                elif key.kind in ("MutatingWebhookConfiguration",
-                                  "ValidatingWebhookConfiguration"):
-                    self._index_webhook_config(key, obj)
-                emitted = [self._emit_locked("MODIFIED", k8s.deepcopy(obj))]
-            stored = k8s.deepcopy(obj)
-        self._dispatch_all(emitted)
-        return k8s.deepcopy(stored)
+                # last finalizer stripped → actually remove (two-phase
+                # delete, cascading to dependents on other shards)
+                if not take_all:
+                    return None
+                with self._rv_lock:
+                    md["resourceVersion"] = self._next_rv()
+                return self._remove_and_gc(key, replacement=obj)
+            with self._rv_lock:
+                md["resourceVersion"] = self._next_rv()
+                shard.objects[key] = obj
+                emitted = [self._emit_locked("MODIFIED", obj)]
+            if key.kind == "CustomResourceDefinition":
+                self._index_crd(obj)
+            elif key.kind in ("MutatingWebhookConfiguration",
+                              "ValidatingWebhookConfiguration"):
+                self._index_webhook_config(key, obj)
+            return emitted
 
     # bounds the patch re-merge loop: each retry re-runs admission (possibly
     # remote HTTPS round-trips), so a hot object must back off and eventually
@@ -558,14 +712,19 @@ class ClusterStore:
         write, as the reference relies on for annotation removal
         (odh notebook_controller.go:516-523) — with bounded backoff now that
         each attempt may spend webhook round-trips outside the lock."""
+        key = self._key(kind, namespace, name)
+        shard = self._shard_of(key)
         for attempt in range(self.PATCH_MAX_RETRIES):
-            with self._lock:
-                key = self._key(kind, namespace, name)
-                old = self._objects.get(key)
-                if old is None:
-                    raise NotFoundError(f"{kind} {namespace}/{name}")
-                merged = k8s.json_merge_patch(old, patch)
-                k8s.meta(merged)["resourceVersion"] = old["metadata"]["resourceVersion"]
+            with shard.lock:
+                old = shard.objects.get(key)
+            if old is None:
+                raise NotFoundError(f"{kind} {namespace}/{name}")
+            merged = k8s.json_merge_patch(old, patch)
+            # fresh metadata dict: json_merge_patch shares untouched
+            # subtrees with the (immutable) stored object
+            merged["metadata"] = {**(merged.get("metadata") or {}),
+                                  "resourceVersion":
+                                      old["metadata"]["resourceVersion"]}
             try:
                 return self.update(merged)
             except ConflictError:
@@ -576,66 +735,110 @@ class ClusterStore:
                             f"attempts")
 
     def update_status(self, obj: dict) -> dict:
-        """Status subresource semantics: only .status is applied."""
-        with self._lock:
-            key = self._key_of(obj)
-            old = self._objects.get(key)
+        """Status subresource semantics: only .status is applied. The
+        replacement shares the (immutable) old object's spec/metadata
+        subtrees — only .status and the rv-bearing metadata dict are
+        fresh."""
+        key = self._key_of(obj)
+        shard = self._shard_of(key)
+        new_status = k8s.deepcopy(obj.get("status", {}))
+        new_rv = k8s.get_in(obj, "metadata", "resourceVersion")
+        started = time.monotonic()
+        with shard.lock:
+            old = shard.objects.get(key)
             if old is None:
                 raise NotFoundError(f"{key.kind} {key.namespace}/{key.name}")
-            new_rv = k8s.get_in(obj, "metadata", "resourceVersion")
-            if new_rv is not None and new_rv != old["metadata"]["resourceVersion"]:
+            if new_rv is not None and \
+                    new_rv != old["metadata"]["resourceVersion"]:
                 raise ConflictError(f"{key.kind} {key.namespace}/{key.name}")
-            stored = k8s.deepcopy(old)
-            stored["status"] = k8s.deepcopy(obj.get("status", {}))
-            stored["metadata"]["resourceVersion"] = self._next_rv()
-            self._objects[key] = stored
-            out = k8s.deepcopy(stored)
-            emitted = [self._emit_locked("MODIFIED", out)]
+            with self._rv_lock:
+                stored = {**old, "status": new_status,
+                          "metadata": {**old["metadata"],
+                                       "resourceVersion": self._next_rv()}}
+                shard.objects[key] = stored
+                emitted = [self._emit_locked("MODIFIED", stored)]
+        self._observe_write(key.kind, started)
         self._dispatch_all(emitted)
-        return k8s.deepcopy(out)
+        return k8s.deepcopy(stored)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         """Two-phase delete: finalizers present → set deletionTimestamp and
         wait for controllers to strip them; else remove + cascade to owned
         objects (background GC)."""
-        with self._lock:
-            snapshot = self._objects.get(self._key(kind, namespace, name))
-            if snapshot is None:
-                raise NotFoundError(f"{kind} {namespace}/{name}")
-            snapshot = k8s.deepcopy(snapshot)
+        key = self._key(kind, namespace, name)
+        shard = self._shard_of(key)
+        with shard.lock:
+            snapshot = shard.objects.get(key)
+        if snapshot is None:
+            raise NotFoundError(f"{kind} {namespace}/{name}")
+        snap = k8s.deepcopy(snapshot)
         # DELETE-gating webhooks (operations: ["DELETE"]) fire like the real
-        # apiserver's; outside the lock (see create())
-        self._run_remote_admission("DELETE", snapshot, snapshot)
-        emitted: list = []
-        with self._lock:
-            key = self._key(kind, namespace, name)
-            obj = self._objects.get(key)
-            if obj is None:
-                raise NotFoundError(f"{kind} {namespace}/{name}")
-            if k8s.get_in(obj, "metadata", "finalizers"):
-                if not k8s.get_in(obj, "metadata", "deletionTimestamp"):
-                    obj["metadata"]["deletionTimestamp"] = _now_iso()
-                    obj["metadata"]["resourceVersion"] = self._next_rv()
-                    emitted.append(self._emit_locked("MODIFIED",
-                                                     k8s.deepcopy(obj)))
-            else:
-                emitted.extend(self._remove_and_gc(key))
+        # apiserver's; outside the locks (see create())
+        self._run_remote_admission("DELETE", snap, snap)
+        # removal cascades across shards → all shard locks; the
+        # finalizer-mark path stays on the object's own shard. Decide
+        # from the snapshot, retry with all locks if the state flipped
+        # during the webhook window.
+        take_all = not k8s.get_in(snapshot, "metadata", "finalizers")
+        started = time.monotonic()
+        emitted: list | None = None
+        for all_shards in ([True] if take_all else [False, True]):
+            emitted = self._apply_delete_locked(key, shard, all_shards)
+            if emitted is not None:
+                break
+        self._observe_write(key.kind, started)
         self._dispatch_all(emitted)
+
+    def _apply_delete_locked(self, key: ObjectKey, shard: _Shard,
+                             take_all: bool) -> list | None:
+        """One locked attempt at a delete; returns emissions, or None
+        when removal was reached without every shard lock held."""
+        with contextlib.ExitStack() as stack:
+            if take_all:
+                stack.enter_context(self._all_shards_locked())
+            else:
+                stack.enter_context(shard.lock)
+            obj = shard.objects.get(key)
+            if obj is None:
+                raise NotFoundError(f"{key.kind} {key.namespace}/{key.name}")
+            if k8s.get_in(obj, "metadata", "finalizers"):
+                emitted: list = []
+                if not k8s.get_in(obj, "metadata", "deletionTimestamp"):
+                    ts = _now_iso()
+                    with self._rv_lock:
+                        # copy-on-write delete mark: the stored object is
+                        # immutable (frames share it), so the mark is a
+                        # fresh dict sharing spec/status
+                        marked = {**obj,
+                                  "metadata": {**obj["metadata"],
+                                               "deletionTimestamp": ts,
+                                               "resourceVersion":
+                                                   self._next_rv()}}
+                        shard.objects[key] = marked
+                        emitted.append(self._emit_locked("MODIFIED", marked))
+                return emitted
+            if not take_all:
+                return None
+            return self._remove_and_gc(key)
 
     # ------------------------------------------------------- delete plumbing
     def _remove_and_gc(self, key: ObjectKey,
                        replacement: dict | None = None) -> list:
         """Remove object and cascade-delete dependents via ownerReferences,
-        honoring dependents' own finalizers. Caller holds the lock; returns
-        emissions for _dispatch_all. The DELETED event carries a FRESH
-        resourceVersion (as the real apiserver's does — the deletion is an
-        etcd revision): the resume ring is rv-ordered, and a DELETED frame
-        reusing the object's last-write rv would sort before newer events
-        and be skipped by any resume past it — a silently lost deletion."""
-        obj = replacement if replacement is not None else self._objects.get(key)
+        honoring dependents' own finalizers. Caller holds EVERY shard lock
+        (canonical index order — dependents live on arbitrary shards);
+        returns emissions for _dispatch_all. The DELETED event carries a
+        FRESH resourceVersion (as the real apiserver's does — the deletion
+        is an etcd revision): the resume ring is rv-ordered, and a DELETED
+        frame reusing the object's last-write rv would sort before newer
+        events and be skipped by any resume past it — a silently lost
+        deletion."""
+        shard = self._shard_of(key)
+        obj = replacement if replacement is not None \
+            else shard.objects.get(key)
         emitted: list = []
-        if key in self._objects:
-            del self._objects[key]
+        if key in shard.objects:
+            del shard.objects[key]
         if obj is None:
             return emitted
         if key.kind == "CustomResourceDefinition":
@@ -643,23 +846,37 @@ class ClusterStore:
         elif key.kind in ("MutatingWebhookConfiguration",
                           "ValidatingWebhookConfiguration"):
             self._unindex_webhook_config(key)
-        final = k8s.deepcopy(obj)
-        final["metadata"]["resourceVersion"] = self._next_rv()
-        emitted.append(self._emit_locked("DELETED", final))
+        with self._rv_lock:
+            # copy-on-write DELETED frame: fresh metadata with the fresh
+            # rv, sharing the immutable object's spec/status
+            final = {**obj, "metadata": {**obj["metadata"],
+                                         "resourceVersion":
+                                             self._next_rv()}}
+            emitted.append(self._emit_locked("DELETED", final))
         owner_uid = k8s.uid(obj)
         if owner_uid:
-            dependents = [dk for dk, dobj in self._objects.items()
-                          if k8s.is_owned_by(dobj, owner_uid)]
+            dependents = []
+            for s in self._shards:
+                dependents.extend(
+                    dk for dk, dobj in s.objects.items()
+                    if k8s.is_owned_by(dobj, owner_uid))
             for dk in dependents:
-                dobj = self._objects.get(dk)
+                dshard = self._shard_of(dk)
+                dobj = dshard.objects.get(dk)
                 if dobj is None:
                     continue
                 if k8s.get_in(dobj, "metadata", "finalizers"):
                     if not k8s.get_in(dobj, "metadata", "deletionTimestamp"):
-                        dobj["metadata"]["deletionTimestamp"] = _now_iso()
-                        dobj["metadata"]["resourceVersion"] = self._next_rv()
-                        emitted.append(self._emit_locked(
-                            "MODIFIED", k8s.deepcopy(dobj)))
+                        ts = _now_iso()
+                        with self._rv_lock:
+                            marked = {**dobj,
+                                      "metadata": {**dobj["metadata"],
+                                                   "deletionTimestamp": ts,
+                                                   "resourceVersion":
+                                                       self._next_rv()}}
+                            dshard.objects[dk] = marked
+                            emitted.append(self._emit_locked("MODIFIED",
+                                                             marked))
                 else:
                     emitted.extend(self._remove_and_gc(dk))
         return emitted
@@ -668,8 +885,9 @@ class ClusterStore:
     def watch(self, kind: str, callback: Callable[[WatchEvent], None],
               namespace: str | None = None,
               label_selector: dict[str, str] | None = None) -> None:
-        with self._lock:
-            self._watches.append(_Watch(kind, callback, namespace, label_selector))
+        with self._rv_lock:
+            self._watches.append(_Watch(kind, callback, namespace,
+                                        label_selector))
 
     def watch_frames(self, kind: str, relay: Callable,
                      namespace: str | None = None,
@@ -685,7 +903,7 @@ class ClusterStore:
         window — or names a version this store never issued (a resume
         against a different store incarnation must relist, never
         silently skip)."""
-        with self._lock:
+        with self._rv_lock:
             replay: list[EventFrame] = []
             if since_rv is not None:
                 ring = self._watch_rings.get(kind)
@@ -709,14 +927,21 @@ class ClusterStore:
         deepcopied snapshot of its current objects plus the anchor rv —
         the init handshake for a server-side watch cache: the cache is
         exactly consistent from birth (every event after the snapshot
-        arrives through the relay, in rv order, under this same lock),
-        so reads served from it are never stale relative to the store."""
-        with self._lock:
-            objs = [k8s.deepcopy(obj) for key, obj in self._objects.items()
-                    if key.kind == kind]
-            self._watches.append(_Watch(kind, relay, None, None,
-                                        frames=True))
-            return objs, self._last_rv
+        arrives through the relay, in rv order). Holding every shard
+        lock plus the rv lock excludes all writers — no event can be
+        stamped while the snapshot is cut — so reads served from the
+        cache are never stale relative to the store."""
+        with self._all_shards_locked():
+            with self._rv_lock:
+                refs = [obj for s in self._shards
+                        for okey, obj in s.objects.items()
+                        if okey.kind == kind]
+                self._watches.append(_Watch(kind, relay, None, None,
+                                            frames=True))
+                anchor = self._last_rv
+        # stored objects are immutable: the copies happen outside the
+        # locks (the deepcopied-return contract is unchanged)
+        return [k8s.deepcopy(o) for o in refs], anchor
 
     def list_cached(self, kind: str, namespace: str | None = None,
                     label_selector: dict[str, str] | None = None,
@@ -730,7 +955,7 @@ class ClusterStore:
     def unwatch(self, callback: Callable[[WatchEvent], None]) -> None:
         """Deregister a watch callback (watch stream teardown — the apiserver
         facade drops its per-connection relay when the HTTP client goes away)."""
-        with self._lock:
+        with self._rv_lock:
             # equality, not identity: a bound method (the serve cache's
             # _on_frame relay) is a fresh object per attribute access, and
             # == compares __self__/__func__; for plain functions/closures
@@ -747,5 +972,8 @@ class ClusterStore:
             return None
 
     def all_objects(self) -> Iterator[dict]:
-        with self._lock:
-            return iter([k8s.deepcopy(o) for o in self._objects.values()])
+        refs: list[dict] = []
+        for shard in self._shards:
+            with shard.lock:
+                refs.extend(shard.objects.values())
+        return iter([k8s.deepcopy(o) for o in refs])

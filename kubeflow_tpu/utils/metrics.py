@@ -79,7 +79,10 @@ METRIC_FAMILY_CATALOG = frozenset({
     "watch_resumes_total",
     "watch_cache_evictions_total",
     "store_list_lock_seconds",
+    "store_write_lock_seconds",
     "watch_queue_coalesced_total",
+    "watch_fanout_bytes_total",
+    "watch_frames_sent_total",
     "apiserver_cache_lists_total",
     # concurrency sanitizer
     "sanitizer_violations_total",
@@ -133,7 +136,10 @@ METRIC_FAMILY_LABELS = {
     "slicepool_bind_misses_total": ("reason",),
     "slicepool_size": ("pool", "state"),
     "store_list_lock_seconds": ("kind",),
+    "store_write_lock_seconds": ("kind",),
     "watch_cache_evictions_total": ("kind",),
+    "watch_fanout_bytes_total": ("encoding",),
+    "watch_frames_sent_total": ("encoding",),
     "watch_queue_coalesced_total": (),
     "watch_resumes_total": ("kind", "mode"),
     "workqueue_adds_total": ("name",),

@@ -1111,6 +1111,8 @@ def run_sharded(count: int, namespace: str, accelerator: str,
                 extra_after_kill: int = 0,
                 lease_duration_s: float = 10.0,
                 renew_period_s: float = 1.0,
+                frontends: int = 1, wire_format: str = "json",
+                kill_frontend_at_frac: float | None = None,
                 stats_out: dict | None = None) -> int:
     """Sharded multi-manager fan-out over the real wire: N manager stacks
     (each its own HttpApiClient + read cache + worker pool + per-shard
@@ -1127,13 +1129,26 @@ def run_sharded(count: int, namespace: str, accelerator: str,
     hard-kill shape) once that fraction of the fleet is Ready; the
     survivors must adopt its shards within the lease duration and
     ``extra_after_kill`` more notebooks created post-kill must still
-    converge — no lost notebooks."""
+    converge — no lost notebooks.
+
+    ``frontends`` replicates the apiserver facade: N ApiServerProxy
+    instances over ONE sharded store, every client holding the full
+    endpoint list (new connections rotate; connect failures fail over).
+    ``wire_format="binary"`` moves the manager fleet onto the compact
+    codec; a JSON watch-integrity observer always rides along when
+    ``frontends > 1``, so the run doubles as the mixed-fleet
+    serialize-once check and its event record is diffed against the
+    store's own resume ring — zero lost, zero duplicated watch events.
+    ``kill_frontend_at_frac`` hard-stops frontend 0 once that fraction
+    of the fleet is Ready: every stream must fail over and RESUME by
+    resourceVersion (zero relists pinned via the observer's metrics)."""
     import threading
 
     from kubeflow_tpu.api import types as api
     from kubeflow_tpu.api.slicepool import install_slicepool_crd
     from kubeflow_tpu.cluster.apiserver import ApiServerProxy
     from kubeflow_tpu.cluster.cache import CachingClient
+    from kubeflow_tpu.cluster.errors import GoneError
     from kubeflow_tpu.cluster.http_client import HttpApiClient
     from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
     from kubeflow_tpu.cluster.store import ClusterStore
@@ -1153,17 +1168,25 @@ def run_sharded(count: int, namespace: str, accelerator: str,
         sim_mgr.start()
         cleanups.append(sim_mgr.stop)
         server_metrics = MetricsRegistry(include_notebook_metrics=False)
-        proxy = ApiServerProxy(store,
-                               latency_s=apiserver_latency_ms / 1000.0)
-        proxy.attach_metrics(server_metrics)
-        proxy.start()
-        cleanups.append(proxy.stop)
+        # replicated frontends: every proxy serves the same store and
+        # attaches the same registry (get-or-create counters — the
+        # fan-out/lock series aggregate across the fleet)
+        proxies = []
+        for _f in range(frontends):
+            proxy = ApiServerProxy(store,
+                                   latency_s=apiserver_latency_ms / 1000.0)
+            proxy.attach_metrics(server_metrics)
+            proxy.start()
+            cleanups.append(proxy.stop)
+            proxies.append(proxy)
+        endpoints = ",".join(p.url for p in proxies)
 
         tracker = _DuplicateTracker()
         stacks = []  # (mgr, registry, requests_counter)
         for m in range(managers):
-            client = HttpApiClient(proxy.url, list_page_size=list_page_size,
-                                   user_agent=f"kubeflow-tpu-manager/m{m}")
+            client = HttpApiClient(endpoints, list_page_size=list_page_size,
+                                   user_agent=f"kubeflow-tpu-manager/m{m}",
+                                   wire_format=wire_format)
             cleanups.append(client.close)
             cfg = ControllerConfig(
                 shard_count=shards, shard_identity=f"m{m}",
@@ -1185,6 +1208,28 @@ def run_sharded(count: int, namespace: str, accelerator: str,
             print("FAIL: shard ownership never settled "
                   f"({[sorted(s[0].sharding.owned_shards()) for s in stacks]})")
             return 1
+
+        # mixed-fleet watch-integrity observer (replicated-frontend runs):
+        # a JSON watcher over the SAME rings the (possibly binary) manager
+        # fleet consumes. Registered before any notebook exists, so its
+        # delivered (type, name, rv) record can be diffed exactly against
+        # the store's resume ring after convergence — lost or duplicated
+        # watch events are counted, not inferred from convergence.
+        obs_events: list[tuple] = []
+        obs_lock = threading.Lock()
+        obs_metrics = None
+        if frontends > 1:
+            obs_metrics = MetricsRegistry()
+            observer = HttpApiClient(endpoints, metrics=obs_metrics,
+                                     user_agent="kftpu-watch-observer")
+            cleanups.append(observer.close)
+
+            def _observe(ev):
+                md = ev.obj.get("metadata", {})
+                with obs_lock:
+                    obs_events.append((ev.type, md.get("name"),
+                                       int(md.get("resourceVersion", 0))))
+            observer.watch(api.KIND, _observe)
 
         namespaces = [f"{namespace}-{i}" for i in range(namespace_count)]
         ready_at: dict[str, float] = {}
@@ -1243,6 +1288,20 @@ def run_sharded(count: int, namespace: str, accelerator: str,
             for i in range(count, count + extra_after_kill):
                 _create(i)
             total = count + extra_after_kill
+        fe_killed_requests = None
+        if kill_frontend_at_frac is not None and frontends > 1:
+            if not _wait_ready(max(1, int(count * kill_frontend_at_frac)),
+                               deadline):
+                print(f"FAIL: only {len(ready_at)}/{count} ready before "
+                      f"the frontend-kill point")
+                return 1
+            # hard-stop frontend 0: its sockets die mid-stream. Every
+            # client holds the full endpoint list, so watches reconnect
+            # on a surviving frontend and resume by resourceVersion —
+            # the observer's relist counter pins that no stream fell
+            # back to a LIST (zero missable gap)
+            fe_killed_requests = proxies[0].requests_served
+            proxies[0].stop()
         converged = _wait_ready(total, deadline)
         wall = time.monotonic() - t0
         store.unwatch(on_event)
@@ -1293,9 +1352,93 @@ def run_sharded(count: int, namespace: str, accelerator: str,
         print(f"aggregate req/nb: {agg_req_nb:.1f}  p50: {p50*1000:.0f}ms  "
               f"p95: {p95*1000:.0f}ms  duplicate-owner reconciles: "
               f"{len(duplicates)}")
+        write_hist = server_metrics.histogram("store_write_lock_seconds", "")
         print(f"store: {cache_lists:.0f} cache-served LISTs, "
               f"{lock_hist.total_count():.0f} store-lock LISTs holding "
-              f"{lock_hist.total_sum()*1000:.1f}ms total")
+              f"{lock_hist.total_sum()*1000:.1f}ms total, "
+              f"{write_hist.total_count():.0f} writes holding "
+              f"{write_hist.total_sum()*1000:.1f}ms total")
+        fe_requests = [p.requests_served for p in proxies]
+        fan_bytes = server_metrics.counter("watch_fanout_bytes_total", "")
+        fan_frames = server_metrics.counter("watch_frames_sent_total", "")
+        fanout = {enc: {"bytes": fan_bytes.sum_where({"encoding": enc}),
+                        "frames": fan_frames.sum_where({"encoding": enc})}
+                  for enc in ("binary", "json")}
+        if frontends > 1:
+            print("| frontend | requests |")
+            print("|---|---|")
+            for f, reqs in enumerate(fe_requests):
+                tag = " (killed)" if fe_killed_requests is not None \
+                    and f == 0 else ""
+                print(f"| fe{f}{tag} | {reqs} |")
+            for enc in ("binary", "json"):
+                if fanout[enc]["frames"]:
+                    print(f"watch fan-out [{enc}]: "
+                          f"{fanout[enc]['bytes']:.0f} B over "
+                          f"{fanout[enc]['frames']:.0f} frames = "
+                          f"{fanout[enc]['bytes'] / fanout[enc]['frames']:.0f}"
+                          f" B/event")
+        watch_lost = watch_dup = None
+        observer_relists = None
+        if obs_metrics is not None:
+            # quiesce, then snapshot the ring and wait for the observer
+            # to catch up to it — the diff below is exact, not racy
+            time.sleep(0.5)
+
+            def _ring_sink(frame):
+                return frame  # relay registered only to read the replay
+
+            ring = None
+            try:
+                replay, _ = store.watch_frames(api.KIND, _ring_sink,
+                                               since_rv=0)
+                ring = {(f.type, f.obj["metadata"]["name"], f.rv)
+                        for f in replay}
+            except GoneError:
+                print("watch-integrity: ring evicted at this scale — "
+                      "per-name monotonicity check only")
+            finally:
+                store.unwatch(_ring_sink)
+            settle = time.monotonic() + 10.0
+            while ring is not None and time.monotonic() < settle:
+                with obs_lock:
+                    if ring <= set(obs_events):
+                        break
+                time.sleep(0.05)
+            with obs_lock:
+                events = list(obs_events)
+            last_rv: dict[str, int] = {}
+            watch_dup = 0
+            for _t, nb_name, rv in events:
+                if rv <= last_rv.get(nb_name, 0):
+                    watch_dup += 1  # duplicate or reordered delivery
+                else:
+                    last_rv[nb_name] = rv
+            if ring is not None:
+                got = set(events)
+                max_ring_rv = max((rv for _, _, rv in ring), default=0)
+                watch_lost = len(ring - got)
+                watch_dup += len({e for e in got - ring
+                                  if e[2] <= max_ring_rv})
+            observer_relists = obs_metrics.counter(
+                "watch_resumes_total", "").sum_where({"mode": "relist"})
+            print(f"watch-integrity: {len(events)} events observed, "
+                  f"lost={watch_lost} dup={watch_dup} "
+                  f"relists={observer_relists:.0f}")
+            if watch_lost:
+                print(f"FAIL: {watch_lost} watch events LOST across the "
+                      f"replicated frontends (ring has them, the observer "
+                      f"never saw them)")
+                return 1
+            if watch_dup:
+                print(f"FAIL: {watch_dup} duplicated/reordered watch "
+                      f"events delivered to the observer")
+                return 1
+            if observer_relists:
+                print(f"FAIL: {observer_relists:.0f} observer reconnects "
+                      f"fell back to a full relist — the resume cursor "
+                      f"did not survive the frontend fleet")
+                return 1
         if stats_out is not None:
             stats_out.update({
                 "wall_s": wall, "req_per_nb": agg_req_nb, "p50_s": p50,
@@ -1303,7 +1446,15 @@ def run_sharded(count: int, namespace: str, accelerator: str,
                 "per_manager": per_manager,
                 "store_lock_lists": lock_hist.total_count(),
                 "store_lock_seconds": lock_hist.total_sum(),
+                "store_lock_writes": write_hist.total_count(),
+                "store_write_seconds": write_hist.total_sum(),
                 "cache_lists": cache_lists,
+                "frontend_requests": fe_requests,
+                "killed_frontend_requests": fe_killed_requests,
+                "fanout": fanout,
+                "watch_events": len(obs_events),
+                "watch_lost": watch_lost, "watch_dup": watch_dup,
+                "observer_relists": observer_relists,
             })
         if duplicates:
             print(f"FAIL: {len(duplicates)} keys reconciled by multiple "
@@ -1321,25 +1472,32 @@ def run_sharded(count: int, namespace: str, accelerator: str,
 def run_soak(count: int, accelerator: str, timeout: float,
              managers: int, shards: int, workers: int = 4,
              namespace_count: int = 64, boot_delay_ms: float = 100.0,
-             stats_out: dict | None = None) -> int:
-    """100k-notebook soak: the sharded CORE control plane in-process (no
-    HTTP wire — the wire adds ~0.5 ms/request of localhost cost that
-    would turn a 100k fan-out into hours on CI hardware; the sharded wire
-    behavior is measured by run_sharded at 2000). N manager instances
-    share one ClusterStore, ownership split by namespace hash; the
-    kubelet sim runs EVENT-DRIVEN boot ticks (one timer entry per pod,
-    zero readiness polling) and no per-pod Node objects, so the soak's
-    cost is reconcile logic, not simulator churn.
+             stats_out: dict | None = None, frontends: int = 0,
+             wire_format: str = "binary",
+             kill_frontend_at_frac: float | None = None) -> int:
+    """100k-to-1M-notebook soak: the sharded CORE control plane. With
+    ``frontends=0`` (the PR-15 shape) managers reconcile the store
+    in-process — no HTTP wire. ``frontends=N`` is the 1M target profile:
+    N replicated ApiServerProxy frontends over ONE sharded store, every
+    manager an HttpApiClient on the compact binary wire holding the full
+    endpoint list. The kubelet sim runs EVENT-DRIVEN boot ticks (one
+    timer entry per pod, zero readiness polling) and no per-pod Node
+    objects, so the soak's cost is reconcile logic, not simulator churn.
 
     Scope: core notebook reconciler only (extension/repair/pool off —
     their fan-outs multiply the object graph ~3x and are covered by the
     wire phases); single-worker slices. Asserted: full convergence, ZERO
-    duplicate-owner reconciles, and the store-lock LIST profile
-    (store_list_lock_seconds), which must stay flat as managers grow —
-    manager resyncs/backfills ride the cache-served LIST path."""
+    duplicate-owner reconciles, the store-lock LIST/write profile
+    (store_list_lock_seconds / store_write_lock_seconds), and — on the
+    wire profile — zero relist resyncs across the whole manager fleet
+    (every watch reconnect, including the ``kill_frontend_at_frac``
+    mid-soak frontend kill, resumed by resourceVersion: no missable
+    gap, so no lost watch events)."""
     import threading
 
     from kubeflow_tpu.api import types as api
+    from kubeflow_tpu.cluster.apiserver import ApiServerProxy
+    from kubeflow_tpu.cluster.http_client import HttpApiClient
     from kubeflow_tpu.cluster.kubelet import StatefulSetSimulator
     from kubeflow_tpu.cluster.store import ClusterStore
     from kubeflow_tpu.controllers import Manager, setup_controllers
@@ -1362,6 +1520,19 @@ def run_soak(count: int, accelerator: str, timeout: float,
         sim_mgr.start()
         cleanups.append(sim_mgr.stop)
 
+        # replicated frontends (the 1M wire profile): all proxies share
+        # one registry, so the fan-out/lock series aggregate fleet-wide
+        proxies = []
+        endpoints = None
+        if frontends > 0:
+            for _f in range(frontends):
+                proxy = ApiServerProxy(store)
+                proxy.attach_metrics(server_metrics)
+                proxy.start()
+                cleanups.append(proxy.stop)
+                proxies.append(proxy)
+            endpoints = ",".join(p.url for p in proxies)
+
         tracker = _DuplicateTracker()
         stacks = []
         for m in range(managers):
@@ -1375,11 +1546,18 @@ def run_soak(count: int, accelerator: str, timeout: float,
                 shard_lease_duration_s=90.0, shard_renew_period_s=2.0,
                 enable_slice_repair=False, enable_slice_pool=False)
             reg = MetricsRegistry()
+            if endpoints is not None:
+                backend = HttpApiClient(
+                    endpoints, metrics=reg, wire_format=wire_format,
+                    user_agent=f"kubeflow-tpu-manager/m{m}")
+                cleanups.append(backend.close)
+            else:
+                backend = store
             # webhooks=False matches the wire loadtest's semantics (an
             # HTTP manager can't install in-process admission either) —
             # and the mutating webhook's odh stop-lock annotation would
             # park every notebook forever with the extension manager off
-            mgr = setup_controllers(store, config=cfg, metrics=reg,
+            mgr = setup_controllers(backend, config=cfg, metrics=reg,
                                     core=True, extension=False,
                                     webhooks=False,
                                     max_concurrent_reconciles=workers)
@@ -1414,6 +1592,23 @@ def run_soak(count: int, accelerator: str, timeout: float,
                     ready_cv.notify_all()
         store.watch(api.KIND, on_event)
 
+        kill_target = None
+        if kill_frontend_at_frac is not None and frontends > 1:
+            kill_target = max(1, int(count * kill_frontend_at_frac))
+        fe_killed_requests = [None]
+
+        def _maybe_kill_frontend(current: int) -> None:
+            # mid-soak frontend kill: streams on fe0 die mid-event; every
+            # client fails over to a surviving endpoint and resumes by rv
+            if kill_target is not None and fe_killed_requests[0] is None \
+                    and current >= kill_target:
+                fe_killed_requests[0] = proxies[0].requests_served
+                print(f"  mid-soak frontend kill: fe0 stopped at ready "
+                      f"{current}/{count} "
+                      f"({fe_killed_requests[0]} requests served)",
+                      flush=True)
+                proxies[0].stop()
+
         t0 = time.monotonic()
         report_every = max(count // 20, 1)
         for i in range(count):
@@ -1424,24 +1619,29 @@ def run_soak(count: int, accelerator: str, timeout: float,
                 elapsed = time.monotonic() - t0
                 print(f"  created {i+1}/{count}, ready {ready[0]} "
                       f"({elapsed:.0f}s)", flush=True)
+                _maybe_kill_frontend(ready[0])
         create_wall = time.monotonic() - t0
         deadline = t0 + timeout
         last_report = time.monotonic()
-        with ready_cv:
-            while ready[0] < count:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                ready_cv.wait(min(remaining, 10.0))
-                if time.monotonic() - last_report >= 30.0:
-                    last_report = time.monotonic()
-                    print(f"  draining: ready {ready[0]}/{count} "
-                          f"({time.monotonic() - t0:.0f}s)", flush=True)
+        while True:  # bounded: deadline-gated, breaks on convergence
+            with ready_cv:
+                if ready[0] < count and deadline > time.monotonic():
+                    ready_cv.wait(min(deadline - time.monotonic(), 5.0))
+                current = ready[0]
+                done = current >= count or time.monotonic() >= deadline
+            _maybe_kill_frontend(current)
+            if time.monotonic() - last_report >= 30.0:
+                last_report = time.monotonic()
+                print(f"  draining: ready {current}/{count} "
+                      f"({time.monotonic() - t0:.0f}s)", flush=True)
+            if done:
+                break
         wall = time.monotonic() - t0
         store.unwatch(on_event)
         converged = ready[0] >= count
         duplicates = tracker.violations()
         lock_hist = server_metrics.histogram("store_list_lock_seconds", "")
+        write_hist = server_metrics.histogram("store_write_lock_seconds", "")
         shard_split = [sorted(s[0].sharding.owned_shards()) for s in stacks]
         # transitions beyond the initial settle mean ownership flapped
         # mid-run (a legal serialized handoff, but it churns resyncs)
@@ -1449,19 +1649,42 @@ def run_soak(count: int, accelerator: str, timeout: float,
             reg.counter("shard_rebalance_total", "").total()
             for _, reg in stacks)
         print(f"soak: {count} notebooks  managers: {managers}  shards: "
-              f"{shards}  wall: {wall:.1f}s (create phase "
+              f"{shards}  frontends: {frontends} ({wire_format} wire)  "
+              f"wall: {wall:.1f}s (create phase "
               f"{create_wall:.1f}s)  ready: {ready[0]}/{count}")
         print(f"shard split: {shard_split}  ownership transitions: "
               f"{rebalances:.0f}")
         print(f"duplicate-owner reconciles: {len(duplicates)}  store-lock "
               f"LISTs: {lock_hist.total_count():.0f} holding "
-              f"{lock_hist.total_sum()*1000:.1f}ms total")
+              f"{lock_hist.total_sum()*1000:.1f}ms total  writes: "
+              f"{write_hist.total_count():.0f} holding "
+              f"{write_hist.total_sum()*1000:.1f}ms total")
+        fe_requests = [p.requests_served for p in proxies]
+        relists = resumes = 0.0
+        if frontends > 0:
+            print("| frontend | requests |")
+            print("|---|---|")
+            for f, reqs in enumerate(fe_requests):
+                tag = " (killed)" if fe_killed_requests[0] is not None \
+                    and f == 0 else ""
+                print(f"| fe{f}{tag} | {reqs} |")
+            for _, reg in stacks:
+                resumes_counter = reg.counter("watch_resumes_total", "")
+                relists += resumes_counter.sum_where({"mode": "relist"})
+                resumes += resumes_counter.sum_where({"mode": "resume"})
+            print(f"manager watch reconnects: {resumes:.0f} rv-resumes, "
+                  f"{relists:.0f} relists")
         if stats_out is not None:
             stats_out.update({
                 "wall_s": wall, "ready": ready[0],
                 "duplicates": duplicates,
                 "store_lock_lists": lock_hist.total_count(),
                 "store_lock_seconds": lock_hist.total_sum(),
+                "store_lock_writes": write_hist.total_count(),
+                "store_write_seconds": write_hist.total_sum(),
+                "frontend_requests": fe_requests,
+                "killed_frontend_requests": fe_killed_requests[0],
+                "relists": relists, "resumes": resumes,
             })
         if not converged:
             print(f"FAIL: only {ready[0]}/{count} notebooks became "
@@ -1470,6 +1693,12 @@ def run_soak(count: int, accelerator: str, timeout: float,
         if duplicates:
             print(f"FAIL: {len(duplicates)} duplicate-owner reconciles: "
                   f"{duplicates[:5]}")
+            return 1
+        if frontends > 0 and relists:
+            print(f"FAIL: {relists:.0f} manager watch reconnects fell back "
+                  f"to a full relist — a resume cursor was lost across the "
+                  f"frontend fleet (missable gap ⇒ potentially lost watch "
+                  f"events)")
             return 1
         return 0
     finally:
@@ -1592,10 +1821,27 @@ def main() -> int:
                          "utilization, oversubscription, or a missing "
                          "preemption cascade (see run_mixed)")
     ap.add_argument("--soak", action="store_true",
-                    help="100k-scale soak: sharded core control plane "
-                         "in-process with event-driven kubelet ticks "
-                         "(uses --count/--managers/--shards/"
-                         "--namespace-count; see run_soak)")
+                    help="100k-to-1M-scale soak: sharded core control "
+                         "plane with event-driven kubelet ticks (uses "
+                         "--count/--managers/--shards/--namespace-count; "
+                         "add --frontends N for the replicated-frontend "
+                         "wire profile; see run_soak)")
+    ap.add_argument("--frontends", type=int, default=0, metavar="N",
+                    help="replicate the apiserver facade: N frontends "
+                         "over one sharded store, every client holding "
+                         "the full endpoint list (sharded runs default "
+                         "to 1; the soak's wire profile needs >= 2)")
+    ap.add_argument("--wire-format", choices=("json", "binary"),
+                    default="binary",
+                    help="manager-fleet wire encoding for --frontends "
+                         "runs (json stays the default/debug path "
+                         "elsewhere)")
+    ap.add_argument("--kill-frontend-at", type=float, default=None,
+                    metavar="FRAC",
+                    help="hard-stop frontend 0 once FRAC of the fleet "
+                         "is Ready: every stream must fail over and "
+                         "resume by resourceVersion (needs "
+                         "--frontends >= 2)")
     args = ap.parse_args()
     if args.emit_yaml:
         try:
@@ -1613,7 +1859,10 @@ def main() -> int:
                         managers=max(args.managers, 1),
                         shards=args.shards or 8, workers=args.workers,
                         namespace_count=args.namespace_count,
-                        boot_delay_ms=args.boot_delay_ms)
+                        boot_delay_ms=args.boot_delay_ms,
+                        frontends=args.frontends,
+                        wire_format=args.wire_format,
+                        kill_frontend_at_frac=args.kill_frontend_at)
     if args.managers > 0:
         return run_sharded(args.count, args.namespace, args.accelerator,
                            args.timeout, managers=args.managers,
@@ -1625,7 +1874,11 @@ def main() -> int:
                            kill_manager_at_frac=args.kill_manager_at,
                            extra_after_kill=(max(args.count // 10, 4)
                                              if args.kill_manager_at
-                                             else 0))
+                                             else 0),
+                           frontends=max(args.frontends, 1),
+                           wire_format=(args.wire_format
+                                        if args.frontends else "json"),
+                           kill_frontend_at_frac=args.kill_frontend_at)
     if args.wire:
         return run_wire(args.count, args.namespace, args.accelerator,
                         args.timeout,

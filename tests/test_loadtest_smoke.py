@@ -9,4 +9,4 @@ from ci.loadtest_smoke import run_smoke
 
 
 def test_wire_smoke_50_notebooks_4_workers():
-    assert run_smoke(count=50, workers=4, budget_s=90.0) == 0
+    assert run_smoke(count=50, workers=4, budget_s=120.0) == 0

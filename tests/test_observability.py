@@ -1011,7 +1011,10 @@ METRIC_FAMILY_CATALOG = {
     "slicepool_bind_misses_total",
     "slicepool_size",
     "store_list_lock_seconds",
+    "store_write_lock_seconds",
     "watch_cache_evictions_total",
+    "watch_fanout_bytes_total",
+    "watch_frames_sent_total",
     "watch_queue_coalesced_total",
     "watch_resumes_total",
     "workqueue_adds_total",
@@ -1171,7 +1174,9 @@ def test_workqueue_and_client_families_exported_via_manager():
     apiserver_breaker_transitions_total, apiserver_cache_lists_total,
     reconcile_read_seconds, reconcile_write_seconds,
     cache_full_scans_total, cache_index_lookups_total,
-    store_list_lock_seconds, serving_generate_seconds_count,
+    store_list_lock_seconds, store_write_lock_seconds,
+    watch_fanout_bytes_total, watch_frames_sent_total,
+    serving_generate_seconds_count,
     serving_generate_seconds_sum, serving_http_requests_total,
     notebook_create_failed_total, notebook_culling_total,
     notebook_running, last_notebook_culling_timestamp_seconds,

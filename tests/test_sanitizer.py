@@ -332,9 +332,9 @@ def test_reconstructed_inversion_is_detected():
 
     store = ClusterStore()
     proxy = ApiServerProxy(store)
-    with proxy._serve_caches_lock:    # cache tier (30) ...
-        with store._lock:             # ... then store tier (20): inverted
-            pass
+    with proxy._serve_caches_lock:            # cache tier (30) ...
+        with store._shards[0].lock:           # ... then store tier (20):
+            pass                              # inverted
     assert sanitizer.get_sanitizer().counts().get(
         sanitizer.RULE_HIERARCHY, 0) >= 1
 

@@ -1,0 +1,250 @@
+"""Sharded ClusterStore: per-(kind, namespace-hash) write locking under
+one globally monotonic resourceVersion stream.
+
+PR-5 pinned the watch-resume contract (tests/test_watch_resume.py);
+this module pins the sharding layer UNDER it: the shard-key function is
+deterministic and spreads real namespace fleets, concurrent writers on
+different shards never tear the global rv order (every rv unique, ring
+order == rv order, ``_last_rv`` the anchor), cross-shard cascade GC
+sees every dependent while holding the full lock set, and the write-
+path lock metric (``store_write_lock_seconds``) is observable per kind.
+The single-shard degenerate config must behave identically — sharding
+is a concurrency optimization, never a semantic fork.
+"""
+
+import threading
+
+import pytest
+
+from kubeflow_tpu.cluster.errors import GoneError
+from kubeflow_tpu.cluster.store import (DEFAULT_SHARDS, ClusterStore,
+                                        _shard_index)
+from kubeflow_tpu.utils import k8s
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+
+def cm(name, ns="default", data=None):
+    return {"kind": "ConfigMap", "apiVersion": "v1",
+            "metadata": {"name": name, "namespace": ns},
+            "data": data or {"k": "v"}}
+
+
+# ------------------------------------------------------------- shard keying
+
+
+def test_shard_index_deterministic_and_bounded():
+    for kind in ("ConfigMap", "Notebook", "StatefulSet"):
+        for i in range(200):
+            ns = f"team-{i}"
+            idx = _shard_index(kind, ns, DEFAULT_SHARDS)
+            assert 0 <= idx < DEFAULT_SHARDS
+            assert idx == _shard_index(kind, ns, DEFAULT_SHARDS)
+
+
+def test_shard_index_spreads_namespace_fleets():
+    """The loadtest shape — one kind, many namespaces — must land on
+    every shard (a hash collapsing namespaces onto one shard would
+    silently serialize the whole fleet's writes again)."""
+    hit = {_shard_index("Notebook", f"team-{i}", DEFAULT_SHARDS)
+           for i in range(64)}
+    assert hit == set(range(DEFAULT_SHARDS))
+
+
+def test_kind_contributes_to_shard_key():
+    """Same namespace, different kinds may shard apart — the key is
+    (kind, namespace), so one hot namespace still spreads its per-kind
+    write streams."""
+    spread = {_shard_index(kind, "default", 64)
+              for kind in ("ConfigMap", "Notebook", "StatefulSet",
+                           "Service", "Pod", "Event", "Secret")}
+    assert len(spread) > 1
+
+
+def test_store_shard_structures_distinct():
+    store = ClusterStore()
+    assert len(store._shards) == DEFAULT_SHARDS
+    assert len({id(s.lock) for s in store._shards}) == DEFAULT_SHARDS
+    assert len({id(s.objects) for s in store._shards}) == DEFAULT_SHARDS
+
+
+# ------------------------------------------- global rv under concurrent load
+
+
+def _hammer(store, thread_idx, namespaces, per_ns, errors):
+    try:
+        for ns in namespaces:
+            for i in range(per_ns):
+                name = f"t{thread_idx}-{i}"
+                store.create(cm(name, ns=ns))
+                obj = store.get("ConfigMap", ns, name)
+                obj["data"] = {"rev": "2"}
+                store.update(obj)
+                if i % 3 == 0:
+                    store.delete("ConfigMap", ns, name)
+    except Exception as exc:  # surfaced by the main thread
+        errors.append(exc)
+
+
+def test_concurrent_writers_rv_unique_and_ring_ordered():
+    """8 writer threads across 16 namespaces: every emitted event rv is
+    unique, the watch ring replays them in strictly increasing order
+    (ring order IS rv order — the property resume correctness stands
+    on), and the final anchor equals the largest rv issued."""
+    store = ClusterStore()
+    relayed = []
+    relay_lock = threading.Lock()
+
+    def relay(frame):
+        with relay_lock:
+            relayed.append((frame.type, frame.rv))
+
+    _, anchor0 = store.watch_frames("ConfigMap", relay)
+    assert anchor0 == 0
+
+    errors: list = []
+    threads = [threading.Thread(
+        target=_hammer,
+        args=(store, t, [f"ns-{(t * 2 + j) % 16}" for j in range(2)],
+              12, errors),
+        daemon=True) for t in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+        assert not th.is_alive(), "writer thread hung"
+    assert not errors, errors
+
+    rvs = [rv for _, rv in relayed]
+    assert len(rvs) == len(set(rvs)), "duplicate resourceVersion emitted"
+    assert rvs == sorted(rvs), "relay order diverged from rv order"
+    # replay from 0 must agree with the live relay exactly (same ring)
+    replay, anchor = store.watch_frames("ConfigMap", lambda *a: None,
+                                        since_rv=0)
+    assert [f.rv for f in replay] == rvs[-len(replay):]
+    assert anchor == max(rvs)
+
+
+def test_rv_anchor_semantics_at_the_edge():
+    """since_rv == _last_rv is a valid (empty) resume; any rv beyond the
+    anchor names a version this store never issued → 410, never a
+    silent skip (a resume against a different store incarnation)."""
+    store = ClusterStore()
+    store.create(cm("edge"))
+    _, anchor = store.watch_frames("ConfigMap", lambda *a: None)
+    replay, again = store.watch_frames("ConfigMap", lambda *a: None,
+                                       since_rv=anchor)
+    assert replay == [] and again == anchor
+    with pytest.raises(GoneError):
+        store.watch_frames("ConfigMap", lambda *a: None,
+                           since_rv=anchor + 1)
+
+
+# --------------------------------------------------------- cross-shard GC
+
+
+def test_cascade_gc_sees_dependents_on_every_shard():
+    """An owner's dependents are spread across namespaces — and so
+    across shards. Deleting the owner must collect every one of them
+    (the cascade walks ALL shards under the full lock set), emitting
+    each DELETED with a fresh, still-monotonic rv."""
+    store = ClusterStore()
+    owner = store.create(cm("owner", ns="default"))
+    owner_uid = k8s.uid(owner)
+    dep_namespaces = [f"team-{i}" for i in range(16)]
+    shards_used = {_shard_index("ConfigMap", ns, DEFAULT_SHARDS)
+                   for ns in dep_namespaces}
+    assert len(shards_used) > 1  # the test premise: deps span shards
+    for ns in dep_namespaces:
+        dep = cm("dep", ns=ns)
+        dep["metadata"]["ownerReferences"] = [
+            {"kind": "ConfigMap", "name": "owner", "uid": owner_uid}]
+        store.create(dep)
+
+    deleted = []
+    store.watch("ConfigMap",
+                lambda ev: deleted.append((ev.type, k8s.namespace(ev.obj),
+                                           int(ev.obj["metadata"]
+                                               ["resourceVersion"]))))
+    store.delete("ConfigMap", "default", "owner")
+    got = [(ns, rv) for t, ns, rv in deleted if t == "DELETED"]
+    assert {ns for ns, _ in got} == set(dep_namespaces) | {"default"}
+    rvs = [rv for _, rv in got]
+    assert rvs == sorted(rvs) and len(rvs) == len(set(rvs))
+    for ns in dep_namespaces:
+        assert store.get_or_none("ConfigMap", ns, "dep") is None
+
+
+def test_cascade_honors_dependent_finalizer_across_shards():
+    store = ClusterStore()
+    owner = store.create(cm("owner2"))
+    dep = cm("held", ns="team-7")
+    dep["metadata"]["ownerReferences"] = [
+        {"kind": "ConfigMap", "name": "owner2", "uid": k8s.uid(owner)}]
+    dep["metadata"]["finalizers"] = ["example.com/hold"]
+    store.create(dep)
+    store.delete("ConfigMap", "default", "owner2")
+    held = store.get("ConfigMap", "team-7", "held")
+    assert held["metadata"]["deletionTimestamp"]
+    held["metadata"]["finalizers"] = []
+    store.update(held)
+    assert store.get_or_none("ConfigMap", "team-7", "held") is None
+
+
+# ----------------------------------------------------------- observability
+
+
+def test_write_lock_metric_observed_per_kind():
+    store = ClusterStore()
+    registry = MetricsRegistry()
+    store.attach_metrics(registry)
+    obj = store.create(cm("m1"))
+    obj["data"] = {"v": "2"}
+    store.update(obj)
+    store.delete("ConfigMap", "default", "m1")
+    store.create({"kind": "Notebook",
+                  "metadata": {"name": "nb", "namespace": "default"},
+                  "spec": {}})
+    store.list_page("ConfigMap", namespace="default", limit=10)
+    text = registry.expose()
+    for kind in ("ConfigMap", "Notebook"):
+        needle = f'store_write_lock_seconds_count{{kind="{kind}"}}'
+        (line,) = [ln for ln in text.splitlines() if ln.startswith(needle)]
+        assert float(line.split()[-1]) >= 1
+    assert "store_list_lock_seconds" in text
+
+
+def test_metric_registration_is_eager():
+    """attach_metrics registers the write/list histograms before any
+    write happens — an idle store still exposes the families, so dash
+    queries never 404 on a quiet frontend."""
+    store = ClusterStore()
+    registry = MetricsRegistry()
+    store.attach_metrics(registry)
+    text = registry.expose()
+    assert "store_write_lock_seconds" in text
+    assert "store_list_lock_seconds" in text
+    assert "watch_cache_evictions_total" in text
+
+
+# ------------------------------------------------------- degenerate configs
+
+
+@pytest.mark.parametrize("nshards", [1, 3])
+def test_non_default_shard_counts_full_semantics(nshards):
+    """Sharding is an optimization, not a semantic fork: the 1-shard
+    (fully serialized) and odd-count configs run the same CRUD + watch
+    + cascade behavior."""
+    store = ClusterStore(shards=nshards)
+    events = []
+    store.watch("ConfigMap", lambda ev: events.append(ev.type))
+    owner = store.create(cm("o", ns="a"))
+    dep = cm("d", ns="b")
+    dep["metadata"]["ownerReferences"] = [
+        {"kind": "ConfigMap", "name": "o", "uid": k8s.uid(owner)}]
+    store.create(dep)
+    got = store.get("ConfigMap", "a", "o")
+    got["data"] = {"v": "2"}
+    store.update(got)
+    store.delete("ConfigMap", "a", "o")
+    assert store.get_or_none("ConfigMap", "b", "d") is None
+    assert events == ["ADDED", "ADDED", "MODIFIED", "DELETED", "DELETED"]

@@ -1,0 +1,433 @@
+"""Binary wire codec and ``Accept``/``Content-Type`` negotiation.
+
+The codec (kubeflow_tpu/cluster/codec.py) is the apiserver's compact
+alternative to JSON: same data model, tagged tokens with string
+interning, self-contained messages. These tests pin three contracts:
+
+1. the codec itself — ``decode(encode(x)) == x`` for anything
+   ``json.dumps`` accepts (seeded property sweep), every truncation or
+   corruption raising ``CodecError`` rather than returning a partial
+   value, and the static intern table frozen as wire format;
+2. verb equivalence over the real HTTP stack — a binary client and a
+   JSON client observe byte-for-byte identical object state through
+   create/get/list/update/patch/update_status/delete, and a malformed
+   binary body (either direction) maps to PR-2 error semantics: 422 on
+   the server, a retryable transport error on the client;
+3. the mixed fleet — one binary and one JSON watcher on the same watch
+   ring receive the same event sequence, with the binary stream's
+   bytes/event measurably below the JSON stream's (the serialize-once
+   dual-encoding cache is exercised, not bypassed).
+"""
+
+import http.client
+import http.server
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.cluster import codec
+from kubeflow_tpu.cluster.apiserver import ApiServerProxy
+from kubeflow_tpu.cluster.errors import InvalidError, NotFoundError
+from kubeflow_tpu.cluster.http_client import (TRANSPORT_ERRORS, HttpApiClient,
+                                              MalformedBinaryError,
+                                              RetryPolicy)
+from kubeflow_tpu.utils import k8s
+from kubeflow_tpu.utils.metrics import MetricsRegistry
+
+
+@pytest.fixture()
+def server(store):
+    proxy = ApiServerProxy(store)
+    proxy.start()
+    yield proxy
+    proxy.stop()
+
+
+@pytest.fixture()
+def json_client(server):
+    cl = HttpApiClient(server.url)
+    yield cl
+    cl.close()
+
+
+@pytest.fixture()
+def bin_client(server):
+    cl = HttpApiClient(server.url, wire_format="binary")
+    yield cl
+    cl.close()
+
+
+def cm(name, ns="default", data=None, labels=None):
+    obj = {"kind": "ConfigMap", "apiVersion": "v1",
+           "metadata": {"name": name, "namespace": ns},
+           "data": data if data is not None else {"k": "v"}}
+    if labels:
+        obj["metadata"]["labels"] = labels
+    return obj
+
+
+def wait_for(fn, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        result = fn()
+        if result:
+            return result
+        time.sleep(0.01)
+    raise AssertionError(f"timeout waiting for {msg}")
+
+
+# ---------------------------------------------------------------- codec core
+
+
+def _rand_string(rng):
+    if rng.random() < 0.4:  # exercise both static-table hits and misses
+        return rng.choice(codec.STATIC_STRINGS)
+    n = rng.randrange(0, 24)
+    return "".join(rng.choice("abcxyz-_/.0189é☃") for _ in range(n))
+
+
+def _rand_value(rng, depth=0):
+    kinds = ["null", "bool", "int", "float", "str"]
+    if depth < 4:
+        kinds += ["list", "dict", "dict"]
+    kind = rng.choice(kinds)
+    if kind == "null":
+        return None
+    if kind == "bool":
+        return rng.random() < 0.5
+    if kind == "int":
+        # spans sub-byte, multi-byte varint, and >64-bit territory
+        return rng.choice([0, 1, -1, 63, -64, 2**31, -2**31,
+                           2**80 + 17, rng.randrange(-10**6, 10**6)])
+    if kind == "float":
+        return rng.choice([0.0, -0.5, 1.5e300, 3.141592653589793,
+                           rng.uniform(-1e9, 1e9)])
+    if kind == "str":
+        return _rand_string(rng)
+    if kind == "list":
+        return [_rand_value(rng, depth + 1)
+                for _ in range(rng.randrange(0, 6))]
+    return {_rand_string(rng) + str(i): _rand_value(rng, depth + 1)
+            for i in range(rng.randrange(0, 6))}
+
+
+def test_roundtrip_property_seeded():
+    """decode(encode(x)) == x across 300 seeded random documents, with
+    int/float identity preserved (JSON's own round-trip is the oracle
+    for model equivalence)."""
+    for seed in range(300):
+        rng = random.Random(seed)
+        value = _rand_value(rng)
+        out = codec.decode(codec.encode(value))
+        assert out == value, f"seed {seed}"
+        # the codec keeps exactly the JSON data model — anything it
+        # round-trips, json round-trips to the same value
+        assert json.loads(json.dumps(value)) == out, f"seed {seed}"
+
+
+def test_roundtrip_k8s_shaped_object():
+    obj = {"apiVersion": "kubeflow.org/v1", "kind": "Notebook",
+           "metadata": {"name": "wire-nb", "namespace": "team-a",
+                        "resourceVersion": "12345", "uid": "uid-7",
+                        "labels": {"notebook-name": "wire-nb"},
+                        "ownerReferences": [{"kind": "Notebook",
+                                             "name": "wire-nb",
+                                             "controller": True}]},
+           "spec": {"template": {"spec": {"containers": [
+               {"name": "nb", "image": "jupyter:1",
+                "resources": {"limits": {"cpu": "4", "memory": "8Gi"}}}]}}},
+           "status": {"readyReplicas": 1, "conditions": [
+               {"type": "Ready", "status": "True"}]}}
+    raw = codec.encode(obj)
+    assert codec.decode(raw) == obj
+    # the point of the codec: interning beats compact JSON on k8s shapes
+    assert len(raw) < len(json.dumps(obj, separators=(",", ":")).encode())
+
+
+def test_every_truncation_raises_codec_error():
+    """No prefix of a valid message decodes to anything — truncation at
+    every byte boundary is a loud CodecError, never a partial value."""
+    raw = codec.encode({"metadata": {"name": "x", "labels": {"a": "b"}},
+                        "items": [1, 2.5, None, True, "x" * 40]})
+    for cut in range(len(raw)):
+        with pytest.raises(codec.CodecError):
+            codec.decode(raw[:cut])
+
+
+def test_trailing_garbage_and_bad_envelope_rejected():
+    raw = codec.encode({"a": 1})
+    with pytest.raises(codec.CodecError):
+        codec.decode(raw + b"\x00")
+    with pytest.raises(codec.CodecError):
+        codec.decode(b"\x7f" + raw[1:])  # unknown envelope flag
+    with pytest.raises(codec.CodecError):
+        codec.decode(b"")
+    with pytest.raises(codec.CodecError):
+        codec.decode(b"\x01\xff\xff\xff")  # DEFLATE envelope, garbage body
+
+
+def test_unencodable_values_rejected():
+    with pytest.raises(codec.CodecError):
+        codec.encode({"x": object()})
+    with pytest.raises(codec.CodecError):
+        codec.encode({1: "non-string key"})
+
+
+def test_static_table_is_pinned_wire_format():
+    """The static intern table is wire format: entry 0 and the table
+    length are frozen under BINARY_CONTENT_TYPE v1 — growing it is fine
+    only with a media-type bump, reordering never is."""
+    assert codec.STATIC_STRINGS[0] == "apiVersion"
+    assert codec.STATIC_STRINGS[2] == "metadata"
+    assert len(codec.STATIC_STRINGS) == 65
+    assert len(set(codec.STATIC_STRINGS)) == len(codec.STATIC_STRINGS)
+    assert "v1" in codec.BINARY_CONTENT_TYPE
+
+
+def test_frame_event_parse_event_roundtrip():
+    payload = codec.encode({"metadata": {"name": "n"}})
+    framed = codec.frame_event("MODIFIED", payload)
+    (total,) = __import__("struct").unpack(">I", framed[:4])
+    assert total == len(framed) - 4
+    etype, obj = codec.parse_event(framed[4:])
+    assert etype == "MODIFIED"
+    assert obj == {"metadata": {"name": "n"}}
+
+
+def test_accepts_binary_negotiation():
+    assert codec.accepts_binary(codec.BINARY_CONTENT_TYPE)
+    assert codec.accepts_binary(
+        codec.BINARY_CONTENT_TYPE + ", application/json")
+    assert codec.accepts_binary(codec.BINARY_PATCH_CONTENT_TYPE)
+    assert not codec.accepts_binary("application/json")
+    assert not codec.accepts_binary(None)
+    assert not codec.accepts_binary("")
+    # the apiserver PATCH handler keys on the merge-patch substring
+    assert "merge-patch" in codec.BINARY_PATCH_CONTENT_TYPE
+
+
+# ------------------------------------------- verb equivalence over the wire
+
+
+def test_every_verb_binary_json_equivalence(json_client, bin_client):
+    """Property-style sweep: for seeded random payloads, every verb
+    performed by the binary client is observed identically by the JSON
+    client (and vice versa) — the codec is a transport detail, not a
+    semantic fork."""
+    for seed in range(6):
+        rng = random.Random(1000 + seed)
+        writer, reader = ((bin_client, json_client) if seed % 2 == 0
+                          else (json_client, bin_client))
+        name = f"eq-{seed}"
+        data = {f"key{i}": json.dumps(_rand_value(rng, depth=2))
+                for i in range(rng.randrange(1, 5))}
+        created = writer.create(cm(name, data=data))
+        assert reader.get("ConfigMap", "default", name) == created
+
+        # update through one wire, read back through the other
+        created["data"] = {"updated": "true"}
+        updated = writer.update(created)
+        assert reader.get("ConfigMap", "default", name) == updated
+
+        # merge-patch rides the binary patch media type when negotiated
+        patched = writer.patch("ConfigMap", "default", name,
+                               {"data": {"patched": "yes", "updated": None}})
+        assert patched["data"] == {"patched": "yes"}
+        assert reader.get("ConfigMap", "default", name) == patched
+
+        writer.delete("ConfigMap", "default", name)
+        with pytest.raises(NotFoundError):
+            reader.get("ConfigMap", "default", name)
+
+    # LIST equivalence over a populated namespace
+    for i in range(5):
+        bin_client.create(cm(f"list-{i}", labels={"app": "wire"}))
+    via_bin = bin_client.list("ConfigMap", namespace="default",
+                              label_selector={"app": "wire"})
+    via_json = json_client.list("ConfigMap", namespace="default",
+                                label_selector={"app": "wire"})
+    key = k8s.name
+    assert sorted(via_bin, key=key) == sorted(via_json, key=key)
+    assert len(via_bin) == 5
+
+
+def test_update_status_subresource_over_binary(json_client, bin_client):
+    nb = {"kind": "Notebook",
+          "metadata": {"name": "bin-nb", "namespace": "default"},
+          "spec": {"template": {"spec": {"containers": [
+              {"name": "nb", "image": "img"}]}}}}
+    created = bin_client.create(nb)
+    created["status"] = {"readyReplicas": 1}
+    created["spec"] = {"mangled": True}  # must NOT be applied via /status
+    bin_client.update_status(created)
+    got = json_client.get("Notebook", "default", "bin-nb")
+    assert got["status"] == {"readyReplicas": 1}
+    assert "mangled" not in got["spec"]
+
+
+def test_response_content_type_negotiated(server, bin_client):
+    """Raw-wire check: Accept: binary gets a binary body with the binary
+    Content-Type; no Accept gets JSON — and error Status bodies stay
+    JSON even for binary clients (debuggability of failures)."""
+    bin_client.create(cm("nego"))
+    host, port = server.url.replace("http://", "").split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=5)
+    try:
+        conn.request("GET", "/api/v1/namespaces/default/configmaps/nego",
+                     headers={"Accept": codec.BINARY_CONTENT_TYPE})
+        resp = conn.getresponse()
+        body = resp.read()
+        assert codec.BINARY_CONTENT_TYPE in resp.headers.get("Content-Type")
+        assert k8s.name(codec.decode(body)) == "nego"
+
+        conn.request("GET", "/api/v1/namespaces/default/configmaps/nego")
+        resp = conn.getresponse()
+        assert "application/json" in resp.headers.get("Content-Type")
+        assert k8s.name(json.loads(resp.read())) == "nego"
+
+        # 404 Status body: JSON always, regardless of Accept
+        conn.request("GET", "/api/v1/namespaces/default/configmaps/ghost",
+                     headers={"Accept": codec.BINARY_CONTENT_TYPE})
+        resp = conn.getresponse()
+        status = json.loads(resp.read())
+        assert resp.status == 404 and status["reason"] == "NotFound"
+    finally:
+        conn.close()
+
+
+# -------------------------------------------------- malformed-body semantics
+
+
+def test_malformed_binary_request_body_is_422(server):
+    """A garbled binary REQUEST body is the client's bug, not a
+    transport flake: the server answers 422 Invalid (a JSON Status),
+    never a 500 or a hang."""
+    host, port = server.url.replace("http://", "").split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=5)
+    try:
+        garbage = b"\x00\xde\xad\xbe\xef"
+        conn.request("POST", "/api/v1/namespaces/default/configmaps",
+                     body=garbage,
+                     headers={"Content-Type": codec.BINARY_CONTENT_TYPE})
+        resp = conn.getresponse()
+        status = json.loads(resp.read())
+        assert resp.status == 422
+        assert "malformed binary body" in status["message"]
+    finally:
+        conn.close()
+    with pytest.raises(InvalidError):
+        raise InvalidError(status["message"])  # taxonomy pin: 422 ⇒ Invalid
+
+
+class _GarbageBinaryHandler(http.server.BaseHTTPRequestHandler):
+    """Claims the binary Content-Type, serves undecodable bytes — the
+    truncated-proxy / corrupted-cache failure shape."""
+
+    hits = 0
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        type(self).hits += 1
+        body = b"\x00\xff\xff\xff\xff"
+        self.send_response(200)
+        self.send_header("Content-Type", codec.BINARY_CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+def test_malformed_binary_response_is_retryable_transport_error():
+    """PR-2 semantics: a binary body that fails to decode rides the
+    transport-retry path (bounded attempts, then the transport error
+    surfaces) — exactly like a JSONDecodeError on a truncated JSON
+    body, never a silent partial object."""
+    assert issubclass(MalformedBinaryError, TRANSPORT_ERRORS)
+    _GarbageBinaryHandler.hits = 0
+    httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                            _GarbageBinaryHandler)
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    client = HttpApiClient(
+        f"http://127.0.0.1:{httpd.server_address[1]}",
+        wire_format="binary",
+        retry_policy=RetryPolicy(max_attempts=3, backoff_base_s=0.01,
+                                 backoff_cap_s=0.02))
+    try:
+        with pytest.raises(MalformedBinaryError):
+            client.get("ConfigMap", "default", "x")
+        # GETs retry through transport errors: every attempt hit the wire
+        assert _GarbageBinaryHandler.hits == 3
+    finally:
+        client.close()
+        httpd.shutdown()
+        httpd.server_close()
+
+
+# ------------------------------------------------------------- mixed fleet
+
+
+def test_mixed_fleet_watch_same_ring(server, json_client, bin_client):
+    """One binary + one JSON watcher on the same watch ring: identical
+    event sequences (type, name, rv), and the fan-out accounting shows
+    the binary stream spending measurably fewer bytes per frame — the
+    dual-encoding frame cache serving both wire formats from one event."""
+    registry = MetricsRegistry()
+    server.attach_metrics(registry)
+    jc_events, bc_events = [], []
+
+    def rec(sink):
+        return lambda ev: sink.append(
+            (ev.type, k8s.name(ev.obj),
+             ev.obj["metadata"].get("resourceVersion")))
+
+    json_client.watch("ConfigMap", rec(jc_events), namespace="default")
+    bin_client.watch("ConfigMap", rec(bc_events), namespace="default")
+
+    # sentinel first: events racing the first-connect LIST+diff resync
+    # may legally deliver twice — score only the post-resync sequence
+    json_client.create(cm("sentinel"))
+    wait_for(lambda: any(n == "sentinel" for _, n, _ in jc_events) and
+             any(n == "sentinel" for _, n, _ in bc_events),
+             msg="sentinel on both streams")
+
+    for i in range(4):
+        obj = json_client.create(cm(f"fleet-{i}",
+                                    data={"payload": "x" * 64, "i": str(i)}))
+        if i % 2 == 0:
+            obj["data"]["updated"] = "yes"
+            obj = bin_client.update(obj)
+    bin_client.delete("ConfigMap", "default", "fleet-0")
+
+    want = 4 + 2 + 1  # ADDED ×4, MODIFIED ×2, DELETED ×1
+
+    def fleet(sink):
+        return [e for e in sink if e[1].startswith("fleet-")]
+
+    wait_for(lambda: len(fleet(jc_events)) >= want and
+             len(fleet(bc_events)) >= want,
+             msg="both fleets to drain the ring")
+    assert fleet(jc_events) == fleet(bc_events)
+    assert [t for t, _, _ in fleet(jc_events)].count("DELETED") == 1
+
+    text = registry.expose()
+
+    def series(fam, enc):
+        needle = f'{fam}{{encoding="{enc}"}}'
+        vals = [float(ln.split()[-1]) for ln in text.splitlines()
+                if ln.startswith(needle)]
+        assert vals, f"missing series {needle}"
+        return vals[0]
+
+    for enc in ("binary", "json"):
+        assert series("watch_frames_sent_total", enc) >= want
+    jpe = series("watch_fanout_bytes_total", "json") / \
+        series("watch_frames_sent_total", "json")
+    bpe = series("watch_fanout_bytes_total", "binary") / \
+        series("watch_frames_sent_total", "binary")
+    assert bpe < jpe, (
+        f"binary bytes/event {bpe:.1f} not below json {jpe:.1f}")
